@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic-ImageNet images/sec/chip.
+
+BASELINE.json metric: "ResNet-50/ImageNet images/sec/chip".  The reference
+publishes no numbers (``published: {}``); the north-star wall-clock anchor is
+"match 8×A100 NCCL reference wall-clock" — per-chip that is ~2,500 images/sec
+(MLPerf-class A100 ResNet-50 throughput), used here as ``vs_baseline``
+denominator so the ratio reads "fraction of an A100's ResNet-50 throughput
+per TPU chip".
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_IMAGES_PER_SEC = 2500.0  # per-GPU anchor (see module docstring)
+
+
+def main() -> None:
+    import optax
+
+    from distributedtensorflow_tpu.models import ResNet50
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.parallel.sharding import batch_spec
+    from distributedtensorflow_tpu.train import (
+        classification_loss,
+        create_sharded_state,
+        make_train_step,
+    )
+    from jax.sharding import NamedSharding
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    n_chips = mesh.size
+    per_chip_batch = 128
+    global_batch = per_chip_batch * n_chips
+
+    model = ResNet50(dtype=jnp.bfloat16)
+    init_fn = lambda r: model.init(r, jnp.zeros((2, 224, 224, 3)))
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(0.1, momentum=0.9, nesterov=True), mesh, rng
+    )
+    step = make_train_step(
+        classification_loss(model, weight_decay=1e-4), mesh, specs
+    )
+
+    # Device-resident synthetic batch: measures the compute+collective path
+    # (host input is benchmarked separately by the input-pipeline tests).
+    sharding = NamedSharding(mesh, batch_spec(mesh))
+    batch = {
+        "image": jax.device_put(
+            jax.random.normal(rng, (global_batch, 224, 224, 3), jnp.bfloat16),
+            sharding,
+        ),
+        "label": jax.device_put(
+            jax.random.randint(rng, (global_batch,), 0, 1000, jnp.int32),
+            sharding,
+        ),
+    }
+
+    # Warmup / compile.  NOTE: sync via a host value fetch, not
+    # block_until_ready — the final loss depends on the whole step chain, so
+    # fetching it forces execution on backends whose block_until_ready is a
+    # no-op (observed with the axon PJRT tunnel).
+    for _ in range(3):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+
+    n_steps = 30
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = n_steps * global_batch / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_synthetic_imagenet_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""Checkpoint/resume (SURVEY.md §5.4)."""
+
+from .manager import CheckpointManager  # noqa: F401
+from .preemption import PreemptionHandler  # noqa: F401

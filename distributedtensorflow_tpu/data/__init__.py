@@ -1,0 +1,12 @@
+"""Distributed input pipeline (host tf.data / synthetic → sharded device batches)."""
+
+from .input_pipeline import (  # noqa: F401
+    InputContext,
+    Prefetcher,
+    current_input_context,
+    device_put_batch,
+    make_input_fn_dataset,
+    shard_dataset,
+    synthetic_classification,
+    tfdata_iterator,
+)

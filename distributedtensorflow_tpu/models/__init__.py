@@ -1,0 +1,25 @@
+"""Model zoo: the reference's five workload models, TPU-first flax modules."""
+
+from .lenet import LeNet5  # noqa: F401
+from .resnet import (  # noqa: F401
+    CifarResNet,
+    ImageNetResNet,
+    ResNet20,
+    ResNet50,
+)
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertEncoder,
+    BertForMLM,
+    bert_base,
+    bert_layout,
+    bert_tiny,
+    mlm_loss,
+)
+from .widedeep import (  # noqa: F401
+    WideDeep,
+    WideDeepConfig,
+    widedeep_layout,
+    widedeep_loss,
+    widedeep_test_config,
+)

@@ -1,0 +1,183 @@
+"""BERT-base masked-LM — reference workload config #4 (BASELINE.json:
+"BERT-base MLM pretrain, gradient accumulation + CollectiveAllReduce").
+
+TPU-first choices:
+- bfloat16 activations, float32 params and layer-norm math;
+- attention exposed behind ``ops.attention.dot_product_attention`` so the
+  Pallas flash-attention kernel can drop in (SURVEY.md §7 step 9);
+- tensor-parallel-ready: QKV/MLP kernels are named so the Megatron sharding
+  rules in :func:`bert_layout` split heads / hidden over the ``model`` axis
+  with one all-reduce per block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import LayoutMap
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+def bert_base() -> "BertConfig":
+    return BertConfig()
+
+
+def bert_tiny() -> "BertConfig":
+    """Test-size config (2 layers, 128 hidden)."""
+    return BertConfig(
+        vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+        intermediate_size=512, max_position=128,
+    )
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(
+            (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name
+        )
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        out = dot_product_attention(q, k, v, mask=mask)
+        out = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(out)
+        if not deterministic:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=False)
+        return out
+
+
+class TransformerBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        attn_out = SelfAttention(cfg, name="attention")(x, mask, deterministic)
+        x = ln("ln_attn")(x + attn_out)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(h)
+        if not deterministic:
+            h = nn.Dropout(cfg.dropout_rate)(h, deterministic=False)
+        return ln("ln_mlp")(x + h)
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        seq_len = input_ids.shape[-1]
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       dtype=cfg.dtype, name="tok_embed")(input_ids)
+        pos = nn.Embed(cfg.max_position, cfg.hidden_size,
+                       dtype=cfg.dtype, name="pos_embed")(jnp.arange(seq_len))
+        x = tok + pos
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             dtype=cfg.dtype, name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x)
+        if not deterministic:
+            x = nn.Dropout(cfg.dropout_rate)(x, deterministic=False)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(cfg.num_layers):
+            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask, deterministic)
+        return x
+
+
+class BertForMLM(nn.Module):
+    """Encoder + tied-embedding MLM head."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        encoder = BertEncoder(cfg, name="encoder")
+        x = encoder(input_ids, token_type_ids, attention_mask, deterministic)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
+        x = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_out")(x)
+        return x
+
+
+def mlm_loss(model: BertForMLM):
+    """LossFn for masked-LM batches: {input_ids, labels, attention_mask}.
+
+    ``labels`` uses -100 (ignore) convention at unmasked positions.
+    """
+    import optax
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        labels = batch["labels"]
+        valid = labels >= 0
+        safe_labels = jnp.where(valid, labels, 0)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), safe_labels
+        )
+        denom = jnp.maximum(valid.sum(), 1)
+        loss = jnp.where(valid, per_tok, 0.0).sum() / denom
+        acc = (
+            jnp.where(valid, jnp.argmax(logits, -1) == safe_labels, False).sum()
+            / denom
+        )
+        return loss, ({"mlm_accuracy": acc.astype(jnp.float32)}, model_state)
+
+    return loss_fn
+
+
+def bert_layout() -> LayoutMap:
+    """Megatron-style tensor-parallel rules over the ``model`` mesh axis.
+
+    QKV and MLP-in shard their *output* features (column parallel); attention
+    out and MLP-out shard their *input* features (row parallel), so each
+    block needs exactly one all-reduce in forward — inserted automatically by
+    XLA from these shardings.  Embeddings shard rows (vocab), the sharded-
+    embedding capability of the reference's PS path (SURVEY.md §2.4 TP row).
+    """
+    return LayoutMap([
+        (r"(query|key|value)/kernel", P(None, "model", None)),
+        (r"attention/out/kernel", P("model", None, None)),
+        (r"mlp_in/kernel", P(None, "model")),
+        (r"mlp_out/kernel", P("model", None)),
+        (r"(tok|pos|type)_embed/embedding", P("model", None)),
+        (r"(query|key|value)/bias", P("model", None)),
+    ])

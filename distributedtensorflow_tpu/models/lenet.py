@@ -1,0 +1,30 @@
+"""LeNet-5 for MNIST — reference workload config #1 (BASELINE.json:
+"MNIST LeNet-5 single-worker, OneDeviceStrategy").
+
+Classic LeCun-98 shape: two conv+pool stages, then 120-84-10 dense head.
+Compute dtype defaults to float32 (the model is tiny; MXU gain is nil).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):  # x: (B, 28, 28, 1) or (B, 32, 32, 1)
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.tanh(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.tanh(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

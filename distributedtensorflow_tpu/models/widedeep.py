@@ -1,0 +1,104 @@
+"""Wide&Deep recommender — reference workload config #5 (BASELINE.json:
+"Async parameter-server Wide&Deep, ParameterServerStrategy, sparse embeddings").
+
+The reference shards its big embedding tables across parameter servers with
+``ShardedVariable`` + partitioners and trains async (SURVEY.md §3.3).  The
+TPU-native redesign (SURVEY.md §2.4 "Async PS" row, §7 hard parts):
+
+- embedding tables are *model-parallel sharded* over the ``model`` mesh axis
+  (rows split across devices, exactly the ``ShardedVariable`` layout) via
+  :func:`widedeep_layout`; lookups become XLA gathers on sharded tables with
+  automatic collective assembly;
+- training is synchronous SPMD — the async-PS *capability* (scale sparse
+  models past one host's memory) is preserved; the async *semantics* are
+  documented as a gap and partially covered by the coordinator module
+  (:mod:`distributedtensorflow_tpu.parallel.coordinator`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import LayoutMap
+
+
+@dataclasses.dataclass(frozen=True)
+class WideDeepConfig:
+    # one vocab size per categorical feature
+    vocab_sizes: Sequence[int] = (100_000, 10_000, 1_000, 100)
+    embed_dim: int = 64
+    num_dense_features: int = 13
+    mlp_dims: Sequence[int] = (1024, 512, 256)
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+def widedeep_test_config() -> WideDeepConfig:
+    return WideDeepConfig(
+        vocab_sizes=(512, 128), embed_dim=8, num_dense_features=4,
+        mlp_dims=(32, 16),
+    )
+
+
+class WideDeep(nn.Module):
+    """Binary-classification Wide&Deep (Cheng et al. 2016 shape).
+
+    Inputs: ``categorical`` (B, n_cat) int ids, ``dense`` (B, n_dense) floats.
+    Output: logit (B,).
+    """
+
+    cfg: WideDeepConfig
+
+    @nn.compact
+    def __call__(self, categorical, dense, train: bool = True):
+        cfg = self.cfg
+        # Deep part: learned embeddings per categorical feature.
+        embeds = []
+        wide_logits = []
+        for i, vocab in enumerate(cfg.vocab_sizes):
+            ids = categorical[:, i]
+            emb = nn.Embed(
+                vocab, cfg.embed_dim, dtype=cfg.dtype, name=f"embed_{i}"
+            )(ids)
+            embeds.append(emb)
+            # Wide part: per-id scalar weight = 1-dim embedding (the linear
+            # model over sparse crosses in the reference).
+            w = nn.Embed(vocab, 1, dtype=jnp.float32, name=f"wide_{i}")(ids)
+            wide_logits.append(w[:, 0])
+        deep = jnp.concatenate(embeds + [dense.astype(cfg.dtype)], axis=-1)
+        for j, dim in enumerate(cfg.mlp_dims):
+            deep = nn.relu(nn.Dense(dim, dtype=cfg.dtype, name=f"mlp_{j}")(deep))
+        deep_logit = nn.Dense(1, dtype=jnp.float32, name="deep_out")(deep)[:, 0]
+        wide_logit = sum(wide_logits) + nn.Dense(
+            1, dtype=jnp.float32, name="wide_dense"
+        )(dense.astype(jnp.float32))[:, 0]
+        return deep_logit + wide_logit
+
+
+def widedeep_layout() -> LayoutMap:
+    """Shard embedding-table rows over ``model`` — the ShardedVariable layout."""
+    return LayoutMap([
+        (r"embed_\d+/embedding", P("model", None)),
+        (r"wide_\d+/embedding", P("model", None)),
+    ])
+
+
+def widedeep_loss(model: WideDeep):
+    """Sigmoid cross-entropy LossFn for batches {categorical, dense, label}."""
+    import optax
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply(
+            {"params": params}, batch["categorical"], batch["dense"]
+        )
+        labels = batch["label"].astype(jnp.float32)
+        loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+        accuracy = jnp.mean(((logits > 0) == (labels > 0.5)).astype(jnp.float32))
+        return loss, ({"accuracy": accuracy}, model_state)
+
+    return loss_fn

@@ -1,0 +1,3 @@
+"""TPU ops: attention (XLA + Pallas kernels), fused primitives."""
+
+from .attention import dot_product_attention, xla_attention  # noqa: F401

@@ -1,0 +1,55 @@
+"""Attention ops: XLA reference implementation + kernel dispatch point.
+
+All attention in the framework routes through :func:`dot_product_attention`
+so fused kernels (Pallas flash attention, ring attention over the ``seq``
+axis — SURVEY.md §5.7) can replace the reference path without touching
+models.  The plain-XLA path is itself MXU-friendly: one batched matmul per
+score/value contraction, softmax in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative in bf16-safe range (bf16 max ~3.4e38; 1e9 fine)
+
+
+def dot_product_attention(
+    q: jax.Array,  # (B, S, H, D)
+    k: jax.Array,  # (B, S, H, D)
+    v: jax.Array,  # (B, S, H, D)
+    *,
+    mask: jax.Array | None = None,  # broadcastable to (B, H, Sq, Sk); True=keep
+    causal: bool = False,
+    implementation: str = "auto",  # "auto" | "xla" | "pallas"
+) -> jax.Array:
+    """Multi-head scaled dot-product attention, BSHD layout.
+
+    ``implementation="auto"`` picks the Pallas flash kernel on TPU when the
+    shapes allow, else the XLA path.
+    """
+    if implementation in ("auto", "pallas"):
+        from . import flash_attention  # noqa: PLC0415 (lazy: pallas optional)
+
+        if flash_attention.supported(q, k, v, mask=mask) or implementation == "pallas":
+            return flash_attention.flash_attention(q, k, v, mask=mask, causal=causal)
+    return xla_attention(q, k, v, mask=mask, causal=causal)
+
+
+def xla_attention(q, k, v, *, mask=None, causal=False):
+    orig_dtype = q.dtype
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(depth).astype(jnp.float32)
+    # (B, H, Sq, Sk) scores; contraction in input dtype (bf16 MXU), softmax fp32
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = scores.astype(jnp.float32)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal_mask, scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(orig_dtype), v)
+    return out

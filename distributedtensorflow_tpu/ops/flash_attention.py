@@ -1,0 +1,20 @@
+"""Flash-attention kernel dispatch (Pallas TPU).
+
+Placeholder gate for round-1 build order (SURVEY.md §7 step 9): the Pallas
+kernel lands behind :func:`supported`; until then everything routes to the
+XLA path, which XLA already fuses reasonably on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def supported(q, k, v, *, mask=None) -> bool:
+    return False
+
+
+def flash_attention(q, k, v, *, mask=None, causal=False) -> jax.Array:
+    from .attention import xla_attention  # noqa: PLC0415
+
+    return xla_attention(q, k, v, mask=mask, causal=causal)

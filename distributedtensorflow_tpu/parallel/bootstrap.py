@@ -1,0 +1,164 @@
+"""Multi-host cluster bootstrap.
+
+Replaces the reference stack's L4 layer (SURVEY.md §1): ``tf.train.Server`` +
+``ClusterSpec`` + ``TFConfigClusterResolver`` + the C++ coordination service.
+JAX bundles the same TSL-lineage coordination service; it is configured through
+``jax.distributed.initialize`` — heartbeats, barriers, and error propagation
+come with it, replacing the reference's gRPC server boot and Python
+``_check_health`` thread (SURVEY.md §3.2, §5.3).
+
+A ``TF_CONFIG``-compatible resolver shim is kept so `run_distributed.sh`-style
+launchers (one process per task, cluster described by a JSON env var —
+SURVEY.md §5.6) keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Resolved cluster topology — the ``ClusterSpec`` equivalent.
+
+    ``auto=True`` means "let ``jax.distributed.initialize`` discover the
+    cluster itself" (Cloud TPU pod metadata path) — the other fields are then
+    ignored.
+    """
+
+    coordinator_address: str | None = None  # "host:port" of process 0
+    num_processes: int = 1
+    process_id: int = 0
+    auto: bool = False
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return self.auto or self.num_processes > 1
+
+
+def parse_tf_config(tf_config_json: str) -> ClusterConfig:
+    """Parse a ``TF_CONFIG`` JSON blob into a :class:`ClusterConfig`.
+
+    Accepts the reference's format (SURVEY.md §5.6):
+    ``{"cluster": {"worker": ["h0:p", "h1:p"], ...}, "task": {"type": "worker",
+    "index": 0}}``.  The first worker is the coordinator (the reference's
+    "collective leader" / chief convention).  ``chief`` and ``ps`` job names
+    from the legacy ParameterServerStrategy launcher are folded into one flat
+    process list, ordered chief → worker → ps, matching the reference's
+    task-enumeration order.
+    """
+    cfg = json.loads(tf_config_json)
+    cluster = cfg.get("cluster", {})
+    task = cfg.get("task", {})
+    ordered_jobs = [j for j in ("chief", "worker", "ps") if j in cluster]
+    ordered_jobs += sorted(j for j in cluster if j not in ("chief", "worker", "ps", "evaluator"))
+    flat: list[str] = []
+    offsets: dict[str, int] = {}
+    for job in ordered_jobs:
+        offsets[job] = len(flat)
+        flat.extend(cluster[job])
+    if not flat:
+        return ClusterConfig()
+    task_type = task.get("type", "worker")
+    task_index = int(task.get("index", 0))
+    if task_type == "evaluator":
+        # Evaluator is outside the training cluster in TF semantics; treat as
+        # a standalone single process.
+        return ClusterConfig()
+    process_id = offsets.get(task_type, 0) + task_index
+    return ClusterConfig(
+        coordinator_address=flat[0],
+        num_processes=len(flat),
+        process_id=process_id,
+    )
+
+
+def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
+    """Resolve cluster topology from the environment.
+
+    Priority order (mirrors the reference's resolver chain, SURVEY.md §2.3):
+
+    1. JAX-native env vars (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
+       / ``JAX_PROCESS_ID``) — the modern launcher path.
+    2. ``TF_CONFIG`` — the reference's launcher contract.
+    3. Cloud TPU metadata — handled inside ``jax.distributed.initialize``
+       itself (args all None); we return an "auto" marker config.
+    """
+    env = dict(os.environ if env is None else env)
+    if "JAX_COORDINATOR_ADDRESS" in env:
+        return ClusterConfig(
+            coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
+            process_id=int(env.get("JAX_PROCESS_ID", "0")),
+        )
+    if env.get("TF_CONFIG"):
+        return parse_tf_config(env["TF_CONFIG"])
+    # Cloud TPU pod: the libtpu/metadata env describes a multi-host slice;
+    # jax.distributed.initialize(None, ...) self-discovers the cluster there.
+    hostnames = env.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h]) > 1:
+        return ClusterConfig(auto=True)
+    return ClusterConfig()
+
+
+def initialize(cluster: ClusterConfig | None = None) -> ClusterConfig:
+    """Bring up the distributed runtime (idempotent).
+
+    Single-process resolutions skip ``jax.distributed.initialize`` entirely so
+    local runs never wait on a coordination service — the reference's
+    "cluster_spec empty → local" branch (SURVEY.md §3.2).
+    """
+    global _initialized
+    cluster = cluster or resolve_cluster()
+    if _initialized:
+        return cluster
+    if cluster.auto:
+        # Cloud TPU metadata self-discovery (SURVEY.md §5.6 build equivalent)
+        jax.distributed.initialize()
+        logger.info(
+            "distributed runtime up (auto): process %d/%d",
+            jax.process_index(), jax.process_count(),
+        )
+    elif cluster.is_multiprocess:
+        jax.distributed.initialize(
+            coordinator_address=cluster.coordinator_address,
+            num_processes=cluster.num_processes,
+            process_id=cluster.process_id,
+        )
+        logger.info(
+            "distributed runtime up: process %d/%d, coordinator %s",
+            cluster.process_id,
+            cluster.num_processes,
+            cluster.coordinator_address,
+        )
+    _initialized = True
+    return cluster
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized and jax.process_count() > 1:
+        jax.distributed.shutdown()
+    _initialized = False
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_chief() -> bool:
+    """Chief-only convention for checkpoint/metric writing (SURVEY.md §5.5)."""
+    return jax.process_index() == 0

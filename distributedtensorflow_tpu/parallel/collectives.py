@@ -1,0 +1,232 @@
+"""Typed collective wrappers over XLA's ICI/DCN collectives.
+
+Replaces the reference stack's L0–L2 communication layers (SURVEY.md §2.2):
+the C++ ring/NCCL collective executor plus the Python ``CrossDeviceOps`` /
+``CollectiveReplicaLauncher`` dispatch.  Here the XLA compiler plays the role
+of ``NcclManager`` — topology-aware algorithm selection, fusion, and
+compute/communication overlap — so these wrappers stay thin: they add axis-name
+typing, pytree conveniences, and the reference's gradient-packing policy
+(``group_by_size``), and are valid inside ``jit`` / ``shard_map``.
+
+No group/instance-key negotiation survives: XLA's static schedule makes the
+reference's collective ordering tokens and launch-order deadlock workarounds
+(SURVEY.md §5.2) unnecessary by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+AxisSpec = str | tuple[str, ...]
+
+
+class ReduceOp(enum.Enum):
+    """Reduction kinds, mirroring ``tf.distribute.ReduceOp`` (+ min/max)."""
+
+    SUM = "sum"
+    MEAN = "mean"
+    MAX = "max"
+    MIN = "min"
+
+
+class Implementation(enum.Enum):
+    """Reference-parity knob (``CommunicationImplementation`` — SURVEY.md §5.6).
+
+    On TPU there is nothing to pick: XLA lowers to ICI/DCN automatically.
+    Retained so reference configs parse; AUTO is the only honest value.
+    """
+
+    AUTO = "auto"
+    RING = "ring"  # accepted, ignored (XLA chooses)
+    NCCL = "nccl"  # accepted, ignored (no NCCL on TPU)
+
+
+@dataclasses.dataclass(frozen=True)
+class Options:
+    """Collective tuning knobs (reference: ``tf.distribute.experimental
+    .CommunicationOptions``, ``collective_util.py:117``).
+
+    ``bytes_per_pack`` feeds :func:`packed_all_reduce`; ``timeout_seconds`` is
+    honored by the watchdog in :mod:`distributedtensorflow_tpu.utils.watchdog`
+    (XLA collectives cannot time out individually — a hang is surfaced by the
+    coordination service / watchdog instead, SURVEY.md §5.2).
+    """
+
+    bytes_per_pack: int = 0  # 0 = one pack per leaf (no repacking)
+    timeout_seconds: float | None = None
+    implementation: Implementation = Implementation.AUTO
+
+
+def _as_tuple(axis: AxisSpec) -> tuple[str, ...]:
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def all_reduce(x: jax.Array, axis: AxisSpec, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+    """All-reduce ``x`` over mesh axis/axes (inside shard_map/jit)."""
+    axis = _as_tuple(axis)
+    if op is ReduceOp.SUM:
+        return lax.psum(x, axis)
+    if op is ReduceOp.MEAN:
+        return lax.pmean(x, axis)
+    if op is ReduceOp.MAX:
+        return lax.pmax(x, axis)
+    if op is ReduceOp.MIN:
+        return lax.pmin(x, axis)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def tree_all_reduce(
+    tree: PyTree, axis: AxisSpec, op: ReduceOp = ReduceOp.SUM
+) -> PyTree:
+    """All-reduce every leaf of a pytree (the gradient-sync primitive).
+
+    The XLA scheduler fuses/overlaps these; equivalent of the reference's
+    ``batch_all_reduce`` (``cross_device_utils.py:407``).
+    """
+    return jax.tree.map(functools.partial(all_reduce, axis=axis, op=op), tree)
+
+
+def all_gather(
+    x: jax.Array, axis: AxisSpec, *, gather_axis: int = 0, tiled: bool = True
+) -> jax.Array:
+    """Gather shards along ``gather_axis`` from all devices on mesh ``axis``.
+
+    Reference: ``Strategy.gather`` / ``collective_ops.all_gather_v2``
+    (SURVEY.md §1 L1).
+    """
+    return lax.all_gather(x, _as_tuple(axis), axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(
+    x: jax.Array, axis: AxisSpec, *, scatter_axis: int = 0
+) -> jax.Array:
+    """Sum-reduce then scatter shards along ``scatter_axis``.
+
+    The ZeRO building block (reference analogue: ``NcclReduceScatterer``,
+    ``collective_nccl_reducer.h:34``).
+    """
+    return lax.psum_scatter(x, _as_tuple(axis), scatter_dimension=scatter_axis, tiled=True)
+
+
+def broadcast(x: jax.Array, axis: AxisSpec, *, src: int = 0) -> jax.Array:
+    """Broadcast the value from mesh-position ``src`` on ``axis`` to all.
+
+    Reference: hierarchical tree broadcast / ``broadcast_send_v2``
+    (SURVEY.md §2.2).  XLA lowers the masked psum to an optimal broadcast.
+    ``where`` (not multiply) masking: NaN/Inf garbage in non-source shards
+    must not poison the sum.
+    """
+    axis = _as_tuple(axis)
+    idx = lax.axis_index(axis[0]) if len(axis) == 1 else _linear_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def _linear_index(axes: tuple[str, ...]) -> jax.Array:
+    idx = jnp.int32(0)
+    for a in axes:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def permute(
+    x: jax.Array, axis: str, perm: Sequence[tuple[int, int]]
+) -> jax.Array:
+    """Point-to-point permutation (reference: ``Permuter``, ``permuter.h:45``)."""
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def shift(x: jax.Array, axis: str, *, offset: int = 1) -> jax.Array:
+    """Rotate shards around mesh ``axis`` — the ring-attention step primitive."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def all_to_all(
+    x: jax.Array, axis: str, *, split_axis: int, concat_axis: int, tiled: bool = True
+) -> jax.Array:
+    """All-to-all resharding (Ulysses head↔sequence swap; MoE token dispatch).
+
+    Reference exposes only the generic op (``collective_ops.py:501``); here it
+    is a first-class primitive (SURVEY.md §5.7).
+    """
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+# --- Gradient packing (reference ``group_by_size``, cross_device_ops.py:1150).
+#
+# XLA already fuses small all-reduces, so packing is OFF by default; it exists
+# for reference parity and for experiments on DCN-spanning meshes where fewer,
+# larger collectives can win.
+
+
+def pack_by_size(
+    leaves: Sequence[jax.Array], bytes_per_pack: int
+) -> list[list[int]]:
+    """Greedy bucketing of leaf indices, preserving order within a pack.
+
+    Mirrors the reference's ``group_by_size`` (leaves are packed in reverse
+    gradient order there; order is the caller's concern here).  A pack never
+    mixes dtypes: concatenating mixed-dtype leaves would silently promote
+    (bf16 grads becoming fp32), changing output dtypes vs the unpacked path.
+    """
+    if bytes_per_pack <= 0:
+        return [[i] for i in range(len(leaves))]
+    packs: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i, leaf in enumerate(leaves):
+        nbytes = leaf.size * leaf.dtype.itemsize
+        if cur and (cur_bytes + nbytes > bytes_per_pack or leaf.dtype != cur_dtype):
+            packs.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        cur_dtype = leaf.dtype
+    if cur:
+        packs.append(cur)
+    return packs
+
+
+def packed_all_reduce(
+    tree: PyTree,
+    axis: AxisSpec,
+    *,
+    options: Options | None = None,
+    op: ReduceOp = ReduceOp.SUM,
+) -> PyTree:
+    """All-reduce a pytree with optional flatten-concat-reduce-split packing."""
+    options = options or Options()
+    leaves, treedef = jax.tree.flatten(tree)
+    if options.bytes_per_pack <= 0:
+        return treedef.unflatten(
+            [all_reduce(leaf, axis, op) for leaf in leaves]
+        )
+    packs = pack_by_size(leaves, options.bytes_per_pack)
+    out: list[jax.Array | None] = [None] * len(leaves)
+    for pack in packs:
+        if len(pack) == 1:
+            i = pack[0]
+            out[i] = all_reduce(leaves[i], axis, op)
+            continue
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in pack])
+        reduced = all_reduce(flat, axis, op)
+        offset = 0
+        for i in pack:
+            n = leaves[i].size
+            out[i] = reduced[offset : offset + n].reshape(leaves[i].shape)
+            offset += n
+    return treedef.unflatten(out)

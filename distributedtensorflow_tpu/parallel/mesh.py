@@ -1,0 +1,173 @@
+"""Device-mesh core: the TPU-native replacement for the tf.distribute strategy zoo.
+
+In the reference stack, parallelism is chosen by picking a *strategy object*
+(``OneDeviceStrategy`` / ``MirroredStrategy`` / ``MultiWorkerMirroredStrategy``
+/ ``ParameterServerStrategyV2`` — see SURVEY.md §2.1).  On TPU the idiomatic
+equivalent is a single SPMD program parameterized by a ``jax.sharding.Mesh``:
+each strategy is *just a mesh shape* (SURVEY.md §7 step 1, §2.4 matrix).
+
+Canonical mesh axes (slowest-varying first — outer axes ride DCN between
+slices, inner axes ride ICI within a slice, so keep bandwidth-hungry axes
+innermost):
+
+=========  ===========================================================
+``data``   pure data parallelism (gradient all-reduce; replaces the
+           MirroredStrategy / MultiWorkerMirroredStrategy replica axis)
+``fsdp``   data parallelism with sharded params/optimizer state
+           (ZeRO-style weight-update sharding)
+``pipe``   pipeline-parallel stage axis (GPipe-style; absent from the
+           reference stack — new capability)
+``seq``    sequence/context parallelism (ring attention / Ulysses;
+           absent from the reference stack — new capability)
+``expert`` expert parallelism for MoE (new capability)
+``model``  tensor/model parallelism (Megatron-style; generalizes the
+           reference's PS ShardedVariable embedding sharding)
+=========  ===========================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Mesh-major order. ``data`` outermost (can span DCN), ``model`` innermost
+# (needs the fastest ICI links for per-layer collectives).
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_PIPE = "pipe"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+AXIS_MODEL = "model"
+
+CANONICAL_AXES: tuple[str, ...] = (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+)
+
+#: Axes over which gradients of replicated parameters are summed.
+BATCH_AXES: tuple[str, ...] = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape over the canonical axes.
+
+    Any single axis may be ``-1`` meaning "all remaining devices".  Axes of
+    size 1 are kept in the mesh (size-1 collectives are no-ops that XLA
+    removes), so downstream sharding rules can always name every canonical
+    axis without caring which ones are active.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+    model: int = 1
+
+    def sizes(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.pipe, self.seq, self.expert, self.model)
+
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        """Concrete per-axis sizes for ``n_devices``, expanding a single -1."""
+        sizes = list(self.sizes())
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got spec {self}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh spec {self} needs {fixed} devices, have {n_devices}"
+            )
+        return tuple(sizes)
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        return build_mesh(self, devices)
+
+
+def build_mesh(
+    spec: MeshSpec, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with ICI-topology-aware device order.
+
+    ``mesh_utils.create_device_mesh`` assigns devices so that innermost mesh
+    axes map to nearest-neighbor ICI links on the TPU torus (the role
+    NcclManager's topology detection plays in the reference stack —
+    SURVEY.md §5.8).
+    """
+    if devices is None:
+        devices = jax.devices()
+    sizes = spec.sizes()
+    if -1 not in sizes:
+        # fully-fixed spec: take a prefix of the available devices, so e.g.
+        # OneDeviceStrategy semantics (data=1) work on a multi-device host.
+        # Single-process only: in a multi-host job a prefix mesh would contain
+        # devices other processes can't address — that needs an explicit
+        # device list from the caller.
+        needed = math.prod(sizes)
+        if needed < len(devices):
+            if jax.process_count() > 1:
+                raise ValueError(
+                    f"mesh spec {spec} uses {needed} of {len(devices)} global "
+                    "devices; sub-mesh selection is single-process only — "
+                    "pass an explicit `devices` list (or use -1 axes) in "
+                    "multi-host jobs"
+                )
+            devices = list(devices)[:needed]
+    shape = spec.resolve(len(devices))
+    if len(devices) == 1:
+        dev_array = np.asarray(devices).reshape(shape)
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=list(devices), allow_split_physical_axes=True
+            )
+        except (NotImplementedError, ValueError):
+            # Non-TPU backends (CPU test meshes) have no physical topology.
+            dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, CANONICAL_AXES)
+
+
+# --- Strategy-zoo presets: each reference strategy is just a mesh shape. ---
+
+
+def one_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """``OneDeviceStrategy`` equivalent: a 1×1×…×1 mesh on one device."""
+    devices = [device] if device is not None else jax.local_devices()[:1]
+    return build_mesh(MeshSpec(data=1), devices)
+
+
+def mirrored_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """``MirroredStrategy`` equivalent: all *local* devices on the data axis."""
+    return build_mesh(MeshSpec(data=-1), devices or jax.local_devices())
+
+
+def multi_worker_mesh() -> Mesh:
+    """``MultiWorkerMirroredStrategy`` equivalent: all *global* devices on data."""
+    return build_mesh(MeshSpec(data=-1), jax.devices())
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The batch axes present in ``mesh`` (for gradient psum / batch sharding)."""
+    return tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+
+
+def replica_count(mesh: Mesh) -> int:
+    """Number of data-parallel replicas (product of batch-axis sizes)."""
+    return math.prod(mesh.shape[a] for a in data_axes(mesh))

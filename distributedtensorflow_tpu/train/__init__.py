@@ -1,0 +1,10 @@
+"""SPMD training engine: state, train/eval step compilation, losses."""
+
+from .state import TrainState, create_sharded_state, split_variables  # noqa: F401
+from .engine import (  # noqa: F401
+    accumulate_gradients,
+    make_eval_step,
+    make_train_step,
+    split_microbatches,
+)
+from .losses import classification_eval, classification_loss  # noqa: F401

@@ -1,0 +1,168 @@
+"""The SPMD train-step engine — one engine for the whole strategy zoo.
+
+Replaces the reference's L3 sync strategies and L6 trainer plumbing
+(SURVEY.md §3.1): where TF builds a cross-replica graph with one Python
+thread per replica, a ``merge_call`` barrier, and an explicit
+``CollectiveAllReduce`` launch, here the *entire* train step is a single
+jitted SPMD program:
+
+- data parallelism comes from sharding the batch over the ``data``/``fsdp``
+  mesh axes; XLA's sharding propagation inserts the gradient all-reduce
+  (reduce-scatter + all-gather under fsdp) over ICI — the compiled
+  equivalent of ``NcclReducer`` (SURVEY.md §2.2);
+- gradient accumulation (the reference's BERT config,
+  ``base_optimizer.py:79-108``) is a ``lax.scan`` over microbatches inside
+  the same program;
+- OneDevice / Mirrored / MultiWorkerMirrored are not code paths — they are
+  mesh shapes (SURVEY.md §7 step 4).
+
+Loss-function contract::
+
+    loss_fn(params, model_state, batch, rng)
+        -> (scalar_loss, (metrics_dict, new_model_state))
+
+``model_state`` carries non-trainable collections (batch_stats); models
+without any pass ``{}`` through unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel import sharding as shardlib
+from .state import TrainState
+
+PyTree = Any
+
+LossFn = Callable[
+    [PyTree, PyTree, PyTree, jax.Array],
+    tuple[jax.Array, tuple[dict[str, jax.Array], PyTree]],
+]
+
+
+def split_microbatches(batch: PyTree, accum_steps: int) -> PyTree:
+    """Reshape each leaf (B, ...) -> (accum_steps, B//accum_steps, ...)."""
+
+    def split(x):
+        b = x.shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"batch dim {b} not divisible by accum_steps={accum_steps}"
+            )
+        return x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
+
+    return jax.tree.map(split, batch)
+
+
+def accumulate_gradients(
+    loss_fn: LossFn,
+    params: PyTree,
+    model_state: PyTree,
+    batch: PyTree,
+    rng: jax.Array,
+    accum_steps: int,
+) -> tuple[PyTree, dict[str, jax.Array], PyTree]:
+    """Gradient accumulation as a ``lax.scan`` over microbatches.
+
+    Keeps memory flat (one microbatch of activations live at a time) while
+    XLA still sees a single fused program — the TPU-idiomatic version of the
+    reference's optimizer-level accumulation.  Returns
+    ``(grads, metrics, new_model_state)`` with grads/metrics averaged over
+    microbatches.
+    """
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if accum_steps <= 1:
+        (loss, (metrics, new_mstate)), grads = grad_fn(
+            params, model_state, batch, rng
+        )
+        return grads, dict(metrics, loss=loss), new_mstate
+
+    micro = split_microbatches(batch, accum_steps)
+    rngs = jax.random.split(rng, accum_steps)
+
+    def body(carry, xs):
+        grads_acc, metrics_acc, mstate = carry
+        mb, r = xs
+        (loss, (metrics, mstate)), grads = grad_fn(params, mstate, mb, r)
+        metrics = dict(metrics, loss=loss)
+        grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+        metrics_acc = jax.tree.map(jnp.add, metrics_acc, metrics)
+        return (grads_acc, metrics_acc, mstate), None
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    mb0 = jax.tree.map(lambda x: x[0], micro)
+    (loss_s, (metrics_s, _)), _ = jax.eval_shape(
+        grad_fn, params, model_state, mb0, rngs[0]
+    )
+    zero_metrics = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), dict(metrics_s, loss=loss_s)
+    )
+
+    (grads, metrics, new_mstate), _ = lax.scan(
+        body, (zero_grads, zero_metrics, model_state), (micro, rngs)
+    )
+    inv = 1.0 / accum_steps
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    metrics = jax.tree.map(lambda m: m * inv, metrics)
+    return grads, metrics, new_mstate
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    mesh: Mesh,
+    state_specs: TrainState,
+    *,
+    accum_steps: int = 1,
+    donate: bool = True,
+) -> Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, dict[str, jax.Array]]]:
+    """Compile the full train step over ``mesh``.
+
+    The returned function has signature ``(state, batch, rng) -> (state,
+    metrics)``.  ``batch`` leaves must have a leading global-batch dimension;
+    it is sharded over the batch axes.  ``state`` is donated: parameters are
+    updated in place in HBM (no double-buffering of the model).
+    """
+    batch_sharding = NamedSharding(mesh, shardlib.batch_spec(mesh))
+    state_shardings = shardlib.named_shardings(mesh, state_specs)
+    repl = NamedSharding(mesh, P())
+
+    def step(state: TrainState, batch: PyTree, rng: jax.Array):
+        # Fold the step counter into the rng so dropout etc. differs per step
+        # without threading a new key from the host.
+        rng = jax.random.fold_in(rng, state.step)
+        grads, metrics, new_mstate = accumulate_gradients(
+            loss_fn, state.params, state.model_state, batch, rng, accum_steps
+        )
+        new_state = state.apply_gradients(grads).replace(model_state=new_mstate)
+        return new_state, metrics
+
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, batch_sharding, repl),
+        out_shardings=(state_shardings, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(
+    metric_fn: Callable[[PyTree, PyTree, PyTree], dict[str, jax.Array]],
+    mesh: Mesh,
+    state_specs: TrainState,
+) -> Callable[[TrainState, PyTree], dict[str, jax.Array]]:
+    """Compile an eval step: ``metric_fn(params, model_state, batch)``."""
+    batch_sharding = NamedSharding(mesh, shardlib.batch_spec(mesh))
+    param_shardings = shardlib.named_shardings(mesh, state_specs.params)
+    mstate_shardings = shardlib.named_shardings(mesh, state_specs.model_state)
+    repl = NamedSharding(mesh, P())
+
+    jitted = jax.jit(
+        metric_fn,
+        in_shardings=(param_shardings, mstate_shardings, batch_sharding),
+        out_shardings=repl,
+    )
+    return lambda state, batch: jitted(state.params, state.model_state, batch)

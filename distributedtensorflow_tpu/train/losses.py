@@ -1,0 +1,82 @@
+"""Loss/metric builders bridging flax models to the engine's LossFn contract.
+
+The reference's equivalent glue is Keras ``compile(loss=..., metrics=...)``
+plus the distributed-aggregation logic inside ``TFOptimizer``
+(SURVEY.md §2.3 "Keras distributed optimizer") — here aggregation needs no
+code at all: metrics come out of the jitted step already globally reduced,
+because the batch is sharded and the mean is a global mean under SPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+PyTree = Any
+
+
+def _apply(model, params, model_state, x, train: bool):
+    """Run a flax module, handling mutable collections if present."""
+    variables = {"params": params, **model_state}
+    if train and model_state:
+        out, new_mstate = model.apply(
+            variables, x, train=True, mutable=list(model_state.keys())
+        )
+        return out, dict(new_mstate)
+    return model.apply(variables, x, train=train), model_state
+
+
+def classification_loss(
+    model,
+    *,
+    weight_decay: float = 0.0,
+    inputs_key: str = "image",
+    labels_key: str = "label",
+) -> Callable:
+    """Softmax cross-entropy LossFn for image classifiers.
+
+    ``weight_decay`` is classic L2 on kernel params (the benchmark ResNet-50
+    recipe applies it in the loss, not the optimizer, when using momentum).
+    """
+
+    def loss_fn(params, model_state, batch, rng):
+        logits, new_mstate = _apply(
+            model, params, model_state, batch[inputs_key], train=True
+        )
+        labels = batch[labels_key]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+        if weight_decay:
+            l2 = sum(
+                jnp.sum(jnp.square(p))
+                for path, p in jax.tree.leaves_with_path(params)
+                if p.ndim > 1
+            )
+            loss = loss + 0.5 * weight_decay * l2
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, ({"accuracy": accuracy}, new_mstate)
+
+    return loss_fn
+
+
+def classification_eval(
+    model, *, inputs_key: str = "image", labels_key: str = "label"
+) -> Callable:
+    """Eval metric_fn: loss + top-1 accuracy, no mutable-state update."""
+
+    def metric_fn(params, model_state, batch):
+        logits, _ = _apply(
+            model, params, model_state, batch[inputs_key], train=False
+        )
+        labels = batch[labels_key]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return {"loss": loss, "accuracy": accuracy}
+
+    return metric_fn

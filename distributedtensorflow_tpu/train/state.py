@@ -1,0 +1,133 @@
+"""Train state: params + mutable model state + optimizer state as one pytree.
+
+Replaces the reference's ``DistributedVariable`` zoo (``values.py`` —
+SURVEY.md §2.1): instead of wrapper objects with per-replica copies and
+read/write policies, state is a plain pytree of ``jax.Array`` s whose
+``NamedSharding`` carries the distribution; mirrored-vs-sharded is a
+PartitionSpec, not a class.  ``model_state`` holds non-trainable collections
+(batch-norm statistics — the reference's ``SyncOnReadVariable`` role:
+cross-replica aggregation happens via a psum inside the step, not via a
+read-time policy object).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import FrozenDict
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel import sharding as shardlib
+
+PyTree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    """Minimal, engine-agnostic training state."""
+
+    step: jax.Array
+    params: PyTree
+    model_state: PyTree  # non-trainable collections (batch_stats, ...)
+    opt_state: PyTree
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: PyTree) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state
+        )
+
+
+def split_variables(variables: PyTree) -> tuple[PyTree, PyTree]:
+    """Split a flax ``init`` variables dict into (params, model_state)."""
+    if isinstance(variables, (dict, FrozenDict)) and "params" in variables:
+        d = dict(variables)
+        params = d.pop("params")
+        return params, d
+    return variables, {}
+
+
+def create_sharded_state(
+    init_fn: Callable[[jax.Array], PyTree],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: jax.Array,
+    *,
+    rules: shardlib.LayoutMap | Callable | None = None,
+    fsdp: bool = False,
+) -> tuple[TrainState, "TrainState"]:
+    """Initialize a TrainState directly into its target sharding.
+
+    ``init_fn(rng)`` returns a flax-style variables dict (``{"params": ...,
+    "batch_stats": ...}``) or a bare params pytree.  Params are produced by
+    ``jit`` with ``out_shardings`` so large models initialize shard-local on
+    each device — no host-side full copy (the reference initializes under
+    ``strategy.scope()`` for the same reason, SURVEY.md §3.3).
+
+    Returns ``(state, state_specs)`` where ``state_specs`` is a TrainState of
+    PartitionSpecs (for use as jit shardings).
+    """
+    var_shapes = jax.eval_shape(init_fn, rng)
+    param_shapes, mstate_shapes = split_variables(var_shapes)
+    param_specs = shardlib.specs_for_tree(param_shapes, mesh, rules, fsdp=fsdp)
+    mstate_specs = shardlib.specs_for_tree(mstate_shapes, mesh, rules)
+
+    opt_shapes = jax.eval_shape(lambda p: tx.init(p), param_shapes)
+    opt_specs = _opt_state_specs(opt_shapes, param_shapes, param_specs)
+
+    state_specs = TrainState(
+        step=P(), params=param_specs, model_state=mstate_specs,
+        opt_state=opt_specs, tx=tx,
+    )
+
+    def build(r):
+        params, model_state = split_variables(init_fn(r))
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            model_state=model_state, opt_state=tx.init(params), tx=tx,
+        )
+
+    out_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), state_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    state = jax.jit(build, out_shardings=out_shardings)(rng)
+    return state, state_specs
+
+
+def _opt_state_specs(opt_shapes: PyTree, param_shapes: PyTree, param_specs: PyTree) -> PyTree:
+    """Shard optimizer slots like their parameters (Adam m/v mirror params).
+
+    Optimizer-state nodes that are param-tree-shaped (momentum, variance,
+    trace, ...) inherit the parameter specs; everything else (step counters)
+    replicates.  This is the default ZeRO-consistent placement: slots live
+    wherever their parameter lives (SURVEY.md §7 step 3).
+    """
+    param_treedef = jax.tree.structure(param_shapes)
+
+    def specs_for_subtree(sub: PyTree) -> PyTree:
+        if jax.tree.structure(sub) == param_treedef:
+            shapes = jax.tree.leaves(param_shapes)
+            leaves = jax.tree.leaves(sub)
+            if all(
+                tuple(a.shape) == tuple(b.shape) for a, b in zip(leaves, shapes)
+            ):
+                return jax.tree.unflatten(
+                    jax.tree.structure(sub), jax.tree.leaves(param_specs)
+                )
+        return jax.tree.map(lambda _: P(), sub)
+
+    def walk(node):
+        if isinstance(node, tuple) and not hasattr(node, "shape"):
+            children = [walk(c) for c in node]
+            if hasattr(node, "_fields"):  # namedtuple (optax state nodes)
+                return type(node)(*children)
+            return tuple(children)
+        return specs_for_subtree(node)
+
+    return walk(opt_shapes)

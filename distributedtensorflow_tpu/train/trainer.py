@@ -1,0 +1,125 @@
+"""Trainer: the fit-loop around the compiled SPMD step.
+
+Replaces the reference's Keras ``Model.fit`` layer (SURVEY.md §2.3 "Keras
+trainer"): step loop, periodic logging/eval, throughput counters, checkpoint
+hooks.  Deliberately thin — all the distribution lives in the compiled step;
+the loop is plain host Python and identical on 1 chip or a pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Iterable
+
+import jax
+
+from ..utils.metrics import MetricWriter, ThroughputMeter
+from .state import TrainState
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    total_steps: int
+    log_every: int = 50
+    eval_every: int = 0  # 0 = no eval
+    eval_steps: int = 10
+    checkpoint_every: int = 0  # 0 = no checkpointing
+    global_batch_size: int = 0
+    logdir: str | None = None
+
+
+class Trainer:
+    def __init__(
+        self,
+        train_step: Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, dict]],
+        config: TrainerConfig,
+        *,
+        eval_step: Callable[[TrainState, PyTree], dict] | None = None,
+        checkpointer=None,  # checkpoint.CheckpointManager-compatible
+    ):
+        self.train_step = train_step
+        self.eval_step = eval_step
+        self.config = config
+        self.checkpointer = checkpointer
+        self.writer = MetricWriter(config.logdir)
+        self.meter = ThroughputMeter(config.global_batch_size)
+
+    def fit(
+        self,
+        state: TrainState,
+        train_iter: Iterable[PyTree],
+        rng: jax.Array,
+        *,
+        eval_iter_fn: Callable[[], Iterable[PyTree]] | None = None,
+    ) -> TrainState:
+        cfg = self.config
+        it = iter(train_iter)
+        self.meter.start()
+        try:
+            state = self._fit_loop(state, it, rng, eval_iter_fn)
+        finally:
+            close = getattr(train_iter, "close", None)
+            if close is not None:
+                close()
+        if self.checkpointer is not None:
+            self.checkpointer.save(cfg.total_steps, state, force=True)
+            self.checkpointer.wait()
+        return state
+
+    def _fit_loop(self, state, it, rng, eval_iter_fn):
+        cfg = self.config
+        start_step = int(state.step)
+        for step_i in range(start_step, cfg.total_steps):
+            batch = next(it)
+            state, metrics = self.train_step(state, batch, rng)
+            self.meter.update()
+            if cfg.log_every and (step_i + 1) % cfg.log_every == 0:
+                # jax.Array fetches sync here, off the critical path cadence
+                last_metrics = {k: float(v) for k, v in metrics.items()}
+                last_metrics.update(self.meter.rates())
+                self.writer.write(step_i + 1, last_metrics)
+                logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
+                self.meter.start()
+            if (
+                cfg.eval_every
+                and self.eval_step is not None
+                and eval_iter_fn is not None
+                and (step_i + 1) % cfg.eval_every == 0
+            ):
+                eval_metrics = self.evaluate(state, eval_iter_fn())
+                self.writer.write(step_i + 1, {f"eval_{k}": v for k, v in eval_metrics.items()})
+                logger.info("eval @ %d: %s", step_i + 1, _fmt(eval_metrics))
+            if (
+                cfg.checkpoint_every
+                and self.checkpointer is not None
+                and (step_i + 1) % cfg.checkpoint_every == 0
+            ):
+                self.checkpointer.save(step_i + 1, state)
+        return state
+
+    def evaluate(self, state: TrainState, eval_iter: Iterable[PyTree]) -> dict:
+        sums: dict[str, float] = {}
+        n = 0
+        try:
+            for i, batch in enumerate(eval_iter):
+                if i >= self.config.eval_steps:
+                    break
+                metrics = self.eval_step(state, batch)
+                for k, v in metrics.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                n += 1
+        finally:
+            close = getattr(eval_iter, "close", None)
+            if close is not None:  # release prefetch threads/device buffers
+                close()
+        return {k: v / max(n, 1) for k, v in sums.items()}
+
+
+def _fmt(metrics: dict) -> str:
+    return " ".join(f"{k}={v:.4g}" for k, v in metrics.items())

@@ -1,0 +1,3 @@
+"""Utilities: metrics/observability, profiling, watchdog."""
+
+from .metrics import MetricWriter, ThroughputMeter  # noqa: F401

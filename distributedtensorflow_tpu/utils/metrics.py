@@ -1,0 +1,90 @@
+"""Observability: chief-only metric writing + throughput counters.
+
+Reference: ``tf.summary`` event files + Keras callbacks + chief-only
+convention (SURVEY.md §5.5).  TensorBoard-compatible event output goes
+through ``tf.summary`` (TF is present for tf.data anyway); falls back to
+JSONL when TF is unavailable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Mapping
+
+import jax
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+
+class MetricWriter:
+    """Writes scalars; only the chief process actually emits (SURVEY.md §5.5)."""
+
+    def __init__(self, logdir: str | None = None, *, use_tensorboard: bool = True):
+        self._chief = jax.process_index() == 0
+        self._tb = None
+        self._jsonl = None
+        if not self._chief or logdir is None:
+            return
+        os.makedirs(logdir, exist_ok=True)
+        if use_tensorboard:
+            try:
+                import tensorflow as tf  # noqa: PLC0415
+
+                self._tb = tf.summary.create_file_writer(logdir)
+            except Exception:  # TF missing/broken -> JSONL fallback
+                self._tb = None
+        if self._tb is None:
+            self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
+
+    def write(self, step: int, scalars: Mapping[str, Any]) -> None:
+        if not self._chief:
+            return
+        scalars = {k: float(v) for k, v in scalars.items()}
+        if self._tb is not None:
+            import tensorflow as tf  # noqa: PLC0415
+
+            with self._tb.as_default(step=step):
+                for k, v in scalars.items():
+                    tf.summary.scalar(k, v)
+            self._tb.flush()
+        elif self._jsonl is not None:
+            self._jsonl.write(json.dumps({"step": step, **scalars}) + "\n")
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+
+class ThroughputMeter:
+    """steps/sec and examples/sec/chip — the BASELINE.json metric counter."""
+
+    def __init__(self, global_batch_size: int):
+        self.global_batch_size = global_batch_size
+        self._t0: float | None = None
+        self._steps = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+        self._steps = 0
+
+    def update(self, n_steps: int = 1) -> None:
+        if self._t0 is None:
+            self.start()
+        self._steps += n_steps
+
+    def rates(self) -> dict[str, float]:
+        if not self._t0 or not self._steps:
+            return {}
+        dt = time.perf_counter() - self._t0
+        steps_per_sec = self._steps / dt
+        ex_per_sec = steps_per_sec * self.global_batch_size
+        n_chips = jax.device_count()
+        return {
+            "steps_per_sec": steps_per_sec,
+            "examples_per_sec": ex_per_sec,
+            "examples_per_sec_per_chip": ex_per_sec / n_chips,
+        }

@@ -1,0 +1,211 @@
+"""The five reference workload configs as runnable presets.
+
+Reference (BASELINE.json ``configs``; repo glue layer SURVEY.md §1 L7):
+
+1. ``mnist_lenet``      — MNIST LeNet-5, OneDeviceStrategy
+2. ``cifar_resnet20``   — CIFAR-10 ResNet-20, MirroredStrategy
+3. ``imagenet_resnet50``— ImageNet ResNet-50, MultiWorkerMirroredStrategy+NCCL
+4. ``bert_mlm``         — BERT-base MLM, gradient accumulation
+5. ``widedeep``         — Wide&Deep, ParameterServerStrategy sparse embeddings
+
+Strategy choice becomes a default :class:`MeshSpec`; every preset runs on any
+mesh (a strategy here is just a shape).  Input is synthetic by default (the
+sandbox has no datasets); pass a tf.data source for real data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .data.input_pipeline import InputContext, synthetic_classification
+from .models import (
+    BertForMLM,
+    LeNet5,
+    ResNet20,
+    ResNet50,
+    WideDeep,
+    WideDeepConfig,
+    bert_base,
+    bert_layout,
+    bert_tiny,
+    mlm_loss,
+    widedeep_layout,
+    widedeep_loss,
+    widedeep_test_config,
+)
+from .parallel.mesh import MeshSpec
+from .parallel.sharding import LayoutMap
+from .train.losses import classification_eval, classification_loss
+
+
+@dataclasses.dataclass
+class Workload:
+    name: str
+    model: Any
+    loss_fn: Callable
+    eval_fn: Callable | None
+    make_optimizer: Callable[[], optax.GradientTransformation]
+    input_fn: Callable[[InputContext, int], Iterator[dict]]  # (ctx, seed) -> iter
+    init_batch: dict[str, np.ndarray]  # example batch (graft entry / benches)
+    init_fn: Callable  # rng -> flax variables
+    global_batch_size: int
+    mesh_spec: MeshSpec
+    accum_steps: int = 1
+    layout: LayoutMap | None = None
+    fsdp: bool = False
+
+
+def _img_input(shape, classes, dtype=np.float32):
+    def input_fn(ctx: InputContext, seed: int):
+        return synthetic_classification(
+            ctx, image_shape=shape, num_classes=classes, seed=seed, dtype=dtype
+        )
+    return input_fn
+
+
+def _img_init(shape, batch=2):
+    return {
+        "image": np.zeros((batch, *shape), np.float32),
+        "label": np.zeros((batch,), np.int32),
+    }
+
+
+def synthetic_mlm(ctx: InputContext, *, vocab_size: int, seq_len: int,
+                  mask_rate: float = 0.15, seed: int = 0) -> Iterator[dict]:
+    """Synthetic masked-LM batches with the -100 ignore convention."""
+    rng = np.random.default_rng(seed + ctx.input_pipeline_id)
+    n = ctx.per_host_batch_size
+    while True:
+        ids = rng.integers(4, vocab_size, size=(n, seq_len))
+        mask = rng.random((n, seq_len)) < mask_rate
+        labels = np.where(mask, ids, -100)
+        inputs = np.where(mask, 3, ids)  # 3 = [MASK]
+        yield {
+            "input_ids": inputs.astype(np.int32),
+            "labels": labels.astype(np.int32),
+            "attention_mask": np.ones((n, seq_len), np.int32),
+        }
+
+
+def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
+    rng = np.random.default_rng(seed + ctx.input_pipeline_id)
+    n = ctx.per_host_batch_size
+    vocabs = np.array(cfg.vocab_sizes)
+    while True:
+        cat = (rng.random((n, len(vocabs))) * vocabs).astype(np.int32)
+        dense = rng.standard_normal((n, cfg.num_dense_features)).astype(np.float32)
+        # learnable rule: label correlates with first categorical parity
+        label = ((cat[:, 0] % 2) ^ (dense[:, 0] > 0)).astype(np.int32)
+        yield {"categorical": cat, "dense": dense, "label": label}
+
+
+def get_workload(name: str, *, test_size: bool = False,
+                 global_batch_size: int | None = None) -> Workload:
+    """Build a preset by name.  ``test_size`` shrinks models for CI."""
+    if name == "mnist_lenet":
+        model = LeNet5()
+        gbs = global_batch_size or 128
+        return Workload(
+            name=name, model=model,
+            loss_fn=classification_loss(model),
+            eval_fn=classification_eval(model),
+            make_optimizer=lambda: optax.sgd(0.05, momentum=0.9),
+            input_fn=_img_input((28, 28, 1), 10),
+            init_batch=_img_init((28, 28, 1)),
+            init_fn=lambda r: model.init(r, jnp.zeros((2, 28, 28, 1))),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=1),  # OneDeviceStrategy semantics
+        )
+    if name == "cifar_resnet20":
+        model = ResNet20(dtype=jnp.float32 if test_size else jnp.bfloat16)
+        gbs = global_batch_size or 256
+        return Workload(
+            name=name, model=model,
+            loss_fn=classification_loss(model, weight_decay=1e-4),
+            eval_fn=classification_eval(model),
+            make_optimizer=lambda: optax.sgd(0.1, momentum=0.9, nesterov=True),
+            input_fn=_img_input((32, 32, 3), 10),
+            init_batch=_img_init((32, 32, 3)),
+            init_fn=lambda r: model.init(r, jnp.zeros((2, 32, 32, 3))),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),  # MirroredStrategy: all local devices
+        )
+    if name == "imagenet_resnet50":
+        model = ResNet50(dtype=jnp.bfloat16)
+        gbs = global_batch_size or 1024
+        size = (64, 64, 3) if test_size else (224, 224, 3)
+        return Workload(
+            name=name, model=model,
+            loss_fn=classification_loss(model, weight_decay=1e-4),
+            eval_fn=classification_eval(model),
+            make_optimizer=lambda: optax.sgd(
+                optax.warmup_cosine_decay_schedule(0.0, 0.8, 1563, 112_590),
+                momentum=0.9, nesterov=True,
+            ),
+            input_fn=_img_input(size, 1000),
+            init_batch=_img_init(size),
+            init_fn=lambda r: model.init(r, jnp.zeros((2, *size))),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),  # MultiWorkerMirrored: all devices
+        )
+    if name == "bert_mlm":
+        cfg = bert_tiny() if test_size else bert_base()
+        model = BertForMLM(cfg)
+        gbs = global_batch_size or 256
+        seq = 128 if test_size else 512
+        return Workload(
+            name=name, model=model,
+            loss_fn=mlm_loss(model),
+            eval_fn=None,
+            make_optimizer=lambda: optax.adamw(1e-4, weight_decay=0.01),
+            input_fn=lambda ctx, seed: synthetic_mlm(
+                ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
+            ),
+            init_batch={
+                "input_ids": np.zeros((2, seq), np.int32),
+                "labels": np.zeros((2, seq), np.int32),
+                "attention_mask": np.ones((2, seq), np.int32),
+            },
+            init_fn=lambda r: model.init(r, jnp.zeros((2, seq), jnp.int32)),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),
+            accum_steps=4,  # the reference BERT config's gradient accumulation
+            layout=bert_layout(),
+        )
+    if name == "widedeep":
+        cfg = widedeep_test_config() if test_size else WideDeepConfig()
+        model = WideDeep(cfg)
+        gbs = global_batch_size or 4096
+        return Workload(
+            name=name, model=model,
+            loss_fn=widedeep_loss(model),
+            eval_fn=None,
+            make_optimizer=lambda: optax.adagrad(0.01),
+            input_fn=lambda ctx, seed: synthetic_recsys(ctx, cfg, seed),
+            init_batch={
+                "categorical": np.zeros((2, len(cfg.vocab_sizes)), np.int32),
+                "dense": np.zeros((2, cfg.num_dense_features), np.float32),
+                "label": np.zeros((2,), np.int32),
+            },
+            init_fn=lambda r: model.init(
+                r,
+                jnp.zeros((2, len(cfg.vocab_sizes)), jnp.int32),
+                jnp.zeros((2, cfg.num_dense_features)),
+            ),
+            global_batch_size=gbs,
+            # sharded embeddings over model axis (the PS capability)
+            mesh_spec=MeshSpec(data=-1),
+            layout=widedeep_layout(),
+        )
+    raise ValueError(
+        f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
+        "imagenet_resnet50 bert_mlm widedeep"
+    )
+
+
+WORKLOADS = ("mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "bert_mlm", "widedeep")

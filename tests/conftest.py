@@ -1,0 +1,48 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+The JAX analogue of the reference's logical-device splitting
+(``test_util.set_logical_devices_to_at_least`` — SURVEY.md §4): one host CPU
+is split into 8 XLA devices so every multi-device code path (DP/FSDP/TP/PP/
+SP/EP meshes, collectives, sharding) runs on a laptop-class machine.
+
+Must run before any JAX backend initialization; the axon sitecustomize in this
+image force-selects the TPU platform, so we re-force CPU via jax.config.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    """data=2 × fsdp=2 × model=2 mesh over the 8 virtual devices."""
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices)
+
+
+@pytest.fixture()
+def dp_mesh(devices):
+    """Pure data-parallel mesh over all 8 devices."""
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=-1), devices)

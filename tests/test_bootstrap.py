@@ -1,0 +1,58 @@
+"""TF_CONFIG shim / cluster resolution tests (reference: TFConfigClusterResolver)."""
+
+import json
+
+from distributedtensorflow_tpu.parallel import (
+    ClusterConfig,
+    parse_tf_config,
+    resolve_cluster,
+)
+
+
+def test_parse_tf_config_workers():
+    cfg = parse_tf_config(json.dumps({
+        "cluster": {"worker": ["h0:1234", "h1:1234", "h2:1234"]},
+        "task": {"type": "worker", "index": 1},
+    }))
+    assert cfg == ClusterConfig("h0:1234", 3, 1)
+
+
+def test_parse_tf_config_chief_and_ps():
+    cfg = parse_tf_config(json.dumps({
+        "cluster": {
+            "chief": ["c0:1"],
+            "worker": ["w0:1", "w1:1"],
+            "ps": ["p0:1"],
+        },
+        "task": {"type": "ps", "index": 0},
+    }))
+    assert cfg.coordinator_address == "c0:1"
+    assert cfg.num_processes == 4
+    assert cfg.process_id == 3  # chief(1) + workers(2) then ps
+
+
+def test_parse_tf_config_evaluator_is_standalone():
+    cfg = parse_tf_config(json.dumps({
+        "cluster": {"worker": ["w0:1"], "evaluator": ["e0:1"]},
+        "task": {"type": "evaluator", "index": 0},
+    }))
+    assert not cfg.is_multiprocess
+
+
+def test_parse_tf_config_empty():
+    assert parse_tf_config("{}") == ClusterConfig()
+
+
+def test_resolve_cluster_priority():
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "j0:9",
+        "JAX_NUM_PROCESSES": "4",
+        "JAX_PROCESS_ID": "2",
+        "TF_CONFIG": json.dumps({"cluster": {"worker": ["x:1", "y:1"]},
+                                 "task": {"type": "worker", "index": 0}}),
+    }
+    cfg = resolve_cluster(env)
+    assert cfg == ClusterConfig("j0:9", 4, 2)
+    cfg2 = resolve_cluster({k: v for k, v in env.items() if k == "TF_CONFIG"})
+    assert cfg2.num_processes == 2
+    assert resolve_cluster({}) == ClusterConfig()

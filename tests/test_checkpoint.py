@@ -1,0 +1,100 @@
+"""Checkpoint/restore tests incl. restore-to-different-topology.
+
+Reference analogue: SURVEY.md §3.5 / §5.4 (Checkpoint + CheckpointManager +
+preemption-consistent save).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflow_tpu.checkpoint import CheckpointManager, PreemptionHandler
+from distributedtensorflow_tpu.models import LeNet5
+from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+from distributedtensorflow_tpu.train.losses import classification_loss
+
+
+def make_state(mesh, lr=0.1):
+    model = LeNet5()
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(lr, momentum=0.9), mesh, jax.random.PRNGKey(0)
+    )
+    return model, state, specs
+
+
+def test_save_restore_roundtrip(tmp_path, dp_mesh):
+    model, state, specs = make_state(dp_mesh)
+    step = make_train_step(classification_loss(model), dp_mesh, specs)
+    batch = {
+        "image": np.random.randn(16, 28, 28, 1).astype(np.float32),
+        "label": np.random.randint(0, 10, (16,)).astype(np.int32),
+    }
+    state, _ = step(state, batch, jax.random.PRNGKey(0))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    assert mgr.save(1, state, force=True)
+    mgr.wait()
+
+    _, fresh, _ = make_state(dp_mesh)
+    restored = mgr.restore_latest(fresh)
+    assert restored is not None
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # optimizer slots (momentum) restored too
+    for a, b in zip(jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_restore_to_different_topology(tmp_path, devices, dp_mesh):
+    """Save on 8-device mesh, restore onto 1-device mesh (elastic resize)."""
+    model, state, specs = make_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    mgr.save(5, state, force=True)
+    mgr.wait()
+
+    small_mesh = build_mesh(MeshSpec(data=1), devices[:1])
+    _, fresh, _ = make_state(small_mesh)
+    restored = mgr.restore_latest(fresh)
+    assert restored is not None
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # restored arrays live on the new mesh
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert set(leaf.devices()) == {devices[0]}
+    mgr.close()
+
+
+def test_restore_latest_none_on_empty(tmp_path, dp_mesh):
+    _, state, _ = make_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path / "empty"), async_save=False)
+    assert mgr.restore_latest(state) is None
+    mgr.close()
+
+
+def test_rotation(tmp_path, dp_mesh):
+    _, state, _ = make_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path / "rot"), max_to_keep=2, async_save=False)
+    for s in (1, 2, 3):
+        mgr.save(s, state.replace(step=jnp.asarray(s)), force=True)
+    mgr.wait()
+    assert mgr.latest_step() == 3
+    assert len(mgr.all_steps()) == 2
+    mgr.close()
+
+
+def test_preemption_handler_trigger_and_save(tmp_path, dp_mesh):
+    _, state, _ = make_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path / "pre"), async_save=False)
+    handler = PreemptionHandler(mgr, mesh=dp_mesh)
+    assert not handler.should_save(0)
+    handler.trigger()
+    assert handler.should_save(1)
+    handler.save_and_exit(7, state.replace(step=jnp.asarray(7)))
+    assert mgr.latest_step() == 7
+    handler.uninstall()
+    mgr.close()

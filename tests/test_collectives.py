@@ -1,0 +1,160 @@
+"""Collective wrapper tests, run under shard_map on the virtual CPU mesh.
+
+Reference analogue: collectives validated against a one-device ground truth
+(SURVEY.md §4 "unit-level").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributedtensorflow_tpu.parallel import (
+    Options,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    broadcast,
+    pack_by_size,
+    packed_all_reduce,
+    reduce_scatter,
+    shift,
+    tree_all_reduce,
+)
+
+
+def smap(mesh, fn, in_spec, out_spec):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec, check_vma=False
+        )
+    )
+
+
+def test_all_reduce_sum(dp_mesh):
+    x = jnp.arange(8.0)
+    f = smap(dp_mesh, lambda a: all_reduce(a, "data"), P("data"), P())
+    # each shard holds one element; psum over data sums all 8 shards' values
+    out = f(x)
+    np.testing.assert_allclose(out, np.full((1,), x.sum()))
+
+
+def test_all_reduce_ops(dp_mesh):
+    x = jnp.arange(8.0)
+    for op, expect in [
+        (ReduceOp.MEAN, x.mean()),
+        (ReduceOp.MAX, x.max()),
+        (ReduceOp.MIN, x.min()),
+    ]:
+        f = smap(dp_mesh, lambda a, op=op: all_reduce(a, "data", op), P("data"), P())
+        np.testing.assert_allclose(f(x), np.full((1,), expect))
+
+
+def test_tree_all_reduce(dp_mesh):
+    tree = {"w": jnp.arange(8.0), "b": jnp.ones((8, 2))}
+    f = smap(
+        dp_mesh,
+        lambda t: tree_all_reduce(t, "data"),
+        ({"w": P("data"), "b": P("data")},),
+        {"w": P(), "b": P()},
+    )
+    out = f(tree)
+    np.testing.assert_allclose(out["w"], np.full((1,), 28.0))
+    np.testing.assert_allclose(out["b"], np.full((1, 2), 8.0))
+
+
+def test_all_gather(dp_mesh):
+    x = jnp.arange(8.0)
+    f = smap(dp_mesh, lambda a: all_gather(a, "data"), P("data"), P())
+    np.testing.assert_allclose(f(x), np.arange(8.0))
+
+
+def test_reduce_scatter(dp_mesh):
+    x = jnp.tile(jnp.arange(8.0), (8, 1))  # every shard sees row (0..7)
+    f = smap(
+        dp_mesh, lambda a: reduce_scatter(a.reshape(-1), "data"), P("data", None), P("data")
+    )
+    out = f(x)
+    np.testing.assert_allclose(out, np.arange(8.0) * 8)
+
+
+def test_broadcast(dp_mesh):
+    x = jnp.arange(8.0) * 10
+    f = smap(dp_mesh, lambda a: broadcast(a, "data", src=3), P("data"), P("data"))
+    np.testing.assert_allclose(f(x), np.full((8,), 30.0))
+
+
+def test_shift_ring(dp_mesh):
+    x = jnp.arange(8.0)
+    f = smap(dp_mesh, lambda a: shift(a, "data", offset=1), P("data"), P("data"))
+    out = f(x)
+    # shard i's value moves to shard i+1 (ring)
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_all_to_all(dp_mesh):
+    # 8 shards, each with 8 rows; all_to_all transposes shard/row blocks
+    x = jnp.arange(64.0).reshape(64, 1)
+    f = smap(
+        dp_mesh,
+        lambda a: all_to_all(a, "data", split_axis=0, concat_axis=0),
+        P("data", None),
+        P("data", None),
+    )
+    out = f(x)
+    blocks = np.arange(64.0).reshape(8, 8)
+    np.testing.assert_allclose(out.reshape(8, 8), blocks.T)
+
+
+def test_pack_by_size():
+    leaves = [jnp.zeros(n, jnp.float32) for n in (10, 10, 100, 5)]
+    packs = pack_by_size(leaves, bytes_per_pack=80)
+    assert packs == [[0, 1], [2], [3]]
+    assert pack_by_size(leaves, 0) == [[0], [1], [2], [3]]
+
+
+def test_pack_by_size_never_mixes_dtypes():
+    leaves = [
+        jnp.zeros(4, jnp.float32),
+        jnp.zeros(4, jnp.bfloat16),
+        jnp.zeros(4, jnp.bfloat16),
+    ]
+    packs = pack_by_size(leaves, bytes_per_pack=1024)
+    assert packs == [[0], [1, 2]]
+
+
+def test_packed_all_reduce_preserves_dtypes(dp_mesh):
+    tree = {"a": jnp.ones((8, 2), jnp.bfloat16), "b": jnp.ones((8, 2), jnp.float32)}
+    spec = {"a": P("data", None), "b": P("data", None)}
+    out = smap(
+        dp_mesh,
+        lambda t: packed_all_reduce(t, "data", options=Options(bytes_per_pack=1 << 20)),
+        (spec,),
+        {"a": P(), "b": P()},
+    )(tree)
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.float32
+
+
+def test_broadcast_ignores_nan_in_nonsource_shards(dp_mesh):
+    x = jnp.arange(8.0).at[5].set(jnp.nan)  # garbage in a non-src shard
+    f = smap(dp_mesh, lambda a: broadcast(a, "data", src=2), P("data"), P("data"))
+    np.testing.assert_allclose(f(x), np.full((8,), 2.0))
+
+
+def test_packed_all_reduce_matches_unpacked(dp_mesh):
+    tree = {
+        "a": jnp.arange(16.0).reshape(8, 2),
+        "b": jnp.ones((8, 3)),
+        "c": jnp.arange(8.0),
+    }
+    spec = {"a": P("data", None), "b": P("data", None), "c": P("data")}
+    plain = smap(dp_mesh, lambda t: tree_all_reduce(t, "data"), (spec,), {"a": P(), "b": P(), "c": P()})(tree)
+    packed = smap(
+        dp_mesh,
+        lambda t: packed_all_reduce(t, "data", options=Options(bytes_per_pack=64)),
+        (spec,),
+        {"a": P(), "b": P(), "c": P()},
+    )(tree)
+    jax.tree.map(np.testing.assert_allclose, packed, plain)

@@ -1,0 +1,68 @@
+"""Mesh core tests (reference analogue: strategy construction tests)."""
+
+import jax
+import pytest
+
+from distributedtensorflow_tpu.parallel import (
+    CANONICAL_AXES,
+    MeshSpec,
+    build_mesh,
+    data_axes,
+    mirrored_mesh,
+    one_device_mesh,
+    replica_count,
+)
+
+
+def test_canonical_axes_order():
+    assert CANONICAL_AXES == ("data", "fsdp", "pipe", "seq", "expert", "model")
+
+
+def test_resolve_wildcard():
+    assert MeshSpec(data=-1).resolve(8) == (8, 1, 1, 1, 1, 1)
+    assert MeshSpec(data=-1, model=2).resolve(8) == (4, 1, 1, 1, 1, 2)
+    assert MeshSpec(data=2, fsdp=2, model=2).resolve(8) == (2, 2, 1, 1, 1, 2)
+
+
+def test_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=3).resolve(8)
+
+
+def test_build_mesh_shape(devices):
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices)
+    assert mesh.axis_names == CANONICAL_AXES
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["model"] == 2
+    assert mesh.size == 8
+
+
+def test_one_device_mesh():
+    mesh = one_device_mesh()
+    assert mesh.size == 1
+    assert replica_count(mesh) == 1
+
+
+def test_mirrored_mesh(devices):
+    mesh = mirrored_mesh(devices)
+    assert mesh.shape["data"] == 8
+    assert replica_count(mesh) == 8
+
+
+def test_data_axes(mesh8):
+    assert data_axes(mesh8) == ("data", "fsdp")
+    assert replica_count(mesh8) == 4
+
+
+def test_mesh_usable_with_jit(dp_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+
+    x = jnp.arange(16.0)
+    xs = jax.device_put(x, NamedSharding(dp_mesh, P("data")))
+    y = jax.jit(lambda a: a * 2)(xs)
+    assert jnp.allclose(y, x * 2)

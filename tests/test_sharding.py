@@ -1,0 +1,118 @@
+"""Partitioner / layout / pytree-sharding tests.
+
+Reference analogue: sharded_variable partitioner tests (SURVEY.md §2.1) and
+DistributedVariable placement behavior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributedtensorflow_tpu.parallel import (
+    FixedShardsPartitioner,
+    LayoutMap,
+    MaxSizePartitioner,
+    MinSizePartitioner,
+    auto_fsdp_spec,
+    batch_spec,
+    shard_batch,
+    shard_tree,
+    spec_for,
+    specs_for_tree,
+    tree_paths,
+)
+
+
+def test_fixed_shards():
+    p = FixedShardsPartitioner(4)
+    assert p.num_shards((100, 8), np.float32) == 4
+
+
+def test_min_size_partitioner():
+    # 1000 * 4 bytes = 4000 bytes; min shard 1000 bytes -> 4 shards
+    p = MinSizePartitioner(min_shard_bytes=1000, max_shards=8)
+    assert p.num_shards((1000,), np.float32) == 4
+    # tiny var -> 1 shard
+    assert p.num_shards((10,), np.float32) == 1
+    # cap at max_shards
+    p2 = MinSizePartitioner(min_shard_bytes=4, max_shards=3)
+    assert p2.num_shards((1000,), np.float32) == 3
+
+
+def test_max_size_partitioner():
+    # 4000 bytes / 1500 max -> ceil = 3 shards
+    p = MaxSizePartitioner(max_shard_bytes=1500)
+    assert p.num_shards((1000,), np.float32) == 3
+
+
+def test_spec_for_clamps_to_mesh(mesh8):
+    p = FixedShardsPartitioner(4)
+    # model axis size 2, dim 0 divisible -> shard over model
+    assert spec_for(p, (100, 8), np.float32, mesh8, "model") == P("model", None)
+    # indivisible dim -> replicated
+    assert spec_for(p, (101, 8), np.float32, mesh8, "model") == P()
+    # single shard -> replicated
+    assert spec_for(FixedShardsPartitioner(1), (100, 8), np.float32, mesh8, "model") == P()
+    # fewer shards requested than the axis size -> replicate (axis-size
+    # sharding would violate per-shard size floors like min_shard_bytes)
+    assert spec_for(
+        MinSizePartitioner(min_shard_bytes=3000), (1000,), np.float32, mesh8, "model"
+    ) == P()  # 4000B var / 2-way = 2000B < 3000B floor
+    assert spec_for(
+        MinSizePartitioner(min_shard_bytes=1000), (1000,), np.float32, mesh8, "model"
+    ) == P("model")  # 2000B shards >= 1000B floor
+
+
+def test_layout_map_first_match_wins():
+    lm = LayoutMap([
+        (r"embed", P("model", None)),
+        (r"kernel", P(None, "model")),
+    ])
+    assert lm.spec("encoder/embed/kernel") == P("model", None)
+    assert lm.spec("mlp/kernel") == P(None, "model")
+    assert lm.spec("bias") == P()
+
+
+def test_tree_paths():
+    tree = {"layer": {"kernel": jnp.zeros(2), "bias": jnp.zeros(2)}, "seq": [jnp.zeros(1)]}
+    paths = tree_paths(tree)
+    assert paths["layer"]["kernel"] == "layer/kernel"
+    assert paths["seq"][0] == "seq/0"
+
+
+def test_auto_fsdp_spec(mesh8):
+    # fsdp axis = 2; largest divisible dim sharded
+    assert auto_fsdp_spec((128, 256), mesh8) == P(None, "fsdp")
+    assert auto_fsdp_spec((256, 128), mesh8) == P("fsdp", None)
+    # too small -> replicated
+    assert auto_fsdp_spec((4, 4), mesh8) == P()
+
+
+def test_specs_for_tree_with_fsdp_fallback(mesh8):
+    tree = {
+        "embed": jnp.zeros((64, 512)),
+        "mlp_kernel": jnp.zeros((512, 1024)),
+        "bias": jnp.zeros((8,)),
+    }
+    lm = LayoutMap([(r"embed", P("model", None))])
+    specs = specs_for_tree(tree, mesh8, lm, fsdp=True)
+    assert specs["embed"] == P("model", None)
+    assert specs["mlp_kernel"] == P(None, "fsdp")  # fsdp fallback
+    assert specs["bias"] == P()  # too small
+
+
+def test_shard_tree_places_arrays(mesh8):
+    tree = {"w": jnp.arange(32.0).reshape(4, 8)}
+    specs = {"w": P(None, "model")}
+    out = shard_tree(tree, mesh8, specs)
+    assert out["w"].sharding == NamedSharding(mesh8, P(None, "model"))
+    np.testing.assert_allclose(out["w"], tree["w"])
+
+
+def test_batch_spec_and_shard_batch(mesh8, dp_mesh):
+    assert batch_spec(dp_mesh) == P(("data", "fsdp"))
+    assert batch_spec(mesh8) == P(("data", "fsdp"))
+    batch = {"x": jnp.ones((16, 3)), "y": jnp.zeros((16,))}
+    out = shard_batch(batch, mesh8)
+    assert out["x"].sharding.spec == P(("data", "fsdp"))

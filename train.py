@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""train.py — CLI entrypoint (reference parity: the repo's train.py, SURVEY.md §1 L7).
+
+Picks a workload preset (the five BASELINE.json configs), builds the mesh
+(the strategy choice), and runs the SPMD training loop.  Works identically
+on one chip or a multi-host pod; multi-host bootstrap is automatic from
+JAX/TF_CONFIG env (run_distributed.sh semantics — SURVEY.md §5.6).
+
+Examples:
+  python train.py --workload mnist_lenet --steps 200
+  python train.py --workload imagenet_resnet50 --steps 100 --mesh data=-1
+  python train.py --workload bert_mlm --steps 50 --mesh data=2,model=4
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+
+
+def parse_mesh(s: str | None):
+    from distributedtensorflow_tpu.parallel import MeshSpec
+
+    if not s:
+        return None
+    kw = {}
+    for part in s.split(","):
+        k, v = part.split("=")
+        kw[k.strip()] = int(v)
+    return MeshSpec(**kw)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workload", "--config", default="mnist_lenet")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="global batch size (default: workload preset)")
+    p.add_argument("--mesh", default=None,
+                   help="mesh axes, e.g. 'data=-1' or 'data=2,model=4' "
+                        "(default: workload preset = its reference strategy)")
+    p.add_argument("--accum-steps", type=int, default=None)
+    p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--eval-every", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--logdir", default=None)
+    p.add_argument("--test-size", action="store_true",
+                   help="shrink the model (CI / smoke tests)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--device", default=None,
+                   help="reference-parity flag (tpu|cpu); default = auto")
+    args = p.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(message)s",
+    )
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from distributedtensorflow_tpu import parallel
+    from distributedtensorflow_tpu.data import current_input_context, Prefetcher
+    from distributedtensorflow_tpu.train import (
+        create_sharded_state,
+        make_eval_step,
+        make_train_step,
+    )
+    from distributedtensorflow_tpu.train.trainer import Trainer, TrainerConfig
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    cluster = parallel.initialize()
+    wl = get_workload(
+        args.workload, test_size=args.test_size,
+        global_batch_size=args.batch_size,
+    )
+    spec = parse_mesh(args.mesh) or wl.mesh_spec
+    mesh = parallel.build_mesh(spec)
+    accum = args.accum_steps if args.accum_steps is not None else wl.accum_steps
+    logging.info(
+        "workload=%s mesh=%s devices=%d processes=%d global_batch=%d accum=%d",
+        wl.name, dict(mesh.shape), mesh.size, jax.process_count(),
+        wl.global_batch_size, accum,
+    )
+
+    rng = jax.random.PRNGKey(args.seed)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng,
+        rules=wl.layout, fsdp=wl.fsdp,
+    )
+    train_step = make_train_step(
+        wl.loss_fn, mesh, specs, accum_steps=accum
+    )
+    eval_step = (
+        make_eval_step(wl.eval_fn, mesh, specs) if wl.eval_fn else None
+    )
+
+    ctx = current_input_context(wl.global_batch_size)
+    train_iter = Prefetcher(wl.input_fn(ctx, args.seed), mesh)
+
+    checkpointer = None
+    if args.checkpoint_dir:
+        from distributedtensorflow_tpu.checkpoint import CheckpointManager
+
+        checkpointer = CheckpointManager(args.checkpoint_dir)
+        state = checkpointer.restore_latest(state) or state
+
+    trainer = Trainer(
+        train_step,
+        TrainerConfig(
+            total_steps=args.steps,
+            log_every=args.log_every,
+            eval_every=args.eval_every,
+            checkpoint_every=args.checkpoint_every,
+            global_batch_size=wl.global_batch_size,
+            logdir=args.logdir,
+        ),
+        eval_step=eval_step,
+        checkpointer=checkpointer,
+    )
+    eval_iter_fn = None
+    if args.eval_every and eval_step is not None:
+        eval_iter_fn = lambda: Prefetcher(wl.input_fn(ctx, args.seed + 999), mesh)
+    state = trainer.fit(state, train_iter, rng, eval_iter_fn=eval_iter_fn)
+    logging.info("done at step %d", int(state.step))
+
+
+if __name__ == "__main__":
+    main()
