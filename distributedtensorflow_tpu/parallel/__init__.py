@@ -42,6 +42,22 @@ from .collectives import (  # noqa: F401
     shift,
     tree_all_reduce,
 )
+from .ring_attention import (  # noqa: F401
+    make_sequence_parallel_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from .pipeline import (  # noqa: F401
+    make_pipelined_fn,
+    pipeline_apply,
+    stack_stage_params,
+)
+from .moe import (  # noqa: F401
+    expert_parallel_moe,
+    init_expert_params,
+    make_moe_layer,
+    top1_route,
+)
 from .sharding import (  # noqa: F401
     FixedShardsPartitioner,
     LayoutMap,

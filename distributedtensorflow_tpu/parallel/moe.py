@@ -1,0 +1,155 @@
+"""Expert parallelism: Switch-style MoE with all_to_all token dispatch.
+
+New capability absent from the reference stack (SURVEY.md §2.4 EP row).
+Experts are sharded over the ``expert`` mesh axis; tokens are routed top-1
+with a capacity limit, dispatched to their expert's device via a pair of
+``lax.all_to_all`` s (the MoE idiom on the ICI torus), processed by the
+local experts, and combined back weighted by the router probability.
+
+Everything is fixed-shape (dispatch/combine are one-hot einsum contractions,
+dropped tokens pass through on the residual path), so the whole layer jits
+into one SPMD program — no data-dependent shapes (XLA requirement).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+PyTree = Any
+
+
+def top1_route(
+    logits: jax.Array,  # (T, E) router logits
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 routing with capacity (Switch Transformer recipe).
+
+    Returns ``(dispatch, combine, aux_loss)``:
+    - dispatch: (T, E, C) one-hot — token t occupies slot c of expert e;
+    - combine: (T, E, C) — dispatch weighted by the router probability;
+    - aux_loss: scalar load-balancing loss (mean_frac_tokens · mean_probs · E).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
+    expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's queue
+    pos_in_expert = jnp.cumsum(expert_onehot, axis=0) * expert_onehot  # 1-based
+    keep = (pos_in_expert <= capacity) & (expert_onehot > 0)
+    slot = (pos_in_expert - 1.0).astype(jnp.int32)  # 0-based, valid where keep
+    slot_onehot = jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1), capacity,
+                                 dtype=jnp.float32)
+    dispatch = keep[..., None] * slot_onehot  # (T, E, C)
+    gate = jnp.sum(probs * expert_onehot, axis=-1, keepdims=True)  # (T, 1)
+    combine = dispatch * gate[..., None]
+    # Switch aux loss: encourages uniform token/prob mass over experts
+    frac_tokens = jnp.mean(expert_onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def expert_parallel_moe(
+    tokens: jax.Array,  # (T, d) — this shard's tokens
+    router_kernel: jax.Array,  # (d, E)
+    expert_params: PyTree,  # leaves (E_local, ...) — local experts
+    expert_fn: Callable[[PyTree, jax.Array], jax.Array],  # (params,(N,d))->(N,d)
+    *,
+    axis_name: str = mesh_lib.AXIS_EXPERT,
+    capacity_factor: float = 1.25,
+) -> tuple[jax.Array, jax.Array]:
+    """Switch MoE layer body (shard_map-internal). Returns (out, aux_loss).
+
+    ``expert_params`` leading dim is the local expert count; global expert
+    count E = E_local * axis_size.  Dropped-over-capacity tokens contribute 0
+    here (caller keeps them on the residual path).
+    """
+    n = lax.axis_size(axis_name)
+    t, d = tokens.shape
+    e = router_kernel.shape[-1]
+    if e % n:
+        raise ValueError(
+            f"n_experts={e} not divisible by expert axis size {n}"
+        )
+    capacity = max(1, int(t * capacity_factor / e))
+
+    logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    dispatch, combine, aux = top1_route(logits, capacity)
+
+    # (T, E, C) x (T, d) -> (E, C, d): expert-major send buffer
+    send = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(jnp.float32))
+    # all_to_all: split experts across devices, gather every shard's slots
+    # (E, C, d) -> (E_local, n*C, d)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+    out = jax.vmap(expert_fn)(expert_params, recv.astype(tokens.dtype))
+    out = out.astype(jnp.float32)
+    # route results back: (E_local, n*C, d) -> (E, C, d)
+    back = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                          tiled=True)
+    combined = jnp.einsum("tec,ecd->td", combine, back)
+    # aux loss is per-shard; mean over shards for a global scalar
+    aux = lax.pmean(aux, axis_name)
+    return combined.astype(tokens.dtype), aux
+
+
+def init_expert_params(
+    init_one: Callable[[jax.Array], PyTree],
+    n_experts: int,
+    rng: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = mesh_lib.AXIS_EXPERT,
+) -> PyTree:
+    """Stack per-expert params on a leading dim sharded over ``expert``."""
+    rngs = jax.random.split(rng, n_experts)
+    stacked = jax.vmap(init_one)(rngs)
+    specs = jax.tree.map(lambda _: P(), jax.eval_shape(init_one, rng))
+    sharding = jax.tree.map(
+        lambda spec: NamedSharding(mesh, P(axis_name, *spec)), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(stacked, sharding)
+
+
+def make_moe_layer(
+    mesh: Mesh,
+    expert_fn: Callable[[PyTree, jax.Array], jax.Array],
+    *,
+    capacity_factor: float = 1.25,
+    axis_name: str = mesh_lib.AXIS_EXPERT,
+) -> Callable:
+    """Global entry: ``fn(tokens (N, d), router_kernel, expert_params)``.
+
+    Tokens are sharded over (batch axes + expert axis) so each expert shard
+    routes its local tokens; expert params are expert-axis sharded.
+    """
+    batch_axes = mesh_lib.data_axes(mesh)
+    tok_axes = tuple(batch_axes) + (axis_name,)
+
+    def run(tokens, router_kernel, expert_params):
+        def body(toks, rk, ep):
+            out, aux = expert_parallel_moe(
+                toks, rk, ep, expert_fn=expert_fn, axis_name=axis_name,
+                capacity_factor=capacity_factor,
+            )
+            if batch_axes:  # make the aux loss a true global scalar
+                aux = lax.pmean(aux, batch_axes)
+            return out, aux
+
+        param_specs = jax.tree.map(lambda _: P(axis_name), expert_params)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(tok_axes), P(), param_specs),
+            out_specs=(P(tok_axes), P()),
+            check_vma=False,
+        )(tokens, router_kernel, expert_params)
+
+    return jax.jit(run)

@@ -1,0 +1,144 @@
+"""Pipeline parallelism: SPMD GPipe over the ``pipe`` mesh axis.
+
+New capability absent from the reference stack (SURVEY.md §2.4: "no GPipe in
+tf.distribute").  Design follows the single-program pipeline pattern
+(SURVEY.md §7 step 9, PAPERS.md MPMD-pipeline entry chose the contrasting
+design; SPMD is picked here for simplicity and jit-compatibility):
+
+- stage s of the model lives on mesh position s of the ``pipe`` axis
+  (stage-stacked params, leading dim sharded over ``pipe``);
+- microbatches march through ticks; at each tick every device runs its stage
+  on its current microbatch and hands the activation to the right neighbor
+  via ``lax.ppermute`` (neighbor ICI transfer, overlapped by XLA);
+- the whole schedule — warmup bubble, steady state, drain — is one
+  ``lax.scan`` inside one jitted program; autodiff through it yields the
+  reverse pipeline automatically.
+
+Bubble fraction is the GPipe (n_stages-1)/(n_micro+n_stages-1); use
+microbatch counts >= 4x stages.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+PyTree = Any
+
+
+def pipeline_apply(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_params: PyTree,
+    microbatches: jax.Array,  # (n_micro, mb, ...) — same on every pipe rank
+    *,
+    axis_name: str = mesh_lib.AXIS_PIPE,
+) -> jax.Array:
+    """Run the microbatch pipeline (shard_map-internal).
+
+    ``stage_fn(params, x) -> y`` must map activations to activations of the
+    same shape (inter-stage handoff is a fixed-size buffer).  Returns the
+    final outputs (n_micro, mb, ...) — valid on the *last* pipe rank and
+    broadcast to all ranks so downstream (loss) code is uniform SPMD.
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n - 1
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        mb_idx = jnp.clip(t - s, 0, n_micro - 1)
+        x_first = lax.dynamic_index_in_dim(microbatches, jnp.clip(t, 0, n_micro - 1),
+                                           keepdims=False)
+        x = jnp.where(s == 0, x_first, recv)
+        y = stage_fn(stage_params, x)
+        active = (t - s >= 0) & (t - s < n_micro)
+        # last stage banks its finished microbatch
+        out_update = jnp.where(active & (s == n - 1), y, 0.0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            lax.dynamic_index_in_dim(outputs, mb_idx, keepdims=False)
+            + out_update,
+            mb_idx, axis=0,
+        )
+        recv = lax.ppermute(y, axis_name, perm_fwd)
+        return (recv, outputs), None
+
+    recv0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (recv0, outputs0), jnp.arange(ticks))
+    # replicate the last stage's outputs to every rank (masked psum broadcast)
+    outputs = jnp.where(s == n - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def make_pipelined_fn(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    mesh: Mesh,
+    param_specs: PyTree,
+    *,
+    n_microbatches: int,
+    axis_name: str = mesh_lib.AXIS_PIPE,
+) -> Callable[[PyTree, jax.Array], jax.Array]:
+    """Global-array entry: ``fn(stacked_params, batch) -> outputs``.
+
+    ``stacked_params`` leaves carry a leading stage dim sharded over ``pipe``
+    (spec prefix ``P("pipe", ...)`` — built by :func:`stack_stage_params`);
+    ``batch`` (B, ...) is split into ``n_microbatches`` internally.
+    """
+    batch_axes = mesh_lib.data_axes(mesh)
+
+    def run(stacked_params, batch):
+        def inner(local_params, x):
+            # shard_map leaves the size-1 stage dim on the leading axis
+            params = jax.tree.map(lambda p: p[0], local_params)
+            mb = x.reshape(n_microbatches, x.shape[0] // n_microbatches,
+                           *x.shape[1:])
+            out = pipeline_apply(stage_fn, params, mb, axis_name=axis_name)
+            return out.reshape(x.shape[0], *out.shape[2:])
+
+        in_param_specs = jax.tree.map(
+            lambda spec: P(axis_name, *spec), param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        x_spec = P(batch_axes if batch_axes else None)
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(in_param_specs, x_spec),
+            out_specs=x_spec,
+            check_vma=False,
+        )(stacked_params, batch)
+
+    return jax.jit(run)
+
+
+def stack_stage_params(
+    init_fn: Callable[[jax.Array], PyTree],
+    n_stages: int,
+    rng: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = mesh_lib.AXIS_PIPE,
+) -> tuple[PyTree, PyTree]:
+    """Initialize per-stage params stacked on a leading ``pipe``-sharded dim.
+
+    Returns ``(stacked_params, per_stage_specs)`` — specs are for the
+    *unstacked* leaves (the stage dim is added by :func:`make_pipelined_fn`).
+    """
+    rngs = jax.random.split(rng, n_stages)
+    stacked = jax.vmap(init_fn)(rngs)
+    specs = jax.tree.map(lambda _: P(), jax.eval_shape(init_fn, rng))
+    sharding = jax.tree.map(
+        lambda spec: NamedSharding(mesh, P(axis_name, *spec)), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    stacked = jax.device_put(stacked, sharding)
+    return stacked, specs

@@ -1,0 +1,163 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+New first-class capability absent from the reference stack (SURVEY.md §5.7):
+TF-classic has only the generic ``all_to_all`` op; long-context training needs
+attention over sequences sharded across devices.
+
+Two schemes, both valid inside ``shard_map`` over the ``seq`` mesh axis:
+
+- :func:`ring_attention` — K/V chunks rotate around the ring via
+  ``lax.ppermute`` while each device's Q stays put; online-softmax
+  accumulators merge each chunk's contribution.  Communication is
+  neighbor-to-neighbor over ICI (the torus's cheapest pattern) and overlaps
+  with the chunk matmuls.  Memory per device stays O(S/n).
+- :func:`ulysses_attention` — two ``all_to_all`` s reshard seq↔heads so each
+  device computes *full-sequence* attention for H/n heads (then swaps back).
+  Cheaper compute structure (one big attention per device, can use the
+  Pallas flash kernel), but needs heads % seq_axis == 0 and all-to-all
+  bandwidth.
+
+References: Ring Attention (Liu et al. 2023) / DeepSpeed-Ulysses patterns —
+re-derived here for the jax/shard_map idiom.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+NEG_INF = -1e9
+
+
+def ring_attention(
+    q: jax.Array,  # (B, S_loc, H, D) — this device's seq shard
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = mesh_lib.AXIS_SEQ,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention over mesh axis ``axis_name`` (shard_map-internal).
+
+    Devices are assumed to hold *contiguous* sequence chunks in mesh-axis
+    order (chunk i on position i) — the layout ``PartitionSpec(..., "seq",
+    ...)`` produces.  Causal masking is resolved at chunk granularity: a K
+    chunk strictly in the future contributes nothing and its compute is
+    skipped via masking (uniform control flow keeps the program SPMD).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge_chunk(m, l, acc, kc, vc, step):
+        # kc holds the chunk originally on device (my - step) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)) * scale
+        if causal:
+            kidx = (my - step) % n
+            q_pos = my * s_loc + jnp.arange(s_loc)
+            k_pos = kidx * s_loc + jnp.arange(s_loc)
+            keep = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(keep[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32))
+        acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv
+        return m_new, l_new, acc_new
+
+    def body(carry, step):
+        m, l, acc, kc, vc = carry
+        m, l, acc = merge_chunk(m, l, acc, kc, vc, step)
+        # rotate K/V to the next device; XLA overlaps this with the matmuls
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m, l, acc, kc, vc), None
+
+    m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    # scan runs only the n-1 steps that need a rotation afterwards; the last
+    # chunk is merged outside so no wasted final ppermute of K and V
+    (m, l, acc, kc, vc), _ = lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(n - 1)
+    )
+    m, l, acc = merge_chunk(m, l, acc, kc, vc, n - 1)
+    # l >= 1 always: the diagonal chunk contributes exp(0) per row, so no
+    # division guard is needed (matches the full-attention softmax exactly)
+    out = acc / l.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,  # (B, S_loc, H, D)
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = mesh_lib.AXIS_SEQ,
+    causal: bool = False,
+    attn_fn: Callable | None = None,
+) -> jax.Array:
+    """Ulysses sequence parallelism (shard_map-internal).
+
+    all_to_all reshards (B, S/n, H, D) -> (B, S, H/n, D), runs full-sequence
+    attention per device on its head subset (``attn_fn``, default the
+    framework attention entry, which may pick the Pallas flash kernel), then
+    reshards back.  Heads must divide the seq-axis size.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"heads={h} not divisible by seq axis size {n}")
+    if attn_fn is None:
+        from ..ops.attention import dot_product_attention
+
+        attn_fn = functools.partial(dot_product_attention, causal=causal)
+
+    def seq_to_heads(x):  # (B, S_loc, H, D) -> (B, S, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # (B, S, H/n, D) -> (B, S_loc, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v))
+    return heads_to_seq(out)
+
+
+def make_sequence_parallel_attention(
+    mesh: Mesh,
+    *,
+    scheme: str = "ring",  # "ring" | "ulysses"
+    causal: bool = False,
+    axis_name: str = mesh_lib.AXIS_SEQ,
+) -> Callable:
+    """Jit-compiled global-array entry: (B, S, H, D) sharded on ``seq``.
+
+    The batch dim is additionally sharded over the batch axes, so this
+    composes dp x sp out of the box.
+    """
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[scheme]
+    kernel = functools.partial(fn, axis_name=axis_name, causal=causal)
+    batch_axes = mesh_lib.data_axes(mesh)
+    spec = P(batch_axes if batch_axes else None, axis_name, None, None)
+
+    smapped = jax.shard_map(
+        lambda q, k, v: kernel(q, k, v),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(smapped)

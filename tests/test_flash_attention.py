@@ -1,0 +1,110 @@
+"""Flash-attention kernel golden tests vs the XLA reference path.
+
+Run in Pallas interpreter mode on CPU (SURVEY.md §7 "gate behind golden
+tests vs full attention").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.ops.attention import xla_attention
+from distributedtensorflow_tpu.ops.flash_attention import (
+    _pick_block_q,
+    flash_attention,
+    supported,
+)
+
+
+def make_qkv(b=2, s=256, h=4, d=32, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+def test_pick_block_q():
+    assert _pick_block_q(256) == 128
+    assert _pick_block_q(128) == 128
+    assert _pick_block_q(96) == 32
+    assert _pick_block_q(100) is None
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_xla(causal):
+    q, k, v = make_qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_with_padding_mask():
+    q, k, v = make_qkv()
+    mask = np.ones((2, 256), bool)
+    mask[:, 200:] = False
+    out = flash_attention(q, k, v, mask=jnp.asarray(mask), interpret=True)
+    ref = xla_attention(q, k, v, mask=jnp.asarray(mask)[:, None, None, :])
+    np.testing.assert_allclose(out[:, :200], ref[:, :200], atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_xla(causal):
+    q, k, v = make_qkv(b=1, s=128, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_gradients_with_mask():
+    q, k, v = make_qkv(b=1, s=128, h=2, d=16)
+    mask = np.ones((1, 128), bool)
+    mask[:, 100:] = False
+    mask = jnp.asarray(mask)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, mask=mask, interpret=True)
+        return jnp.sum((out * mask[:, :, None, None]) ** 2)
+
+    def loss_ref(q, k, v):
+        out = xla_attention(q, k, v, mask=mask[:, None, None, :])
+        return jnp.sum((out * mask[:, :, None, None]) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_supported_gates():
+    q, k, v = make_qkv(s=100)  # indivisible seq
+    assert not supported(q, k, v)
+    q3 = jnp.zeros((2, 64, 4))
+    assert not supported(q3, q3, q3)
+
+
+def test_forced_pallas_raises_clear_errors():
+    q, k, v = make_qkv(s=100)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, interpret=True)
+    q2, k2, v2 = make_qkv(s=128)
+    bad_mask = jnp.ones((2, 4, 128, 128), bool)  # full attention mask
+    with pytest.raises(ValueError, match="mask shape"):
+        flash_attention(q2, k2, v2, mask=bad_mask, interpret=True)
+    with pytest.raises(ValueError, match="matching BSHD"):
+        flash_attention(q2, k2[:, :64], v2, interpret=True)
+
+
+def test_jit_and_vmap_compose():
+    q, k, v = make_qkv(b=2, s=128, h=2, d=16)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=True))
+    np.testing.assert_allclose(
+        f(q, k, v), xla_attention(q, k, v), atol=2e-5, rtol=2e-5
+    )

@@ -1,0 +1,114 @@
+"""Expert-parallel MoE tests.
+
+Golden reference: the same layer on an expert-axis-of-1 mesh (pure local
+computation) must match the expert=4 all_to_all-dispatched run exactly when
+capacity is ample.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributedtensorflow_tpu.parallel.moe import (
+    init_expert_params,
+    make_moe_layer,
+    top1_route,
+)
+
+D = 8
+E = 8
+
+
+class ExpertMLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(D, name="out")(nn.relu(nn.Dense(2 * D, name="in")(x)))
+
+
+def expert_fn(params, x):
+    return ExpertMLP().apply({"params": params}, x)
+
+
+def init_one(r):
+    return ExpertMLP().init(r, jnp.zeros((1, D)))["params"]
+
+
+def test_top1_route_invariants():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (16, E))
+    dispatch, combine, aux = top1_route(logits, capacity=4)
+    assert dispatch.shape == (16, E, 4)
+    # each token occupies at most one slot
+    per_token = dispatch.sum(axis=(1, 2))
+    assert ((per_token == 0) | (per_token == 1)).all()
+    # no slot is used twice
+    per_slot = dispatch.sum(axis=0)
+    assert (per_slot <= 1).all()
+    assert np.isfinite(float(aux))
+
+
+def test_capacity_drops_tokens():
+    # all tokens want expert 0; capacity 2 keeps exactly 2
+    logits = jnp.zeros((10, E)).at[:, 0].set(10.0)
+    dispatch, _, _ = top1_route(logits, capacity=2)
+    assert float(dispatch.sum()) == 2.0
+
+
+@pytest.mark.parametrize("expert_axis", [1, 4])
+def test_moe_runs_and_matches_across_meshes(devices, expert_axis):
+    mesh = build_mesh(MeshSpec(data=2, expert=expert_axis),
+                      devices[: 2 * expert_axis])
+    params = init_expert_params(init_one, E, jax.random.PRNGKey(0), mesh)
+    layer = make_moe_layer(mesh, expert_fn, capacity_factor=float(E))
+    tokens = jax.random.normal(jax.random.PRNGKey(1), (64, D))
+    router = jax.random.normal(jax.random.PRNGKey(2), (D, E)) * 0.1
+    out, aux = layer(tokens, router, params)
+    assert out.shape == tokens.shape
+    assert np.isfinite(np.asarray(out)).all()
+    # stash for cross-mesh comparison
+    test_moe_runs_and_matches_across_meshes.results[expert_axis] = (
+        np.asarray(out), float(aux),
+    )
+
+
+test_moe_runs_and_matches_across_meshes.results = {}
+
+
+def test_moe_cross_mesh_agreement():
+    res = test_moe_runs_and_matches_across_meshes.results
+    if len(res) < 2:
+        pytest.skip("parametrized runs incomplete")
+    (o1, a1), (o4, a4) = res[1], res[4]
+    np.testing.assert_allclose(o1, o4, atol=1e-5, rtol=1e-5)
+    # aux is a per-shard load-balance statistic (mean of per-shard products);
+    # it is an estimator, not shard-count-invariant — only roughly equal
+    np.testing.assert_allclose(a1, a4, rtol=0.2)
+
+
+def test_moe_indivisible_experts_raises(devices):
+    mesh = build_mesh(MeshSpec(data=2, expert=4), devices)
+    params = init_expert_params(init_one, E, jax.random.PRNGKey(0), mesh)
+    layer = make_moe_layer(mesh, expert_fn)
+    tokens = jax.random.normal(jax.random.PRNGKey(1), (64, D))
+    router = jax.random.normal(jax.random.PRNGKey(2), (D, 6))  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        layer(tokens, router, params)
+
+
+def test_moe_gradients_flow(devices):
+    mesh = build_mesh(MeshSpec(data=2, expert=4), devices)
+    params = init_expert_params(init_one, E, jax.random.PRNGKey(0), mesh)
+    layer = make_moe_layer(mesh, expert_fn, capacity_factor=float(E))
+    tokens = jax.random.normal(jax.random.PRNGKey(1), (64, D))
+    router = jax.random.normal(jax.random.PRNGKey(2), (D, E)) * 0.1
+
+    def loss(params, router):
+        out, aux = layer(tokens, router, params)
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    grads, grouter = jax.grad(loss, argnums=(0, 1))(params, router)
+    gnorm = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
+    assert gnorm > 0
+    assert float(jnp.sum(jnp.abs(grouter))) > 0
