@@ -27,6 +27,13 @@ from .bootstrap import (  # noqa: F401
     resolve_cluster,
     shutdown,
 )
+from .coordinator import (  # noqa: F401
+    ClosureAborted,
+    Coordinator,
+    PerWorker,
+    RemoteValue,
+    WorkerUnavailableError,
+)
 from .collectives import (  # noqa: F401
     Implementation,
     Options,

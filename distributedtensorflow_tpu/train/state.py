@@ -42,6 +42,16 @@ class TrainState(struct.PyTreeNode):
             step=self.step + 1, params=new_params, opt_state=new_opt_state
         )
 
+    def snapshot(self) -> "TrainState":
+        """Deep-copy the device buffers.
+
+        The compiled train step donates its input state, so a reference kept
+        across a step (async eval/checkpoint closures) points at deleted
+        buffers.  ``snapshot()`` returns a state safe to hand to a
+        :class:`~distributedtensorflow_tpu.parallel.Coordinator` closure.
+        """
+        return jax.tree.map(jnp.copy, self)
+
 
 def split_variables(variables: PyTree) -> tuple[PyTree, PyTree]:
     """Split a flax ``init`` variables dict into (params, model_state)."""

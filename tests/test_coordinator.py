@@ -1,0 +1,165 @@
+"""Coordinator semantics tests (reference: cluster_coordinator.py behavior,
+SURVEY.md §3.3 — schedule/join/fetch, retry on worker loss, error parking,
+per-worker datasets)."""
+
+import threading
+import time
+
+import pytest
+
+from distributedtensorflow_tpu.parallel.coordinator import (
+    ClosureAborted,
+    Coordinator,
+    PerWorker,
+    RemoteValue,
+    WorkerUnavailableError,
+)
+
+
+def test_schedule_and_fetch():
+    with Coordinator(num_workers=2) as coord:
+        rv = coord.schedule(lambda x, y: x + y, (2, 3))
+        assert rv.fetch(timeout=10) == 5
+        coord.join()
+        assert coord.done()
+
+
+def test_schedule_many_parallel():
+    with Coordinator(num_workers=4) as coord:
+        rvs = [coord.schedule(lambda i=i: i * i) for i in range(50)]
+        coord.join(timeout=30)
+        assert [rv.fetch() for rv in rvs] == [i * i for i in range(50)]
+
+
+def test_fetch_nested_structure():
+    with Coordinator(num_workers=2) as coord:
+        rvs = {"a": coord.schedule(lambda: 1), "b": [coord.schedule(lambda: 2)]}
+        coord.join(timeout=10)
+        assert coord.fetch(rvs) == {"a": 1, "b": [2]}
+
+
+def test_application_error_reraised_at_join():
+    def boom():
+        raise ValueError("application bug")
+
+    with Coordinator(num_workers=2) as coord:
+        rv = coord.schedule(boom)
+        with pytest.raises(ValueError, match="application bug"):
+            coord.join(timeout=10)
+        with pytest.raises(ValueError):
+            rv.fetch(timeout=10)
+
+
+def test_error_cancels_queued_closures():
+    release = threading.Event()
+
+    def blocker():
+        release.wait(10)
+
+    def boom():
+        raise RuntimeError("fail fast")
+
+    coord = Coordinator(num_workers=1)
+    try:
+        coord.schedule(blocker)
+        coord.schedule(boom)
+        late = coord.schedule(lambda: 42)  # queued behind the failure
+        release.set()
+        with pytest.raises(RuntimeError, match="fail fast"):
+            coord.join(timeout=10)
+        with pytest.raises(ClosureAborted):
+            late.fetch(timeout=10)
+    finally:
+        coord.shutdown()
+
+
+def test_retryable_error_requeues_to_another_worker():
+    """WorkerUnavailableError = transport failure → transparent retry."""
+    attempts = []
+
+    def flaky():
+        attempts.append(threading.get_ident())
+        if len(attempts) == 1:
+            raise WorkerUnavailableError("worker preempted")
+        return "ok"
+
+    with Coordinator(num_workers=2) as coord:
+        rv = coord.schedule(flaky)
+        assert rv.fetch(timeout=10) == "ok"
+        assert len(attempts) == 2
+
+
+def test_preempt_worker_fault_injection():
+    """A preempted worker's closures land on surviving workers."""
+    with Coordinator(num_workers=2) as coord:
+        coord.preempt_worker(0)
+        rvs = [coord.schedule(lambda i=i: i) for i in range(10)]
+        coord.join(timeout=30)
+        assert [rv.fetch() for rv in rvs] == list(range(10))
+
+
+def test_per_worker_dataset():
+    import itertools
+
+    with Coordinator(num_workers=3) as coord:
+        ds = coord.create_per_worker_dataset(
+            lambda worker_id: (worker_id * 100 + j for j in itertools.count())
+        )
+        assert isinstance(ds, PerWorker)
+
+        def step(it):
+            return next(it)
+
+        got = [coord.schedule(step, (ds,)).fetch(timeout=10) for _ in range(9)]
+        # Each worker consumed from its OWN iterator: per worker id, the
+        # consumed values are exactly the prefix 0..k of its stream.
+        by_worker: dict[int, list[int]] = {}
+        for v in got:
+            by_worker.setdefault(v // 100, []).append(v % 100)
+        for wid, vals in by_worker.items():
+            assert vals == list(range(len(vals))), (wid, vals)
+
+
+def test_join_is_barrier():
+    done_flags = []
+
+    def slow(i):
+        time.sleep(0.05)
+        done_flags.append(i)
+
+    with Coordinator(num_workers=4) as coord:
+        for i in range(8):
+            coord.schedule(slow, (i,))
+        coord.join(timeout=30)
+        assert sorted(done_flags) == list(range(8))
+
+
+def test_retry_cap_exhausted():
+    def always_unavailable():
+        raise WorkerUnavailableError("dead resource")
+
+    with Coordinator(num_workers=2, max_retries=3) as coord:
+        rv = coord.schedule(always_unavailable)
+        with pytest.raises(RuntimeError, match="3 retryable attempts"):
+            rv.fetch(timeout=10)
+        with pytest.raises(RuntimeError):
+            coord.join(timeout=10)
+
+
+def test_shutdown_cancels_queued_closures():
+    release = threading.Event()
+    coord = Coordinator(num_workers=1)
+    coord.schedule(lambda: release.wait(10))
+    queued = coord.schedule(lambda: 1)  # stuck behind the blocker
+    coord._queue.close()
+    release.set()
+    with pytest.raises(ClosureAborted):
+        queued.fetch(timeout=10)
+    coord.shutdown()
+
+
+def test_remote_value_done():
+    rv = RemoteValue()
+    assert not rv.done()
+    rv._set_value(7)
+    assert rv.done() and rv.fetch() == 7
