@@ -19,12 +19,15 @@ from .mesh import (  # noqa: F401
 )
 from .bootstrap import (  # noqa: F401
     ClusterConfig,
+    expand_nodelist,
     initialize,
     is_chief,
     parse_tf_config,
     process_count,
     process_index,
     resolve_cluster,
+    resolve_mpi,
+    resolve_slurm,
     shutdown,
 )
 from .coordinator import (  # noqa: F401

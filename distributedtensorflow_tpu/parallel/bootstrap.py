@@ -18,6 +18,7 @@ import dataclasses
 import json
 import logging
 import os
+import re
 
 import jax
 
@@ -82,26 +83,145 @@ def parse_tf_config(tf_config_json: str) -> ClusterConfig:
     )
 
 
+def expand_nodelist(nodelist: str) -> list[str]:
+    """Expand a Slurm compact nodelist: ``"n[001-003,07],login0"``.
+
+    The subset of Slurm hostlist syntax the reference's
+    ``SlurmClusterResolver`` handles (SURVEY.md §2.3): comma-separated
+    entries, each optionally with one ``[...]`` range group of
+    zero-padded ranges and scalars.
+    """
+    out: list[str] = []
+    # Split on commas not inside brackets.
+    entries, depth, cur = [], 0, []
+    for ch in nodelist:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            entries.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        entries.append("".join(cur))
+    def expand_entry(entry: str) -> list[str]:
+        m = re.fullmatch(r"([^\[]*)\[([^\]]+)\](.*)", entry)
+        if not m:
+            return [entry]
+        prefix, body, suffix = m.groups()
+        expanded: list[str] = []
+        for part in body.split(","):
+            if "-" in part:
+                lo, hi = part.split("-")
+                width = len(lo)
+                expanded.extend(
+                    f"{prefix}{i:0{width}d}{tail}"
+                    for i in range(int(lo), int(hi) + 1)
+                    # multi-group names (Cray "c0c[0-1]n[0-3]"): recurse on
+                    # the suffix so every group expands, not just the first
+                    for tail in expand_entry(suffix)
+                )
+            else:
+                expanded.extend(
+                    f"{prefix}{part}{tail}" for tail in expand_entry(suffix)
+                )
+        return expanded
+
+    for entry in entries:
+        out.extend(expand_entry(entry))
+    return out
+
+
+def resolve_slurm(
+    env: dict[str, str], *, coordinator_port: int = 12321
+) -> ClusterConfig | None:
+    """Resolve from Slurm step env (reference ``slurm_cluster_resolver.py``).
+
+    One JAX process per Slurm task; the coordinator is the first node of the
+    step nodelist.  Honors ``SLURM_STEP_NODELIST`` (srun step) with
+    ``SLURM_JOB_NODELIST`` (sbatch allocation) as fallback.
+    """
+    if "SLURM_PROCID" not in env:
+        return None
+    ntasks = int(env.get("SLURM_STEP_NUM_TASKS", env.get("SLURM_NTASKS", "1")))
+    if ntasks <= 1:
+        # Not a multi-task launch: fall through (a Slurm-wrapped TPU pod job
+        # with one task per host still needs the TPU metadata auto path).
+        return None
+    addr = env.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        nodelist = env.get(
+            "SLURM_STEP_NODELIST", env.get("SLURM_JOB_NODELIST", "")
+        )
+        nodes = expand_nodelist(nodelist) if nodelist else []
+        if not nodes:
+            return None
+        port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
+        addr = f"{nodes[0]}:{port}"
+    return ClusterConfig(
+        coordinator_address=addr,
+        num_processes=ntasks,
+        process_id=int(env["SLURM_PROCID"]),
+    )
+
+
+def resolve_mpi(env: dict[str, str]) -> ClusterConfig | None:
+    """Resolve from an OpenMPI/mpirun launch (``OMPI_COMM_WORLD_*``).
+
+    MPI gives rank/size but no coordinator address — that must come from
+    ``JAX_COORDINATOR_ADDRESS`` (typically ``$(hostname -i)`` of rank 0,
+    exported by the launch script, the ``run_distributed.sh`` pattern).
+    """
+    if "OMPI_COMM_WORLD_RANK" not in env:
+        return None
+    size = int(env.get("OMPI_COMM_WORLD_SIZE", "1"))
+    if size <= 1:
+        return None  # single rank: fall through (see resolve_slurm)
+    addr = env.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return None
+    return ClusterConfig(
+        coordinator_address=addr,
+        num_processes=size,
+        process_id=int(env["OMPI_COMM_WORLD_RANK"]),
+    )
+
+
 def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     """Resolve cluster topology from the environment.
 
-    Priority order (mirrors the reference's resolver chain, SURVEY.md §2.3):
+    Priority order (mirrors the reference's resolver chain, SURVEY.md §2.3:
+    TFConfig → Slurm/GCE/K8s resolvers):
 
     1. JAX-native env vars (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
        / ``JAX_PROCESS_ID``) — the modern launcher path.
     2. ``TF_CONFIG`` — the reference's launcher contract.
-    3. Cloud TPU metadata — handled inside ``jax.distributed.initialize``
+    3. Slurm step env (``SLURM_PROCID``/``SLURM_NTASKS``/nodelist).
+    4. OpenMPI env (``OMPI_COMM_WORLD_RANK``/``SIZE``).
+    5. Cloud TPU metadata — handled inside ``jax.distributed.initialize``
        itself (args all None); we return an "auto" marker config.
     """
     env = dict(os.environ if env is None else env)
-    if "JAX_COORDINATOR_ADDRESS" in env:
-        return ClusterConfig(
-            coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
-            num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
-            process_id=int(env.get("JAX_PROCESS_ID", "0")),
-        )
+    if env.get("JAX_COORDINATOR_ADDRESS"):
+        if "JAX_PROCESS_ID" in env:
+            return ClusterConfig(
+                coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
+                num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
+                process_id=int(env.get("JAX_PROCESS_ID", "0")),
+            )
+        if not any(k in env for k in ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK")):
+            logger.warning(
+                "JAX_COORDINATOR_ADDRESS set but JAX_PROCESS_ID missing and no "
+                "Slurm/MPI env to derive a rank from; treating as local"
+            )
     if env.get("TF_CONFIG"):
         return parse_tf_config(env["TF_CONFIG"])
+    for resolver in (resolve_slurm, resolve_mpi):
+        cfg = resolver(env)
+        if cfg is not None:
+            return cfg
     # Cloud TPU pod: the libtpu/metadata env describes a multi-host slice;
     # jax.distributed.initialize(None, ...) self-discovers the cluster there.
     hostnames = env.get("TPU_WORKER_HOSTNAMES", "")

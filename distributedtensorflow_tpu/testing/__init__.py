@@ -1,0 +1,10 @@
+"""Test harness: multi-process cluster runner + fault injection."""
+
+from .multi_process_runner import (  # noqa: F401
+    MultiProcessResult,
+    MultiProcessRunner,
+    SubprocessTimeoutError,
+    UnexpectedSubprocessExitError,
+    pick_unused_port,
+    run,
+)

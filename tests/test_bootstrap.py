@@ -56,3 +56,74 @@ def test_resolve_cluster_priority():
     cfg2 = resolve_cluster({k: v for k, v in env.items() if k == "TF_CONFIG"})
     assert cfg2.num_processes == 2
     assert resolve_cluster({}) == ClusterConfig()
+
+
+def test_expand_nodelist():
+    from distributedtensorflow_tpu.parallel import expand_nodelist
+
+    assert expand_nodelist("n001") == ["n001"]
+    assert expand_nodelist("n[001-003]") == ["n001", "n002", "n003"]
+    assert expand_nodelist("n[001-002,07],login0") == ["n001", "n002", "n07", "login0"]
+    assert expand_nodelist("a[1-2]b") == ["a1b", "a2b"]
+    assert expand_nodelist("tpu-host[10-11],cpu[1,3]") == [
+        "tpu-host10", "tpu-host11", "cpu1", "cpu3"]
+    # Cray-style multi-group names: every bracket group expands
+    assert expand_nodelist("c0c[0-1]n[0-1]") == [
+        "c0c0n0", "c0c0n1", "c0c1n0", "c0c1n1"]
+
+
+def test_resolve_slurm():
+    from distributedtensorflow_tpu.parallel import resolve_slurm
+
+    env = {
+        "SLURM_PROCID": "3",
+        "SLURM_NTASKS": "4",
+        "SLURM_STEP_NODELIST": "node[01-04]",
+    }
+    cfg = resolve_slurm(env)
+    assert cfg.coordinator_address == "node01:12321"
+    assert cfg.num_processes == 4 and cfg.process_id == 3
+
+    # single task -> None: fall through (Slurm-wrapped TPU pod jobs must
+    # still reach the TPU metadata auto path)
+    assert resolve_slurm({"SLURM_PROCID": "0", "SLURM_NTASKS": "1"}) is None
+    # no slurm env -> None (fall through to next resolver)
+    assert resolve_slurm({}) is None
+    # custom coordinator port
+    env["JAX_COORDINATOR_PORT"] = "999"
+    assert resolve_slurm(env).coordinator_address == "node01:999"
+    # an explicitly exported coordinator address wins over the nodelist
+    env["JAX_COORDINATOR_ADDRESS"] = "10.1.2.3:555"
+    assert resolve_slurm(env).coordinator_address == "10.1.2.3:555"
+
+
+def test_resolve_mpi():
+    from distributedtensorflow_tpu.parallel import resolve_mpi
+
+    env = {
+        "OMPI_COMM_WORLD_RANK": "1",
+        "OMPI_COMM_WORLD_SIZE": "2",
+        "JAX_COORDINATOR_ADDRESS": "10.0.0.1:777",
+    }
+    cfg = resolve_mpi(env)
+    assert cfg.coordinator_address == "10.0.0.1:777"
+    assert cfg.num_processes == 2 and cfg.process_id == 1
+    # MPI without coordinator address cannot resolve
+    assert resolve_mpi({"OMPI_COMM_WORLD_RANK": "0", "OMPI_COMM_WORLD_SIZE": "2"}) is None
+    assert resolve_mpi({}) is None
+
+
+def test_resolve_cluster_slurm_priority():
+    import json as _json
+
+    env = {
+        "SLURM_PROCID": "0",
+        "SLURM_NTASKS": "2",
+        "SLURM_STEP_NODELIST": "n[1-2]",
+    }
+    cfg = resolve_cluster(env)
+    assert cfg.num_processes == 2 and cfg.coordinator_address == "n1:12321"
+    # TF_CONFIG outranks Slurm
+    env["TF_CONFIG"] = _json.dumps({"cluster": {"worker": ["w:1", "v:1", "u:1"]},
+                                    "task": {"type": "worker", "index": 2}})
+    assert resolve_cluster(env).num_processes == 3

@@ -1,0 +1,207 @@
+"""Multi-process cluster tests: real 2-process JAX clusters with Gloo
+collectives, resolver-chain bootstrap, fault injection, restart-resume.
+
+Reference model: ``MultiProcessRunner`` + ``multi_worker_test_base`` +
+``fault_tolerance_test_base`` (SURVEY.md §4, §5.3).  These fork real OS
+processes, so they are the slowest tests in the suite; keep the cluster at
+2 tasks with 1 virtual device each.
+"""
+
+import os
+
+import pytest
+
+from distributedtensorflow_tpu.testing import (
+    MultiProcessRunner,
+    SubprocessTimeoutError,
+    UnexpectedSubprocessExitError,
+    pick_unused_port,
+    run,
+)
+
+ONE_DEV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+
+# --- child fns (module-level: spawn pickles them) ---------------------------
+
+
+def _allgather_task(task_id):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    x = multihost_utils.process_allgather(jnp.array([float(task_id + 1)]))
+    return {
+        "gathered": [float(v) for v in x.ravel()],
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+    }
+
+
+def _psum_over_mesh_task(task_id):
+    """Global mesh across processes: the MultiWorkerMirrored north star."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=-1))  # spans both processes' devices
+    n = mesh.size
+
+    @jax.jit
+    def global_sum(x):
+        return jnp.sum(x)
+
+    shards = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")),
+        np.full((1,), float(task_id + 1), np.float32),
+        (n,),
+    )
+    return float(global_sum(shards))
+
+
+def _failing_task(task_id):
+    if task_id == 1:
+        raise ValueError("injected application failure")
+    return "ok"
+
+
+def _sleeper_task(task_id):
+    import time
+
+    time.sleep(60)
+    return "never"
+
+
+def _train_with_checkpoint_task(task_id, ckpt_dir, total_steps):
+    """Train mnist-lenet with periodic checkpoints; resume if one exists."""
+    import jax
+
+    from distributedtensorflow_tpu.checkpoint import CheckpointManager
+    from distributedtensorflow_tpu.data import InputContext, device_put_batch
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    wl = get_workload("mnist_lenet", test_size=True, global_batch_size=8)
+    mesh = build_mesh(MeshSpec(data=-1))
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(wl.init_fn, wl.make_optimizer(), mesh, rng)
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    restored = mgr.restore_latest(state)
+    start_step = 0
+    if restored is not None:
+        state = restored
+        start_step = int(state.step)
+    step_fn = make_train_step(wl.loss_fn, mesh, specs)
+    it = wl.input_fn(InputContext(1, 0, wl.global_batch_size), 0)
+    for i in range(start_step, total_steps):
+        state, _ = step_fn(state, device_put_batch(next(it), mesh), rng)
+        if (i + 1) % 5 == 0:
+            mgr.save(i + 1, state)
+    mgr.wait()
+    mgr.close()
+    return {"start_step": start_step, "end_step": int(state.step)}
+
+
+# --- tests ------------------------------------------------------------------
+
+
+def test_two_process_allgather():
+    result = run(_allgather_task, 2, env=ONE_DEV, timeout=120)
+    assert result.exit_codes == {0: 0, 1: 0}
+    for task_id in (0, 1):
+        rv = result.return_values[task_id]
+        assert rv["gathered"] == [1.0, 2.0]
+        assert rv["process_index"] == task_id
+        assert rv["process_count"] == 2
+
+
+def test_global_mesh_psum_across_processes():
+    result = run(_psum_over_mesh_task, 2, env=ONE_DEV, timeout=120)
+    # Each process contributed its shard; the jitted global sum sees both.
+    assert result.return_values == {0: 3.0, 1: 3.0}
+
+
+def test_slurm_resolver_end_to_end():
+    """Children bootstrap via the Slurm resolver chain, not JAX env vars."""
+    port = pick_unused_port()
+    base = {
+        "JAX_COORDINATOR_ADDRESS": "",  # force fall-through past path 1
+        "SLURM_NTASKS": "2",
+        "SLURM_STEP_NODELIST": "localhost",
+        "JAX_COORDINATOR_PORT": str(port),
+        **ONE_DEV,
+    }
+    result = run(
+        _allgather_task, 2, env=base,
+        per_task_env=[{"SLURM_PROCID": "0"}, {"SLURM_PROCID": "1"}],
+        timeout=120,
+    )
+    assert result.return_values[0]["process_count"] == 2
+    assert result.return_values[1]["gathered"] == [1.0, 2.0]
+
+
+def test_tf_config_resolver_end_to_end():
+    """run_distributed.sh semantics: cluster from TF_CONFIG per task."""
+    import json
+
+    port = pick_unused_port()
+    workers = [f"localhost:{port}", f"localhost:{pick_unused_port()}"]
+    per_task = [
+        {"TF_CONFIG": json.dumps({
+            "cluster": {"worker": workers},
+            "task": {"type": "worker", "index": i},
+        })}
+        for i in range(2)
+    ]
+    result = run(
+        _allgather_task, 2,
+        env={"JAX_COORDINATOR_ADDRESS": "", **ONE_DEV},
+        per_task_env=per_task, timeout=120,
+    )
+    assert result.return_values[0]["gathered"] == [1.0, 2.0]
+
+
+def test_unexpected_exit_raises():
+    with pytest.raises(UnexpectedSubprocessExitError) as ei:
+        run(_failing_task, 2, env=ONE_DEV, timeout=120)
+    result = ei.value.result
+    assert result.return_values[0] == "ok"
+    assert "injected application failure" in result.return_values[1]
+
+
+def test_kill_fault_injection():
+    runner = MultiProcessRunner(
+        _sleeper_task, 2, env=ONE_DEV, timeout=20
+    ).start()
+    runner.terminate(0)
+    runner.terminate(1)
+    result = runner.join()
+    assert result.return_values == {}
+    assert all(code != 0 for code in result.exit_codes.values())
+
+
+def test_timeout_kills_stragglers():
+    runner = MultiProcessRunner(_sleeper_task, 1, env=ONE_DEV).start()
+    with pytest.raises(SubprocessTimeoutError):
+        runner.join(timeout=8)
+
+
+def test_restart_resume_from_checkpoint(tmp_path):
+    """Fault-tolerance semantics (SURVEY.md §5.3): the sync path recovers by
+    restart-from-checkpoint.  First run 'preempted' after 10 steps; second
+    run must resume at 10, not 0."""
+    ckpt = str(tmp_path / "ckpt")
+    first = run(
+        _train_with_checkpoint_task, 1, args=(ckpt, 10), env=ONE_DEV,
+        timeout=240,
+    )
+    assert first.return_values[0] == {"start_step": 0, "end_step": 10}
+    second = run(
+        _train_with_checkpoint_task, 1, args=(ckpt, 15), env=ONE_DEV,
+        timeout=240,
+    )
+    assert second.return_values[0] == {"start_step": 10, "end_step": 15}
