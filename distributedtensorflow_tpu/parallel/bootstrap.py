@@ -205,16 +205,38 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     """
     env = dict(os.environ if env is None else env)
     if env.get("JAX_COORDINATOR_ADDRESS"):
-        if "JAX_PROCESS_ID" in env:
-            return ClusterConfig(
+        # Rank may come from JAX_PROCESS_ID, a Slurm/MPI env (defer to those
+        # resolvers), or — for JAX_PROCESS_ID-less rank-0 launches — default
+        # to 0 when JAX_NUM_PROCESSES is given.
+        has_scheduler_rank = any(
+            k in env for k in ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK")
+        )
+        if "JAX_PROCESS_ID" in env or (
+            "JAX_NUM_PROCESSES" in env and not has_scheduler_rank
+        ):
+            cfg = ClusterConfig(
                 coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
                 num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
                 process_id=int(env.get("JAX_PROCESS_ID", "0")),
             )
-        if not any(k in env for k in ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK")):
+            if cfg.process_id >= cfg.num_processes:
+                raise ValueError(
+                    f"JAX_PROCESS_ID={cfg.process_id} out of range for "
+                    f"JAX_NUM_PROCESSES={cfg.num_processes}; multi-process "
+                    "launches must export JAX_NUM_PROCESSES on every rank"
+                )
+            if "JAX_PROCESS_ID" not in env and cfg.num_processes > 1:
+                logger.warning(
+                    "JAX_PROCESS_ID missing; assuming process_id=0 (rank-0 "
+                    "launch). Every other process in this job must export a "
+                    "distinct JAX_PROCESS_ID or the job will not form."
+                )
+            return cfg
+        if not has_scheduler_rank:
             logger.warning(
-                "JAX_COORDINATOR_ADDRESS set but JAX_PROCESS_ID missing and no "
-                "Slurm/MPI env to derive a rank from; treating as local"
+                "JAX_COORDINATOR_ADDRESS set but neither JAX_PROCESS_ID nor "
+                "JAX_NUM_PROCESSES present and no Slurm/MPI env to derive a "
+                "rank from; treating as local"
             )
     if env.get("TF_CONFIG"):
         return parse_tf_config(env["TF_CONFIG"])

@@ -46,16 +46,37 @@ class UnexpectedSubprocessExitError(RuntimeError):
 
 @dataclasses.dataclass
 class MultiProcessResult:
-    """Per-task outcomes; ``return_values[i]`` missing if task i died."""
+    """Per-task outcomes.
+
+    ``return_values[i]`` holds task i's return value (missing if it died or
+    raised); ``failures[i]`` holds the ``repr`` of the exception a failed
+    task raised (missing if it succeeded or was killed before reporting).
+    """
 
     return_values: dict[int, Any]
+    failures: dict[int, str]
     exit_codes: dict[int, int | None]
 
 
+_handed_out_ports: set[int] = set()
+
+
 def pick_unused_port() -> int:
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+    """Pick a free localhost port, never repeating within this process.
+
+    The socket closes before the caller binds the port, so an unrelated
+    process could still steal it (inherent to port-picking); the dedupe set
+    closes the much more likely race of two consecutive calls getting the
+    same ephemeral port back from the kernel.
+    """
+    while True:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        if port not in _handed_out_ports:
+            _handed_out_ports.add(port)
+            return port
 
 
 def _child_main(
@@ -77,11 +98,11 @@ def _child_main(
     import jax
 
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    if init_distributed:
-        from ..parallel import bootstrap
-
-        bootstrap.initialize()
     try:
+        if init_distributed:
+            from ..parallel import bootstrap
+
+            bootstrap.initialize()
         value = fn(task_id, *args, **kwargs)
         result_queue.put((task_id, True, value))
     except BaseException as e:  # noqa: BLE001 — reported to the parent
@@ -150,14 +171,26 @@ class MultiProcessRunner:
     def join(self, timeout: float | None = None) -> MultiProcessResult:
         timeout = self._timeout if timeout is None else timeout
         deadline = time.monotonic() + timeout
+        values: dict[int, Any] = {}
+        failures: dict[int, str] = {}
+        # Drain while waiting: a child whose return value exceeds the queue's
+        # pipe buffer blocks in its feeder thread until the parent reads, so
+        # joining before draining would deadlock (then falsely time out).
+        while (
+            any(p.is_alive() for p in self._procs)
+            and time.monotonic() < deadline
+        ):
+            self._drain(values, failures, wait=0.05)
         for p in self._procs:
             p.join(max(0.0, deadline - time.monotonic()))
         timed_out = [p for p in self._procs if p.is_alive()]
         for p in timed_out:
             p.kill()
             p.join(10)
+        self._drain(values, failures)
         result = MultiProcessResult(
-            return_values=self._drain(),
+            return_values=values,
+            failures=failures,
             exit_codes={i: p.exitcode for i, p in enumerate(self._procs)},
         )
         if timed_out:
@@ -172,20 +205,27 @@ class MultiProcessRunner:
         }
         if bad:
             raise UnexpectedSubprocessExitError(
-                f"tasks exited nonzero: {bad}; "
-                f"failures: { {k: v for k, v in result.return_values.items() if isinstance(v, str)} }",
-                result,
+                f"tasks exited nonzero: {bad}; failures: {failures}", result,
             )
         return result
 
-    def _drain(self) -> dict[int, Any]:
-        values: dict[int, Any] = {}
+    def _drain(
+        self,
+        values: dict[int, Any],
+        failures: dict[int, str],
+        wait: float = 0.0,
+    ) -> None:
+        block = wait > 0
         while True:
             try:
-                task_id, ok, value = self._queue.get_nowait()
+                task_id, ok, value = self._queue.get(block, wait or None)
             except queue_lib.Empty:
-                return values
-            values[task_id] = value  # error repr when the task failed
+                return
+            block = False  # only the first read waits
+            if ok:
+                values[task_id] = value
+            else:
+                failures[task_id] = value
 
 
 def run(

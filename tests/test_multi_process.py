@@ -170,7 +170,8 @@ def test_unexpected_exit_raises():
         run(_failing_task, 2, env=ONE_DEV, timeout=120)
     result = ei.value.result
     assert result.return_values[0] == "ok"
-    assert "injected application failure" in result.return_values[1]
+    assert 1 not in result.return_values
+    assert "injected application failure" in result.failures[1]
 
 
 def test_kill_fault_injection():
