@@ -1,0 +1,29 @@
+"""ctypes bindings for the native runtime library (C++).
+
+The compiled layer of the framework, mirroring the reference stack's native
+components (SURVEY.md §2.2/§2.3): record IO (the tf.data C++ record-reader
+role, ``hdr/data/``) and host-side ring collectives (the RingReducer /
+rendezvous-transport role, ``hdr/common_runtime/ring_reducer.h:32``,
+``hdr/distributed_runtime/rpc/rpc_rendezvous_mgr.h:45``).  Device-side
+collectives are XLA-compiled onto ICI and never touch this module; this is
+the *host* path — data loading, CPU tensors, cross-process control.
+
+pybind11 is not available in this image, so the library exposes a flat C ABI
+consumed here with ctypes.  The shared object is built on demand from
+``native/src`` with g++ (no network, no pip).
+"""
+
+from .lib import build_native_library, load_native_library, native_available
+from .recordio import RecordReader, RecordWriter, crc32c, masked_crc32c
+from .ringcomm import HostCollectives
+
+__all__ = [
+    "HostCollectives",
+    "RecordReader",
+    "RecordWriter",
+    "build_native_library",
+    "crc32c",
+    "load_native_library",
+    "masked_crc32c",
+    "native_available",
+]

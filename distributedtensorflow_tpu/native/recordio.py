@@ -1,0 +1,128 @@
+"""Python surface over the native record IO (C++ threaded reader/writer).
+
+The record format is the classic length+CRC32C framing, so files written
+here are interchangeable with TFRecord files (the reference's on-disk input
+format — SURVEY.md §2.3 tf.data).  The reader's multi-file threading and
+shuffle buffer run entirely in C++; Python only sees finished ``bytes``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections.abc import Iterator, Sequence
+
+from .lib import load_native_library
+
+
+def crc32c(data: bytes) -> int:
+    """Raw CRC32-C of ``data`` (native slice-by-8 implementation)."""
+    return load_native_library().dtf_crc32c(data, len(data))
+
+
+def masked_crc32c(data: bytes) -> int:
+    """Masked CRC32-C as stored in the record framing."""
+    return load_native_library().dtf_crc32c_masked(data, len(data))
+
+
+class RecordWriter:
+    """Writes length+CRC framed records to one file."""
+
+    def __init__(self, path: str):
+        self._lib = load_native_library()
+        self._h = self._lib.dtf_writer_open(str(path).encode())
+        if not self._h:
+            raise OSError(f"cannot open {path!r} for writing")
+
+    def write(self, record: bytes) -> None:
+        if self._h is None:
+            raise ValueError("writer is closed")
+        if self._lib.dtf_writer_write(self._h, record, len(record)) != 0:
+            raise OSError("record write failed")
+
+    def flush(self) -> None:
+        if self._h is not None:
+            self._lib.dtf_writer_flush(self._h)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.dtf_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "RecordWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RecordCorruptionError(IOError):
+    """A record failed CRC verification or had broken framing."""
+
+
+class RecordReader:
+    """Iterates records from many files with C++ reader threads.
+
+    Args:
+      paths: record files; assigned round-robin to reader threads, so with
+        ``num_threads > 1`` records from different files interleave (the
+        tf.data ``interleave`` behavior).
+      num_threads: C++ reader threads (clamped to ``len(paths)``).
+      shuffle_buffer: >1 enables streaming shuffle over a buffer of this many
+        records (the ``shuffle(buffer_size)`` contract).
+      seed: shuffle RNG seed — same seed + same single-threaded file order
+        reproduces the same stream.
+      verify_crc: verify per-record CRCs (cheap: slice-by-8, single pass).
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        *,
+        num_threads: int = 1,
+        shuffle_buffer: int = 0,
+        seed: int = 0,
+        verify_crc: bool = True,
+    ):
+        if not paths:
+            raise ValueError("RecordReader needs at least one file")
+        self._lib = load_native_library()
+        arr = (ctypes.c_char_p * len(paths))(
+            *[str(p).encode() for p in paths]
+        )
+        self._h = self._lib.dtf_reader_open(
+            arr, len(paths), num_threads, shuffle_buffer, seed, int(verify_crc)
+        )
+        if not self._h:
+            raise OSError(f"cannot open record files {list(paths)!r}")
+
+    def __iter__(self) -> Iterator[bytes]:
+        return self
+
+    def __next__(self) -> bytes:
+        if self._h is None:
+            raise StopIteration
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.dtf_reader_next(self._h, ctypes.byref(out))
+        if n == -1:
+            self.close()
+            raise StopIteration
+        if n == -2:
+            self.close()
+            raise RecordCorruptionError(
+                "corrupt record encountered (bad CRC or framing)"
+            )
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.dtf_free(out)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.dtf_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "RecordReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

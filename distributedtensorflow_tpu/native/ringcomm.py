@@ -1,0 +1,135 @@
+"""Host collectives: numpy-facing surface over the C++ TCP ring.
+
+Covers the host-side role of the reference's compiled collectives
+(SURVEY.md §2.2 RingReducer/RingGatherer + gRPC rendezvous): CPU-resident
+tensors moving between processes — metric aggregation, input-pipeline
+coordination, CPU fallback in the multi-process test harness.  Device-side
+(TPU) collectives never come here; they are XLA-compiled onto ICI via
+``parallel.collectives``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections.abc import Sequence
+
+import numpy as np
+
+from .lib import load_native_library
+
+_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+_OPS = {"sum": 0, "max": 1, "min": 2, "prod": 3}
+
+
+class HostCollectives:
+    """A ring communicator over TCP among ``world`` host processes.
+
+    Every process passes the same ``peers`` list ("host:port" per rank);
+    rank ``r`` listens on ``peers[r]`` and connects to ``peers[(r+1)%world]``.
+    Construction is a rendezvous: it returns once both neighbor links are up.
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        peers: Sequence[str],
+        *,
+        timeout_ms: int = 300_000,
+    ):
+        self._lib = load_native_library()
+        world = len(peers)
+        if not 0 <= rank < world:
+            raise ValueError(f"rank {rank} out of range for {world} peers")
+        arr = (ctypes.c_char_p * world)(*[p.encode() for p in peers])
+        self._h = self._lib.dtf_comm_create(rank, world, arr, timeout_ms)
+        if not self._h:
+            raise ConnectionError(
+                f"ring setup failed (rank {rank}, peers {list(peers)})"
+            )
+        self.rank = rank
+        self.world = world
+
+    def _check(self, status: int, what: str) -> None:
+        if status != 0:
+            raise ConnectionError(f"{what} failed (rank {self.rank})")
+
+    def all_reduce(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
+        """Ring all-reduce; returns a new array with the reduced values."""
+        dt = _DTYPES.get(np.dtype(x.dtype))
+        if dt is None:
+            raise TypeError(f"unsupported dtype {x.dtype}")
+        out = np.ascontiguousarray(x).copy()
+        self._check(
+            self._lib.dtf_comm_allreduce(
+                self._h,
+                out.ctypes.data_as(ctypes.c_void_p),
+                out.size,
+                dt,
+                _OPS[op],
+            ),
+            "all_reduce",
+        )
+        return out
+
+    def all_gather(self, x: np.ndarray) -> np.ndarray:
+        """Gather equal-shaped arrays from all ranks; output has a leading
+        ``world`` axis ordered by rank."""
+        x = np.ascontiguousarray(x)
+        out = np.empty((self.world,) + x.shape, dtype=x.dtype)
+        self._check(
+            self._lib.dtf_comm_allgather(
+                self._h,
+                x.ctypes.data_as(ctypes.c_void_p),
+                x.nbytes,
+                out.ctypes.data_as(ctypes.c_void_p),
+            ),
+            "all_gather",
+        )
+        return out
+
+    def all_gather_bytes(self, blob: bytes, max_len: int = 1 << 20) -> list[bytes]:
+        """Gather variable-length byte strings (padded under the hood)."""
+        if len(blob) > max_len:
+            raise ValueError(f"blob of {len(blob)} bytes exceeds max_len={max_len}")
+        buf = np.zeros(max_len + 8, dtype=np.uint8)
+        buf[:8] = np.frombuffer(
+            len(blob).to_bytes(8, "little"), dtype=np.uint8
+        )
+        buf[8 : 8 + len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+        gathered = self.all_gather(buf)
+        out = []
+        for r in range(self.world):
+            n = int.from_bytes(gathered[r, :8].tobytes(), "little")
+            out.append(gathered[r, 8 : 8 + n].tobytes())
+        return out
+
+    def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:
+        """Broadcast ``x`` from ``root``; non-root input values are ignored
+        (shape/dtype must match)."""
+        out = np.ascontiguousarray(x).copy()
+        self._check(
+            self._lib.dtf_comm_broadcast(
+                self._h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes, root
+            ),
+            "broadcast",
+        )
+        return out
+
+    def barrier(self) -> None:
+        self._check(self._lib.dtf_comm_barrier(self._h), "barrier")
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.dtf_comm_destroy(self._h)
+            self._h = None
+
+    def __enter__(self) -> "HostCollectives":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -205,19 +205,22 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     """
     env = dict(os.environ if env is None else env)
     if env.get("JAX_COORDINATOR_ADDRESS"):
-        # Rank may come from JAX_PROCESS_ID, a Slurm/MPI env (defer to those
-        # resolvers), or — for JAX_PROCESS_ID-less rank-0 launches — default
-        # to 0 when JAX_NUM_PROCESSES is given.
+        # Rank precedence: JAX_PROCESS_ID, else a scheduler rank var (a
+        # multi-task Slurm/MPI launch with the JAX vars exported), else 0.
+        # An explicit JAX_NUM_PROCESSES always selects this path — even with
+        # stale scheduler vars in the env (e.g. an interactive `srun --pty`
+        # shell has SLURM_PROCID=0), the user's explicit JAX vars win.
         has_scheduler_rank = any(
             k in env for k in ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK")
         )
-        if "JAX_PROCESS_ID" in env or (
-            "JAX_NUM_PROCESSES" in env and not has_scheduler_rank
-        ):
+        if "JAX_PROCESS_ID" in env or "JAX_NUM_PROCESSES" in env:
+            rank = env.get("JAX_PROCESS_ID") or env.get(
+                "SLURM_PROCID"
+            ) or env.get("OMPI_COMM_WORLD_RANK") or "0"
             cfg = ClusterConfig(
                 coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
                 num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
-                process_id=int(env.get("JAX_PROCESS_ID", "0")),
+                process_id=int(rank),
             )
             if cfg.process_id >= cfg.num_processes:
                 raise ValueError(
@@ -227,9 +230,10 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
                 )
             if "JAX_PROCESS_ID" not in env and cfg.num_processes > 1:
                 logger.warning(
-                    "JAX_PROCESS_ID missing; assuming process_id=0 (rank-0 "
-                    "launch). Every other process in this job must export a "
-                    "distinct JAX_PROCESS_ID or the job will not form."
+                    "JAX_PROCESS_ID missing; derived process_id=%d (from "
+                    "scheduler env, or 0). Every process in this job must "
+                    "resolve a distinct rank or the job will not form.",
+                    cfg.process_id,
                 )
             return cfg
         if not has_scheduler_rank:

@@ -1,0 +1,27 @@
+// CRC32-C (Castagnoli) with the record-framing mask.
+//
+// TPU-native reimplementation of the record checksum used by the reference
+// stack's record format (SURVEY.md §2.3 tf.data / hdr/data — the wheel ships
+// only headers; this is an independent slice-by-8 software implementation).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace dtf {
+
+// Raw CRC32-C over `n` bytes, seeded with `crc` (0 for a fresh sum).
+uint32_t crc32c(uint32_t crc, const void* data, size_t n);
+
+// Rotate-and-offset masking so CRCs stored alongside CRC-covered data do not
+// corrupt themselves (same scheme as the classic record format).
+inline uint32_t crc32c_mask(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+inline uint32_t crc32c_unmask(uint32_t masked) {
+  uint32_t rot = masked - 0xa282ead8u;
+  return (rot >> 17) | (rot << 15);
+}
+
+}  // namespace dtf

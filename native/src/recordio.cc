@@ -1,0 +1,299 @@
+// Native record IO: length-prefixed, CRC32C-framed records with a threaded,
+// shuffling, multi-file reader.
+//
+// This is the compiled data-loader core of the framework's input pipeline —
+// the native equivalent of the reference stack's tf.data C++ record readers
+// (SURVEY.md §2.3 "tf.data C++ runtime (hdr/data/)").  Wire format per record
+// (compatible with the classic TFRecord framing):
+//
+//   uint64 length (little-endian)
+//   uint32 masked crc32c of the 8 length bytes
+//   byte   data[length]
+//   uint32 masked crc32c of data
+//
+// The reader fans N worker threads over the file list (static round-robin
+// assignment), each streaming records into a bounded queue; an optional
+// shuffle buffer on the consumer side does reservoir-style sampling so
+// records mix across files (the tf.data interleave+shuffle idiom).
+//
+// Exposed as a flat C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "crc32c.h"
+
+namespace dtf {
+namespace {
+
+struct Record {
+  uint8_t* data = nullptr;
+  uint64_t len = 0;
+};
+
+// Bounded MPSC queue of records.
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t cap) : cap_(cap) {}
+
+  // Returns false if the queue was closed for writing (consumer gone).
+  bool push(Record r) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_not_full_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) {
+      free(r.data);
+      return false;
+    }
+    q_.push_back(r);
+    cv_not_empty_.notify_one();
+    return true;
+  }
+
+  // Producer-side: one fewer producer remains; consumers wake on last exit.
+  void producer_done() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (--producers_ == 0) cv_not_empty_.notify_all();
+  }
+
+  void add_producer() {
+    std::lock_guard<std::mutex> lk(mu_);
+    ++producers_;
+  }
+
+  // Returns false on end-of-stream (all producers done, queue drained).
+  bool pop(Record* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_not_empty_.wait(lk, [&] { return !q_.empty() || producers_ == 0; });
+    if (q_.empty()) return false;
+    *out = q_.front();
+    q_.pop_front();
+    cv_not_full_.notify_one();
+    return true;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    for (auto& r : q_) free(r.data);
+    q_.clear();
+    cv_not_full_.notify_all();
+    cv_not_empty_.notify_all();
+  }
+
+ private:
+  const size_t cap_;
+  std::mutex mu_;
+  std::condition_variable cv_not_full_, cv_not_empty_;
+  std::deque<Record> q_;
+  int producers_ = 0;
+  bool closed_ = false;
+};
+
+class Writer {
+ public:
+  explicit Writer(const char* path) : f_(fopen(path, "wb")) {}
+  ~Writer() {
+    if (f_) fclose(f_);
+  }
+  bool ok() const { return f_ != nullptr; }
+
+  bool write(const void* data, uint64_t len) {
+    uint8_t hdr[12];
+    memcpy(hdr, &len, 8);  // little-endian hosts only (x86/aarch64)
+    uint32_t lc = crc32c_mask(crc32c(0, hdr, 8));
+    memcpy(hdr + 8, &lc, 4);
+    uint32_t dc = crc32c_mask(crc32c(0, data, len));
+    return fwrite(hdr, 1, 12, f_) == 12 &&
+           (len == 0 || fwrite(data, 1, len, f_) == len) &&
+           fwrite(&dc, 1, 4, f_) == 4;
+  }
+
+  bool flush() { return fflush(f_) == 0; }
+
+ private:
+  FILE* f_;
+};
+
+// Reads one file sequentially, pushing records into the shared queue.
+// Returns false on framing/CRC corruption.
+bool read_file(const std::string& path, bool verify_crc, BoundedQueue* q) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return false;
+  bool ok = true;
+  for (;;) {
+    uint8_t hdr[12];
+    size_t n = fread(hdr, 1, 12, f);
+    if (n == 0) break;  // clean EOF
+    if (n != 12) {
+      ok = false;
+      break;
+    }
+    uint64_t len;
+    memcpy(&len, hdr, 8);
+    if (verify_crc) {
+      uint32_t lc;
+      memcpy(&lc, hdr + 8, 4);
+      if (crc32c_mask(crc32c(0, hdr, 8)) != lc) {
+        ok = false;
+        break;
+      }
+    }
+    // 1 GiB sanity cap: a corrupt length field would otherwise drive a
+    // multi-exabyte malloc.
+    if (len > (1ull << 30)) {
+      ok = false;
+      break;
+    }
+    uint8_t* data = static_cast<uint8_t*>(malloc(len ? len : 1));
+    if (data == nullptr) {
+      ok = false;
+      break;
+    }
+    if (fread(data, 1, len, f) != len) {
+      free(data);
+      ok = false;
+      break;
+    }
+    uint32_t dc;
+    if (fread(&dc, 1, 4, f) != 4) {
+      free(data);
+      ok = false;
+      break;
+    }
+    if (verify_crc && crc32c_mask(crc32c(0, data, len)) != dc) {
+      free(data);
+      ok = false;
+      break;
+    }
+    if (!q->push(Record{data, len})) break;  // reader closed underneath us
+  }
+  fclose(f);
+  return ok;
+}
+
+class Reader {
+ public:
+  Reader(std::vector<std::string> files, int num_threads, int shuffle_buffer,
+         uint64_t seed, bool verify_crc)
+      : files_(std::move(files)),
+        queue_(256),
+        shuffle_cap_(shuffle_buffer),
+        rng_(seed) {
+    if (num_threads < 1) num_threads = 1;
+    if (num_threads > static_cast<int>(files_.size()))
+      num_threads = static_cast<int>(files_.size());
+    for (int t = 0; t < num_threads; ++t) queue_.add_producer();
+    for (int t = 0; t < num_threads; ++t) {
+      threads_.emplace_back([this, t, num_threads, verify_crc] {
+        // Static round-robin file assignment per worker thread.
+        for (size_t i = t; i < files_.size(); i += num_threads) {
+          if (!read_file(files_[i], verify_crc, &queue_))
+            corrupt_.store(true, std::memory_order_relaxed);
+        }
+        queue_.producer_done();
+      });
+    }
+  }
+
+  ~Reader() {
+    queue_.close();
+    for (auto& th : threads_) th.join();
+    for (auto& r : shuffle_) free(r.data);
+  }
+
+  // -1 = end of stream, -2 = corruption detected; else record length.
+  int64_t next(uint8_t** out) {
+    // Fail fast: once any worker hits corruption the stream is poisoned —
+    // report it on the next pull rather than after the drain, so bounded
+    // consumers (islice/early break) still see the error.
+    if (corrupt_.load(std::memory_order_relaxed)) return -2;
+    // Keep the shuffle buffer topped up, then emit a uniformly random
+    // element from it (streaming shuffle, same contract as a
+    // shuffle(buffer_size) dataset stage).
+    Record r;
+    while (static_cast<int>(shuffle_.size()) < std::max(1, shuffle_cap_)) {
+      if (!queue_.pop(&r)) break;
+      shuffle_.push_back(r);
+    }
+    if (corrupt_.load(std::memory_order_relaxed)) return -2;
+    if (shuffle_.empty()) return -1;
+    size_t ix = 0;
+    if (shuffle_cap_ > 1 && shuffle_.size() > 1) {
+      ix = std::uniform_int_distribution<size_t>(0, shuffle_.size() - 1)(rng_);
+    }
+    r = shuffle_[ix];
+    shuffle_[ix] = shuffle_.back();
+    shuffle_.pop_back();
+    *out = r.data;
+    return static_cast<int64_t>(r.len);
+  }
+
+ private:
+  std::vector<std::string> files_;
+  BoundedQueue queue_;
+  std::vector<std::thread> threads_;
+  std::vector<Record> shuffle_;
+  int shuffle_cap_;
+  std::mt19937_64 rng_;
+  std::atomic<bool> corrupt_{false};
+};
+
+}  // namespace
+}  // namespace dtf
+
+extern "C" {
+
+void* dtf_writer_open(const char* path) {
+  auto* w = new dtf::Writer(path);
+  if (!w->ok()) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int dtf_writer_write(void* w, const void* data, uint64_t len) {
+  return static_cast<dtf::Writer*>(w)->write(data, len) ? 0 : -1;
+}
+
+int dtf_writer_flush(void* w) {
+  return static_cast<dtf::Writer*>(w)->flush() ? 0 : -1;
+}
+
+void dtf_writer_close(void* w) { delete static_cast<dtf::Writer*>(w); }
+
+void* dtf_reader_open(const char** paths, int n_files, int num_threads,
+                      int shuffle_buffer, uint64_t seed, int verify_crc) {
+  std::vector<std::string> files(paths, paths + n_files);
+  if (files.empty()) return nullptr;
+  return new dtf::Reader(std::move(files), num_threads, shuffle_buffer, seed,
+                         verify_crc != 0);
+}
+
+int64_t dtf_reader_next(void* r, uint8_t** out) {
+  return static_cast<dtf::Reader*>(r)->next(out);
+}
+
+void dtf_reader_close(void* r) { delete static_cast<dtf::Reader*>(r); }
+
+void dtf_free(void* p) { free(p); }
+
+uint32_t dtf_crc32c(const void* data, uint64_t len) {
+  return dtf::crc32c(0, data, len);
+}
+
+uint32_t dtf_crc32c_masked(const void* data, uint64_t len) {
+  return dtf::crc32c_mask(dtf::crc32c(0, data, len));
+}
+
+}  // extern "C"

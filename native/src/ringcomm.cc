@@ -1,0 +1,356 @@
+// Host-side ring collectives over TCP.
+//
+// The compiled equivalent of the reference stack's C++ ring collectives and
+// cross-host tensor transport (SURVEY.md §2.2: RingReducer
+// `hdr/common_runtime/ring_reducer.h:32`, RingGatherer, rendezvous transport
+// `hdr/distributed_runtime/rpc/rpc_rendezvous_mgr.h:45`).  On TPU the hot
+// path's collectives are XLA-compiled onto ICI; this library covers the
+// *host* side — CPU-resident tensors, DCN-ish control/data exchange between
+// processes, and the CPU fallback used by the multi-process test harness —
+// where a compiled ring beats Python sockets.
+//
+// Topology: rank i accepts one connection from rank i-1 and connects to rank
+// i+1 (mod world).  Every collective is built from poll()-driven
+// simultaneous send+recv on the two neighbor sockets, so large payloads
+// cannot deadlock on full kernel socket buffers.
+//
+// Flat C ABI for ctypes.  Thread-compatible: one collective at a time per
+// communicator (callers serialize, as with a CUDA stream).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace dtf {
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+void set_nonblocking(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+bool parse_addr(const std::string& addr, std::string* host, int* port) {
+  auto colon = addr.rfind(':');
+  if (colon == std::string::npos) return false;
+  *host = addr.substr(0, colon);
+  *port = atoi(addr.c_str() + colon + 1);
+  return *port > 0;
+}
+
+struct Comm {
+  int rank = 0;
+  int world = 1;
+  int next_fd = -1;  // send side (to rank+1)
+  int prev_fd = -1;  // recv side (from rank-1)
+  int timeout_ms = 300000;
+};
+
+// Simultaneous bidirectional transfer: push `sn` bytes to next_fd while
+// pulling `rn` bytes from prev_fd.  Returns 0, or -1 on error/timeout.
+int sendrecv(Comm* c, const uint8_t* sbuf, size_t sn, uint8_t* rbuf,
+             size_t rn) {
+  size_t sent = 0, recvd = 0;
+  const int64_t deadline = now_ms() + c->timeout_ms;
+  while (sent < sn || recvd < rn) {
+    struct pollfd fds[2];
+    int nf = 0;
+    int send_ix = -1, recv_ix = -1;
+    if (sent < sn) {
+      send_ix = nf;
+      fds[nf++] = {c->next_fd, POLLOUT, 0};
+    }
+    if (recvd < rn) {
+      recv_ix = nf;
+      fds[nf++] = {c->prev_fd, POLLIN, 0};
+    }
+    int64_t left = deadline - now_ms();
+    if (left <= 0) return -1;
+    int pr = poll(fds, nf, static_cast<int>(left > 1000 ? 1000 : left));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (send_ix >= 0 && (fds[send_ix].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t k = send(c->next_fd, sbuf + sent, sn - sent, MSG_NOSIGNAL);
+      if (k > 0)
+        sent += static_cast<size_t>(k);
+      else if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+        return -1;
+    }
+    if (recv_ix >= 0 && (fds[recv_ix].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = recv(c->prev_fd, rbuf + recvd, rn - recvd, 0);
+      if (k > 0)
+        recvd += static_cast<size_t>(k);
+      else if (k == 0)
+        return -1;  // peer closed mid-collective
+      else if (errno != EAGAIN && errno != EWOULDBLOCK)
+        return -1;
+    }
+  }
+  return 0;
+}
+
+int send_all(Comm* c, const uint8_t* buf, size_t n) {
+  return sendrecv(c, buf, n, nullptr, 0);
+}
+int recv_all(Comm* c, uint8_t* buf, size_t n) {
+  return sendrecv(c, nullptr, 0, buf, n);
+}
+
+// dtype codes shared with the Python binding.
+enum DType { F32 = 0, F64 = 1, I32 = 2, I64 = 3 };
+enum Op { SUM = 0, MAX = 1, MIN = 2, PROD = 3 };
+
+size_t dtype_size(int dt) { return (dt == F32 || dt == I32) ? 4 : 8; }
+
+template <typename T>
+void reduce_typed(T* acc, const T* in, size_t n, int op) {
+  switch (op) {
+    case SUM:
+      for (size_t i = 0; i < n; ++i) acc[i] += in[i];
+      break;
+    case MAX:
+      for (size_t i = 0; i < n; ++i) acc[i] = acc[i] > in[i] ? acc[i] : in[i];
+      break;
+    case MIN:
+      for (size_t i = 0; i < n; ++i) acc[i] = acc[i] < in[i] ? acc[i] : in[i];
+      break;
+    case PROD:
+      for (size_t i = 0; i < n; ++i) acc[i] *= in[i];
+      break;
+  }
+}
+
+void reduce(uint8_t* acc, const uint8_t* in, size_t n_elems, int dt, int op) {
+  switch (dt) {
+    case F32:
+      reduce_typed(reinterpret_cast<float*>(acc),
+                   reinterpret_cast<const float*>(in), n_elems, op);
+      break;
+    case F64:
+      reduce_typed(reinterpret_cast<double*>(acc),
+                   reinterpret_cast<const double*>(in), n_elems, op);
+      break;
+    case I32:
+      reduce_typed(reinterpret_cast<int32_t*>(acc),
+                   reinterpret_cast<const int32_t*>(in), n_elems, op);
+      break;
+    case I64:
+      reduce_typed(reinterpret_cast<int64_t*>(acc),
+                   reinterpret_cast<const int64_t*>(in), n_elems, op);
+      break;
+  }
+}
+
+}  // namespace
+}  // namespace dtf
+
+extern "C" {
+
+// peer_addrs: array of `world` strings "host:port"; rank r listens on
+// peer_addrs[r]'s port and connects to peer_addrs[(r+1)%world].
+void* dtf_comm_create(int rank, int world, const char** peer_addrs,
+                      int timeout_ms) {
+  using dtf::Comm;
+  auto* c = new Comm;
+  c->rank = rank;
+  c->world = world;
+  c->timeout_ms = timeout_ms > 0 ? timeout_ms : 300000;
+  if (world <= 1) return c;
+
+  std::string my_host, next_host;
+  int my_port = 0, next_port = 0;
+  if (!dtf::parse_addr(peer_addrs[rank], &my_host, &my_port) ||
+      !dtf::parse_addr(peer_addrs[(rank + 1) % world], &next_host,
+                       &next_port)) {
+    delete c;
+    return nullptr;
+  }
+
+  // Listen for the previous rank.
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in la{};
+  la.sin_family = AF_INET;
+  la.sin_addr.s_addr = htonl(INADDR_ANY);
+  la.sin_port = htons(static_cast<uint16_t>(my_port));
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&la), sizeof(la)) != 0 ||
+      listen(lfd, 4) != 0) {
+    close(lfd);
+    delete c;
+    return nullptr;
+  }
+
+  // Connect to the next rank, retrying until its listener is up.
+  const int64_t deadline = dtf::now_ms() + c->timeout_ms;
+  int nfd = -1;
+  while (dtf::now_ms() < deadline) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(next_host.c_str(), nullptr, &hints, &res) != 0 || !res) {
+      usleep(100000);
+      continue;
+    }
+    sockaddr_in na = *reinterpret_cast<sockaddr_in*>(res->ai_addr);
+    na.sin_port = htons(static_cast<uint16_t>(next_port));
+    freeaddrinfo(res);
+    nfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(nfd, reinterpret_cast<sockaddr*>(&na), sizeof(na)) == 0) break;
+    close(nfd);
+    nfd = -1;
+    usleep(100000);
+  }
+  if (nfd < 0) {
+    close(lfd);
+    delete c;
+    return nullptr;
+  }
+
+  // Accept the previous rank (poll so a dead peer can't hang us forever).
+  struct pollfd pf = {lfd, POLLIN, 0};
+  int64_t left = deadline - dtf::now_ms();
+  int pfd = -1;
+  if (poll(&pf, 1, static_cast<int>(left > 0 ? left : 0)) > 0) {
+    pfd = accept(lfd, nullptr, nullptr);
+  }
+  close(lfd);
+  if (pfd < 0) {
+    close(nfd);
+    delete c;
+    return nullptr;
+  }
+
+  dtf::set_nodelay(nfd);
+  dtf::set_nodelay(pfd);
+  dtf::set_nonblocking(nfd);
+  dtf::set_nonblocking(pfd);
+  c->next_fd = nfd;
+  c->prev_fd = pfd;
+  return c;
+}
+
+int dtf_comm_rank(void* h) { return static_cast<dtf::Comm*>(h)->rank; }
+int dtf_comm_size(void* h) { return static_cast<dtf::Comm*>(h)->world; }
+
+void dtf_comm_destroy(void* h) {
+  auto* c = static_cast<dtf::Comm*>(h);
+  if (c->next_fd >= 0) close(c->next_fd);
+  if (c->prev_fd >= 0) close(c->prev_fd);
+  delete c;
+}
+
+// In-place ring all-reduce: reduce-scatter phase then all-gather phase,
+// 2*(world-1) neighbor exchanges of ~n/world elements each — the same
+// schedule as the reference's RingReducer (ring_alg.h state machine).
+int dtf_comm_allreduce(void* h, void* data, uint64_t n_elems, int dtype,
+                       int op) {
+  auto* c = static_cast<dtf::Comm*>(h);
+  if (c->world <= 1) return 0;
+  const size_t esz = dtf::dtype_size(dtype);
+  const int w = c->world;
+  uint8_t* base = static_cast<uint8_t*>(data);
+
+  // Chunk boundaries (chunk i covers elements [off[i], off[i+1])).
+  std::vector<size_t> off(w + 1);
+  for (int i = 0; i <= w; ++i) off[i] = (n_elems * i) / w;
+  auto chunk_elems = [&](int i) {
+    int m = ((i % w) + w) % w;
+    return off[m + 1] - off[m];
+  };
+  auto chunk_base = [&](int i) {
+    int m = ((i % w) + w) % w;
+    return base + off[m] * esz;
+  };
+
+  size_t max_chunk = 0;
+  for (int i = 0; i < w; ++i)
+    max_chunk = std::max(max_chunk, off[i + 1] - off[i]);
+  std::vector<uint8_t> scratch(max_chunk * esz);
+
+  // Reduce-scatter: after step s, rank r holds the partial for chunk r-s.
+  for (int s = 0; s < w - 1; ++s) {
+    int send_c = c->rank - s;
+    int recv_c = c->rank - s - 1;
+    size_t rn = chunk_elems(recv_c);
+    if (dtf::sendrecv(c, chunk_base(send_c), chunk_elems(send_c) * esz,
+                      scratch.data(), rn * esz) != 0)
+      return -1;
+    dtf::reduce(chunk_base(recv_c), scratch.data(), rn, dtype, op);
+  }
+  // All-gather: circulate the fully-reduced chunks.
+  for (int s = 0; s < w - 1; ++s) {
+    int send_c = c->rank + 1 - s;
+    int recv_c = c->rank - s;
+    if (dtf::sendrecv(c, chunk_base(send_c), chunk_elems(send_c) * esz,
+                      chunk_base(recv_c), chunk_elems(recv_c) * esz) != 0)
+      return -1;
+  }
+  return 0;
+}
+
+// Ring all-gather of equal-size byte blobs; out must hold world*n bytes,
+// laid out by rank.  out may not alias data.
+int dtf_comm_allgather(void* h, const void* data, uint64_t n, void* out) {
+  auto* c = static_cast<dtf::Comm*>(h);
+  uint8_t* o = static_cast<uint8_t*>(out);
+  memcpy(o + c->rank * n, data, n);
+  if (c->world <= 1) return 0;
+  const int w = c->world;
+  for (int s = 0; s < w - 1; ++s) {
+    int send_b = ((c->rank - s) % w + w) % w;
+    int recv_b = ((c->rank - s - 1) % w + w) % w;
+    if (dtf::sendrecv(c, o + send_b * n, n, o + recv_b * n, n) != 0) return -1;
+  }
+  return 0;
+}
+
+// Pass-along-ring broadcast from `root`.
+int dtf_comm_broadcast(void* h, void* data, uint64_t n, int root) {
+  auto* c = static_cast<dtf::Comm*>(h);
+  if (c->world <= 1) return 0;
+  uint8_t* p = static_cast<uint8_t*>(data);
+  const int last = (root - 1 + c->world) % c->world;  // tail of the chain
+  if (c->rank == root) return dtf::send_all(c, p, n);
+  if (dtf::recv_all(c, p, n) != 0) return -1;
+  if (c->rank != last) return dtf::send_all(c, p, n);
+  return 0;
+}
+
+int dtf_comm_barrier(void* h) {
+  auto* c = static_cast<dtf::Comm*>(h);
+  if (c->world <= 1) return 0;
+  // All-gather of one byte: returns only after every rank has entered.
+  std::vector<uint8_t> all(static_cast<size_t>(c->world));
+  uint8_t token = 1;
+  return dtf_comm_allgather(h, &token, 1, all.data());
+}
+
+}  // extern "C"
