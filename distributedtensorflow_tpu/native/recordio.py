@@ -9,6 +9,7 @@ shuffle buffer run entirely in C++; Python only sees finished ``bytes``.
 from __future__ import annotations
 
 import ctypes
+import weakref
 from collections.abc import Iterator, Sequence
 
 from .lib import load_native_library
@@ -32,6 +33,10 @@ class RecordWriter:
         self._h = self._lib.dtf_writer_open(str(path).encode())
         if not self._h:
             raise OSError(f"cannot open {path!r} for writing")
+        # GC safety net: a dropped writer still flushes and closes its FILE*.
+        self._finalizer = weakref.finalize(
+            self, self._lib.dtf_writer_close, self._h
+        )
 
     def write(self, record: bytes) -> None:
         if self._h is None:
@@ -45,6 +50,7 @@ class RecordWriter:
 
     def close(self) -> None:
         if self._h is not None:
+            self._finalizer.detach()
             self._lib.dtf_writer_close(self._h)
             self._h = None
 
@@ -94,6 +100,11 @@ class RecordReader:
         )
         if not self._h:
             raise OSError(f"cannot open record files {list(paths)!r}")
+        # GC safety net: a dropped, unexhausted reader still joins its C++
+        # worker threads and frees queued records.
+        self._finalizer = weakref.finalize(
+            self, self._lib.dtf_reader_close, self._h
+        )
 
     def __iter__(self) -> Iterator[bytes]:
         return self
@@ -118,6 +129,7 @@ class RecordReader:
 
     def close(self) -> None:
         if self._h is not None:
+            self._finalizer.detach()
             self._lib.dtf_reader_close(self._h)
             self._h = None
 

@@ -32,6 +32,14 @@ class TrainerConfig:
     checkpoint_every: int = 0  # 0 = no checkpointing
     global_batch_size: int = 0
     logdir: str | None = None
+    # Profiling window (SURVEY.md §5.1): capture a jax.profiler trace of
+    # steps [profile_start, profile_start + profile_steps) into profile_dir.
+    profile_dir: str | None = None
+    profile_start: int = 10
+    profile_steps: int = 5
+    # Hang watchdog (SURVEY.md §5.2): dump all thread stacks if no step
+    # completes for this many seconds.  0 disables.
+    watchdog_timeout: float = 0.0
 
 
 class Trainer:
@@ -61,9 +69,16 @@ class Trainer:
         cfg = self.config
         it = iter(train_iter)
         self.meter.start()
+        watchdog = None
+        if cfg.watchdog_timeout > 0:
+            from ..utils.watchdog import Watchdog
+
+            watchdog = Watchdog(cfg.watchdog_timeout)
         try:
-            state = self._fit_loop(state, it, rng, eval_iter_fn)
+            state = self._fit_loop(state, it, rng, eval_iter_fn, watchdog)
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             close = getattr(train_iter, "close", None)
             if close is not None:
                 close()
@@ -72,35 +87,65 @@ class Trainer:
             self.checkpointer.wait()
         return state
 
-    def _fit_loop(self, state, it, rng, eval_iter_fn):
+    def _fit_loop(self, state, it, rng, eval_iter_fn, watchdog=None):
         cfg = self.config
         start_step = int(state.step)
-        for step_i in range(start_step, cfg.total_steps):
-            batch = next(it)
-            state, metrics = self.train_step(state, batch, rng)
-            self.meter.update()
-            if cfg.log_every and (step_i + 1) % cfg.log_every == 0:
-                # jax.Array fetches sync here, off the critical path cadence
-                last_metrics = {k: float(v) for k, v in metrics.items()}
-                last_metrics.update(self.meter.rates())
-                self.writer.write(step_i + 1, last_metrics)
-                logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
-                self.meter.start()
-            if (
-                cfg.eval_every
-                and self.eval_step is not None
-                and eval_iter_fn is not None
-                and (step_i + 1) % cfg.eval_every == 0
-            ):
-                eval_metrics = self.evaluate(state, eval_iter_fn())
-                self.writer.write(step_i + 1, {f"eval_{k}": v for k, v in eval_metrics.items()})
-                logger.info("eval @ %d: %s", step_i + 1, _fmt(eval_metrics))
-            if (
-                cfg.checkpoint_every
-                and self.checkpointer is not None
-                and (step_i + 1) % cfg.checkpoint_every == 0
-            ):
-                self.checkpointer.save(step_i + 1, state)
+        # Profile window is relative to THIS run's first step, so resuming
+        # from a checkpoint past profile_start still produces a trace.
+        profile_at = start_step + cfg.profile_start
+        profiling = False
+        try:
+            for step_i in range(start_step, cfg.total_steps):
+                if cfg.profile_dir and step_i == profile_at:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                batch = next(it)
+                state, metrics = self.train_step(state, batch, rng)
+                self.meter.update()
+                if watchdog is not None:
+                    watchdog.ping()
+                if profiling and step_i + 1 >= profile_at + cfg.profile_steps:
+                    # Force the profiled steps to actually execute before
+                    # closing the trace (fetch, not block_until_ready — see
+                    # bench.py note on the axon backend).
+                    jax.tree.map(float, metrics)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    logger.info(
+                        "profiler trace written to %s", cfg.profile_dir
+                    )
+                if cfg.log_every and (step_i + 1) % cfg.log_every == 0:
+                    # jax.Array fetches sync here, off the critical cadence
+                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    last_metrics.update(self.meter.rates())
+                    self.writer.write(step_i + 1, last_metrics)
+                    logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
+                    self.meter.start()
+                if (
+                    cfg.eval_every
+                    and self.eval_step is not None
+                    and eval_iter_fn is not None
+                    and (step_i + 1) % cfg.eval_every == 0
+                ):
+                    eval_metrics = self.evaluate(state, eval_iter_fn())
+                    self.writer.write(
+                        step_i + 1,
+                        {f"eval_{k}": v for k, v in eval_metrics.items()},
+                    )
+                    logger.info("eval @ %d: %s", step_i + 1, _fmt(eval_metrics))
+                    if watchdog is not None:  # a long eval is progress
+                        watchdog.ping()
+                if (
+                    cfg.checkpoint_every
+                    and self.checkpointer is not None
+                    and (step_i + 1) % cfg.checkpoint_every == 0
+                ):
+                    self.checkpointer.save(step_i + 1, state)
+                    if watchdog is not None:  # so is a synchronous save
+                        watchdog.ping()
+        finally:
+            if profiling:  # exception mid-window, or window past total_steps
+                jax.profiler.stop_trace()
         return state
 
     def evaluate(self, state: TrainState, eval_iter: Iterable[PyTree]) -> dict:

@@ -1,0 +1,70 @@
+"""Op/run determinism switch and A/B debugging helpers.
+
+The reference's race-debugging toolkit is ``enable_op_determinism``
+(SURVEY.md §5.2, `tf/python/framework/config.py:945`) plus collective
+ordering tokens.  On TPU, XLA compiles a fixed schedule, so run-to-run
+determinism is the default; what still varies and is pinned here:
+
+- PRNG partitioning: with ``jax_threefry_partitionable`` the same seed
+  produces the same dropout/init bits *regardless of mesh shape*, so a
+  1-chip golden run reproduces on a 256-chip mesh (the A/B use case the
+  reference's switch exists for).
+- Seed derivation: :func:`derive_seed` folds names/indices into a base seed
+  so every consumer (data shuffle, dropout, init) gets a distinct,
+  reproducible stream — no accidental seed reuse across hosts.
+- Golden-run comparison: :func:`tree_fingerprint` hashes a whole pytree of
+  arrays to one hex digest for cheap cross-run/cross-topology equality
+  checks in tests and triage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def enable_determinism() -> None:
+    """Pin the remaining sources of cross-run/cross-topology variance.
+
+    Call before first device use.  Idempotent.
+    """
+    # Same key -> same bits independent of how the computation is sharded.
+    jax.config.update("jax_threefry_partitionable", True)
+    # Trade speed for reproducible matmul numerics across XLA versions'
+    # default-precision choices (bf16 reduction order is fixed per compile
+    # anyway; this pins the input precision decision).
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def derive_seed(base: int, *names: int | str) -> int:
+    """Derive a distinct 31-bit seed from a base seed and a name path.
+
+    ``derive_seed(seed, "shuffle", epoch, host)`` — stable across runs,
+    distinct across consumers, no birthday-collision-prone ad-hoc addition.
+    """
+    h = hashlib.sha256(str(base).encode())
+    for n in names:
+        h.update(b"\x00" + str(n).encode())
+    return int.from_bytes(h.digest()[:4], "little") & 0x7FFFFFFF
+
+
+def tree_fingerprint(tree: PyTree) -> str:
+    """SHA-256 over every leaf's bytes (host-fetched), leaves in key order.
+
+    Two runs producing the same fingerprint have bit-identical state —
+    the golden-run A/B check.
+    """
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    h.update(str(treedef).encode())
+    for path, leaf in leaves:
+        h.update(jax.tree_util.keystr(path).encode())
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()

@@ -1,0 +1,74 @@
+"""Tracing / profiling surface.
+
+Replaces the reference's profiler layer (SURVEY.md §5.1:
+``tf.profiler.experimental.start/stop`` `tf/python/profiler/profiler_v2.py:81`,
+remote ``start_server(port)`` `:169`, scoped annotations, C++ ``TraceMe``)
+with the TPU-native equivalents: ``jax.profiler`` XPlane traces viewable in
+TensorBoard/Perfetto, a profiling server for on-demand remote capture, and
+``named_scope``/``TraceAnnotation`` markers that land in both XLA HLO
+metadata and the host trace — no user-code changes needed beyond the scope,
+matching the reference's executor-level hook-in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from collections.abc import Iterator
+
+import jax
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, perfetto: bool = False) -> Iterator[None]:
+    """Capture a profiler trace into ``logdir`` for the ``with`` body.
+
+    Output is the XPlane/TensorBoard profile format (the same artifact class
+    as the reference's TensorBoard profile plugin output); ``perfetto=True``
+    additionally writes a Perfetto-loadable trace.
+    """
+    jax.profiler.start_trace(logdir, create_perfetto_trace=perfetto)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        if jax.process_index() == 0:
+            logger.info("profiler trace written to %s", logdir)
+
+
+def start_server(port: int) -> object:
+    """Start the profiling server for remote on-demand capture.
+
+    The ``tf.profiler.experimental.server.start`` equivalent
+    (``profiler_v2.py:169``): once running, a TensorBoard "capture profile"
+    request (or ``jax.profiler.trace_remote``) can pull a trace from this
+    process over the network.
+    """
+    server = jax.profiler.start_server(port)
+    logger.info("profiler server listening on port %d", port)
+    return server
+
+
+def annotate(name: str) -> contextlib.AbstractContextManager:
+    """Host-side scoped annotation visible in the trace viewer.
+
+    The ``TraceMe`` equivalent (`tsl/profiler/lib/traceme.h:89`): wraps a
+    host-code region; shows up on the Python/host timeline.
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def named_scope(name: str) -> contextlib.AbstractContextManager:
+    """Device-side scope: names the XLA ops traced inside it.
+
+    Shows up in HLO metadata and therefore in the device timeline — the
+    device-level analogue of :func:`annotate`.
+    """
+    return jax.named_scope(name)
+
+
+def save_device_memory_profile(path: str) -> None:
+    """Dump a pprof-format device (HBM) memory profile to ``path``."""
+    jax.profiler.save_device_memory_profile(path)

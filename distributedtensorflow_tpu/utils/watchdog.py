@@ -1,0 +1,121 @@
+"""Hang watchdog: dump all thread stacks when progress stalls.
+
+The reference ships a watchdog that dumps stacks on coordinator hangs
+(SURVEY.md §5.2, ``coordinator/watchdog.py:25``) plus collective timeouts
+(``collective_util.Options.timeout_seconds``).  SPMD training has the same
+failure mode — one wedged host stalls every collective in the job — and the
+most valuable artifact is "where was every thread when it stalled".
+
+Usage::
+
+    wd = Watchdog(timeout=300, on_timeout=...)   # starts armed
+    for batch in data:
+        step(...)
+        wd.ping()                                 # progress heartbeat
+    wd.stop()
+
+or as a context manager wrapping any potentially-hanging region.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import sys
+import threading
+import time
+import traceback
+from collections.abc import Callable
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+
+def dump_all_stacks(file=None) -> str:
+    """Format the stack of every live thread; also returns the text."""
+    out = []
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in frames.items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    text = "\n".join(out)
+    print(text, file=file or sys.stderr, flush=True)
+    return text
+
+
+class Watchdog:
+    """Background timer that fires when :meth:`ping` stops arriving.
+
+    On timeout it dumps every thread's stack (the post-mortem the reference's
+    watchdog produces) and calls ``on_timeout``.  By default the process
+    keeps running — set ``fatal=True`` to abort with a core-style stack dump
+    (``faulthandler``), which is what you want under a job scheduler that
+    will restart the task.
+    """
+
+    def __init__(
+        self,
+        timeout: float = 300.0,
+        *,
+        on_timeout: Callable[[], None] | None = None,
+        fatal: bool = False,
+        poll_interval: float | None = None,
+    ):
+        self.timeout = timeout
+        self._on_timeout = on_timeout
+        self._fatal = fatal
+        self._last = time.monotonic()
+        self._fired = False
+        self._stop = threading.Event()
+        self._poll = poll_interval if poll_interval is not None else min(
+            timeout / 4, 5.0
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="dtf-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def ping(self) -> None:
+        """Record progress; resets the timeout clock."""
+        self._last = time.monotonic()
+        self._fired = False
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            if self._fired:
+                continue
+            idle = time.monotonic() - self._last
+            if idle < self.timeout:
+                continue
+            self._fired = True
+            logger.error(
+                "watchdog: no progress for %.0fs (timeout %.0fs); "
+                "dumping all thread stacks",
+                idle,
+                self.timeout,
+            )
+            dump_all_stacks()
+            if self._on_timeout is not None:
+                try:
+                    self._on_timeout()
+                except Exception:
+                    logger.exception("watchdog on_timeout callback failed")
+            if self._fatal:
+                faulthandler.dump_traceback()
+                import os
+
+                os.abort()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self._poll * 2 + 1)
+
+    def __enter__(self) -> "Watchdog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

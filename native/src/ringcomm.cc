@@ -208,7 +208,10 @@ void* dtf_comm_create(int rank, int world, const char** peer_addrs,
     return nullptr;
   }
 
-  // Connect to the next rank, retrying until its listener is up.
+  // Connect to the next rank, retrying until its listener is up.  The
+  // connect itself is non-blocking + poll so a black-holed peer (dropped
+  // SYNs) cannot pin us to the kernel's multi-minute connect timeout —
+  // each attempt is bounded and the overall deadline is honored.
   const int64_t deadline = dtf::now_ms() + c->timeout_ms;
   int nfd = -1;
   while (dtf::now_ms() < deadline) {
@@ -223,7 +226,22 @@ void* dtf_comm_create(int rank, int world, const char** peer_addrs,
     na.sin_port = htons(static_cast<uint16_t>(next_port));
     freeaddrinfo(res);
     nfd = socket(AF_INET, SOCK_STREAM, 0);
-    if (connect(nfd, reinterpret_cast<sockaddr*>(&na), sizeof(na)) == 0) break;
+    dtf::set_nonblocking(nfd);
+    int rc = connect(nfd, reinterpret_cast<sockaddr*>(&na), sizeof(na));
+    if (rc != 0 && errno == EINPROGRESS) {
+      struct pollfd pf = {nfd, POLLOUT, 0};
+      int64_t left = deadline - dtf::now_ms();
+      if (poll(&pf, 1, static_cast<int>(
+                   left > 2000 ? 2000 : (left > 0 ? left : 0))) > 0) {
+        int err = 0;
+        socklen_t len = sizeof(err);
+        getsockopt(nfd, SOL_SOCKET, SO_ERROR, &err, &len);
+        rc = err == 0 ? 0 : -1;
+      } else {
+        rc = -1;  // attempt timed out; retry within the deadline
+      }
+    }
+    if (rc == 0) break;
     close(nfd);
     nfd = -1;
     usleep(100000);

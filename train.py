@@ -47,6 +47,13 @@ def main() -> None:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--logdir", default=None)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of a few steps here")
+    p.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   help="dump all stacks if no step completes for N seconds")
+    p.add_argument("--deterministic", action="store_true",
+                   help="pin PRNG partitioning + matmul precision for "
+                        "cross-topology reproducibility")
     p.add_argument("--test-size", action="store_true",
                    help="shrink the model (CI / smoke tests)")
     p.add_argument("--seed", type=int, default=0)
@@ -60,6 +67,10 @@ def main() -> None:
     )
     if args.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    if args.deterministic:
+        from distributedtensorflow_tpu.utils import enable_determinism
+
+        enable_determinism()
 
     from distributedtensorflow_tpu import parallel
     from distributedtensorflow_tpu.data import current_input_context, Prefetcher
@@ -116,6 +127,8 @@ def main() -> None:
             checkpoint_every=args.checkpoint_every,
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
+            profile_dir=args.profile_dir,
+            watchdog_timeout=args.watchdog_timeout,
         ),
         eval_step=eval_step,
         checkpointer=checkpointer,
