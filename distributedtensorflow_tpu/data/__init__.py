@@ -1,5 +1,10 @@
 """Distributed input pipeline (host tf.data / synthetic → sharded device batches)."""
 
+from .service import (  # noqa: F401
+    DataServiceClient,
+    DispatchServer,
+    WorkerServer,
+)
 from .input_pipeline import (  # noqa: F401
     InputContext,
     Prefetcher,

@@ -1,0 +1,411 @@
+"""Disaggregated input service: dispatcher + data workers + client.
+
+The tf.data-service equivalent (SURVEY.md §2.3: ``DispatchServer``
+`tf/python/data/experimental/service/server_lib.py:131`, ``WorkerServer``
+`:349`): input preprocessing runs on a separate pool of cheap CPU hosts so
+TPU hosts never stall on data.  Shapes of the design kept from the
+reference; the implementation is this framework's own socket protocol (the
+reference's is gRPC/protobuf into the tf.data C++ runtime):
+
+- a **dispatcher** process tracks the worker pool and assigns each worker a
+  shard index (``distributed_epoch`` semantics: the dataset is partitioned
+  across workers, every element produced exactly once per epoch);
+- **data workers** run the actual input pipeline (e.g. the native
+  ``RecordReader`` + decode) and serve batches over TCP;
+- the **client** (one per trainer host) round-robins over workers; a worker
+  death mid-epoch drops that worker's remaining shard after a configurable
+  policy (``ignore_errors=True``) or raises — the reference's fault
+  semantics for dynamic worker pools.
+
+Wire format: every frame is ``uint64 LE length + payload``.  A request is
+one JSON frame; a response is one JSON frame optionally followed by one
+binary frame carrying an ``.npz`` archive of the batch (numpy arrays only —
+no pickle on the wire).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import socket
+import socketserver
+import threading
+import time
+from collections.abc import Callable, Iterator
+from typing import Any
+
+import numpy as np
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+Batch = dict[str, np.ndarray]
+# input_fn(shard_index, num_shards) -> iterator of batches
+WorkerInputFn = Callable[[int, int], Iterator[Batch]]
+
+_HEARTBEAT_INTERVAL_S = 2.0
+_WORKER_TIMEOUT_S = 10.0
+
+
+# --- framing ----------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(len(payload).to_bytes(8, "little") + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = (int.from_bytes(_recv_exact(sock, 8), "little"),)
+    if n > (1 << 31):
+        raise ConnectionError(f"oversized frame ({n} bytes)")
+    return _recv_exact(sock, n)
+
+
+def _send_msg(sock: socket.socket, header: dict, data: bytes | None = None) -> None:
+    header = dict(header, has_data=data is not None)
+    _send_frame(sock, json.dumps(header).encode())
+    if data is not None:
+        _send_frame(sock, data)
+
+
+def _recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:
+    header = json.loads(_recv_frame(sock))
+    data = _recv_frame(sock) if header.get("has_data") else None
+    return header, data
+
+
+def _rpc(addr: str, request: dict, *, timeout: float = 30.0) -> tuple[dict, bytes | None]:
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        _send_msg(s, request)
+        return _recv_msg(s)
+
+
+def encode_batch(batch: Batch) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **batch)
+    return buf.getvalue()
+
+
+def decode_batch(data: bytes) -> Batch:
+    with np.load(io.BytesIO(data)) as z:
+        return {k: z[k] for k in z.files}
+
+
+# --- dispatcher -------------------------------------------------------------
+
+
+class DispatchServer:
+    """Tracks the data-worker pool; hands out shard assignments.
+
+    The reference's ``DispatchServer`` (`server_lib.py:131`).  State is
+    in-memory: workers re-register after a dispatcher restart (the
+    fault-tolerance mode the reference calls non-fault-tolerant dispatch).
+    """
+
+    def __init__(self, port: int = 0):
+        self._lock = threading.Lock()
+        # addr -> {"shard": int, "last_seen": float}
+        self._workers: dict[str, dict] = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    req, _ = _recv_msg(self.request)
+                    _send_msg(self.request, outer._handle(req))
+                except (ConnectionError, json.JSONDecodeError):
+                    pass
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("0.0.0.0", port), Handler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dtf-dispatcher", daemon=True
+        )
+        self._thread.start()
+        logger.info("data-service dispatcher on port %d", self.port)
+
+    def _evict_stale(self, now: float) -> None:
+        stale = [
+            a
+            for a, w in self._workers.items()
+            if now - w["last_seen"] >= _WORKER_TIMEOUT_S
+        ]
+        for a in stale:
+            logger.warning("data worker %s timed out; freeing shard %d",
+                           a, self._workers[a]["shard"])
+            del self._workers[a]
+
+    def _handle(self, req: dict) -> dict:
+        kind = req.get("kind")
+        with self._lock:
+            now = time.monotonic()
+            self._evict_stale(now)
+            if kind == "register_worker":
+                addr = req["addr"]
+                if addr not in self._workers:
+                    # Lowest free shard index: replacement workers take over
+                    # a dead worker's shard rather than growing the index
+                    # space (which would break the exactly-once partition).
+                    used = {w["shard"] for w in self._workers.values()}
+                    shard = next(i for i in range(len(used) + 1) if i not in used)
+                    self._workers[addr] = {"shard": shard, "last_seen": now}
+                else:
+                    self._workers[addr]["last_seen"] = now
+                return {"ok": True, "shard": self._workers[addr]["shard"]}
+            if kind == "deregister_worker":
+                self._workers.pop(req["addr"], None)
+                return {"ok": True}
+            if kind == "heartbeat":
+                w = self._workers.get(req["addr"])
+                if w is None:  # dispatcher restarted: ask to re-register
+                    return {"ok": False, "reregister": True}
+                w["last_seen"] = now
+                return {"ok": True}
+            if kind == "get_workers":
+                return {
+                    "ok": True,
+                    "workers": {
+                        a: w["shard"] for a, w in self._workers.items()
+                    },
+                }
+            return {"ok": False, "error": f"unknown rpc {kind!r}"}
+
+    def target(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# --- worker -----------------------------------------------------------------
+
+
+class WorkerServer:
+    """Runs the input pipeline; serves batches (reference `server_lib.py:349`).
+
+    ``input_fn(shard_index, num_shards_hint)`` builds the batch iterator.
+    ``num_shards_hint`` is the pool size at epoch start — with
+    distributed_epoch sharding each worker reads only its ``shard_index``-th
+    slice of the files.
+    """
+
+    def __init__(
+        self,
+        dispatcher: str,
+        input_fn: WorkerInputFn,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        pool_size_hint: int | None = None,
+    ):
+        self._dispatcher = dispatcher
+        self._input_fn = input_fn
+        self._lock = threading.Lock()  # guards _iters/_epoch_locks/shard_index
+        # epoch -> (iterator, per-epoch lock).  Per-epoch locking: requests
+        # for different epochs (or the iterator-creation fast path) don't
+        # serialize the whole worker behind one long next(it).
+        self._iters: dict[str, tuple[Iterator[Batch], threading.Lock]] = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    req, _ = _recv_msg(self.request)
+                    header, data = outer._handle(req)
+                    _send_msg(self.request, header, data)
+                except (ConnectionError, json.JSONDecodeError):
+                    pass
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("0.0.0.0", port), Handler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.addr = f"{host}:{self.port}"
+        self._pool_size_hint = pool_size_hint
+
+        resp = _rpc(dispatcher, {"kind": "register_worker", "addr": self.addr})
+        if not resp[0].get("ok"):
+            raise ConnectionError(f"worker registration failed: {resp[0]}")
+        self.shard_index = int(resp[0]["shard"])
+
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(
+                target=self._server.serve_forever,
+                name="dtf-data-worker",
+                daemon=True,
+            ),
+            threading.Thread(
+                target=self._heartbeat_loop,
+                name="dtf-data-worker-hb",
+                daemon=True,
+            ),
+        ]
+        for t in self._threads:
+            t.start()
+        logger.info(
+            "data worker %s up (shard %d)", self.addr, self.shard_index
+        )
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(_HEARTBEAT_INTERVAL_S):
+            try:
+                resp, _ = _rpc(
+                    self._dispatcher,
+                    {"kind": "heartbeat", "addr": self.addr},
+                    timeout=5.0,
+                )
+                if resp.get("reregister"):
+                    resp, _ = _rpc(
+                        self._dispatcher,
+                        {"kind": "register_worker", "addr": self.addr},
+                        timeout=5.0,
+                    )
+                    new_shard = int(resp["shard"])
+                    with self._lock:
+                        if new_shard != self.shard_index:
+                            # Shard moved (dispatcher restart): serving the
+                            # old slice would duplicate/lose data — drop
+                            # cached iterators so new epochs use the new
+                            # shard.
+                            logger.warning(
+                                "data worker %s: shard %d -> %d after "
+                                "dispatcher restart",
+                                self.addr, self.shard_index, new_shard,
+                            )
+                            self.shard_index = new_shard
+                            self._iters.clear()
+            except OSError:
+                logger.warning("data worker %s: dispatcher unreachable", self.addr)
+
+    def _handle(self, req: dict) -> tuple[dict, bytes | None]:
+        if req.get("kind") != "get_next":
+            return {"ok": False, "error": "unknown rpc"}, None
+        epoch = str(req.get("epoch", 0))
+        with self._lock:
+            entry = self._iters.get(epoch)
+            if entry is None:
+                num_shards = int(
+                    req.get("num_shards")
+                    or self._pool_size_hint
+                    or 1
+                )
+                entry = (
+                    self._input_fn(self.shard_index, num_shards),
+                    threading.Lock(),
+                )
+                self._iters[epoch] = entry
+        it, epoch_lock = entry
+        with epoch_lock:  # iterators aren't thread-safe; serialize per epoch
+            try:
+                batch = next(it)
+            except StopIteration:
+                return {"ok": True, "eof": True}, None
+        return {"ok": True, "eof": False}, encode_batch(batch)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:  # planned shutdown: free our shard immediately, don't wait
+            _rpc(
+                self._dispatcher,
+                {"kind": "deregister_worker", "addr": self.addr},
+                timeout=5.0,
+            )
+        except OSError:
+            pass
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# --- client -----------------------------------------------------------------
+
+
+class DataServiceClient:
+    """Round-robin batch puller over the live worker pool.
+
+    One epoch = every worker's shard drained to EOF.  ``ignore_errors``
+    controls mid-epoch worker death: True drops the dead worker's remaining
+    data (dynamic-pool semantics), False raises.
+    """
+
+    def __init__(
+        self,
+        dispatcher: str,
+        *,
+        epoch: int = 0,
+        ignore_errors: bool = False,
+        wait_for_workers_s: float = 30.0,
+        get_next_timeout_s: float = 120.0,
+    ):
+        self._dispatcher = dispatcher
+        self._epoch = epoch
+        self._ignore_errors = ignore_errors
+        self._timeout = get_next_timeout_s
+        deadline = time.monotonic() + wait_for_workers_s
+        self._workers: list[str] = []
+        while time.monotonic() < deadline:
+            try:
+                resp, _ = _rpc(dispatcher, {"kind": "get_workers"}, timeout=5.0)
+            except OSError:
+                # Dispatcher still starting up — that's what the grace
+                # window is for.
+                time.sleep(0.2)
+                continue
+            self._workers = sorted(
+                resp.get("workers", {}), key=lambda a: resp["workers"][a]
+            )
+            if self._workers:
+                break
+            time.sleep(0.2)
+        if not self._workers:
+            raise TimeoutError("no data workers registered")
+        self._num_shards = len(self._workers)
+        self._live = list(self._workers)
+        self._rr = 0
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self
+
+    def __next__(self) -> Batch:
+        while self._live:
+            addr = self._live[self._rr % len(self._live)]
+            try:
+                header, data = _rpc(
+                    addr,
+                    {
+                        "kind": "get_next",
+                        "epoch": self._epoch,
+                        "num_shards": self._num_shards,
+                    },
+                    timeout=self._timeout,
+                )
+            except OSError as e:
+                if not self._ignore_errors:
+                    raise ConnectionError(
+                        f"data worker {addr} died mid-epoch"
+                    ) from e
+                logger.warning("dropping dead data worker %s", addr)
+                self._live.remove(addr)
+                continue
+            if header.get("eof"):
+                self._live.remove(addr)
+                continue
+            self._rr += 1
+            return decode_batch(data)
+        raise StopIteration

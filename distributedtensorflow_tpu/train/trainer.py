@@ -146,6 +146,12 @@ class Trainer:
         finally:
             if profiling:  # exception mid-window, or window past total_steps
                 jax.profiler.stop_trace()
+        if cfg.profile_dir and cfg.total_steps <= profile_at:
+            logger.warning(
+                "profile window never opened: run ended at step %d before "
+                "profile_start step %d — lower --profile-start",
+                cfg.total_steps, profile_at,
+            )
         return state
 
     def evaluate(self, state: TrainState, eval_iter: Iterable[PyTree]) -> dict:

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# run_distributed.sh — reference-parity launcher (SURVEY.md §1 L7, §5.6).
+#
+# The reference launches one process per cluster task with a per-task
+# TF_CONFIG.  This launcher does the same for the JAX runtime: one process
+# per task, cluster described by env vars, rank 0 is the coordinator.
+#
+# Local multi-process (virtual devices, smoke/integration testing):
+#   ./run_distributed.sh -n 4 -- --workload mnist_lenet --steps 50 --device cpu
+#
+# Multi-host (run on every host, matching the reference's per-task launch):
+#   COORDINATOR=host0:12321 NPROC=16 RANK=$I ./run_distributed.sh -- ...
+#
+# Under Slurm/MPI no flags are needed at all — train.py's resolver chain
+# picks the cluster up from the scheduler env (SLURM_*/OMPI_*).
+set -euo pipefail
+
+NPROC_LOCAL=""
+PORT=12321
+ARGS=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -n|--nproc) NPROC_LOCAL="$2"; shift 2 ;;
+    -p|--port) PORT="$2"; shift 2 ;;
+    --) shift; ARGS=("$@"); break ;;
+    *) ARGS+=("$1"); shift ;;
+  esac
+done
+
+if [[ -n "$NPROC_LOCAL" ]]; then
+  # Local fan-out: N processes on this host, each 1 virtual CPU device.
+  # Mirrors the reference's in-process multi-worker test clusters.
+  pids=()
+  trap 'kill "${pids[@]}" 2>/dev/null || true' EXIT
+  for ((i = 0; i < NPROC_LOCAL; i++)); do
+    JAX_COORDINATOR_ADDRESS="127.0.0.1:${PORT}" \
+    JAX_NUM_PROCESSES="$NPROC_LOCAL" \
+    JAX_PROCESS_ID="$i" \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=1" \
+      python "$(dirname "$0")/train.py" "${ARGS[@]}" &
+    pids+=($!)
+  done
+  status=0
+  for pid in "${pids[@]}"; do
+    wait "$pid" || status=$?
+  done
+  trap - EXIT
+  exit "$status"
+fi
+
+# Single-task invocation: cluster comes from COORDINATOR/NPROC/RANK or the
+# scheduler env (resolver chain in distributedtensorflow_tpu.parallel).
+if [[ -n "${COORDINATOR:-}" ]]; then
+  export JAX_COORDINATOR_ADDRESS="$COORDINATOR"
+  export JAX_NUM_PROCESSES="${NPROC:?set NPROC with COORDINATOR}"
+  export JAX_PROCESS_ID="${RANK:?set RANK with COORDINATOR}"
+fi
+exec python "$(dirname "$0")/train.py" "${ARGS[@]}"

@@ -1,0 +1,153 @@
+"""Disaggregated input service tests (tf.data-service analogue).
+
+Reference model: SURVEY.md §2.3 "tf.data service" — dispatcher + worker
+pool + client, distributed_epoch sharding, dynamic worker-pool fault
+semantics.
+"""
+
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.data import (
+    DataServiceClient,
+    DispatchServer,
+    WorkerServer,
+)
+from distributedtensorflow_tpu.data.service import decode_batch, encode_batch
+
+
+def _sharded_input_fn(n_total=24, batch=2):
+    """Batches of consecutive ids; each worker serves its shard slice."""
+
+    def input_fn(shard_index, num_shards):
+        ids = np.arange(n_total)[shard_index::num_shards]
+        for i in range(0, len(ids) - len(ids) % batch, batch):
+            yield {"id": ids[i : i + batch].astype(np.int64)}
+
+    return input_fn
+
+
+@pytest.fixture()
+def dispatcher():
+    d = DispatchServer(port=0)
+    yield d
+    d.stop()
+
+
+def test_encode_decode_batch_roundtrip():
+    b = {
+        "x": np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32),
+        "y": np.arange(4, dtype=np.int32),
+    }
+    out = decode_batch(encode_batch(b))
+    assert set(out) == {"x", "y"}
+    np.testing.assert_array_equal(out["x"], b["x"])
+    np.testing.assert_array_equal(out["y"], b["y"])
+
+
+def test_distributed_epoch_exactly_once(dispatcher):
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(), port=0)
+        for _ in range(3)
+    ]
+    try:
+        client = DataServiceClient(dispatcher.target())
+        got = np.concatenate([b["id"] for b in client])
+        # 24 ids over 3 shards of 8, batch 2 -> all ids exactly once
+        assert sorted(got.tolist()) == list(range(24))
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_shard_assignment_is_distinct(dispatcher):
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(), port=0)
+        for _ in range(4)
+    ]
+    try:
+        assert sorted(w.shard_index for w in workers) == [0, 1, 2, 3]
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_separate_epochs_restart_iteration(dispatcher):
+    w = WorkerServer(dispatcher.target(), _sharded_input_fn(), port=0)
+    try:
+        first = [b["id"] for b in DataServiceClient(dispatcher.target(), epoch=0)]
+        second = [b["id"] for b in DataServiceClient(dispatcher.target(), epoch=1)]
+        np.testing.assert_array_equal(
+            np.concatenate(first), np.concatenate(second)
+        )
+    finally:
+        w.stop()
+
+
+def test_worker_death_raises_by_default(dispatcher):
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(96), port=0)
+        for _ in range(2)
+    ]
+    client = DataServiceClient(dispatcher.target())
+    next(client)  # pool is live
+    workers[0].stop()
+    dead = workers.pop(0)
+    try:
+        with pytest.raises(ConnectionError):
+            for _ in range(200):
+                next(client)
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_worker_death_ignored_when_configured(dispatcher):
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(96), port=0)
+        for _ in range(2)
+    ]
+    client = DataServiceClient(dispatcher.target(), ignore_errors=True)
+    first = next(client)
+    workers[0].stop()
+    survivor_shard = workers[1].shard_index
+    try:
+        rest = list(client)
+        got = np.concatenate([first["id"]] + [b["id"] for b in rest])
+        # survivor's shard must be fully present in what we received
+        survivor_ids = set(np.arange(96)[survivor_shard::2].tolist())
+        assert survivor_ids.issubset(set(got.tolist()))
+    finally:
+        workers[1].stop()
+
+
+def test_client_times_out_with_no_workers(dispatcher):
+    with pytest.raises(TimeoutError):
+        DataServiceClient(dispatcher.target(), wait_for_workers_s=0.5)
+
+
+def test_replacement_worker_reuses_freed_shard(dispatcher):
+    """A replacement takes over the stopped worker's shard index, keeping the
+    exactly-once partition intact (shards stay in [0, pool_size))."""
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(), port=0)
+        for _ in range(3)
+    ]
+    try:
+        dead = workers.pop(1)
+        freed = dead.shard_index
+        dead.stop()  # deregisters immediately
+        replacement = WorkerServer(
+            dispatcher.target(), _sharded_input_fn(), port=0
+        )
+        workers.append(replacement)
+        assert replacement.shard_index == freed
+        assert sorted(w.shard_index for w in workers) == [0, 1, 2]
+        # full epoch still exactly-once
+        got = np.concatenate(
+            [b["id"] for b in DataServiceClient(dispatcher.target())]
+        )
+        assert sorted(got.tolist()) == list(range(24))
+    finally:
+        for w in workers:
+            w.stop()

@@ -49,6 +49,10 @@ def main() -> None:
     p.add_argument("--logdir", default=None)
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of a few steps here")
+    p.add_argument("--profile-start", type=int, default=10,
+                   help="steps into this run before the trace window opens")
+    p.add_argument("--profile-steps", type=int, default=5,
+                   help="number of steps to trace")
     p.add_argument("--watchdog-timeout", type=float, default=0.0,
                    help="dump all stacks if no step completes for N seconds")
     p.add_argument("--deterministic", action="store_true",
@@ -128,6 +132,8 @@ def main() -> None:
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
             profile_dir=args.profile_dir,
+            profile_start=args.profile_start,
+            profile_steps=args.profile_steps,
             watchdog_timeout=args.watchdog_timeout,
         ),
         eval_step=eval_step,
