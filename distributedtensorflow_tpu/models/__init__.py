@@ -1,5 +1,14 @@
-"""Model zoo: the reference's five workload models, TPU-first flax modules."""
+"""Model zoo: the reference's five workload models + a long-context decoder
+LM, TPU-first flax modules."""
 
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTLM,
+    gpt_layout,
+    gpt_small,
+    gpt_tiny,
+    lm_loss,
+)
 from .lenet import LeNet5  # noqa: F401
 from .resnet import (  # noqa: F401
     CifarResNet,

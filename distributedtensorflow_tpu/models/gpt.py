@@ -1,0 +1,199 @@
+"""GPT-style decoder LM — the long-context flagship.
+
+No decoder LM exists in the reference stack (its longest-sequence workload
+is BERT-base MLM at 512 tokens — SURVEY.md §5.7); this model is the vehicle
+for the framework's first-class long-context capability: its attention is
+pluggable, so the same module runs
+
+- dense causal attention (Pallas flash kernel via ``ops.attention``), or
+- **sequence-parallel** ring / Ulysses attention over the ``seq`` mesh axis
+  (``parallel.ring_attention.sequence_parallel_attention_fn``) for
+  sequences too long for one device's HBM.
+
+TPU-first choices: bfloat16 activations with float32 layer-norm/softmax,
+rotary position embeddings (no learned position table to shard), pre-LN
+blocks, Megatron-ready kernel names for the ``model``-axis layout in
+:func:`gpt_layout`, and ``jax.checkpoint`` over blocks (remat) so long
+sequences trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import LayoutMap
+
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq: int = 2048
+    dropout_rate: float = 0.0
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = True
+
+
+def gpt_small() -> GPTConfig:
+    return GPTConfig()
+
+
+def gpt_tiny() -> GPTConfig:
+    """Test-size config (2 layers, 128 hidden, short context)."""
+    return GPTConfig(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        intermediate_size=256, max_seq=256, remat=False,
+    )
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding, (B, S, H, D) with D even; fp32 trig, cast back."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPTConfig
+    attn_fn: AttnFn | None = None  # None = dense causal (flash-capable)
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # Fused QKV projection: one large MXU matmul (column-parallel under
+        # the model axis — gpt_layout shards the fused output dim).
+        qkv = nn.Dense(
+            3 * cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="qkv"
+        )(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (*x.shape[:2], cfg.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v)
+        else:
+            out = dot_product_attention(q, k, v, causal=True)
+        out = out.reshape(*x.shape[:2], cfg.hidden_size)
+        # Row-parallel output projection (its input dim is head-sharded).
+        return nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="proj"
+        )(out)
+
+
+class GPTBlock(nn.Module):
+    cfg: GPTConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        x = x + CausalSelfAttention(cfg, self.attn_fn, name="attn")(
+            h, positions, deterministic
+        )
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        # Column- then row-parallel MLP (Megatron split over `model`).
+        m = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False,
+                     name="fc_in")(h)
+        m = nn.gelu(m)
+        m = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, use_bias=False,
+                     name="fc_out")(m)
+        if cfg.dropout_rate:
+            m = nn.Dropout(cfg.dropout_rate)(m, deterministic=deterministic)
+        return x + m
+
+
+class GPTLM(nn.Module):
+    """Decoder-only LM head over token ids; logits in float32."""
+
+    cfg: GPTConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size,
+            dtype=cfg.dtype, name="wte",
+        )(input_ids)
+        positions = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[1]), input_ids.shape
+        )
+        block = GPTBlock
+        if cfg.remat:
+            # Remat each block: activations recomputed in backward — the
+            # jax.checkpoint HBM/FLOPs trade for long sequences.  For
+            # nn.remat over a Module class, static_argnums counts
+            # __call__'s args INCLUDING self: deterministic is index 3
+            # (verified by tests/test_gpt.py::test_remat_path_trains).
+            block = nn.remat(GPTBlock, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = block(cfg, self.attn_fn, name=f"h{i}")(
+                x, positions, deterministic
+            )
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Tied output head: reuse the embedding table (one less huge
+        # vocab-sharded matrix; standard for decoder LMs).
+        wte = self.variables["params"]["wte"]["embedding"]
+        return (x @ wte.T.astype(jnp.float32)).astype(jnp.float32)
+
+
+def lm_loss(model: GPTLM):
+    """Next-token cross-entropy; ignores the final position's prediction."""
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        targets = batch["input_ids"][:, 1:]
+        logits = logits[:, :-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("mask")
+        if mask is not None:
+            m = mask[:, 1:].astype(jnp.float32)
+            loss = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+        else:
+            loss = jnp.mean(nll)
+        return loss, ({"perplexity": jnp.exp(loss)}, model_state)
+
+    return loss_fn
+
+
+def gpt_layout() -> LayoutMap:
+    """Megatron-style ``model``-axis sharding rules for :class:`GPTLM`.
+
+    QKV and MLP-in are column-parallel (output dim sharded); proj and
+    MLP-out are row-parallel (input dim sharded); the tied embedding is
+    vocab-sharded.  Batch/seq sharding comes from the data/seq axes at the
+    activation level, not the layout map.
+    """
+    return LayoutMap([
+        (r".*wte/embedding", P("model", None)),
+        (r".*attn/qkv/kernel", P(None, "model")),
+        (r".*attn/proj/kernel", P("model", None)),
+        (r".*fc_in/kernel", P(None, "model")),
+        (r".*fc_out/kernel", P("model", None)),
+    ])

@@ -55,6 +55,7 @@ from .collectives import (  # noqa: F401
 from .ring_attention import (  # noqa: F401
     make_sequence_parallel_attention,
     ring_attention,
+    sequence_parallel_attention_fn,
     ulysses_attention,
 )
 from .pipeline import (  # noqa: F401

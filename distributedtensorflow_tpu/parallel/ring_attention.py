@@ -148,16 +148,37 @@ def make_sequence_parallel_attention(
     The batch dim is additionally sharded over the batch axes, so this
     composes dp x sp out of the box.
     """
+    return jax.jit(
+        sequence_parallel_attention_fn(
+            mesh, scheme=scheme, causal=causal, axis_name=axis_name
+        )
+    )
+
+
+def sequence_parallel_attention_fn(
+    mesh: Mesh,
+    *,
+    scheme: str = "ring",  # "ring" | "ulysses"
+    causal: bool = True,
+    axis_name: str = mesh_lib.AXIS_SEQ,
+) -> Callable:
+    """Un-jitted shard_map attention for use *inside* a jitted model.
+
+    The manual-collectives region embedded in a GSPMD program: models (e.g.
+    ``models.gpt.GPTLM``) take this as their ``attn_fn`` so the surrounding
+    train step stays one ``jit`` while attention runs ring/Ulysses over the
+    ``seq`` axis.  Dropping it into a mesh without a real ``seq`` axis
+    (size 1) degrades to plain blockwise attention — same program, no
+    collectives — so the model code never branches.
+    """
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[scheme]
     kernel = functools.partial(fn, axis_name=axis_name, causal=causal)
     batch_axes = mesh_lib.data_axes(mesh)
     spec = P(batch_axes if batch_axes else None, axis_name, None, None)
-
-    smapped = jax.shard_map(
+    return jax.shard_map(
         lambda q, k, v: kernel(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
-    return jax.jit(smapped)

@@ -58,6 +58,12 @@ class Workload:
     accum_steps: int = 1
     layout: LayoutMap | None = None
     fsdp: bool = False
+    # Optional rebind once the concrete mesh exists (e.g. gpt_lm swaps in
+    # sequence-parallel attention when the mesh has a real seq axis).
+    finalize: Callable[["Workload", Any], "Workload"] | None = None
+
+    def for_mesh(self, mesh) -> "Workload":
+        return self.finalize(self, mesh) if self.finalize else self
 
 
 def _img_input(shape, classes, dtype=np.float32):
@@ -90,6 +96,20 @@ def synthetic_mlm(ctx: InputContext, *, vocab_size: int, seq_len: int,
             "labels": labels.astype(np.int32),
             "attention_mask": np.ones((n, seq_len), np.int32),
         }
+
+
+def synthetic_lm(ctx: InputContext, *, vocab_size: int, seq_len: int,
+                 seed: int = 0) -> Iterator[dict]:
+    """Synthetic next-token LM batches (structured so loss can fall)."""
+    rng = np.random.default_rng(seed + ctx.input_pipeline_id)
+    n = ctx.per_host_batch_size
+    while True:
+        # Learnable structure: arithmetic sequences mod vocab — next token
+        # is predictable from the previous two.
+        start = rng.integers(0, vocab_size, size=(n, 1))
+        step = rng.integers(1, 7, size=(n, 1))
+        ids = (start + step * np.arange(seq_len)) % vocab_size
+        yield {"input_ids": ids.astype(np.int32)}
 
 
 def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
@@ -202,10 +222,54 @@ def get_workload(name: str, *, test_size: bool = False,
             mesh_spec=MeshSpec(data=-1),
             layout=widedeep_layout(),
         )
+    if name in ("gpt_lm", "lm_long_context"):
+        from .models import GPTLM, gpt_layout, gpt_small, gpt_tiny, lm_loss
+
+        cfg = gpt_tiny() if test_size else gpt_small()
+        seq = 64 if test_size else 2048
+        gbs = global_batch_size or (8 if test_size else 64)
+
+        def build(attn_fn=None):
+            model = GPTLM(cfg, attn_fn)
+            return model, lm_loss(model)
+
+        model, loss = build()
+
+        def finalize(wl: Workload, mesh) -> Workload:
+            # With a real seq axis, swap dense attention for the
+            # sequence-parallel shard_map region (ring by default) — the
+            # long-context path (SURVEY.md §5.7).
+            if dict(mesh.shape).get("seq", 1) <= 1:
+                return wl
+            from .parallel.ring_attention import sequence_parallel_attention_fn
+
+            sp_model, sp_loss = build(
+                sequence_parallel_attention_fn(mesh, scheme="ring", causal=True)
+            )
+            return dataclasses.replace(wl, model=sp_model, loss_fn=sp_loss)
+
+        return Workload(
+            name=name, model=model,
+            loss_fn=loss,
+            eval_fn=None,
+            make_optimizer=lambda: optax.adamw(3e-4, weight_decay=0.1),
+            input_fn=lambda ctx, seed: synthetic_lm(
+                ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
+            ),
+            init_batch={"input_ids": np.zeros((2, seq), np.int32)},
+            init_fn=lambda r: model.init(r, jnp.zeros((2, seq), jnp.int32)),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),
+            layout=gpt_layout(),
+            finalize=finalize,
+        )
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
-        "imagenet_resnet50 bert_mlm widedeep"
+        "imagenet_resnet50 bert_mlm widedeep gpt_lm"
     )
 
 
-WORKLOADS = ("mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "bert_mlm", "widedeep")
+WORKLOADS = (
+    "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "bert_mlm",
+    "widedeep", "gpt_lm",
+)

@@ -1,0 +1,138 @@
+"""GPT decoder LM tests: forward, training, and model-level sequence
+parallelism (ring attention inside the jitted step — SURVEY.md §5.7).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.models import GPTLM, gpt_tiny
+from distributedtensorflow_tpu.models.gpt import rope
+from distributedtensorflow_tpu.parallel import (
+    MeshSpec,
+    build_mesh,
+    sequence_parallel_attention_fn,
+)
+from distributedtensorflow_tpu.workloads import get_workload
+
+
+def test_forward_shapes_and_dtype():
+    cfg = gpt_tiny()
+    model = GPTLM(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_remat_path_trains():
+    """The production remat=True path: forward AND backward must work
+    (flax static_argnums numbering regression gate)."""
+    cfg = dataclasses.replace(gpt_tiny(), remat=True, dropout_rate=0.1)
+    model = GPTLM(cfg)
+    from distributedtensorflow_tpu.models import lm_loss
+
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    params = model.init(rng, ids)["params"]
+    loss_fn = lm_loss(model)
+    (loss, _), grads = jax.value_and_grad(
+        lambda p: loss_fn(p, {}, {"input_ids": ids}, rng)[:2], has_aux=True
+    )(params)
+    assert np.isfinite(float(loss))
+    gnorm = jax.tree_util.tree_reduce(
+        lambda a, g: a + float(jnp.sum(jnp.abs(g))), grads, 0.0
+    )
+    assert gnorm > 0
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    cfg = gpt_tiny()
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(1)
+    ids = jax.random.randint(rng, (1, 12), 0, cfg.vocab_size)
+    params = model.init(rng, ids)["params"]
+    base = model.apply({"params": params}, ids)
+    changed = ids.at[0, 8].set((ids[0, 8] + 1) % cfg.vocab_size)
+    out = model.apply({"params": params}, changed)
+    np.testing.assert_allclose(
+        np.asarray(base[0, :8]), np.asarray(out[0, :8]), rtol=2e-4, atol=2e-4
+    )
+    assert not np.allclose(np.asarray(base[0, 8:]), np.asarray(out[0, 8:]))
+
+
+def test_rope_relative_shift_invariance():
+    """RoPE scores depend on relative offsets: shifting all positions by a
+    constant leaves q·k inner products unchanged."""
+    rng = jax.random.PRNGKey(2)
+    q = jax.random.normal(rng, (1, 6, 2, 8))
+    k = jax.random.normal(jax.random.PRNGKey(3), (1, 6, 2, 8))
+    pos = jnp.arange(6)[None, :]
+    s0 = jnp.einsum(
+        "bqhd,bkhd->bhqk", rope(q, pos, 1e4), rope(k, pos, 1e4)
+    )
+    s1 = jnp.einsum(
+        "bqhd,bkhd->bhqk", rope(q, pos + 17, 1e4), rope(k, pos + 17, 1e4)
+    )
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), atol=1e-4)
+
+
+def test_workload_trains_loss_falls(devices):
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=8)
+    from distributedtensorflow_tpu.data import InputContext, device_put_batch
+    from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+
+    mesh = build_mesh(MeshSpec(data=-1), devices)
+    wl = wl.for_mesh(mesh)
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    ctx = InputContext(1, 0, wl.global_batch_size)
+    it = wl.input_fn(ctx, 0)
+    losses = []
+    for _ in range(30):
+        batch = device_put_batch(next(it), mesh)
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    # uniform-random init sits at ln(512)≈6.24; a clear sustained drop is
+    # the signal (20 %+ needs more steps than a unit test should take)
+    assert losses[-1] < losses[0] - 0.4, losses[::10]
+
+
+@pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+def test_sequence_parallel_matches_dense(devices, scheme):
+    """Same params, same input: SP attention inside the model must match the
+    dense model's logits (the §7 'golden tests vs full attention' gate)."""
+    # float32 so this is a true golden test (bf16 noise would swamp the
+    # ring-vs-dense comparison at model depth).
+    cfg = dataclasses.replace(gpt_tiny(), dropout_rate=0.0, dtype=jnp.float32)
+    mesh = build_mesh(MeshSpec(data=2, seq=4), devices)
+    dense = GPTLM(cfg)
+    sp = GPTLM(cfg, sequence_parallel_attention_fn(mesh, scheme=scheme))
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (4, 32), 0, cfg.vocab_size)
+    params = dense.init(rng, ids)["params"]
+
+    ref = dense.apply({"params": params}, ids)
+    with jax.sharding.set_mesh(mesh):
+        got = jax.jit(lambda p, x: sp.apply({"params": p}, x))(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_gpt_lm_finalize_binds_sp(devices):
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=8)
+    assert wl.model.attn_fn is None
+    sp_mesh = build_mesh(MeshSpec(data=2, seq=4), devices)
+    bound = wl.for_mesh(sp_mesh)
+    assert bound.model.attn_fn is not None
+    dp_mesh = build_mesh(MeshSpec(data=-1), devices)
+    assert wl.for_mesh(dp_mesh).model.attn_fn is None

@@ -93,6 +93,7 @@ def main() -> None:
     )
     spec = parse_mesh(args.mesh) or wl.mesh_spec
     mesh = parallel.build_mesh(spec)
+    wl = wl.for_mesh(mesh)  # e.g. gpt_lm binds seq-parallel attention
     accum = args.accum_steps if args.accum_steps is not None else wl.accum_steps
     logging.info(
         "workload=%s mesh=%s devices=%d processes=%d global_batch=%d accum=%d",
