@@ -11,3 +11,4 @@ pipeline, sequence (ring attention / Ulysses) and expert parallelism.
 __version__ = "0.1.0"
 
 from . import parallel  # noqa: F401
+from . import strategies  # noqa: F401

@@ -1,5 +1,12 @@
 """Distributed input pipeline (host tf.data / synthetic → sharded device batches)."""
 
+from .recordio_dataset import (  # noqa: F401
+    decode_example,
+    encode_example,
+    record_dataset,
+    write_example,
+    write_record_shards,
+)
 from .service import (  # noqa: F401
     DataServiceClient,
     DispatchServer,

@@ -1,0 +1,158 @@
+"""Strategy-compatibility shim: the reference API surface on the mesh engine.
+
+Migration layer for users of the reference's ``tf.distribute`` strategy zoo
+(SURVEY.md §2.1): each strategy class here resolves to its mesh equivalent
+(§2.4 coverage matrix) — because under SPMD **a strategy is just a mesh
+shape**.  The classes expose the strategy surface that survives the
+paradigm change:
+
+- ``scope()`` — enters the mesh (``jax.sharding.set_mesh``); sharded-state
+  creation inside behaves like variable creation under a strategy scope.
+- ``num_replicas_in_sync`` — data-parallel width.
+- ``experimental_distribute_dataset`` / ``distribute_datasets_from_function``
+  — per-host input sharding (`InputContext` semantics,
+  `distribute_lib.py:841/:1349`).
+- ``run(fn, args)`` — jit-compiles ``fn`` over the mesh; with batch-leading
+  args this is the ``strategy.run`` data-parallel step
+  (`distribute_lib.py:1557`).
+- ``reduce(op, value)`` — cross-replica reduction of a sharded array
+  (`distribute_lib.py:1675`).
+
+Semantic deltas from the reference (documented, deliberate):
+- ``ParameterServerStrategy`` maps to *synchronous* training with
+  embeddings sharded over the ``model`` axis (SURVEY.md §7 hard parts:
+  TPU has no async PS; capability parity is sharded big-embedding
+  training + the ``parallel.Coordinator`` for host-side fan-out).
+- ``MultiWorkerMirroredStrategy`` boots the JAX distributed runtime
+  (coordination service) instead of a gRPC server mesh.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from collections.abc import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+
+from .data.input_pipeline import (
+    InputContext,
+    current_input_context,
+    shard_dataset,
+    tfdata_iterator,
+)
+from .parallel import bootstrap
+from .parallel.mesh import MeshSpec, build_mesh
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+
+class Strategy:
+    """Base: a named mesh shape plus the surviving strategy surface."""
+
+    def __init__(self, mesh_spec: MeshSpec, devices=None):
+        self.mesh = build_mesh(mesh_spec, devices)
+        self._jit_cache: dict[Callable, Callable] = {}
+
+    # --- scope ------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def scope(self):
+        """Enter the mesh: jit calls inside see it as the ambient mesh."""
+        with jax.sharding.set_mesh(self.mesh):
+            yield self
+
+    # --- replica topology -------------------------------------------------
+
+    @property
+    def num_replicas_in_sync(self) -> int:
+        shape = dict(self.mesh.shape)
+        return shape.get("data", 1) * shape.get("fsdp", 1)
+
+    # --- input ------------------------------------------------------------
+
+    def distribute_datasets_from_function(
+        self, dataset_fn: Callable[[InputContext], Iterator], *,
+        global_batch_size: int = 0,
+    ) -> Iterator:
+        ctx = current_input_context(global_batch_size)
+        return dataset_fn(ctx)
+
+    def experimental_distribute_dataset(self, ds) -> Iterator:
+        """Shard a tf.data.Dataset per host (DATA policy) and iterate numpy."""
+        ctx = current_input_context(0)
+        return tfdata_iterator(shard_dataset(ds, ctx))
+
+    # --- compute ----------------------------------------------------------
+
+    def run(self, fn: Callable, args: tuple = (), kwargs: dict | None = None):
+        """Run ``fn`` jitted over the mesh (once — SPMD, not per-replica).
+
+        The jitted wrapper is cached per ``fn`` so per-step calls hit the
+        jit cache instead of retracing (strategy.run is the reference's
+        per-step entry point).
+        """
+        jitted = self._jit_cache.get(fn)
+        if jitted is None:
+            jitted = self._jit_cache[fn] = jax.jit(fn)
+        with jax.sharding.set_mesh(self.mesh):
+            return jitted(*args, **(kwargs or {}))
+
+    def reduce(self, reduce_op: str, value: jax.Array, axis=None):
+        """Cross-replica reduce of a (possibly sharded) array to a host scalar
+        per element: 'sum' | 'mean' | 'max' | 'min' over the batch dim."""
+        ops = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}
+        return jax.device_get(ops[reduce_op.lower()](value, axis=axis))
+
+
+class OneDeviceStrategy(Strategy):
+    """Reference `one_device_strategy.py:39` → mesh with every axis = 1."""
+
+    def __init__(self, device=None):
+        devices = [device] if device is not None else [jax.devices()[0]]
+        super().__init__(MeshSpec(data=1), devices)
+
+
+class MirroredStrategy(Strategy):
+    """Reference `mirrored_strategy.py:200` (in-host sync DP) →
+    ``data=-1`` over this process's devices."""
+
+    def __init__(self, devices=None):
+        devices = list(devices) if devices is not None else jax.local_devices()
+        super().__init__(MeshSpec(data=-1), devices)
+
+
+class MultiWorkerMirroredStrategy(Strategy):
+    """Reference `collective_all_reduce_strategy.py:57` (multi-host sync DP)
+    → distributed runtime up + ``data=-1`` over ALL devices."""
+
+    def __init__(self, cluster=None):
+        bootstrap.initialize(cluster)
+        super().__init__(MeshSpec(data=-1))
+
+
+class ParameterServerStrategy(Strategy):
+    """Reference `parameter_server_strategy_v2.py:77` →  **sync** training
+    with parameters shardable over the ``model`` axis (embedding-TP replaces
+    PS-sharded variables; see module docstring for the semantic delta)."""
+
+    def __init__(self, model_axis_size: int = -1, devices=None):
+        if model_axis_size == -1:
+            model_axis_size = max(
+                1, len(devices or jax.devices()) // 2
+            ) if len(devices or jax.devices()) > 1 else 1
+        super().__init__(MeshSpec(data=-1, model=model_axis_size), devices)
+        logger.info(
+            "ParameterServerStrategy maps to sync sharded-variable training "
+            "(model axis = %d); use parallel.Coordinator for async host-side "
+            "dispatch", model_axis_size,
+        )
+
+
+class TPUStrategy(Strategy):
+    """Reference `tpu_strategy.py:243` → the native path: all devices, DP by
+    default; pass a richer ``MeshSpec`` directly for tp/pp/sp/ep."""
+
+    def __init__(self, mesh_spec: MeshSpec | None = None):
+        super().__init__(mesh_spec or MeshSpec(data=-1))
