@@ -89,6 +89,10 @@ def record_dataset(
     Yields dicts of stacked arrays with a leading ``batch_size`` dim (the
     per-host batch; pass ``ctx.per_host_batch_size`` upstream).  With
     ``batch_size=None`` yields individual decoded examples.
+
+    Argument validation happens HERE, eagerly — not at first iteration —
+    so a config typo fails at job setup rather than inside a prefetch
+    thread mid-training.
     """
     files = list(files)
     if not files:
@@ -105,6 +109,17 @@ def record_dataset(
             )
         files = files[host::n_hosts]
 
+    return _record_dataset_iter(
+        files, policy, host, n_hosts, batch_size=batch_size,
+        decode_fn=decode_fn, shuffle_buffer=shuffle_buffer, seed=seed,
+        num_threads=num_threads, drop_remainder=drop_remainder,
+    )
+
+
+def _record_dataset_iter(
+    files, policy, host, n_hosts, *, batch_size, decode_fn, shuffle_buffer,
+    seed, num_threads, drop_remainder,
+) -> Iterator[Example]:
     data_sharded = policy == "DATA" and n_hosts > 1
     # DATA sharding partitions by *stream position*, so every host must see
     # the IDENTICAL stream order: single reader thread, no native shuffle,

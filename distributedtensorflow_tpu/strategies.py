@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import weakref
 from collections.abc import Callable, Iterator
 
 import jax
@@ -43,7 +44,13 @@ from .data.input_pipeline import (
     tfdata_iterator,
 )
 from .parallel import bootstrap
-from .parallel.mesh import MeshSpec, build_mesh
+from .parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    mirrored_mesh,
+    multi_worker_mesh,
+    one_device_mesh,
+)
 
 logger = logging.getLogger("distributedtensorflow_tpu")
 
@@ -51,9 +58,14 @@ logger = logging.getLogger("distributedtensorflow_tpu")
 class Strategy:
     """Base: a named mesh shape plus the surviving strategy surface."""
 
-    def __init__(self, mesh_spec: MeshSpec, devices=None):
-        self.mesh = build_mesh(mesh_spec, devices)
-        self._jit_cache: dict[Callable, Callable] = {}
+    def __init__(self, mesh_spec: MeshSpec | None = None, devices=None,
+                 *, mesh=None):
+        self.mesh = mesh if mesh is not None else build_mesh(
+            mesh_spec or MeshSpec(data=-1), devices
+        )
+        # Weak keys: per-step lambdas don't accumulate forever (they also
+        # don't cache — pass a stable fn reference to get jit-cache hits).
+        self._jit_cache: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
 
     # --- scope ------------------------------------------------------------
 
@@ -89,9 +101,10 @@ class Strategy:
     def run(self, fn: Callable, args: tuple = (), kwargs: dict | None = None):
         """Run ``fn`` jitted over the mesh (once — SPMD, not per-replica).
 
-        The jitted wrapper is cached per ``fn`` so per-step calls hit the
-        jit cache instead of retracing (strategy.run is the reference's
-        per-step entry point).
+        The jitted wrapper is cached per ``fn`` (weakly) so per-step calls
+        with a STABLE function reference hit the jit cache instead of
+        retracing (strategy.run is the reference's per-step entry point).
+        A fresh lambda per call defeats the cache — hoist it.
         """
         jitted = self._jit_cache.get(fn)
         if jitted is None:
@@ -107,20 +120,19 @@ class Strategy:
 
 
 class OneDeviceStrategy(Strategy):
-    """Reference `one_device_strategy.py:39` → mesh with every axis = 1."""
+    """Reference `one_device_strategy.py:39` → mesh with every axis = 1
+    (on a *local* device — `mesh.one_device_mesh`)."""
 
     def __init__(self, device=None):
-        devices = [device] if device is not None else [jax.devices()[0]]
-        super().__init__(MeshSpec(data=1), devices)
+        super().__init__(mesh=one_device_mesh(device))
 
 
 class MirroredStrategy(Strategy):
     """Reference `mirrored_strategy.py:200` (in-host sync DP) →
-    ``data=-1`` over this process's devices."""
+    ``data=-1`` over this process's devices (`mesh.mirrored_mesh`)."""
 
     def __init__(self, devices=None):
-        devices = list(devices) if devices is not None else jax.local_devices()
-        super().__init__(MeshSpec(data=-1), devices)
+        super().__init__(mesh=mirrored_mesh(devices))
 
 
 class MultiWorkerMirroredStrategy(Strategy):
@@ -129,7 +141,7 @@ class MultiWorkerMirroredStrategy(Strategy):
 
     def __init__(self, cluster=None):
         bootstrap.initialize(cluster)
-        super().__init__(MeshSpec(data=-1))
+        super().__init__(mesh=multi_worker_mesh())
 
 
 class ParameterServerStrategy(Strategy):
@@ -138,10 +150,12 @@ class ParameterServerStrategy(Strategy):
     PS-sharded variables; see module docstring for the semantic delta)."""
 
     def __init__(self, model_axis_size: int = -1, devices=None):
+        n = len(devices if devices is not None else jax.devices())
         if model_axis_size == -1:
-            model_axis_size = max(
-                1, len(devices or jax.devices()) // 2
-            ) if len(devices or jax.devices()) > 1 else 1
+            # Largest divisor of n that is <= n//2 (1 when n is 1 or prime).
+            model_axis_size = next(
+                (d for d in range(n // 2, 0, -1) if n % d == 0), 1
+            )
         super().__init__(MeshSpec(data=-1, model=model_axis_size), devices)
         logger.info(
             "ParameterServerStrategy maps to sync sharded-variable training "

@@ -122,3 +122,15 @@ def test_file_sharding_insufficient_files_raises(tmp_path):
     paths, _ = _make_shards(tmp_path, n_shards=1)
     with pytest.raises(ValueError):
         list(record_dataset(paths, InputContext(2, 0, 0), policy="FILE"))
+
+
+def test_validation_is_eager(tmp_path):
+    """Config errors must raise at call time, not at first next() inside a
+    prefetch thread."""
+    paths, _ = _make_shards(tmp_path, n_shards=1)
+    with pytest.raises(ValueError):
+        record_dataset([])  # no iteration
+    with pytest.raises(ValueError):
+        record_dataset(paths, policy="BOGUS")
+    with pytest.raises(ValueError):
+        record_dataset(paths, InputContext(2, 0, 0), policy="FILE")
