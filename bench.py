@@ -30,29 +30,39 @@ if os.environ.get("BENCH_SKIP_PROBE") != "1":
     # in communicate() after kill(), which never returns if the child is in
     # uninterruptible sleep on the wedged device — the exact failure mode
     # this probe exists to catch.  Here we give up on an unkillable child.
-    _probe = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.PIPE,
-    )
-    try:
-        _rc = _probe.wait(timeout=DEVICE_PROBE_TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        _probe.kill()
-        try:
-            _probe.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            pass  # child stuck in D-state; abandon it
-        print(
-            f"bench: jax device probe unresponsive after "
-            f"{DEVICE_PROBE_TIMEOUT_S}s (TPU tunnel down?)",
-            file=sys.stderr,
+    import tempfile
+
+    # stderr to a temp FILE, not a pipe: nobody drains a pipe while the
+    # parent blocks in wait(), so a verbose fast-failing child would fill
+    # the pipe buffer and masquerade as a hang.
+    with tempfile.TemporaryFile() as _errf:
+        _probe = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=_errf,
         )
-        raise SystemExit(2)
-    if _rc != 0:
-        _err = _probe.stderr.read().decode() if _probe.stderr else ""
-        print(f"bench: jax device probe failed:\n{_err}", file=sys.stderr)
-        raise SystemExit(2)
+        try:
+            _rc = _probe.wait(timeout=DEVICE_PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            _probe.kill()
+            try:
+                _probe.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # child stuck in D-state; abandon it
+            print(
+                f"bench: jax device probe unresponsive after "
+                f"{DEVICE_PROBE_TIMEOUT_S}s (TPU tunnel down?)",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        if _rc != 0:
+            _errf.seek(0)
+            print(
+                f"bench: jax device probe failed:\n"
+                f"{_errf.read().decode(errors='replace')}",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

@@ -19,6 +19,8 @@ from .mesh import (  # noqa: F401
 )
 from .bootstrap import (  # noqa: F401
     ClusterConfig,
+    barrier,
+    broadcast_from_chief,
     expand_nodelist,
     initialize,
     is_chief,

@@ -308,3 +308,44 @@ def process_index() -> int:
 def is_chief() -> bool:
     """Chief-only convention for checkpoint/metric writing (SURVEY.md §5.5)."""
     return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cluster-wide barrier via the coordination service.
+
+    The reference's coordination-service barrier (SURVEY.md §5.3,
+    `coordination_service.h:67`); used e.g. to line all hosts up on the
+    same checkpoint step.  No-op in single-process runs.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_chief(pytree):
+    """Ship a host-side pytree from process 0 to every process.
+
+    The coordination-service KV-store pattern (chief decides, all agree):
+    e.g. a dynamically chosen step count, eval split, or config dict.
+    Arbitrary picklable leaves are supported (strings included — the raw
+    ``broadcast_one_to_all`` is numeric-only): the chief's tree ships as a
+    pickled uint8 payload.  Returns the chief's values on every process;
+    no-op single-process.
+    """
+    if jax.process_count() <= 1:
+        return pytree
+    import pickle
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(pytree)
+    n = int(
+        multihost_utils.broadcast_one_to_all(np.int64(len(payload)))
+    )
+    buf = np.frombuffer(payload, dtype=np.uint8) if is_chief() else np.zeros(
+        n, np.uint8
+    )
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return pickle.loads(np.asarray(out).tobytes())

@@ -29,6 +29,9 @@ from __future__ import annotations
 
 import collections
 import logging
+import multiprocessing as mp
+import os
+import signal
 import threading
 import time
 from typing import Any, Callable, Generic, Iterable, Iterator, TypeVar
@@ -224,6 +227,104 @@ class PerWorker(Generic[T]):
             return self._values[worker_id]
 
 
+def _subprocess_worker_main(conn) -> None:
+    """Loop of a process-backed worker: recv (fn, args, kwargs), send result."""
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, KeyboardInterrupt):
+            return
+        if msg is None:
+            return
+        fn, args, kwargs = msg
+        try:
+            result = fn(*args, **kwargs)
+            conn.send(("ok", result))
+        except BaseException as e:  # noqa: BLE001 — shipped to the parent
+            try:
+                conn.send(("err", e))
+            except Exception:  # unpicklable exception: ship the repr
+                conn.send(("err", RuntimeError(repr(e))))
+
+
+class _SubprocessExecutor:
+    """A persistent worker OS process executing pickled closures.
+
+    The process analogue of the reference's remote eager workers (§3.3):
+    real isolation, real death.  A dead child surfaces as
+    :class:`WorkerUnavailableError` — exactly the retryable signal the
+    coordinator's re-queue path expects — and the executor respawns for the
+    next closure.  Closures and their resolved args must be picklable
+    (module-level functions; no PerWorker iterators).
+    """
+
+    def __init__(self, worker_id: int):
+        self.worker_id = worker_id
+        self._ctx = mp.get_context("spawn")
+        self._lock = threading.Lock()
+        self._spawn()
+
+    def _spawn(self) -> None:
+        self._conn, child = self._ctx.Pipe()
+        self._proc = self._ctx.Process(
+            target=_subprocess_worker_main, args=(child,), daemon=True,
+            name=f"coordinator-proc-{self.worker_id}",
+        )
+        self._proc.start()
+        child.close()
+
+    @property
+    def pid(self) -> int:
+        return self._proc.pid
+
+    def execute(self, fn, args, kwargs):
+        with self._lock:
+            try:
+                self._conn.send((fn, args, kwargs))
+                status, payload = self._conn.recv()
+            except (EOFError, OSError) as e:
+                self._respawn()
+                raise WorkerUnavailableError(
+                    f"worker process {self.worker_id} died: {e!r}"
+                ) from e
+        if status == "err":
+            raise payload
+        return payload
+
+    def _respawn(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+        if self._proc.is_alive():
+            self._proc.kill()
+        self._proc.join(timeout=5)
+        self._spawn()
+
+    def kill(self) -> None:
+        """Fault injection: SIGKILL the worker process."""
+        os.kill(self._proc.pid, signal.SIGKILL)
+
+    def close(self) -> None:
+        # Don't block shutdown behind a worker thread parked in recv() on a
+        # long/hung closure: bounded lock wait, then escalate to kill.
+        got = self._lock.acquire(timeout=1.0)
+        try:
+            if got:
+                try:
+                    self._conn.send(None)  # graceful: child loop exits
+                    self._conn.close()
+                except OSError:
+                    pass
+        finally:
+            if got:
+                self._lock.release()
+        self._proc.join(timeout=5 if got else 0.1)
+        if self._proc.is_alive():
+            self._proc.kill()
+            self._proc.join(timeout=5)
+
+
 class _Worker(threading.Thread):
     """Dispatch thread (reference ``Worker``, :1027): pops and executes.
 
@@ -256,8 +357,16 @@ class _Worker(threading.Thread):
                 if isinstance(v, PerWorker):
                     return v._resolve(self.worker_id)
                 return v
+            executor = self._coord._executor_for(self.worker_id)
             try:
-                result = closure.execute(resolve)
+                if executor is not None:
+                    result = executor.execute(
+                        closure.fn,
+                        tuple(resolve(a) for a in closure.args),
+                        {k: resolve(v) for k, v in closure.kwargs.items()},
+                    )
+                else:
+                    result = closure.execute(resolve)
             except self._coord._retryable as e:
                 self.failures += 1
                 closure.attempts += 1
@@ -301,7 +410,14 @@ class Coordinator:
         queue_size: int = 256,
         retryable_exceptions: tuple[type[BaseException], ...] = (),
         max_retries: int = 16,
+        use_processes: bool = False,
     ):
+        """``use_processes=True`` backs each worker with a real OS process
+        (the reference's remote-worker isolation): closures run out-of-
+        process, a killed/crashed worker transparently re-queues its
+        closure, and the pool respawns the process.  Requires picklable
+        closures/args; PerWorker values stay thread-mode only.
+        """
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         self._queue = _ClosureQueue(queue_size)
@@ -310,9 +426,30 @@ class Coordinator:
         self._retryable = (WorkerUnavailableError, *retryable_exceptions)
         self._failed_workers: set[int] = set()
         self._failed_lock = threading.Lock()
+        self._executors: list[_SubprocessExecutor] | None = (
+            [_SubprocessExecutor(i) for i in range(num_workers)]
+            if use_processes
+            else None
+        )
         self._workers = [_Worker(i, self) for i in range(num_workers)]
         for w in self._workers:
             w.start()
+
+    def _executor_for(self, worker_id: int) -> "_SubprocessExecutor | None":
+        return self._executors[worker_id] if self._executors else None
+
+    def worker_pids(self) -> list[int] | None:
+        """PIDs of process-backed workers (None in thread mode)."""
+        if not self._executors:
+            return None
+        return [e.pid for e in self._executors]
+
+    def kill_worker_process(self, worker_id: int) -> None:
+        """Fault injection: SIGKILL a process-backed worker (its in-flight
+        closure re-queues onto another worker; the process respawns)."""
+        if not self._executors:
+            raise RuntimeError("kill_worker_process needs use_processes=True")
+        self._executors[worker_id].kill()
 
     @property
     def num_workers(self) -> int:
@@ -378,6 +515,9 @@ class Coordinator:
         self._queue.close()
         for w in self._workers:
             w.join(timeout=5)
+        if self._executors:
+            for e in self._executors:
+                e.close()
 
     def __enter__(self) -> "Coordinator":
         return self

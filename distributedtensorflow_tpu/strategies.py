@@ -29,9 +29,9 @@ Semantic deltas from the reference (documented, deliberate):
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import logging
-import weakref
 from collections.abc import Callable, Iterator
 
 import jax
@@ -63,9 +63,11 @@ class Strategy:
         self.mesh = mesh if mesh is not None else build_mesh(
             mesh_spec or MeshSpec(data=-1), devices
         )
-        # Weak keys: per-step lambdas don't accumulate forever (they also
-        # don't cache — pass a stable fn reference to get jit-cache hits).
-        self._jit_cache: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
+        # Bounded FIFO cache (a weak-key dict would never evict: the jitted
+        # value strongly references the key fn).  Stable fn references hit
+        # the cache; per-step lambdas churn through it without growing it.
+        self._jit_cache: collections.OrderedDict = collections.OrderedDict()
+        self._jit_cache_max = 64
 
     # --- scope ------------------------------------------------------------
 
@@ -109,6 +111,8 @@ class Strategy:
         jitted = self._jit_cache.get(fn)
         if jitted is None:
             jitted = self._jit_cache[fn] = jax.jit(fn)
+            while len(self._jit_cache) > self._jit_cache_max:
+                self._jit_cache.popitem(last=False)
         with jax.sharding.set_mesh(self.mesh):
             return jitted(*args, **(kwargs or {}))
 

@@ -1,0 +1,79 @@
+"""Process-backed coordinator workers: real OS-process isolation + death.
+
+Reference model: SURVEY.md §3.3 — closures run on remote worker processes;
+``WorkerPreemptionHandler`` re-queues on worker death.  Thread-mode
+semantics are covered in test_coordinator.py; here the workers are real
+processes and the fault injection is a real SIGKILL.
+"""
+
+import os
+import time
+
+import pytest
+
+from distributedtensorflow_tpu.parallel.coordinator import Coordinator
+
+
+# module-level fns: process workers need picklable closures
+
+
+def _pid(x):
+    return (os.getpid(), x * 2)
+
+
+def _slow_pid(x):
+    time.sleep(0.4)
+    return (os.getpid(), x)
+
+
+def _boom(x):
+    raise ValueError(f"app error {x}")
+
+
+@pytest.fixture()
+def coord():
+    c = Coordinator(num_workers=3, use_processes=True)
+    yield c
+    c.shutdown()
+
+
+def test_closures_run_out_of_process(coord):
+    rvs = [coord.schedule(_pid, (i,)) for i in range(12)]
+    coord.join()
+    results = [rv.fetch() for rv in rvs]
+    pids = {pid for pid, _ in results}
+    assert os.getpid() not in pids  # really out-of-process
+    assert len(pids) > 1  # really a pool
+    assert sorted(v for _, v in results) == [i * 2 for i in range(12)]
+
+
+def test_worker_pids_reported(coord):
+    pids = coord.worker_pids()
+    assert len(pids) == 3 and os.getpid() not in pids
+
+
+def test_kill_mid_flight_requeues_and_respawns(coord):
+    rvs = [coord.schedule(_slow_pid, (i,)) for i in range(6)]
+    time.sleep(0.15)  # let closures land on workers
+    before = coord.worker_pids()
+    coord.kill_worker_process(0)
+    coord.join(timeout=30)
+    got = sorted(v for _, v in (rv.fetch() for rv in rvs))
+    assert got == list(range(6))  # nothing lost to the kill
+    # next closure on worker 0 triggers respawn; pool stays 3-wide
+    coord.schedule(_pid, (99,)).fetch(timeout=10)
+    assert len(coord.worker_pids()) == 3
+    assert before is not None
+
+
+def test_app_error_from_child_reraised(coord):
+    coord.schedule(_boom, (7,))
+    with pytest.raises(ValueError, match="app error 7"):
+        coord.join(timeout=10)
+
+
+def test_thread_mode_has_no_pids():
+    with Coordinator(num_workers=2) as c:
+        assert c.worker_pids() is None
+        with pytest.raises(RuntimeError):
+            c.kill_worker_process(0)

@@ -106,7 +106,28 @@ def _train_with_checkpoint_task(task_id, ckpt_dir, total_steps):
     return {"start_step": start_step, "end_step": int(state.step)}
 
 
+def _barrier_broadcast_task(task_id):
+    import time
+
+    from distributedtensorflow_tpu.parallel import barrier, broadcast_from_chief
+
+    if task_id == 1:
+        time.sleep(0.3)  # stagger arrival; barrier must still line us up
+    barrier("test-sync")
+    # chief picks a value; everyone must see the chief's copy
+    chosen = {"step": 1234 if task_id == 0 else -1, "name": f"t{task_id}"}
+    agreed = broadcast_from_chief(chosen)
+    return {"step": int(agreed["step"])}
+
+
 # --- tests ------------------------------------------------------------------
+
+
+def test_barrier_and_chief_broadcast():
+    result = run(_barrier_broadcast_task, 2, env=ONE_DEV, timeout=120)
+    assert result.exit_codes == {0: 0, 1: 0}
+    assert result.return_values[0]["step"] == 1234
+    assert result.return_values[1]["step"] == 1234
 
 
 def test_two_process_allgather():
