@@ -70,6 +70,7 @@ from .moe import (  # noqa: F401
     init_expert_params,
     make_moe_layer,
     top1_route,
+    top2_route,
 )
 from .sharding import (  # noqa: F401
     FixedShardsPartitioner,

@@ -25,6 +25,14 @@ from . import mesh as mesh_lib
 PyTree = Any
 
 
+def _capacity_slots(pos: jax.Array, mask: jax.Array, capacity: int) -> jax.Array:
+    """(T, E) 1-based queue positions + assignment mask → (T, E, C) one-hot
+    dispatch, dropping assignments past ``capacity``."""
+    keep = (pos <= capacity) & (mask > 0)
+    slot = jnp.clip(pos - 1.0, 0, capacity - 1).astype(jnp.int32)
+    return keep[..., None] * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+
+
 def top1_route(
     logits: jax.Array,  # (T, E) router logits
     capacity: int,
@@ -42,11 +50,7 @@ def top1_route(
     expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
     # position of each token within its expert's queue
     pos_in_expert = jnp.cumsum(expert_onehot, axis=0) * expert_onehot  # 1-based
-    keep = (pos_in_expert <= capacity) & (expert_onehot > 0)
-    slot = (pos_in_expert - 1.0).astype(jnp.int32)  # 0-based, valid where keep
-    slot_onehot = jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1), capacity,
-                                 dtype=jnp.float32)
-    dispatch = keep[..., None] * slot_onehot  # (T, E, C)
+    dispatch = _capacity_slots(pos_in_expert, expert_onehot, capacity)
     gate = jnp.sum(probs * expert_onehot, axis=-1, keepdims=True)  # (T, 1)
     combine = dispatch * gate[..., None]
     # Switch aux loss: encourages uniform token/prob mass over experts
@@ -54,6 +58,52 @@ def top1_route(
     frac_probs = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return dispatch, combine, aux
+
+
+def top2_route(
+    logits: jax.Array,  # (T, E) router logits
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-2 routing with capacity (GShard recipe).
+
+    Each token goes to its two highest-probability experts; the two gates
+    are renormalized to sum to 1.  Top-2 assignments queue AFTER all top-1
+    assignments per expert (GShard's priority rule: second choices only
+    take leftover capacity).  Same return contract as :func:`top1_route`.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    # Queue positions: top-1 first, then top-2 behind ALL top-1 of that
+    # expert (so capacity preempts second choices, never first choices).
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1  # 1-based
+    count1 = jnp.sum(mask1, axis=0, keepdims=True)  # (1, E)
+    pos2 = (jnp.cumsum(mask2, axis=0) + count1) * mask2
+
+    d1 = _capacity_slots(pos1, mask1, capacity)  # (T, E, C)
+    d2 = _capacity_slots(pos2, mask2, capacity)
+    dispatch = d1 + d2
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    # GShard aux loss over the FIRST choice (same form as Switch).
+    frac_tokens = jnp.mean(mask1, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+ROUTERS = {"top1": top1_route, "top2": top2_route}
+#: assignments per token, for capacity scaling (GShard: top-2 needs 2x slots)
+_ASSIGNMENTS = {"top1": 1, "top2": 2}
 
 
 def expert_parallel_moe(
@@ -64,13 +114,19 @@ def expert_parallel_moe(
     *,
     axis_name: str = mesh_lib.AXIS_EXPERT,
     capacity_factor: float = 1.25,
+    router: str = "top1",
 ) -> tuple[jax.Array, jax.Array]:
-    """Switch MoE layer body (shard_map-internal). Returns (out, aux_loss).
+    """MoE layer body (shard_map-internal). Returns (out, aux_loss).
 
-    ``expert_params`` leading dim is the local expert count; global expert
-    count E = E_local * axis_size.  Dropped-over-capacity tokens contribute 0
+    ``router``: "top1" (Switch) or "top2" (GShard).  ``expert_params``
+    leading dim is the local expert count; global expert count
+    E = E_local * axis_size.  Dropped-over-capacity tokens contribute 0
     here (caller keeps them on the residual path).
     """
+    if router not in ROUTERS:
+        raise ValueError(
+            f"unknown router {router!r}; expected one of {list(ROUTERS)}"
+        )
     n = lax.axis_size(axis_name)
     t, d = tokens.shape
     e = router_kernel.shape[-1]
@@ -78,10 +134,15 @@ def expert_parallel_moe(
         raise ValueError(
             f"n_experts={e} not divisible by expert axis size {n}"
         )
-    capacity = max(1, int(t * capacity_factor / e))
+    # Scale capacity by assignments-per-token: top-2 produces 2T assignments,
+    # so capacity_factor=1.0 still means "room for every assignment" under a
+    # uniform router (the GShard 2*cf*T/E convention).
+    capacity = max(
+        1, int(t * capacity_factor * _ASSIGNMENTS[router] / e)
+    )
 
     logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
-    dispatch, combine, aux = top1_route(logits, capacity)
+    dispatch, combine, aux = ROUTERS[router](logits, capacity)
 
     # (T, E, C) x (T, d) -> (E, C, d): expert-major send buffer
     send = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(jnp.float32))
@@ -125,12 +186,17 @@ def make_moe_layer(
     *,
     capacity_factor: float = 1.25,
     axis_name: str = mesh_lib.AXIS_EXPERT,
+    router: str = "top1",
 ) -> Callable:
     """Global entry: ``fn(tokens (N, d), router_kernel, expert_params)``.
 
     Tokens are sharded over (batch axes + expert axis) so each expert shard
     routes its local tokens; expert params are expert-axis sharded.
     """
+    if router not in ROUTERS:  # eager: fail here, not inside the jit trace
+        raise ValueError(
+            f"unknown router {router!r}; expected one of {list(ROUTERS)}"
+        )
     batch_axes = mesh_lib.data_axes(mesh)
     tok_axes = tuple(batch_axes) + (axis_name,)
 
@@ -138,7 +204,7 @@ def make_moe_layer(
         def body(toks, rk, ep):
             out, aux = expert_parallel_moe(
                 toks, rk, ep, expert_fn=expert_fn, axis_name=axis_name,
-                capacity_factor=capacity_factor,
+                capacity_factor=capacity_factor, router=router,
             )
             if batch_axes:  # make the aux loss a true global scalar
                 aux = lax.pmean(aux, batch_axes)

@@ -16,6 +16,7 @@ from distributedtensorflow_tpu.parallel.moe import (
     init_expert_params,
     make_moe_layer,
     top1_route,
+    top2_route,
 )
 
 D = 8
@@ -54,6 +55,48 @@ def test_capacity_drops_tokens():
     logits = jnp.zeros((10, E)).at[:, 0].set(10.0)
     dispatch, _, _ = top1_route(logits, capacity=2)
     assert float(dispatch.sum()) == 2.0
+
+
+def test_top2_route_invariants():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (16, E))
+    dispatch, combine, aux = top2_route(logits, capacity=6)
+    assert dispatch.shape == (16, E, 6)
+    # each token occupies at most two slots (its two experts)
+    per_token = dispatch.sum(axis=(1, 2))
+    assert (per_token <= 2).all()
+    # ample capacity: every token gets both choices
+    assert (per_token == 2).all()
+    # no slot used twice
+    assert (dispatch.sum(axis=0) <= 1).all()
+    # gates renormalize: combine mass per fully-routed token sums to 1
+    np.testing.assert_allclose(
+        np.asarray(combine.sum(axis=(1, 2))), 1.0, rtol=1e-5
+    )
+    assert np.isfinite(float(aux))
+
+
+def test_top2_second_choice_preempted_first():
+    """GShard priority: top-1 assignments beat top-2 for scarce capacity."""
+    # every token's top-1 is expert 0 (huge logit), top-2 is expert 1
+    logits = jnp.zeros((6, E)).at[:, 0].set(10.0).at[:, 1].set(5.0)
+    dispatch, _, _ = top2_route(logits, capacity=4)
+    # expert 0 gets its 4 slots filled by top-1 choices
+    assert float(dispatch[:, 0].sum()) == 4.0
+    # expert 1 has room for all 6 second choices? capacity 4 -> only 4
+    assert float(dispatch[:, 1].sum()) == 4.0
+
+
+def test_moe_layer_top2_runs(devices):
+    mesh = build_mesh(MeshSpec(data=1, expert=4), devices[:4])
+    rng = jax.random.PRNGKey(0)
+    params = init_expert_params(init_one, E, rng, mesh)
+    moe = make_moe_layer(mesh, expert_fn, capacity_factor=2.0, router="top2")
+    tokens = jax.random.normal(rng, (32, D))
+    router_kernel = jax.random.normal(jax.random.PRNGKey(2), (D, E)) * 0.1
+    out, aux = moe(tokens, router_kernel, params)
+    assert out.shape == tokens.shape
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(float(aux))
 
 
 @pytest.mark.parametrize("expert_axis", [1, 4])

@@ -32,9 +32,50 @@ def parse_mesh(s: str | None):
     return MeshSpec(**kw)
 
 
+def apply_config_file(
+    p: argparse.ArgumentParser, args: argparse.Namespace, argv: list[str]
+):
+    """JSON config-tree support (SURVEY.md §5.6): file supplies defaults,
+    explicitly-passed CLI flags win (even when passed their default value),
+    and file values go through each flag's argparse type conversion."""
+    import json
+
+    # dests the user actually typed on the command line
+    explicit: set[str] = set()
+    for action in p._actions:
+        for opt in action.option_strings:
+            if any(a == opt or a.startswith(opt + "=") for a in argv):
+                explicit.add(action.dest)
+    by_dest = {a.dest: a for a in p._actions}
+
+    with open(args.config) as f:
+        cfg = json.load(f)
+    for k, v in cfg.items():
+        key = k.replace("-", "_")
+        action = by_dest.get(key)
+        if action is None:
+            raise SystemExit(f"config file key {k!r} is not a known flag")
+        if key in explicit:
+            continue  # CLI wins
+        if action.type is not None and v is not None:
+            try:
+                v = action.type(v)
+            except (TypeError, ValueError) as e:
+                raise SystemExit(
+                    f"config file key {k!r}: invalid value {v!r} ({e})"
+                )
+        elif isinstance(action.const, bool):  # store_true/false flags
+            v = bool(v)
+        setattr(args, key, v)
+    return args
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--workload", "--config", default="mnist_lenet")
+    p.add_argument("--config", default=None,
+                   help="a JSON file of flag defaults (CLI flags override), "
+                        "or a workload preset name (reference --config alias)")
+    p.add_argument("--workload", default="mnist_lenet")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=None,
                    help="global batch size (default: workload preset)")
@@ -64,6 +105,14 @@ def main() -> None:
     p.add_argument("--device", default=None,
                    help="reference-parity flag (tpu|cpu); default = auto")
     args = p.parse_args()
+    if args.config:
+        import os
+        import sys
+
+        if os.path.exists(args.config):
+            args = apply_config_file(p, args, sys.argv[1:])
+        else:  # reference semantics: --config <preset name>
+            args.workload = args.config
 
     logging.basicConfig(
         level=logging.INFO,
