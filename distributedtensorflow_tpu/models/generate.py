@@ -1,0 +1,119 @@
+"""Autoregressive generation for the GPT decoder LM (serving path).
+
+No serving/inference loop exists in the reference's training harness; this
+completes the decoder-LM story: KV-cache incremental decoding
+(``GPTLM(decode=True)`` — one-token steps against a static ``max_seq``
+cache), greedy or temperature/top-k sampling, ragged right-padded prompts.
+
+TPU-first: the whole generate loop is ONE ``lax.scan`` inside ``jit`` —
+static shapes (prompt buffer padded to ``prompt_pad + max_new_tokens``),
+the KV cache as scan carry, no host round-trips per token.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .gpt import GPTConfig, GPTLM
+
+
+def _sample(logits, rng, temperature, *, greedy: bool, top_k: int):
+    """(B, V) logits -> (B,) token ids.  ``temperature`` is traced (no
+    recompile per value); only greedy/top_k change the compiled program."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        topv, _ = jax.lax.top_k(logits, top_k)  # O(V log k), no full sort
+        kth = topv[:, -1][:, None]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "greedy", "top_k"),
+)
+def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
+                   cfg: GPTConfig, max_new_tokens: int, greedy: bool,
+                   top_k: int):
+    model = GPTLM(cfg, decode=True)
+    b, prompt_pad = prompt.shape
+    total = prompt_pad + max_new_tokens
+
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1
+    )
+
+    # First token primes the cache (flax creates the cache collection on a
+    # mutable apply); the scan then carries it functionally.
+    logits0, vars0 = model.apply(
+        {"params": params}, tokens[:, :1],
+        positions=jnp.zeros((b, 1), jnp.int32),
+        mutable=["cache"],
+    )
+    cache = vars0["cache"]
+
+    def step(carry, t):
+        tokens, cache, rng, logits = carry
+        rng, sub = jax.random.split(rng)
+        sampled = _sample(logits[:, -1], sub, temperature, greedy=greedy,
+                          top_k=top_k)
+        # While t+1 is still inside this sequence's prompt, feed the prompt
+        # token; afterwards feed the sample (teacher-forced prefill and
+        # decode in one uniform loop — no separate prefill program).
+        in_prompt = (t + 1) < prompt_lens  # (B,)
+        prompt_tok = jax.lax.dynamic_slice_in_dim(tokens, t + 1, 1, axis=1)[:, 0]
+        nxt = jnp.where(in_prompt, prompt_tok, sampled).astype(tokens.dtype)
+        tokens = jax.lax.dynamic_update_slice_in_dim(
+            tokens, nxt[:, None], t + 1, axis=1
+        )
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache}, nxt[:, None],
+            positions=jnp.full((b, 1), t + 1, jnp.int32),
+            mutable=["cache"],
+        )
+        return (tokens, vars_out["cache"], rng, logits), None
+
+    (tokens, _, _, _), _ = jax.lax.scan(
+        step, (tokens, cache, rng, logits0), jnp.arange(total - 1)
+    )
+    return tokens
+
+
+def generate(
+    params,
+    prompt: jax.Array,  # (B, P) right-padded token ids
+    *,
+    cfg: GPTConfig,
+    max_new_tokens: int,
+    prompt_lens: jax.Array | None = None,  # (B,) true lengths; default P
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Generate continuations; returns (B, P + max_new_tokens) token ids.
+
+    ``temperature=0`` is greedy; otherwise softmax sampling at the given
+    temperature, optionally truncated to the ``top_k`` highest logits.
+    The KV cache needs ``cfg.max_seq >= P + max_new_tokens``.
+    """
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if cfg.max_seq < total:
+        raise ValueError(
+            f"cfg.max_seq={cfg.max_seq} < prompt+new={total}; raise max_seq"
+        )
+    if prompt_lens is None:
+        prompt_lens = jnp.full((b,), p, jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_impl(
+        params, prompt.astype(jnp.int32), prompt_lens.astype(jnp.int32), rng,
+        jnp.asarray(temperature, jnp.float32),
+        cfg=cfg, max_new_tokens=max_new_tokens,
+        greedy=float(temperature) <= 0.0, top_k=int(top_k),
+    )

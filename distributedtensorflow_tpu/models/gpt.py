@@ -73,6 +73,7 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
     attn_fn: AttnFn | None = None  # None = dense causal (flash-capable)
+    decode: bool = False  # KV-cache incremental decoding (serving path)
 
     @nn.compact
     def __call__(self, x, positions, deterministic: bool):
@@ -88,7 +89,16 @@ class CausalSelfAttention(nn.Module):
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if self.attn_fn is not None:
+        if self.decode:
+            if self.attn_fn is not None:
+                raise ValueError(
+                    "decode=True uses dense cached attention; a custom "
+                    "attn_fn (e.g. sequence-parallel) is not supported in "
+                    "decode mode — shard the batch, not the sequence, when "
+                    "serving"
+                )
+            out = self._cached_attention(q, k, v)
+        elif self.attn_fn is not None:
             out = self.attn_fn(q, k, v)
         else:
             out = dot_product_attention(q, k, v, causal=True)
@@ -98,18 +108,59 @@ class CausalSelfAttention(nn.Module):
             cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="proj"
         )(out)
 
+    def _cached_attention(self, q, k, v):
+        """One-token decode step against the KV cache (static shapes: the
+        cache is ``max_seq`` long; future slots are masked out)."""
+        cfg = self.cfg
+        b, s_new, h, d = q.shape
+        cached_k = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, cfg.max_seq, h, d), k.dtype),
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, cfg.max_seq, h, d), v.dtype),
+        )
+        cache_ix = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        ix = cache_ix.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k, (0, ix, 0, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v, (0, ix, 0, 0)
+        )
+        cache_ix.value = ix + s_new
+        # Causal validity per query: query at absolute position ix+i sees
+        # keys at positions <= ix+i.  (Also correct for multi-token chunked
+        # prefill, not just one-token decode.)
+        q_pos = ix + jnp.arange(s_new)
+        k_idx = jnp.arange(cfg.max_seq)
+        valid = k_idx[None, :] <= q_pos[:, None]  # (s_new, max_seq)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+            cached_k.value.astype(jnp.float32),
+        ) / (d ** 0.5)
+        scores = jnp.where(valid[None, None, :, :], scores, -1e9)
+        weights = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", weights, cached_v.value.astype(jnp.float32)
+        ).astype(q.dtype)
+
 
 class GPTBlock(nn.Module):
     cfg: GPTConfig
     attn_fn: AttnFn | None = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions, deterministic: bool):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
-        x = x + CausalSelfAttention(cfg, self.attn_fn, name="attn")(
-            h, positions, deterministic
-        )
+        x = x + CausalSelfAttention(
+            cfg, self.attn_fn, self.decode, name="attn"
+        )(h, positions, deterministic)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
         # Column- then row-parallel MLP (Megatron split over `model`).
         m = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False,
@@ -123,23 +174,31 @@ class GPTBlock(nn.Module):
 
 
 class GPTLM(nn.Module):
-    """Decoder-only LM head over token ids; logits in float32."""
+    """Decoder-only LM head over token ids; logits in float32.
+
+    ``decode=True`` switches every attention to KV-cache incremental mode
+    (one-token steps against a ``max_seq`` cache in the "cache" variable
+    collection) — the serving path used by :func:`generate`.
+    """
 
     cfg: GPTConfig
     attn_fn: AttnFn | None = None
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True):
+    def __call__(self, input_ids, *, deterministic: bool = True,
+                 positions=None):
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_size,
             dtype=cfg.dtype, name="wte",
         )(input_ids)
-        positions = jnp.broadcast_to(
-            jnp.arange(input_ids.shape[1]), input_ids.shape
-        )
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1]), input_ids.shape
+            )
         block = GPTBlock
-        if cfg.remat:
+        if cfg.remat and not self.decode:
             # Remat each block: activations recomputed in backward — the
             # jax.checkpoint HBM/FLOPs trade for long sequences.  For
             # nn.remat over a Module class, static_argnums counts
@@ -147,7 +206,7 @@ class GPTLM(nn.Module):
             # (verified by tests/test_gpt.py::test_remat_path_trains).
             block = nn.remat(GPTBlock, static_argnums=(3,))
         for i in range(cfg.num_layers):
-            x = block(cfg, self.attn_fn, name=f"h{i}")(
+            x = block(cfg, self.attn_fn, self.decode, name=f"h{i}")(
                 x, positions, deterministic
             )
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
