@@ -30,6 +30,7 @@ from .widedeep import (  # noqa: F401
     WideDeep,
     WideDeepConfig,
     widedeep_layout,
+    widedeep_eval,
     widedeep_loss,
     widedeep_test_config,
 )

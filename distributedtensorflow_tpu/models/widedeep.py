@@ -102,3 +102,25 @@ def widedeep_loss(model: WideDeep):
         return loss, ({"accuracy": accuracy}, model_state)
 
     return loss_fn
+
+
+def widedeep_eval(model: WideDeep):
+    """Eval metrics: accuracy + mean log-loss on held-out batches."""
+    import optax
+
+    def eval_fn(params, model_state, batch):
+        del model_state
+        logits = model.apply(
+            {"params": params}, batch["categorical"], batch["dense"]
+        )
+        labels = batch["label"].astype(jnp.float32)
+        return {
+            "accuracy": jnp.mean(
+                ((logits > 0) == (labels > 0.5)).astype(jnp.float32)
+            ),
+            "log_loss": optax.sigmoid_binary_cross_entropy(
+                logits, labels
+            ).mean(),
+        }
+
+    return eval_fn

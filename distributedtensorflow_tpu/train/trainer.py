@@ -40,6 +40,13 @@ class TrainerConfig:
     # Hang watchdog (SURVEY.md §5.2): dump all thread stacks if no step
     # completes for this many seconds.  0 disables.
     watchdog_timeout: float = 0.0
+    # Accuracy gate (BASELINE.json "top-1 parity" pattern): stop as soon as
+    # eval metric `target_metric` reaches `target_value` (``target_mode``
+    # "max": metric >= value; "min": metric <= value, for losses).
+    # Needs eval_every > 0 and an eval_fn.
+    target_metric: str | None = None
+    target_value: float | None = None
+    target_mode: str = "max"
 
 
 class Trainer:
@@ -83,7 +90,9 @@ class Trainer:
             if close is not None:
                 close()
         if self.checkpointer is not None:
-            self.checkpointer.save(cfg.total_steps, state, force=True)
+            # Label with the step actually reached (an accuracy-gate early
+            # stop must not save under the total_steps slot).
+            self.checkpointer.save(int(state.step), state, force=True)
             self.checkpointer.wait()
         return state
 
@@ -135,6 +144,10 @@ class Trainer:
                     logger.info("eval @ %d: %s", step_i + 1, _fmt(eval_metrics))
                     if watchdog is not None:  # a long eval is progress
                         watchdog.ping()
+                    if cfg.target_metric and self._target_reached(
+                        eval_metrics, step_i + 1
+                    ):
+                        return state
                 if (
                     cfg.checkpoint_every
                     and self.checkpointer is not None
@@ -153,6 +166,31 @@ class Trainer:
                 cfg.total_steps, profile_at,
             )
         return state
+
+    def _target_reached(self, eval_metrics: dict, step: int) -> bool:
+        cfg = self.config
+        if cfg.target_metric not in eval_metrics:
+            logger.warning(
+                "target metric %r not in eval metrics %s; gate cannot fire",
+                cfg.target_metric, sorted(eval_metrics),
+            )
+            return False
+        value = eval_metrics[cfg.target_metric]
+        if cfg.target_value is None:
+            raise ValueError("target_metric set but target_value is None")
+        hit = (
+            value <= cfg.target_value
+            if cfg.target_mode == "min"
+            else value >= cfg.target_value
+        )
+        if hit:
+            logger.info(
+                "target reached: %s=%.4f %s %.4f at step %d; stopping",
+                cfg.target_metric, value,
+                "<=" if cfg.target_mode == "min" else ">=",
+                cfg.target_value, step,
+            )
+        return hit
 
     def evaluate(self, state: TrainState, eval_iter: Iterable[PyTree]) -> dict:
         sums: dict[str, float] = {}

@@ -35,6 +35,7 @@ from .models import (
     bert_tiny,
     mlm_loss,
     widedeep_layout,
+    widedeep_eval,
     widedeep_loss,
     widedeep_test_config,
 )
@@ -204,7 +205,7 @@ def get_workload(name: str, *, test_size: bool = False,
         return Workload(
             name=name, model=model,
             loss_fn=widedeep_loss(model),
-            eval_fn=None,
+            eval_fn=widedeep_eval(model),
             make_optimizer=lambda: optax.adagrad(0.01),
             input_fn=lambda ctx, seed: synthetic_recsys(ctx, cfg, seed),
             init_batch={

@@ -85,6 +85,12 @@ def main() -> None:
     p.add_argument("--accum-steps", type=int, default=None)
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--eval-every", type=int, default=0)
+    p.add_argument("--target-metric", default=None,
+                   help="stop when this eval metric reaches --target-value "
+                        "(the reference's accuracy-parity gate)")
+    p.add_argument("--target-value", type=float, default=None)
+    p.add_argument("--target-mode", choices=("max", "min"), default="max",
+                   help="'max': stop when metric >= value; 'min': <= (losses)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--logdir", default=None)
@@ -161,6 +167,16 @@ def main() -> None:
     eval_step = (
         make_eval_step(wl.eval_fn, mesh, specs) if wl.eval_fn else None
     )
+    if args.target_metric:  # the gate must be able to fire (fail at setup)
+        if args.target_value is None:
+            raise SystemExit("--target-metric requires --target-value")
+        if not args.eval_every:
+            raise SystemExit("--target-metric requires --eval-every > 0")
+        if eval_step is None:
+            raise SystemExit(
+                f"workload {wl.name!r} has no eval_fn; --target-metric "
+                "cannot fire"
+            )
 
     ctx = current_input_context(wl.global_batch_size)
     train_iter = Prefetcher(wl.input_fn(ctx, args.seed), mesh)
@@ -185,6 +201,9 @@ def main() -> None:
             profile_start=args.profile_start,
             profile_steps=args.profile_steps,
             watchdog_timeout=args.watchdog_timeout,
+            target_metric=args.target_metric,
+            target_value=args.target_value,
+            target_mode=args.target_mode,
         ),
         eval_step=eval_step,
         checkpointer=checkpointer,
