@@ -16,57 +16,20 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
-import sys
 import time
 
-#: The axon TPU tunnel can go unresponsive; the hang sits inside a C call
-#: holding the GIL, so no in-process timeout (signal/thread) can fire.
-#: Probe device contact in a SUBPROCESS first and fail fast if it wedges.
-DEVICE_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "180"))
+from bench_probe import probe_devices_or_die
 
-if os.environ.get("BENCH_SKIP_PROBE") != "1":
-    # Popen + bounded waits, NOT subprocess.run: run()'s timeout path blocks
-    # in communicate() after kill(), which never returns if the child is in
-    # uninterruptible sleep on the wedged device — the exact failure mode
-    # this probe exists to catch.  Here we give up on an unkillable child.
-    import tempfile
-
-    # stderr to a temp FILE, not a pipe: nobody drains a pipe while the
-    # parent blocks in wait(), so a verbose fast-failing child would fill
-    # the pipe buffer and masquerade as a hang.
-    with tempfile.TemporaryFile() as _errf:
-        _probe = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL,
-            stderr=_errf,
-        )
-        try:
-            _rc = _probe.wait(timeout=DEVICE_PROBE_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            _probe.kill()
-            try:
-                _probe.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass  # child stuck in D-state; abandon it
-            print(
-                f"bench: jax device probe unresponsive after "
-                f"{DEVICE_PROBE_TIMEOUT_S}s (TPU tunnel down?)",
-                file=sys.stderr,
-            )
-            raise SystemExit(2)
-        if _rc != 0:
-            _errf.seek(0)
-            print(
-                f"bench: jax device probe failed:\n"
-                f"{_errf.read().decode(errors='replace')}",
-                file=sys.stderr,
-            )
-            raise SystemExit(2)
+probe_devices_or_die("bench")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+
+# The axon sitecustomize force-selects the TPU platform over JAX_PLATFORMS;
+# BENCH_PLATFORM=cpu re-forces it (CPU smoke runs).
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
 A100_IMAGES_PER_SEC = 2500.0  # per-GPU anchor (see module docstring)
 

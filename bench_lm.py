@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Secondary benchmark: GPT decoder-LM training tokens/sec/chip.
+
+Not the driver's headline metric (that is bench.py's ResNet-50
+images/sec/chip) — this measures the long-context/LM path: a GPT-small
+train step (remat on, bf16, fused QKV) on synthetic data.  Prints one JSON
+line in the same shape as bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from bench_probe import probe_devices_or_die
+
+probe_devices_or_die("bench_lm")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+# The axon sitecustomize force-selects the TPU platform over JAX_PLATFORMS;
+# BENCH_PLATFORM=cpu re-forces it (CPU smoke runs).
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+
+def main() -> None:
+    from distributedtensorflow_tpu.data import InputContext, device_put_batch
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    n_chips = mesh.size
+    test_size = os.environ.get("BENCH_LM_TEST") == "1"  # CPU smoke mode
+    seq = 128 if test_size else 1024
+    per_chip_batch = 2 if test_size else 8
+    wl = get_workload(
+        "gpt_lm", test_size=test_size,
+        global_batch_size=per_chip_batch * n_chips,
+    )
+    wl = wl.for_mesh(mesh)
+
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    ids = np.random.default_rng(0).integers(
+        0, wl.model.cfg.vocab_size, size=(wl.global_batch_size, seq)
+    ).astype(np.int32)
+    batch = device_put_batch({"input_ids": ids}, mesh)
+
+    for _ in range(3):  # warmup/compile
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])  # force execution (axon: block_until_ready no-op)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = n_steps * wl.global_batch_size * seq / dt
+    per_chip = tokens_per_sec / n_chips
+    # Anchor: an A100 trains GPT-2-small (~124M params) at roughly 150k
+    # tokens/sec with remat off; used as the vs_baseline denominator.
+    print(json.dumps({
+        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(per_chip / 150_000.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Shared device-probe for the bench scripts (import BEFORE jax).
+
+The axon TPU tunnel can wedge inside a C call holding the GIL, making both
+``import jax`` and ``jax.devices()`` unkillable from within the process —
+so the probe runs in a SUBPROCESS with bounded waits and gives up on an
+unkillable (D-state) child.  Knobs:
+
+- ``BENCH_SKIP_PROBE=1`` — skip entirely.
+- ``BENCH_DEVICE_TIMEOUT_S`` — probe timeout (default 180).
+- ``BENCH_PLATFORM`` — platform to probe and run on (e.g. ``cpu``); the
+  probe child re-forces it via jax.config because the axon sitecustomize
+  overrides the ``JAX_PLATFORMS`` env var.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+
+def probe_devices_or_die(name: str = "bench") -> None:
+    """Exit(2) with a diagnostic if first device contact hangs or fails."""
+    if os.environ.get("BENCH_SKIP_PROBE") == "1":
+        return
+    timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "180"))
+    platform = os.environ.get("BENCH_PLATFORM")
+    force = (
+        f"import jax; jax.config.update('jax_platforms', {platform!r}); "
+        if platform
+        else "import jax; "
+    )
+    with tempfile.TemporaryFile() as errf:
+        probe = subprocess.Popen(
+            [sys.executable, "-c", force + "jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=errf,
+        )
+        try:
+            rc = probe.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            probe.kill()
+            try:
+                probe.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # child stuck in D-state; abandon it
+            print(
+                f"{name}: jax device probe unresponsive after {timeout_s}s "
+                "(TPU tunnel down?)",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        if rc != 0:
+            errf.seek(0)
+            print(
+                f"{name}: jax device probe failed:\n"
+                f"{errf.read().decode(errors='replace')}",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)

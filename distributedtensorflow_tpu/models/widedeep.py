@@ -88,17 +88,25 @@ def widedeep_layout() -> LayoutMap:
     ])
 
 
-def widedeep_loss(model: WideDeep):
-    """Sigmoid cross-entropy LossFn for batches {categorical, dense, label}."""
+def _forward_metrics(model: WideDeep, params, batch):
+    """Shared forward + metric math so train 'accuracy' and eval 'accuracy'
+    can never drift (the --target-metric gate stops on these)."""
     import optax
 
+    logits = model.apply(
+        {"params": params}, batch["categorical"], batch["dense"]
+    )
+    labels = batch["label"].astype(jnp.float32)
+    loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+    accuracy = jnp.mean(((logits > 0) == (labels > 0.5)).astype(jnp.float32))
+    return loss, accuracy
+
+
+def widedeep_loss(model: WideDeep):
+    """Sigmoid cross-entropy LossFn for batches {categorical, dense, label}."""
+
     def loss_fn(params, model_state, batch, rng):
-        logits = model.apply(
-            {"params": params}, batch["categorical"], batch["dense"]
-        )
-        labels = batch["label"].astype(jnp.float32)
-        loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
-        accuracy = jnp.mean(((logits > 0) == (labels > 0.5)).astype(jnp.float32))
+        loss, accuracy = _forward_metrics(model, params, batch)
         return loss, ({"accuracy": accuracy}, model_state)
 
     return loss_fn
@@ -106,21 +114,10 @@ def widedeep_loss(model: WideDeep):
 
 def widedeep_eval(model: WideDeep):
     """Eval metrics: accuracy + mean log-loss on held-out batches."""
-    import optax
 
     def eval_fn(params, model_state, batch):
         del model_state
-        logits = model.apply(
-            {"params": params}, batch["categorical"], batch["dense"]
-        )
-        labels = batch["label"].astype(jnp.float32)
-        return {
-            "accuracy": jnp.mean(
-                ((logits > 0) == (labels > 0.5)).astype(jnp.float32)
-            ),
-            "log_loss": optax.sigmoid_binary_cross_entropy(
-                logits, labels
-            ).mean(),
-        }
+        loss, accuracy = _forward_metrics(model, params, batch)
+        return {"accuracy": accuracy, "log_loss": loss}
 
     return eval_fn

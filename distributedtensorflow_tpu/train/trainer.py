@@ -48,6 +48,19 @@ class TrainerConfig:
     target_value: float | None = None
     target_mode: str = "max"
 
+    def __post_init__(self):
+        # Fail a dead-on-arrival gate at setup, not after the first eval.
+        if self.target_metric:
+            if self.target_value is None:
+                raise ValueError("target_metric set but target_value is None")
+            if not self.eval_every:
+                raise ValueError(
+                    "target_metric set but eval_every is 0 — the gate can "
+                    "never fire"
+                )
+        if self.target_mode not in ("max", "min"):
+            raise ValueError(f"target_mode must be max|min, got {self.target_mode!r}")
+
 
 class Trainer:
     def __init__(
@@ -176,8 +189,6 @@ class Trainer:
             )
             return False
         value = eval_metrics[cfg.target_metric]
-        if cfg.target_value is None:
-            raise ValueError("target_metric set but target_value is None")
         hit = (
             value <= cfg.target_value
             if cfg.target_mode == "min"

@@ -1,0 +1,194 @@
+// Native-layer self-test binary: exercises record IO and the TCP ring
+// collectives in one process (one thread per rank over localhost), so the
+// whole thing runs under ThreadSanitizer / AddressSanitizer:
+//
+//   make -C native test        # plain build + run
+//   make -C native tsan        # ThreadSanitizer build + run
+//   make -C native asan        # AddressSanitizer build + run
+//
+// This is the CI sanitizer job the reference stack runs upstream for its
+// C++ collectives (SURVEY.md §5.2 build equivalent).
+
+#include <unistd.h>
+
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "crc32c.h"
+
+extern "C" {
+void* dtf_writer_open(const char* path);
+int dtf_writer_write(void* w, const void* data, uint64_t len);
+void dtf_writer_close(void* w);
+void* dtf_reader_open(const char** paths, int n_files, int num_threads,
+                      int shuffle_buffer, uint64_t seed, int verify_crc);
+int64_t dtf_reader_next(void* r, uint8_t** out);
+void dtf_reader_close(void* r);
+void dtf_free(void* p);
+void* dtf_comm_create(int rank, int world, const char** peer_addrs,
+                      int timeout_ms);
+void dtf_comm_destroy(void* h);
+int dtf_comm_allreduce(void* h, void* data, uint64_t n_elems, int dtype,
+                       int op);
+int dtf_comm_allgather(void* h, const void* data, uint64_t n, void* out);
+int dtf_comm_broadcast(void* h, void* data, uint64_t n, int root);
+int dtf_comm_barrier(void* h);
+}
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__, __LINE__, \
+              #cond);                                                     \
+      exit(1);                                                            \
+    }                                                                     \
+  } while (0)
+
+static void test_crc32c() {
+  // RFC 3720 vector.
+  CHECK(dtf::crc32c(0, "123456789", 9) == 0xE3069283u);
+  uint32_t m = dtf::crc32c_mask(0xE3069283u);
+  CHECK(dtf::crc32c_unmask(m) == 0xE3069283u);
+  printf("crc32c: OK\n");
+}
+
+static void test_recordio() {
+  char tmpl[] = "/tmp/dtf_native_test_XXXXXX";
+  CHECK(mkdtemp(tmpl) != nullptr);
+  std::vector<std::string> paths;
+  const int kFiles = 3, kRecords = 200;
+  for (int f = 0; f < kFiles; ++f) {
+    paths.push_back(std::string(tmpl) + "/shard" + std::to_string(f));
+    void* w = dtf_writer_open(paths.back().c_str());
+    CHECK(w != nullptr);
+    for (int i = 0; i < kRecords; ++i) {
+      std::string rec =
+          "file" + std::to_string(f) + ":" + std::to_string(i) +
+          std::string(static_cast<size_t>(i % 17), 'x');
+      CHECK(dtf_writer_write(w, rec.data(), rec.size()) == 0);
+    }
+    dtf_writer_close(w);
+  }
+  std::vector<const char*> cpaths;
+  for (auto& p : paths) cpaths.push_back(p.c_str());
+  // Threaded + shuffled read: the TSAN-interesting configuration.
+  void* r = dtf_reader_open(cpaths.data(), kFiles, kFiles, 64, 42, 1);
+  CHECK(r != nullptr);
+  int count = 0;
+  for (;;) {
+    uint8_t* data = nullptr;
+    int64_t n = dtf_reader_next(r, &data);
+    if (n < 0) {
+      CHECK(n == -1);  // clean EOF, no corruption
+      break;
+    }
+    ++count;
+    dtf_free(data);
+  }
+  dtf_reader_close(r);
+  CHECK(count == kFiles * kRecords);
+  // Early close with records still queued (join/cleanup path under TSAN).
+  void* r2 = dtf_reader_open(cpaths.data(), kFiles, kFiles, 0, 0, 1);
+  uint8_t* data = nullptr;
+  CHECK(dtf_reader_next(r2, &data) > 0);
+  dtf_free(data);
+  dtf_reader_close(r2);
+  printf("recordio: OK (%d records, threaded+shuffled)\n", count);
+}
+
+static void ring_rank(int rank, int world, const std::vector<std::string>& peers,
+                      int* status) {
+  std::vector<const char*> cpeers;
+  for (auto& p : peers) cpeers.push_back(p.c_str());
+  void* c = dtf_comm_create(rank, world, cpeers.data(), 20000);
+  if (!c) {
+    *status = 1;
+    return;
+  }
+  *status = 2;
+  // float32 sum all-reduce, odd size
+  std::vector<float> x(1001);
+  for (size_t i = 0; i < x.size(); ++i)
+    x[i] = static_cast<float>(rank + 1) + static_cast<float>(i % 7);
+  if (dtf_comm_allreduce(c, x.data(), x.size(), /*f32*/ 0, /*sum*/ 0) != 0) {
+    *status = 3;
+    dtf_comm_destroy(c);
+    return;
+  }
+  for (size_t i = 0; i < x.size(); ++i) {
+    float expect = static_cast<float>(world * (world + 1)) / 2.0f +
+                   static_cast<float>(world) * static_cast<float>(i % 7);
+    if (std::fabs(x[i] - expect) > 1e-3f) {
+      *status = 4;
+      dtf_comm_destroy(c);
+      return;
+    }
+  }
+  // all-gather
+  int64_t mine = rank * 10;
+  std::vector<int64_t> all(static_cast<size_t>(world));
+  if (dtf_comm_allgather(c, &mine, sizeof(mine), all.data()) != 0) {
+    *status = 5;
+    dtf_comm_destroy(c);
+    return;
+  }
+  for (int rkt = 0; rkt < world; ++rkt) {
+    if (all[static_cast<size_t>(rkt)] != rkt * 10) {
+      *status = 6;
+      dtf_comm_destroy(c);
+      return;
+    }
+  }
+  // broadcast from rank 1
+  double b = rank == 1 ? 3.25 : 0.0;
+  if (dtf_comm_broadcast(c, &b, sizeof(b), 1) != 0 || b != 3.25) {
+    *status = 7;
+    dtf_comm_destroy(c);
+    return;
+  }
+  if (dtf_comm_barrier(c) != 0) {
+    *status = 8;
+    dtf_comm_destroy(c);
+    return;
+  }
+  dtf_comm_destroy(c);
+  *status = 0;
+}
+
+static void test_ringcomm() {
+  const int world = 4;
+  // Stride by world so nearby-pid concurrent runs (pytest + make tsan in
+  // parallel CI) can't overlap port ranges.
+  const int base = 21000 + (getpid() % 400) * world;
+  std::vector<std::string> peers;
+  for (int i = 0; i < world; ++i)
+    peers.push_back("127.0.0.1:" + std::to_string(base + i));
+  std::vector<int> status(world, -1);
+  std::vector<std::thread> threads;
+  for (int r = 0; r < world; ++r)
+    threads.emplace_back(ring_rank, r, world, std::cref(peers), &status[r]);
+  for (auto& t : threads) t.join();
+  for (int r = 0; r < world; ++r) {
+    if (status[r] != 0) {
+      fprintf(stderr, "rank %d failed with status %d\n", r, status[r]);
+      exit(1);
+    }
+  }
+  printf("ringcomm: OK (world=%d allreduce/allgather/broadcast/barrier)\n",
+         world);
+}
+
+int main() {
+  test_crc32c();
+  test_recordio();
+  test_ringcomm();
+  printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}

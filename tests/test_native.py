@@ -126,6 +126,23 @@ def test_tfrecord_interop_both_directions(tmp_path):
     assert list(RecordReader([theirs])) == [b"gamma"]
 
 
+# --- native self-test binary (the sanitizer vehicle) ------------------------
+
+
+def test_native_selftest_binary():
+    """Build and run the pure-C++ self-test (the `make tsan`/`asan` vehicle,
+    SURVEY.md §5.2) in its plain configuration."""
+    import subprocess
+    from distributedtensorflow_tpu.native.lib import _NATIVE_DIR
+
+    r = subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR), "test"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL NATIVE TESTS PASSED" in r.stdout
+
+
 # --- host ring collectives --------------------------------------------------
 
 
