@@ -49,26 +49,49 @@ class CheckpointManager:
         max_to_keep: int = 3,
         async_save: bool = True,
         save_interval_steps: int = 1,
+        best_metric: str | None = None,
+        best_mode: str = "max",
     ):
+        """``best_metric`` switches retention from keep-latest to keep-best:
+        rotation keeps the ``max_to_keep`` checkpoints with the best value
+        of that metric (pass metrics to :meth:`save`), ``best_mode``
+        "max"/"min" — the keep-best policy of the reference's
+        CheckpointManager idiom."""
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
                 save_interval_steps=save_interval_steps,
+                best_fn=(
+                    (lambda m: float(m[best_metric])) if best_metric else None
+                ),
+                best_mode=best_mode,
                 create=True,
             ),
         )
+        self._best_metric = best_metric
 
-    def save(self, step: int, state: TrainState, *, force: bool = False) -> bool:
+    def save(self, step: int, state: TrainState, *, force: bool = False,
+             metrics: dict | None = None) -> bool:
         if step in self._mgr.all_steps():
             return False  # already saved (e.g. periodic save + final save)
+        if self._best_metric and not (metrics and self._best_metric in metrics):
+            raise ValueError(
+                f"best_metric={self._best_metric!r} retention needs "
+                f"metrics[{self._best_metric!r}] passed to save()"
+            )
         saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(_as_tree(state)), force=force
+            step, args=ocp.args.StandardSave(_as_tree(state)), force=force,
+            metrics={k: float(v) for k, v in metrics.items()} if metrics else None,
         )
         if saved:
             logger.info("checkpoint saved at step %d", step)
         return saved
+
+    def best_step(self) -> int | None:
+        """Step of the best checkpoint under the best_metric policy."""
+        return self._mgr.best_step()
 
     def restore_latest(self, target: TrainState) -> TrainState | None:
         """Restore the newest checkpoint into ``target``'s shardings.

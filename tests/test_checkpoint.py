@@ -87,6 +87,29 @@ def test_rotation(tmp_path, dp_mesh):
     mgr.close()
 
 
+def test_keep_best_retention(tmp_path, dp_mesh):
+    """best_metric retention keeps the best-K checkpoints, not the latest."""
+    from distributedtensorflow_tpu.checkpoint import CheckpointManager
+
+    state, _ = _make_state(dp_mesh)
+    mgr = CheckpointManager(
+        str(tmp_path / "best"), max_to_keep=2, async_save=False,
+        best_metric="accuracy", best_mode="max",
+    )
+    scores = {10: 0.2, 20: 0.9, 30: 0.5, 40: 0.7}
+    for step, acc in scores.items():
+        mgr.save(step, state.replace(step=step), metrics={"accuracy": acc})
+    mgr.wait()
+    kept = set(mgr.all_steps())
+    assert kept == {20, 40}, kept  # two best accuracies, not two latest
+    assert mgr.best_step() == 20
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="best_metric"):
+        mgr.save(50, state.replace(step=50))  # metrics required
+    mgr.close()
+
+
 def test_preemption_handler_trigger_and_save(tmp_path, dp_mesh):
     _, state, _ = make_state(dp_mesh)
     mgr = CheckpointManager(str(tmp_path / "pre"), async_save=False)
