@@ -71,6 +71,16 @@ class CheckpointManager:
             ),
         )
         self._best_metric = best_metric
+        self._best_mode = best_mode
+
+    @property
+    def best_metric(self) -> str | None:
+        """Metric name driving keep-best retention (None = keep-latest)."""
+        return self._best_metric
+
+    @property
+    def best_mode(self) -> str:
+        return self._best_mode
 
     def save(self, step: int, state: TrainState, *, force: bool = False,
              metrics: dict | None = None) -> bool:

@@ -216,10 +216,11 @@ class WorkerServer:
         self._dispatcher = dispatcher
         self._input_fn = input_fn
         self._lock = threading.Lock()  # guards _iters/_epoch_locks/shard_index
-        # epoch -> (iterator, per-epoch lock).  Per-epoch locking: requests
-        # for different epochs (or the iterator-creation fast path) don't
-        # serialize the whole worker behind one long next(it).
-        self._iters: dict[str, tuple[Iterator[Batch], threading.Lock]] = {}
+        # epoch -> (iterator, per-epoch lock, num_shards it was built for).
+        # Per-epoch locking: requests for different epochs (or the
+        # iterator-creation fast path) don't serialize the whole worker
+        # behind one long next(it).
+        self._iters: dict[str, tuple[Iterator[Batch], threading.Lock, int]] = {}
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -298,20 +299,42 @@ class WorkerServer:
         if req.get("kind") != "get_next":
             return {"ok": False, "error": "unknown rpc"}, None
         epoch = str(req.get("epoch", 0))
+        num_shards = int(req.get("num_shards") or self._pool_size_hint or 1)
         with self._lock:
+            # A worker evicted by heartbeat timeout that re-registered may
+            # hold a shard index outside the client's num_shards snapshot
+            # (the pool grew past it); serving input_fn(shard, num_shards)
+            # then would overlap another worker's slice and break the
+            # exactly-once epoch guarantee.  Refuse instead.
+            if self.shard_index >= num_shards:
+                return {
+                    "ok": False,
+                    "error": (
+                        f"shard {self.shard_index} >= num_shards "
+                        f"{num_shards}: worker pool changed since the "
+                        "client snapshotted it"
+                    ),
+                }, None
             entry = self._iters.get(epoch)
             if entry is None:
-                num_shards = int(
-                    req.get("num_shards")
-                    or self._pool_size_hint
-                    or 1
-                )
                 entry = (
                     self._input_fn(self.shard_index, num_shards),
                     threading.Lock(),
+                    num_shards,
                 )
                 self._iters[epoch] = entry
-        it, epoch_lock = entry
+            elif entry[2] != num_shards:
+                # Cached iterator was built for a different pool snapshot;
+                # its slice doesn't partition cleanly under this client's
+                # num_shards.
+                return {
+                    "ok": False,
+                    "error": (
+                        f"epoch {epoch} iterator built with num_shards="
+                        f"{entry[2]}, request has {num_shards}"
+                    ),
+                }, None
+        it, epoch_lock, _ = entry
         with epoch_lock:  # iterators aren't thread-safe; serialize per epoch
             try:
                 batch = next(it)
@@ -401,6 +424,18 @@ class DataServiceClient:
                         f"data worker {addr} died mid-epoch"
                     ) from e
                 logger.warning("dropping dead data worker %s", addr)
+                self._live.remove(addr)
+                continue
+            if not header.get("ok"):
+                # Worker refused (shard/pool mismatch after membership
+                # change) — its data can't be served consistently this epoch.
+                if not self._ignore_errors:
+                    raise RuntimeError(
+                        f"data worker {addr}: {header.get('error')}"
+                    )
+                logger.warning(
+                    "dropping data worker %s: %s", addr, header.get("error")
+                )
                 self._live.remove(addr)
                 continue
             if header.get("eof"):

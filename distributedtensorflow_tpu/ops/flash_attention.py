@@ -11,10 +11,12 @@ per (batch, head) — at BERT/long-context head dims (64..128) a full K/V head
 fits VMEM comfortably up to ~8k tokens, which is also the per-device shard
 regime ring attention (``parallel/ring_attention.py``) operates in.
 
-Backward: blockwise recompute in XLA (lax.scan over q-blocks, memory-bounded
-— never materializes (S, S)); standard flash-attention gradient math from
-the saved LSE.  A Pallas backward kernel is a later optimization; the
-contraction-heavy steps here already land on the MXU.
+Backward: two Pallas kernels (the standard TPU flash-attention split) —
+a dq kernel sweeping k-blocks innermost and a dk/dv kernel sweeping
+q-blocks innermost, both recomputing the p-tile in VMEM from the saved
+LSE so no (S, S) score tile ever reaches HBM.  An XLA blockwise-recompute
+fallback (`_flash_backward_xla`) is kept as the golden reference; select
+with ``BACKWARD_IMPL``.
 
 Layout: BSHD (batch, seq, heads, head_dim) to match ``ops.attention``.
 """
@@ -229,10 +231,286 @@ def _flash_forward(q, k, v, mask, *, causal, interpret):
     return o.transpose(0, 2, 1, 3), lse[:, :, 0, :]
 
 
-# --- Backward (blockwise XLA recompute from LSE) ----------------------------
+# --- Backward: Pallas kernels (dq sweep + dkv sweep) ------------------------
+
+#: "pallas" (default) or "xla" — the XLA blockwise recompute kept as the
+#: golden reference for A/B numerics and as an escape hatch.  Read at TRACE
+#: time: a function jitted before flipping this keeps its compiled backward
+#: (jit caching) — for a reliable A/B pass ``backward_impl=`` to
+#: :func:`flash_attention` and re-jit instead of mutating mid-run.
+BACKWARD_IMPL = "pallas"
 
 
-def _flash_backward(res, g, *, causal):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, block_q, block_k, causal,
+                   have_mask, mask_ref=None):
+    """dq for one q-block, accumulated over the k sweep (k innermost).
+
+    Recomputes the p-tile from the saved LSE:
+      p  = exp(q k^T * scale - lse)
+      ds = p * (g v^T - delta) * scale
+      dq = sum_k ds @ k
+    """
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:, :] = jnp.zeros_like(dq_scr)
+
+    run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        gq = g_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if have_mask:
+            keep = mask_ref[0, 0, :]  # (block_k,)
+            s = jnp.where(keep[None, :], s, NEG_INF)
+        lse = lse_ref[0, 0, 0, :]  # (block_q,)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            gq, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        delta = delta_ref[0, 0, 0, :]  # (block_q,)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:, :] = dq_scr[:, :] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[:, :].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q,
+                    block_k, causal, have_mask, mask_ref=None):
+    """dk/dv for one k-block, accumulated over the q sweep (q innermost).
+
+      dv = sum_q p^T @ g
+      dk = sum_q ds^T @ q
+    """
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:, :] = jnp.zeros_like(dk_scr)
+        dv_scr[:, :] = jnp.zeros_like(dv_scr)
+
+    # A q-block strictly above the causal diagonal (all q < all k) never
+    # attends to this k-block.
+    run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        gq = g_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if have_mask:
+            keep = mask_ref[0, 0, :]  # (block_k,)
+            s = jnp.where(keep[None, :], s, NEG_INF)
+        lse = lse_ref[0, 0, 0, :]  # (block_q,)
+        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
+        dv_scr[:, :] = dv_scr[:, :] + jax.lax.dot_general(
+            p.astype(gq.dtype), gq, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, D)
+        dp = jax.lax.dot_general(
+            gq, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0, 0, :]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:, :] = dk_scr[:, :] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, D)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(res, g, *, causal, interpret):
+    q, k, v, mask, o, lse = res
+    # delta = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it.
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", g.astype(jnp.float32), o.astype(jnp.float32)
+    )
+    return _flash_backward_pallas_core(
+        q, k, v, mask, g, lse, delta, causal=causal, interpret=interpret
+    )
+
+
+def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *, causal,
+                                interpret):
+    """dq/dk/dv kernels from externally-supplied LSE and delta rows.
+
+    Split out so ring attention (``parallel/ring_attention.py``) can drive
+    the same kernels per K/V chunk with the *global* (cross-chunk) LSE.
+    ``lse``/``delta`` are (B, H, S) fp32.
+    """
+    batch, seq, heads, depth = q.shape
+    block_q = _pick_block_q(seq)
+    block_k = _pick_block_k(seq)
+    scale = 1.0 / (depth ** 0.5)
+    mem = pl.ANY if interpret else pltpu.VMEM
+
+    # (B, H, 1, S) keeps kernel blocks' trailing dims tile-legal like lse.
+    delta = delta[:, :, None, :]
+    lse4 = lse[:, :, None, :]  # (B, H, 1, S)
+
+    qt, kt, vt, gt = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
+
+    have_mask = mask is not None
+    mask3 = mask.reshape(batch, 1, seq) if have_mask else None
+
+    # --- dq kernel: grid (B, H, n_q, n_k), k innermost ---
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, block_q, depth), lambda b, h, i, j: (b, h, i, 0),
+                     memory_space=mem),  # q
+        pl.BlockSpec((1, 1, block_k, depth), lambda b, h, i, j: (b, h, j, 0),
+                     memory_space=mem),  # k
+        pl.BlockSpec((1, 1, block_k, depth), lambda b, h, i, j: (b, h, j, 0),
+                     memory_space=mem),  # v
+        pl.BlockSpec((1, 1, block_q, depth), lambda b, h, i, j: (b, h, i, 0),
+                     memory_space=mem),  # g
+        pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i),
+                     memory_space=mem),  # lse
+        pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i),
+                     memory_space=mem),  # delta
+    ]
+    dq_args = [qt, kt, vt, gt, lse4, delta]
+    if have_mask:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j),
+                         memory_space=mem)
+        )
+        dq_args.append(mask3)
+
+    common = dict(scale=scale, block_q=block_q, block_k=block_k,
+                  causal=causal)
+    if have_mask:
+        def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      mask_ref, dq_ref, dq_scr):
+            _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                           dq_ref, dq_scr, have_mask=True,
+                           mask_ref=mask_ref, **common)
+    else:
+        def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr):
+            _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                           dq_ref, dq_scr, have_mask=False, **common)
+
+    dqt = pl.pallas_call(
+        dq_kernel,
+        grid=(batch, heads, seq // block_q, seq // block_k),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, depth),
+                               lambda b, h, i, j: (b, h, i, 0),
+                               memory_space=mem),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, depth), jnp.float32)],
+        interpret=interpret,
+    )(*dq_args)
+
+    # --- dk/dv kernel: grid (B, H, n_k, n_q), q innermost ---
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, depth), lambda b, h, j, i: (b, h, i, 0),
+                     memory_space=mem),  # q
+        pl.BlockSpec((1, 1, block_k, depth), lambda b, h, j, i: (b, h, j, 0),
+                     memory_space=mem),  # k
+        pl.BlockSpec((1, 1, block_k, depth), lambda b, h, j, i: (b, h, j, 0),
+                     memory_space=mem),  # v
+        pl.BlockSpec((1, 1, block_q, depth), lambda b, h, j, i: (b, h, i, 0),
+                     memory_space=mem),  # g
+        pl.BlockSpec((1, 1, 1, block_q), lambda b, h, j, i: (b, h, 0, i),
+                     memory_space=mem),  # lse
+        pl.BlockSpec((1, 1, 1, block_q), lambda b, h, j, i: (b, h, 0, i),
+                     memory_space=mem),  # delta
+    ]
+    dkv_args = [qt, kt, vt, gt, lse4, delta]
+    if have_mask:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, h, j, i: (b, 0, j),
+                         memory_space=mem)
+        )
+        dkv_args.append(mask3)
+
+    if have_mask:
+        def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                       mask_ref, dk_ref, dv_ref, dk_scr, dv_scr):
+            _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                            dk_ref, dv_ref, dk_scr, dv_scr, have_mask=True,
+                            mask_ref=mask_ref, **common)
+    else:
+        def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr):
+            _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                            dk_ref, dv_ref, dk_scr, dv_scr, have_mask=False,
+                            **common)
+
+    dkt, dvt = pl.pallas_call(
+        dkv_kernel,
+        grid=(batch, heads, seq // block_k, seq // block_q),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, depth),
+                         lambda b, h, j, i: (b, h, j, 0), memory_space=mem),
+            pl.BlockSpec((1, 1, block_k, depth),
+                         lambda b, h, j, i: (b, h, j, 0), memory_space=mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, depth), jnp.float32),
+            pltpu.VMEM((block_k, depth), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*dkv_args)
+
+    bsdh = lambda x: x.transpose(0, 2, 1, 3)
+    return bsdh(dqt), bsdh(dkt), bsdh(dvt)
+
+
+# --- Backward (blockwise XLA recompute from LSE — golden fallback) ----------
+
+
+def _flash_backward_xla(res, g, *, causal):
     q, k, v, mask, o, lse = res
     batch, seq, heads, depth = q.shape
     block_q = _pick_block_q(seq)
@@ -288,30 +566,39 @@ def _flash_backward(res, g, *, causal):
 # --- Public entry with custom VJP -------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, mask, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, causal, interpret, backward_impl):
     o, _ = _flash_forward(q, k, v, mask, causal=causal, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, mask, causal, interpret):
+def _flash_fwd(q, k, v, mask, causal, interpret, backward_impl):
     o, lse = _flash_forward(q, k, v, mask, causal=causal, interpret=interpret)
     return o, (q, k, v, mask, o, lse)
 
 
-def _flash_bwd(causal, interpret, res, g):
-    dq, dk, dv = _flash_backward(res, g, causal=causal)
+def _flash_bwd(causal, interpret, backward_impl, res, g):
+    impl = backward_impl or BACKWARD_IMPL
+    if impl == "pallas":
+        dq, dk, dv = _flash_backward_pallas(
+            res, g, causal=causal, interpret=interpret
+        )
+    else:
+        dq, dk, dv = _flash_backward_xla(res, g, causal=causal)
     return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, mask=None, causal=False, interpret=None):
+def flash_attention(q, k, v, *, mask=None, causal=False, interpret=None,
+                    backward_impl=None):
     """Flash attention, BSHD layout; differentiable.
 
     ``mask`` is a padding mask (B, S) or (B, 1, 1, S), True = attend.
     ``interpret=None`` auto-selects interpreter mode off-TPU (for tests).
+    ``backward_impl`` picks the backward: None = module ``BACKWARD_IMPL``
+    default, "pallas" = kernel, "xla" = blockwise-recompute golden path.
     Raises ValueError for shapes/masks the kernel cannot handle (callers
     wanting silent fallback should go through
     ``ops.attention.dot_product_attention`` with ``implementation="auto"``).
@@ -334,4 +621,4 @@ def flash_attention(q, k, v, *, mask=None, causal=False, interpret=None):
     if interpret is None:
         interpret = not _on_tpu()
     pad = _as_padding_mask(mask, q.shape)
-    return _flash(q, k, v, pad, causal, interpret)
+    return _flash(q, k, v, pad, causal, interpret, backward_impl)

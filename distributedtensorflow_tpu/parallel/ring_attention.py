@@ -43,15 +43,211 @@ def ring_attention(
     *,
     axis_name: str = mesh_lib.AXIS_SEQ,
     causal: bool = False,
+    impl: str | None = None,  # None=auto | "flash" | "xla"
 ) -> jax.Array:
     """Ring attention over mesh axis ``axis_name`` (shard_map-internal).
 
     Devices are assumed to hold *contiguous* sequence chunks in mesh-axis
     order (chunk i on position i) — the layout ``PartitionSpec(..., "seq",
-    ...)`` produces.  Causal masking is resolved at chunk granularity: a K
-    chunk strictly in the future contributes nothing and its compute is
-    skipped via masking (uniform control flow keeps the program SPMD).
+    ...)`` produces.
+
+    Chunk compute dispatches to the Pallas flash-attention kernels
+    (``ops/flash_attention.py``) whenever the chunk shape supports them
+    (auto) — per SURVEY.md §5.7 "ring attention with Pallas kernel": no
+    (S_loc, S_loc) score tile ever reaches HBM, in forward *or* backward.
+    ``impl="xla"`` forces the einsum online-softmax fallback (odd chunk
+    sizes / unsupported dtypes).
     """
+    if impl is None:
+        from ..ops import flash_attention as fa
+
+        # Off-TPU, interpret-mode Pallas per ring step would be orders of
+        # magnitude slower than the einsum ring — match ops-level
+        # supported() and only auto-pick flash on real TPU hardware.
+        ok = (
+            fa._on_tpu()
+            and q.shape == k.shape == v.shape
+            and fa._pick_block_q(q.shape[1]) is not None
+            and q.dtype in (jnp.bfloat16, jnp.float32)
+        )
+        impl = "flash" if ok else "xla"
+    if impl == "flash":
+        from ..ops.flash_attention import _on_tpu
+
+        return _ring_flash(q, k, v, axis_name, causal, not _on_tpu())
+    return _ring_attention_xla(q, k, v, axis_name=axis_name, causal=causal)
+
+
+# --- Flash-kernel ring (custom VJP) -----------------------------------------
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
+    """Ring forward: each chunk through the Pallas flash kernel, partials
+    merged by their log-sum-exp.  Returns (out, global lse)."""
+    from ..ops.flash_attention import _flash_forward
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk(step, kc, vc):
+        """(o_chunk fp32 (B,S,H,D), lse_chunk (B,H,S)) for this ring step."""
+        kidx = (my - step) % n
+
+        def diag(_):
+            return _flash_forward(q, kc, vc, None, causal=True,
+                                  interpret=interpret)
+
+        def past(_):
+            return _flash_forward(q, kc, vc, None, causal=False,
+                                  interpret=interpret)
+
+        if not causal:
+            o, lse = past(None)
+            return o.astype(jnp.float32), lse
+
+        def future(_):
+            # Strictly-future chunk: nothing to compute.  lse=-inf makes the
+            # merge weight exp(lse - m) exactly 0.
+            return (
+                jnp.zeros((b, s_loc, h, d), q.dtype),
+                jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
+            )
+
+        o, lse = lax.cond(
+            kidx > my,
+            future,
+            lambda _: lax.cond(kidx == my, diag, past, None),
+            None,
+        )
+        return o.astype(jnp.float32), lse
+
+    def merge(m, l, acc, o_c, lse_c):
+        # o_c is chunk-softmax-normalized; exp(lse_c - m_new) restores the
+        # un-normalized numerator so partials combine exactly.
+        m_new = jnp.maximum(m, lse_c)  # (B, H, S)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_c - m_new)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + (
+            o_c * beta.transpose(0, 2, 1)[..., None]
+        )
+        l = l * alpha + beta
+        return m_new, l, acc
+
+    def body(carry, step):
+        m, l, acc, kc, vc = carry
+        o_c, lse_c = chunk(step, kc, vc)
+        m, l, acc = merge(m, l, acc, o_c, lse_c)
+        # rotate K/V to the next device; XLA overlaps this with the matmuls
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m, l, acc, kc, vc), None
+
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    # last chunk merged outside the scan: no wasted final K/V rotation
+    (m, l, acc, kc, vc), _ = lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(n - 1)
+    )
+    o_c, lse_c = chunk(n - 1, kc, vc)
+    m, l, acc = merge(m, l, acc, o_c, lse_c)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    lse_global = m + jnp.log(l)
+    return out.astype(q.dtype), lse_global
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, res, g):
+    """Backward ring: per-chunk Pallas dq/dk/dv kernels driven by the
+    *global* LSE; dk/dv partials rotate with their K/V chunk so after a
+    full cycle every chunk's gradient lands back on its home device."""
+    from ..ops.flash_attention import _flash_backward_pallas_core
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    gf = g.astype(jnp.float32)
+    delta = jnp.einsum("bqhd,bqhd->bhq", gf, out.astype(jnp.float32))
+
+    def chunk_grads(step, kc, vc):
+        kidx = (my - step) % n
+
+        def run(causal_flag):
+            def f(_):
+                return _flash_backward_pallas_core(
+                    q, kc, vc, None, g, lse, delta,
+                    causal=causal_flag, interpret=interpret,
+                )
+            return f
+
+        if not causal:
+            return run(False)(None)
+
+        def future(_):
+            return (
+                jnp.zeros_like(q), jnp.zeros_like(kc), jnp.zeros_like(vc)
+            )
+
+        return lax.cond(
+            kidx > my,
+            future,
+            lambda _: lax.cond(kidx == my, run(True), run(False), None),
+            None,
+        )
+
+    def body(carry, step):
+        dq_acc, kc, vc, dk_ring, dv_ring = carry
+        dq_c, dk_c, dv_c = chunk_grads(step, kc, vc)
+        dq_acc = dq_acc + dq_c.astype(jnp.float32)
+        dk_ring = dk_ring + dk_c.astype(jnp.float32)
+        dv_ring = dv_ring + dv_c.astype(jnp.float32)
+        # K/V and their gradient partials travel together; n rotations is a
+        # full cycle, so dk/dv end the scan on their chunk's home device.
+        kc, vc, dk_ring, dv_ring = (
+            lax.ppermute(x, axis_name, perm)
+            for x in (kc, vc, dk_ring, dv_ring)
+        )
+        return (dq_acc, kc, vc, dk_ring, dv_ring), None
+
+    zeros_q = jnp.zeros(q.shape, jnp.float32)
+    zeros_k = jnp.zeros(k.shape, jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        body,
+        (zeros_q, k, v, zeros_k, jnp.zeros(v.shape, jnp.float32)),
+        jnp.arange(n),
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+# --- XLA einsum fallback ----------------------------------------------------
+
+
+def _ring_attention_xla(
+    q: jax.Array,  # (B, S_loc, H, D) — this device's seq shard
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = mesh_lib.AXIS_SEQ,
+    causal: bool = False,
+) -> jax.Array:
+    """Einsum online-softmax ring (chunk-granular causal masking, uniform
+    control flow).  Fallback for shapes/dtypes the flash kernels reject."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape

@@ -77,6 +77,9 @@ class Trainer:
         self.checkpointer = checkpointer
         self.writer = MetricWriter(config.logdir)
         self.meter = ThroughputMeter(config.global_batch_size)
+        # Latest eval metrics, threaded into checkpointer.save() so a
+        # best_metric (keep-best) manager works under the Trainer.
+        self._last_eval_metrics: dict | None = None
 
     def fit(
         self,
@@ -105,9 +108,28 @@ class Trainer:
         if self.checkpointer is not None:
             # Label with the step actually reached (an accuracy-gate early
             # stop must not save under the total_steps slot).
-            self.checkpointer.save(int(state.step), state, force=True)
+            self.checkpointer.save(
+                int(state.step), state, force=True, metrics=self._ckpt_metrics()
+            )
             self.checkpointer.wait()
         return state
+
+    def _ckpt_metrics(self) -> dict | None:
+        """Metrics to attach to a checkpoint save.
+
+        A keep-best manager (``best_metric`` set) requires metrics on every
+        save; before the first eval there are none, so fall back to -inf/+inf
+        (worst possible) rather than failing the save.
+        """
+        if self._last_eval_metrics is not None:
+            return self._last_eval_metrics
+        best_metric = getattr(self.checkpointer, "best_metric", None)
+        if best_metric is not None:
+            worst = float("-inf") if getattr(
+                self.checkpointer, "best_mode", "max"
+            ) == "max" else float("inf")
+            return {best_metric: worst}
+        return None
 
     def _fit_loop(self, state, it, rng, eval_iter_fn, watchdog=None):
         cfg = self.config
@@ -150,6 +172,7 @@ class Trainer:
                     and (step_i + 1) % cfg.eval_every == 0
                 ):
                     eval_metrics = self.evaluate(state, eval_iter_fn())
+                    self._last_eval_metrics = eval_metrics
                     self.writer.write(
                         step_i + 1,
                         {f"eval_{k}": v for k, v in eval_metrics.items()},
@@ -166,7 +189,9 @@ class Trainer:
                     and self.checkpointer is not None
                     and (step_i + 1) % cfg.checkpoint_every == 0
                 ):
-                    self.checkpointer.save(step_i + 1, state)
+                    self.checkpointer.save(
+                        step_i + 1, state, metrics=self._ckpt_metrics()
+                    )
                     if watchdog is not None:  # so is a synchronous save
                         watchdog.ping()
         finally:
@@ -204,21 +229,29 @@ class Trainer:
         return hit
 
     def evaluate(self, state: TrainState, eval_iter: Iterable[PyTree]) -> dict:
+        """Average eval metrics, weighted by per-batch example count.
+
+        Metrics are per-example means (the loss_fn convention), so weighting
+        by batch size makes a ragged final batch count exactly once per
+        example instead of skewing the mean.  ``eval_steps <= 0`` means
+        "the whole iterator" (dataset-wide exact eval on finite iterators).
+        """
         sums: dict[str, float] = {}
-        n = 0
+        total_w = 0.0
         try:
             for i, batch in enumerate(eval_iter):
-                if i >= self.config.eval_steps:
+                if self.config.eval_steps > 0 and i >= self.config.eval_steps:
                     break
+                w = float(jax.tree.leaves(batch)[0].shape[0])
                 metrics = self.eval_step(state, batch)
                 for k, v in metrics.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
-                n += 1
+                    sums[k] = sums.get(k, 0.0) + w * float(v)
+                total_w += w
         finally:
             close = getattr(eval_iter, "close", None)
             if close is not None:  # release prefetch threads/device buffers
                 close()
-        return {k: v / max(n, 1) for k, v in sums.items()}
+        return {k: v / max(total_w, 1.0) for k, v in sums.items()}
 
 
 def _fmt(metrics: dict) -> str:

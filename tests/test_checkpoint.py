@@ -91,7 +91,7 @@ def test_keep_best_retention(tmp_path, dp_mesh):
     """best_metric retention keeps the best-K checkpoints, not the latest."""
     from distributedtensorflow_tpu.checkpoint import CheckpointManager
 
-    state, _ = _make_state(dp_mesh)
+    _, state, _ = make_state(dp_mesh)
     mgr = CheckpointManager(
         str(tmp_path / "best"), max_to_keep=2, async_save=False,
         best_metric="accuracy", best_mode="max",

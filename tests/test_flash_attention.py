@@ -83,6 +83,30 @@ def test_gradients_with_mask():
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_backward_matches_xla_backward(causal):
+    """A/B the two backward implementations through the same saved residuals."""
+    import distributedtensorflow_tpu.ops.flash_attention as fa
+
+    q, k, v = make_qkv(b=1, s=256, h=2, d=16, seed=3)
+    mask = np.ones((1, 256), bool)
+    mask[:, 240:] = False
+    mask = jnp.asarray(mask)
+
+    def loss(impl):
+        def f(q, k, v):
+            out = flash_attention(q, k, v, mask=mask, causal=causal,
+                                  interpret=True, backward_impl=impl)
+            return jnp.sum((out * mask[:, :, None, None]) ** 2)
+        return f
+
+    assert fa.BACKWARD_IMPL == "pallas"  # the default path
+    g_pallas = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pallas, g_xla):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
 def test_supported_gates():
     q, k, v = make_qkv(s=100)  # indivisible seq
     assert not supported(q, k, v)

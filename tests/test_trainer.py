@@ -1,0 +1,132 @@
+"""Trainer fit-loop tests: eval weighting, keep-best checkpoint threading.
+
+Reference analogue: SURVEY.md §2.3 "Keras trainer" (Model.fit loop,
+`keras/src/backend/tensorflow/trainer.py:315`) — the loop around the
+compiled step: periodic eval, checkpoint hooks, metric averaging.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflow_tpu.checkpoint import CheckpointManager
+from distributedtensorflow_tpu.models import LeNet5
+from distributedtensorflow_tpu.train import (
+    create_sharded_state,
+    make_eval_step,
+    make_train_step,
+)
+from distributedtensorflow_tpu.train.losses import (
+    classification_eval,
+    classification_loss,
+)
+from distributedtensorflow_tpu.train.trainer import Trainer, TrainerConfig
+
+
+def _setup(mesh):
+    model = LeNet5()
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(0.05, momentum=0.9), mesh, jax.random.PRNGKey(0)
+    )
+    train_step = make_train_step(classification_loss(model), mesh, specs)
+    eval_step = make_eval_step(classification_eval(model), mesh, specs)
+    return state, train_step, eval_step
+
+
+def _batches(n, batch_size=16, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        yield {
+            "image": rng.standard_normal((batch_size, 28, 28, 1)).astype(
+                np.float32
+            ),
+            "label": rng.integers(0, 10, (batch_size,)).astype(np.int32),
+        }
+
+
+def test_fit_runs_and_evals(tmp_path, dp_mesh):
+    state, train_step, eval_step = _setup(dp_mesh)
+    cfg = TrainerConfig(
+        total_steps=4, log_every=2, eval_every=2, eval_steps=2,
+        global_batch_size=16, logdir=str(tmp_path / "logs"),
+    )
+    trainer = Trainer(train_step, cfg, eval_step=eval_step)
+    out = trainer.fit(
+        state,
+        _batches(4),
+        jax.random.PRNGKey(1),
+        eval_iter_fn=lambda: _batches(2, seed=99),
+    )
+    assert int(out.step) == 4
+    assert trainer._last_eval_metrics is not None
+    assert "accuracy" in trainer._last_eval_metrics
+
+
+def test_keep_best_checkpointer_under_trainer(tmp_path, dp_mesh):
+    """A best_metric manager must work through Trainer.fit (metrics are
+    threaded into every save; pre-eval saves use a worst-possible score)."""
+    state, train_step, eval_step = _setup(dp_mesh)
+    mgr = CheckpointManager(
+        str(tmp_path / "best"), max_to_keep=2, async_save=False,
+        best_metric="accuracy", best_mode="max",
+    )
+    # checkpoint_every=1: the step-1 save happens BEFORE the first eval, so
+    # the worst-possible-score fallback path in _ckpt_metrics is exercised.
+    cfg = TrainerConfig(
+        total_steps=4, log_every=0, eval_every=2, eval_steps=1,
+        checkpoint_every=1, global_batch_size=16,
+    )
+    trainer = Trainer(train_step, cfg, eval_step=eval_step, checkpointer=mgr)
+    out = trainer.fit(
+        state,
+        _batches(4),
+        jax.random.PRNGKey(1),
+        eval_iter_fn=lambda: _batches(1, seed=99),
+    )
+    # No ValueError raised; checkpoints exist and carry metrics.
+    assert mgr.all_steps(), "no checkpoints written"
+    assert mgr.best_step() is not None
+    assert int(out.step) == 4
+    mgr.close()
+
+
+def test_eval_weighted_by_batch_size(dp_mesh):
+    """A ragged final batch must count per-example, not per-batch."""
+    state, train_step, eval_step = _setup(dp_mesh)
+    cfg = TrainerConfig(total_steps=1, eval_steps=0, global_batch_size=16)
+    trainer = Trainer(train_step, cfg, eval_step=eval_step)
+
+    rng = np.random.default_rng(0)
+    big = {
+        "image": rng.standard_normal((24, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, (24,)).astype(np.int32),
+    }
+    small = {
+        "image": rng.standard_normal((8, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, (8,)).astype(np.int32),
+    }
+    got = trainer.evaluate(state, iter([big, small]))
+
+    # Ground truth: eval over the concatenation as one batch.
+    both = {k: np.concatenate([big[k], small[k]]) for k in big}
+    want = {k: float(v) for k, v in eval_step(state, both).items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5)
+
+
+def test_eval_steps_zero_consumes_finite_iterator(dp_mesh):
+    state, train_step, eval_step = _setup(dp_mesh)
+    cfg = TrainerConfig(total_steps=1, eval_steps=0, global_batch_size=16)
+    trainer = Trainer(train_step, cfg, eval_step=eval_step)
+    seen = []
+
+    def gen():
+        for b in _batches(3):
+            seen.append(1)
+            yield b
+
+    trainer.evaluate(state, gen())
+    assert len(seen) == 3  # whole iterator, not the default 10-step cap

@@ -71,7 +71,10 @@ def apply_config_file(
 
 
 def main() -> None:
-    p = argparse.ArgumentParser(description=__doc__)
+    # allow_abbrev=False: apply_config_file detects explicitly-typed flags
+    # by matching argv against option strings; prefix abbreviations would
+    # dodge that match and get silently overridden by config-file values.
+    p = argparse.ArgumentParser(description=__doc__, allow_abbrev=False)
     p.add_argument("--config", default=None,
                    help="a JSON file of flag defaults (CLI flags override), "
                         "or a workload preset name (reference --config alias)")
