@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 synthetic-ImageNet images/sec/chip.
+"""Headline benchmark: ResNet-50 synthetic-ImageNet images/sec/chip (+ MFU).
 
 BASELINE.json metric: "ResNet-50/ImageNet images/sec/chip".  The reference
 publishes no numbers (``published: {}``); the north-star wall-clock anchor is
@@ -8,34 +8,85 @@ publishes no numbers (``published: {}``); the north-star wall-clock anchor is
 denominator so the ratio reads "fraction of an A100's ResNet-50 throughput
 per TPU chip".
 
+Hardened against the flaky axon TPU tunnel (the round-1 failure mode):
+
+1. the device probe retries with backoff (``BENCH_PROBE_RETRIES`` ×
+   ``BENCH_PROBE_BACKOFF_S``) instead of one all-or-nothing shot;
+2. every successful measurement is persisted to ``BENCH_RESULTS/`` so a
+   number landed at ANY point in the round survives a tunnel outage at
+   round end;
+3. if the chip is unreachable now but a persisted TPU result exists, that
+   result is re-emitted with ``"cached_from"`` set;
+4. only as a last resort a small CPU run is emitted, clearly labeled
+   ``"platform": "cpu_fallback"`` (a liveness signal, not a perf claim).
+
 Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import sys
 import time
 
-from bench_probe import probe_devices_or_die
-
-probe_devices_or_die("bench")
-
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-
-# The axon sitecustomize force-selects the TPU platform over JAX_PLATFORMS;
-# BENCH_PLATFORM=cpu re-forces it (CPU smoke runs).
-if os.environ.get("BENCH_PLATFORM"):
-    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+REPO = os.path.dirname(os.path.abspath(__file__))
+RESULTS_DIR = os.path.join(REPO, "BENCH_RESULTS")
 
 A100_IMAGES_PER_SEC = 2500.0  # per-GPU anchor (see module docstring)
 
+#: Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
+PEAK_FLOPS_BY_KIND = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+}
 
-def main() -> None:
+#: ResNet-50 @224 fwd ≈ 4.1 GFLOPs/image (MACs×2); train step ≈ 3× fwd.
+#: Used when XLA's compiled cost analysis is unavailable on the backend.
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 12.3e9
+
+
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for sub, peak in PEAK_FLOPS_BY_KIND.items():
+        if sub in kind:
+            return peak
+    return 197e12  # this sandbox's chip is a TPU v5 lite
+
+
+def _latest_persisted_tpu() -> dict | None:
+    from bench_probe import is_tpu_platform
+
+    best = None
+    for path in sorted(glob.glob(os.path.join(RESULTS_DIR, "resnet50_*.json"))):
+        try:
+            with open(path) as f:
+                r = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if is_tpu_platform(r.get("platform", "")):
+            r["cached_from"] = os.path.basename(path)
+            best = r
+    return best
+
+
+def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
+              image_size: int = 224) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    # The axon sitecustomize force-selects the TPU platform over
+    # JAX_PLATFORMS; BENCH_PLATFORM=cpu re-forces it (CPU smoke runs).
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
     import optax
+    from jax.sharding import NamedSharding
 
     from distributedtensorflow_tpu.models import ResNet50
     from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
@@ -45,15 +96,15 @@ def main() -> None:
         create_sharded_state,
         make_train_step,
     )
-    from jax.sharding import NamedSharding
 
     mesh = build_mesh(MeshSpec(data=-1))
     n_chips = mesh.size
-    per_chip_batch = 128
     global_batch = per_chip_batch * n_chips
+    platform = jax.devices()[0].platform
+    device_kind = jax.devices()[0].device_kind
 
     model = ResNet50(dtype=jnp.bfloat16)
-    init_fn = lambda r: model.init(r, jnp.zeros((2, 224, 224, 3)))
+    init_fn = lambda r: model.init(r, jnp.zeros((2, image_size, image_size, 3)))
     rng = jax.random.PRNGKey(0)
     state, specs = create_sharded_state(
         init_fn, optax.sgd(0.1, momentum=0.9, nesterov=True), mesh, rng
@@ -67,7 +118,9 @@ def main() -> None:
     sharding = NamedSharding(mesh, batch_spec(mesh))
     batch = {
         "image": jax.device_put(
-            jax.random.normal(rng, (global_batch, 224, 224, 3), jnp.bfloat16),
+            jax.random.normal(
+                rng, (global_batch, image_size, image_size, 3), jnp.bfloat16
+            ),
             sharding,
         ),
         "label": jax.device_put(
@@ -76,29 +129,113 @@ def main() -> None:
         ),
     }
 
-    # Warmup / compile.  NOTE: sync via a host value fetch, not
-    # block_until_ready — the final loss depends on the whole step chain, so
-    # fetching it forces execution on backends whose block_until_ready is a
-    # no-op (observed with the axon PJRT tunnel).
-    for _ in range(3):
-        state, metrics = step(state, batch, rng)
+    # AOT-compile ONCE and reuse the executable for warmup, timing, and
+    # cost analysis (a separate lower().compile() for cost analysis alone
+    # would pay a second full ResNet-50 compile over the flaky tunnel).
+    compiled = step.lower(state, batch, rng).compile()
+
+    # Warmup.  NOTE: sync via a host value fetch, not block_until_ready —
+    # the final loss depends on the whole step chain, so fetching it forces
+    # execution on backends whose block_until_ready is a no-op (observed
+    # with the axon PJRT tunnel).
+    for _ in range(warmup):
+        state, metrics = compiled(state, batch, rng)
     float(metrics["loss"])
 
-    n_steps = 30
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = step(state, batch, rng)
+        state, metrics = compiled(state, batch, rng)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     images_per_sec = n_steps * global_batch / dt
     per_chip = images_per_sec / n_chips
-    print(json.dumps({
+
+    # Model-FLOPs utilization, computed per chip on both sides: XLA's cost
+    # analysis counts the PARTITIONED (per-device) module's FLOPs, which is
+    # exactly the per-chip numerator; the analytic fallback is global and
+    # divided down by n_chips.
+    flops_per_chip_step = None
+    try:
+        cost = compiled.cost_analysis()
+        if cost and cost.get("flops"):
+            flops_per_chip_step = float(cost["flops"])
+    except Exception as e:  # cost analysis is best-effort on the tunnel
+        print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
+    flops_source = "xla_cost_analysis"
+    if not flops_per_chip_step:
+        # analytic constant is for 224px; scale by the conv-FLOP area ratio
+        flops_per_chip_step = (
+            RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
+            * (image_size / 224.0) ** 2 / n_chips
+        )
+        flops_source = "analytic_12.3GF_per_image"
+    mfu = (flops_per_chip_step * n_steps / dt) / _peak_flops(device_kind)
+
+    return {
         "metric": "resnet50_synthetic_imagenet_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 4),
-    }))
+        "mfu": round(mfu, 4),
+        "mfu_flops_source": flops_source,
+        "platform": platform,
+        "device_kind": device_kind,
+        "n_chips": n_chips,
+        "global_batch": global_batch,
+        "n_steps": n_steps,
+        "image_size": image_size,
+        "step_time_ms": round(1000 * dt / n_steps, 2),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+def main() -> None:
+    from bench_probe import (
+        is_tpu_platform,
+        persist_result,
+        probe_devices_with_retries,
+    )
+
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        # explicit CPU smoke run: tiny shapes (bf16 conv on CPU is emulated
+        # and glacial at 224px), honestly labeled via platform/image_size
+        result = run_bench(per_chip_batch=2, n_steps=2, warmup=1,
+                           image_size=64)
+        print(json.dumps(result))
+        return
+
+    if probe_devices_with_retries("bench"):
+        result = run_bench(
+            per_chip_batch=int(os.environ.get("BENCH_BATCH", "128")),
+            n_steps=int(os.environ.get("BENCH_STEPS", "30")),
+            warmup=3,
+        )
+        if is_tpu_platform(result["platform"]):
+            persist_result("resnet50", result)
+        print(json.dumps(result))
+        return
+
+    cached = _latest_persisted_tpu()
+    if cached is not None:
+        print(
+            "bench: tunnel down; emitting persisted TPU result "
+            f"{cached['cached_from']}",
+            file=sys.stderr,
+        )
+        print(json.dumps(cached))
+        return
+
+    print(
+        "bench: TPU unreachable and no persisted result; CPU fallback "
+        "(liveness only, NOT a perf claim)",
+        file=sys.stderr,
+    )
+    os.environ["BENCH_PLATFORM"] = "cpu"
+    result = run_bench(per_chip_batch=2, n_steps=2, warmup=1, image_size=64)
+    result["platform"] = "cpu_fallback"
+    result["vs_baseline"] = 0.0
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

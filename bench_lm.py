@@ -13,9 +13,10 @@ import json
 import os
 import time
 
-from bench_probe import probe_devices_or_die
+from bench_probe import probe_devices_with_retries
 
-probe_devices_or_die("bench_lm")
+if not probe_devices_with_retries("bench_lm"):
+    raise SystemExit(2)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -69,12 +70,21 @@ def main() -> None:
     per_chip = tokens_per_sec / n_chips
     # Anchor: an A100 trains GPT-2-small (~124M params) at roughly 150k
     # tokens/sec with remat off; used as the vs_baseline denominator.
-    print(json.dumps({
+    result = {
         "metric": "gpt_small_train_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / 150_000.0, 4),
-    }))
+        "platform": jax.devices()[0].platform,
+        "seq": seq,
+        "global_batch": wl.global_batch_size,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    from bench_probe import is_tpu_platform, persist_result
+
+    if is_tpu_platform(result["platform"]) and not test_size:
+        persist_result("lm", result)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

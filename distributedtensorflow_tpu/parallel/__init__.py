@@ -68,10 +68,13 @@ from .pipeline import (  # noqa: F401
 from .moe import (  # noqa: F401
     expert_parallel_moe,
     init_expert_params,
+    local_moe,
+    make_moe_fn,
     make_moe_layer,
     top1_route,
     top2_route,
 )
+from .pipeline import gpipe_bubble_fraction  # noqa: F401
 from .sharding import (  # noqa: F401
     FixedShardsPartitioner,
     LayoutMap,

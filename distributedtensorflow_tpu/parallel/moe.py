@@ -180,7 +180,7 @@ def init_expert_params(
     return jax.device_put(stacked, sharding)
 
 
-def make_moe_layer(
+def make_moe_fn(
     mesh: Mesh,
     expert_fn: Callable[[PyTree, jax.Array], jax.Array],
     *,
@@ -188,10 +188,13 @@ def make_moe_layer(
     axis_name: str = mesh_lib.AXIS_EXPERT,
     router: str = "top1",
 ) -> Callable:
-    """Global entry: ``fn(tokens (N, d), router_kernel, expert_params)``.
+    """Un-jitted shard_map MoE region for use INSIDE a jitted model.
 
-    Tokens are sharded over (batch axes + expert axis) so each expert shard
-    routes its local tokens; expert params are expert-axis sharded.
+    ``fn(tokens (N, d), router_kernel, expert_params) -> (out, aux)`` —
+    tokens are sharded over (batch axes + expert axis) so each expert shard
+    routes its local tokens; expert params are expert-axis sharded.  The
+    model-level embedding (``models/gpt_moe.py``) drops this into its MLP
+    the same way ring attention drops into ``attn_fn``.
     """
     if router not in ROUTERS:  # eager: fail here, not inside the jit trace
         raise ValueError(
@@ -218,4 +221,45 @@ def make_moe_layer(
             check_vma=False,
         )(tokens, router_kernel, expert_params)
 
-    return jax.jit(run)
+    return run
+
+
+def make_moe_layer(
+    mesh: Mesh,
+    expert_fn: Callable[[PyTree, jax.Array], jax.Array],
+    *,
+    capacity_factor: float = 1.25,
+    axis_name: str = mesh_lib.AXIS_EXPERT,
+    router: str = "top1",
+) -> Callable:
+    """Jit-compiled global entry around :func:`make_moe_fn`."""
+    return jax.jit(make_moe_fn(
+        mesh, expert_fn, capacity_factor=capacity_factor,
+        axis_name=axis_name, router=router,
+    ))
+
+
+def local_moe(
+    tokens: jax.Array,  # (T, d)
+    router_kernel: jax.Array,  # (d, E)
+    expert_params: PyTree,  # leaves (E, ...) — ALL experts, replicated
+    expert_fn: Callable[[PyTree, jax.Array], jax.Array],
+    *,
+    capacity_factor: float = 1.25,
+    router: str = "top1",
+) -> tuple[jax.Array, jax.Array]:
+    """Single-device MoE (no collectives): every expert lives locally.
+
+    Same routing/capacity math as :func:`expert_parallel_moe` with axis
+    size 1 — the golden reference for EP tests and the fallback when the
+    mesh has no real ``expert`` axis.
+    """
+    t, d = tokens.shape
+    e = router_kernel.shape[-1]
+    capacity = max(1, int(t * capacity_factor * _ASSIGNMENTS[router] / e))
+    logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    dispatch, combine, aux = ROUTERS[router](logits, capacity)
+    send = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(jnp.float32))
+    out = jax.vmap(expert_fn)(expert_params, send.astype(tokens.dtype))
+    combined = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))
+    return combined.astype(tokens.dtype), aux

@@ -33,6 +33,15 @@ from . import mesh as mesh_lib
 PyTree = Any
 
 
+def gpipe_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Idle fraction of the GPipe schedule: (S-1)/(M+S-1).
+
+    E.g. 4 stages × 16 microbatches → 15.8% bubble.  Keep microbatch counts
+    >= 4× stages; 1F1B would shrink peak activation memory, not the bubble.
+    """
+    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+
+
 def pipeline_apply(
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
     stage_params: PyTree,

@@ -61,12 +61,16 @@ def ring_attention(
     if impl is None:
         from ..ops import flash_attention as fa
 
-        # Off-TPU, interpret-mode Pallas per ring step would be orders of
-        # magnitude slower than the einsum ring — match ops-level
-        # supported() and only auto-pick flash on real TPU hardware.
+        # Match ops-level supported(): only auto-pick flash on real TPU
+        # hardware (off-TPU the interpret-mode kernel is orders of magnitude
+        # slower than the einsum ring), and only once the PER-DEVICE chunk
+        # is long enough that the kernel beats XLA's fused attention
+        # (MIN_SEQ_FOR_PALLAS — the bench_attn.py-evidenced threshold).
+        # Callers can always force impl="flash".
         ok = (
             fa._on_tpu()
             and q.shape == k.shape == v.shape
+            and q.shape[1] >= fa.MIN_SEQ_FOR_PALLAS
             and fa._pick_block_q(q.shape[1]) is not None
             and q.dtype in (jnp.bfloat16, jnp.float32)
         )

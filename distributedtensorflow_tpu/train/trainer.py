@@ -117,19 +117,25 @@ class Trainer:
     def _ckpt_metrics(self) -> dict | None:
         """Metrics to attach to a checkpoint save.
 
-        A keep-best manager (``best_metric`` set) requires metrics on every
-        save; before the first eval there are none, so fall back to -inf/+inf
-        (worst possible) rather than failing the save.
+        A keep-best manager (``best_metric`` set) requires its metric on
+        EVERY save; when eval hasn't run yet — or ran but didn't produce
+        that metric (wrong eval_fn, empty eval iterator) — substitute the
+        worst possible score rather than killing a long fit mid-run.
         """
-        if self._last_eval_metrics is not None:
-            return self._last_eval_metrics
+        metrics = dict(self._last_eval_metrics or {})
         best_metric = getattr(self.checkpointer, "best_metric", None)
-        if best_metric is not None:
+        if best_metric is not None and best_metric not in metrics:
             worst = float("-inf") if getattr(
                 self.checkpointer, "best_mode", "max"
             ) == "max" else float("inf")
-            return {best_metric: worst}
-        return None
+            if self._last_eval_metrics is not None:
+                logger.warning(
+                    "checkpoint keep-best metric %r missing from eval "
+                    "metrics %s; saving with worst-possible score",
+                    best_metric, sorted(metrics),
+                )
+            metrics[best_metric] = worst
+        return metrics or None
 
     def _fit_loop(self, state, it, rng, eval_iter_fn, watchdog=None):
         cfg = self.config

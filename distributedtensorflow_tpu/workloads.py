@@ -237,10 +237,39 @@ def get_workload(name: str, *, test_size: bool = False,
         model, loss = build()
 
         def finalize(wl: Workload, mesh) -> Workload:
+            shape = dict(mesh.shape)
+            # With a real pipe axis, swap in the GPipe pipeline over the
+            # block stack (embed/head outside) — params gain a stage dim,
+            # so init_fn and layout change too.
+            if shape.get("pipe", 1) > 1:
+                if shape.get("seq", 1) > 1:
+                    raise NotImplementedError(
+                        "pipe x seq on one mesh needs ring attention inside "
+                        "the pipeline shard_map; shard one of them"
+                    )
+                from .models.gpt_pipeline import (
+                    PipelinedGPT,
+                    pipelined_lm_loss,
+                )
+
+                n_micro = 4 * shape["pipe"]
+                local_batch = wl.global_batch_size // max(
+                    1, shape.get("data", 1) * shape.get("fsdp", 1)
+                )
+                while n_micro > 1 and local_batch % n_micro:
+                    n_micro //= 2
+                pp = PipelinedGPT(cfg, mesh, n_microbatches=n_micro)
+                return dataclasses.replace(
+                    wl,
+                    model=pp,
+                    loss_fn=pipelined_lm_loss(pp),
+                    init_fn=pp.init,
+                    layout=pp.layout(),
+                )
             # With a real seq axis, swap dense attention for the
             # sequence-parallel shard_map region (ring by default) — the
             # long-context path (SURVEY.md §5.7).
-            if dict(mesh.shape).get("seq", 1) <= 1:
+            if shape.get("seq", 1) <= 1:
                 return wl
             from .parallel.ring_attention import sequence_parallel_attention_fn
 

@@ -1,0 +1,147 @@
+"""Pipeline-parallel GPT: parity vs the dense model, and real training.
+
+Round-1 verdict item #4: the pipeline must train a real model, with a
+gradient-equivalence test vs the non-PP step and a loss-decrease test.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflow_tpu.models.gpt import GPTLM, gpt_tiny, lm_loss
+from distributedtensorflow_tpu.models.gpt_pipeline import (
+    PipelinedGPT,
+    params_to_dense,
+    pipelined_lm_loss,
+)
+from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributedtensorflow_tpu.parallel.pipeline import gpipe_bubble_fraction
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+
+
+@pytest.fixture()
+def pipe_mesh(devices):
+    """data=4 × pipe=2 over the 8 virtual devices (tiny GPT has 2 layers)."""
+    return build_mesh(MeshSpec(data=4, pipe=2), devices)
+
+
+def make_batch(b=8, s=32, vocab=512, seed=0):
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, vocab, size=(b, 1))
+    step = rng.integers(1, 7, size=(b, 1))
+    ids = (start + step * np.arange(s)) % vocab
+    return {"input_ids": ids.astype(np.int32)}
+
+
+def test_bubble_fraction():
+    assert gpipe_bubble_fraction(4, 16) == pytest.approx(3 / 19)
+    assert gpipe_bubble_fraction(1, 8) == 0.0
+
+
+def test_forward_matches_dense(pipe_mesh):
+    # fp32: parity vs the dense model must not drown in bf16 rounding
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32)
+    pp = PipelinedGPT(cfg, pipe_mesh, n_microbatches=2)
+    variables = pp.init(jax.random.PRNGKey(0))
+    batch = make_batch()
+
+    logits_pp = pp.apply(variables, jnp.asarray(batch["input_ids"]))
+
+    dense = GPTLM(cfg)
+    dense_params = params_to_dense(variables["params"], cfg)
+    logits_dense = dense.apply(
+        {"params": dense_params}, jnp.asarray(batch["input_ids"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(logits_dense), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_gradient_equivalence_vs_dense(pipe_mesh):
+    """Same loss and same per-layer gradients as the unpipelined model."""
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32)
+    pp = PipelinedGPT(cfg, pipe_mesh, n_microbatches=4)
+    variables = pp.init(jax.random.PRNGKey(1))
+    batch = {"input_ids": jnp.asarray(make_batch(b=16, seed=3)["input_ids"])}
+    rng = jax.random.PRNGKey(0)
+
+    pp_loss_fn = pipelined_lm_loss(pp)
+    (loss_pp, _), grads_pp = jax.value_and_grad(pp_loss_fn, has_aux=True)(
+        variables["params"], {}, batch, rng
+    )
+
+    dense = GPTLM(cfg)
+    dense_params = params_to_dense(variables["params"], cfg)
+    dense_loss_fn = lm_loss(dense)
+    (loss_dense, _), grads_dense = jax.value_and_grad(
+        dense_loss_fn, has_aux=True
+    )(dense_params, {}, batch, rng)
+
+    np.testing.assert_allclose(
+        float(loss_pp), float(loss_dense), atol=1e-5, rtol=1e-5
+    )
+    # map dense grads back into the stacked layout and compare leaf-by-leaf
+    grads_dense_stacked = {
+        "wte": grads_dense["wte"],
+        "ln_f": grads_dense["ln_f"],
+        "blocks": jax.tree.map(
+            lambda *leaves: jnp.stack(leaves).reshape(
+                2, 1, *leaves[0].shape
+            ),
+            grads_dense["h0"], grads_dense["h1"],
+        ),
+    }
+    flat_pp = jax.tree.leaves_with_path(grads_pp)
+    flat_dense = dict(
+        (str(k), v) for k, v in jax.tree.leaves_with_path(grads_dense_stacked)
+    )
+    assert flat_dense, "empty grad tree"
+    for key_path, leaf in flat_pp:
+        ref = flat_dense[str(key_path)]
+        np.testing.assert_allclose(
+            np.asarray(leaf, np.float32), np.asarray(ref, np.float32),
+            atol=5e-4, rtol=5e-4,
+            err_msg=f"grad mismatch at {key_path}",
+        )
+
+
+def test_workload_trains_through_pipeline(pipe_mesh):
+    """get_workload('gpt_lm').for_mesh(pipe_mesh) → loss decreases."""
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=16)
+    wl = wl.for_mesh(pipe_mesh)
+    assert isinstance(wl.model, PipelinedGPT)
+
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), pipe_mesh,
+        jax.random.PRNGKey(0), rules=wl.layout,
+    )
+    # stage dim of block params actually lands on the pipe axis
+    leaf_spec = jax.tree.leaves(
+        specs.params["blocks"], is_leaf=lambda x: hasattr(x, "index")
+    )
+    from jax.sharding import PartitionSpec as P
+
+    leaves = jax.tree.leaves(
+        jax.tree.map(lambda _: 0, specs.params["blocks"]))
+    assert leaves  # blocks exist
+    flat_specs = [
+        s for _, s in jax.tree.leaves_with_path(
+            specs.params["blocks"], is_leaf=lambda x: isinstance(x, P))
+        if isinstance(s, P)
+    ]
+    assert flat_specs and all(s[0] == "pipe" for s in flat_specs)
+
+    step = make_train_step(wl.loss_fn, pipe_mesh, specs)
+    rng = jax.random.PRNGKey(0)
+    it = iter([make_batch(b=16, s=32, seed=i) for i in range(8)])
+    losses = []
+    for batch in it:
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-long TPU-tunnel watcher: retry the chip until a window opens, then
+# land the three benchmark numbers (headline ResNet-50, LM tokens/sec,
+# flash-attention A/B) into BENCH_RESULTS/.  Exits after a full success or
+# when the deadline passes.  Round-1 lesson: one probe shot at round end =
+# zero perf evidence; this amortizes the flakiness over the whole round.
+set -u
+cd "$(dirname "$0")"
+DEADLINE=${TPU_WATCH_DEADLINE_S:-36000}   # default 10h
+SLEEP=${TPU_WATCH_SLEEP_S:-900}           # 15 min between probes
+START=$(date +%s)
+LOG=BENCH_RESULTS/tpu_watch.log
+mkdir -p BENCH_RESULTS
+
+while true; do
+  now=$(date +%s)
+  if (( now - START > DEADLINE )); then
+    echo "$(date -Is) watcher: deadline reached" >> "$LOG"
+    exit 1
+  fi
+  if BENCH_PROBE_RETRIES=1 BENCH_DEVICE_TIMEOUT_S=60 timeout 90 \
+      python -c "from bench_probe import probe_devices; import sys; sys.exit(0 if probe_devices('watch') else 1)" \
+      >> "$LOG" 2>&1; then
+    echo "$(date -Is) watcher: tunnel UP, running benches" >> "$LOG"
+    ok=1
+    BENCH_SKIP_PROBE=1 timeout 1200 python bench.py      >> "$LOG" 2>&1 || ok=0
+    BENCH_SKIP_PROBE=1 timeout 1200 python bench_lm.py   >> "$LOG" 2>&1 || ok=0
+    BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
+    if (( ok == 1 )); then
+      echo "$(date -Is) watcher: all benches landed" >> "$LOG"
+      exit 0
+    fi
+    echo "$(date -Is) watcher: partial failure, will retry" >> "$LOG"
+  else
+    echo "$(date -Is) watcher: tunnel down" >> "$LOG"
+  fi
+  sleep "$SLEEP"
+done
