@@ -374,7 +374,16 @@ def sequence_parallel_attention_fn(
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[scheme]
     kernel = functools.partial(fn, axis_name=axis_name, causal=causal)
     batch_axes = mesh_lib.data_axes(mesh)
-    spec = P(batch_axes if batch_axes else None, axis_name, None, None)
+    # Heads stay sharded over the model axis INSIDE the region: ring
+    # attention is per-head independent, so on a dp x tp x sp mesh the
+    # Megatron head shards never gather — each device ring-rotates only its
+    # own heads' K/V (size-1 model axis makes this a no-op).
+    head_axis = (
+        mesh_lib.AXIS_MODEL
+        if scheme == "ring" and mesh.shape.get(mesh_lib.AXIS_MODEL, 1) > 1
+        else None
+    )
+    spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
     return jax.shard_map(
         lambda q, k, v: kernel(q, k, v),
         mesh=mesh,

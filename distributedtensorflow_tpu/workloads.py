@@ -293,13 +293,53 @@ def get_workload(name: str, *, test_size: bool = False,
             layout=gpt_layout(),
             finalize=finalize,
         )
+    if name == "gpt_moe":
+        from .models.gpt_moe import (
+            GPTMoELM,
+            bind_expert_parallel,
+            gpt_moe_layout,
+            gpt_moe_small,
+            gpt_moe_tiny,
+            moe_lm_loss,
+        )
+
+        cfg = gpt_moe_tiny() if test_size else gpt_moe_small()
+        seq = 64 if test_size else 2048
+        gbs = global_batch_size or (8 if test_size else 64)
+        model = GPTMoELM(cfg)  # local (replicated) experts until for_mesh
+
+        def finalize(wl: Workload, mesh) -> Workload:
+            # With a real expert axis, swap in the all_to_all shard_map
+            # dispatch region (SURVEY.md §2.4 EP row).
+            ep_model = bind_expert_parallel(cfg, mesh)
+            if ep_model.moe_fn is None:
+                return wl
+            return dataclasses.replace(
+                wl, model=ep_model, loss_fn=moe_lm_loss(ep_model),
+            )
+
+        return Workload(
+            name=name, model=model,
+            loss_fn=moe_lm_loss(model),
+            eval_fn=None,
+            make_optimizer=lambda: optax.adamw(3e-4, weight_decay=0.1),
+            input_fn=lambda ctx, seed: synthetic_lm(
+                ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
+            ),
+            init_batch={"input_ids": np.zeros((2, seq), np.int32)},
+            init_fn=lambda r: model.init(r, jnp.zeros((2, seq), jnp.int32)),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),
+            layout=gpt_moe_layout(),
+            finalize=finalize,
+        )
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
-        "imagenet_resnet50 bert_mlm widedeep gpt_lm"
+        "imagenet_resnet50 bert_mlm widedeep gpt_lm gpt_moe"
     )
 
 
 WORKLOADS = (
     "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "bert_mlm",
-    "widedeep", "gpt_lm",
+    "widedeep", "gpt_lm", "gpt_moe",
 )

@@ -1,0 +1,116 @@
+"""GPT-MoE: expert parallelism inside a real train step.
+
+Round-1 verdict item #5: EP must run in a zoo model with gradients through
+the router, not just as a standalone layer.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflow_tpu.models.gpt_moe import (
+    GPTMoELM,
+    bind_expert_parallel,
+    gpt_moe_layout,
+    gpt_moe_tiny,
+    moe_lm_loss,
+)
+from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+
+
+@pytest.fixture()
+def ep_mesh(devices):
+    """data=2 × expert=4 over the 8 virtual devices."""
+    return build_mesh(MeshSpec(data=2, expert=4), devices)
+
+
+def make_batch(b=8, s=64, vocab=512, seed=0):
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, vocab, size=(b, 1))
+    step = rng.integers(1, 7, size=(b, 1))
+    ids = (start + step * np.arange(s)) % vocab
+    return {"input_ids": ids.astype(np.int32)}
+
+
+def test_expert_parallel_matches_local(ep_mesh):
+    """With no capacity drops, EP all_to_all dispatch == replicated experts.
+
+    (Capacity large enough that no token is dropped: routing then reduces
+    to pure gating, which is shard-layout invariant.  With drops the two
+    differ by construction — per-shard vs global queues.)
+    """
+    cfg = dataclasses.replace(
+        gpt_moe_tiny(), dtype=jnp.float32, capacity_factor=8.0
+    )
+    local_model = GPTMoELM(cfg)
+    variables = local_model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32)
+    )
+    ids = jnp.asarray(make_batch(b=8, s=16)["input_ids"])
+
+    logits_local, aux_local = local_model.apply(variables, ids)
+    ep_model = bind_expert_parallel(cfg, ep_mesh)
+    assert ep_model.moe_fn is not None
+    logits_ep, aux_ep = jax.jit(
+        lambda v, i: ep_model.apply(v, i)
+    )(variables, ids)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_ep), np.asarray(logits_local), atol=2e-4, rtol=2e-4
+    )
+    # aux loss definition differs only by shard-mean vs global-mean of the
+    # same per-token quantities; with identical routing they agree closely
+    np.testing.assert_allclose(float(aux_ep), float(aux_local), atol=0.2)
+
+
+def test_router_gets_gradients(ep_mesh):
+    cfg = dataclasses.replace(gpt_moe_tiny(), dtype=jnp.float32)
+    model = bind_expert_parallel(cfg, ep_mesh)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32)
+    )
+    loss_fn = moe_lm_loss(model)
+    batch = {"input_ids": jnp.asarray(make_batch(b=8, s=16)["input_ids"])}
+    (_, (metrics, _)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        variables["params"], {}, batch, jax.random.PRNGKey(1)
+    )
+    assert np.isfinite(float(metrics["aux_loss"]))
+    router_grad = grads["h1"]["moe_mlp"]["router"]
+    assert float(jnp.sum(jnp.abs(router_grad))) > 0.0
+    expert_grad = grads["h1"]["moe_mlp"]["experts_in"]
+    assert float(jnp.sum(jnp.abs(expert_grad))) > 0.0
+
+
+def test_workload_trains_on_expert_mesh(ep_mesh):
+    """get_workload('gpt_moe').for_mesh(ep_mesh) → top-2 EP training."""
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    wl = get_workload("gpt_moe", test_size=True, global_batch_size=16)
+    wl = wl.for_mesh(ep_mesh)
+    assert wl.model.moe_fn is not None  # expert-parallel bound
+    assert wl.model.cfg.router == "top2"
+
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), ep_mesh,
+        jax.random.PRNGKey(0), rules=wl.layout,
+    )
+    # expert stacks actually shard over the expert axis
+    from jax.sharding import PartitionSpec as P
+
+    assert specs.params["h1"]["moe_mlp"]["experts_in"] == P(
+        "expert", None, None
+    )
+
+    step = make_train_step(wl.loss_fn, ep_mesh, specs)
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for i in range(8):
+        state, metrics = step(state, make_batch(b=16, s=64, seed=i), rng)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
