@@ -28,6 +28,8 @@ from .bootstrap import (  # noqa: F401
     process_count,
     process_index,
     resolve_cluster,
+    resolve_gce,
+    resolve_kubernetes,
     resolve_mpi,
     resolve_slurm,
     shutdown,

@@ -189,6 +189,96 @@ def resolve_mpi(env: dict[str, str]) -> ClusterConfig | None:
     )
 
 
+def resolve_kubernetes(
+    env: dict[str, str], *, coordinator_port: int = 12321
+) -> ClusterConfig | None:
+    """Resolve from Kubernetes pod env (reference ``kubernetes_cluster_resolver.py``).
+
+    The reference resolver asks the K8s API for pod IPs by label selector;
+    a JAX job instead uses the stable identities K8s already injects into
+    every pod — no API credentials or network round-trip needed:
+
+    - **Indexed Job** (``completionMode: Indexed``): rank comes from the
+      ``JOB_COMPLETION_INDEX`` env var K8s sets on each pod.
+    - **StatefulSet**: rank is the trailing ``-<n>`` ordinal of the pod
+      hostname (``myjob-3``).
+
+    World size comes from ``K8S_NUM_PODS`` (set it from
+    ``spec.completions``/``spec.replicas`` via the downward API or the
+    manifest).  The coordinator is pod 0 reached through the headless
+    service: ``<base>-0.<K8S_HEADLESS_SERVICE>:port``, overridable with
+    ``JAX_COORDINATOR_ADDRESS``.  Only activates inside a cluster
+    (``KUBERNETES_SERVICE_HOST`` is set in every pod).
+    """
+    if "KUBERNETES_SERVICE_HOST" not in env:
+        return None
+    num = int(env.get("K8S_NUM_PODS", "0"))
+    if num <= 1:
+        return None
+    hostname = env.get("HOSTNAME", "")
+    m = re.fullmatch(r"(.*)-(\d+)", hostname)
+    if "JOB_COMPLETION_INDEX" in env:  # Indexed Job
+        rank = int(env["JOB_COMPLETION_INDEX"])
+    elif m:  # StatefulSet ordinal
+        rank = int(m.group(2))
+    else:
+        return None
+    addr = env.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        svc = env.get("K8S_HEADLESS_SERVICE")
+        if not svc or not m:
+            # Without both a headless service and a `<base>-<n>` pod name
+            # there is no pod-0 DNS name to construct — fall through rather
+            # than hand jax.distributed a garbage address.
+            return None
+        port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
+        addr = f"{m.group(1)}-0.{svc}:{port}"
+    if not 0 <= rank < num:
+        raise ValueError(
+            f"K8s pod ordinal {rank} out of range for K8S_NUM_PODS={num}"
+        )
+    return ClusterConfig(
+        coordinator_address=addr, num_processes=num, process_id=rank
+    )
+
+
+def resolve_gce(
+    env: dict[str, str], *, coordinator_port: int = 12321
+) -> ClusterConfig | None:
+    """Resolve from a GCE instance group (reference ``gce_cluster_resolver.py``).
+
+    The reference resolver lists the group's instances through the Compute
+    API (credentials + network); here the launcher snapshots that list into
+    ``GCE_INSTANCE_GROUP_HOSTS`` (comma-separated hostnames, group order —
+    one ``gcloud compute instance-groups list-instances`` away), which keeps
+    the resolver hermetic and testable.  Rank is ``GCE_TASK_INDEX`` if set,
+    else this instance's position in the list (``GCE_INSTANCE_NAME`` /
+    ``HOSTNAME``).  The first instance is the coordinator, the reference's
+    task-0 convention.
+    """
+    hosts = [h for h in env.get("GCE_INSTANCE_GROUP_HOSTS", "").split(",") if h]
+    if len(hosts) <= 1:
+        return None
+    if "GCE_TASK_INDEX" in env:
+        rank = int(env["GCE_TASK_INDEX"])
+    else:
+        name = env.get("GCE_INSTANCE_NAME") or env.get("HOSTNAME", "")
+        short = {h.split(".")[0]: i for i, h in enumerate(hosts)}
+        rank = short.get(name.split(".")[0], -1)
+        if rank < 0:
+            return None
+    if not 0 <= rank < len(hosts):
+        raise ValueError(
+            f"GCE_TASK_INDEX={rank} out of range for "
+            f"{len(hosts)} instance-group hosts"
+        )
+    port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
+    addr = env.get("JAX_COORDINATOR_ADDRESS") or f"{hosts[0]}:{port}"
+    return ClusterConfig(
+        coordinator_address=addr, num_processes=len(hosts), process_id=rank
+    )
+
+
 def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     """Resolve cluster topology from the environment.
 
@@ -200,7 +290,9 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     2. ``TF_CONFIG`` — the reference's launcher contract.
     3. Slurm step env (``SLURM_PROCID``/``SLURM_NTASKS``/nodelist).
     4. OpenMPI env (``OMPI_COMM_WORLD_RANK``/``SIZE``).
-    5. Cloud TPU metadata — handled inside ``jax.distributed.initialize``
+    5. Kubernetes pod identity (Indexed Job / StatefulSet ordinal).
+    6. GCE instance-group snapshot (``GCE_INSTANCE_GROUP_HOSTS``).
+    7. Cloud TPU metadata — handled inside ``jax.distributed.initialize``
        itself (args all None); we return an "auto" marker config.
     """
     env = dict(os.environ if env is None else env)
@@ -211,12 +303,20 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
         # stale scheduler vars in the env (e.g. an interactive `srun --pty`
         # shell has SLURM_PROCID=0), the user's explicit JAX vars win.
         has_scheduler_rank = any(
-            k in env for k in ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK")
+            k in env
+            for k in (
+                "SLURM_PROCID",
+                "OMPI_COMM_WORLD_RANK",
+                "JOB_COMPLETION_INDEX",
+                "GCE_TASK_INDEX",
+            )
         )
         if "JAX_PROCESS_ID" in env or "JAX_NUM_PROCESSES" in env:
             rank = env.get("JAX_PROCESS_ID") or env.get(
                 "SLURM_PROCID"
-            ) or env.get("OMPI_COMM_WORLD_RANK") or "0"
+            ) or env.get("OMPI_COMM_WORLD_RANK") or env.get(
+                "JOB_COMPLETION_INDEX"
+            ) or env.get("GCE_TASK_INDEX") or "0"
             cfg = ClusterConfig(
                 coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
                 num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
@@ -244,7 +344,7 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
             )
     if env.get("TF_CONFIG"):
         return parse_tf_config(env["TF_CONFIG"])
-    for resolver in (resolve_slurm, resolve_mpi):
+    for resolver in (resolve_slurm, resolve_mpi, resolve_kubernetes, resolve_gce):
         cfg = resolver(env)
         if cfg is not None:
             return cfg

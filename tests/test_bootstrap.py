@@ -127,3 +127,131 @@ def test_resolve_cluster_slurm_priority():
     env["TF_CONFIG"] = _json.dumps({"cluster": {"worker": ["w:1", "v:1", "u:1"]},
                                     "task": {"type": "worker", "index": 2}})
     assert resolve_cluster(env).num_processes == 3
+
+
+def test_resolve_kubernetes_indexed_job():
+    from distributedtensorflow_tpu.parallel import resolve_kubernetes
+
+    env = {
+        "KUBERNETES_SERVICE_HOST": "10.96.0.1",
+        "K8S_NUM_PODS": "4",
+        "JOB_COMPLETION_INDEX": "2",
+        "HOSTNAME": "trainer-2",
+        "K8S_HEADLESS_SERVICE": "trainer-svc",
+    }
+    cfg = resolve_kubernetes(env)
+    assert cfg.coordinator_address == "trainer-0.trainer-svc:12321"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+    # explicit coordinator address wins
+    env["JAX_COORDINATOR_ADDRESS"] = "10.2.3.4:888"
+    assert resolve_kubernetes(env).coordinator_address == "10.2.3.4:888"
+    # outside a cluster -> None
+    assert resolve_kubernetes({"K8S_NUM_PODS": "4", "HOSTNAME": "t-0"}) is None
+    # Indexed Job with a non-ordinal hostname: explicit address still works,
+    # but without one there is no pod-0 DNS name to build -> None
+    env2 = {
+        "KUBERNETES_SERVICE_HOST": "10.96.0.1",
+        "K8S_NUM_PODS": "4",
+        "JOB_COMPLETION_INDEX": "1",
+        "HOSTNAME": "trainer-1-x7kq2",
+        "K8S_HEADLESS_SERVICE": "trainer-svc",
+    }
+    assert resolve_kubernetes(env2) is None
+    env2["JAX_COORDINATOR_ADDRESS"] = "10.9.9.9:111"
+    cfg2 = resolve_kubernetes(env2)
+    assert cfg2.process_id == 1 and cfg2.coordinator_address == "10.9.9.9:111"
+    # single pod -> None (fall through)
+    assert resolve_kubernetes(
+        {"KUBERNETES_SERVICE_HOST": "x", "K8S_NUM_PODS": "1", "HOSTNAME": "t-0"}
+    ) is None
+
+
+def test_resolve_kubernetes_statefulset_ordinal():
+    import pytest
+
+    from distributedtensorflow_tpu.parallel import resolve_kubernetes
+
+    env = {
+        "KUBERNETES_SERVICE_HOST": "10.96.0.1",
+        "K8S_NUM_PODS": "3",
+        "HOSTNAME": "bert-mlm-1",
+        "K8S_HEADLESS_SERVICE": "bert-mlm",
+        "JAX_COORDINATOR_PORT": "777",
+    }
+    cfg = resolve_kubernetes(env)
+    assert cfg.coordinator_address == "bert-mlm-0.bert-mlm:777"
+    assert cfg.num_processes == 3 and cfg.process_id == 1
+    # hostname without an ordinal cannot resolve
+    env2 = dict(env, HOSTNAME="bert")
+    assert resolve_kubernetes(env2) is None
+    # no headless service and no explicit address -> None
+    env3 = dict(env)
+    del env3["K8S_HEADLESS_SERVICE"]
+    assert resolve_kubernetes(env3) is None
+    # ordinal out of range is a loud error, not a silent mis-rank
+    with pytest.raises(ValueError):
+        resolve_kubernetes(dict(env, HOSTNAME="bert-mlm-7"))
+    # negative ranks are just as loud
+    with pytest.raises(ValueError):
+        resolve_kubernetes(
+            dict(env, JOB_COMPLETION_INDEX="-1", HOSTNAME="bert-mlm-0")
+        )
+
+
+def test_resolve_gce_instance_group():
+    import pytest
+
+    from distributedtensorflow_tpu.parallel import resolve_gce
+
+    hosts = "vm-a.c.proj.internal,vm-b.c.proj.internal,vm-c.c.proj.internal"
+    env = {"GCE_INSTANCE_GROUP_HOSTS": hosts, "GCE_TASK_INDEX": "1"}
+    cfg = resolve_gce(env)
+    assert cfg.coordinator_address == "vm-a.c.proj.internal:12321"
+    assert cfg.num_processes == 3 and cfg.process_id == 1
+    # rank from hostname position when GCE_TASK_INDEX is absent
+    cfg = resolve_gce({"GCE_INSTANCE_GROUP_HOSTS": hosts, "HOSTNAME": "vm-c"})
+    assert cfg.process_id == 2
+    # hostname not in the group -> None (fall through)
+    assert resolve_gce(
+        {"GCE_INSTANCE_GROUP_HOSTS": hosts, "HOSTNAME": "other"}
+    ) is None
+    # <=1 host -> None
+    assert resolve_gce({"GCE_INSTANCE_GROUP_HOSTS": "vm-a"}) is None
+    assert resolve_gce({}) is None
+    with pytest.raises(ValueError):
+        resolve_gce({"GCE_INSTANCE_GROUP_HOSTS": hosts, "GCE_TASK_INDEX": "9"})
+    with pytest.raises(ValueError):
+        resolve_gce({"GCE_INSTANCE_GROUP_HOSTS": hosts, "GCE_TASK_INDEX": "-1"})
+
+
+def test_jax_native_branch_derives_rank_from_k8s_and_gce():
+    # JAX_COORDINATOR_ADDRESS + JAX_NUM_PROCESSES exported by a K8s manifest:
+    # the JAX-native branch must derive the rank from JOB_COMPLETION_INDEX
+    # (and GCE_TASK_INDEX), not default every pod to rank 0.
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "svc-0.svc:12321",
+        "JAX_NUM_PROCESSES": "4",
+        "JOB_COMPLETION_INDEX": "3",
+    }
+    assert resolve_cluster(env).process_id == 3
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "vm-a:12321",
+        "JAX_NUM_PROCESSES": "3",
+        "GCE_TASK_INDEX": "2",
+    }
+    assert resolve_cluster(env).process_id == 2
+
+
+def test_resolve_cluster_k8s_and_gce_in_chain():
+    env = {
+        "KUBERNETES_SERVICE_HOST": "10.96.0.1",
+        "K8S_NUM_PODS": "2",
+        "HOSTNAME": "w-1",
+        "K8S_HEADLESS_SERVICE": "w",
+        "GCE_INSTANCE_GROUP_HOSTS": "a,b,c",
+        "GCE_TASK_INDEX": "0",
+    }
+    # K8s outranks GCE in the chain
+    assert resolve_cluster(env).num_processes == 2
+    del env["KUBERNETES_SERVICE_HOST"]
+    assert resolve_cluster(env).num_processes == 3
