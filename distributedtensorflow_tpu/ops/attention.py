@@ -21,19 +21,30 @@ def dot_product_attention(
     v: jax.Array,  # (B, S, H, D)
     *,
     mask: jax.Array | None = None,  # broadcastable to (B, H, Sq, Sk); True=keep
+    segment_ids: jax.Array | None = None,  # int (B, S): packed sequences
     causal: bool = False,
     implementation: str = "auto",  # "auto" | "xla" | "pallas"
 ) -> jax.Array:
     """Multi-head scaled dot-product attention, BSHD layout.
 
     ``implementation="auto"`` picks the Pallas flash kernel on TPU when the
-    shapes allow, else the XLA path.
+    shapes allow, else the XLA path.  ``segment_ids`` restricts attention to
+    within packed segments (BERT-style example packing); on the XLA path it
+    lowers to a block-diagonal mask, on the Pallas path it stays O(S) memory.
     """
     if implementation in ("auto", "pallas"):
         from . import flash_attention  # noqa: PLC0415 (lazy: pallas optional)
 
-        if flash_attention.supported(q, k, v, mask=mask) or implementation == "pallas":
-            return flash_attention.flash_attention(q, k, v, mask=mask, causal=causal)
+        if (
+            flash_attention.supported(q, k, v, mask=mask, segment_ids=segment_ids)
+            or implementation == "pallas"
+        ):
+            return flash_attention.flash_attention(
+                q, k, v, mask=mask, segment_ids=segment_ids, causal=causal
+            )
+    if segment_ids is not None:
+        seg = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None, :, :]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
     return xla_attention(q, k, v, mask=mask, causal=causal)
 
 

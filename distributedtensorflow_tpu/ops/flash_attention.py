@@ -59,7 +59,7 @@ def _on_tpu() -> bool:
 MIN_SEQ_FOR_PALLAS = 4096
 
 
-def supported(q, k, v, *, mask=None) -> bool:
+def supported(q, k, v, *, mask=None, segment_ids=None) -> bool:
     """True when auto-dispatch should take the Pallas kernel for this call."""
     if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
         return False
@@ -69,6 +69,8 @@ def supported(q, k, v, *, mask=None) -> bool:
     if seq < MIN_SEQ_FOR_PALLAS or _pick_block_q(seq) is None:
         return False
     if q.dtype not in (jnp.bfloat16, jnp.float32):
+        return False
+    if segment_ids is not None and not _is_segment_ids(segment_ids, q.shape):
         return False
     return mask is None or _is_padding_mask(mask, q.shape)
 
@@ -86,6 +88,15 @@ def _as_padding_mask(mask, qshape):
     return mask.reshape(b, s).astype(jnp.bool_)
 
 
+def _is_segment_ids(segment_ids, qshape) -> bool:
+    """(B, S) integer ids: tokens attend only within their own segment
+    (packed-sequence / example-packing semantics, BERT-style pretraining)."""
+    return (
+        tuple(segment_ids.shape) == (qshape[0], qshape[1])
+        and jnp.issubdtype(segment_ids.dtype, jnp.integer)
+    )
+
+
 # --- Forward kernel ---------------------------------------------------------
 
 
@@ -99,9 +110,17 @@ def _pick_block_k(seq_len: int) -> int | None:
     return None
 
 
+def _segment_mask(s, qseg_ref, kseg_ref):
+    """Mask score tile entries whose q and k tokens are in different packed
+    segments (qseg: (block_q,), kseg: (block_k,))."""
+    qseg = qseg_ref[0, 0, :]
+    kseg = kseg_ref[0, 0, :]
+    return jnp.where(qseg[:, None] == kseg[None, :], s, NEG_INF)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, block_q, block_k, causal,
-                have_mask, mask_ref=None):
+                have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None):
     """One (q-block, k-block) grid step of online-softmax accumulation.
 
     Grid is (B, H, n_q, n_k) with k innermost; the m/l/acc state for the
@@ -141,6 +160,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if have_mask:
             keep = mask_ref[0, 0, :]  # (block_k,)
             s = jnp.where(keep[None, :], s, NEG_INF)
+        if qseg_ref is not None:
+            s = _segment_mask(s, qseg_ref, kseg_ref)
         m_prev = m_scr[:, :1]  # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -166,7 +187,51 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         )
 
 
-def _flash_forward(q, k, v, mask, *, causal, interpret):
+def _extra_specs_and_args(mask, segment_ids, batch, seq, block_q, block_k,
+                          mem, *, swap_grid=False):
+    """(in_specs, args, ref_names) for the optional mask / segment-id inputs.
+
+    ``swap_grid``: the dkv kernel's grid is (B, H, n_k, n_q) — its index_map
+    axis roles are swapped relative to the fwd/dq grids.
+    """
+    if swap_grid:
+        kidx = lambda b, h, j, i: (b, 0, j)
+        qidx = lambda b, h, j, i: (b, 0, i)
+    else:
+        kidx = lambda b, h, i, j: (b, 0, j)
+        qidx = lambda b, h, i, j: (b, 0, i)
+    specs, args, names = [], [], []
+    if mask is not None:
+        specs.append(pl.BlockSpec((1, 1, block_k), kidx, memory_space=mem))
+        args.append(mask.reshape(batch, 1, seq))
+        names.append("mask_ref")
+    if segment_ids is not None:
+        seg3 = segment_ids.reshape(batch, 1, seq).astype(jnp.int32)
+        specs.append(pl.BlockSpec((1, 1, block_q), qidx, memory_space=mem))
+        args.append(seg3)
+        names.append("qseg_ref")
+        specs.append(pl.BlockSpec((1, 1, block_k), kidx, memory_space=mem))
+        args.append(seg3)
+        names.append("kseg_ref")
+    return specs, args, names
+
+
+def _wrap_kernel(inner, n_fixed_in, extra_names, **kw):
+    """Adapt ``inner(*fixed_refs, *outs_and_scratch, **extras, **kw)`` to the
+    positional ref list pallas_call passes (fixed inputs, extra inputs,
+    outputs+scratch)."""
+    n_extra = len(extra_names)
+
+    def kernel(*refs):
+        fixed = refs[:n_fixed_in]
+        extras = dict(zip(extra_names, refs[n_fixed_in:n_fixed_in + n_extra]))
+        rest = refs[n_fixed_in + n_extra:]
+        inner(*fixed, *rest, have_mask="mask_ref" in extras, **extras, **kw)
+
+    return kernel
+
+
+def _flash_forward(q, k, v, mask, segment_ids, *, causal, interpret):
     batch, seq, heads, depth = q.shape
     block_q = _pick_block_q(seq)
     block_k = _pick_block_k(seq)
@@ -186,34 +251,18 @@ def _flash_forward(q, k, v, mask, *, causal, interpret):
         (1, 1, block_k, depth), lambda b, h, i, j: (b, h, j, 0),
         memory_space=mem,
     )
-    in_specs = [qspec, kvspec, kvspec]
-    args = [qt, kt, vt]
-    have_mask = mask is not None
-    if have_mask:
-        in_specs.append(
-            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j),
-                         memory_space=mem)
-        )
-        args.append(mask.reshape(batch, 1, seq))
-
-    common = dict(scale=scale, block_q=block_q, block_k=block_k,
-                  causal=causal)
-    if have_mask:
-        def kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr):
-            _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                        m_scr, l_scr, acc_scr, have_mask=True,
-                        mask_ref=mask_ref, **common)
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr):
-            _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                        m_scr, l_scr, acc_scr, have_mask=False, **common)
+    extra_specs, extra_args, extra_names = _extra_specs_and_args(
+        mask, segment_ids, batch, seq, block_q, block_k, mem
+    )
+    kernel = _wrap_kernel(
+        _fwd_kernel, 3, extra_names,
+        scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+    )
 
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=in_specs,
+        in_specs=[qspec, kvspec, kvspec, *extra_specs],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, depth),
                          lambda b, h, i, j: (b, h, i, 0), memory_space=mem),
@@ -231,7 +280,7 @@ def _flash_forward(q, k, v, mask, *, causal, interpret):
             pltpu.VMEM((block_q, depth), jnp.float32),  # output accumulator
         ],
         interpret=interpret,
-    )(*args)
+    )(qt, kt, vt, *extra_args)
     return o.transpose(0, 2, 1, 3), lse[:, :, 0, :]
 
 
@@ -247,7 +296,7 @@ BACKWARD_IMPL = "pallas"
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, scale, block_q, block_k, causal,
-                   have_mask, mask_ref=None):
+                   have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None):
     """dq for one q-block, accumulated over the k sweep (k innermost).
 
     Recomputes the p-tile from the saved LSE:
@@ -285,6 +334,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         if have_mask:
             keep = mask_ref[0, 0, :]  # (block_k,)
             s = jnp.where(keep[None, :], s, NEG_INF)
+        if qseg_ref is not None:
+            s = _segment_mask(s, qseg_ref, kseg_ref)
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
@@ -305,7 +356,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q,
-                    block_k, causal, have_mask, mask_ref=None):
+                    block_k, causal, have_mask, mask_ref=None,
+                    qseg_ref=None, kseg_ref=None):
     """dk/dv for one k-block, accumulated over the q sweep (q innermost).
 
       dv = sum_q p^T @ g
@@ -344,6 +396,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         if have_mask:
             keep = mask_ref[0, 0, :]  # (block_k,)
             s = jnp.where(keep[None, :], s, NEG_INF)
+        if qseg_ref is not None:
+            s = _segment_mask(s, qseg_ref, kseg_ref)
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
         dv_scr[:, :] = dv_scr[:, :] + jax.lax.dot_general(
@@ -368,18 +422,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_backward_pallas(res, g, *, causal, interpret):
-    q, k, v, mask, o, lse = res
+    q, k, v, mask, segment_ids, o, lse = res
     # delta = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it.
     delta = jnp.einsum(
         "bqhd,bqhd->bhq", g.astype(jnp.float32), o.astype(jnp.float32)
     )
     return _flash_backward_pallas_core(
-        q, k, v, mask, g, lse, delta, causal=causal, interpret=interpret
+        q, k, v, mask, g, lse, delta, segment_ids=segment_ids,
+        causal=causal, interpret=interpret
     )
 
 
-def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *, causal,
-                                interpret):
+def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
+                                segment_ids=None, causal, interpret):
     """dq/dk/dv kernels from externally-supplied LSE and delta rows.
 
     Split out so ring attention (``parallel/ring_attention.py``) can drive
@@ -398,9 +453,6 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *, causal,
 
     qt, kt, vt, gt = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
 
-    have_mask = mask is not None
-    mask3 = mask.reshape(batch, 1, seq) if have_mask else None
-
     # --- dq kernel: grid (B, H, n_q, n_k), k innermost ---
     dq_in_specs = [
         pl.BlockSpec((1, 1, block_q, depth), lambda b, h, i, j: (b, h, i, 0),
@@ -416,27 +468,15 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *, causal,
         pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i),
                      memory_space=mem),  # delta
     ]
-    dq_args = [qt, kt, vt, gt, lse4, delta]
-    if have_mask:
-        dq_in_specs.append(
-            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j),
-                         memory_space=mem)
-        )
-        dq_args.append(mask3)
-
-    common = dict(scale=scale, block_q=block_q, block_k=block_k,
-                  causal=causal)
-    if have_mask:
-        def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                      mask_ref, dq_ref, dq_scr):
-            _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                           dq_ref, dq_scr, have_mask=True,
-                           mask_ref=mask_ref, **common)
-    else:
-        def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                      dq_ref, dq_scr):
-            _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                           dq_ref, dq_scr, have_mask=False, **common)
+    extra_specs, extra_args, extra_names = _extra_specs_and_args(
+        mask, segment_ids, batch, seq, block_q, block_k, mem
+    )
+    dq_in_specs += extra_specs
+    dq_args = [qt, kt, vt, gt, lse4, delta, *extra_args]
+    dq_kernel = _wrap_kernel(
+        _bwd_dq_kernel, 6, extra_names,
+        scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+    )
 
     dqt = pl.pallas_call(
         dq_kernel,
@@ -465,26 +505,15 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *, causal,
         pl.BlockSpec((1, 1, 1, block_q), lambda b, h, j, i: (b, h, 0, i),
                      memory_space=mem),  # delta
     ]
-    dkv_args = [qt, kt, vt, gt, lse4, delta]
-    if have_mask:
-        dkv_in_specs.append(
-            pl.BlockSpec((1, 1, block_k), lambda b, h, j, i: (b, 0, j),
-                         memory_space=mem)
-        )
-        dkv_args.append(mask3)
-
-    if have_mask:
-        def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                       mask_ref, dk_ref, dv_ref, dk_scr, dv_scr):
-            _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                            dk_ref, dv_ref, dk_scr, dv_scr, have_mask=True,
-                            mask_ref=mask_ref, **common)
-    else:
-        def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_scr, dv_scr):
-            _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                            dk_ref, dv_ref, dk_scr, dv_scr, have_mask=False,
-                            **common)
+    extra_specs2, extra_args2, extra_names2 = _extra_specs_and_args(
+        mask, segment_ids, batch, seq, block_q, block_k, mem, swap_grid=True
+    )
+    dkv_in_specs += extra_specs2
+    dkv_args = [qt, kt, vt, gt, lse4, delta, *extra_args2]
+    dkv_kernel = _wrap_kernel(
+        _bwd_dkv_kernel, 6, extra_names2,
+        scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+    )
 
     dkt, dvt = pl.pallas_call(
         dkv_kernel,
@@ -515,7 +544,7 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *, causal,
 
 
 def _flash_backward_xla(res, g, *, causal):
-    q, k, v, mask, o, lse = res
+    q, k, v, mask, segment_ids, o, lse = res
     batch, seq, heads, depth = q.shape
     block_q = _pick_block_q(seq)
     scale = 1.0 / (depth ** 0.5)
@@ -539,10 +568,14 @@ def _flash_backward_xla(res, g, *, causal):
     lse_blocks = lse.reshape(batch, heads, n_blocks, block_q).transpose(2, 0, 1, 3)
     delta_blocks = delta.reshape(batch, heads, n_blocks, block_q).transpose(2, 0, 1, 3)
     k_pos = jnp.arange(seq)
+    seg_blocks = (
+        segment_ids.reshape(batch, n_blocks, block_q).transpose(1, 0, 2)
+        if segment_ids is not None else jnp.zeros((n_blocks, batch, 1), jnp.int32)
+    )
 
     def body(carry, xs):
         dk_acc, dv_acc = carry
-        qb, gb, lseb, deltab, blk = xs
+        qb, gb, lseb, deltab, segb, blk = xs
         s = jnp.einsum("bqhd,bkhd->bhqk", qb, kf) * scale
         if causal:
             q_pos = blk * block_q + jnp.arange(block_q)
@@ -550,6 +583,11 @@ def _flash_backward_xla(res, g, *, causal):
                           s, NEG_INF)
         if mask is not None:
             s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+        if segment_ids is not None:
+            s = jnp.where(
+                segb[:, None, :, None] == segment_ids[:, None, None, :],
+                s, NEG_INF,
+            )
         p = jnp.exp(s - lseb[:, :, :, None])  # (B, H, bq, S)
         dv_acc = dv_acc + jnp.einsum("bhqk,bqhd->bkhd", p, gb)
         dp = jnp.einsum("bqhd,bkhd->bhqk", gb, vf)
@@ -561,7 +599,8 @@ def _flash_backward_xla(res, g, *, causal):
     zeros = jnp.zeros_like(kf)
     (dk, dv), dq_blocks = jax.lax.scan(
         body, (zeros, zeros),
-        (q_blocks, g_blocks, lse_blocks, delta_blocks, jnp.arange(n_blocks)),
+        (q_blocks, g_blocks, lse_blocks, delta_blocks, seg_blocks,
+         jnp.arange(n_blocks)),
     )
     dq = dq_blocks.transpose(1, 0, 2, 3, 4).reshape(batch, seq, heads, depth)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
@@ -570,15 +609,17 @@ def _flash_backward_xla(res, g, *, causal):
 # --- Public entry with custom VJP -------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, mask, causal, interpret, backward_impl):
-    o, _ = _flash_forward(q, k, v, mask, causal=causal, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, mask, segment_ids, causal, interpret, backward_impl):
+    o, _ = _flash_forward(q, k, v, mask, segment_ids, causal=causal,
+                          interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, mask, causal, interpret, backward_impl):
-    o, lse = _flash_forward(q, k, v, mask, causal=causal, interpret=interpret)
-    return o, (q, k, v, mask, o, lse)
+def _flash_fwd(q, k, v, mask, segment_ids, causal, interpret, backward_impl):
+    o, lse = _flash_forward(q, k, v, mask, segment_ids, causal=causal,
+                            interpret=interpret)
+    return o, (q, k, v, mask, segment_ids, o, lse)
 
 
 def _flash_bwd(causal, interpret, backward_impl, res, g):
@@ -589,17 +630,20 @@ def _flash_bwd(causal, interpret, backward_impl, res, g):
         )
     else:
         dq, dk, dv = _flash_backward_xla(res, g, causal=causal)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, mask=None, causal=False, interpret=None,
-                    backward_impl=None):
+def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
+                    interpret=None, backward_impl=None):
     """Flash attention, BSHD layout; differentiable.
 
     ``mask`` is a padding mask (B, S) or (B, 1, 1, S), True = attend.
+    ``segment_ids`` is an int (B, S) array for packed sequences (BERT-style
+    example packing): tokens attend only within their own segment; composes
+    with ``mask`` and ``causal``.
     ``interpret=None`` auto-selects interpreter mode off-TPU (for tests).
     ``backward_impl`` picks the backward: None = module ``BACKWARD_IMPL``
     default, "pallas" = kernel, "xla" = blockwise-recompute golden path.
@@ -622,7 +666,12 @@ def flash_attention(q, k, v, *, mask=None, causal=False, interpret=None,
             f"mask shape {mask.shape} unsupported: need (B, S) or "
             "(B, 1, 1, S) padding mask"
         )
+    if segment_ids is not None and not _is_segment_ids(segment_ids, q.shape):
+        raise ValueError(
+            f"segment_ids shape/dtype unsupported: need int (B, S), got "
+            f"{segment_ids.shape} {segment_ids.dtype}"
+        )
     if interpret is None:
         interpret = not _on_tpu()
     pad = _as_padding_mask(mask, q.shape)
-    return _flash(q, k, v, pad, causal, interpret, backward_impl)
+    return _flash(q, k, v, pad, segment_ids, causal, interpret, backward_impl)

@@ -296,21 +296,14 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
        itself (args all None); we return an "auto" marker config.
     """
     env = dict(os.environ if env is None else env)
+    saw_dangling_addr = False
     if env.get("JAX_COORDINATOR_ADDRESS"):
         # Rank precedence: JAX_PROCESS_ID, else a scheduler rank var (a
-        # multi-task Slurm/MPI launch with the JAX vars exported), else 0.
-        # An explicit JAX_NUM_PROCESSES always selects this path — even with
-        # stale scheduler vars in the env (e.g. an interactive `srun --pty`
-        # shell has SLURM_PROCID=0), the user's explicit JAX vars win.
-        has_scheduler_rank = any(
-            k in env
-            for k in (
-                "SLURM_PROCID",
-                "OMPI_COMM_WORLD_RANK",
-                "JOB_COMPLETION_INDEX",
-                "GCE_TASK_INDEX",
-            )
-        )
+        # multi-task Slurm/MPI/K8s/GCE launch with the JAX vars exported),
+        # else 0.  An explicit JAX_NUM_PROCESSES always selects this path —
+        # even with stale scheduler vars in the env (e.g. an interactive
+        # `srun --pty` shell has SLURM_PROCID=0), the user's explicit JAX
+        # vars win.
         if "JAX_PROCESS_ID" in env or "JAX_NUM_PROCESSES" in env:
             rank = env.get("JAX_PROCESS_ID") or env.get(
                 "SLURM_PROCID"
@@ -336,12 +329,7 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
                     cfg.process_id,
                 )
             return cfg
-        if not has_scheduler_rank:
-            logger.warning(
-                "JAX_COORDINATOR_ADDRESS set but neither JAX_PROCESS_ID nor "
-                "JAX_NUM_PROCESSES present and no Slurm/MPI env to derive a "
-                "rank from; treating as local"
-            )
+        saw_dangling_addr = True  # warn only if nothing downstream resolves
     if env.get("TF_CONFIG"):
         return parse_tf_config(env["TF_CONFIG"])
     for resolver in (resolve_slurm, resolve_mpi, resolve_kubernetes, resolve_gce):
@@ -353,6 +341,12 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     hostnames = env.get("TPU_WORKER_HOSTNAMES", "")
     if len([h for h in hostnames.split(",") if h]) > 1:
         return ClusterConfig(auto=True)
+    if saw_dangling_addr:
+        logger.warning(
+            "JAX_COORDINATOR_ADDRESS set but JAX_PROCESS_ID/JAX_NUM_PROCESSES "
+            "are absent and no scheduler env (TF_CONFIG/Slurm/MPI/K8s/GCE) "
+            "resolved a cluster; treating as local"
+        )
     return ClusterConfig()
 
 

@@ -100,11 +100,11 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
         kidx = (my - step) % n
 
         def diag(_):
-            return _flash_forward(q, kc, vc, None, causal=True,
+            return _flash_forward(q, kc, vc, None, None, causal=True,
                                   interpret=interpret)
 
         def past(_):
-            return _flash_forward(q, kc, vc, None, causal=False,
+            return _flash_forward(q, kc, vc, None, None, causal=False,
                                   interpret=interpret)
 
         if not causal:

@@ -16,6 +16,7 @@ point toward (SURVEY.md §7).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 import re
 from typing import Any, Callable, Sequence
@@ -25,6 +26,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
+
+logger = logging.getLogger("distributedtensorflow_tpu")
 
 PyTree = Any
 
@@ -107,6 +110,20 @@ def spec_for(
     n = partitioner.num_shards(shape, np.dtype(dtype))
     axis_size = mesh.shape[axis]
     if n < axis_size or axis_size <= 1 or shape[dim] % axis_size != 0:
+        if n >= axis_size > 1 and shape[dim] % axis_size != 0:
+            # The partitioner *wanted* this variable sharded but the dim
+            # doesn't divide the mesh axis — a large embedding silently
+            # replicating would defeat the Wide&Deep sharded-embedding
+            # path this exists for, so say it loudly (pad the vocab to a
+            # multiple of the axis size to shard it).
+            logger.warning(
+                "spec_for: %s-byte variable shape=%s wants >=%d shards but "
+                "dim %d (size %d) does not divide mesh axis %r (size %d); "
+                "REPLICATING instead. Pad the dimension to a multiple of "
+                "%d to shard it.",
+                math.prod(shape) * np.dtype(dtype).itemsize, tuple(shape),
+                n, dim, shape[dim], axis, axis_size, axis_size,
+            )
         return P()
     spec = [None] * len(shape)
     spec[dim] = axis

@@ -68,6 +68,7 @@ class Strategy:
         # the cache; per-step lambdas churn through it without growing it.
         self._jit_cache: collections.OrderedDict = collections.OrderedDict()
         self._jit_cache_max = 64
+        self._reducers: dict = {}
 
     # --- scope ------------------------------------------------------------
 
@@ -117,10 +118,42 @@ class Strategy:
             return jitted(*args, **(kwargs or {}))
 
     def reduce(self, reduce_op: str, value: jax.Array, axis=None):
-        """Cross-replica reduce of a (possibly sharded) array to a host scalar
-        per element: 'sum' | 'mean' | 'max' | 'min' over the batch dim."""
+        """Cross-replica reduce (`distribute_lib.py:1675`): 'sum' | 'mean' |
+        'max' | 'min' over ``axis`` (None = all axes).
+
+        Under SPMD a sharded ``jax.Array`` IS the across-all-replicas value,
+        so the reduction is compiled over the mesh — for sharded inputs XLA
+        emits the cross-device collective (psum-family) on device, and only
+        the reduced result is fetched to host.  The jitted reducer is cached
+        per (op, axis).
+        """
         ops = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}
-        return jax.device_get(ops[reduce_op.lower()](value, axis=axis))
+        key = (reduce_op.lower(), axis)
+        fn = self._reducers.get(key)
+        if fn is None:
+            op = ops[key[0]]  # KeyError on unknown op, matching the reference
+            fn = self._reducers[key] = jax.jit(lambda v: op(v, axis=axis))
+        with jax.sharding.set_mesh(self.mesh):
+            return jax.device_get(fn(value))
+
+    def gather(self, value: jax.Array, axis: int = 0):
+        """Reference ``Strategy.gather`` (`distribute_lib.py:2109`): the
+        per-replica shards concatenated along ``axis``, as one host array on
+        every process.
+
+        Under SPMD the global sharded array already has the concatenated
+        semantics (``axis`` is its existing batch dim, kept for signature
+        parity); this returns a fully-replicated host copy — in multi-host
+        runs the shards other processes own are all-gathered first.
+        """
+        del axis  # global arrays are already concatenated along it
+        import numpy as np
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(value, tiled=True))
+        return np.asarray(jax.device_get(value))
 
 
 class OneDeviceStrategy(Strategy):

@@ -34,10 +34,11 @@ class MetricWriter:
                 import tensorflow as tf  # noqa: PLC0415
 
                 self._tb = tf.summary.create_file_writer(logdir)
-            except Exception:  # TF missing/broken -> JSONL fallback
+            except Exception:  # TF missing/broken -> JSONL only
                 self._tb = None
-        if self._tb is None:
-            self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
+        # JSONL is always written: a human/tool-greppable record of the run
+        # (TensorBoard events are the reference-parity surface on top).
+        self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
 
     def write(self, step: int, scalars: Mapping[str, Any]) -> None:
         if not self._chief:
@@ -50,7 +51,7 @@ class MetricWriter:
                 for k, v in scalars.items():
                     tf.summary.scalar(k, v)
             self._tb.flush()
-        elif self._jsonl is not None:
+        if self._jsonl is not None:
             self._jsonl.write(json.dumps({"step": step, **scalars}) + "\n")
             self._jsonl.flush()
 

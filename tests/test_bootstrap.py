@@ -255,3 +255,26 @@ def test_resolve_cluster_k8s_and_gce_in_chain():
     assert resolve_cluster(env).num_processes == 2
     del env["KUBERNETES_SERVICE_HOST"]
     assert resolve_cluster(env).num_processes == 3
+
+
+def test_dangling_coordinator_address_warns_only_when_nothing_resolves(caplog):
+    import logging
+
+    # address alone, nothing downstream: local + loud
+    with caplog.at_level(logging.WARNING):
+        cfg = resolve_cluster({"JAX_COORDINATOR_ADDRESS": "a:1"})
+    assert cfg.num_processes == 1
+    assert any("treating as local" in r.message for r in caplog.records)
+    # same address, but K8s pod identity resolves the cluster: no warning
+    caplog.clear()
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "a:1",
+        "KUBERNETES_SERVICE_HOST": "x",
+        "K8S_NUM_PODS": "2",
+        "HOSTNAME": "w-1",
+        "K8S_HEADLESS_SERVICE": "w",
+    }
+    with caplog.at_level(logging.WARNING):
+        cfg = resolve_cluster(env)
+    assert cfg.num_processes == 2 and cfg.coordinator_address == "a:1"
+    assert not any("treating as local" in r.message for r in caplog.records)

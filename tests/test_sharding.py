@@ -116,3 +116,23 @@ def test_batch_spec_and_shard_batch(mesh8, dp_mesh):
     batch = {"x": jnp.ones((16, 3)), "y": jnp.zeros((16,))}
     out = shard_batch(batch, mesh8)
     assert out["x"].sharding.spec == P(("data", "fsdp"))
+
+
+def test_spec_for_warns_on_non_dividing_shard_request(mesh8, caplog):
+    """A partitioner that WANTS sharding but can't get it (dim does not
+    divide the mesh axis) must say so loudly, not silently replicate."""
+    import logging
+
+    part = FixedShardsPartitioner(4)
+    with caplog.at_level(logging.WARNING):
+        spec = spec_for(part, (1001, 8), np.float32, mesh8, "model")
+    assert spec == P()
+    assert any("REPLICATING" in r.message for r in caplog.records)
+    # clean paths stay quiet: dividing shard, or partitioner wants 1 shard
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        assert spec_for(part, (1000, 8), np.float32, mesh8, "model") != P()
+        assert spec_for(
+            FixedShardsPartitioner(1), (1001, 8), np.float32, mesh8, "model"
+        ) == P()
+    assert not caplog.records

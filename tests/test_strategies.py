@@ -106,3 +106,31 @@ def test_training_under_strategy_scope(devices):
         batch = device_put_batch(next(iter(wl.input_fn(ctx, 0))), strat.mesh)
         state, metrics = step(state, batch, rng)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_reduce_is_mesh_compiled_and_correct(devices):
+    from distributedtensorflow_tpu.parallel import shard_batch
+
+    strat = MirroredStrategy()
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    sharded = shard_batch({"x": jnp.asarray(x)}, strat.mesh)["x"]
+    assert float(strat.reduce("sum", sharded)) == x.sum()
+    np.testing.assert_allclose(
+        strat.reduce("mean", sharded, axis=0), x.mean(axis=0), rtol=1e-6
+    )
+    assert float(strat.reduce("max", sharded)) == x.max()
+    with pytest.raises(KeyError):
+        strat.reduce("prod", sharded)
+    # jitted reducers are cached per (op, axis)
+    assert ("sum", None) in strat._reducers
+
+
+def test_gather_returns_full_host_copy(devices):
+    from distributedtensorflow_tpu.parallel import shard_batch
+
+    strat = MirroredStrategy()
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    sharded = shard_batch({"x": jnp.asarray(x)}, strat.mesh)["x"]
+    got = strat.gather(sharded, axis=0)
+    assert isinstance(got, np.ndarray)
+    np.testing.assert_array_equal(got, x)
