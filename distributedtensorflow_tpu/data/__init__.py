@@ -18,6 +18,7 @@ from .input_pipeline import (  # noqa: F401
     current_input_context,
     device_put_batch,
     make_input_fn_dataset,
+    pack_sequences,
     shard_dataset,
     synthetic_classification,
     tfdata_iterator,

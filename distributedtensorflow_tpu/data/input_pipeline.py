@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
-from typing import Any, Callable, Iterable, Iterator
+from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
 import numpy as np
@@ -201,3 +201,60 @@ def make_input_fn_dataset(
     the user fn sees an InputContext and returns a per-host dataset/iterator."""
     ctx = current_input_context(global_batch_size)
     return input_fn(ctx), ctx
+
+
+def pack_sequences(
+    examples,
+    seq_len: int,
+    *,
+    pad_value: int = 0,
+    extra_keys: Sequence[str] = (),
+):
+    """Greedy first-fit packing of variable-length token examples.
+
+    The packed-pretraining input transform (BERT/T5-style example packing):
+    each output row concatenates whole examples until ``seq_len`` is full,
+    emitting ``segment_ids`` (1-based per packed example, 0 = padding) and
+    ``position_ids`` (restarting at 0 per example) so attention stays within
+    segments (``ops.flash_attention`` segment support) and positions are
+    per-example.
+
+    ``examples`` is an iterable of dicts with an ``input_ids`` 1-D array
+    plus any ``extra_keys`` (same length, packed alongside, padded with
+    ``-100`` for ``labels``-like keys so loss masking keeps working, else
+    ``pad_value``).
+
+    Yields dicts of (seq_len,) int32 arrays: ``input_ids``, ``segment_ids``,
+    ``position_ids``, and each extra key.  An example longer than
+    ``seq_len`` is truncated.
+    """
+    def new_row():
+        row = {
+            "input_ids": np.full(seq_len, pad_value, np.int32),
+            "segment_ids": np.zeros(seq_len, np.int32),
+            "position_ids": np.zeros(seq_len, np.int32),
+        }
+        for key in extra_keys:
+            fill = -100 if key == "labels" else pad_value
+            row[key] = np.full(seq_len, fill, np.int32)
+        return row, 0, 0  # row, used, n_segments
+
+    row, used, n_seg = new_row()
+    for ex in examples:
+        ids = np.asarray(ex["input_ids"], np.int32)[:seq_len]
+        n = len(ids)
+        if n == 0:
+            continue
+        if used + n > seq_len:
+            yield row
+            row, used, n_seg = new_row()
+        sl = slice(used, used + n)
+        row["input_ids"][sl] = ids
+        row["segment_ids"][sl] = n_seg + 1
+        row["position_ids"][sl] = np.arange(n)
+        for key in extra_keys:
+            row[key][sl] = np.asarray(ex[key], np.int32)[:n]
+        used += n
+        n_seg += 1
+    if used:
+        yield row

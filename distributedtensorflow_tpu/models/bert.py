@@ -52,7 +52,7 @@ class SelfAttention(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask, deterministic: bool):
+    def __call__(self, x, mask, deterministic: bool, segment_ids=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
         dense = lambda name: nn.DenseGeneral(
@@ -61,7 +61,7 @@ class SelfAttention(nn.Module):
         q = dense("query")(x)
         k = dense("key")(x)
         v = dense("value")(x)
-        out = dot_product_attention(q, k, v, mask=mask)
+        out = dot_product_attention(q, k, v, mask=mask, segment_ids=segment_ids)
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
         )(out)
@@ -74,10 +74,12 @@ class TransformerBlock(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask, deterministic: bool):
+    def __call__(self, x, mask, deterministic: bool, segment_ids=None):
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
-        attn_out = SelfAttention(cfg, name="attention")(x, mask, deterministic)
+        attn_out = SelfAttention(cfg, name="attention")(
+            x, mask, deterministic, segment_ids
+        )
         x = ln("ln_attn")(x + attn_out)
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
         h = nn.gelu(h)
@@ -92,13 +94,20 @@ class BertEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, segment_ids=None,
+                 position_ids=None):
+        """``segment_ids``/``position_ids`` enable packed pretraining:
+        multiple short examples share one row; attention stays within a
+        segment (flash kernel keeps it O(S) memory) and positions restart
+        per packed example when the packer supplies ``position_ids``."""
         cfg = self.cfg
         seq_len = input_ids.shape[-1]
         tok = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                        dtype=cfg.dtype, name="tok_embed")(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(seq_len)
         pos = nn.Embed(cfg.max_position, cfg.hidden_size,
-                       dtype=cfg.dtype, name="pos_embed")(jnp.arange(seq_len))
+                       dtype=cfg.dtype, name="pos_embed")(position_ids)
         x = tok + pos
         if token_type_ids is not None:
             x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
@@ -110,7 +119,9 @@ class BertEncoder(nn.Module):
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
         for i in range(cfg.num_layers):
-            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask, deterministic)
+            x = TransformerBlock(cfg, name=f"layer_{i}")(
+                x, mask, deterministic, segment_ids
+            )
         return x
 
 
@@ -121,10 +132,12 @@ class BertForMLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, segment_ids=None,
+                 position_ids=None):
         cfg = self.cfg
         encoder = BertEncoder(cfg, name="encoder")
-        x = encoder(input_ids, token_type_ids, attention_mask, deterministic)
+        x = encoder(input_ids, token_type_ids, attention_mask, deterministic,
+                    segment_ids, position_ids)
         x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
@@ -145,6 +158,8 @@ def mlm_loss(model: BertForMLM):
             batch["input_ids"],
             attention_mask=batch.get("attention_mask"),
             deterministic=False,
+            segment_ids=batch.get("segment_ids"),
+            position_ids=batch.get("position_ids"),
             rngs={"dropout": rng},
         )
         labels = batch["labels"]

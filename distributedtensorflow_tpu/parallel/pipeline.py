@@ -48,6 +48,7 @@ def pipeline_apply(
     microbatches: jax.Array,  # (n_micro, mb, ...) — same on every pipe rank
     *,
     axis_name: str = mesh_lib.AXIS_PIPE,
+    remat: bool = False,
 ) -> jax.Array:
     """Run the microbatch pipeline (shard_map-internal).
 
@@ -55,7 +56,15 @@ def pipeline_apply(
     same shape (inter-stage handoff is a fixed-size buffer).  Returns the
     final outputs (n_micro, mb, ...) — valid on the *last* pipe rank and
     broadcast to all ranks so downstream (loss) code is uniform SPMD.
+
+    ``remat=True`` checkpoints each stage invocation: the backward pass
+    recomputes stage activations per (tick) instead of storing all
+    ``n_micro + n_stages - 1`` of them — the activation-memory control that
+    motivates 1F1B schedules, obtained here by rematerialization (GPipe's
+    bubble fraction is unchanged; see :func:`gpipe_bubble_fraction`).
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     n = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
@@ -96,12 +105,14 @@ def make_pipelined_fn(
     *,
     n_microbatches: int,
     axis_name: str = mesh_lib.AXIS_PIPE,
+    remat: bool = False,
 ) -> Callable[[PyTree, jax.Array], jax.Array]:
     """Global-array entry: ``fn(stacked_params, batch) -> outputs``.
 
     ``stacked_params`` leaves carry a leading stage dim sharded over ``pipe``
     (spec prefix ``P("pipe", ...)`` — built by :func:`stack_stage_params`);
     ``batch`` (B, ...) is split into ``n_microbatches`` internally.
+    ``remat`` forwards to :func:`pipeline_apply` (per-stage recompute).
     """
     batch_axes = mesh_lib.data_axes(mesh)
 
@@ -111,7 +122,8 @@ def make_pipelined_fn(
             params = jax.tree.map(lambda p: p[0], local_params)
             mb = x.reshape(n_microbatches, x.shape[0] // n_microbatches,
                            *x.shape[1:])
-            out = pipeline_apply(stage_fn, params, mb, axis_name=axis_name)
+            out = pipeline_apply(stage_fn, params, mb, axis_name=axis_name,
+                                 remat=remat)
             return out.reshape(x.shape[0], *out.shape[2:])
 
         in_param_specs = jax.tree.map(

@@ -128,6 +128,8 @@ class Strategy:
         per (op, axis).
         """
         ops = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}
+        if isinstance(axis, list):
+            axis = tuple(axis)
         key = (reduce_op.lower(), axis)
         fn = self._reducers.get(key)
         if fn is None:

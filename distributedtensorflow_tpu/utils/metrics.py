@@ -1,9 +1,9 @@
 """Observability: chief-only metric writing + throughput counters.
 
 Reference: ``tf.summary`` event files + Keras callbacks + chief-only
-convention (SURVEY.md §5.5).  TensorBoard-compatible event output goes
-through ``tf.summary`` (TF is present for tf.data anyway); falls back to
-JSONL when TF is unavailable.
+convention (SURVEY.md §5.5).  A ``metrics.jsonl`` record is always written
+(the human/tool-greppable artifact); TensorBoard-compatible event output is
+layered on top through ``tf.summary`` when TF is importable.
 """
 
 from __future__ import annotations
