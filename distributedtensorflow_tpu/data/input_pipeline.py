@@ -209,25 +209,37 @@ def pack_sequences(
     *,
     pad_value: int = 0,
     extra_keys: Sequence[str] = (),
+    fill_values: dict | None = None,
 ):
-    """Greedy first-fit packing of variable-length token examples.
+    """Greedy next-fit packing of variable-length token examples.
 
     The packed-pretraining input transform (BERT/T5-style example packing):
-    each output row concatenates whole examples until ``seq_len`` is full,
-    emitting ``segment_ids`` (1-based per packed example, 0 = padding) and
-    ``position_ids`` (restarting at 0 per example) so attention stays within
-    segments (``ops.flash_attention`` segment support) and positions are
-    per-example.
+    each output row concatenates whole examples in arrival order until the
+    next one no longer fits (next-fit: only the currently open row is
+    considered — streaming-friendly; a bin-packing first-fit would trade
+    memory for slightly denser rows).  Emits ``segment_ids`` (1-based per
+    packed example, 0 = padding) and ``position_ids`` (restarting at 0 per
+    example) so attention stays within segments (``ops.flash_attention``
+    segment support) and positions are per-example.
 
     ``examples`` is an iterable of dicts with an ``input_ids`` 1-D array
-    plus any ``extra_keys`` (same length, packed alongside, padded with
-    ``-100`` for ``labels``-like keys so loss masking keeps working, else
-    ``pad_value``).
+    plus any ``extra_keys`` (same length, packed alongside).  Padding fill
+    per extra key comes from ``fill_values``; keys ending in ``"labels"``
+    default to ``-100`` (the ignore-index convention ``mlm_loss`` masks
+    on), everything else to ``pad_value`` — pass ``fill_values`` explicitly
+    for label-like keys under other names.
 
     Yields dicts of (seq_len,) int32 arrays: ``input_ids``, ``segment_ids``,
     ``position_ids``, and each extra key.  An example longer than
     ``seq_len`` is truncated.
     """
+    fills = {
+        key: (fill_values or {}).get(
+            key, -100 if key.endswith("labels") else pad_value
+        )
+        for key in extra_keys
+    }
+
     def new_row():
         row = {
             "input_ids": np.full(seq_len, pad_value, np.int32),
@@ -235,8 +247,7 @@ def pack_sequences(
             "position_ids": np.zeros(seq_len, np.int32),
         }
         for key in extra_keys:
-            fill = -100 if key == "labels" else pad_value
-            row[key] = np.full(seq_len, fill, np.int32)
+            row[key] = np.full(seq_len, fills[key], np.int32)
         return row, 0, 0  # row, used, n_segments
 
     row, used, n_seg = new_row()

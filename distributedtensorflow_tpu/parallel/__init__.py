@@ -31,6 +31,7 @@ from .bootstrap import (  # noqa: F401
     resolve_gce,
     resolve_kubernetes,
     resolve_mpi,
+    resolve_sagemaker,
     resolve_slurm,
     shutdown,
 )

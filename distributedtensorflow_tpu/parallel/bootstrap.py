@@ -279,6 +279,35 @@ def resolve_gce(
     )
 
 
+def resolve_sagemaker(
+    env: dict[str, str], *, coordinator_port: int = 12321
+) -> ClusterConfig | None:
+    """Resolve from SageMaker training env (reference ``sagemaker_cluster_resolver``
+    semantics, SURVEY.md §2.3): ``SM_HOSTS`` is a JSON list of container
+    hostnames, ``SM_CURRENT_HOST`` this container's.  The first host (sorted,
+    SageMaker's algo-1 convention) is the coordinator.
+    """
+    raw = env.get("SM_HOSTS")
+    if not raw:
+        return None
+    try:
+        hosts = sorted(json.loads(raw))
+    except (json.JSONDecodeError, TypeError):
+        return None
+    if len(hosts) <= 1:
+        return None
+    current = env.get("SM_CURRENT_HOST", "")
+    if current not in hosts:
+        return None
+    port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
+    addr = env.get("JAX_COORDINATOR_ADDRESS") or f"{hosts[0]}:{port}"
+    return ClusterConfig(
+        coordinator_address=addr,
+        num_processes=len(hosts),
+        process_id=hosts.index(current),
+    )
+
+
 def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     """Resolve cluster topology from the environment.
 
@@ -292,7 +321,8 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     4. OpenMPI env (``OMPI_COMM_WORLD_RANK``/``SIZE``).
     5. Kubernetes pod identity (Indexed Job / StatefulSet ordinal).
     6. GCE instance-group snapshot (``GCE_INSTANCE_GROUP_HOSTS``).
-    7. Cloud TPU metadata — handled inside ``jax.distributed.initialize``
+    7. SageMaker training env (``SM_HOSTS``/``SM_CURRENT_HOST``).
+    8. Cloud TPU metadata — handled inside ``jax.distributed.initialize``
        itself (args all None); we return an "auto" marker config.
     """
     env = dict(os.environ if env is None else env)
@@ -332,7 +362,8 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
         saw_dangling_addr = True  # warn only if nothing downstream resolves
     if env.get("TF_CONFIG"):
         return parse_tf_config(env["TF_CONFIG"])
-    for resolver in (resolve_slurm, resolve_mpi, resolve_kubernetes, resolve_gce):
+    for resolver in (resolve_slurm, resolve_mpi, resolve_kubernetes,
+                     resolve_gce, resolve_sagemaker):
         cfg = resolver(env)
         if cfg is not None:
             return cfg

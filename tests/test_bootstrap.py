@@ -278,3 +278,26 @@ def test_dangling_coordinator_address_warns_only_when_nothing_resolves(caplog):
         cfg = resolve_cluster(env)
     assert cfg.num_processes == 2 and cfg.coordinator_address == "a:1"
     assert not any("treating as local" in r.message for r in caplog.records)
+
+
+def test_resolve_sagemaker():
+    import json as _json
+
+    from distributedtensorflow_tpu.parallel import resolve_sagemaker
+
+    env = {
+        "SM_HOSTS": _json.dumps(["algo-2", "algo-1", "algo-3"]),
+        "SM_CURRENT_HOST": "algo-2",
+    }
+    cfg = resolve_sagemaker(env)
+    assert cfg.coordinator_address == "algo-1:12321"  # sorted, algo-1 leads
+    assert cfg.num_processes == 3 and cfg.process_id == 1
+    # single host / missing current host / bad JSON -> None (fall through)
+    assert resolve_sagemaker({"SM_HOSTS": '["algo-1"]',
+                              "SM_CURRENT_HOST": "algo-1"}) is None
+    assert resolve_sagemaker({"SM_HOSTS": '["a", "b"]',
+                              "SM_CURRENT_HOST": "c"}) is None
+    assert resolve_sagemaker({"SM_HOSTS": "not json"}) is None
+    assert resolve_sagemaker({}) is None
+    # part of the chain
+    assert resolve_cluster(env).num_processes == 3

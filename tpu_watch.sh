@@ -24,6 +24,9 @@ while true; do
     echo "$(date -Is) watcher: tunnel UP, running benches" >> "$LOG"
     ok=1
     BENCH_SKIP_PROBE=1 timeout 1200 python bench.py      >> "$LOG" 2>&1 || ok=0
+    # batch-size sweep: each run persists its own JSON; bench.py's cached
+    # path re-emits the best value
+    BENCH_SKIP_PROBE=1 BENCH_BATCH=256 timeout 1200 python bench.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 timeout 1200 python bench_lm.py   >> "$LOG" 2>&1 || ok=0
     BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
     if (( ok == 1 )); then
