@@ -291,9 +291,14 @@ def resolve_sagemaker(
     if not raw:
         return None
     try:
-        hosts = sorted(json.loads(raw))
-    except (json.JSONDecodeError, TypeError):
+        decoded = json.loads(raw)
+    except json.JSONDecodeError:
         return None
+    if not isinstance(decoded, list) or not all(
+        isinstance(h, str) for h in decoded
+    ):
+        return None
+    hosts = sorted(decoded)
     if len(hosts) <= 1:
         return None
     current = env.get("SM_CURRENT_HOST", "")
@@ -375,7 +380,8 @@ def resolve_cluster(env: dict[str, str] | None = None) -> ClusterConfig:
     if saw_dangling_addr:
         logger.warning(
             "JAX_COORDINATOR_ADDRESS set but JAX_PROCESS_ID/JAX_NUM_PROCESSES "
-            "are absent and no scheduler env (TF_CONFIG/Slurm/MPI/K8s/GCE) "
+            "are absent and no scheduler env (TF_CONFIG/Slurm/MPI/K8s/GCE/"
+            "SageMaker) "
             "resolved a cluster; treating as local"
         )
     return ClusterConfig()

@@ -126,8 +126,14 @@ def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
 
 
 def get_workload(name: str, *, test_size: bool = False,
-                 global_batch_size: int | None = None) -> Workload:
-    """Build a preset by name.  ``test_size`` shrinks models for CI."""
+                 global_batch_size: int | None = None,
+                 sp_scheme: str = "ring") -> Workload:
+    """Build a preset by name.  ``test_size`` shrinks models for CI.
+
+    ``sp_scheme`` picks the sequence-parallel attention used by ``gpt_lm``
+    on meshes with a ``seq`` axis: ``"ring"`` (ppermute KV rotation, flash
+    chunk kernels) or ``"ulysses"`` (all_to_all head<->sequence reshard).
+    """
     if name == "mnist_lenet":
         model = LeNet5()
         gbs = global_batch_size or 128
@@ -274,7 +280,9 @@ def get_workload(name: str, *, test_size: bool = False,
             from .parallel.ring_attention import sequence_parallel_attention_fn
 
             sp_model, sp_loss = build(
-                sequence_parallel_attention_fn(mesh, scheme="ring", causal=True)
+                sequence_parallel_attention_fn(
+                    mesh, scheme=sp_scheme, causal=True
+                )
             )
             return dataclasses.replace(wl, model=sp_model, loss_fn=sp_loss)
 

@@ -281,12 +281,12 @@ def test_dangling_coordinator_address_warns_only_when_nothing_resolves(caplog):
 
 
 def test_resolve_sagemaker():
-    import json as _json
+    import json
 
     from distributedtensorflow_tpu.parallel import resolve_sagemaker
 
     env = {
-        "SM_HOSTS": _json.dumps(["algo-2", "algo-1", "algo-3"]),
+        "SM_HOSTS": json.dumps(["algo-2", "algo-1", "algo-3"]),
         "SM_CURRENT_HOST": "algo-2",
     }
     cfg = resolve_sagemaker(env)
@@ -298,6 +298,12 @@ def test_resolve_sagemaker():
     assert resolve_sagemaker({"SM_HOSTS": '["a", "b"]',
                               "SM_CURRENT_HOST": "c"}) is None
     assert resolve_sagemaker({"SM_HOSTS": "not json"}) is None
+    # decoded JSON that is not a list of strings -> None, not a bogus cluster
+    assert resolve_sagemaker({"SM_HOSTS": '"abc"',
+                              "SM_CURRENT_HOST": "a"}) is None
+    assert resolve_sagemaker({"SM_HOSTS": '{"a": 1, "b": 2}',
+                              "SM_CURRENT_HOST": "a"}) is None
+    assert resolve_sagemaker({"SM_HOSTS": "[1, 2]"}) is None
     assert resolve_sagemaker({}) is None
     # part of the chain
     assert resolve_cluster(env).num_processes == 3

@@ -186,6 +186,28 @@ def test_tf_config_resolver_end_to_end():
     assert result.return_values[0]["gathered"] == [1.0, 2.0]
 
 
+def test_k8s_resolver_end_to_end():
+    """Indexed-Job pod identity forms the cluster (explicit coordinator
+    address override, the documented K8s manifest pattern)."""
+    port = pick_unused_port()
+    base = {
+        "KUBERNETES_SERVICE_HOST": "10.96.0.1",
+        "K8S_NUM_PODS": "2",
+        "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+        **ONE_DEV,
+    }
+    result = run(
+        _allgather_task, 2, env=base,
+        per_task_env=[
+            {"JOB_COMPLETION_INDEX": "0", "HOSTNAME": "trainer-0"},
+            {"JOB_COMPLETION_INDEX": "1", "HOSTNAME": "trainer-1"},
+        ],
+        timeout=120,
+    )
+    assert result.return_values[0]["process_count"] == 2
+    assert result.return_values[1]["gathered"] == [1.0, 2.0]
+
+
 def test_unexpected_exit_raises():
     with pytest.raises(UnexpectedSubprocessExitError) as ei:
         run(_failing_task, 2, env=ONE_DEV, timeout=120)

@@ -76,3 +76,31 @@ def test_bert_tp_sharding_applied(devices):
     # placement followed the spec
     arr = state.params["encoder"]["layer_0"]["attention"]["query"]["kernel"]
     assert arr.sharding.spec == qk
+
+
+def test_gpt_lm_ulysses_scheme(devices):
+    """sp_scheme='ulysses' trains on a seq mesh (all_to_all reshard path)."""
+    import numpy as np
+
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.train import (
+        create_sharded_state,
+        make_train_step,
+    )
+
+    mesh = build_mesh(MeshSpec(data=2, seq=2), devices[:4])
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=8,
+                      sp_scheme="ulysses").for_mesh(mesh)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, jax.random.PRNGKey(0),
+        rules=wl.layout,
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    rng = jax.random.PRNGKey(0)
+    ids = np.random.default_rng(0).integers(0, 128, (8, 64)).astype(np.int32)
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, {"input_ids": ids}, rng)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]

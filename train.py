@@ -113,6 +113,11 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--device", default=None,
                    help="reference-parity flag (tpu|cpu); default = auto")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(reruns skip the 20-40s first compile)")
+    p.add_argument("--sp-scheme", choices=("ring", "ulysses"), default="ring",
+                   help="sequence-parallel attention for gpt_lm on seq meshes")
     args = p.parse_args()
     if args.config:
         import os
@@ -129,6 +134,9 @@ def main() -> None:
     )
     if args.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    if args.compile_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     if args.deterministic:
         from distributedtensorflow_tpu.utils import enable_determinism
 
@@ -147,7 +155,7 @@ def main() -> None:
     cluster = parallel.initialize()
     wl = get_workload(
         args.workload, test_size=args.test_size,
-        global_batch_size=args.batch_size,
+        global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
     )
     spec = parse_mesh(args.mesh) or wl.mesh_spec
     mesh = parallel.build_mesh(spec)
