@@ -86,7 +86,7 @@ def _best_recent_persisted_tpu() -> dict | None:
             continue
         try:
             ts = datetime.datetime.fromisoformat(r["timestamp"]).timestamp()
-        except (KeyError, ValueError):
+        except (KeyError, ValueError, TypeError):
             ts = 0.0
         r["cached_from"] = os.path.basename(path)
         results.append((ts, r))

@@ -10,11 +10,13 @@ from .mesh import (  # noqa: F401
     BATCH_AXES,
     CANONICAL_AXES,
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
     data_axes,
     mirrored_mesh,
     multi_worker_mesh,
     one_device_mesh,
+    slice_count,
     replica_count,
 )
 from .bootstrap import (  # noqa: F401

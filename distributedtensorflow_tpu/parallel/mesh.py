@@ -144,6 +144,72 @@ def build_mesh(
     return Mesh(dev_array, CANONICAL_AXES)
 
 
+def slice_count(devices: Sequence[jax.Device] | None = None) -> int:
+    """Number of distinct TPU slices among ``devices`` (1 off-TPU).
+
+    Multi-slice jobs see a ``slice_index`` on each device; collectives
+    between slices ride DCN, within a slice ICI (SURVEY.md §5.8).
+    """
+    if devices is None:
+        devices = jax.devices()
+    return len({getattr(d, "slice_index", 0) for d in devices})
+
+
+def build_hybrid_mesh(
+    ici_spec: MeshSpec,
+    dcn_spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Multi-slice mesh: ``dcn_spec`` axes span slices (DCN), ``ici_spec``
+    axes stay within a slice (ICI torus).
+
+    The resulting mesh's axis sizes are the per-axis product of the two
+    specs; keep bandwidth-hungry axes (``model``, ``seq``) in ``ici_spec``
+    and put ``data`` (one gradient all-reduce per step, latency-tolerant)
+    across DCN — the multi-slice recipe the reference's NcclManager never
+    had to express (single-slice GPUs).
+
+    ``dcn_spec`` defaults to ``data=<n_slices>``.  Falls back to a plain
+    :func:`build_mesh` when only one slice is visible (CPU test meshes,
+    single-slice pods), resolving ``ici_spec`` over all devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_slices = slice_count(devices)
+    if n_slices == 1:
+        return build_mesh(ici_spec, devices)
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices across {n_slices} slices is ragged"
+        )
+    per_slice = len(devices) // n_slices
+    dcn_spec = dcn_spec or MeshSpec(data=n_slices)
+    dcn_shape = dcn_spec.resolve(n_slices)
+    ici_shape = ici_spec.resolve(per_slice)
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=list(devices),
+            allow_split_physical_axes=True,
+        )
+    except (NotImplementedError, ValueError):
+        # No physical-topology info.  Order devices slice-major, lay the
+        # DCN axes over the slice dimension and the ICI axes within a
+        # slice, then interleave (dcn_i, ici_i) per canonical axis — the
+        # same layout create_hybrid_device_mesh produces, minus torus
+        # awareness.  A plain reshape to the product shape would only be
+        # correct when the DCN axes happen to be the outermost ones.
+        n_axes = len(ici_shape)
+        total = tuple(d * i for d, i in zip(dcn_shape, ici_shape))
+        ordered = sorted(devices, key=lambda d: (getattr(d, "slice_index", 0),
+                                                 getattr(d, "id", 0)))
+        dev_array = np.empty(len(ordered), dtype=object)
+        dev_array[:] = ordered
+        dev_array = dev_array.reshape(*dcn_shape, *ici_shape)
+        interleave = [ax for i in range(n_axes) for ax in (i, n_axes + i)]
+        dev_array = dev_array.transpose(interleave).reshape(total)
+    return Mesh(dev_array, CANONICAL_AXES)
+
+
 # --- Strategy-zoo presets: each reference strategy is just a mesh shape. ---
 
 
@@ -159,8 +225,11 @@ def mirrored_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
 
 
 def multi_worker_mesh() -> Mesh:
-    """``MultiWorkerMirroredStrategy`` equivalent: all *global* devices on data."""
-    return build_mesh(MeshSpec(data=-1), jax.devices())
+    """``MultiWorkerMirroredStrategy`` equivalent: all *global* devices on
+    ``data`` — slice-aware: on a multi-slice job the data axis is laid out
+    with whole slices contiguous so the gradient all-reduce's intra-slice
+    phase rides ICI and only the inter-slice phase touches DCN."""
+    return build_hybrid_mesh(MeshSpec(data=-1), devices=jax.devices())
 
 
 def data_axes(mesh: Mesh) -> tuple[str, ...]:

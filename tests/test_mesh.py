@@ -66,3 +66,83 @@ def test_mesh_usable_with_jit(dp_mesh):
     xs = jax.device_put(x, NamedSharding(dp_mesh, P("data")))
     y = jax.jit(lambda a: a * 2)(xs)
     assert jnp.allclose(y, x * 2)
+
+
+def test_slice_count_cpu_is_one(devices):
+    from distributedtensorflow_tpu.parallel import slice_count
+
+    assert slice_count(devices) == 1
+
+
+def test_build_hybrid_mesh_single_slice_falls_back(devices):
+    from distributedtensorflow_tpu.parallel import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh(MeshSpec(data=2, model=4), devices=devices)
+    assert dict(mesh.shape)["data"] == 2 and dict(mesh.shape)["model"] == 4
+
+
+def test_build_hybrid_mesh_multi_slice_layout(devices, monkeypatch):
+    """2 fake slices x 4 devices: data spans slices (DCN), model stays
+    within a slice (ICI) — whole slices contiguous along the data axis."""
+    from distributedtensorflow_tpu.parallel import build_hybrid_mesh, mesh as mesh_lib
+
+    class FakeDev:  # hashable (default object identity), not iterable
+        def __init__(self, i, s):
+            self.id, self.slice_index, self.process_index = i, s, 0
+
+    fake = [FakeDev(i, i // 4) for i in range(8)]
+    # no physical topology on fakes: force the documented reshape fallback
+    monkeypatch.setattr(
+        mesh_lib.mesh_utils, "create_hybrid_device_mesh",
+        lambda *a, **k: (_ for _ in ()).throw(NotImplementedError()),
+    )
+    mesh_devs = build_hybrid_mesh(
+        MeshSpec(data=1, model=4), devices=fake
+    ).devices
+    assert mesh_devs.shape == (2, 1, 1, 1, 1, 4)
+    # each data row is one whole slice
+    for row in range(2):
+        slices = {d.slice_index for d in mesh_devs[row].flatten()}
+        assert slices == {row}
+
+
+def test_build_hybrid_mesh_ragged_slices_error():
+    import types
+
+    import pytest
+
+    from distributedtensorflow_tpu.parallel import build_hybrid_mesh
+
+    fake = [
+        types.SimpleNamespace(id=i, slice_index=0 if i < 5 else 1)
+        for i in range(7)
+    ]
+    with pytest.raises(ValueError, match="ragged"):
+        build_hybrid_mesh(MeshSpec(data=-1), devices=fake)
+
+
+def test_build_hybrid_mesh_dcn_on_inner_axis(devices, monkeypatch):
+    """dcn_spec on a non-outermost axis (pipe) still puts whole slices on
+    the DCN axis in the no-topology fallback."""
+    from distributedtensorflow_tpu.parallel import build_hybrid_mesh, mesh as mesh_lib
+
+    class FakeDev:
+        def __init__(self, i, s):
+            self.id, self.slice_index, self.process_index = i, s, 0
+
+    fake = [FakeDev(i, i // 2) for i in range(4)]  # 2 slices x 2 devices
+    monkeypatch.setattr(
+        mesh_lib.mesh_utils, "create_hybrid_device_mesh",
+        lambda *a, **k: (_ for _ in ()).throw(NotImplementedError()),
+    )
+    mesh = build_hybrid_mesh(
+        MeshSpec(data=2), dcn_spec=MeshSpec(data=1, pipe=2), devices=fake
+    )
+    devs = mesh.devices  # (data=2, fsdp=1, pipe=2, ...)
+    assert devs.shape[:3] == (2, 1, 2)
+    # the pipe axis (DCN) crosses slices; the data axis (ICI) stays within
+    for d in range(2):
+        assert {x.slice_index for x in devs[d, 0, :, 0, 0, 0].flatten()} == {0, 1}
+    for p in range(2):
+        col = devs[:, 0, p, 0, 0, 0].flatten()
+        assert len({x.slice_index for x in col}) == 1
