@@ -34,7 +34,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
-from ..parallel.pipeline import gpipe_bubble_fraction, pipeline_apply
+from ..parallel.pipeline import (
+    circular_bubble_fraction,
+    circular_pipeline_apply,
+    gpipe_bubble_fraction,
+    pipeline_apply,
+)
 from .gpt import GPTBlock, GPTConfig
 
 PyTree = Any
@@ -53,21 +58,31 @@ class PipelinedGPT:
     mesh: Mesh
     n_microbatches: int
     axis_name: str = mesh_lib.AXIS_PIPE
+    #: >1 selects the circular (interleaved) schedule: each rank holds
+    #: n_virtual non-adjacent stage chunks, shrinking the bubble
+    #: n_virtual-fold (`circular_bubble_fraction`).
+    n_virtual: int = 1
 
     def __post_init__(self):
         cfg = self.cfg
         self.n_stages = self.mesh.shape[self.axis_name]
-        if cfg.num_layers % self.n_stages:
+        total_stages = self.n_stages * self.n_virtual
+        if cfg.num_layers % total_stages:
             raise ValueError(
                 f"num_layers={cfg.num_layers} not divisible by "
-                f"pipe={self.n_stages} stages"
+                f"pipe={self.n_stages} x n_virtual={self.n_virtual} stages"
+            )
+        if self.n_virtual > 1 and self.n_microbatches < self.n_stages:
+            raise ValueError(
+                f"circular schedule needs n_microbatches >= n_stages "
+                f"({self.n_microbatches} < {self.n_stages})"
             )
         if cfg.dropout_rate:
             raise NotImplementedError(
                 "dropout inside the pipeline needs per-stage rng plumbing; "
                 "set dropout_rate=0 for pipeline parallelism"
             )
-        self.layers_per_stage = cfg.num_layers // self.n_stages
+        self.layers_per_stage = cfg.num_layers // total_stages
         self._embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="wte"
         )
@@ -88,10 +103,20 @@ class PipelinedGPT:
         def init_one(r):
             return self._block.init(r, x, positions, True)["params"]
 
-        block_rngs = jax.random.split(
-            r_blocks, self.n_stages * self.layers_per_stage
-        ).reshape(self.n_stages, self.layers_per_stage, -1)
-        blocks = jax.vmap(jax.vmap(init_one))(block_rngs)
+        # Execution-order layer k lands at [k // lps] of the stage stack;
+        # circular: stage c*n + p -> blocks[c, p] (chunk-major, rank dim
+        # second so the pipe sharding stays on one leading-ish axis).
+        if self.n_virtual > 1:
+            block_rngs = jax.random.split(
+                r_blocks,
+                self.n_virtual * self.n_stages * self.layers_per_stage,
+            ).reshape(self.n_virtual, self.n_stages, self.layers_per_stage, -1)
+            blocks = jax.vmap(jax.vmap(jax.vmap(init_one)))(block_rngs)
+        else:
+            block_rngs = jax.random.split(
+                r_blocks, self.n_stages * self.layers_per_stage
+            ).reshape(self.n_stages, self.layers_per_stage, -1)
+            blocks = jax.vmap(jax.vmap(init_one))(block_rngs)
 
         ln_params = self._ln_f.init(
             r_ln, jnp.zeros((1, cfg.hidden_size))
@@ -106,8 +131,12 @@ class PipelinedGPT:
         """(path, shape) -> spec rule: stage dim of block leaves on ``pipe``."""
         axis = self.axis_name
 
+        circular = self.n_virtual > 1
+
         def rule(path: str, shape: tuple) -> P:
             if path.startswith("blocks/") or "/blocks/" in path:
+                if circular:  # (v, n_stages, lps, ...): pipe on dim 1
+                    return P(None, axis, *([None] * (len(shape) - 2)))
                 return P(axis, *([None] * (len(shape) - 1)))
             return P()
 
@@ -140,14 +169,21 @@ class PipelinedGPT:
 
         batch_axes = mesh_lib.data_axes(self.mesh)
         x_spec = P(batch_axes if batch_axes else None, None, None)
-        block_specs = jax.tree.map(
-            lambda p: P(self.axis_name, *([None] * (p.ndim - 1))),
-            params["blocks"],
-        )
+        circular = self.n_virtual > 1
+        if circular:
+            block_specs = jax.tree.map(
+                lambda p: P(None, self.axis_name, *([None] * (p.ndim - 2))),
+                params["blocks"],
+            )
+        else:
+            block_specs = jax.tree.map(
+                lambda p: P(self.axis_name, *([None] * (p.ndim - 1))),
+                params["blocks"],
+            )
         n_micro = self.n_microbatches
+        n_virtual = self.n_virtual
 
         def inner(block_params, xl):
-            local = jax.tree.map(lambda p: p[0], block_params)  # strip stage
             if xl.shape[0] % n_micro:
                 raise ValueError(
                     f"per-host batch {xl.shape[0]} not divisible by "
@@ -156,9 +192,17 @@ class PipelinedGPT:
             mb = xl.reshape(
                 n_micro, xl.shape[0] // n_micro, *xl.shape[1:]
             )
-            out = pipeline_apply(
-                self._stage_fn, local, mb, axis_name=self.axis_name
-            )
+            if circular:
+                local = jax.tree.map(lambda p: p[:, 0], block_params)
+                out = circular_pipeline_apply(
+                    self._stage_fn, local, mb, n_virtual=n_virtual,
+                    axis_name=self.axis_name,
+                )
+            else:
+                local = jax.tree.map(lambda p: p[0], block_params)
+                out = pipeline_apply(
+                    self._stage_fn, local, mb, axis_name=self.axis_name
+                )
             return out.reshape(xl.shape)
 
         x = jax.shard_map(
@@ -172,6 +216,10 @@ class PipelinedGPT:
         return (x @ wte.T.astype(jnp.float32)).astype(jnp.float32)
 
     def bubble_fraction(self) -> float:
+        if self.n_virtual > 1:
+            return circular_bubble_fraction(
+                self.n_stages, self.n_microbatches, self.n_virtual
+            )
         return gpipe_bubble_fraction(self.n_stages, self.n_microbatches)
 
 
@@ -191,13 +239,32 @@ def pipelined_lm_loss(model: PipelinedGPT):
     return loss_fn
 
 
-def params_to_dense(pipe_params: dict, cfg: GPTConfig) -> dict:
+def params_to_dense(
+    pipe_params: dict, cfg: GPTConfig, *, n_virtual: int = 1
+) -> dict:
     """Re-arrange pipeline params into the dense :class:`GPTLM` tree
     (``h{i}`` per layer) — for parity tests and for serving a
-    pipeline-trained checkpoint on an unpipelined mesh."""
-    n_stages = jax.tree.leaves(pipe_params["blocks"])[0].shape[0]
-    layers_per_stage = cfg.num_layers // n_stages
+    pipeline-trained checkpoint on an unpipelined mesh.  ``n_virtual > 1``
+    reads the circular ``(v, n_stages, lps, ...)`` block layout (execution
+    order: stage ``c*n + p`` holds layers ``(c*n+p)*lps ...``)."""
+    leaf = jax.tree.leaves(pipe_params["blocks"])[0]
     dense = {"wte": pipe_params["wte"], "ln_f": pipe_params["ln_f"]}
+    if n_virtual > 1:
+        v, n_stages, lps = leaf.shape[:3]
+        if v != n_virtual:
+            raise ValueError(
+                f"params have {v} virtual chunks, caller said {n_virtual}"
+            )
+        for c in range(v):
+            for p_ in range(n_stages):
+                for j in range(lps):
+                    k = (c * n_stages + p_) * lps + j
+                    dense[f"h{k}"] = jax.tree.map(
+                        lambda q: q[c][p_][j], pipe_params["blocks"]
+                    )
+        return dense
+    n_stages = leaf.shape[0]
+    layers_per_stage = cfg.num_layers // n_stages
     for s in range(n_stages):
         for j in range(layers_per_stage):
             dense[f"h{s * layers_per_stage + j}"] = jax.tree.map(

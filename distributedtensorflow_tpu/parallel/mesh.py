@@ -175,12 +175,26 @@ def build_hybrid_mesh(
     """
     if devices is None:
         devices = jax.devices()
-    n_slices = slice_count(devices)
+    slice_sizes: dict[int, int] = {}
+    for d in devices:
+        idx = getattr(d, "slice_index", 0)
+        slice_sizes[idx] = slice_sizes.get(idx, 0) + 1
+    n_slices = len(slice_sizes)
     if n_slices == 1:
+        if dcn_spec is not None:
+            # Keep the combined shape identical to the multi-slice case
+            # (elastic restore onto one slice must not halve the mesh):
+            # per-axis product, -1 wildcards preserved.
+            merged = MeshSpec(*(
+                -1 if -1 in (d, i) else d * i
+                for d, i in zip(dcn_spec.sizes(), ici_spec.sizes())
+            ))
+            return build_mesh(merged, devices)
         return build_mesh(ici_spec, devices)
-    if len(devices) % n_slices:
+    if len(set(slice_sizes.values())) != 1:
         raise ValueError(
-            f"{len(devices)} devices across {n_slices} slices is ragged"
+            f"slices have unequal device counts {slice_sizes}; a hybrid "
+            "mesh needs uniform slices (whole slices lie along DCN axes)"
         )
     per_slice = len(devices) // n_slices
     dcn_spec = dcn_spec or MeshSpec(data=n_slices)

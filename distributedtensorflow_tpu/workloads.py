@@ -127,12 +127,15 @@ def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
 
 def get_workload(name: str, *, test_size: bool = False,
                  global_batch_size: int | None = None,
-                 sp_scheme: str = "ring") -> Workload:
+                 sp_scheme: str = "ring",
+                 pp_virtual: int = 1) -> Workload:
     """Build a preset by name.  ``test_size`` shrinks models for CI.
 
     ``sp_scheme`` picks the sequence-parallel attention used by ``gpt_lm``
     on meshes with a ``seq`` axis: ``"ring"`` (ppermute KV rotation, flash
     chunk kernels) or ``"ulysses"`` (all_to_all head<->sequence reshard).
+    ``pp_virtual > 1`` selects the circular (interleaved) pipeline schedule
+    for ``gpt_lm`` on meshes with a ``pipe`` axis.
     """
     if name == "mnist_lenet":
         model = LeNet5()
@@ -264,7 +267,8 @@ def get_workload(name: str, *, test_size: bool = False,
                 )
                 while n_micro > 1 and local_batch % n_micro:
                     n_micro //= 2
-                pp = PipelinedGPT(cfg, mesh, n_microbatches=n_micro)
+                pp = PipelinedGPT(cfg, mesh, n_microbatches=n_micro,
+                                  n_virtual=pp_virtual)
                 return dataclasses.replace(
                     wl,
                     model=pp,

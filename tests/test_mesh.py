@@ -117,7 +117,7 @@ def test_build_hybrid_mesh_ragged_slices_error():
         types.SimpleNamespace(id=i, slice_index=0 if i < 5 else 1)
         for i in range(7)
     ]
-    with pytest.raises(ValueError, match="ragged"):
+    with pytest.raises(ValueError, match="unequal"):
         build_hybrid_mesh(MeshSpec(data=-1), devices=fake)
 
 
@@ -146,3 +146,29 @@ def test_build_hybrid_mesh_dcn_on_inner_axis(devices, monkeypatch):
     for p in range(2):
         col = devs[:, 0, p, 0, 0, 0].flatten()
         assert len({x.slice_index for x in col}) == 1
+
+
+def test_build_hybrid_mesh_unequal_slices_error():
+    import pytest
+
+    from distributedtensorflow_tpu.parallel import build_hybrid_mesh
+
+    class FakeDev:
+        def __init__(self, i, s):
+            self.id, self.slice_index, self.process_index = i, s, 0
+
+    fake = [FakeDev(i, 0 if i < 3 else 1) for i in range(8)]  # 3 + 5
+    with pytest.raises(ValueError, match="unequal"):
+        build_hybrid_mesh(MeshSpec(data=-1), devices=fake)
+
+
+def test_build_hybrid_mesh_single_slice_honors_dcn_spec(devices):
+    """Elastic restore onto one slice keeps the combined mesh shape."""
+    from distributedtensorflow_tpu.parallel import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh(
+        MeshSpec(data=1, model=4), dcn_spec=MeshSpec(data=2), devices=devices
+    )
+    shape = dict(mesh.shape)
+    assert shape["data"] == 2 and shape["model"] == 4
+    assert mesh.devices.size == 8  # all devices used, none dropped

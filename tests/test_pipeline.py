@@ -104,3 +104,114 @@ def test_pipeline_remat_matches_no_remat(pipe_mesh):
     for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(gr)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
+
+
+def make_circular(pipe_mesh, n_virtual=2, width=16):
+    from distributedtensorflow_tpu.parallel import (
+        make_circular_pipelined_fn,
+        stack_circular_stage_params,
+    )
+
+    model = StageMLP(width)
+    init_fn = lambda r: model.init(r, jnp.zeros((1, width)))["params"]
+    n_stages = pipe_mesh.shape["pipe"]
+    stacked, specs = stack_circular_stage_params(
+        init_fn, n_stages, n_virtual, jax.random.PRNGKey(0), pipe_mesh
+    )
+    stage_fn = lambda p, x: model.apply({"params": p}, x)
+    return model, stacked, specs, stage_fn
+
+
+def circular_sequential_ref(model, stacked, x):
+    """Apply all v*n stages in execution order k -> [k//n, k%n]."""
+    leaves = jax.tree.leaves(stacked)
+    v, n = leaves[0].shape[0], leaves[0].shape[1]
+    for k in range(v * n):
+        params = jax.tree.map(lambda p: np.asarray(p)[k // n, k % n], stacked)
+        x = model.apply({"params": params}, x)
+    return x
+
+
+@pytest.mark.parametrize("n_micro,n_virtual", [(4, 2), (8, 2), (4, 1), (8, 3)])
+def test_circular_pipeline_matches_sequential(pipe_mesh, n_micro, n_virtual):
+    from distributedtensorflow_tpu.parallel import make_circular_pipelined_fn
+
+    model, stacked, specs, stage_fn = make_circular(pipe_mesh, n_virtual)
+    fn = make_circular_pipelined_fn(
+        stage_fn, pipe_mesh, specs,
+        n_microbatches=n_micro, n_virtual=n_virtual,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro * 4, 16))
+    out = fn(stacked, x)
+    ref = circular_sequential_ref(model, stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_circular_pipeline_gradients_match(pipe_mesh):
+    from distributedtensorflow_tpu.parallel import make_circular_pipelined_fn
+
+    model, stacked, specs, stage_fn = make_circular(pipe_mesh, n_virtual=2)
+    fn = make_circular_pipelined_fn(
+        stage_fn, pipe_mesh, specs, n_microbatches=4, n_virtual=2,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 16))
+
+    def loss_pipe(params):
+        return jnp.sum(fn(params, x) ** 2)
+
+    def loss_seq(params):
+        leaves = jax.tree.leaves(params)
+        v, n = leaves[0].shape[0], leaves[0].shape[1]
+        y = x
+        for k in range(v * n):
+            p = jax.tree.map(lambda q: q[k // n, k % n], params)
+            y = model.apply({"params": p}, y)
+        return jnp.sum(y ** 2)
+
+    gp = jax.grad(loss_pipe)(stacked)
+    gs = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_circular_needs_enough_microbatches(pipe_mesh):
+    from distributedtensorflow_tpu.parallel import make_circular_pipelined_fn
+
+    _, _, specs, stage_fn = make_circular(pipe_mesh)
+    with pytest.raises(ValueError, match="n_microbatches >= n_stages"):
+        make_circular_pipelined_fn(
+            stage_fn, pipe_mesh, specs, n_microbatches=2, n_virtual=2
+        )
+
+
+def test_circular_bubble_smaller_than_gpipe():
+    from distributedtensorflow_tpu.parallel import (
+        circular_bubble_fraction,
+        gpipe_bubble_fraction,
+    )
+
+    # same total stage count (16) and microbatches: interleaving wins
+    assert circular_bubble_fraction(4, 16, 4) < gpipe_bubble_fraction(16, 16)
+    assert abs(circular_bubble_fraction(4, 16, 1)
+               - gpipe_bubble_fraction(4, 16)) < 1e-12
+
+
+def test_circular_v1_matches_gpipe(pipe_mesh):
+    """The two schedules are maintained separately (the circular wrap
+    buffer would be dead weight in the GPipe scan carry); this pins them
+    to each other so they cannot drift."""
+    from distributedtensorflow_tpu.parallel import make_circular_pipelined_fn
+
+    model, stacked, specs, stage_fn = setup(pipe_mesh)
+    gpipe = make_pipelined_fn(stage_fn, pipe_mesh, specs, n_microbatches=4)
+    circ_stack = jax.tree.map(lambda p: p[None], stacked)  # (1, n, ...)
+    circular = make_circular_pipelined_fn(
+        stage_fn, pipe_mesh, specs, n_microbatches=4, n_virtual=1
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 16))
+    np.testing.assert_allclose(
+        np.asarray(circular(circ_stack, x)), np.asarray(gpipe(stacked, x)),
+        atol=1e-6, rtol=1e-6,
+    )

@@ -118,6 +118,9 @@ def main() -> None:
                         "(reruns skip the 20-40s first compile)")
     p.add_argument("--sp-scheme", choices=("ring", "ulysses"), default="ring",
                    help="sequence-parallel attention for gpt_lm on seq meshes")
+    p.add_argument("--pp-virtual", type=int, default=1,
+                   help="virtual pipeline chunks per rank (>1 = circular/"
+                        "interleaved schedule, smaller bubble)")
     args = p.parse_args()
     if args.config:
         import os
@@ -156,6 +159,7 @@ def main() -> None:
     wl = get_workload(
         args.workload, test_size=args.test_size,
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
+        pp_virtual=args.pp_virtual,
     )
     spec = parse_mesh(args.mesh) or wl.mesh_spec
     mesh = parallel.build_mesh(spec)
