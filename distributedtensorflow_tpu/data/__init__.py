@@ -20,6 +20,7 @@ from .input_pipeline import (  # noqa: F401
     make_input_fn_dataset,
     pack_sequences,
     shard_dataset,
+    skip_batches,
     synthetic_classification,
     tfdata_iterator,
 )

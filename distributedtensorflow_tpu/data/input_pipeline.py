@@ -20,6 +20,7 @@ vs compute-bound separation).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Sequence
@@ -29,6 +30,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 from ..parallel import sharding as shardlib
+
+logger = logging.getLogger("distributedtensorflow_tpu")
 
 PyTree = Any
 
@@ -269,3 +272,26 @@ def pack_sequences(
         n_seg += 1
     if used:
         yield row
+
+
+def skip_batches(it: Iterator[PyTree], n: int) -> Iterator[PyTree]:
+    """Fast-forward an input iterator past ``n`` already-consumed batches.
+
+    The resume-position half of the reference's tf.data iterator
+    checkpointing (`input_lib.py` iterators save their position with the
+    model): our inputs are deterministic functions of (seed, step), so
+    restoring to step N means draining N batches — otherwise a resumed run
+    re-trains on the first N batches and diverges from the uninterrupted
+    run.  Generation-cost note: synthetic sources regenerate in microseconds;
+    recordio sources re-read (the tf.data ``skip()`` cost) — callers with a
+    step-keyed source can seek instead.
+    """
+    for i in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            logger.warning(
+                "input exhausted after skipping %d/%d batches on resume", i, n
+            )
+            break
+    return it

@@ -65,6 +65,11 @@ class PipelinedGPT:
 
     def __post_init__(self):
         cfg = self.cfg
+        if self.n_virtual < 1:
+            raise ValueError(
+                f"n_virtual must be >= 1, got {self.n_virtual} "
+                "(--pp-virtual on the CLI)"
+            )
         self.n_stages = self.mesh.shape[self.axis_name]
         total_stages = self.n_stages * self.n_virtual
         if cfg.num_layers % total_stages:

@@ -169,9 +169,11 @@ def build_hybrid_mesh(
     across DCN — the multi-slice recipe the reference's NcclManager never
     had to express (single-slice GPUs).
 
-    ``dcn_spec`` defaults to ``data=<n_slices>``.  Falls back to a plain
-    :func:`build_mesh` when only one slice is visible (CPU test meshes,
-    single-slice pods), resolving ``ici_spec`` over all devices.
+    ``dcn_spec`` defaults to ``data=<n_slices>``.  With only one slice
+    visible (CPU test meshes, single-slice pods) the per-axis product of
+    the two specs is built over all devices via :func:`build_mesh` — the
+    same combined shape as the multi-slice case, so elastic restore onto
+    one slice keeps the mesh shape.
     """
     if devices is None:
         devices = jax.devices()

@@ -98,6 +98,57 @@ def pipeline_apply(
     return lax.psum(outputs, axis_name)
 
 
+def _make_wrapper(
+    stage_fn, mesh, param_specs, *, n_microbatches, n_virtual,
+    axis_name, remat,
+):
+    """Shared shard_map/jit wrapper for both schedules.
+
+    ``n_virtual is None`` selects the GPipe path: param leaves are
+    ``(n_stages, ...)`` with spec ``P(pipe, ...)``.  Otherwise circular:
+    leaves ``(n_virtual, n_stages, ...)`` with spec ``P(None, pipe, ...)``.
+    """
+    circular = n_virtual is not None
+    batch_axes = mesh_lib.data_axes(mesh)
+
+    def run(stacked_params, batch):
+        def inner(local_params, x):
+            if x.shape[0] % n_microbatches:
+                raise ValueError(
+                    f"per-shard batch {x.shape[0]} not divisible by "
+                    f"n_microbatches={n_microbatches}"
+                )
+            mb = x.reshape(n_microbatches, x.shape[0] // n_microbatches,
+                           *x.shape[1:])
+            if circular:
+                params = jax.tree.map(lambda p: p[:, 0], local_params)
+                out = circular_pipeline_apply(
+                    stage_fn, params, mb, n_virtual=n_virtual,
+                    axis_name=axis_name, remat=remat,
+                )
+            else:
+                # shard_map leaves the size-1 stage dim on the leading axis
+                params = jax.tree.map(lambda p: p[0], local_params)
+                out = pipeline_apply(stage_fn, params, mb,
+                                     axis_name=axis_name, remat=remat)
+            return out.reshape(x.shape[0], *out.shape[2:])
+
+        prefix = (None, axis_name) if circular else (axis_name,)
+        in_param_specs = jax.tree.map(
+            lambda spec: P(*prefix, *spec), param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        x_spec = P(batch_axes if batch_axes else None)
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(in_param_specs, x_spec),
+            out_specs=x_spec,
+            check_vma=False,
+        )(stacked_params, batch)
+
+    return jax.jit(run)
+
+
 def make_pipelined_fn(
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
     mesh: Mesh,
@@ -114,31 +165,10 @@ def make_pipelined_fn(
     ``batch`` (B, ...) is split into ``n_microbatches`` internally.
     ``remat`` forwards to :func:`pipeline_apply` (per-stage recompute).
     """
-    batch_axes = mesh_lib.data_axes(mesh)
-
-    def run(stacked_params, batch):
-        def inner(local_params, x):
-            # shard_map leaves the size-1 stage dim on the leading axis
-            params = jax.tree.map(lambda p: p[0], local_params)
-            mb = x.reshape(n_microbatches, x.shape[0] // n_microbatches,
-                           *x.shape[1:])
-            out = pipeline_apply(stage_fn, params, mb, axis_name=axis_name,
-                                 remat=remat)
-            return out.reshape(x.shape[0], *out.shape[2:])
-
-        in_param_specs = jax.tree.map(
-            lambda spec: P(axis_name, *spec), param_specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
-        x_spec = P(batch_axes if batch_axes else None)
-        return jax.shard_map(
-            inner, mesh=mesh,
-            in_specs=(in_param_specs, x_spec),
-            out_specs=x_spec,
-            check_vma=False,
-        )(stacked_params, batch)
-
-    return jax.jit(run)
+    return _make_wrapper(
+        stage_fn, mesh, param_specs, n_microbatches=n_microbatches,
+        n_virtual=None, axis_name=axis_name, remat=remat,
+    )
 
 
 def stack_stage_params(
@@ -304,6 +334,8 @@ def make_circular_pipelined_fn(
     ``stacked_params`` leaves are ``(n_virtual, n_stages, ...)`` with the
     stage dim sharded over ``pipe`` (:func:`stack_circular_stage_params`).
     """
+    if n_virtual < 1:
+        raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
     n_stages = mesh.shape[axis_name]
     if n_microbatches < n_stages:
         raise ValueError(
@@ -311,29 +343,7 @@ def make_circular_pipelined_fn(
             f"({n_microbatches} < {n_stages}): the wrap-around must arrive "
             "before its re-entry slot"
         )
-    batch_axes = mesh_lib.data_axes(mesh)
-
-    def run(stacked_params, batch):
-        def inner(local_params, x):
-            params = jax.tree.map(lambda p: p[:, 0], local_params)  # (v, ...)
-            mb = x.reshape(n_microbatches, x.shape[0] // n_microbatches,
-                           *x.shape[1:])
-            out = circular_pipeline_apply(
-                stage_fn, params, mb, n_virtual=n_virtual,
-                axis_name=axis_name, remat=remat,
-            )
-            return out.reshape(x.shape[0], *out.shape[2:])
-
-        in_param_specs = jax.tree.map(
-            lambda spec: P(None, axis_name, *spec), param_specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
-        x_spec = P(batch_axes if batch_axes else None)
-        return jax.shard_map(
-            inner, mesh=mesh,
-            in_specs=(in_param_specs, x_spec),
-            out_specs=x_spec,
-            check_vma=False,
-        )(stacked_params, batch)
-
-    return jax.jit(run)
+    return _make_wrapper(
+        stage_fn, mesh, param_specs, n_microbatches=n_microbatches,
+        n_virtual=n_virtual, axis_name=axis_name, remat=remat,
+    )

@@ -79,3 +79,36 @@ def test_tfdata_sharding():
     sharded = shard_dataset(tf.data.Dataset.range(100), ctx).batch(10)
     vals = np.concatenate(list(tfdata_iterator(sharded)))
     np.testing.assert_array_equal(vals, np.arange(1, 100, 2))
+
+
+def test_skip_batches_resume_position():
+    from distributedtensorflow_tpu.data import skip_batches
+    from distributedtensorflow_tpu.data.input_pipeline import (
+        InputContext,
+        synthetic_classification,
+    )
+
+    ctx = InputContext(1, 0, 8)
+    full = list(synthetic_classification(
+        ctx, image_shape=(4, 4, 1), num_classes=10, seed=7, steps=10
+    ))
+    resumed = skip_batches(
+        iter(synthetic_classification(
+            ctx, image_shape=(4, 4, 1), num_classes=10, seed=7, steps=10
+        )), 4,
+    )
+    got = list(resumed)
+    assert len(got) == 6
+    np.testing.assert_array_equal(got[0]["label"], full[4]["label"])
+    np.testing.assert_allclose(got[0]["image"], full[4]["image"])
+
+
+def test_skip_batches_past_end_warns(caplog):
+    import logging
+
+    from distributedtensorflow_tpu.data import skip_batches
+
+    with caplog.at_level(logging.WARNING):
+        it = skip_batches(iter([1, 2]), 5)
+    assert list(it) == []
+    assert any("exhausted" in r.message for r in caplog.records)

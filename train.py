@@ -194,14 +194,23 @@ def main() -> None:
             )
 
     ctx = current_input_context(wl.global_batch_size)
-    train_iter = Prefetcher(wl.input_fn(ctx, args.seed), mesh)
+    raw_iter = wl.input_fn(ctx, args.seed)
 
     checkpointer = None
     if args.checkpoint_dir:
         from distributedtensorflow_tpu.checkpoint import CheckpointManager
+        from distributedtensorflow_tpu.data import skip_batches
 
         checkpointer = CheckpointManager(args.checkpoint_dir)
         state = checkpointer.restore_latest(state) or state
+        restored_step = int(state.step)
+        if restored_step > 0:
+            # resume input position: the batches before restored_step were
+            # already consumed by the interrupted run (tf.data iterator-
+            # checkpoint semantics)
+            logging.info("fast-forwarding input %d batches", restored_step)
+            raw_iter = skip_batches(iter(raw_iter), restored_step)
+    train_iter = Prefetcher(raw_iter, mesh)
 
     trainer = Trainer(
         train_step,
