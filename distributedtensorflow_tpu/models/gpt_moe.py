@@ -38,7 +38,9 @@ class GPTMoEConfig(GPTConfig):
     n_experts: int = 8
     moe_every_k: int = 2  # every k-th block is MoE (1 = all blocks)
     capacity_factor: float = 1.25
-    router: str = "top2"  # GShard default; "top1" = Switch
+    router: str = "top2"  # GShard default; "top1" = Switch.  "expert_choice"
+    # is rejected: its per-expert top-k over the whole sequence reads future
+    # tokens' router scores — invalid for a causal LM (encoder-only router).
     aux_loss_weight: float = 1e-2
 
 
@@ -128,6 +130,16 @@ class GPTMoELM(nn.Module):
 
     cfg: GPTMoEConfig
     moe_fn: MoEFn | None = None
+
+    def __post_init__(self):
+        if self.cfg.router == "expert_choice":
+            raise ValueError(
+                "expert_choice routing is non-causal (each expert's top-k "
+                "reads the whole sequence's router scores, future tokens "
+                "included) — invalid for this autoregressive LM. Use it in "
+                "encoder models; pick 'top1' or 'top2' here."
+            )
+        super().__post_init__()
 
     @nn.compact
     def __call__(self, input_ids, *, deterministic: bool = True):

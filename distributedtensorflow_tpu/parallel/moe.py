@@ -101,9 +101,43 @@ def top2_route(
     return dispatch, combine, aux
 
 
-ROUTERS = {"top1": top1_route, "top2": top2_route}
-#: assignments per token, for capacity scaling (GShard: top-2 needs 2x slots)
-_ASSIGNMENTS = {"top1": 1, "top2": 2}
+def expert_choice_route(
+    logits: jax.Array,  # (T, E) router logits
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Expert-choice routing (Zhou et al. 2022): each EXPERT selects its
+    top-``capacity`` tokens by router probability — the inverted assignment.
+
+    Load balance is perfect *by construction* (every expert processes
+    exactly ``capacity`` tokens), so no auxiliary loss is needed:
+    ``aux_loss`` is a constant 0.  Tokens may be chosen by zero experts
+    (they ride the residual path) or by several (their outputs sum,
+    weighted by the selecting experts' probabilities).  Same return
+    contract as :func:`top1_route`.
+
+    **Not causal**: whether token t is selected depends on every other
+    token's router score — including future positions.  Use only in
+    encoder / non-autoregressive settings (the EC paper's domain);
+    ``models/gpt_moe.py`` rejects it for the causal LM.
+    """
+    t, e = logits.shape
+    capacity = min(capacity, t)  # an expert cannot pick more tokens than exist
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+    gates, token_idx = jax.lax.top_k(probs.T, capacity)  # (E, C) both
+    dispatch = jax.nn.one_hot(token_idx, t, dtype=jnp.float32)  # (E, C, T)
+    dispatch = dispatch.transpose(2, 0, 1)  # (T, E, C)
+    combine = dispatch * gates[None, :, :]
+    return dispatch, combine, jnp.zeros((), jnp.float32)
+
+
+ROUTERS = {
+    "top1": top1_route,
+    "top2": top2_route,
+    "expert_choice": expert_choice_route,
+}
+#: assignments per token, for capacity scaling (GShard: top-2 needs 2x slots;
+#: expert-choice capacity is the EC paper's k = cf * T / E).
+_ASSIGNMENTS = {"top1": 1, "top2": 2, "expert_choice": 1}
 
 
 def expert_parallel_moe(
@@ -118,7 +152,8 @@ def expert_parallel_moe(
 ) -> tuple[jax.Array, jax.Array]:
     """MoE layer body (shard_map-internal). Returns (out, aux_loss).
 
-    ``router``: "top1" (Switch) or "top2" (GShard).  ``expert_params``
+    ``router``: "top1" (Switch), "top2" (GShard), or "expert_choice"
+    (encoder-only — see :func:`expert_choice_route`).  ``expert_params``
     leading dim is the local expert count; global expert count
     E = E_local * axis_size.  Dropped-over-capacity tokens contribute 0
     here (caller keeps them on the residual path).

@@ -114,3 +114,17 @@ def test_workload_trains_on_expert_mesh(ep_mesh):
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0], losses
+
+
+def test_gpt_moe_rejects_expert_choice():
+    """Expert-choice routing reads future tokens' router scores (per-expert
+    top-k over the whole sequence) — invalid for a causal LM, so the model
+    refuses it at construction; the router stays available for encoder use
+    (tests in test_moe.py)."""
+    import dataclasses
+
+    from distributedtensorflow_tpu.models.gpt_moe import GPTMoELM, gpt_moe_tiny
+
+    cfg = dataclasses.replace(gpt_moe_tiny(), router="expert_choice")
+    with pytest.raises(ValueError, match="non-causal"):
+        GPTMoELM(cfg)
