@@ -99,6 +99,38 @@ def synthetic_mlm(ctx: InputContext, *, vocab_size: int, seq_len: int,
         }
 
 
+def synthetic_packed_mlm(ctx: InputContext, *, vocab_size: int,
+                         seq_len: int, mask_rate: float = 0.15,
+                         seed: int = 0) -> Iterator[dict]:
+    """Packed masked-LM batches: variable-length synthetic examples packed
+    into fixed rows by :func:`data.pack_sequences`, with ``segment_ids`` /
+    ``position_ids`` so attention stays within packed examples (the
+    BERT-style example-packing pipeline, wired to the flash kernel's
+    segment support)."""
+    from .data.input_pipeline import pack_sequences
+
+    rng = np.random.default_rng(seed + ctx.input_pipeline_id)
+    n = ctx.per_host_batch_size
+
+    def examples():
+        while True:
+            length = int(rng.integers(seq_len // 4, 3 * seq_len // 4))
+            ids = rng.integers(4, vocab_size, size=(length,))
+            mask = rng.random(length) < mask_rate
+            yield {
+                "input_ids": np.where(mask, 3, ids),  # 3 = [MASK]
+                "labels": np.where(mask, ids, -100),
+            }
+
+    rows = pack_sequences(examples(), seq_len, extra_keys=("labels",))
+    while True:
+        batch = [next(rows) for _ in range(n)]
+        yield {
+            k: np.stack([r[k] for r in batch]).astype(np.int32)
+            for k in batch[0]
+        }
+
+
 def synthetic_lm(ctx: InputContext, *, vocab_size: int, seq_len: int,
                  seed: int = 0) -> Iterator[dict]:
     """Synthetic next-token LM batches (structured so loss can fall)."""
@@ -183,24 +215,43 @@ def get_workload(name: str, *, test_size: bool = False,
             global_batch_size=gbs,
             mesh_spec=MeshSpec(data=-1),  # MultiWorkerMirrored: all devices
         )
-    if name == "bert_mlm":
+    if name in ("bert_mlm", "bert_mlm_packed"):
+        # Config #4 (BERT-base MLM, CollectiveAllReduce + grad accum).  The
+        # "_packed" variant feeds example-packed rows (multiple short
+        # examples per row, segment-restricted attention via the flash
+        # kernel's segment support, per-example positions) — the packed
+        # pretraining pipeline; everything else is identical.
+        packed = name.endswith("_packed")
         cfg = bert_tiny() if test_size else bert_base()
         model = BertForMLM(cfg)
         gbs = global_batch_size or 256
         seq = 128 if test_size else 512
+        if packed:
+            input_fn = lambda ctx, seed: synthetic_packed_mlm(
+                ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
+            )
+            init_batch = {
+                "input_ids": np.zeros((2, seq), np.int32),
+                "labels": np.zeros((2, seq), np.int32),
+                "segment_ids": np.zeros((2, seq), np.int32),
+                "position_ids": np.zeros((2, seq), np.int32),
+            }
+        else:
+            input_fn = lambda ctx, seed: synthetic_mlm(
+                ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
+            )
+            init_batch = {
+                "input_ids": np.zeros((2, seq), np.int32),
+                "labels": np.zeros((2, seq), np.int32),
+                "attention_mask": np.ones((2, seq), np.int32),
+            }
         return Workload(
             name=name, model=model,
             loss_fn=mlm_loss(model),
             eval_fn=None,
             make_optimizer=lambda: optax.adamw(1e-4, weight_decay=0.01),
-            input_fn=lambda ctx, seed: synthetic_mlm(
-                ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
-            ),
-            init_batch={
-                "input_ids": np.zeros((2, seq), np.int32),
-                "labels": np.zeros((2, seq), np.int32),
-                "attention_mask": np.ones((2, seq), np.int32),
-            },
+            input_fn=input_fn,
+            init_batch=init_batch,
             init_fn=lambda r: model.init(r, jnp.zeros((2, seq), jnp.int32)),
             global_batch_size=gbs,
             mesh_spec=MeshSpec(data=-1),
@@ -347,11 +398,12 @@ def get_workload(name: str, *, test_size: bool = False,
         )
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
-        "imagenet_resnet50 bert_mlm widedeep gpt_lm gpt_moe"
+        "imagenet_resnet50 bert_mlm bert_mlm_packed widedeep gpt_lm "
+        "gpt_moe"
     )
 
 
 WORKLOADS = (
     "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "bert_mlm",
-    "widedeep", "gpt_lm", "gpt_moe",
+    "bert_mlm_packed", "widedeep", "gpt_lm", "gpt_moe",
 )
