@@ -20,26 +20,45 @@ import jax.numpy as jnp
 from .gpt import GPTConfig, GPTLM
 
 
-def _sample(logits, rng, temperature, *, greedy: bool, top_k: int):
+def _sample(logits, rng, temperature, *, greedy: bool, top_k: int,
+            top_p: float = 1.0):
     """(B, V) logits -> (B,) token ids.  ``temperature`` is traced (no
-    recompile per value); only greedy/top_k change the compiled program."""
+    recompile per value); greedy/top_k/top_p change the compiled program."""
     if greedy:
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.maximum(temperature, 1e-6)
+    sorted_desc = None
     if top_k > 0:
         topv, _ = jax.lax.top_k(logits, top_k)  # O(V log k), no full sort
         kth = topv[:, -1][:, None]
         logits = jnp.where(logits < kth, -1e9, logits)
+        sorted_desc = topv  # the only survivors; already descending
+    if top_p < 1.0:
+        # nucleus sampling: keep the smallest descending-prob prefix with
+        # cumulative mass >= top_p (the first token is always kept).  After
+        # top_k only the k survivors can be in the nucleus, so reuse them
+        # instead of a full O(V log V) sort per decoded token; the -1e9
+        # masked tail's softmax mass is ~0, so probs match the full-vocab
+        # softmax over survivors.
+        if sorted_desc is None:
+            sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+        kept = exclusive_cum < top_p
+        cutoff = jnp.min(
+            jnp.where(kept, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -1e9, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "greedy", "top_k"),
+    static_argnames=("cfg", "max_new_tokens", "greedy", "top_k", "top_p"),
 )
 def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
                    cfg: GPTConfig, max_new_tokens: int, greedy: bool,
-                   top_k: int):
+                   top_k: int, top_p: float):
     model = GPTLM(cfg, decode=True)
     b, prompt_pad = prompt.shape
     total = prompt_pad + max_new_tokens
@@ -61,7 +80,7 @@ def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
         tokens, cache, rng, logits = carry
         rng, sub = jax.random.split(rng)
         sampled = _sample(logits[:, -1], sub, temperature, greedy=greedy,
-                          top_k=top_k)
+                          top_k=top_k, top_p=top_p)
         # While t+1 is still inside this sequence's prompt, feed the prompt
         # token; afterwards feed the sample (teacher-forced prefill and
         # decode in one uniform loop — no separate prefill program).
@@ -93,14 +112,18 @@ def generate(
     prompt_lens: jax.Array | None = None,  # (B,) true lengths; default P
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """Generate continuations; returns (B, P + max_new_tokens) token ids.
 
     ``temperature=0`` is greedy; otherwise softmax sampling at the given
-    temperature, optionally truncated to the ``top_k`` highest logits.
+    temperature, optionally truncated to the ``top_k`` highest logits
+    and/or the ``top_p`` nucleus (smallest probability mass >= top_p).
     The KV cache needs ``cfg.max_seq >= P + max_new_tokens``.
     """
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, p = prompt.shape
     total = p + max_new_tokens
     if cfg.max_seq < total:
@@ -116,4 +139,5 @@ def generate(
         jnp.asarray(temperature, jnp.float32),
         cfg=cfg, max_new_tokens=max_new_tokens,
         greedy=float(temperature) <= 0.0, top_k=int(top_k),
+        top_p=float(top_p),
     )

@@ -134,3 +134,45 @@ def test_max_seq_guard():
     small = dataclasses.replace(cfg, max_seq=10)
     with pytest.raises(ValueError, match="max_seq"):
         generate(params, ids, cfg=small, max_new_tokens=6)
+
+
+def test_top_p_tiny_nucleus_equals_greedy():
+    """top_p -> 0 keeps only the argmax token: sampling == greedy."""
+    cfg, _, params, prompt = _setup(seq=4, batch=1)
+    greedy = generate(params, prompt, cfg=cfg, max_new_tokens=6)
+    nucleus = generate(
+        params, prompt, cfg=cfg, max_new_tokens=6,
+        temperature=1.0, top_p=1e-6, rng=jax.random.PRNGKey(3),
+    )
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+
+
+def test_top_p_one_is_unrestricted():
+    cfg, _, params, prompt = _setup(seq=4, batch=1)
+    a = generate(params, prompt, cfg=cfg, max_new_tokens=6,
+                 temperature=1.0, rng=jax.random.PRNGKey(4))
+    b = generate(params, prompt, cfg=cfg, max_new_tokens=6,
+                 temperature=1.0, top_p=1.0, rng=jax.random.PRNGKey(4))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_p_validation():
+    import pytest
+
+    cfg, _, params, prompt = _setup(seq=4, batch=1)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(params, prompt, cfg=cfg, max_new_tokens=2, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(params, prompt, cfg=cfg, max_new_tokens=2, top_p=1.5)
+
+
+def test_top_p_composes_with_top_k():
+    """top_k + top_p: output tokens always come from the top_k set, and the
+    fast path (nucleus over the k survivors) equals greedy for tiny top_p."""
+    cfg, _, params, prompt = _setup(seq=4, batch=1)
+    greedy = generate(params, prompt, cfg=cfg, max_new_tokens=6)
+    both = generate(
+        params, prompt, cfg=cfg, max_new_tokens=6,
+        temperature=1.0, top_k=8, top_p=1e-6, rng=jax.random.PRNGKey(5),
+    )
+    np.testing.assert_array_equal(np.asarray(both), np.asarray(greedy))
