@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
 import jax
 
@@ -118,12 +119,24 @@ def main() -> None:
                         "(reruns skip the 20-40s first compile)")
     p.add_argument("--sp-scheme", choices=("ring", "ulysses"), default="ring",
                    help="sequence-parallel attention for gpt_lm on seq meshes")
+    p.add_argument("--data-dir", default=None, metavar="DIR",
+                   help="train from record files (*.tfrecord/*.rio, written "
+                        "by data.write_record_shards) instead of the "
+                        "workload's synthetic input; keys must match the "
+                        "workload's batch keys")
+    p.add_argument("--eval-data-dir", default=None, metavar="DIR",
+                   help="record files for eval; defaults to --data-dir "
+                        "(use a held-out split for honest numbers)")
+    p.add_argument("--autoshard", choices=("AUTO", "FILE", "DATA", "OFF"),
+                   default="AUTO", help="per-host input sharding policy for "
+                                        "--data-dir (reference AutoShardPolicy)")
+    p.add_argument("--shuffle-buffer", type=int, default=4096,
+                   help="record shuffle buffer for --data-dir (0 = off)")
     p.add_argument("--pp-virtual", type=int, default=1,
                    help="virtual pipeline chunks per rank (>1 = circular/"
                         "interleaved schedule, smaller bubble)")
     args = p.parse_args()
     if args.config:
-        import os
         import sys
 
         if os.path.exists(args.config):
@@ -194,7 +207,41 @@ def main() -> None:
             )
 
     ctx = current_input_context(wl.global_batch_size)
-    raw_iter = wl.input_fn(ctx, args.seed)
+
+    def record_files(data_dir):
+        import glob as globlib
+
+        files = sorted(
+            f for pat in ("*.tfrecord", "*.rio", "*.rec")
+            for f in globlib.glob(os.path.join(data_dir, pat))
+        )
+        if not files:
+            raise SystemExit(f"{data_dir}: no record files")
+        return files
+
+    def repeated_records(files, seed):
+        """Epoch-cycling record stream (tf.data ``repeat()`` semantics):
+        a finite file set must not end training with StopIteration; each
+        epoch reshuffles with a distinct seed."""
+        from distributedtensorflow_tpu.data import record_dataset
+
+        epoch = 0
+        while True:
+            yield from record_dataset(
+                files, ctx, batch_size=ctx.per_host_batch_size,
+                policy=args.autoshard, shuffle_buffer=args.shuffle_buffer,
+                seed=seed + epoch,
+            )
+            epoch += 1
+            logging.info("input epoch %d complete", epoch)
+
+    if args.data_dir:
+        files = record_files(args.data_dir)
+        logging.info("reading %d record files (%s sharding)",
+                     len(files), args.autoshard)
+        raw_iter = repeated_records(files, args.seed)
+    else:
+        raw_iter = wl.input_fn(ctx, args.seed)
 
     checkpointer = None
     if args.checkpoint_dir:
@@ -218,6 +265,8 @@ def main() -> None:
             total_steps=args.steps,
             log_every=args.log_every,
             eval_every=args.eval_every,
+            # record-backed eval is one finite pass: evaluate it exactly
+            eval_steps=0 if (args.data_dir or args.eval_data_dir) else 10,
             checkpoint_every=args.checkpoint_every,
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
@@ -234,7 +283,22 @@ def main() -> None:
     )
     eval_iter_fn = None
     if args.eval_every and eval_step is not None:
-        eval_iter_fn = lambda: Prefetcher(wl.input_fn(ctx, args.seed + 999), mesh)
+        if args.data_dir or args.eval_data_dir:
+            from distributedtensorflow_tpu.data import record_dataset
+
+            eval_files = record_files(args.eval_data_dir or args.data_dir)
+            # one finite pass, no shuffle: with eval_steps <= 0 the trainer
+            # does a dataset-wide exact eval over these files
+            eval_iter_fn = lambda: Prefetcher(
+                record_dataset(eval_files, ctx,
+                               batch_size=ctx.per_host_batch_size,
+                               policy=args.autoshard, shuffle_buffer=0),
+                mesh,
+            )
+        else:
+            eval_iter_fn = lambda: Prefetcher(
+                wl.input_fn(ctx, args.seed + 999), mesh
+            )
     state = trainer.fit(state, train_iter, rng, eval_iter_fn=eval_iter_fn)
     logging.info("done at step %d", int(state.step))
 
