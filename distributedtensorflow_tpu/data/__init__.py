@@ -4,6 +4,7 @@ from .recordio_dataset import (  # noqa: F401
     decode_example,
     encode_example,
     record_dataset,
+    repeated_record_dataset,
     write_example,
     write_record_shards,
 )

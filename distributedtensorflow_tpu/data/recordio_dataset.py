@@ -178,3 +178,41 @@ def write_record_shards(
         for w in writers:
             w.close()
     return paths
+
+
+def repeated_record_dataset(
+    files: Sequence[str],
+    ctx: InputContext | None = None,
+    *,
+    batch_size: int | None = None,
+    policy: str = "AUTO",
+    shuffle_buffer: int = 0,
+    seed: int = 0,
+    on_epoch=None,
+) -> Iterator[Example]:
+    """Endless epoch-cycling stream over record files (tf.data ``repeat()``).
+
+    Finite files must not end training with StopIteration; each epoch
+    reshuffles with ``seed + epoch``.  ``on_epoch(epoch)`` (optional) is
+    called after each completed pass — the trainer logs it.
+    """
+    epoch = 0
+    while True:
+        yielded = False
+        for batch in record_dataset(
+            files, ctx, batch_size=batch_size, policy=policy,
+            shuffle_buffer=shuffle_buffer, seed=seed + epoch,
+        ):
+            yielded = True
+            yield batch
+        if not yielded:
+            # drop_remainder batching of an undersized shard: without this
+            # the loop would re-read the files forever yielding nothing.
+            raise ValueError(
+                f"record epoch produced 0 batches from {len(files)} files "
+                f"(batch_size={batch_size}): this host's shard holds fewer "
+                "examples than one batch — shrink the batch or add data"
+            )
+        epoch += 1
+        if on_epoch is not None:
+            on_epoch(epoch)

@@ -134,6 +134,18 @@ def expand_nodelist(nodelist: str) -> list[str]:
     return out
 
 
+def _coordinator_addr(
+    env: dict[str, str], default_host: str, coordinator_port: int
+) -> str:
+    """Explicit ``JAX_COORDINATOR_ADDRESS`` wins; else ``default_host`` with
+    ``JAX_COORDINATOR_PORT`` (or the resolver's default port)."""
+    addr = env.get("JAX_COORDINATOR_ADDRESS")
+    if addr:
+        return addr
+    port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
+    return f"{default_host}:{port}"
+
+
 def resolve_slurm(
     env: dict[str, str], *, coordinator_port: int = 12321
 ) -> ClusterConfig | None:
@@ -158,8 +170,7 @@ def resolve_slurm(
         nodes = expand_nodelist(nodelist) if nodelist else []
         if not nodes:
             return None
-        port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
-        addr = f"{nodes[0]}:{port}"
+        addr = _coordinator_addr(env, nodes[0], coordinator_port)
     return ClusterConfig(
         coordinator_address=addr,
         num_processes=ntasks,
@@ -231,8 +242,7 @@ def resolve_kubernetes(
             # there is no pod-0 DNS name to construct — fall through rather
             # than hand jax.distributed a garbage address.
             return None
-        port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
-        addr = f"{m.group(1)}-0.{svc}:{port}"
+        addr = _coordinator_addr(env, f"{m.group(1)}-0.{svc}", coordinator_port)
     if not 0 <= rank < num:
         raise ValueError(
             f"K8s pod ordinal {rank} out of range for K8S_NUM_PODS={num}"
@@ -272,8 +282,7 @@ def resolve_gce(
             f"GCE_TASK_INDEX={rank} out of range for "
             f"{len(hosts)} instance-group hosts"
         )
-    port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
-    addr = env.get("JAX_COORDINATOR_ADDRESS") or f"{hosts[0]}:{port}"
+    addr = _coordinator_addr(env, hosts[0], coordinator_port)
     return ClusterConfig(
         coordinator_address=addr, num_processes=len(hosts), process_id=rank
     )
@@ -304,10 +313,8 @@ def resolve_sagemaker(
     current = env.get("SM_CURRENT_HOST", "")
     if current not in hosts:
         return None
-    port = int(env.get("JAX_COORDINATOR_PORT", str(coordinator_port)))
-    addr = env.get("JAX_COORDINATOR_ADDRESS") or f"{hosts[0]}:{port}"
     return ClusterConfig(
-        coordinator_address=addr,
+        coordinator_address=_coordinator_addr(env, hosts[0], coordinator_port),
         num_processes=len(hosts),
         process_id=hosts.index(current),
     )

@@ -80,14 +80,6 @@ def test_bert_tp_sharding_applied(devices):
 
 def test_gpt_lm_ulysses_scheme(devices):
     """sp_scheme='ulysses' trains on a seq mesh (all_to_all reshard path)."""
-    import numpy as np
-
-    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
-    from distributedtensorflow_tpu.train import (
-        create_sharded_state,
-        make_train_step,
-    )
-
     mesh = build_mesh(MeshSpec(data=2, seq=2), devices[:4])
     wl = get_workload("gpt_lm", test_size=True, global_batch_size=8,
                       sp_scheme="ulysses").for_mesh(mesh)

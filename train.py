@@ -219,27 +219,18 @@ def main() -> None:
             raise SystemExit(f"{data_dir}: no record files")
         return files
 
-    def repeated_records(files, seed):
-        """Epoch-cycling record stream (tf.data ``repeat()`` semantics):
-        a finite file set must not end training with StopIteration; each
-        epoch reshuffles with a distinct seed."""
-        from distributedtensorflow_tpu.data import record_dataset
-
-        epoch = 0
-        while True:
-            yield from record_dataset(
-                files, ctx, batch_size=ctx.per_host_batch_size,
-                policy=args.autoshard, shuffle_buffer=args.shuffle_buffer,
-                seed=seed + epoch,
-            )
-            epoch += 1
-            logging.info("input epoch %d complete", epoch)
-
     if args.data_dir:
+        from distributedtensorflow_tpu.data import repeated_record_dataset
+
         files = record_files(args.data_dir)
         logging.info("reading %d record files (%s sharding)",
                      len(files), args.autoshard)
-        raw_iter = repeated_records(files, args.seed)
+        raw_iter = repeated_record_dataset(
+            files, ctx, batch_size=ctx.per_host_batch_size,
+            policy=args.autoshard, shuffle_buffer=args.shuffle_buffer,
+            seed=args.seed,
+            on_epoch=lambda e: logging.info("input epoch %d complete", e),
+        )
     else:
         raw_iter = wl.input_fn(ctx, args.seed)
 
@@ -265,8 +256,10 @@ def main() -> None:
             total_steps=args.steps,
             log_every=args.log_every,
             eval_every=args.eval_every,
-            # record-backed eval is one finite pass: evaluate it exactly
-            eval_steps=0 if (args.data_dir or args.eval_data_dir) else 10,
+            # an explicit held-out record split is evaluated exactly (one
+            # full pass); eval on the training files stays bounded so large
+            # datasets don't pay a full re-read every eval_every steps
+            eval_steps=0 if args.eval_data_dir else 10,
             checkpoint_every=args.checkpoint_every,
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
@@ -287,14 +280,21 @@ def main() -> None:
             from distributedtensorflow_tpu.data import record_dataset
 
             eval_files = record_files(args.eval_data_dir or args.data_dir)
-            # one finite pass, no shuffle: with eval_steps <= 0 the trainer
-            # does a dataset-wide exact eval over these files
+            # one finite unshuffled pass, ragged final batch kept (the
+            # trainer weights it by example count)
             eval_iter_fn = lambda: Prefetcher(
                 record_dataset(eval_files, ctx,
                                batch_size=ctx.per_host_batch_size,
-                               policy=args.autoshard, shuffle_buffer=0),
+                               policy=args.autoshard, shuffle_buffer=0,
+                               drop_remainder=False),
                 mesh,
             )
+            if not args.eval_data_dir:
+                logging.warning(
+                    "no --eval-data-dir: eval reads the TRAINING files "
+                    "(bounded to eval_steps batches; pass a held-out split "
+                    "for a dataset-wide exact eval)"
+                )
         else:
             eval_iter_fn = lambda: Prefetcher(
                 wl.input_fn(ctx, args.seed + 999), mesh
