@@ -125,10 +125,17 @@ class Prefetcher:
         except BaseException as e:  # surfaced on the consumer thread
             self._err = e
         finally:
-            try:
-                self._q.put_nowait(self._DONE)
-            except queue.Full:
-                pass
+            # The DONE sentinel must not be droppable: with a full queue a
+            # put_nowait would lose it and the consumer would block forever
+            # after draining the buffered batches (finite sources end while
+            # the queue is full whenever the consumer is slower than the
+            # producer).  Bounded put that yields to close().
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def close(self) -> None:
         """Stop the worker and release buffered device batches.

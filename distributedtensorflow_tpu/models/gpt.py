@@ -40,6 +40,10 @@ class GPTConfig:
     num_layers: int = 12
     num_heads: int = 12
     intermediate_size: int = 3072
+    #: >0 chunks the MLP over the sequence (ops.blockwise): the (B, S, d_ff)
+    #: intermediate never materializes whole — the blockwise-FFN half of the
+    #: long-context recipe (SURVEY.md §5.7). Must divide the sequence length.
+    ffn_chunk_size: int = 0
     max_seq: int = 2048
     dropout_rate: float = 0.0
     rope_theta: float = 10000.0
@@ -163,11 +167,35 @@ class GPTBlock(nn.Module):
         )(h, positions, deterministic)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
         # Column- then row-parallel MLP (Megatron split over `model`).
-        m = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False,
-                     name="fc_in")(h)
-        m = nn.gelu(m)
-        m = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, use_bias=False,
-                     name="fc_out")(m)
+        fc_in = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                         use_bias=False, name="fc_in")
+        fc_out = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, use_bias=False,
+                          name="fc_out")
+
+        def mlp(hc):
+            return fc_out(nn.gelu(fc_in(hc)))
+
+        if cfg.ffn_chunk_size > 0 and not self.decode:
+            from ..ops.blockwise import blockwise_map
+
+            if h.shape[1] % cfg.ffn_chunk_size:
+                # silent dense fallback would materialize the full
+                # (B, S, d_ff) intermediate exactly when the user asked
+                # for the memory bound — fail loudly instead
+                raise ValueError(
+                    f"ffn_chunk_size={cfg.ffn_chunk_size} does not divide "
+                    f"sequence length {h.shape[1]}; pick a divisor or pad"
+                )
+
+            # remat only outside init (param creation can't happen inside
+            # jax.checkpoint); per-chunk recompute bounds backward memory
+            # to one (B, chunk, d_ff) tile.
+            m = blockwise_map(
+                mlp, h, cfg.ffn_chunk_size,
+                remat=not self.is_initializing(),
+            )
+        else:
+            m = mlp(h)
         if cfg.dropout_rate:
             m = nn.Dropout(cfg.dropout_rate)(m, deterministic=deterministic)
         return x + m

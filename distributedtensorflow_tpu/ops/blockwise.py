@@ -1,0 +1,54 @@
+"""Blockwise (chunked) token-wise computation for long sequences.
+
+The feed-forward half of the blockwise-transformer recipe (SURVEY.md §5.7:
+"ring attention ... blockwise feed-forward"; the attention half is
+``ops/flash_attention.py`` + ``parallel/ring_attention.py``): a token-wise
+function applied over sequence chunks so the (B, S, d_ff) intermediate never
+materializes at once — with per-chunk rematerialization the backward pass
+peaks at one (B, chunk, d_ff) tile instead of the full sequence.
+
+Chunks are a compile-time Python loop (no ``lax.map``): each chunk is an
+independent matmul pair XLA schedules back-to-back, and flax module calls
+stay legal inside it (lifted transforms not required).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def blockwise_map(
+    fn: Callable[[jax.Array], jax.Array],
+    x: jax.Array,
+    chunk_size: int,
+    *,
+    axis: int = 1,
+    remat: bool = True,
+) -> jax.Array:
+    """Apply token-wise ``fn`` over ``chunk_size`` slices of ``axis``.
+
+    ``fn`` must be elementwise over ``axis`` (each output position depends
+    only on the same input position — true for MLPs/normalizations, NOT for
+    attention).  ``remat=True`` checkpoints each chunk: backward recomputes
+    that chunk's intermediates instead of storing all of them.  The axis
+    length must divide evenly (callers pad, or pick a divisor chunk).
+    """
+    length = x.shape[axis]
+    if chunk_size <= 0:
+        raise ValueError(f"chunk_size must be positive, got {chunk_size}")
+    if length % chunk_size:
+        raise ValueError(
+            f"axis {axis} length {length} not divisible by "
+            f"chunk_size {chunk_size}"
+        )
+    if chunk_size == length:
+        return fn(x)
+    chunk_fn = jax.checkpoint(fn) if remat else fn
+    parts = [
+        chunk_fn(jax.lax.slice_in_dim(x, i, i + chunk_size, axis=axis))
+        for i in range(0, length, chunk_size)
+    ]
+    return jnp.concatenate(parts, axis=axis)

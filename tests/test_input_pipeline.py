@@ -112,3 +112,21 @@ def test_skip_batches_past_end_warns(caplog):
         it = skip_batches(iter([1, 2]), 5)
     assert list(it) == []
     assert any("exhausted" in r.message for r in caplog.records)
+
+
+def test_prefetcher_finite_source_terminates_with_slow_consumer(dp_mesh):
+    """Regression: a finite source that ends while the queue is full must
+    still deliver the DONE sentinel — the consumer previously hung forever
+    after draining the buffered batches (put_nowait dropped the sentinel)."""
+    import time
+
+    from distributedtensorflow_tpu.data import Prefetcher
+
+    def batches():
+        for i in range(6):  # > buffer_size so the queue is full at the end
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    pf = Prefetcher(batches(), dp_mesh, buffer_size=2)
+    time.sleep(0.5)  # let the producer finish and hit the full queue
+    got = list(pf)  # must terminate, not hang
+    assert len(got) == 6

@@ -278,15 +278,37 @@ def main() -> None:
     if args.eval_every and eval_step is not None:
         if args.data_dir or args.eval_data_dir:
             from distributedtensorflow_tpu.data import record_dataset
+            from distributedtensorflow_tpu.parallel.mesh import replica_count
 
             eval_files = record_files(args.eval_data_dir or args.data_dir)
-            # one finite unshuffled pass, ragged final batch kept (the
-            # trainer weights it by example count)
+            shard_div = replica_count(mesh)
+
+            def shardable(it):
+                """The ragged final batch is kept but truncated to a
+                multiple of the mesh batch divisor — device_put_batch
+                cannot shard e.g. 5 rows over data=2.  Drops < shard_div
+                examples (vs < batch_size under drop_remainder=True); the
+                trainer weights the short batch by its true count."""
+                for batch in it:
+                    n = len(next(iter(batch.values())))
+                    keep = n - n % shard_div
+                    if keep == 0:
+                        continue
+                    if keep != n:
+                        logging.info(
+                            "eval: truncated ragged final batch %d -> %d "
+                            "(mesh batch divisor %d)", n, keep, shard_div,
+                        )
+                        batch = {k: v[:keep] for k, v in batch.items()}
+                    yield batch
+
+            # one finite unshuffled pass
             eval_iter_fn = lambda: Prefetcher(
-                record_dataset(eval_files, ctx,
-                               batch_size=ctx.per_host_batch_size,
-                               policy=args.autoshard, shuffle_buffer=0,
-                               drop_remainder=False),
+                shardable(record_dataset(
+                    eval_files, ctx, batch_size=ctx.per_host_batch_size,
+                    policy=args.autoshard, shuffle_buffer=0,
+                    drop_remainder=False,
+                )),
                 mesh,
             )
             if not args.eval_data_dir:
