@@ -19,8 +19,6 @@ from __future__ import annotations
 import logging
 from typing import Any
 
-import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
 from ..train.state import TrainState

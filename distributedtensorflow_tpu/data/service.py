@@ -33,7 +33,6 @@ import socketserver
 import threading
 import time
 from collections.abc import Callable, Iterator
-from typing import Any
 
 import numpy as np
 

@@ -16,7 +16,6 @@ dispatch (one-hot einsum + capacity), all collectives compiled onto ICI.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Callable
 
 import flax.linen as nn

@@ -24,7 +24,6 @@ GPipe); this is the framework's own new-capability bar.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Callable
 
 import flax.linen as nn

@@ -20,7 +20,6 @@ microbatch counts >= 4x stages.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax

@@ -61,7 +61,7 @@ def test_kill_mid_flight_requeues_and_respawns(coord):
     got = sorted(v for _, v in (rv.fetch() for rv in rvs))
     assert got == list(range(6))  # nothing lost to the kill
     # next closure on worker 0 triggers respawn; pool stays 3-wide
-    coord.schedule(_pid, (99,)).fetch(timeout=10)
+    coord.schedule(_pid, (99,)).fetch(timeout=60)
     assert len(coord.worker_pids()) == 3
     assert before is not None
 
@@ -69,7 +69,7 @@ def test_kill_mid_flight_requeues_and_respawns(coord):
 def test_app_error_from_child_reraised(coord):
     coord.schedule(_boom, (7,))
     with pytest.raises(ValueError, match="app error 7"):
-        coord.join(timeout=10)
+        coord.join(timeout=60)
 
 
 def test_thread_mode_has_no_pids():
