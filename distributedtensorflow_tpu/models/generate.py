@@ -54,11 +54,12 @@ def _sample(logits, rng, temperature, *, greedy: bool, top_k: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "greedy", "top_k", "top_p"),
+    static_argnames=("cfg", "max_new_tokens", "greedy", "top_k", "top_p",
+                     "eos_token_id"),
 )
 def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
                    cfg: GPTConfig, max_new_tokens: int, greedy: bool,
-                   top_k: int, top_p: float):
+                   top_k: int, top_p: float, eos_token_id: int):
     model = GPTLM(cfg, decode=True)
     b, prompt_pad = prompt.shape
     total = prompt_pad + max_new_tokens
@@ -76,8 +77,10 @@ def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
     )
     cache = vars0["cache"]
 
+    done0 = jnp.zeros((b,), bool)
+
     def step(carry, t):
-        tokens, cache, rng, logits = carry
+        tokens, cache, rng, logits, done = carry
         rng, sub = jax.random.split(rng)
         sampled = _sample(logits[:, -1], sub, temperature, greedy=greedy,
                           top_k=top_k, top_p=top_p)
@@ -86,7 +89,13 @@ def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
         # decode in one uniform loop — no separate prefill program).
         in_prompt = (t + 1) < prompt_lens  # (B,)
         prompt_tok = jax.lax.dynamic_slice_in_dim(tokens, t + 1, 1, axis=1)[:, 0]
+        if eos_token_id >= 0:
+            # a finished sequence keeps emitting eos (shapes stay static;
+            # "early stop" = the output is frozen from the eos on)
+            sampled = jnp.where(done, eos_token_id, sampled)
         nxt = jnp.where(in_prompt, prompt_tok, sampled).astype(tokens.dtype)
+        if eos_token_id >= 0:
+            done = done | (~in_prompt & (nxt == eos_token_id))
         tokens = jax.lax.dynamic_update_slice_in_dim(
             tokens, nxt[:, None], t + 1, axis=1
         )
@@ -95,10 +104,10 @@ def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
             positions=jnp.full((b, 1), t + 1, jnp.int32),
             mutable=["cache"],
         )
-        return (tokens, vars_out["cache"], rng, logits), None
+        return (tokens, vars_out["cache"], rng, logits, done), None
 
-    (tokens, _, _, _), _ = jax.lax.scan(
-        step, (tokens, cache, rng, logits0), jnp.arange(total - 1)
+    (tokens, _, _, _, _), _ = jax.lax.scan(
+        step, (tokens, cache, rng, logits0, done0), jnp.arange(total - 1)
     )
     return tokens
 
@@ -113,6 +122,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_token_id: int | None = None,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """Generate continuations; returns (B, P + max_new_tokens) token ids.
@@ -120,10 +130,17 @@ def generate(
     ``temperature=0`` is greedy; otherwise softmax sampling at the given
     temperature, optionally truncated to the ``top_k`` highest logits
     and/or the ``top_p`` nucleus (smallest probability mass >= top_p).
+    ``eos_token_id`` freezes a sequence once it samples that token (it
+    keeps emitting eos; shapes stay static).
     The KV cache needs ``cfg.max_seq >= P + max_new_tokens``.
     """
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if eos_token_id is not None and eos_token_id < 0:
+        raise ValueError(
+            f"eos_token_id must be a valid token id, got {eos_token_id} "
+            "(pass None to disable eos handling)"
+        )
     b, p = prompt.shape
     total = p + max_new_tokens
     if cfg.max_seq < total:
@@ -140,4 +157,5 @@ def generate(
         cfg=cfg, max_new_tokens=max_new_tokens,
         greedy=float(temperature) <= 0.0, top_k=int(top_k),
         top_p=float(top_p),
+        eos_token_id=-1 if eos_token_id is None else int(eos_token_id),
     )

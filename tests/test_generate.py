@@ -176,3 +176,43 @@ def test_top_p_composes_with_top_k():
         temperature=1.0, top_k=8, top_p=1e-6, rng=jax.random.PRNGKey(5),
     )
     np.testing.assert_array_equal(np.asarray(both), np.asarray(greedy))
+
+
+def test_eos_freezes_sequence():
+    """Once eos is emitted, the sequence keeps emitting eos to the end.
+
+    To guarantee the freeze path actually runs, eos is chosen as a token
+    the UNFROZEN run demonstrably samples early: the sampling stream is
+    identical up to that first occurrence, so the eos run must hit it and
+    freeze from there."""
+    cfg, _, params, prompt = _setup(seq=4, batch=1)
+    rng = jax.random.PRNGKey(0)
+    free = np.asarray(generate(params, prompt, cfg=cfg, max_new_tokens=8,
+                               temperature=1.0, rng=rng))[0, 4:]
+    eos = int(free[1])  # a token provably sampled at generated position 1
+    out = np.asarray(generate(params, prompt, cfg=cfg, max_new_tokens=8,
+                              temperature=1.0, eos_token_id=eos,
+                              rng=rng))[0, 4:]
+    first = np.nonzero(out == eos)[0][0]
+    assert first <= 1  # sampled no later than in the unfrozen run
+    assert (out[first:] == eos).all(), out
+    # the unfrozen run continued past it with at least one non-eos token
+    assert (free[first:] != eos).any(), free
+
+
+def test_eos_rejects_negative_id():
+    import pytest
+
+    cfg, _, params, prompt = _setup(seq=4, batch=1)
+    with pytest.raises(ValueError, match="eos_token_id"):
+        generate(params, prompt, cfg=cfg, max_new_tokens=2, eos_token_id=-1)
+
+
+def test_eos_does_not_trigger_inside_prompt():
+    cfg, _, params, _ = _setup(seq=4, batch=1)
+    prompt = jnp.asarray([[7, 7, 7, 9]], jnp.int32)  # eos ids in the prompt
+    out = generate(params, prompt, cfg=cfg, max_new_tokens=4,
+                   eos_token_id=7)
+    # prompt is preserved and generation still happened (greedy argmax may
+    # or may not be 7, but the prompt region must be untouched)
+    np.testing.assert_array_equal(np.asarray(out)[:, :4], np.asarray(prompt))
