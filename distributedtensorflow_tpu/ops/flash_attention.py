@@ -188,11 +188,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _extra_specs_and_args(mask, segment_ids, batch, seq, block_q, block_k,
-                          mem, *, swap_grid=False):
+                          mem, *, swap_grid=False, kv_segment_ids=None):
     """(in_specs, args, ref_names) for the optional mask / segment-id inputs.
 
     ``swap_grid``: the dkv kernel's grid is (B, H, n_k, n_q) — its index_map
     axis roles are swapped relative to the fwd/dq grids.
+    ``kv_segment_ids``: distinct key/value-side segment array (ring
+    attention rotates K/V chunks, so their segments differ from the local
+    q shard's); defaults to ``segment_ids`` (self-attention).
     """
     if swap_grid:
         kidx = lambda b, h, j, i: (b, 0, j)
@@ -206,12 +209,14 @@ def _extra_specs_and_args(mask, segment_ids, batch, seq, block_q, block_k,
         args.append(mask.reshape(batch, 1, seq))
         names.append("mask_ref")
     if segment_ids is not None:
-        seg3 = segment_ids.reshape(batch, 1, seq).astype(jnp.int32)
+        qseg3 = segment_ids.reshape(batch, 1, seq).astype(jnp.int32)
+        kseg = segment_ids if kv_segment_ids is None else kv_segment_ids
+        kseg3 = kseg.reshape(batch, 1, seq).astype(jnp.int32)
         specs.append(pl.BlockSpec((1, 1, block_q), qidx, memory_space=mem))
-        args.append(seg3)
+        args.append(qseg3)
         names.append("qseg_ref")
         specs.append(pl.BlockSpec((1, 1, block_k), kidx, memory_space=mem))
-        args.append(seg3)
+        args.append(kseg3)
         names.append("kseg_ref")
     return specs, args, names
 
@@ -231,7 +236,8 @@ def _wrap_kernel(inner, n_fixed_in, extra_names, **kw):
     return kernel
 
 
-def _flash_forward(q, k, v, mask, segment_ids, *, causal, interpret):
+def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
+                   causal, interpret):
     batch, seq, heads, depth = q.shape
     block_q = _pick_block_q(seq)
     block_k = _pick_block_k(seq)
@@ -252,7 +258,8 @@ def _flash_forward(q, k, v, mask, segment_ids, *, causal, interpret):
         memory_space=mem,
     )
     extra_specs, extra_args, extra_names = _extra_specs_and_args(
-        mask, segment_ids, batch, seq, block_q, block_k, mem
+        mask, segment_ids, batch, seq, block_q, block_k, mem,
+        kv_segment_ids=kv_segment_ids,
     )
     kernel = _wrap_kernel(
         _fwd_kernel, 3, extra_names,
@@ -434,7 +441,8 @@ def _flash_backward_pallas(res, g, *, causal, interpret):
 
 
 def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
-                                segment_ids=None, causal, interpret):
+                                segment_ids=None, kv_segment_ids=None,
+                                causal, interpret):
     """dq/dk/dv kernels from externally-supplied LSE and delta rows.
 
     Split out so ring attention (``parallel/ring_attention.py``) can drive
@@ -469,7 +477,8 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
                      memory_space=mem),  # delta
     ]
     extra_specs, extra_args, extra_names = _extra_specs_and_args(
-        mask, segment_ids, batch, seq, block_q, block_k, mem
+        mask, segment_ids, batch, seq, block_q, block_k, mem,
+        kv_segment_ids=kv_segment_ids,
     )
     dq_in_specs += extra_specs
     dq_args = [qt, kt, vt, gt, lse4, delta, *extra_args]
@@ -506,7 +515,8 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
                      memory_space=mem),  # delta
     ]
     extra_specs2, extra_args2, extra_names2 = _extra_specs_and_args(
-        mask, segment_ids, batch, seq, block_q, block_k, mem, swap_grid=True
+        mask, segment_ids, batch, seq, block_q, block_k, mem, swap_grid=True,
+        kv_segment_ids=kv_segment_ids,
     )
     dkv_in_specs += extra_specs2
     dkv_args = [qt, kt, vt, gt, lse4, delta, *extra_args2]

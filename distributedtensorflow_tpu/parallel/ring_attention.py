@@ -44,6 +44,7 @@ def ring_attention(
     axis_name: str = mesh_lib.AXIS_SEQ,
     causal: bool = False,
     impl: str | None = None,  # None=auto | "flash" | "xla"
+    segment_ids: jax.Array | None = None,  # (B, S_loc) this shard's segments
 ) -> jax.Array:
     """Ring attention over mesh axis ``axis_name`` (shard_map-internal).
 
@@ -78,34 +79,46 @@ def ring_attention(
     if impl == "flash":
         from ..ops.flash_attention import _on_tpu
 
-        return _ring_flash(q, k, v, axis_name, causal, not _on_tpu())
-    return _ring_attention_xla(q, k, v, axis_name=axis_name, causal=causal)
+        return _ring_flash(q, k, v, segment_ids, axis_name, causal,
+                           not _on_tpu())
+    return _ring_attention_xla(q, k, v, axis_name=axis_name, causal=causal,
+                               segment_ids=segment_ids)
 
 
 # --- Flash-kernel ring (custom VJP) -----------------------------------------
 
 
-def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
+def _ring_flash_fwd_impl(q, k, v, seg, axis_name, causal, interpret):
     """Ring forward: each chunk through the Pallas flash kernel, partials
-    merged by their log-sum-exp.  Returns (out, global lse)."""
+    merged by their log-sum-exp.  Returns (out, global lse).
+
+    ``seg`` (B, S_loc) or None: packed-segment ids; the K/V chunk's segment
+    ids rotate with it, and each chunk pair is masked q-segment vs
+    k-segment inside the kernel.  A chunk fully masked for some q row gets
+    lse ~ -1e9 there, so the merge weights its (uniform-average) output by
+    ~0 — the same mechanism that nullifies strictly-future causal chunks.
+    """
     from ..ops.flash_attention import _flash_forward
 
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
+    have_seg = seg is not None
 
-    def chunk(step, kc, vc):
+    def chunk(step, kc, vc, seg_c):
         """(o_chunk fp32 (B,S,H,D), lse_chunk (B,H,S)) for this ring step."""
         kidx = (my - step) % n
+        seg_kw = dict(segment_ids=seg, kv_segment_ids=seg_c) if have_seg \
+            else dict(segment_ids=None)
 
         def diag(_):
-            return _flash_forward(q, kc, vc, None, None, causal=True,
-                                  interpret=interpret)
+            return _flash_forward(q, kc, vc, None, causal=True,
+                                  interpret=interpret, **seg_kw)
 
         def past(_):
-            return _flash_forward(q, kc, vc, None, None, causal=False,
-                                  interpret=interpret)
+            return _flash_forward(q, kc, vc, None, causal=False,
+                                  interpret=interpret, **seg_kw)
 
         if not causal:
             o, lse = past(None)
@@ -140,37 +153,42 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
         return m_new, l, acc
 
     def body(carry, step):
-        m, l, acc, kc, vc = carry
-        o_c, lse_c = chunk(step, kc, vc)
+        m, l, acc, kc, vc, seg_c = carry
+        o_c, lse_c = chunk(step, kc, vc, seg_c)
         m, l, acc = merge(m, l, acc, o_c, lse_c)
-        # rotate K/V to the next device; XLA overlaps this with the matmuls
+        # rotate K/V (+ segments) to the next device; XLA overlaps this
+        # with the matmuls
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (m, l, acc, kc, vc), None
+        if have_seg:
+            seg_c = lax.ppermute(seg_c, axis_name, perm)
+        return (m, l, acc, kc, vc, seg_c), None
 
     m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
     acc0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    seg0 = seg if have_seg else jnp.zeros((), jnp.int32)
     # last chunk merged outside the scan: no wasted final K/V rotation
-    (m, l, acc, kc, vc), _ = lax.scan(
-        body, (m0, l0, acc0, k, v), jnp.arange(n - 1)
+    (m, l, acc, kc, vc, seg_c), _ = lax.scan(
+        body, (m0, l0, acc0, k, v, seg0), jnp.arange(n - 1)
     )
-    o_c, lse_c = chunk(n - 1, kc, vc)
+    o_c, lse_c = chunk(n - 1, kc, vc, seg_c)
     m, l, acc = merge(m, l, acc, o_c, lse_c)
     out = acc / l.transpose(0, 2, 1)[..., None]
     lse_global = m + jnp.log(l)
     return out.astype(q.dtype), lse_global
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_flash(q, k, v, axis_name, causal, interpret):
-    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ring_flash(q, k, v, seg, axis_name, causal, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, seg, axis_name, causal, interpret)
     return out
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
-    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
-    return out, (q, k, v, out, lse)
+def _ring_flash_fwd(q, k, v, seg, axis_name, causal, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, seg, axis_name, causal,
+                                    interpret)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, interpret, res, g):
@@ -179,21 +197,24 @@ def _ring_flash_bwd(axis_name, causal, interpret, res, g):
     full cycle every chunk's gradient lands back on its home device."""
     from ..ops.flash_attention import _flash_backward_pallas_core
 
-    q, k, v, out, lse = res
+    q, k, v, seg, out, lse = res
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     gf = g.astype(jnp.float32)
     delta = jnp.einsum("bqhd,bqhd->bhq", gf, out.astype(jnp.float32))
+    have_seg = seg is not None
 
-    def chunk_grads(step, kc, vc):
+    def chunk_grads(step, kc, vc, seg_c):
         kidx = (my - step) % n
+        seg_kw = dict(segment_ids=seg, kv_segment_ids=seg_c) if have_seg \
+            else {}
 
         def run(causal_flag):
             def f(_):
                 return _flash_backward_pallas_core(
                     q, kc, vc, None, g, lse, delta,
-                    causal=causal_flag, interpret=interpret,
+                    causal=causal_flag, interpret=interpret, **seg_kw,
                 )
             return f
 
@@ -213,8 +234,8 @@ def _ring_flash_bwd(axis_name, causal, interpret, res, g):
         )
 
     def body(carry, step):
-        dq_acc, kc, vc, dk_ring, dv_ring = carry
-        dq_c, dk_c, dv_c = chunk_grads(step, kc, vc)
+        dq_acc, kc, vc, seg_c, dk_ring, dv_ring = carry
+        dq_c, dk_c, dv_c = chunk_grads(step, kc, vc, seg_c)
         dq_acc = dq_acc + dq_c.astype(jnp.float32)
         dk_ring = dk_ring + dk_c.astype(jnp.float32)
         dv_ring = dv_ring + dv_c.astype(jnp.float32)
@@ -224,16 +245,19 @@ def _ring_flash_bwd(axis_name, causal, interpret, res, g):
             lax.ppermute(x, axis_name, perm)
             for x in (kc, vc, dk_ring, dv_ring)
         )
-        return (dq_acc, kc, vc, dk_ring, dv_ring), None
+        if have_seg:
+            seg_c = lax.ppermute(seg_c, axis_name, perm)
+        return (dq_acc, kc, vc, seg_c, dk_ring, dv_ring), None
 
     zeros_q = jnp.zeros(q.shape, jnp.float32)
     zeros_k = jnp.zeros(k.shape, jnp.float32)
-    (dq, _, _, dk, dv), _ = lax.scan(
+    seg0 = seg if have_seg else jnp.zeros((), jnp.int32)
+    (dq, _, _, _, dk, dv), _ = lax.scan(
         body,
-        (zeros_q, k, v, zeros_k, jnp.zeros(v.shape, jnp.float32)),
+        (zeros_q, k, v, seg0, zeros_k, jnp.zeros(v.shape, jnp.float32)),
         jnp.arange(n),
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -249,6 +273,7 @@ def _ring_attention_xla(
     *,
     axis_name: str = mesh_lib.AXIS_SEQ,
     causal: bool = False,
+    segment_ids: jax.Array | None = None,  # (B, S_loc)
 ) -> jax.Array:
     """Einsum online-softmax ring (chunk-granular causal masking, uniform
     control flow).  Fallback for shapes/dtypes the flash kernels reject."""
@@ -258,8 +283,9 @@ def _ring_attention_xla(
     scale = 1.0 / (d ** 0.5)
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    have_seg = segment_ids is not None
 
-    def merge_chunk(m, l, acc, kc, vc, step):
+    def merge_chunk(m, l, acc, kc, vc, seg_c, step):
         # kc holds the chunk originally on device (my - step) % n
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)) * scale
         if causal:
@@ -268,6 +294,9 @@ def _ring_attention_xla(
             k_pos = kidx * s_loc + jnp.arange(s_loc)
             keep = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(keep[None, None], s, NEG_INF)
+        if have_seg:
+            same = segment_ids[:, :, None] == seg_c[:, None, :]  # (B, Sq, Sk)
+            s = jnp.where(same[:, None], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
@@ -277,22 +306,25 @@ def _ring_attention_xla(
         return m_new, l_new, acc_new
 
     def body(carry, step):
-        m, l, acc, kc, vc = carry
-        m, l, acc = merge_chunk(m, l, acc, kc, vc, step)
+        m, l, acc, kc, vc, seg_c = carry
+        m, l, acc = merge_chunk(m, l, acc, kc, vc, seg_c, step)
         # rotate K/V to the next device; XLA overlaps this with the matmuls
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (m, l, acc, kc, vc), None
+        if have_seg:
+            seg_c = lax.ppermute(seg_c, axis_name, perm)
+        return (m, l, acc, kc, vc, seg_c), None
 
     m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
     acc0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    seg0 = segment_ids if have_seg else jnp.zeros((), jnp.int32)
     # scan runs only the n-1 steps that need a rotation afterwards; the last
     # chunk is merged outside so no wasted final ppermute of K and V
-    (m, l, acc, kc, vc), _ = lax.scan(
-        body, (m0, l0, acc0, k, v), jnp.arange(n - 1)
+    (m, l, acc, kc, vc, seg_c), _ = lax.scan(
+        body, (m0, l0, acc0, k, v, seg0), jnp.arange(n - 1)
     )
-    m, l, acc = merge_chunk(m, l, acc, kc, vc, n - 1)
+    m, l, acc = merge_chunk(m, l, acc, kc, vc, seg_c, n - 1)
     # l >= 1 always: the diagonal chunk contributes exp(0) per row, so no
     # division guard is needed (matches the full-attention softmax exactly)
     out = acc / l.transpose(0, 2, 1, 3)
@@ -307,13 +339,17 @@ def ulysses_attention(
     axis_name: str = mesh_lib.AXIS_SEQ,
     causal: bool = False,
     attn_fn: Callable | None = None,
+    segment_ids: jax.Array | None = None,  # (B, S_loc)
 ) -> jax.Array:
     """Ulysses sequence parallelism (shard_map-internal).
 
     all_to_all reshards (B, S/n, H, D) -> (B, S, H/n, D), runs full-sequence
     attention per device on its head subset (``attn_fn``, default the
     framework attention entry, which may pick the Pallas flash kernel), then
-    reshards back.  Heads must divide the seq-axis size.
+    reshards back.  Heads must divide the seq-axis size.  ``segment_ids``
+    (packed sequences) are all-gathered along ``seq`` — each device sees the
+    full-sequence ids its full-sequence attention needs (ids are int32 and
+    tiny next to K/V).
     """
     n = lax.axis_size(axis_name)
     h = q.shape[2]
@@ -323,6 +359,9 @@ def ulysses_attention(
         from ..ops.attention import dot_product_attention
 
         attn_fn = functools.partial(dot_product_attention, causal=causal)
+    if segment_ids is not None:
+        seg_full = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        attn_fn = functools.partial(attn_fn, segment_ids=seg_full)
 
     def seq_to_heads(x):  # (B, S_loc, H, D) -> (B, S, H/n, D)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -384,10 +423,25 @@ def sequence_parallel_attention_fn(
         else None
     )
     spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
-    return jax.shard_map(
+    seg_spec = P(batch_axes if batch_axes else None, axis_name)
+    plain = jax.shard_map(
         lambda q, k, v: kernel(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
+    packed = jax.shard_map(
+        lambda q, k, v, seg: kernel(q, k, v, segment_ids=seg),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+    def attention(q, k, v, segment_ids=None):
+        if segment_ids is None:
+            return plain(q, k, v)
+        return packed(q, k, v, segment_ids)
+
+    return attention
