@@ -1,16 +1,22 @@
 #!/usr/bin/env python
-"""Secondary benchmark: GPT decoder-LM training tokens/sec/chip.
+"""Secondary benchmark: GPT decoder-LM training tokens/sec/chip (+ MFU).
 
 Not the driver's headline metric (that is bench.py's ResNet-50
 images/sec/chip) — this measures the long-context/LM path: a GPT-small
-train step (remat on, bf16, fused QKV) on synthetic data.  Prints one JSON
-line in the same shape as bench.py.
+train step (bf16, fused QKV) on synthetic data.  Prints one JSON line in
+the same shape as bench.py.
+
+Knobs (env): ``BENCH_LM_BATCH`` per-chip batch (default 8),
+``BENCH_LM_SEQ`` sequence length (default 1024), ``BENCH_LM_REMAT`` 1/0
+(default 0 — the A100 anchor number is remat-off; remat trades ~1/3 extra
+FLOPs for activation memory and only helps once the batch doesn't fit).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 from bench_probe import probe_devices_with_retries
@@ -27,9 +33,12 @@ import numpy as np  # noqa: E402
 if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
+#: Peak dense bf16 FLOP/s per chip (bench.py keeps the authoritative map).
+from bench import _peak_flops  # noqa: E402
+
 
 def main() -> None:
-    from distributedtensorflow_tpu.data import InputContext, device_put_batch
+    from distributedtensorflow_tpu.data import device_put_batch
     from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
     from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
     from distributedtensorflow_tpu.workloads import get_workload
@@ -37,11 +46,18 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(data=-1))
     n_chips = mesh.size
     test_size = os.environ.get("BENCH_LM_TEST") == "1"  # CPU smoke mode
-    seq = 128 if test_size else 1024
-    per_chip_batch = 2 if test_size else 8
+    seq = int(os.environ.get("BENCH_LM_SEQ", "128" if test_size else "1024"))
+    per_chip_batch = int(
+        os.environ.get("BENCH_LM_BATCH", "2" if test_size else "8")
+    )
+    # "0"/"1"/"attn" — attn = checkpoint only the attention op per block
+    remat_env = os.environ.get("BENCH_LM_REMAT", "0")
+    remat = {"0": False, "1": True}.get(remat_env, remat_env)
+    attn_impl = os.environ.get("BENCH_LM_ATTN") or None
     wl = get_workload(
         "gpt_lm", test_size=test_size,
         global_batch_size=per_chip_batch * n_chips,
+        seq_len=seq, remat=remat, attn_impl=attn_impl,
     )
     wl = wl.for_mesh(mesh)
 
@@ -55,19 +71,44 @@ def main() -> None:
     ).astype(np.int32)
     batch = device_put_batch({"input_ids": ids}, mesh)
 
-    for _ in range(3):  # warmup/compile
-        state, metrics = step(state, batch, rng)
+    # AOT-compile once; reuse for warmup, timing, and cost analysis.
+    compiled = step.lower(state, batch, rng).compile()
+    for _ in range(3):  # warmup
+        state, metrics = compiled(state, batch, rng)
     float(metrics["loss"])  # force execution (axon: block_until_ready no-op)
 
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = step(state, batch, rng)
+        state, metrics = compiled(state, batch, rng)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     tokens_per_sec = n_steps * wl.global_batch_size * seq / dt
     per_chip = tokens_per_sec / n_chips
+
+    # MFU from XLA's partitioned-module cost analysis (per-chip FLOPs);
+    # analytic fallback 6N per token fwd+bwd (+2N when remat recomputes fwd).
+    flops_per_chip_step = None
+    try:
+        cost = compiled.cost_analysis()
+        if cost and cost.get("flops"):
+            flops_per_chip_step = float(cost["flops"])
+        flops_source = "xla_cost_analysis"
+    except Exception as e:
+        print(f"bench_lm: cost_analysis unavailable ({e})", file=sys.stderr)
+    if not flops_per_chip_step:
+        n_params = sum(
+            int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
+        )
+        # 6N fwd+bwd; +2N full-block recompute; attention-only remat
+        # recomputes ~5% of the forward.
+        per_token = {False: 6.0, True: 8.0, "attn": 6.3}[remat] * n_params
+        flops_per_chip_step = per_token * wl.global_batch_size * seq / n_chips
+        flops_source = "analytic_6N_per_token"
+    device_kind = jax.devices()[0].device_kind
+    mfu = (flops_per_chip_step * n_steps / dt) / _peak_flops(device_kind)
+
     # Anchor: an A100 trains GPT-2-small (~124M params) at roughly 150k
     # tokens/sec with remat off; used as the vs_baseline denominator.
     result = {
@@ -75,9 +116,15 @@ def main() -> None:
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / 150_000.0, 4),
+        "mfu": round(mfu, 4),
+        "mfu_flops_source": flops_source,
         "platform": jax.devices()[0].platform,
+        "device_kind": device_kind,
         "seq": seq,
         "global_batch": wl.global_batch_size,
+        "remat": remat,
+        "attn_impl": attn_impl or "auto",
+        "step_time_ms": round(1000 * dt / n_steps, 2),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     from bench_probe import is_tpu_platform, persist_result

@@ -111,7 +111,11 @@ class MoEGPTBlock(nn.Module):
     def __call__(self, x, positions, deterministic: bool):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
-        x = x + CausalSelfAttention(cfg, None, False, name="attn")(
+        attn_cls = CausalSelfAttention
+        if cfg.remat_attn and not self.is_initializing():
+            # same convention as gpt.GPTBlock: attention-only checkpoint
+            attn_cls = nn.remat(CausalSelfAttention, static_argnums=(3,))
+        x = x + attn_cls(cfg, None, False, name="attn")(
             h, positions, deterministic
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
@@ -141,7 +145,8 @@ class GPTMoELM(nn.Module):
         super().__post_init__()
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True):
+    def __call__(self, input_ids, *, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="wte"
@@ -167,24 +172,33 @@ class GPTMoELM(nn.Module):
                     x, positions, deterministic
                 )
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            return x, aux_total  # loss applies the chunked head (ops/xent)
         wte = self.variables["params"]["wte"]["embedding"]
         logits = (x @ wte.T.astype(jnp.float32)).astype(jnp.float32)
         return logits, aux_total
 
 
 def moe_lm_loss(model: GPTMoELM):
-    """Next-token cross-entropy + weighted router aux loss."""
+    """Next-token cross-entropy + weighted router aux loss.
+
+    Cross-entropy uses the vocab-chunked head (``ops/xent.py``) like the
+    dense GPT's ``lm_loss``: full-vocab fp32 logits never materialize.
+    """
+    from ..ops.xent import chunked_softmax_xent
+
     aux_w = model.cfg.aux_loss_weight
 
     def loss_fn(params, model_state, batch, rng):
-        logits, aux = model.apply(
+        hidden, aux = model.apply(
             {"params": params}, batch["input_ids"], deterministic=False,
+            return_hidden=True,
         )
-        targets = batch["input_ids"][:, 1:]
-        logits = logits[:, :-1]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        lm = jnp.mean(nll)
+        lm = chunked_softmax_xent(
+            hidden[:, :-1],
+            params["wte"]["embedding"],
+            batch["input_ids"][:, 1:],
+        )
         loss = lm + aux_w * aux
         return loss, (
             {"perplexity": jnp.exp(lm), "aux_loss": aux}, model_state,

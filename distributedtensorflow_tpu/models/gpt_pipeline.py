@@ -166,7 +166,8 @@ class PipelinedGPT:
         x, _ = lax.scan(one, x, stage_params)
         return x
 
-    def apply(self, variables: dict, input_ids: jax.Array) -> jax.Array:
+    def apply(self, variables: dict, input_ids: jax.Array, *,
+              return_hidden: bool = False) -> jax.Array:
         params = variables["params"] if "params" in variables else variables
         cfg = self.cfg
         x = self._embed.apply({"params": params["wte"]}, input_ids)
@@ -216,6 +217,8 @@ class PipelinedGPT:
         )(params["blocks"], x)
 
         x = self._ln_f.apply({"params": params["ln_f"]}, x)
+        if return_hidden:
+            return x  # loss applies the chunked head (ops/xent.py)
         wte = params["wte"]["embedding"]
         return (x @ wte.T.astype(jnp.float32)).astype(jnp.float32)
 
@@ -229,15 +232,19 @@ class PipelinedGPT:
 
 def pipelined_lm_loss(model: PipelinedGPT):
     """Next-token cross-entropy through the pipeline (same math as
-    ``gpt.lm_loss``; rng unused — dropout is rejected at construction)."""
+    ``gpt.lm_loss`` incl. the vocab-chunked head; rng unused — dropout is
+    rejected at construction)."""
+    from ..ops.xent import chunked_softmax_xent
 
     def loss_fn(params, model_state, batch, rng):
-        logits = model.apply({"params": params}, batch["input_ids"])
-        targets = batch["input_ids"][:, 1:]
-        logits = logits[:, :-1]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        loss = jnp.mean(nll)
+        hidden = model.apply(
+            {"params": params}, batch["input_ids"], return_hidden=True
+        )
+        loss = chunked_softmax_xent(
+            hidden[:, :-1],
+            params["wte"]["embedding"],
+            batch["input_ids"][:, 1:],
+        )
         return loss, ({"perplexity": jnp.exp(loss)}, model_state)
 
     return loss_fn

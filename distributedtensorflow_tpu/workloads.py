@@ -160,14 +160,20 @@ def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
 def get_workload(name: str, *, test_size: bool = False,
                  global_batch_size: int | None = None,
                  sp_scheme: str = "ring",
-                 pp_virtual: int = 1) -> Workload:
+                 pp_virtual: int = 1,
+                 seq_len: int | None = None,
+                 remat: bool | str | None = None,
+                 attn_impl: str | None = None) -> Workload:
     """Build a preset by name.  ``test_size`` shrinks models for CI.
 
     ``sp_scheme`` picks the sequence-parallel attention used by ``gpt_lm``
     on meshes with a ``seq`` axis: ``"ring"`` (ppermute KV rotation, flash
     chunk kernels) or ``"ulysses"`` (all_to_all head<->sequence reshard).
     ``pp_virtual > 1`` selects the circular (interleaved) pipeline schedule
-    for ``gpt_lm`` on meshes with a ``pipe`` axis.
+    for ``gpt_lm`` on meshes with a ``pipe`` axis.  ``seq_len`` / ``remat``
+    override the LM presets' sequence length and rematerialization (remat
+    trades ~1/3 extra FLOPs for activation memory; benches turn it off when
+    the batch fits).
     """
     if name == "mnist_lenet":
         model = LeNet5()
@@ -287,7 +293,16 @@ def get_workload(name: str, *, test_size: bool = False,
         from .models import GPTLM, gpt_layout, gpt_small, gpt_tiny, lm_loss
 
         cfg = gpt_tiny() if test_size else gpt_small()
-        seq = 64 if test_size else 2048
+        seq = seq_len or (64 if test_size else 2048)
+        if remat is not None or attn_impl is not None or seq > cfg.max_seq:
+            # remat: True/False = whole blocks; "attn" = attention-only.
+            cfg = dataclasses.replace(
+                cfg,
+                remat=cfg.remat if remat is None else remat is True,
+                remat_attn=remat == "attn",
+                attn_impl=attn_impl or cfg.attn_impl,
+                max_seq=max(cfg.max_seq, seq),
+            )
         gbs = global_batch_size or (8 if test_size else 64)
 
         def build(attn_fn=None):
@@ -367,7 +382,16 @@ def get_workload(name: str, *, test_size: bool = False,
         )
 
         cfg = gpt_moe_tiny() if test_size else gpt_moe_small()
-        seq = 64 if test_size else 2048
+        seq = seq_len or (64 if test_size else 2048)
+        if remat is not None or attn_impl is not None or seq > cfg.max_seq:
+            # remat: True/False = whole blocks; "attn" = attention-only.
+            cfg = dataclasses.replace(
+                cfg,
+                remat=cfg.remat if remat is None else remat is True,
+                remat_attn=remat == "attn",
+                attn_impl=attn_impl or cfg.attn_impl,
+                max_seq=max(cfg.max_seq, seq),
+            )
         gbs = global_batch_size or (8 if test_size else 64)
         model = GPTMoELM(cfg)  # local (replicated) experts until for_mesh
 

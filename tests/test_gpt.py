@@ -50,6 +50,77 @@ def test_remat_path_trains():
     assert gnorm > 0
 
 
+def test_chunked_xent_matches_naive_logits_loss():
+    """lm_loss (vocab-chunked head, ops/xent.py) == log_softmax over the
+    full logits tensor — values and grads, any chunking."""
+    from distributedtensorflow_tpu.models import lm_loss
+    from distributedtensorflow_tpu.ops.xent import chunked_softmax_xent
+
+    cfg = gpt_tiny()
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(2)
+    ids = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    mask = jnp.asarray(
+        np.random.default_rng(0).integers(0, 2, (2, 16)), jnp.int32
+    )
+    params = model.init(rng, ids)["params"]
+    batch = {"input_ids": ids, "mask": mask}
+
+    def naive(p):
+        logits = model.apply({"params": p}, ids)[:, :-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, ids[:, 1:][..., None], axis=-1
+        )[..., 0]
+        m = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+    chunked = lm_loss(model)
+    (lc, _), gc = jax.value_and_grad(
+        lambda p: chunked(p, {}, batch, rng)[:2], has_aux=True
+    )(params)
+    ln, gn = jax.value_and_grad(naive)(params)
+    np.testing.assert_allclose(float(lc), float(ln), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gc), jax.tree.leaves(gn)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+    # odd chunk sizes pad internally and still agree
+    hidden = model.apply({"params": params}, ids, return_hidden=True)
+    wte = params["wte"]["embedding"]
+    full = chunked_softmax_xent(hidden[:, :-1], wte, ids[:, 1:],
+                                mask[:, 1:])
+    for chunk in (5, 7, 30):
+        part = chunked_softmax_xent(hidden[:, :-1], wte, ids[:, 1:],
+                                    mask[:, 1:], chunk_tokens=chunk)
+        np.testing.assert_allclose(float(part), float(full), rtol=1e-6)
+
+
+def test_remat_attn_matches_dense():
+    """remat_attn=True (attention-only checkpoint) changes memory, not
+    math: loss and grads match the plain path."""
+    from distributedtensorflow_tpu.models import lm_loss
+
+    rng = jax.random.PRNGKey(3)
+    cfg = gpt_tiny()
+    ids = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    losses, grads = [], []
+    for remat_attn in (False, True):
+        model = GPTLM(dataclasses.replace(cfg, remat_attn=remat_attn))
+        params = model.init(rng, ids)["params"]
+        loss_fn = lm_loss(model)
+        (loss, _), g = jax.value_and_grad(
+            lambda p: loss_fn(p, {}, {"input_ids": ids}, rng)[:2],
+            has_aux=True,
+        )(params)
+        losses.append(float(loss))
+        grads.append(g)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(grads[0]), jax.tree.leaves(grads[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
+
+
 def test_causality():
     """Changing a future token must not change past logits."""
     cfg = gpt_tiny()

@@ -27,7 +27,10 @@ while true; do
     # batch-size sweep: each run persists its own JSON; bench.py's cached
     # path re-emits the best value
     BENCH_SKIP_PROBE=1 BENCH_BATCH=256 timeout 1200 python bench.py >> "$LOG" 2>&1 || true
-    BENCH_SKIP_PROBE=1 timeout 1200 python bench_lm.py   >> "$LOG" 2>&1 || ok=0
+    # LM: bs16 remat-off + chunked-xent head is the measured best config;
+    # also record bs32 attention-only-remat (2x batch, ~5% recompute)
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=16 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || ok=0
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
     if (( ok == 1 )); then
       echo "$(date -Is) watcher: all benches landed" >> "$LOG"
