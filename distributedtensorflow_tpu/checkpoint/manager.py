@@ -123,11 +123,30 @@ class CheckpointManager:
             opt_state=restored["opt_state"],
         )
 
+    def restore(self, step: int, target: TrainState) -> TrainState:
+        """Restore a specific step into ``target``'s shardings."""
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_as_tree(target))
+        )
+        logger.info("restored checkpoint step %d", step)
+        return target.replace(
+            step=restored["step"],
+            params=restored["params"],
+            model_state=restored["model_state"],
+            opt_state=restored["opt_state"],
+        )
+
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
     def all_steps(self) -> list[int]:
         return list(self._mgr.all_steps())
+
+    def reload(self) -> None:
+        """Re-scan the directory for checkpoints written by OTHER processes
+        (Orbax caches the step list; a sidecar evaluator polling a training
+        job's directory must reload before ``latest_step``)."""
+        self._mgr.reload()
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -8,3 +8,5 @@ from .engine import (  # noqa: F401
     split_microbatches,
 )
 from .losses import classification_eval, classification_loss  # noqa: F401
+from .sidecar import SidecarEvaluator  # noqa: F401
+from .trainer import weighted_evaluate  # noqa: F401

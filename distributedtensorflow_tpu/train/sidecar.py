@@ -1,0 +1,119 @@
+"""Sidecar evaluator: a dedicated evaluation task outside the training job.
+
+Reference analogue: the ``evaluator`` task type of the `tf.distribute`
+multi-worker convention — TF_CONFIG may declare an ``evaluator`` job that is
+*excluded* from the training cluster (our resolver does the same:
+``parallel/bootstrap.py`` ``parse_tf_config`` returns a standalone
+single-process config for it) and runs Keras's sidecar-evaluation loop:
+poll the checkpoint directory, evaluate each new checkpoint, write metrics.
+
+TPU-first shape: the evaluator restores *sharded* checkpoints into its own
+(usually single-chip) mesh — Orbax reshards on read, so the training job's
+topology never leaks in — and the eval step is the same compiled SPMD
+program ``train.make_eval_step`` builds for inline eval.
+
+Run it via ``train.py --job evaluator`` (automatic when TF_CONFIG says
+``task.type == "evaluator"``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Iterable
+
+from ..utils.metrics import MetricWriter
+from .state import TrainState
+from .trainer import weighted_evaluate
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+PyTree = Any
+
+
+class SidecarEvaluator:
+    """Poll a checkpoint directory; evaluate every new checkpoint.
+
+    ``eval_iter_fn`` returns a fresh (finite or bounded) eval iterator per
+    evaluation.  Evaluation always targets the *newest* checkpoint — if the
+    trainer saved several while one eval ran, intermediate ones are skipped
+    (the reference sidecar's catch-up behavior).
+    """
+
+    def __init__(
+        self,
+        checkpointer,  # checkpoint.CheckpointManager on the TRAINING job's dir
+        eval_step: Callable[[TrainState, PyTree], dict],
+        eval_iter_fn: Callable[[], Iterable[PyTree]],
+        state_template: TrainState,  # abstract/concrete state with shardings
+        *,
+        eval_steps: int = 0,  # <=0: consume the whole iterator
+        poll_interval_s: float = 10.0,
+        max_evaluations: int | None = None,  # None = until stop conditions
+        stop_after_step: int | None = None,  # evaluated step >= this -> done
+        idle_timeout_s: float | None = None,  # no new ckpt for this long -> done
+        logdir: str | None = None,
+    ):
+        self.checkpointer = checkpointer
+        self.eval_step = eval_step
+        self.eval_iter_fn = eval_iter_fn
+        self.state_template = state_template
+        self.eval_steps = eval_steps
+        self.poll_interval_s = poll_interval_s
+        self.max_evaluations = max_evaluations
+        self.stop_after_step = stop_after_step
+        self.idle_timeout_s = idle_timeout_s
+        self.writer = MetricWriter(logdir)
+        self.history: dict[int, dict] = {}  # step -> metrics
+
+    def _evaluate_step(self, step: int) -> dict:
+        state = self.checkpointer.restore(step, self.state_template)
+        metrics = weighted_evaluate(
+            self.eval_step, state, self.eval_iter_fn(),
+            max_steps=self.eval_steps,
+        )
+        self.history[step] = metrics
+        self.writer.write(step, {f"eval/{k}": v for k, v in metrics.items()})
+        logger.info(
+            "sidecar: step %d %s", step,
+            " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items())),
+        )
+        return metrics
+
+    def run(self) -> dict[int, dict]:
+        """Evaluate until a stop condition; returns {step: metrics}."""
+        last_evaluated = -1
+        last_new_ckpt_t = time.monotonic()
+        try:
+            while True:
+                self.checkpointer.reload()  # other-process writes
+                step = self.checkpointer.latest_step()
+                if step is not None and step > last_evaluated:
+                    self._evaluate_step(step)
+                    last_evaluated = step
+                    last_new_ckpt_t = time.monotonic()
+                    if (
+                        self.max_evaluations is not None
+                        and len(self.history) >= self.max_evaluations
+                    ):
+                        logger.info("sidecar: max_evaluations reached")
+                        return self.history
+                    if (
+                        self.stop_after_step is not None
+                        and step >= self.stop_after_step
+                    ):
+                        logger.info("sidecar: final step %d evaluated", step)
+                        return self.history
+                    continue  # a newer checkpoint may already exist
+                if (
+                    self.idle_timeout_s is not None
+                    and time.monotonic() - last_new_ckpt_t > self.idle_timeout_s
+                ):
+                    logger.info(
+                        "sidecar: no new checkpoint for %.0fs; stopping",
+                        self.idle_timeout_s,
+                    )
+                    return self.history
+                time.sleep(self.poll_interval_s)
+        finally:
+            self.writer.close()

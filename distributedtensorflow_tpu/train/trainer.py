@@ -241,22 +241,36 @@ class Trainer:
         example instead of skewing the mean.  ``eval_steps <= 0`` means
         "the whole iterator" (dataset-wide exact eval on finite iterators).
         """
-        sums: dict[str, float] = {}
-        total_w = 0.0
-        try:
-            for i, batch in enumerate(eval_iter):
-                if self.config.eval_steps > 0 and i >= self.config.eval_steps:
-                    break
-                w = float(jax.tree.leaves(batch)[0].shape[0])
-                metrics = self.eval_step(state, batch)
-                for k, v in metrics.items():
-                    sums[k] = sums.get(k, 0.0) + w * float(v)
-                total_w += w
-        finally:
-            close = getattr(eval_iter, "close", None)
-            if close is not None:  # release prefetch threads/device buffers
-                close()
-        return {k: v / max(total_w, 1.0) for k, v in sums.items()}
+        return weighted_evaluate(
+            self.eval_step, state, eval_iter, max_steps=self.config.eval_steps
+        )
+
+
+def weighted_evaluate(
+    eval_step: Callable[[TrainState, PyTree], dict],
+    state: TrainState,
+    eval_iter: Iterable[PyTree],
+    *,
+    max_steps: int = 0,
+) -> dict:
+    """Batch-size-weighted metric averaging (shared by Trainer and the
+    sidecar evaluator).  ``max_steps <= 0`` consumes the whole iterator."""
+    sums: dict[str, float] = {}
+    total_w = 0.0
+    try:
+        for i, batch in enumerate(eval_iter):
+            if max_steps > 0 and i >= max_steps:
+                break
+            w = float(jax.tree.leaves(batch)[0].shape[0])
+            metrics = eval_step(state, batch)
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + w * float(v)
+            total_w += w
+    finally:
+        close = getattr(eval_iter, "close", None)
+        if close is not None:  # release prefetch threads/device buffers
+            close()
+    return {k: v / max(total_w, 1.0) for k, v in sums.items()}
 
 
 def _fmt(metrics: dict) -> str:

@@ -1,0 +1,163 @@
+"""Sidecar evaluator tests: checkpoint-dir polling, catch-up-to-newest,
+idle timeout, and the train.py --job evaluator CLI path.
+
+Reference analogue: the TF_CONFIG "evaluator" task convention — an
+evaluation process outside the training cluster that re-reads checkpoints
+as they appear (SURVEY.md §2.3 cluster resolvers / §5.5 observability).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributedtensorflow_tpu.checkpoint import CheckpointManager
+from distributedtensorflow_tpu.models import LeNet5
+from distributedtensorflow_tpu.train import (
+    SidecarEvaluator,
+    classification_eval,
+    create_sharded_state,
+    make_eval_step,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(mesh):
+    model = LeNet5()
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(0.1), mesh, jax.random.PRNGKey(0)
+    )
+    eval_step = make_eval_step(classification_eval(model), mesh, specs)
+    return state, eval_step
+
+
+def _batches(n=2, batch=8):
+    rng = np.random.default_rng(0)
+    return [
+        {
+            "image": rng.normal(size=(batch, 28, 28, 1)).astype(np.float32),
+            "label": rng.integers(0, 10, (batch,)).astype(np.int32),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_sidecar_skips_to_newest_and_picks_up_new(tmp_path, dp_mesh):
+    state, eval_step = _setup(dp_mesh)
+    writer_mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    writer_mgr.save(1, state.replace(step=jnp.asarray(1)), force=True)
+    writer_mgr.save(2, state.replace(step=jnp.asarray(2)), force=True)
+    writer_mgr.wait()
+
+    # Separate manager instance — the cross-process reload() path.
+    sidecar = SidecarEvaluator(
+        CheckpointManager(str(tmp_path / "ckpt"), async_save=False),
+        eval_step,
+        lambda: iter(_batches()),
+        state,
+        poll_interval_s=0.05,
+        max_evaluations=1,
+    )
+    history = sidecar.run()
+    # catch-up: only the NEWEST checkpoint is evaluated
+    assert set(history) == {2}
+    assert "accuracy" in history[2] and "loss" in history[2]
+
+    # a later checkpoint appears while the sidecar polls -> picked up
+    def save_later():
+        time.sleep(0.3)
+        writer_mgr.save(3, state.replace(step=jnp.asarray(3)), force=True)
+        writer_mgr.wait()
+
+    t = threading.Thread(target=save_later)
+    t.start()
+    sidecar.max_evaluations = 2
+    history = sidecar.run()
+    t.join()
+    assert set(history) == {2, 3}
+    writer_mgr.close()
+
+
+def test_sidecar_idle_timeout_on_empty_dir(tmp_path, dp_mesh):
+    state, eval_step = _setup(dp_mesh)
+    sidecar = SidecarEvaluator(
+        CheckpointManager(str(tmp_path / "empty"), async_save=False),
+        eval_step,
+        lambda: iter(_batches()),
+        state,
+        poll_interval_s=0.05,
+        idle_timeout_s=0.3,
+    )
+    t0 = time.monotonic()
+    assert sidecar.run() == {}
+    assert time.monotonic() - t0 < 10
+
+
+def test_sidecar_stop_after_step(tmp_path, dp_mesh):
+    state, eval_step = _setup(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    mgr.save(5, state.replace(step=jnp.asarray(5)), force=True)
+    mgr.wait()
+    sidecar = SidecarEvaluator(
+        CheckpointManager(str(tmp_path / "ckpt"), async_save=False),
+        eval_step,
+        lambda: iter(_batches()),
+        state,
+        poll_interval_s=0.05,
+        stop_after_step=5,  # the final checkpoint: evaluate it, then stop
+    )
+    assert set(sidecar.run()) == {5}
+    mgr.close()
+
+
+def test_cli_evaluator_job(tmp_path, dp_mesh):
+    """train.py --job auto + TF_CONFIG evaluator task runs the sidecar and
+    writes eval metrics for the trainer's checkpoints."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    logdir = str(tmp_path / "logs")
+    # train 4 steps on synthetic MNIST, checkpointing (in-process: reuse
+    # this test's jax runtime instead of a second slow subprocess)
+    train = subprocess.run(
+        [
+            sys.executable, "train.py", "--workload", "mnist_lenet",
+            "--test-size", "--device", "cpu", "--steps", "4",
+            "--checkpoint-dir", ckpt_dir, "--checkpoint-every", "2",
+            "--batch-size", "16", "--log-every", "2",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert train.returncode == 0, train.stderr[-2000:]
+
+    env = dict(
+        os.environ,
+        TF_CONFIG=json.dumps({
+            "cluster": {"worker": ["localhost:12345"],
+                        "evaluator": ["localhost:12399"]},
+            "task": {"type": "evaluator", "index": 0},
+        }),
+    )
+    ev = subprocess.run(
+        [
+            sys.executable, "train.py", "--workload", "mnist_lenet",
+            "--test-size", "--device", "cpu", "--steps", "4",
+            "--checkpoint-dir", ckpt_dir, "--batch-size", "16",
+            "--max-evaluations", "1", "--poll-interval", "0.1",
+            "--idle-timeout", "60", "--logdir", logdir,
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert ev.returncode == 0, ev.stderr[-2000:]
+    assert "evaluator:" in ev.stderr or "evaluator:" in ev.stdout
+    with open(os.path.join(logdir, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    assert records and records[-1]["step"] == 4
+    assert "eval/accuracy" in records[-1]

@@ -71,6 +71,115 @@ def apply_config_file(
     return args
 
 
+def record_files(data_dir):
+    """Record files under ``data_dir`` (TFRecord-compatible framing)."""
+    import glob as globlib
+
+    files = sorted(
+        f for pat in ("*.tfrecord", "*.rio", "*.rec")
+        for f in globlib.glob(os.path.join(data_dir, pat))
+    )
+    if not files:
+        raise SystemExit(f"{data_dir}: no record files")
+    return files
+
+
+def shardable_batches(it, mesh):
+    """Truncate a ragged final batch to a multiple of the mesh batch
+    divisor — ``device_put_batch`` cannot shard e.g. 5 rows over data=2.
+    Drops < shard_div examples (vs < batch_size under drop_remainder=True);
+    the weighted eval counts the short batch by its true size."""
+    from distributedtensorflow_tpu.parallel.mesh import replica_count
+
+    shard_div = replica_count(mesh)
+    for batch in it:
+        n = len(next(iter(batch.values())))
+        keep = n - n % shard_div
+        if keep == 0:
+            continue
+        if keep != n:
+            logging.info(
+                "eval: truncated ragged final batch %d -> %d "
+                "(mesh batch divisor %d)", n, keep, shard_div,
+            )
+            batch = {k: v[:keep] for k, v in batch.items()}
+        yield batch
+
+
+def run_evaluator(args) -> None:
+    """Sidecar-evaluator role: poll --checkpoint-dir, evaluate new
+    checkpoints on this process's local devices (standalone — never joins
+    the training cluster, mirroring the reference's evaluator-task
+    semantics)."""
+    from distributedtensorflow_tpu import parallel
+    from distributedtensorflow_tpu.checkpoint import CheckpointManager
+    from distributedtensorflow_tpu.data import InputContext, Prefetcher
+    from distributedtensorflow_tpu.train import (
+        SidecarEvaluator,
+        create_sharded_state,
+        make_eval_step,
+    )
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    if not args.checkpoint_dir:
+        raise SystemExit("--job evaluator requires --checkpoint-dir")
+    wl = get_workload(
+        args.workload, test_size=args.test_size,
+        global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
+        pp_virtual=args.pp_virtual, seq_len=args.seq_len,
+        attn_impl=args.attn_impl,
+    )
+    if wl.eval_fn is None:
+        raise SystemExit(f"workload {wl.name!r} has no eval_fn to sidecar")
+    spec = parse_mesh(args.mesh) or parallel.MeshSpec(data=-1)
+    mesh = parallel.build_mesh(spec)
+    wl = wl.for_mesh(mesh)
+    logging.info("evaluator: workload=%s mesh=%s watching %s",
+                 wl.name, dict(mesh.shape), args.checkpoint_dir)
+
+    rng = jax.random.PRNGKey(args.seed)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng,
+        rules=wl.layout, fsdp=wl.fsdp,
+    )
+    eval_step = make_eval_step(wl.eval_fn, mesh, specs)
+    ctx = InputContext(1, 0, wl.global_batch_size)
+
+    if args.eval_data_dir or args.data_dir:
+        from distributedtensorflow_tpu.data import record_dataset
+
+        files = record_files(args.eval_data_dir or args.data_dir)
+        eval_iter_fn = lambda: Prefetcher(  # one finite unshuffled pass
+            shardable_batches(record_dataset(
+                files, ctx, batch_size=ctx.per_host_batch_size,
+                policy=args.autoshard, shuffle_buffer=0,
+                drop_remainder=False,
+            ), mesh),
+            mesh,
+        )
+        eval_steps = 0  # dataset-wide exact eval
+    else:
+        eval_iter_fn = lambda: Prefetcher(
+            wl.input_fn(ctx, args.seed + 999), mesh
+        )
+        eval_steps = 10  # synthetic iterators are infinite; stay bounded
+
+    sidecar = SidecarEvaluator(
+        CheckpointManager(args.checkpoint_dir),
+        eval_step,
+        eval_iter_fn,
+        state,
+        eval_steps=eval_steps,
+        poll_interval_s=args.poll_interval,
+        max_evaluations=args.max_evaluations,
+        stop_after_step=args.steps if args.steps > 0 else None,
+        idle_timeout_s=args.idle_timeout,
+        logdir=args.logdir,
+    )
+    history = sidecar.run()
+    logging.info("evaluator: done; evaluated %d checkpoints", len(history))
+
+
 def main() -> None:
     # allow_abbrev=False: apply_config_file detects explicitly-typed flags
     # by matching argv against option strings; prefix abbreviations would
@@ -135,6 +244,29 @@ def main() -> None:
     p.add_argument("--pp-virtual", type=int, default=1,
                    help="virtual pipeline chunks per rank (>1 = circular/"
                         "interleaved schedule, smaller bubble)")
+    p.add_argument("--job", choices=("auto", "train", "evaluator"),
+                   default="auto",
+                   help="role of this process: train, or sidecar evaluator "
+                        "(polls --checkpoint-dir and evaluates new "
+                        "checkpoints). auto = evaluator iff TF_CONFIG "
+                        "task.type == 'evaluator' (reference semantics)")
+    p.add_argument("--poll-interval", type=float, default=10.0,
+                   help="evaluator: seconds between checkpoint-dir polls")
+    p.add_argument("--max-evaluations", type=int, default=None,
+                   help="evaluator: stop after N evaluations")
+    p.add_argument("--idle-timeout", type=float, default=600.0,
+                   help="evaluator: stop after this long with no new "
+                        "checkpoint")
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="LM presets: override sequence length")
+    p.add_argument("--remat", choices=("on", "off", "attn"), default=None,
+                   help="LM presets: rematerialization — whole blocks (on),"
+                        " none (off), or attention-only (attn: remat-free"
+                        " speed at ~2x the batch)")
+    p.add_argument("--attn-impl", choices=("auto", "xla", "pallas"),
+                   default=None,
+                   help="LM presets: attention kernel (auto = Pallas flash"
+                        " on TPU past the evidenced seq threshold)")
     args = p.parse_args()
     if args.config:
         import sys
@@ -158,6 +290,25 @@ def main() -> None:
 
         enable_determinism()
 
+    job = args.job
+    if job == "auto":
+        # Reference semantics: an "evaluator" task in TF_CONFIG is outside
+        # the training cluster and runs the sidecar-evaluation loop.
+        import json as jsonlib
+
+        tf_config = os.environ.get("TF_CONFIG")
+        try:
+            task_type = (
+                jsonlib.loads(tf_config).get("task", {}).get("type")
+                if tf_config else None
+            )
+        except (ValueError, AttributeError):
+            task_type = None
+        job = "evaluator" if task_type == "evaluator" else "train"
+    if job == "evaluator":
+        run_evaluator(args)
+        return
+
     from distributedtensorflow_tpu import parallel
     from distributedtensorflow_tpu.data import current_input_context, Prefetcher
     from distributedtensorflow_tpu.train import (
@@ -173,6 +324,9 @@ def main() -> None:
         args.workload, test_size=args.test_size,
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual,
+        seq_len=args.seq_len,
+        remat={"on": True, "off": False, "attn": "attn", None: None}[args.remat],
+        attn_impl=args.attn_impl,
     )
     spec = parse_mesh(args.mesh) or wl.mesh_spec
     mesh = parallel.build_mesh(spec)
@@ -207,17 +361,6 @@ def main() -> None:
             )
 
     ctx = current_input_context(wl.global_batch_size)
-
-    def record_files(data_dir):
-        import glob as globlib
-
-        files = sorted(
-            f for pat in ("*.tfrecord", "*.rio", "*.rec")
-            for f in globlib.glob(os.path.join(data_dir, pat))
-        )
-        if not files:
-            raise SystemExit(f"{data_dir}: no record files")
-        return files
 
     if args.data_dir:
         from distributedtensorflow_tpu.data import repeated_record_dataset
@@ -278,37 +421,16 @@ def main() -> None:
     if args.eval_every and eval_step is not None:
         if args.data_dir or args.eval_data_dir:
             from distributedtensorflow_tpu.data import record_dataset
-            from distributedtensorflow_tpu.parallel.mesh import replica_count
 
             eval_files = record_files(args.eval_data_dir or args.data_dir)
-            shard_div = replica_count(mesh)
-
-            def shardable(it):
-                """The ragged final batch is kept but truncated to a
-                multiple of the mesh batch divisor — device_put_batch
-                cannot shard e.g. 5 rows over data=2.  Drops < shard_div
-                examples (vs < batch_size under drop_remainder=True); the
-                trainer weights the short batch by its true count."""
-                for batch in it:
-                    n = len(next(iter(batch.values())))
-                    keep = n - n % shard_div
-                    if keep == 0:
-                        continue
-                    if keep != n:
-                        logging.info(
-                            "eval: truncated ragged final batch %d -> %d "
-                            "(mesh batch divisor %d)", n, keep, shard_div,
-                        )
-                        batch = {k: v[:keep] for k, v in batch.items()}
-                    yield batch
 
             # one finite unshuffled pass
             eval_iter_fn = lambda: Prefetcher(
-                shardable(record_dataset(
+                shardable_batches(record_dataset(
                     eval_files, ctx, batch_size=ctx.per_host_batch_size,
                     policy=args.autoshard, shuffle_buffer=0,
                     drop_remainder=False,
-                )),
+                ), mesh),
                 mesh,
             )
             if not args.eval_data_dir:
