@@ -64,19 +64,30 @@ def classification_loss(
 
 
 def classification_eval(
-    model, *, inputs_key: str = "image", labels_key: str = "label"
+    model, *, inputs_key: str = "image", labels_key: str = "label",
+    top5: bool = False,
 ) -> Callable:
-    """Eval metric_fn: loss + top-1 accuracy, no mutable-state update."""
+    """Eval metric_fn: loss + top-1 (and optional top-5) accuracy, no
+    mutable-state update.  ``top5`` is the ImageNet-recipe companion metric
+    (the reference's ResNet-50 config reports both)."""
 
     def metric_fn(params, model_state, batch):
         logits, _ = _apply(
             model, params, model_state, batch[inputs_key], train=False
         )
         labels = batch[labels_key]
+        logits = logits.astype(jnp.float32)
         loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), labels
+            logits, labels
         ).mean()
         accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
-        return {"loss": loss, "accuracy": accuracy}
+        metrics = {"loss": loss, "accuracy": accuracy}
+        if top5:
+            k = min(5, logits.shape[-1])
+            _, top = jax.lax.top_k(logits, k)  # (B, k)
+            metrics["top5_accuracy"] = jnp.mean(
+                jnp.any(top == labels[:, None], axis=-1).astype(jnp.float32)
+            )
+        return metrics
 
     return metric_fn

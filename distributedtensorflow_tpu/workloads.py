@@ -210,7 +210,7 @@ def get_workload(name: str, *, test_size: bool = False,
         return Workload(
             name=name, model=model,
             loss_fn=classification_loss(model, weight_decay=1e-4),
-            eval_fn=classification_eval(model),
+            eval_fn=classification_eval(model, top5=True),
             make_optimizer=lambda: optax.sgd(
                 optax.warmup_cosine_decay_schedule(0.0, 0.8, 1563, 112_590),
                 momentum=0.9, nesterov=True,

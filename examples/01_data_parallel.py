@@ -1,0 +1,43 @@
+"""Data-parallel training via the API (no CLI).
+
+The reference would pick MirroredStrategy / MultiWorkerMirroredStrategy;
+here both are one mesh shape: batch sharded over ``data``, gradient
+all-reduce compiled into the step by XLA (SURVEY.md §7 step 4).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/01_data_parallel.py
+"""
+
+import jax
+
+from distributedtensorflow_tpu import parallel
+from distributedtensorflow_tpu.data import InputContext, Prefetcher
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+from distributedtensorflow_tpu.workloads import get_workload
+
+
+def main():
+    parallel.initialize()  # no-op single-process; resolver chain multi-host
+    mesh = parallel.build_mesh(parallel.MeshSpec(data=-1))  # all devices
+    print(f"mesh: {dict(mesh.shape)} over {mesh.size} devices")
+
+    wl = get_workload("mnist_lenet", test_size=True, global_batch_size=64)
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+
+    ctx = InputContext(jax.process_count(), jax.process_index(),
+                       wl.global_batch_size)
+    with Prefetcher(wl.input_fn(ctx, seed=0), mesh) as batches:
+        for i, batch in enumerate(batches):
+            state, metrics = step(state, batch, rng)
+            if i % 20 == 0:
+                print(f"step {i}: loss={float(metrics['loss']):.4f}")
+            if i >= 100:
+                break
+    print(f"final loss {float(metrics['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Long-context GPT with sequence parallelism (ring attention).
+
+The mesh gets a real ``seq`` axis; ``gpt_lm``'s ``for_mesh`` hook swaps
+dense attention for the ring-attention shard_map region (ppermute KV
+rotation, Pallas flash chunk kernels on TPU — SURVEY.md §5.7).  Activations
+stay O(S / seq_axis) per device, so sequence length scales with the mesh.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/02_long_context.py
+"""
+
+import jax
+
+from distributedtensorflow_tpu import parallel
+from distributedtensorflow_tpu.data import InputContext, device_put_batch
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+from distributedtensorflow_tpu.workloads import get_workload
+
+
+def main():
+    parallel.initialize()
+    # data x seq: batch sharded 2 ways, every sequence split over 4 devices
+    mesh = parallel.build_mesh(parallel.MeshSpec(data=2, seq=4))
+    print(f"mesh: {dict(mesh.shape)}")
+
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=8,
+                      seq_len=256)           # 4x the tiny preset's context
+    wl = wl.for_mesh(mesh)                   # <- binds ring attention
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+
+    ctx = InputContext(1, 0, wl.global_batch_size)
+    it = iter(wl.input_fn(ctx, 0))
+    for i in range(20):
+        batch = device_put_batch(next(it), mesh)
+        state, metrics = step(state, batch, rng)
+        if i % 5 == 0:
+            print(f"step {i}: perplexity={float(metrics['perplexity']):.1f}")
+    # Ulysses variant: get_workload(..., sp_scheme="ulysses") — all_to_all
+    # head<->sequence reshard instead of the KV ring.
+
+
+if __name__ == "__main__":
+    main()

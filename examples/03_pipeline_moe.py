@@ -1,0 +1,56 @@
+"""Pipeline-parallel GPT and expert-parallel GPT-MoE.
+
+Both are new capability over the reference stack (SURVEY.md §2.4: no GPipe,
+no MoE in tf.distribute):
+
+- PP: GPT blocks split over the ``pipe`` axis, microbatches marched through
+  a ppermute ring; ``--pp-virtual``/``pp_virtual>1`` switches GPipe to the
+  circular (interleaved) schedule with an n_virtual-fold smaller bubble.
+- EP: every 2nd block's MLP routed over experts sharded on ``expert``,
+  all_to_all token dispatch, router aux loss folded into the LM loss.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/03_pipeline_moe.py
+"""
+
+import jax
+
+from distributedtensorflow_tpu import parallel
+from distributedtensorflow_tpu.data import InputContext, device_put_batch
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+from distributedtensorflow_tpu.workloads import get_workload
+
+
+def train_a_bit(name, wl, mesh, steps=10):
+    wl = wl.for_mesh(mesh)
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    it = iter(wl.input_fn(InputContext(1, 0, wl.global_batch_size), 0))
+    for _ in range(steps):
+        state, metrics = step(state, device_put_batch(next(it), mesh), rng)
+    print(f"{name}: loss={float(metrics['loss']):.4f} "
+          + (f"aux={float(metrics['aux_loss']):.4f}"
+             if "aux_loss" in metrics else ""))
+
+
+def main():
+    parallel.initialize()
+
+    # --- pipeline: 2-way data x 2-stage pipe, circular schedule ------------
+    pp_mesh = parallel.build_mesh(parallel.MeshSpec(data=2, pipe=2))
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=16,
+                      pp_virtual=1)  # tiny model: 2 layers -> 1 layer/stage
+    print(f"pipe mesh {dict(pp_mesh.shape)}; "
+          f"bubble={wl.for_mesh(pp_mesh).model.bubble_fraction():.1%}")
+    train_a_bit("pipelined gpt", wl, pp_mesh)
+
+    # --- MoE: 2-way data x 4-way expert ------------------------------------
+    ep_mesh = parallel.build_mesh(parallel.MeshSpec(data=2, expert=4))
+    wl = get_workload("gpt_moe", test_size=True, global_batch_size=8)
+    train_a_bit("gpt-moe (top-2 routing)", wl, ep_mesh)
+
+
+if __name__ == "__main__":
+    main()

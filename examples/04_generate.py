@@ -1,0 +1,40 @@
+"""KV-cache autoregressive generation (the serving path).
+
+One jitted scan drives the whole decode: every attention layer runs in
+incremental mode against a static ``max_seq`` cache, so there is exactly
+one compilation no matter how many tokens are generated.  Greedy, top-k,
+and nucleus (top-p) sampling; eos freezing with static shapes.
+
+Run: python examples/04_generate.py   (any platform; tiny model)
+"""
+
+import jax
+import jax.numpy as jnp
+
+from distributedtensorflow_tpu.models import GPTLM, gpt_tiny
+from distributedtensorflow_tpu.models.generate import generate
+
+
+def main():
+    cfg = gpt_tiny()
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+
+    prompt = jax.random.randint(rng, (2, 12), 0, cfg.vocab_size)
+    out = generate(
+        params, prompt, cfg=cfg, max_new_tokens=20,
+        temperature=0.8, top_p=0.9, rng=rng,
+    )
+    print(f"prompt shape {prompt.shape} -> output shape {out.shape}")
+    for row in out.tolist():
+        print("tokens:", row[:12], "->", row[12:])
+
+    greedy = generate(params, prompt, cfg=cfg, max_new_tokens=20)
+    again = generate(params, prompt, cfg=cfg, max_new_tokens=20)
+    assert (greedy == again).all(), "greedy decoding is deterministic"
+    print("greedy decode deterministic: ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Train + checkpoint in one process; evaluate checkpoints from another.
+#
+# The reference's TF_CONFIG "evaluator" task convention: the evaluator is
+# OUTSIDE the training cluster and polls the checkpoint directory.  Here
+# the role is selected by --job (or automatically when TF_CONFIG says
+# task.type == "evaluator").
+set -e
+cd "$(dirname "$0")/.."
+CKPT=$(mktemp -d)
+LOGS=$(mktemp -d)
+export XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+# evaluator in the background: polls until it has seen the final step
+python train.py --job evaluator --workload mnist_lenet --test-size \
+  --device cpu --steps 60 --checkpoint-dir "$CKPT" --batch-size 32 \
+  --poll-interval 1 --idle-timeout 120 --logdir "$LOGS" &
+EVAL_PID=$!
+
+# trainer in the foreground
+python train.py --workload mnist_lenet --test-size --device cpu \
+  --steps 60 --checkpoint-every 20 --checkpoint-dir "$CKPT" \
+  --batch-size 32 --mesh data=2 --log-every 20
+
+wait "$EVAL_PID"
+echo "--- sidecar metrics ---"
+cat "$LOGS/metrics.jsonl"
+rm -rf "$CKPT" "$LOGS"

@@ -25,15 +25,17 @@ from distributedtensorflow_tpu.train.losses import (
 from distributedtensorflow_tpu.train.trainer import Trainer, TrainerConfig
 
 
-def _setup(mesh):
+def _setup(mesh, *, top5=False):
     model = LeNet5()
     init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
     state, specs = create_sharded_state(
         init_fn, optax.sgd(0.05, momentum=0.9), mesh, jax.random.PRNGKey(0)
     )
     train_step = make_train_step(classification_loss(model), mesh, specs)
-    eval_step = make_eval_step(classification_eval(model), mesh, specs)
-    return state, train_step, eval_step
+    eval_step = make_eval_step(
+        classification_eval(model, top5=top5), mesh, specs
+    )
+    return model, state, train_step, eval_step
 
 
 def _batches(n, batch_size=16, seed=0):
@@ -48,7 +50,7 @@ def _batches(n, batch_size=16, seed=0):
 
 
 def test_fit_runs_and_evals(tmp_path, dp_mesh):
-    state, train_step, eval_step = _setup(dp_mesh)
+    _, state, train_step, eval_step = _setup(dp_mesh)
     cfg = TrainerConfig(
         total_steps=4, log_every=2, eval_every=2, eval_steps=2,
         global_batch_size=16, logdir=str(tmp_path / "logs"),
@@ -68,7 +70,7 @@ def test_fit_runs_and_evals(tmp_path, dp_mesh):
 def test_keep_best_checkpointer_under_trainer(tmp_path, dp_mesh):
     """A best_metric manager must work through Trainer.fit (metrics are
     threaded into every save; pre-eval saves use a worst-possible score)."""
-    state, train_step, eval_step = _setup(dp_mesh)
+    _, state, train_step, eval_step = _setup(dp_mesh)
     mgr = CheckpointManager(
         str(tmp_path / "best"), max_to_keep=2, async_save=False,
         best_metric="accuracy", best_mode="max",
@@ -95,7 +97,7 @@ def test_keep_best_checkpointer_under_trainer(tmp_path, dp_mesh):
 
 def test_eval_weighted_by_batch_size(dp_mesh):
     """A ragged final batch must count per-example, not per-batch."""
-    state, train_step, eval_step = _setup(dp_mesh)
+    _, state, train_step, eval_step = _setup(dp_mesh)
     cfg = TrainerConfig(total_steps=1, eval_steps=0, global_batch_size=16)
     trainer = Trainer(train_step, cfg, eval_step=eval_step)
 
@@ -118,7 +120,7 @@ def test_eval_weighted_by_batch_size(dp_mesh):
 
 
 def test_eval_steps_zero_consumes_finite_iterator(dp_mesh):
-    state, train_step, eval_step = _setup(dp_mesh)
+    _, state, train_step, eval_step = _setup(dp_mesh)
     cfg = TrainerConfig(total_steps=1, eval_steps=0, global_batch_size=16)
     trainer = Trainer(train_step, cfg, eval_step=eval_step)
     seen = []
@@ -130,3 +132,22 @@ def test_eval_steps_zero_consumes_finite_iterator(dp_mesh):
 
     trainer.evaluate(state, gen())
     assert len(seen) == 3  # whole iterator, not the default 10-step cap
+
+
+def test_top5_accuracy_metric(dp_mesh):
+    """top5=True adds a top-5 accuracy that upper-bounds top-1 and matches
+    a numpy reference."""
+    model, state, _, eval_step = _setup(dp_mesh, top5=True)
+    batch = next(_batches(1))
+    metrics = eval_step(state, batch)
+    assert set(metrics) == {"loss", "accuracy", "top5_accuracy"}
+    assert metrics["top5_accuracy"] >= metrics["accuracy"]
+    # numpy reference on the same logits
+    logits = np.asarray(
+        model.apply(
+            {"params": state.params}, batch["image"], train=False
+        )
+    )
+    top5 = np.argsort(-logits, axis=-1)[:, :5]
+    want = np.mean([l in row for l, row in zip(batch["label"], top5)])
+    np.testing.assert_allclose(float(metrics["top5_accuracy"]), want, rtol=1e-6)
