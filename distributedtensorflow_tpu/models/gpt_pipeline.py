@@ -61,6 +61,10 @@ class PipelinedGPT:
     #: n_virtual non-adjacent stage chunks, shrinking the bubble
     #: n_virtual-fold (`circular_bubble_fraction`).
     n_virtual: int = 1
+    #: Sequence-parallel attention inside the stages when the mesh has a
+    #: real ``seq`` axis: "ring" (ppermute KV rotation) or "ulysses"
+    #: (all_to_all head<->sequence reshard).
+    sp_scheme: str = "ring"
 
     def __post_init__(self):
         cfg = self.cfg
@@ -69,6 +73,12 @@ class PipelinedGPT:
                 f"n_virtual must be >= 1, got {self.n_virtual} "
                 "(--pp-virtual on the CLI)"
             )
+        # pipe x seq composition: with a real seq axis on the mesh, every
+        # activation is additionally sharded over seq and each stage's
+        # attention runs the K/V ring across it (direct lax collectives —
+        # the pipeline's shard_map already makes every axis manual).
+        self.seq_axis = mesh_lib.AXIS_SEQ
+        self.seq_parallel = dict(self.mesh.shape).get(self.seq_axis, 1) > 1
         self.n_stages = self.mesh.shape[self.axis_name]
         total_stages = self.n_stages * self.n_virtual
         if cfg.num_layers % total_stages:
@@ -90,7 +100,34 @@ class PipelinedGPT:
         self._embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="wte"
         )
+        # _block initializes params (dense attention; attn_fn carries no
+        # params, so the tree is identical either way).  _apply_block is
+        # what stages execute: under seq parallelism it swaps in ring
+        # attention, whose lax collectives only trace inside the shard_map.
         self._block = GPTBlock(cfg)
+        if self.seq_parallel:
+            import functools
+
+            from ..parallel.ring_attention import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            try:
+                sp_fn = {"ring": ring_attention,
+                         "ulysses": ulysses_attention}[self.sp_scheme]
+            except KeyError:
+                raise ValueError(
+                    f"sp_scheme must be ring|ulysses, got {self.sp_scheme!r}"
+                ) from None
+            self._apply_block = GPTBlock(
+                cfg,
+                functools.partial(
+                    sp_fn, axis_name=self.seq_axis, causal=True
+                ),
+            )
+        else:
+            self._apply_block = self._block
         self._ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
 
     # --- init ---------------------------------------------------------------
@@ -151,12 +188,22 @@ class PipelinedGPT:
     def _stage_fn(self, stage_params: PyTree, x: jax.Array) -> jax.Array:
         """Apply this stage's ``layers_per_stage`` blocks (scan over the
         layer dim of the local param stack)."""
-        positions = jnp.broadcast_to(
-            jnp.arange(x.shape[1]), x.shape[:2]
-        )
+        if self.seq_parallel:
+            # x holds this device's contiguous sequence chunk: positions
+            # carry the global offset (RoPE and the ring's causal masking
+            # both key off absolute position).
+            s_loc = x.shape[1]
+            positions = jnp.broadcast_to(
+                lax.axis_index(self.seq_axis) * s_loc + jnp.arange(s_loc),
+                x.shape[:2],
+            )
+        else:
+            positions = jnp.broadcast_to(
+                jnp.arange(x.shape[1]), x.shape[:2]
+            )
 
         def one(x, layer_params):
-            y = self._block.apply(
+            y = self._apply_block.apply(
                 {"params": layer_params}, x, positions, True
             )
             return y, None
@@ -173,7 +220,11 @@ class PipelinedGPT:
         x = self._embed.apply({"params": params["wte"]}, input_ids)
 
         batch_axes = mesh_lib.data_axes(self.mesh)
-        x_spec = P(batch_axes if batch_axes else None, None, None)
+        x_spec = P(
+            batch_axes if batch_axes else None,
+            self.seq_axis if self.seq_parallel else None,
+            None,
+        )
         circular = self.n_virtual > 1
         if circular:
             block_specs = jax.tree.map(

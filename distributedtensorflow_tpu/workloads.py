@@ -317,11 +317,8 @@ def get_workload(name: str, *, test_size: bool = False,
             # block stack (embed/head outside) — params gain a stage dim,
             # so init_fn and layout change too.
             if shape.get("pipe", 1) > 1:
-                if shape.get("seq", 1) > 1:
-                    raise NotImplementedError(
-                        "pipe x seq on one mesh needs ring attention inside "
-                        "the pipeline shard_map; shard one of them"
-                    )
+                # pipe x seq composes: PipelinedGPT detects a real seq axis
+                # and runs ring attention inside each stage.
                 from .models.gpt_pipeline import (
                     PipelinedGPT,
                     pipelined_lm_loss,
@@ -334,7 +331,7 @@ def get_workload(name: str, *, test_size: bool = False,
                 while n_micro > 1 and local_batch % n_micro:
                     n_micro //= 2
                 pp = PipelinedGPT(cfg, mesh, n_microbatches=n_micro,
-                                  n_virtual=pp_virtual)
+                                  n_virtual=pp_virtual, sp_scheme=sp_scheme)
                 return dataclasses.replace(
                     wl,
                     model=pp,

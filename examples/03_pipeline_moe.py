@@ -21,7 +21,6 @@ from distributedtensorflow_tpu.workloads import get_workload
 
 
 def train_a_bit(name, wl, mesh, steps=10):
-    wl = wl.for_mesh(mesh)
     rng = jax.random.PRNGKey(0)
     state, specs = create_sharded_state(
         wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
@@ -38,18 +37,20 @@ def train_a_bit(name, wl, mesh, steps=10):
 def main():
     parallel.initialize()
 
-    # --- pipeline: 2-way data x 2-stage pipe, circular schedule ------------
+    # --- pipeline: 2-way data x 2-stage pipe (GPipe schedule; the tiny
+    # 2-layer model can't also interleave — on a 12-layer config, pass
+    # pp_virtual=2+ for the circular schedule's smaller bubble) ------------
     pp_mesh = parallel.build_mesh(parallel.MeshSpec(data=2, pipe=2))
-    wl = get_workload("gpt_lm", test_size=True, global_batch_size=16,
-                      pp_virtual=1)  # tiny model: 2 layers -> 1 layer/stage
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=16)
+    wl = wl.for_mesh(pp_mesh)
     print(f"pipe mesh {dict(pp_mesh.shape)}; "
-          f"bubble={wl.for_mesh(pp_mesh).model.bubble_fraction():.1%}")
+          f"bubble={wl.model.bubble_fraction():.1%}")
     train_a_bit("pipelined gpt", wl, pp_mesh)
 
     # --- MoE: 2-way data x 4-way expert ------------------------------------
     ep_mesh = parallel.build_mesh(parallel.MeshSpec(data=2, expert=4))
     wl = get_workload("gpt_moe", test_size=True, global_batch_size=8)
-    train_a_bit("gpt-moe (top-2 routing)", wl, ep_mesh)
+    train_a_bit("gpt-moe (top-2 routing)", wl.for_mesh(ep_mesh), ep_mesh)
 
 
 if __name__ == "__main__":

@@ -44,11 +44,11 @@ while true; do
         echo "$(date -Is) watcher: TPU convergence artifact landed" >> "$LOG"
       fi
     fi
-    if (( ok == 1 )); then
-      echo "$(date -Is) watcher: all benches landed" >> "$LOG"
+    if (( ok == 1 )) && [ -f ARTIFACTS/convergence_mnist_tpu/.done ]; then
+      echo "$(date -Is) watcher: all benches + convergence landed" >> "$LOG"
       exit 0
     fi
-    echo "$(date -Is) watcher: partial failure, will retry" >> "$LOG"
+    echo "$(date -Is) watcher: partial success, will retry" >> "$LOG"
   else
     echo "$(date -Is) watcher: tunnel down" >> "$LOG"
   fi
