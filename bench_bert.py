@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Benchmark for reference config #4: BERT-base MLM examples/sec/chip.
+
+The reference trains BERT-base MLM (512 tokens) with gradient accumulation
+over CollectiveAllReduce (BASELINE.json configs[3]).  This measures the raw
+train-step throughput of our preset on one chip (accumulation is a lax.scan
+over the same compiled step — per-example cost is identical, so the raw
+step is the honest unit).
+
+Knobs (env): ``BENCH_BERT_BATCH`` per-chip batch (default 16),
+``BENCH_BERT_SEQ`` (default 512).  Prints one JSON line like bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from bench_probe import probe_devices_with_retries
+
+if not probe_devices_with_retries("bench_bert"):
+    raise SystemExit(2)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+from bench import _peak_flops  # noqa: E402
+
+
+def main() -> None:
+    from distributedtensorflow_tpu.data import InputContext, device_put_batch
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    n_chips = mesh.size
+    test_size = os.environ.get("BENCH_BERT_TEST") == "1"
+    per_chip_batch = int(
+        os.environ.get("BENCH_BERT_BATCH", "2" if test_size else "16")
+    )
+    seq = int(os.environ.get("BENCH_BERT_SEQ", "128" if test_size else "512"))
+    wl = get_workload(
+        "bert_mlm", test_size=test_size,
+        global_batch_size=per_chip_batch * n_chips,
+        seq_len=seq,
+    )
+
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    ctx = InputContext(1, 0, wl.global_batch_size)
+    batch = device_put_batch(next(iter(wl.input_fn(ctx, 0))), mesh)
+
+    compiled = step.lower(state, batch, rng).compile()
+    for _ in range(3):
+        state, metrics = compiled(state, batch, rng)
+    float(metrics["loss"])  # force execution (axon: block_until_ready no-op)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = compiled(state, batch, rng)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    per_chip = n_steps * wl.global_batch_size / dt / n_chips
+
+    flops_per_chip_step = None
+    flops_source = "analytic_6N_per_token"
+    try:
+        cost = compiled.cost_analysis()
+        if cost and cost.get("flops"):
+            flops_per_chip_step = float(cost["flops"])
+            flops_source = "xla_cost_analysis"
+    except Exception as e:
+        print(f"bench_bert: cost_analysis unavailable ({e})", file=sys.stderr)
+    if not flops_per_chip_step:
+        n_params = sum(
+            int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
+        )
+        flops_per_chip_step = (
+            6.0 * n_params * wl.global_batch_size * seq / n_chips
+        )
+    device_kind = jax.devices()[0].device_kind
+    mfu = (flops_per_chip_step * n_steps / dt) / _peak_flops(device_kind)
+
+    # Anchor: an A100 pretrains BERT-base (seq 512) at roughly 200
+    # examples/sec (MLPerf-class phase-2 throughput).
+    result = {
+        "metric": "bert_base_mlm_examples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(per_chip / 200.0, 4),
+        "mfu": round(mfu, 4),
+        "mfu_flops_source": flops_source,
+        "platform": jax.devices()[0].platform,
+        "device_kind": device_kind,
+        "seq": seq,
+        "global_batch": wl.global_batch_size,
+        "step_time_ms": round(1000 * dt / n_steps, 2),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    from bench_probe import is_tpu_platform, persist_result
+
+    if is_tpu_platform(result["platform"]) and not test_size:
+        persist_result("bert", result)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

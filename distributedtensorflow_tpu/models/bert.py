@@ -133,11 +133,19 @@ class BertForMLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  deterministic: bool = True, segment_ids=None,
-                 position_ids=None):
+                 position_ids=None, masked_positions=None):
+        """``masked_positions`` (B, P): run the MLM head only at those
+        positions (returns (B, P, V) instead of (B, S, V)).  The standard
+        BERT-pretraining optimization — ~15% of positions are masked, so
+        the transform/projection head does 6-7x less work and the logits
+        tensor shrinks the same factor.  Param tree is identical either
+        way."""
         cfg = self.cfg
         encoder = BertEncoder(cfg, name="encoder")
         x = encoder(input_ids, token_type_ids, attention_mask, deterministic,
                     segment_ids, position_ids)
+        if masked_positions is not None:
+            x = jnp.take_along_axis(x, masked_positions[..., None], axis=1)
         x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
@@ -145,14 +153,41 @@ class BertForMLM(nn.Module):
         return x
 
 
-def mlm_loss(model: BertForMLM):
+def mlm_loss(model: BertForMLM, *, max_predictions: int | None = None):
     """LossFn for masked-LM batches: {input_ids, labels, attention_mask}.
 
     ``labels`` uses -100 (ignore) convention at unmasked positions.
+
+    ``max_predictions`` enables the gathered-head path: the P first masked
+    positions per row (found with a static-shape ``top_k`` on the validity
+    mask) are gathered *before* the MLM head, so transform/projection and
+    the (.., V) logits run on P positions instead of S — the reference
+    BERT-pretraining recipe's ``masked_lm_positions`` idea, recovered here
+    from the -100 convention inside the compiled step.  Rows with more
+    than P masked positions drop the excess (standard practice; size P to
+    the masking rate).
     """
     import optax
 
-    def loss_fn(params, model_state, batch, rng):
+    def gathered(params, batch, rng, labels, valid):
+        p = min(max_predictions, labels.shape[1])
+        weights, pos = jax.lax.top_k(valid.astype(jnp.int32), p)  # (B, P)
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            deterministic=False,
+            segment_ids=batch.get("segment_ids"),
+            position_ids=batch.get("position_ids"),
+            masked_positions=pos,
+            rngs={"dropout": rng},
+        )  # (B, P, V)
+        safe_labels = jnp.take_along_axis(
+            jnp.where(valid, labels, 0), pos, axis=1
+        )
+        return logits, safe_labels, weights.astype(jnp.float32)
+
+    def dense(params, batch, rng, labels, valid):
         logits = model.apply(
             {"params": params},
             batch["input_ids"],
@@ -161,19 +196,20 @@ def mlm_loss(model: BertForMLM):
             segment_ids=batch.get("segment_ids"),
             position_ids=batch.get("position_ids"),
             rngs={"dropout": rng},
-        )
+        )  # (B, S, V)
+        return logits, jnp.where(valid, labels, 0), valid.astype(jnp.float32)
+
+    def loss_fn(params, model_state, batch, rng):
         labels = batch["labels"]
         valid = labels >= 0
-        safe_labels = jnp.where(valid, labels, 0)
+        head = gathered if max_predictions else dense
+        logits, safe_labels, w = head(params, batch, rng, labels, valid)
         per_tok = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), safe_labels
         )
-        denom = jnp.maximum(valid.sum(), 1)
-        loss = jnp.where(valid, per_tok, 0.0).sum() / denom
-        acc = (
-            jnp.where(valid, jnp.argmax(logits, -1) == safe_labels, False).sum()
-            / denom
-        )
+        denom = jnp.maximum(w.sum(), 1.0)
+        loss = (per_tok * w).sum() / denom
+        acc = ((jnp.argmax(logits, -1) == safe_labels) * w).sum() / denom
         return loss, ({"mlm_accuracy": acc.astype(jnp.float32)}, model_state)
 
     return loss_fn

@@ -79,6 +79,12 @@ class PipelinedGPT:
         # the pipeline's shard_map already makes every axis manual).
         self.seq_axis = mesh_lib.AXIS_SEQ
         self.seq_parallel = dict(self.mesh.shape).get(self.seq_axis, 1) > 1
+        if self.sp_scheme not in ("ring", "ulysses"):
+            # validated regardless of mesh shape, so a typo surfaces at
+            # construction, not when the config is later scaled to seq > 1
+            raise ValueError(
+                f"sp_scheme must be ring|ulysses, got {self.sp_scheme!r}"
+            )
         self.n_stages = self.mesh.shape[self.axis_name]
         total_stages = self.n_stages * self.n_virtual
         if cfg.num_layers % total_stages:
@@ -113,13 +119,8 @@ class PipelinedGPT:
                 ulysses_attention,
             )
 
-            try:
-                sp_fn = {"ring": ring_attention,
-                         "ulysses": ulysses_attention}[self.sp_scheme]
-            except KeyError:
-                raise ValueError(
-                    f"sp_scheme must be ring|ulysses, got {self.sp_scheme!r}"
-                ) from None
+            sp_fn = {"ring": ring_attention,
+                     "ulysses": ulysses_attention}[self.sp_scheme]
             self._apply_block = GPTBlock(
                 cfg,
                 functools.partial(

@@ -229,9 +229,13 @@ def get_workload(name: str, *, test_size: bool = False,
         # pretraining pipeline; everything else is identical.
         packed = name.endswith("_packed")
         cfg = bert_tiny() if test_size else bert_base()
-        model = BertForMLM(cfg)
         gbs = global_batch_size or 256
-        seq = 128 if test_size else 512
+        seq = seq_len or (128 if test_size else 512)
+        if seq > cfg.max_position:
+            # grow the position table with the override (same contract as
+            # the gpt presets' max_seq growth)
+            cfg = dataclasses.replace(cfg, max_position=seq)
+        model = BertForMLM(cfg)
         if packed:
             input_fn = lambda ctx, seed: synthetic_packed_mlm(
                 ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
@@ -253,7 +257,9 @@ def get_workload(name: str, *, test_size: bool = False,
             }
         return Workload(
             name=name, model=model,
-            loss_fn=mlm_loss(model),
+            # Gathered MLM head: P = 20% of seq (mask rate is 15%; excess
+            # masked positions in a row are dropped, standard practice).
+            loss_fn=mlm_loss(model, max_predictions=seq // 5 + 1),
             eval_fn=None,
             make_optimizer=lambda: optax.adamw(1e-4, weight_decay=0.01),
             input_fn=input_fn,

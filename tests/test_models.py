@@ -1,5 +1,7 @@
 """Model zoo smoke tests: shapes, param counts, gradient flow."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -184,3 +186,50 @@ def test_mlm_loss_accepts_packed_batch():
     assert np.isfinite(float(loss))
     gnorm = sum(jnp.sum(jnp.abs(g)) for g in jax.tree.leaves(grads))
     assert float(gnorm) > 0
+
+
+def test_mlm_gathered_head_matches_dense():
+    """max_predictions (gather masked positions before the head) must give
+    the same loss/accuracy/grads as the dense head when no row exceeds P.
+
+    Dropout off (deterministic rngs differ in shape between the paths), so
+    the only difference is where the head runs."""
+    cfg = dataclasses.replace(bert_tiny(), dropout_rate=0.0)
+    model = BertForMLM(cfg)
+    rng = jax.random.PRNGKey(4)
+    b, s, n_masked = 4, 32, 5
+    ids = jax.random.randint(rng, (b, s), 0, cfg.vocab_size)
+    vs = model.init(rng, ids)
+    labels = np.full((b, s), -100, np.int32)
+    r = np.random.default_rng(0)
+    for i in range(b):  # scattered masked positions, n_masked per row
+        pos = r.choice(s, size=n_masked, replace=False)
+        labels[i, pos] = np.asarray(ids[i, pos])
+    batch = {
+        "input_ids": np.asarray(ids, np.int32),
+        "labels": labels,
+        "attention_mask": np.ones((b, s), np.int32),
+    }
+    dense_fn = mlm_loss(model)
+    gather_fn = mlm_loss(model, max_predictions=8)  # > n_masked
+    (ld, (md, _)), gd = jax.value_and_grad(dense_fn, has_aux=True)(
+        vs["params"], {}, batch, rng
+    )
+    (lg, (mg, _)), gg = jax.value_and_grad(gather_fn, has_aux=True)(
+        vs["params"], {}, batch, rng
+    )
+    np.testing.assert_allclose(float(lg), float(ld), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(mg["mlm_accuracy"]), float(md["mlm_accuracy"]), rtol=1e-6
+    )
+    for a, c in zip(jax.tree.leaves(gg), jax.tree.leaves(gd)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(c, np.float32),
+            atol=2e-4, rtol=2e-3,
+        )
+    # excess masked positions are dropped, not crashed on
+    overflow_fn = mlm_loss(model, max_predictions=3)  # < n_masked
+    (lo, _), _ = jax.value_and_grad(overflow_fn, has_aux=True)(
+        vs["params"], {}, batch, rng
+    )
+    assert np.isfinite(float(lo))
