@@ -155,44 +155,23 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
     # cost analysis (a separate lower().compile() for cost analysis alone
     # would pay a second full ResNet-50 compile over the flaky tunnel).
     compiled = step.lower(state, batch, rng).compile()
+    from bench_probe import mfu_from_compiled, timed_steps
 
-    # Warmup.  NOTE: sync via a host value fetch, not block_until_ready —
-    # the final loss depends on the whole step chain, so fetching it forces
-    # execution on backends whose block_until_ready is a no-op (observed
-    # with the axon PJRT tunnel).
-    for _ in range(warmup):
-        state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
+    state, dt = timed_steps(compiled, state, batch, rng,
+                            n_steps=n_steps, warmup=warmup)
     images_per_sec = n_steps * global_batch / dt
     per_chip = images_per_sec / n_chips
 
     # Model-FLOPs utilization, computed per chip on both sides: XLA's cost
     # analysis counts the PARTITIONED (per-device) module's FLOPs, which is
     # exactly the per-chip numerator; the analytic fallback is global and
-    # divided down by n_chips.
-    flops_per_chip_step = None
-    try:
-        cost = compiled.cost_analysis()
-        if cost and cost.get("flops"):
-            flops_per_chip_step = float(cost["flops"])
-    except Exception as e:  # cost analysis is best-effort on the tunnel
-        print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
-    flops_source = "xla_cost_analysis"
-    if not flops_per_chip_step:
-        # analytic constant is for 224px; scale by the conv-FLOP area ratio
-        flops_per_chip_step = (
-            RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
-            * (image_size / 224.0) ** 2 / n_chips
-        )
-        flops_source = "analytic_12.3GF_per_image"
-    mfu = (flops_per_chip_step * n_steps / dt) / _peak_flops(device_kind)
+    # divided down by n_chips (224px constant scaled by conv-FLOP area).
+    mfu, flops_source = mfu_from_compiled(
+        compiled, dt, n_steps, device_kind,
+        RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
+        * (image_size / 224.0) ** 2 / n_chips,
+        "analytic_12.3GF_per_image",
+    )
 
     return {
         "metric": "resnet50_synthetic_imagenet_images_per_sec_per_chip",

@@ -29,7 +29,6 @@ import numpy as np  # noqa: E402
 if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
-from bench import _peak_flops  # noqa: E402
 
 
 def main() -> None:
@@ -60,37 +59,36 @@ def main() -> None:
     batch = device_put_batch(next(iter(wl.input_fn(ctx, 0))), mesh)
 
     compiled = step.lower(state, batch, rng).compile()
-    for _ in range(3):
-        state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])  # force execution (axon: block_until_ready no-op)
-
     n_steps = 20
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    from bench_probe import timed_steps, mfu_from_compiled
 
+    state, dt = timed_steps(compiled, state, batch, rng,
+                            n_steps=n_steps, warmup=3)
     per_chip = n_steps * wl.global_batch_size / dt / n_chips
 
-    flops_per_chip_step = None
-    flops_source = "analytic_6N_per_token"
-    try:
-        cost = compiled.cost_analysis()
-        if cost and cost.get("flops"):
-            flops_per_chip_step = float(cost["flops"])
-            flops_source = "xla_cost_analysis"
-    except Exception as e:
-        print(f"bench_bert: cost_analysis unavailable ({e})", file=sys.stderr)
-    if not flops_per_chip_step:
-        n_params = sum(
-            int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
-        )
-        flops_per_chip_step = (
-            6.0 * n_params * wl.global_batch_size * seq / n_chips
-        )
+    # Analytic fallback honoring the GATHERED head: encoder matmul params
+    # run at all S positions, the mlm_* head params only at the P gathered
+    # positions, and embedding tables are lookups (no matmul FLOPs).
+    n_encoder = n_head = 0
+    for path, leaf in jax.tree.leaves_with_path(state.params):
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "embed" in key:
+            continue
+        n = int(np.prod(leaf.shape))
+        if "mlm_" in key:
+            n_head += n
+        else:
+            n_encoder += n
+    p_gathered = seq // 5 + 1  # the preset's max_predictions
+    fallback = (
+        6.0 * wl.global_batch_size
+        * (n_encoder * seq + n_head * p_gathered) / n_chips
+    )
     device_kind = jax.devices()[0].device_kind
-    mfu = (flops_per_chip_step * n_steps / dt) / _peak_flops(device_kind)
+    mfu, flops_source = mfu_from_compiled(
+        compiled, dt, n_steps, device_kind, fallback,
+        "analytic_6N_enc_at_S_head_at_P",
+    )
 
     # Anchor: an A100 pretrains BERT-base (seq 512) at roughly 200
     # examples/sec (MLPerf-class phase-2 throughput).

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 from bench_probe import probe_devices_with_retries
@@ -25,7 +24,6 @@ if not probe_devices_with_retries("bench_lm"):
     raise SystemExit(2)
 
 import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 # The axon sitecustomize force-selects the TPU platform over JAX_PLATFORMS;
@@ -33,8 +31,6 @@ import numpy as np  # noqa: E402
 if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
-#: Peak dense bf16 FLOP/s per chip (bench.py keeps the authoritative map).
-from bench import _peak_flops  # noqa: E402
 
 
 def main() -> None:
@@ -73,41 +69,26 @@ def main() -> None:
 
     # AOT-compile once; reuse for warmup, timing, and cost analysis.
     compiled = step.lower(state, batch, rng).compile()
-    for _ in range(3):  # warmup
-        state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])  # force execution (axon: block_until_ready no-op)
-
     n_steps = 20
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    from bench_probe import mfu_from_compiled, timed_steps
 
+    state, dt = timed_steps(compiled, state, batch, rng,
+                            n_steps=n_steps, warmup=3)
     tokens_per_sec = n_steps * wl.global_batch_size * seq / dt
     per_chip = tokens_per_sec / n_chips
 
-    # MFU from XLA's partitioned-module cost analysis (per-chip FLOPs);
-    # analytic fallback 6N per token fwd+bwd (+2N when remat recomputes fwd).
-    flops_per_chip_step = None
-    try:
-        cost = compiled.cost_analysis()
-        if cost and cost.get("flops"):
-            flops_per_chip_step = float(cost["flops"])
-        flops_source = "xla_cost_analysis"
-    except Exception as e:
-        print(f"bench_lm: cost_analysis unavailable ({e})", file=sys.stderr)
-    if not flops_per_chip_step:
-        n_params = sum(
-            int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
-        )
-        # 6N fwd+bwd; +2N full-block recompute; attention-only remat
-        # recomputes ~5% of the forward.
-        per_token = {False: 6.0, True: 8.0, "attn": 6.3}[remat] * n_params
-        flops_per_chip_step = per_token * wl.global_batch_size * seq / n_chips
-        flops_source = "analytic_6N_per_token"
+    # Analytic fallback: 6N per token fwd+bwd; +2N full-block recompute;
+    # attention-only remat recomputes ~5% of the forward.
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
+    )
+    per_token = {False: 6.0, True: 8.0, "attn": 6.3}[remat] * n_params
     device_kind = jax.devices()[0].device_kind
-    mfu = (flops_per_chip_step * n_steps / dt) / _peak_flops(device_kind)
+    mfu, flops_source = mfu_from_compiled(
+        compiled, dt, n_steps, device_kind,
+        per_token * wl.global_batch_size * seq / n_chips,
+        "analytic_6N_per_token",
+    )
 
     # Anchor: an A100 trains GPT-2-small (~124M params) at roughly 150k
     # tokens/sec with remat off; used as the vs_baseline denominator.

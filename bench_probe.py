@@ -158,3 +158,44 @@ def probe_devices_or_die(name: str = "bench") -> None:
                 file=sys.stderr,
             )
             raise SystemExit(2)
+
+
+# --- shared measurement harness (used by bench.py / bench_lm / bench_bert) ---
+
+
+def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
+    """Run warmup + timed steps of a compiled ``(state, batch, rng) ->
+    (state, metrics)`` executable.  Sync is a host fetch of the loss (NOT
+    block_until_ready, which is a no-op on the axon tunnel backend).
+    Returns ``(state, dt_seconds)``."""
+    import time
+
+    for _ in range(warmup):
+        state, metrics = compiled(state, batch, rng)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = compiled(state, batch, rng)
+    float(metrics["loss"])
+    return state, time.perf_counter() - t0
+
+
+def mfu_from_compiled(compiled, dt: float, n_steps: int, device_kind: str,
+                      fallback_flops_per_step: float,
+                      fallback_source: str) -> tuple[float, str]:
+    """Model-FLOPs utilization from XLA's partitioned-module cost analysis
+    (per-chip FLOPs), falling back to the caller's analytic estimate."""
+    from bench import _peak_flops
+
+    flops_per_step = None
+    source = "xla_cost_analysis"
+    try:
+        cost = compiled.cost_analysis()
+        if cost and cost.get("flops"):
+            flops_per_step = float(cost["flops"])
+    except Exception as e:  # cost analysis is best-effort on the tunnel
+        print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
+    if not flops_per_step:
+        flops_per_step = fallback_flops_per_step
+        source = fallback_source
+    return (flops_per_step * n_steps / dt) / _peak_flops(device_kind), source

@@ -130,6 +130,7 @@ class PipelinedGPT:
         else:
             self._apply_block = self._block
         self._ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self._region = None  # jitted pipeline region, built on first apply
 
     # --- init ---------------------------------------------------------------
 
@@ -170,17 +171,31 @@ class PipelinedGPT:
     # --- layout -------------------------------------------------------------
 
     def layout(self) -> Callable[[str, tuple], P]:
-        """(path, shape) -> spec rule: stage dim of block leaves on ``pipe``."""
+        """(path, shape) -> spec rule: stage dim of block leaves on ``pipe``,
+        plus Megatron ``model``-axis sharding of the per-layer kernels when
+        the mesh has a real model axis (pipe x tp: the model axis stays
+        *auto* inside the pipeline's hybrid shard_map, so GSPMD partitions
+        the stage matmuls and inserts the row-parallel all-reduce exactly
+        as on an unpipelined mesh)."""
         axis = self.axis_name
-
         circular = self.n_virtual > 1
+        tp = dict(self.mesh.shape).get(mesh_lib.AXIS_MODEL, 1) > 1
 
         def rule(path: str, shape: tuple) -> P:
-            if path.startswith("blocks/") or "/blocks/" in path:
-                if circular:  # (v, n_stages, lps, ...): pipe on dim 1
-                    return P(None, axis, *([None] * (len(shape) - 2)))
-                return P(axis, *([None] * (len(shape) - 1)))
-            return P()
+            if not (path.startswith("blocks/") or "/blocks/" in path):
+                return P()
+            # stage-stack prefix: (n_stages, lps, ...) or (v, n_stages, lps, ...)
+            tail = [None] * (len(shape) - (2 if circular else 1))
+            if tp and path.endswith("/kernel"):
+                # per-layer kernels are 2D (in, out) at tail[-2:]:
+                # column-parallel shards out, row-parallel shards in
+                if "attn/qkv" in path or "fc_in" in path:
+                    tail[-1] = mesh_lib.AXIS_MODEL
+                elif "attn/proj" in path or "fc_out" in path:
+                    tail[-2] = mesh_lib.AXIS_MODEL
+            if circular:  # (v, n_stages, lps, ...): pipe on dim 1
+                return P(None, axis, *tail)
+            return P(axis, *tail)
 
         return rule
 
@@ -204,10 +219,13 @@ class PipelinedGPT:
             )
 
         def one(x, layer_params):
+            # fp32 across the schedule, cfg.dtype inside the block (the
+            # block's pre-LN casts do the rest)
             y = self._apply_block.apply(
-                {"params": layer_params}, x, positions, True
+                {"params": layer_params}, x.astype(self.cfg.dtype),
+                positions, True,
             )
-            return y, None
+            return y.astype(jnp.float32), None
 
         if self.cfg.remat:
             one = jax.checkpoint(one)
@@ -220,9 +238,16 @@ class PipelinedGPT:
         cfg = self.cfg
         x = self._embed.apply({"params": params["wte"]}, input_ids)
 
-        batch_axes = mesh_lib.data_axes(self.mesh)
+        # Hybrid shard_map: only the axes whose collectives the pipeline
+        # emits by hand (pipe ppermute, seq ring) are manual; data and
+        # model stay AUTO — GSPMD shards the batch and partitions the
+        # Megatron kernels (incl. the row-parallel all-reduce) inside the
+        # region exactly as it would outside it.
+        manual = {self.axis_name}
+        if self.seq_parallel:
+            manual.add(self.seq_axis)
         x_spec = P(
-            batch_axes if batch_axes else None,
+            None,  # batch dim: auto (data/fsdp sharding propagates)
             self.seq_axis if self.seq_parallel else None,
             None,
         )
@@ -241,9 +266,12 @@ class PipelinedGPT:
         n_virtual = self.n_virtual
 
         def inner(block_params, xl):
+            # xl stays fp32 through the pipeline machinery (scan carries,
+            # ppermute handoffs); _stage_fn casts to cfg.dtype internally.
+            # xl's batch dim is GLOBAL here (data is an auto axis)
             if xl.shape[0] % n_micro:
                 raise ValueError(
-                    f"per-host batch {xl.shape[0]} not divisible by "
+                    f"global batch {xl.shape[0]} not divisible by "
                     f"n_microbatches={n_micro}"
                 )
             mb = xl.reshape(
@@ -262,11 +290,27 @@ class PipelinedGPT:
                 )
             return out.reshape(xl.shape)
 
-        x = jax.shard_map(
-            inner, mesh=self.mesh,
-            in_specs=(block_specs, x_spec), out_specs=x_spec,
-            check_vma=False,
-        )(params["blocks"], x)
+        # Everything crossing or carried by the partial-manual region is
+        # fp32: jax 0.9's partial-manual shard_map partitioner crashes on
+        # bf16 copies ("invalid binary instruction opcode copy").  Stage
+        # compute is still cfg.dtype (see _stage_fn); the fp32 handoffs are
+        # (mb, S, D) residuals — tiny next to the stage matmuls — and ln_f
+        # upcasts the output anyway.
+        # The jit wrapper is load-bearing: partial-manual shard_map has no
+        # eager impl path in jax 0.9 (_unmatch_spec only supports
+        # all-manual), and grad-of-eager interprets the region the same
+        # broken way.  Under an outer jit this inlines.  Cached on self so
+        # eager callers don't pay a retrace per apply() (the specs depend
+        # only on construction-time state; `inner` closes over nothing
+        # call-specific).
+        if self._region is None:
+            self._region = jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(block_specs, x_spec), out_specs=x_spec,
+                axis_names=frozenset(manual),
+                check_vma=False,
+            ))
+        x = self._region(params["blocks"], x.astype(jnp.float32))
 
         x = self._ln_f.apply({"params": params["ln_f"]}, x)
         if return_hidden:
