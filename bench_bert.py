@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 from bench_probe import probe_devices_with_retries
@@ -79,7 +78,9 @@ def main() -> None:
             n_head += n
         else:
             n_encoder += n
-    p_gathered = seq // 5 + 1  # the preset's max_predictions
+    from distributedtensorflow_tpu.models import max_predictions_for
+
+    p_gathered = max_predictions_for(seq)  # the preset's gathered-head size
     fallback = (
         6.0 * wl.global_batch_size
         * (n_encoder * seq + n_head * p_gathered) / n_chips

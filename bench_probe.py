@@ -172,7 +172,7 @@ def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
 
     for _ in range(warmup):
         state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])
+        float(metrics["loss"])
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = compiled(state, batch, rng)

@@ -8,6 +8,7 @@ from .gpt import (  # noqa: F401
     gpt_layout,
     gpt_small,
     gpt_tiny,
+    lm_eval,
     lm_loss,
 )
 from .lenet import LeNet5  # noqa: F401
@@ -24,6 +25,8 @@ from .bert import (  # noqa: F401
     bert_base,
     bert_layout,
     bert_tiny,
+    max_predictions_for,
+    mlm_eval,
     mlm_loss,
 )
 from .widedeep import (  # noqa: F401

@@ -153,66 +153,86 @@ class BertForMLM(nn.Module):
         return x
 
 
-def mlm_loss(model: BertForMLM, *, max_predictions: int | None = None):
-    """LossFn for masked-LM batches: {input_ids, labels, attention_mask}.
+def max_predictions_for(seq_len: int) -> int:
+    """Gathered-head size for a sequence length: 20% of positions (mask
+    rate is 15%; rows with more masked positions drop the excess).  The
+    single definition shared by the workload presets and the benches."""
+    return seq_len // 5 + 1
 
-    ``labels`` uses -100 (ignore) convention at unmasked positions.
 
-    ``max_predictions`` enables the gathered-head path: the P first masked
-    positions per row (found with a static-shape ``top_k`` on the validity
-    mask) are gathered *before* the MLM head, so transform/projection and
-    the (.., V) logits run on P positions instead of S — the reference
-    BERT-pretraining recipe's ``masked_lm_positions`` idea, recovered here
-    from the -100 convention inside the compiled step.  Rows with more
-    than P masked positions drop the excess (standard practice; size P to
-    the masking rate).
+def _mlm_metrics(model: BertForMLM, max_predictions: int | None,
+                 params, batch, rng):
+    """Shared head dispatch + weighted loss/accuracy for mlm_loss/mlm_eval.
+
+    ``max_predictions`` set: the P first masked positions per row (found
+    with a static-shape ``top_k`` on the validity mask) are gathered
+    *before* the MLM head, so transform/projection and the (.., V) logits
+    run on P positions instead of S — the reference BERT-pretraining
+    recipe's ``masked_lm_positions`` idea, recovered from the -100
+    convention inside the compiled step.  ``rng=None`` = deterministic
+    (eval) forward.
     """
     import optax
 
-    def gathered(params, batch, rng, labels, valid):
+    labels = batch["labels"]
+    valid = labels >= 0
+    kwargs = dict(
+        attention_mask=batch.get("attention_mask"),
+        segment_ids=batch.get("segment_ids"),
+        position_ids=batch.get("position_ids"),
+        deterministic=rng is None,
+    )
+    if rng is not None:
+        kwargs["rngs"] = {"dropout": rng}
+    if max_predictions:
         p = min(max_predictions, labels.shape[1])
-        weights, pos = jax.lax.top_k(valid.astype(jnp.int32), p)  # (B, P)
+        w, pos = jax.lax.top_k(valid.astype(jnp.int32), p)  # (B, P)
         logits = model.apply(
-            {"params": params},
-            batch["input_ids"],
-            attention_mask=batch.get("attention_mask"),
-            deterministic=False,
-            segment_ids=batch.get("segment_ids"),
-            position_ids=batch.get("position_ids"),
-            masked_positions=pos,
-            rngs={"dropout": rng},
+            {"params": params}, batch["input_ids"],
+            masked_positions=pos, **kwargs,
         )  # (B, P, V)
         safe_labels = jnp.take_along_axis(
             jnp.where(valid, labels, 0), pos, axis=1
         )
-        return logits, safe_labels, weights.astype(jnp.float32)
-
-    def dense(params, batch, rng, labels, valid):
+        w = w.astype(jnp.float32)
+    else:
         logits = model.apply(
-            {"params": params},
-            batch["input_ids"],
-            attention_mask=batch.get("attention_mask"),
-            deterministic=False,
-            segment_ids=batch.get("segment_ids"),
-            position_ids=batch.get("position_ids"),
-            rngs={"dropout": rng},
+            {"params": params}, batch["input_ids"], **kwargs
         )  # (B, S, V)
-        return logits, jnp.where(valid, labels, 0), valid.astype(jnp.float32)
+        safe_labels = jnp.where(valid, labels, 0)
+        w = valid.astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), safe_labels
+    )
+    denom = jnp.maximum(w.sum(), 1.0)
+    loss = (per_tok * w).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == safe_labels) * w).sum() / denom
+    return loss, acc.astype(jnp.float32)
+
+
+def mlm_loss(model: BertForMLM, *, max_predictions: int | None = None):
+    """LossFn for masked-LM batches: {input_ids, labels, attention_mask}.
+
+    ``labels`` uses -100 (ignore) convention at unmasked positions; see
+    :func:`_mlm_metrics` for the ``max_predictions`` gathered-head path.
+    """
 
     def loss_fn(params, model_state, batch, rng):
-        labels = batch["labels"]
-        valid = labels >= 0
-        head = gathered if max_predictions else dense
-        logits, safe_labels, w = head(params, batch, rng, labels, valid)
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), safe_labels
-        )
-        denom = jnp.maximum(w.sum(), 1.0)
-        loss = (per_tok * w).sum() / denom
-        acc = ((jnp.argmax(logits, -1) == safe_labels) * w).sum() / denom
-        return loss, ({"mlm_accuracy": acc.astype(jnp.float32)}, model_state)
+        loss, acc = _mlm_metrics(model, max_predictions, params, batch, rng)
+        return loss, ({"mlm_accuracy": acc}, model_state)
 
     return loss_fn
+
+
+def mlm_eval(model: BertForMLM, *, max_predictions: int | None = None):
+    """Eval metric_fn: deterministic forward (rng=None), same shared head
+    dispatch as :func:`mlm_loss`."""
+
+    def metric_fn(params, model_state, batch):
+        loss, acc = _mlm_metrics(model, max_predictions, params, batch, None)
+        return {"loss": loss, "mlm_accuracy": acc}
+
+    return metric_fn
 
 
 def bert_layout() -> LayoutMap:

@@ -298,6 +298,31 @@ def lm_loss(model: GPTLM):
     return loss_fn
 
 
+def lm_eval(model: GPTLM):
+    """Eval metric_fn (params, model_state, batch) -> {loss, perplexity}.
+
+    Deterministic forward (no dropout rng), same vocab-chunked head as
+    ``lm_loss`` — wired into the ``gpt_lm`` preset so ``--eval-every`` and
+    the sidecar evaluator work for LM workloads."""
+    from ..ops.xent import chunked_softmax_xent
+
+    def metric_fn(params, model_state, batch):
+        hidden = model.apply(
+            {"params": params}, batch["input_ids"], deterministic=True,
+            return_hidden=True,
+        )
+        mask = batch.get("mask")
+        loss = chunked_softmax_xent(
+            hidden[:, :-1],
+            params["wte"]["embedding"],
+            batch["input_ids"][:, 1:],
+            mask[:, 1:] if mask is not None else None,
+        )
+        return {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return metric_fn
+
+
 def gpt_layout() -> LayoutMap:
     """Megatron-style ``model``-axis sharding rules for :class:`GPTLM`.
 

@@ -207,6 +207,26 @@ def moe_lm_loss(model: GPTMoELM):
     return loss_fn
 
 
+def moe_lm_eval(model: GPTMoELM):
+    """Eval metric_fn: deterministic forward, router aux reported but not
+    folded into the eval loss (it is a training regularizer)."""
+    from ..ops.xent import chunked_softmax_xent
+
+    def metric_fn(params, model_state, batch):
+        hidden, aux = model.apply(
+            {"params": params}, batch["input_ids"], deterministic=True,
+            return_hidden=True,
+        )
+        lm = chunked_softmax_xent(
+            hidden[:, :-1],
+            params["wte"]["embedding"],
+            batch["input_ids"][:, 1:],
+        )
+        return {"loss": lm, "perplexity": jnp.exp(lm), "aux_loss": aux}
+
+    return metric_fn
+
+
 def gpt_moe_layout() -> LayoutMap:
     """gpt_layout + expert-axis sharding for the expert stacks; the router
     is tiny and stays replicated."""

@@ -346,6 +346,25 @@ def pipelined_lm_loss(model: PipelinedGPT):
     return loss_fn
 
 
+def pipelined_lm_eval(model: PipelinedGPT):
+    """Eval metric_fn through the pipeline (dropout is rejected at
+    construction, so forward is already deterministic)."""
+    from ..ops.xent import chunked_softmax_xent
+
+    def metric_fn(params, model_state, batch):
+        hidden = model.apply(
+            {"params": params}, batch["input_ids"], return_hidden=True
+        )
+        loss = chunked_softmax_xent(
+            hidden[:, :-1],
+            params["wte"]["embedding"],
+            batch["input_ids"][:, 1:],
+        )
+        return {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return metric_fn
+
+
 def params_to_dense(
     pipe_params: dict, cfg: GPTConfig, *, n_virtual: int = 1
 ) -> dict:

@@ -1,0 +1,78 @@
+"""Optimizer/schedule factory behind the CLI's --optimizer/--lr flags.
+
+The reference's equivalent surface is Keras ``model.compile(optimizer=...)``
+with per-config hyperparameters (BASELINE.json configs carry the recipe);
+here every workload preset ships a default optax chain and these flags
+override it.  LAMB/LARS are included for the large-batch recipes the
+reference-era configs imply (BERT/ResNet at pod batch sizes).
+"""
+
+from __future__ import annotations
+
+import optax
+
+OPTIMIZERS = ("sgd", "momentum", "adam", "adamw", "lamb", "lars",
+              "adagrad", "adafactor", "lion")
+SCHEDULES = ("constant", "cosine", "linear")
+
+
+def build_schedule(
+    name: str,
+    lr: float,
+    *,
+    warmup_steps: int = 0,
+    total_steps: int = 0,
+) -> optax.Schedule | float:
+    """LR schedule: constant | cosine | linear (each with optional linear
+    warmup from 0).  Decay schedules need ``total_steps``."""
+    if name not in SCHEDULES:
+        raise ValueError(f"schedule must be one of {SCHEDULES}, got {name!r}")
+    if name == "constant":
+        if warmup_steps:
+            return optax.linear_schedule(0.0, lr, warmup_steps)
+        return lr
+    if not total_steps:
+        raise ValueError(f"schedule {name!r} needs total_steps > 0")
+    if name == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, lr, max(warmup_steps, 1), total_steps
+        )
+    # linear decay to 0 after warmup
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, lr, max(warmup_steps, 1)),
+            optax.linear_schedule(
+                lr, 0.0, max(total_steps - warmup_steps, 1)
+            ),
+        ],
+        [max(warmup_steps, 1)],
+    )
+
+
+def build_optimizer(
+    name: str,
+    lr: float | optax.Schedule,
+    *,
+    weight_decay: float = 0.0,
+    momentum: float = 0.9,
+) -> optax.GradientTransformation:
+    """Build an optax chain by name (the --optimizer CLI surface)."""
+    if name == "sgd":
+        return optax.sgd(lr)
+    if name == "momentum":
+        return optax.sgd(lr, momentum=momentum, nesterov=True)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adamw":
+        return optax.adamw(lr, weight_decay=weight_decay)
+    if name == "lamb":
+        return optax.lamb(lr, weight_decay=weight_decay)
+    if name == "lars":
+        return optax.lars(lr, weight_decay=weight_decay, momentum=momentum)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    if name == "adafactor":
+        return optax.adafactor(lr)
+    if name == "lion":
+        return optax.lion(lr, weight_decay=weight_decay)
+    raise ValueError(f"optimizer must be one of {OPTIMIZERS}, got {name!r}")

@@ -33,6 +33,8 @@ from .models import (
     bert_base,
     bert_layout,
     bert_tiny,
+    max_predictions_for,
+    mlm_eval,
     mlm_loss,
     widedeep_layout,
     widedeep_eval,
@@ -257,10 +259,9 @@ def get_workload(name: str, *, test_size: bool = False,
             }
         return Workload(
             name=name, model=model,
-            # Gathered MLM head: P = 20% of seq (mask rate is 15%; excess
-            # masked positions in a row are dropped, standard practice).
-            loss_fn=mlm_loss(model, max_predictions=seq // 5 + 1),
-            eval_fn=None,
+            # Gathered MLM head (models.bert.max_predictions_for).
+            loss_fn=mlm_loss(model, max_predictions=max_predictions_for(seq)),
+            eval_fn=mlm_eval(model, max_predictions=max_predictions_for(seq)),
             make_optimizer=lambda: optax.adamw(1e-4, weight_decay=0.01),
             input_fn=input_fn,
             init_batch=init_batch,
@@ -296,7 +297,7 @@ def get_workload(name: str, *, test_size: bool = False,
             layout=widedeep_layout(),
         )
     if name in ("gpt_lm", "lm_long_context"):
-        from .models import GPTLM, gpt_layout, gpt_small, gpt_tiny, lm_loss
+        from .models import GPTLM, gpt_layout, gpt_small, gpt_tiny, lm_eval, lm_loss
 
         cfg = gpt_tiny() if test_size else gpt_small()
         seq = seq_len or (64 if test_size else 2048)
@@ -313,9 +314,9 @@ def get_workload(name: str, *, test_size: bool = False,
 
         def build(attn_fn=None):
             model = GPTLM(cfg, attn_fn)
-            return model, lm_loss(model)
+            return model, lm_loss(model), lm_eval(model)
 
-        model, loss = build()
+        model, loss, ev = build()
 
         def finalize(wl: Workload, mesh) -> Workload:
             shape = dict(mesh.shape)
@@ -327,6 +328,7 @@ def get_workload(name: str, *, test_size: bool = False,
                 # and runs ring attention inside each stage.
                 from .models.gpt_pipeline import (
                     PipelinedGPT,
+                    pipelined_lm_eval,
                     pipelined_lm_loss,
                 )
 
@@ -342,6 +344,7 @@ def get_workload(name: str, *, test_size: bool = False,
                     wl,
                     model=pp,
                     loss_fn=pipelined_lm_loss(pp),
+                    eval_fn=pipelined_lm_eval(pp),
                     init_fn=pp.init,
                     layout=pp.layout(),
                 )
@@ -352,17 +355,19 @@ def get_workload(name: str, *, test_size: bool = False,
                 return wl
             from .parallel.ring_attention import sequence_parallel_attention_fn
 
-            sp_model, sp_loss = build(
+            sp_model, sp_loss, sp_eval = build(
                 sequence_parallel_attention_fn(
                     mesh, scheme=sp_scheme, causal=True
                 )
             )
-            return dataclasses.replace(wl, model=sp_model, loss_fn=sp_loss)
+            return dataclasses.replace(
+                wl, model=sp_model, loss_fn=sp_loss, eval_fn=sp_eval
+            )
 
         return Workload(
             name=name, model=model,
             loss_fn=loss,
-            eval_fn=None,
+            eval_fn=ev,
             make_optimizer=lambda: optax.adamw(3e-4, weight_decay=0.1),
             input_fn=lambda ctx, seed: synthetic_lm(
                 ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
@@ -381,6 +386,7 @@ def get_workload(name: str, *, test_size: bool = False,
             gpt_moe_layout,
             gpt_moe_small,
             gpt_moe_tiny,
+            moe_lm_eval,
             moe_lm_loss,
         )
 
@@ -406,12 +412,13 @@ def get_workload(name: str, *, test_size: bool = False,
                 return wl
             return dataclasses.replace(
                 wl, model=ep_model, loss_fn=moe_lm_loss(ep_model),
+                eval_fn=moe_lm_eval(ep_model),
             )
 
         return Workload(
             name=name, model=model,
             loss_fn=moe_lm_loss(model),
-            eval_fn=None,
+            eval_fn=moe_lm_eval(model),
             make_optimizer=lambda: optax.adamw(3e-4, weight_decay=0.1),
             input_fn=lambda ctx, seed: synthetic_lm(
                 ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed

@@ -106,6 +106,44 @@ def shardable_batches(it, mesh):
         yield batch
 
 
+def apply_optimizer_flags(wl, args):
+    """--optimizer/--lr/--schedule override the preset's optax chain.
+
+    Used by BOTH roles: the sidecar evaluator's state template must build
+    the same optimizer as the trainer for opt_state restore to match.
+    """
+    if not args.optimizer:
+        if args.lr is not None:
+            raise SystemExit(
+                "--lr requires --optimizer (which family to build)"
+            )
+        if args.schedule != "constant" or args.warmup_steps or args.weight_decay:
+            raise SystemExit(
+                "--schedule/--warmup-steps/--weight-decay require "
+                "--optimizer (they parameterize the override, not the "
+                "preset's own optax chain)"
+            )
+        return wl
+    if args.lr is None:
+        raise SystemExit("--optimizer requires --lr")
+    import dataclasses
+
+    from distributedtensorflow_tpu.train.optimizers import (
+        build_optimizer,
+        build_schedule,
+    )
+
+    lr = build_schedule(
+        args.schedule, args.lr,
+        warmup_steps=args.warmup_steps, total_steps=args.steps,
+    )
+    opt_name, wd = args.optimizer, args.weight_decay
+    return dataclasses.replace(
+        wl,
+        make_optimizer=lambda: build_optimizer(opt_name, lr, weight_decay=wd),
+    )
+
+
 def run_evaluator(args) -> None:
     """Sidecar-evaluator role: poll --checkpoint-dir, evaluate new
     checkpoints on this process's local devices (standalone — never joins
@@ -131,6 +169,7 @@ def run_evaluator(args) -> None:
     )
     if wl.eval_fn is None:
         raise SystemExit(f"workload {wl.name!r} has no eval_fn to sidecar")
+    wl = apply_optimizer_flags(wl, args)
     spec = parse_mesh(args.mesh) or parallel.MeshSpec(data=-1)
     mesh = parallel.build_mesh(spec)
     wl = wl.for_mesh(mesh)
@@ -259,6 +298,19 @@ def main() -> None:
                         "checkpoint")
     p.add_argument("--seq-len", type=int, default=None,
                    help="LM presets: override sequence length")
+    p.add_argument("--optimizer", default=None,
+                   choices=("sgd", "momentum", "adam", "adamw", "lamb",
+                            "lars", "adagrad", "adafactor", "lion"),
+                   help="override the preset's optimizer (requires --lr)")
+    p.add_argument("--lr", type=float, default=None,
+                   help="peak learning rate for --optimizer")
+    p.add_argument("--schedule", choices=("constant", "cosine", "linear"),
+                   default="constant",
+                   help="LR schedule for --optimizer (decay over --steps)")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup steps for --optimizer")
+    p.add_argument("--weight-decay", type=float, default=0.0,
+                   help="weight decay for --optimizer (adamw/lamb/lars/lion)")
     p.add_argument("--remat", choices=("on", "off", "attn"), default=None,
                    help="LM presets: rematerialization — whole blocks (on),"
                         " none (off), or attention-only (attn: remat-free"
@@ -328,6 +380,7 @@ def main() -> None:
         remat={"on": True, "off": False, "attn": "attn", None: None}[args.remat],
         attn_impl=args.attn_impl,
     )
+    wl = apply_optimizer_flags(wl, args)
     spec = parse_mesh(args.mesh) or wl.mesh_spec
     mesh = parallel.build_mesh(spec)
     wl = wl.for_mesh(mesh)  # e.g. gpt_lm binds seq-parallel attention
