@@ -34,19 +34,25 @@ def build_schedule(
     if not total_steps:
         raise ValueError(f"schedule {name!r} needs total_steps > 0")
     if name == "cosine":
+        if not warmup_steps:  # start AT peak lr, not a forced 1-step warmup
+            return optax.cosine_decay_schedule(lr, total_steps)
         return optax.warmup_cosine_decay_schedule(
-            0.0, lr, max(warmup_steps, 1), total_steps
+            0.0, lr, warmup_steps, total_steps
         )
-    # linear decay to 0 after warmup
+    # linear decay to 0 after warmup; lr(total_steps) == 0 exactly
+    if not warmup_steps:
+        return optax.linear_schedule(lr, 0.0, total_steps)
     return optax.join_schedules(
         [
-            optax.linear_schedule(0.0, lr, max(warmup_steps, 1)),
-            optax.linear_schedule(
-                lr, 0.0, max(total_steps - warmup_steps, 1)
-            ),
+            optax.linear_schedule(0.0, lr, warmup_steps),
+            optax.linear_schedule(lr, 0.0, total_steps - warmup_steps),
         ],
-        [max(warmup_steps, 1)],
+        [warmup_steps],
     )
+
+
+#: Optimizers whose optax builder takes decoupled weight decay.
+_DECAY_CAPABLE = ("adamw", "lamb", "lars", "lion")
 
 
 def build_optimizer(
@@ -56,7 +62,17 @@ def build_optimizer(
     weight_decay: float = 0.0,
     momentum: float = 0.9,
 ) -> optax.GradientTransformation:
-    """Build an optax chain by name (the --optimizer CLI surface)."""
+    """Build an optax chain by name (the --optimizer CLI surface).
+
+    ``weight_decay`` is rejected (not silently dropped) for optimizers
+    without a decoupled-decay parameter — put L2 in the loss for those
+    (``classification_loss(weight_decay=...)``).
+    """
+    if weight_decay and name not in _DECAY_CAPABLE:
+        raise ValueError(
+            f"optimizer {name!r} has no decoupled weight decay "
+            f"(supported: {_DECAY_CAPABLE}); use the loss-side L2 instead"
+        )
     if name == "sgd":
         return optax.sgd(lr)
     if name == "momentum":

@@ -91,3 +91,57 @@ def test_moe_with_model_axis(devices):
     state, metrics = step(state, make_batch(8, 64), jax.random.PRNGKey(0))
     assert np.isfinite(float(metrics["loss"]))
     assert np.isfinite(float(metrics["aux_loss"]))
+
+
+def test_four_axis_mesh_trains_subprocess():
+    """data x pipe x seq x model — ALL parallelism axes in ONE train step
+    (ring attention + Megatron TP inside the pipeline's hybrid region).
+
+    Needs 16 virtual devices, so it runs in a subprocess with its own
+    device count (the conftest pins this process to 8)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 16)
+        import numpy as np
+        from distributedtensorflow_tpu.workloads import get_workload
+        from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+        from distributedtensorflow_tpu.train import (
+            create_sharded_state, make_train_step)
+        from distributedtensorflow_tpu.data import (
+            InputContext, device_put_batch)
+
+        mesh = build_mesh(MeshSpec(data=2, pipe=2, seq=2, model=2),
+                          jax.devices()[:16])
+        wl = get_workload("gpt_lm", test_size=True,
+                          global_batch_size=16).for_mesh(mesh)
+        state, specs = create_sharded_state(
+            wl.init_fn, wl.make_optimizer(), mesh, jax.random.PRNGKey(0),
+            rules=wl.layout)
+        step = make_train_step(wl.loss_fn, mesh, specs)
+        batch = device_put_batch(
+            next(iter(wl.input_fn(InputContext(1, 0, 16), 0))), mesh)
+        losses = []
+        for i in range(4):
+            state, m = step(state, batch, jax.random.PRNGKey(0))
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses), losses
+        assert losses[-1] < losses[0], losses
+        print("4AXIS_OK", losses[-1])
+    """)
+    import os
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the subprocess sets its own device count
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=560,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "4AXIS_OK" in res.stdout

@@ -14,10 +14,13 @@ from distributedtensorflow_tpu.train.optimizers import (
 
 
 def test_every_optimizer_builds_and_steps():
+    from distributedtensorflow_tpu.train.optimizers import _DECAY_CAPABLE
+
     params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
     grads = jax.tree.map(jnp.ones_like, params)
     for name in OPTIMIZERS:
-        opt = build_optimizer(name, 1e-2, weight_decay=0.01)
+        wd = 0.01 if name in _DECAY_CAPABLE else 0.0
+        opt = build_optimizer(name, 1e-2, weight_decay=wd)
         state = opt.init(params)
         updates, _ = opt.update(grads, state, params)
         new = jax.tree.map(lambda p, u: p + u, params, updates)
@@ -26,6 +29,9 @@ def test_every_optimizer_builds_and_steps():
         ), name
     with pytest.raises(ValueError, match="optimizer"):
         build_optimizer("sgdd", 1e-2)
+    # weight decay is rejected, not silently dropped, where unsupported
+    with pytest.raises(ValueError, match="decoupled"):
+        build_optimizer("adam", 1e-2, weight_decay=0.01)
 
 
 def test_schedules():
@@ -41,6 +47,12 @@ def test_schedules():
     lin = build_schedule("linear", lr, warmup_steps=5, total_steps=100)
     assert float(lin(5)) == pytest.approx(lr, rel=1e-3)
     assert float(lin(100)) == pytest.approx(0.0, abs=1e-6)
+    # warmup_steps=0 starts AT peak (no forced 1-step warmup)
+    cos0 = build_schedule("cosine", lr, total_steps=100)
+    assert float(cos0(0)) == pytest.approx(lr)
+    lin0 = build_schedule("linear", lr, total_steps=100)
+    assert float(lin0(0)) == pytest.approx(lr)
+    assert float(lin0(100)) == pytest.approx(0.0, abs=1e-6)
     with pytest.raises(ValueError, match="total_steps"):
         build_schedule("cosine", lr)
     with pytest.raises(ValueError, match="schedule"):

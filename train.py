@@ -298,14 +298,16 @@ def main() -> None:
                         "checkpoint")
     p.add_argument("--seq-len", type=int, default=None,
                    help="LM presets: override sequence length")
-    p.add_argument("--optimizer", default=None,
-                   choices=("sgd", "momentum", "adam", "adamw", "lamb",
-                            "lars", "adagrad", "adafactor", "lion"),
+    from distributedtensorflow_tpu.train.optimizers import (
+        OPTIMIZERS,
+        SCHEDULES,
+    )
+
+    p.add_argument("--optimizer", default=None, choices=OPTIMIZERS,
                    help="override the preset's optimizer (requires --lr)")
     p.add_argument("--lr", type=float, default=None,
                    help="peak learning rate for --optimizer")
-    p.add_argument("--schedule", choices=("constant", "cosine", "linear"),
-                   default="constant",
+    p.add_argument("--schedule", choices=SCHEDULES, default="constant",
                    help="LR schedule for --optimizer (decay over --steps)")
     p.add_argument("--warmup-steps", type=int, default=0,
                    help="linear LR warmup steps for --optimizer")
