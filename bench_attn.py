@@ -116,7 +116,15 @@ def main() -> None:
                         "xla_bwd_ms"):
                 row[key] = round(row[key], 3)
         except Exception as e:  # one seq OOMing must not kill the sweep
-            row["error"] = f"{type(e).__name__}: {e}"
+            # keep the artifact readable: first line + the OOM headline if
+            # present, not the multi-KB compiler traceback
+            text = str(e)
+            oom = next(
+                (ln.strip() for ln in text.splitlines()
+                 if "Ran out of memory" in ln), None,
+            )
+            first = text.splitlines()[0][:200] if text else ""
+            row["error"] = f"{type(e).__name__}: {oom or first}"
         rows.append(row)
         print(f"bench_attn: {row}", file=sys.stderr)
 

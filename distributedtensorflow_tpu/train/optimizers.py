@@ -33,6 +33,11 @@ def build_schedule(
         return lr
     if not total_steps:
         raise ValueError(f"schedule {name!r} needs total_steps > 0")
+    if warmup_steps >= total_steps:
+        raise ValueError(
+            f"warmup_steps={warmup_steps} must be < total_steps="
+            f"{total_steps} for schedule {name!r} (nothing left to decay)"
+        )
     if name == "cosine":
         if not warmup_steps:  # start AT peak lr, not a forced 1-step warmup
             return optax.cosine_decay_schedule(lr, total_steps)

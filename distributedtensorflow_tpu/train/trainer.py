@@ -167,6 +167,7 @@ class Trainer:
                     # jax.Array fetches sync here, off the critical cadence
                     last_metrics = {k: float(v) for k, v in metrics.items()}
                     last_metrics.update(self.meter.rates())
+                    last_metrics.update(device_memory_stats())
                     self.writer.write(step_i + 1, last_metrics)
                     logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
                     self.meter.start()
@@ -244,6 +245,29 @@ class Trainer:
         return weighted_evaluate(
             self.eval_step, state, eval_iter, max_steps=self.config.eval_steps
         )
+
+
+def device_memory_stats() -> dict[str, float]:
+    """Device-0 HBM usage (GiB), for the periodic metric stream.
+
+    Reference analogue: the memory timeline of the TF profiler
+    (SURVEY.md §5.1); here it rides the scalar metrics so OOM creep is
+    visible in TensorBoard/JSONL without a trace.  Backends without
+    ``memory_stats`` (virtual CPU) contribute nothing.
+    """
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return {}
+    if not stats:
+        return {}
+    gib = 1 / (1024 ** 3)
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_in_use_gib"] = stats["bytes_in_use"] * gib
+    if "peak_bytes_in_use" in stats:
+        out["hbm_peak_gib"] = stats["peak_bytes_in_use"] * gib
+    return out
 
 
 def weighted_evaluate(

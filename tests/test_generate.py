@@ -216,3 +216,31 @@ def test_eos_does_not_trigger_inside_prompt():
     # prompt is preserved and generation still happened (greedy argmax may
     # or may not be 7, but the prompt region must be untouched)
     np.testing.assert_array_equal(np.asarray(out)[:, :4], np.asarray(prompt))
+
+
+def test_generate_with_tensor_parallel_params(devices):
+    """TP serving: generation with Megatron-sharded params produces the
+    SAME tokens as replicated params (GSPMD partitions the decode loop;
+    the KV cache shards over heads with the qkv kernels)."""
+    from jax.sharding import NamedSharding
+
+    from distributedtensorflow_tpu.models.gpt import gpt_layout
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    cfg, model, params, ids = _setup()
+    prompt = ids[:, :12]
+    base = generate(params, prompt, cfg=cfg, max_new_tokens=12)
+
+    mesh = build_mesh(MeshSpec(data=1, model=4), devices)
+    rules = gpt_layout()
+
+    def put(path, p):
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        return jax.device_put(p, NamedSharding(mesh, rules.spec(key)))
+
+    sharded = jax.tree_util.tree_map_with_path(put, params)
+    # kernels really are sharded over model
+    qkv = sharded["h0"]["attn"]["qkv"]["kernel"]
+    assert len(qkv.sharding.device_set) == 4  # model=4 mesh
+    out = generate(sharded, prompt, cfg=cfg, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(out))

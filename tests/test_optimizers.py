@@ -55,6 +55,8 @@ def test_schedules():
     assert float(lin0(100)) == pytest.approx(0.0, abs=1e-6)
     with pytest.raises(ValueError, match="total_steps"):
         build_schedule("cosine", lr)
+    with pytest.raises(ValueError, match="warmup_steps"):
+        build_schedule("linear", lr, warmup_steps=100, total_steps=100)
     with pytest.raises(ValueError, match="schedule"):
         build_schedule("exp", lr, total_steps=10)
 

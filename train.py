@@ -129,14 +129,25 @@ def apply_optimizer_flags(wl, args):
     import dataclasses
 
     from distributedtensorflow_tpu.train.optimizers import (
+        _DECAY_CAPABLE,
         build_optimizer,
         build_schedule,
     )
 
-    lr = build_schedule(
-        args.schedule, args.lr,
-        warmup_steps=args.warmup_steps, total_steps=args.steps,
-    )
+    # Fail flag misuse HERE (clean SystemExit) rather than as a deep
+    # ValueError when the deferred make_optimizer first runs.
+    if args.weight_decay and args.optimizer not in _DECAY_CAPABLE:
+        raise SystemExit(
+            f"--optimizer {args.optimizer} has no decoupled weight decay "
+            f"(supported: {', '.join(_DECAY_CAPABLE)})"
+        )
+    try:
+        lr = build_schedule(
+            args.schedule, args.lr,
+            warmup_steps=args.warmup_steps, total_steps=args.steps,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
     opt_name, wd = args.optimizer, args.weight_decay
     return dataclasses.replace(
         wl,
