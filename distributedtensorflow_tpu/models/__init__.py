@@ -29,6 +29,13 @@ from .bert import (  # noqa: F401
     mlm_eval,
     mlm_loss,
 )
+from .vit import (  # noqa: F401
+    ViT,
+    ViTConfig,
+    vit_layout,
+    vit_s16,
+    vit_tiny,
+)
 from .widedeep import (  # noqa: F401
     WideDeep,
     WideDeepConfig,

@@ -1,0 +1,125 @@
+"""Vision Transformer — an extra model family beyond the reference zoo.
+
+The reference's image configs are all ConvNets (LeNet/ResNet —
+BASELINE.json configs 1-3); a ViT exercises the framework's encoder
+path on images: patch embedding as one strided conv (MXU-friendly),
+pre-LN blocks over ``ops.attention.dot_product_attention`` (so the Pallas
+flash kernel drops in at long patch sequences), Megatron TP layout over
+the ``model`` axis, bf16 activations with fp32 LayerNorm — the same
+TPU-first choices as the BERT/GPT implementations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import LayoutMap
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 384      # ViT-S
+    num_layers: int = 12
+    num_heads: int = 6
+    intermediate_size: int = 1536
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+def vit_s16() -> ViTConfig:
+    return ViTConfig()
+
+
+def vit_tiny() -> ViTConfig:
+    """Test-size: 32px/8px patches, 2 layers, 128 hidden."""
+    return ViTConfig(
+        image_size=32, patch_size=8, num_classes=10,
+        hidden_size=128, num_layers=2, num_heads=4, intermediate_size=256,
+    )
+
+
+class ViTBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, head_dim), dtype=cfg.dtype, use_bias=False,
+            name="qkv",
+        )(h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = dot_product_attention(q, k, v)  # bidirectional
+        attn = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, use_bias=False,
+            name="proj",
+        )(attn)
+        x = x + attn
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     use_bias=False, name="fc_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     use_bias=False, name="fc_out")(h)
+        if cfg.dropout_rate and not deterministic:
+            h = nn.Dropout(cfg.dropout_rate)(h, deterministic=False)
+        return x + h
+
+
+class ViT(nn.Module):
+    """ViT classifier; ``apply(variables, images, train=...)`` -> fp32 logits
+    (the framework classification-loss contract — same as ResNet)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.cfg
+        if tuple(images.shape[1:]) != (cfg.image_size, cfg.image_size, 3):
+            raise ValueError(
+                f"expected (B, {cfg.image_size}, {cfg.image_size}, 3) NHWC "
+                f"input, got {images.shape}"
+            )
+        # Patchify = one strided conv: (B, H/P, W/P, D) in a single MXU op.
+        x = nn.Conv(
+            cfg.hidden_size,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=cfg.dtype, name="patch_embed",
+        )(images.astype(cfg.dtype))
+        b, ph, pw, d = x.shape
+        x = x.reshape(b, ph * pw, d)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, ph * pw, cfg.hidden_size), jnp.float32,
+        )
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = ViTBlock(cfg, name=f"block_{i}")(x, deterministic=not train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = jnp.mean(x, axis=1)  # global average pool (no cls token)
+        return nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, name="head"
+        )(x)
+
+
+def vit_layout() -> LayoutMap:
+    """Megatron TP rules over ``model``: QKV/fc_in column-parallel,
+    proj/fc_out row-parallel (one all-reduce per block, inserted by XLA)."""
+    return LayoutMap([
+        (r".*qkv/kernel", P(None, None, "model", None)),
+        (r".*proj/kernel", P("model", None, None)),
+        (r".*fc_in/kernel", P(None, "model")),
+        (r".*fc_out/kernel", P("model", None)),
+    ])

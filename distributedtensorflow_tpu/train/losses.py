@@ -18,15 +18,21 @@ import optax
 PyTree = Any
 
 
-def _apply(model, params, model_state, x, train: bool):
-    """Run a flax module, handling mutable collections if present."""
+def _apply(model, params, model_state, x, train: bool, rng=None):
+    """Run a flax module, handling mutable collections if present.
+
+    ``rng`` (train only) is threaded to dropout; models without dropout
+    ignore the extra stream.
+    """
     variables = {"params": params, **model_state}
+    rngs = {"dropout": rng} if (train and rng is not None) else None
     if train and model_state:
         out, new_mstate = model.apply(
-            variables, x, train=True, mutable=list(model_state.keys())
+            variables, x, train=True, mutable=list(model_state.keys()),
+            rngs=rngs,
         )
         return out, dict(new_mstate)
-    return model.apply(variables, x, train=train), model_state
+    return model.apply(variables, x, train=train, rngs=rngs), model_state
 
 
 def classification_loss(
@@ -44,7 +50,8 @@ def classification_loss(
 
     def loss_fn(params, model_state, batch, rng):
         logits, new_mstate = _apply(
-            model, params, model_state, batch[inputs_key], train=True
+            model, params, model_state, batch[inputs_key], train=True,
+            rng=rng,
         )
         labels = batch[labels_key]
         loss = optax.softmax_cross_entropy_with_integer_labels(

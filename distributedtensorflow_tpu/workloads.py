@@ -223,6 +223,29 @@ def get_workload(name: str, *, test_size: bool = False,
             global_batch_size=gbs,
             mesh_spec=MeshSpec(data=-1),  # MultiWorkerMirrored: all devices
         )
+    if name == "imagenet_vit":
+        from .models import ViT, vit_layout, vit_s16, vit_tiny
+
+        cfg = vit_tiny() if test_size else vit_s16()
+        model = ViT(cfg)
+        gbs = global_batch_size or 1024
+        size = (cfg.image_size, cfg.image_size, 3)
+        return Workload(
+            name=name, model=model,
+            loss_fn=classification_loss(model),
+            eval_fn=classification_eval(model, top5=not test_size),
+            # ViT recipe: adamw + cosine (vs the ResNet SGD recipe)
+            make_optimizer=lambda: optax.adamw(
+                optax.warmup_cosine_decay_schedule(0.0, 3e-3, 1563, 93_750),
+                weight_decay=0.05,
+            ),
+            input_fn=_img_input(size, cfg.num_classes),
+            init_batch=_img_init(size),
+            init_fn=lambda r: model.init(r, jnp.zeros((2, *size))),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),
+            layout=vit_layout(),
+        )
     if name in ("bert_mlm", "bert_mlm_packed"):
         # Config #4 (BERT-base MLM, CollectiveAllReduce + grad accum).  The
         # "_packed" variant feeds example-packed rows (multiple short
@@ -432,12 +455,12 @@ def get_workload(name: str, *, test_size: bool = False,
         )
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
-        "imagenet_resnet50 bert_mlm bert_mlm_packed widedeep gpt_lm "
-        "gpt_moe"
+        "imagenet_resnet50 imagenet_vit bert_mlm bert_mlm_packed widedeep "
+        "gpt_lm gpt_moe"
     )
 
 
 WORKLOADS = (
-    "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "bert_mlm",
-    "bert_mlm_packed", "widedeep", "gpt_lm", "gpt_moe",
+    "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "imagenet_vit",
+    "bert_mlm", "bert_mlm_packed", "widedeep", "gpt_lm", "gpt_moe",
 )
