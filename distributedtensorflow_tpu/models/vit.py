@@ -55,15 +55,19 @@ class ViTBlock(nn.Module):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
-        qkv = nn.DenseGeneral(
-            (3, cfg.num_heads, head_dim), dtype=cfg.dtype, use_bias=False,
-            name="qkv",
+        # Fused QKV as one (D, 3H) matmul, like the GPT blocks: the flat 3H
+        # output dim shards over `model` for any tp dividing 3*hidden (the
+        # per-head layout would require tp | num_heads — ViT-S has 6).
+        qkv = nn.Dense(
+            3 * cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="qkv"
         )(h)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (*h.shape[:2], cfg.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
         attn = dot_product_attention(q, k, v)  # bidirectional
-        attn = nn.DenseGeneral(
-            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, use_bias=False,
-            name="proj",
+        attn = attn.reshape(*h.shape[:2], cfg.hidden_size)
+        attn = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="proj"
         )(attn)
         x = x + attn
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
@@ -118,8 +122,8 @@ def vit_layout() -> LayoutMap:
     """Megatron TP rules over ``model``: QKV/fc_in column-parallel,
     proj/fc_out row-parallel (one all-reduce per block, inserted by XLA)."""
     return LayoutMap([
-        (r".*qkv/kernel", P(None, None, "model", None)),
-        (r".*proj/kernel", P("model", None, None)),
+        (r".*qkv/kernel", P(None, "model")),
+        (r".*proj/kernel", P("model", None)),
         (r".*fc_in/kernel", P(None, "model")),
         (r".*fc_out/kernel", P("model", None)),
     ])

@@ -207,3 +207,37 @@ def test_gpt_lm_finalize_binds_sp(devices):
     assert bound.model.attn_fn is not None
     dp_mesh = build_mesh(MeshSpec(data=-1), devices)
     assert wl.for_mesh(dp_mesh).model.attn_fn is None
+
+
+def test_chunked_xent_random_shapes():
+    """Property sweep: chunked == naive for random (B, S, V, chunk) combos
+    including non-dividing chunk sizes and degenerate masks."""
+    from distributedtensorflow_tpu.ops.xent import chunked_softmax_xent
+
+    r = np.random.default_rng(7)
+    for _ in range(6):
+        b = int(r.integers(1, 4))
+        s = int(r.integers(2, 23))
+        d = int(r.integers(4, 17))
+        v = int(r.integers(5, 61))
+        chunk = int(r.integers(1, b * s + 5))
+        hidden = jnp.asarray(r.normal(size=(b, s, d)), jnp.float32)
+        wte = jnp.asarray(r.normal(size=(v, d)), jnp.float32)
+        targets = jnp.asarray(r.integers(0, v, (b, s)), jnp.int32)
+        mask = jnp.asarray(r.integers(0, 2, (b, s)), jnp.int32)
+        got = chunked_softmax_xent(hidden, wte, targets, mask,
+                                   chunk_tokens=chunk)
+        logp = jax.nn.log_softmax(hidden @ wte.T, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        m = mask.astype(jnp.float32)
+        want = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+        np.testing.assert_allclose(
+            float(got), float(want), rtol=2e-6, atol=1e-6,
+            err_msg=f"b={b} s={s} v={v} chunk={chunk}",
+        )
+    # all-masked-out rows: finite zero loss, no NaN from the 0/0 guard
+    zero = chunked_softmax_xent(
+        jnp.ones((1, 4, 8)), jnp.ones((5, 8)),
+        jnp.zeros((1, 4), jnp.int32), jnp.zeros((1, 4), jnp.int32),
+    )
+    assert float(zero) == 0.0

@@ -31,6 +31,8 @@ while true; do
     # also record bs32 attention-only-remat (2x batch, ~5% recompute)
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=16 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || ok=0
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    # long-context config: flash attention auto-dispatches at 4k seq
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || ok=0
     BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
     # full-stack convergence on the real chip (accuracy gate through the
