@@ -100,6 +100,11 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.dtf_reader_next.restype = c.c_int64
     lib.dtf_reader_next.argtypes = [c.c_void_p, c.POINTER(u8p)]
+    lib.dtf_reader_next_packed.restype = c.c_int64
+    lib.dtf_reader_next_packed.argtypes = [
+        c.c_void_p, c.POINTER(u8p), c.POINTER(c.POINTER(c.c_uint64)),
+        c.c_int64, c.c_int64,
+    ]
     lib.dtf_reader_close.restype = None
     lib.dtf_reader_close.argtypes = [c.c_void_p]
     lib.dtf_free.restype = None

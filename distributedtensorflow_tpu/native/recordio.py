@@ -100,6 +100,11 @@ class RecordReader:
         )
         if not self._h:
             raise OSError(f"cannot open record files {list(paths)!r}")
+        # Batched pulls: one FFI round-trip per ~batch of records (the
+        # per-record ctypes path was ~5x slower than plain Python file
+        # reads — bench_input.py).  _pending holds sliced-out records.
+        self._pending: list[bytes] = []
+        self._pending_ix = 0
         # GC safety net: a dropped, unexhausted reader still joins its C++
         # worker threads and frees queued records.
         self._finalizer = weakref.finalize(
@@ -109,12 +114,24 @@ class RecordReader:
     def __iter__(self) -> Iterator[bytes]:
         return self
 
+    #: Per-FFI-call batch bounds (records / payload bytes).
+    _BATCH_RECORDS = 1024
+    _BATCH_BYTES = 8 << 20
+
     def __next__(self) -> bytes:
+        if self._pending_ix < len(self._pending):
+            rec = self._pending[self._pending_ix]
+            self._pending_ix += 1
+            return rec
         if self._h is None:
             raise StopIteration
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        n = self._lib.dtf_reader_next(self._h, ctypes.byref(out))
-        if n == -1:
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        lens = ctypes.POINTER(ctypes.c_uint64)()
+        n = self._lib.dtf_reader_next_packed(
+            self._h, ctypes.byref(buf), ctypes.byref(lens),
+            self._BATCH_RECORDS, self._BATCH_BYTES,
+        )
+        if n == 0:
             self.close()
             raise StopIteration
         if n == -2:
@@ -123,9 +140,18 @@ class RecordReader:
                 "corrupt record encountered (bad CRC or framing)"
             )
         try:
-            return ctypes.string_at(out, n)
+            sizes = lens[:n]
+            blob = ctypes.string_at(buf, sum(sizes))
         finally:
-            self._lib.dtf_free(out)
+            self._lib.dtf_free(buf)
+            self._lib.dtf_free(lens)
+        out, off = [], 0
+        for size in sizes:
+            out.append(blob[off:off + size])
+            off += size
+        self._pending = out
+        self._pending_ix = 1
+        return out[0]
 
     def close(self) -> None:
         if self._h is not None:

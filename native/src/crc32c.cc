@@ -28,9 +28,53 @@ const Tables& tables() {
   return kTables;
 }
 
+#if defined(__x86_64__)
+// Hardware path: the SSE4.2 crc32 instruction computes exactly the
+// Castagnoli polynomial.  Compiled with a per-function target attribute so
+// the binary stays runnable on pre-SSE4.2 CPUs; dispatched once at startup
+// via __builtin_cpu_supports.  ~8-10x the slice-by-8 table path, which
+// made CRC verification ~40% of record-reader time (bench_input.py).
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(uint32_t crc, const void* data, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint64_t c = ~crc;
+  while (n > 0 && (reinterpret_cast<uintptr_t>(p) & 7u) != 0) {
+    c = __builtin_ia32_crc32qi(static_cast<uint32_t>(c), *p++);
+    --n;
+  }
+  while (n >= 8) {
+    uint64_t w;
+    __builtin_memcpy(&w, p, 8);
+    c = __builtin_ia32_crc32di(c, w);
+    p += 8;
+    n -= 8;
+  }
+  while (n > 0) {
+    c = __builtin_ia32_crc32qi(static_cast<uint32_t>(c), *p++);
+    --n;
+  }
+  return ~static_cast<uint32_t>(c);
+}
+
+bool have_sse42() {
+  __builtin_cpu_init();
+  return __builtin_cpu_supports("sse4.2");
+}
+#endif  // __x86_64__
+
 }  // namespace
 
+uint32_t crc32c_sw(uint32_t crc, const void* data, size_t n);
+
 uint32_t crc32c(uint32_t crc, const void* data, size_t n) {
+#if defined(__x86_64__)
+  static const bool hw = have_sse42();
+  if (hw) return crc32c_hw(crc, data, n);
+#endif
+  return crc32c_sw(crc, data, n);
+}
+
+uint32_t crc32c_sw(uint32_t crc, const void* data, size_t n) {
   const auto& tb = tables();
   const uint8_t* p = static_cast<const uint8_t*>(data);
   crc = ~crc;

@@ -16,6 +16,13 @@
 // shuffle buffer on the consumer side does reservoir-style sampling so
 // records mix across files (the tf.data interleave+shuffle idiom).
 //
+// Throughput design: workers PACK records into batches (contiguous payload
+// buffer + length array) before queueing, so queue traffic — mutex +
+// condvar per element — is paid once per ~256 records, and the batched C
+// ABI (dtf_reader_next_packed) hands a whole producer batch to Python in
+// one FFI round-trip with zero consumer-side copies.  Per-record paths
+// (dtf_reader_next, the shuffle buffer) unpack batches on demand.
+//
 // Exposed as a flat C ABI for ctypes (no pybind11 in this image).
 
 #include <atomic>
@@ -41,17 +48,34 @@ struct Record {
   uint64_t len = 0;
 };
 
-// Bounded MPSC queue of records.
+// A producer-packed run of records: concatenated payloads + length array.
+struct Batch {
+  uint8_t* buf = nullptr;     // malloc'd payload bytes (concatenated)
+  uint64_t* lens = nullptr;   // malloc'd per-record lengths
+  int64_t count = 0;
+};
+
+inline void free_batch(Batch* b) {
+  free(b->buf);
+  free(b->lens);
+  *b = Batch{};
+}
+
+//: producer-side packing bounds (records / payload bytes per batch)
+constexpr int64_t kBatchRecords = 256;
+constexpr uint64_t kBatchBytes = 2ull << 20;
+
+// Bounded MPSC queue of batches.
 class BoundedQueue {
  public:
   explicit BoundedQueue(size_t cap) : cap_(cap) {}
 
   // Returns false if the queue was closed for writing (consumer gone).
-  bool push(Record r) {
+  bool push(Batch r) {
     std::unique_lock<std::mutex> lk(mu_);
     cv_not_full_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
     if (closed_) {
-      free(r.data);
+      free_batch(&r);
       return false;
     }
     q_.push_back(r);
@@ -71,7 +95,7 @@ class BoundedQueue {
   }
 
   // Returns false on end-of-stream (all producers done, queue drained).
-  bool pop(Record* out) {
+  bool pop(Batch* out) {
     std::unique_lock<std::mutex> lk(mu_);
     cv_not_empty_.wait(lk, [&] { return !q_.empty() || producers_ == 0; });
     if (q_.empty()) return false;
@@ -84,7 +108,7 @@ class BoundedQueue {
   void close() {
     std::lock_guard<std::mutex> lk(mu_);
     closed_ = true;
-    for (auto& r : q_) free(r.data);
+    for (auto& r : q_) free_batch(&r);
     q_.clear();
     cv_not_full_.notify_all();
     cv_not_empty_.notify_all();
@@ -94,7 +118,7 @@ class BoundedQueue {
   const size_t cap_;
   std::mutex mu_;
   std::condition_variable cv_not_full_, cv_not_empty_;
-  std::deque<Record> q_;
+  std::deque<Batch> q_;
   int producers_ = 0;
   bool closed_ = false;
 };
@@ -124,12 +148,37 @@ class Writer {
   FILE* f_;
 };
 
-// Reads one file sequentially, pushing records into the shared queue.
-// Returns false on framing/CRC corruption.
+// Reads one file sequentially, packing records into batches and pushing
+// them into the shared queue.  Returns false on framing/CRC corruption.
 bool read_file(const std::string& path, bool verify_crc, BoundedQueue* q) {
   FILE* f = fopen(path.c_str(), "rb");
   if (!f) return false;
   bool ok = true;
+  std::vector<uint8_t> payload;
+  std::vector<uint64_t> lens;
+  payload.reserve(kBatchBytes);
+  lens.reserve(kBatchRecords);
+
+  // 1 = flushed (or nothing to flush), 0 = reader closed (stop quietly),
+  // -1 = allocation failure (caller must poison the stream — silently
+  // dropping the tail would read as a clean EOF).
+  auto flush = [&]() -> int {
+    if (lens.empty()) return 1;
+    Batch b;
+    b.count = static_cast<int64_t>(lens.size());
+    b.buf = static_cast<uint8_t*>(malloc(payload.empty() ? 1 : payload.size()));
+    b.lens = static_cast<uint64_t*>(malloc(lens.size() * sizeof(uint64_t)));
+    if (b.buf == nullptr || b.lens == nullptr) {
+      free_batch(&b);
+      return -1;
+    }
+    if (!payload.empty()) memcpy(b.buf, payload.data(), payload.size());
+    memcpy(b.lens, lens.data(), lens.size() * sizeof(uint64_t));
+    payload.clear();
+    lens.clear();
+    return q->push(b) ? 1 : 0;
+  };
+
   for (;;) {
     uint8_t hdr[12];
     size_t n = fread(hdr, 1, 12, f);
@@ -154,29 +203,33 @@ bool read_file(const std::string& path, bool verify_crc, BoundedQueue* q) {
       ok = false;
       break;
     }
-    uint8_t* data = static_cast<uint8_t*>(malloc(len ? len : 1));
-    if (data == nullptr) {
-      ok = false;
-      break;
-    }
-    if (fread(data, 1, len, f) != len) {
-      free(data);
+    size_t off = payload.size();
+    payload.resize(off + len);
+    if (len && fread(payload.data() + off, 1, len, f) != len) {
       ok = false;
       break;
     }
     uint32_t dc;
     if (fread(&dc, 1, 4, f) != 4) {
-      free(data);
       ok = false;
       break;
     }
-    if (verify_crc && crc32c_mask(crc32c(0, data, len)) != dc) {
-      free(data);
+    if (verify_crc &&
+        crc32c_mask(crc32c(0, payload.data() + off, len)) != dc) {
       ok = false;
       break;
     }
-    if (!q->push(Record{data, len})) break;  // reader closed underneath us
+    lens.push_back(len);
+    if (static_cast<int64_t>(lens.size()) >= kBatchRecords ||
+        payload.size() >= kBatchBytes) {
+      int fr = flush();
+      if (fr <= 0) {
+        if (fr < 0) ok = false;  // alloc failure = poisoned, not clean EOF
+        break;
+      }
+    }
   }
+  if (ok && flush() < 0) ok = false;  // final partial batch
   fclose(f);
   return ok;
 }
@@ -186,7 +239,7 @@ class Reader {
   Reader(std::vector<std::string> files, int num_threads, int shuffle_buffer,
          uint64_t seed, bool verify_crc)
       : files_(std::move(files)),
-        queue_(256),
+        queue_(8),  // batches (~2 MB each): bounds prefetch at ~16 MB
         shuffle_cap_(shuffle_buffer),
         rng_(seed) {
     if (num_threads < 1) num_threads = 1;
@@ -195,10 +248,17 @@ class Reader {
     for (int t = 0; t < num_threads; ++t) queue_.add_producer();
     for (int t = 0; t < num_threads; ++t) {
       threads_.emplace_back([this, t, num_threads, verify_crc] {
-        // Static round-robin file assignment per worker thread.
-        for (size_t i = t; i < files_.size(); i += num_threads) {
-          if (!read_file(files_[i], verify_crc, &queue_))
-            corrupt_.store(true, std::memory_order_relaxed);
+        // Static round-robin file assignment per worker thread.  A throw
+        // escaping a std::thread aborts the process (std::terminate), so
+        // allocation failures (vector resize on a huge record) poison the
+        // stream instead — Python raises RecordCorruptionError.
+        try {
+          for (size_t i = t; i < files_.size(); i += num_threads) {
+            if (!read_file(files_[i], verify_crc, &queue_))
+              corrupt_.store(true, std::memory_order_relaxed);
+          }
+        } catch (...) {
+          corrupt_.store(true, std::memory_order_relaxed);
         }
         queue_.producer_done();
       });
@@ -209,6 +269,7 @@ class Reader {
     queue_.close();
     for (auto& th : threads_) th.join();
     for (auto& r : shuffle_) free(r.data);
+    free_batch(&cur_);
   }
 
   // -1 = end of stream, -2 = corruption detected; else record length.
@@ -222,7 +283,7 @@ class Reader {
     // shuffle(buffer_size) dataset stage).
     Record r;
     while (static_cast<int>(shuffle_.size()) < std::max(1, shuffle_cap_)) {
-      if (!queue_.pop(&r)) break;
+      if (!unpack_one(&r)) break;
       shuffle_.push_back(r);
     }
     if (corrupt_.load(std::memory_order_relaxed)) return -2;
@@ -238,11 +299,88 @@ class Reader {
     return static_cast<int64_t>(r.len);
   }
 
+  // Batched pull, zero-copy when possible: with no shuffle and no
+  // partially-unpacked batch, a whole producer batch transfers straight
+  // to the caller.  Returns count (0 = end of stream), -2 = corruption.
+  int64_t next_packed(uint8_t** out_buf, uint64_t** out_lens,
+                      int64_t max_records, uint64_t max_bytes) {
+    if (corrupt_.load(std::memory_order_relaxed)) return -2;
+    if (shuffle_cap_ <= 1 && cur_.count == 0 &&
+        max_records >= kBatchRecords && max_bytes >= kBatchBytes) {
+      Batch b;
+      if (!queue_.pop(&b)) {
+        return corrupt_.load(std::memory_order_relaxed) ? -2 : 0;
+      }
+      if (corrupt_.load(std::memory_order_relaxed)) {
+        free_batch(&b);
+        return -2;
+      }
+      *out_buf = b.buf;
+      *out_lens = b.lens;
+      return b.count;
+    }
+    // Shuffled (or bound-limited) path: assemble from per-record pulls.
+    std::vector<uint8_t> payload;
+    std::vector<uint64_t> lens;
+    while (static_cast<int64_t>(lens.size()) < max_records &&
+           payload.size() < max_bytes) {
+      uint8_t* rec = nullptr;
+      int64_t n = next(&rec);
+      if (n == -2) return -2;
+      if (n == -1) break;
+      payload.insert(payload.end(), rec, rec + n);
+      free(rec);
+      lens.push_back(static_cast<uint64_t>(n));
+    }
+    if (lens.empty()) {
+      return corrupt_.load(std::memory_order_relaxed) ? -2 : 0;
+    }
+    auto* b = static_cast<uint8_t*>(malloc(payload.empty() ? 1 : payload.size()));
+    auto* l = static_cast<uint64_t*>(malloc(lens.size() * sizeof(uint64_t)));
+    if (b == nullptr || l == nullptr) {
+      free(b);
+      free(l);
+      return -2;
+    }
+    if (!payload.empty()) memcpy(b, payload.data(), payload.size());
+    memcpy(l, lens.data(), lens.size() * sizeof(uint64_t));
+    *out_buf = b;
+    *out_lens = l;
+    return static_cast<int64_t>(lens.size());
+  }
+
  private:
+  // Copy the next record out of the current batch (popping a new batch
+  // when spent).  Returns false at end of stream.
+  bool unpack_one(Record* out) {
+    while (cur_ix_ >= cur_.count) {
+      free_batch(&cur_);
+      cur_ix_ = 0;
+      cur_off_ = 0;
+      if (!queue_.pop(&cur_)) return false;
+    }
+    uint64_t len = cur_.lens[cur_ix_];
+    auto* data = static_cast<uint8_t*>(malloc(len ? len : 1));
+    if (data == nullptr) {
+      // poison rather than mimic a clean end of stream
+      corrupt_.store(true, std::memory_order_relaxed);
+      return false;
+    }
+    if (len) memcpy(data, cur_.buf + cur_off_, len);
+    cur_ix_ += 1;
+    cur_off_ += len;
+    out->data = data;
+    out->len = len;
+    return true;
+  }
+
   std::vector<std::string> files_;
   BoundedQueue queue_;
   std::vector<std::thread> threads_;
   std::vector<Record> shuffle_;
+  Batch cur_;            // batch being unpacked by the per-record path
+  int64_t cur_ix_ = 0;   // next record index within cur_
+  uint64_t cur_off_ = 0; // byte offset of that record in cur_.buf
   int shuffle_cap_;
   std::mt19937_64 rng_;
   std::atomic<bool> corrupt_{false};
@@ -282,6 +420,20 @@ void* dtf_reader_open(const char** paths, int n_files, int num_threads,
 
 int64_t dtf_reader_next(void* r, uint8_t** out) {
   return static_cast<dtf::Reader*>(r)->next(out);
+}
+
+// Batched pull: up to max_records records (or ~max_bytes of payload) as
+// ONE malloc'd buffer + a malloc'd uint64 length array — one FFI
+// round-trip per batch instead of three per record, and zero-copy when a
+// whole producer batch can be handed over (see Reader::next_packed).
+// Returns the record count (0 = clean end of stream), -2 = corruption.
+// Caller frees *out_buf and *out_lens with dtf_free.
+int64_t dtf_reader_next_packed(void* r, uint8_t** out_buf,
+                               uint64_t** out_lens, int64_t max_records,
+                               int64_t max_bytes) {
+  if (max_records <= 0 || max_bytes <= 0) return 0;
+  return static_cast<dtf::Reader*>(r)->next_packed(
+      out_buf, out_lens, max_records, static_cast<uint64_t>(max_bytes));
 }
 
 void dtf_reader_close(void* r) { delete static_cast<dtf::Reader*>(r); }
