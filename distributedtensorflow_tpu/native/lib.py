@@ -105,6 +105,10 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_void_p, c.POINTER(u8p), c.POINTER(c.POINTER(c.c_uint64)),
         c.c_int64, c.c_int64,
     ]
+    lib.dtf_reader_batch_records.restype = c.c_int64
+    lib.dtf_reader_batch_records.argtypes = []
+    lib.dtf_reader_batch_bytes.restype = c.c_int64
+    lib.dtf_reader_batch_bytes.argtypes = []
     lib.dtf_reader_close.restype = None
     lib.dtf_reader_close.argtypes = [c.c_void_p]
     lib.dtf_free.restype = None

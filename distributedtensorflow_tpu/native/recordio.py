@@ -16,7 +16,8 @@ from .lib import load_native_library
 
 
 def crc32c(data: bytes) -> int:
-    """Raw CRC32-C of ``data`` (native slice-by-8 implementation)."""
+    """Raw CRC32-C of ``data`` (native: SSE4.2 crc32 instruction when the
+    CPU has it, slice-by-8 table fallback)."""
     return load_native_library().dtf_crc32c(data, len(data))
 
 
@@ -77,7 +78,8 @@ class RecordReader:
         records (the ``shuffle(buffer_size)`` contract).
       seed: shuffle RNG seed — same seed + same single-threaded file order
         reproduces the same stream.
-      verify_crc: verify per-record CRCs (cheap: slice-by-8, single pass).
+      verify_crc: verify per-record CRCs (cheap: hardware CRC32C where
+        available, slice-by-8 fallback; single pass).
     """
 
     def __init__(
@@ -114,10 +116,6 @@ class RecordReader:
     def __iter__(self) -> Iterator[bytes]:
         return self
 
-    #: Per-FFI-call batch bounds (records / payload bytes).
-    _BATCH_RECORDS = 1024
-    _BATCH_BYTES = 8 << 20
-
     def __next__(self) -> bytes:
         if self._pending_ix < len(self._pending):
             rec = self._pending[self._pending_ix]
@@ -127,9 +125,12 @@ class RecordReader:
             raise StopIteration
         buf = ctypes.POINTER(ctypes.c_uint8)()
         lens = ctypes.POINTER(ctypes.c_uint64)()
+        # Limits >= the producer's packing bounds (read from the C ABI so
+        # the two can't drift apart) keep the handoff zero-copy in C.
         n = self._lib.dtf_reader_next_packed(
             self._h, ctypes.byref(buf), ctypes.byref(lens),
-            self._BATCH_RECORDS, self._BATCH_BYTES,
+            4 * self._lib.dtf_reader_batch_records(),
+            4 * self._lib.dtf_reader_batch_bytes(),
         )
         if n == 0:
             self.close()
@@ -141,6 +142,10 @@ class RecordReader:
             )
         try:
             sizes = lens[:n]
+            # One bulk copy, then C-speed bytes slicing.  (Measured faster
+            # than per-record ctypes.string_at despite the extra copy: a
+            # ctypes call costs ~1us while a ~KB memcpy costs ~50ns; the
+            # <=8MB blob is transient.)
             blob = ctypes.string_at(buf, sum(sizes))
         finally:
             self._lib.dtf_free(buf)

@@ -32,6 +32,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <string>
@@ -151,7 +152,11 @@ class Writer {
 // Reads one file sequentially, packing records into batches and pushing
 // them into the shared queue.  Returns false on framing/CRC corruption.
 bool read_file(const std::string& path, bool verify_crc, BoundedQueue* q) {
-  FILE* f = fopen(path.c_str(), "rb");
+  // RAII: vector resizes below may throw bad_alloc (caught by the worker
+  // thread); the FILE* must not leak on that path.
+  std::unique_ptr<FILE, int (*)(FILE*)> holder(fopen(path.c_str(), "rb"),
+                                               fclose);
+  FILE* f = holder.get();
   if (!f) return false;
   bool ok = true;
   std::vector<uint8_t> payload;
@@ -230,7 +235,6 @@ bool read_file(const std::string& path, bool verify_crc, BoundedQueue* q) {
     }
   }
   if (ok && flush() < 0) ok = false;  // final partial batch
-  fclose(f);
   return ok;
 }
 
@@ -437,6 +441,13 @@ int64_t dtf_reader_next_packed(void* r, uint8_t** out_buf,
 }
 
 void dtf_reader_close(void* r) { delete static_cast<dtf::Reader*>(r); }
+
+// Producer batch-packing bounds — exported so the Python side can size its
+// pull limits >= these (the zero-copy handoff in next_packed requires it).
+int64_t dtf_reader_batch_records(void) { return dtf::kBatchRecords; }
+int64_t dtf_reader_batch_bytes(void) {
+  return static_cast<int64_t>(dtf::kBatchBytes);
+}
 
 void dtf_free(void* p) { free(p); }
 

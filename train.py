@@ -397,6 +397,15 @@ def main() -> None:
     spec = parse_mesh(args.mesh) or wl.mesh_spec
     mesh = parallel.build_mesh(spec)
     wl = wl.for_mesh(mesh)  # e.g. gpt_lm binds seq-parallel attention
+    from distributedtensorflow_tpu.parallel.mesh import replica_count
+
+    shard_div = replica_count(mesh)
+    if wl.global_batch_size % shard_div:
+        raise SystemExit(
+            f"global batch {wl.global_batch_size} is not divisible by the "
+            f"mesh's batch-sharding factor {shard_div} (data x fsdp axes); "
+            f"pick --batch-size as a multiple of {shard_div}"
+        )
     accum = args.accum_steps if args.accum_steps is not None else wl.accum_steps
     logging.info(
         "workload=%s mesh=%s devices=%d processes=%d global_batch=%d accum=%d",
