@@ -34,6 +34,7 @@ while true; do
     # long-context config: flash attention auto-dispatches at 4k seq
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || ok=0
+    BENCH_SKIP_PROBE=1 BENCH_BERT_BATCH=32 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
     # full-stack convergence on the real chip (accuracy gate through the
     # CLI) — retried each window until one run SUCCEEDS (.done sentinel;
