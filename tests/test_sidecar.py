@@ -161,3 +161,42 @@ def test_cli_evaluator_job(tmp_path, dp_mesh):
         records = [json.loads(line) for line in f]
     assert records and records[-1]["step"] == 4
     assert "eval/accuracy" in records[-1]
+
+
+def test_sidecar_concurrent_with_async_writer(tmp_path, dp_mesh):
+    """Evaluator restores while an async-save writer keeps committing new
+    checkpoints — Orbax's atomic-rename protocol must never hand the
+    reader a partial checkpoint (every restore succeeds; the final step is
+    always caught)."""
+    state, eval_step = _setup(dp_mesh)
+    ckpt = str(tmp_path / "ckpt")
+    writer = CheckpointManager(ckpt, async_save=True, max_to_keep=3)
+    final_step = 8
+
+    def trainer():
+        s = state
+        for step in range(1, final_step + 1):
+            s = s.replace(step=jnp.asarray(step))
+            writer.save(step, s, force=True)
+            time.sleep(0.4)
+        writer.wait()
+
+    t = threading.Thread(target=trainer)
+    t.start()
+    try:
+        sidecar = SidecarEvaluator(
+            CheckpointManager(ckpt, async_save=False),
+            eval_step,
+            lambda: iter(_batches(1)),
+            state,
+            poll_interval_s=0.1,
+            stop_after_step=final_step,
+            idle_timeout_s=120,
+        )
+        history = sidecar.run()
+    finally:
+        t.join()
+        writer.close()
+    assert final_step in history
+    for metrics in history.values():  # every concurrent restore was whole
+        assert np.isfinite(metrics["loss"])
