@@ -36,6 +36,8 @@ while true; do
     BENCH_SKIP_PROBE=1 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || ok=0
     BENCH_SKIP_PROBE=1 BENCH_BERT_BATCH=32 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
+    # long-context tail: 16k/32k where only the flash kernel can run
+    BENCH_SKIP_PROBE=1 BENCH_ATTN_SEQS=16384,32768 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || true
     # full-stack convergence on the real chip (accuracy gate through the
     # CLI) — retried each window until one run SUCCEEDS (.done sentinel;
     # metrics.jsonl alone also exists for timed-out/crashed runs)
