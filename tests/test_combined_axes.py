@@ -7,9 +7,7 @@ Megatron-sharded jit) was untested.  These tests run the SAME workload on a
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
 from distributedtensorflow_tpu.train import create_sharded_state, make_train_step

@@ -10,7 +10,6 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from distributedtensorflow_tpu.utils import (
     Watchdog,

@@ -14,7 +14,6 @@ from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
 from distributedtensorflow_tpu.parallel.ring_attention import (
     make_sequence_parallel_attention,
 )
-from distributedtensorflow_tpu.parallel.sharding import batch_spec
 
 
 def make_qkv(b=2, s=64, h=4, d=16, seed=0):

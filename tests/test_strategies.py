@@ -87,7 +87,6 @@ def test_distribute_datasets_from_function_gets_context(devices):
 
 def test_training_under_strategy_scope(devices):
     """End-to-end: sharded-state creation + train step inside scope()."""
-    import optax
 
     from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
     from distributedtensorflow_tpu.workloads import get_workload

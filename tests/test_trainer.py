@@ -9,7 +9,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from distributedtensorflow_tpu.checkpoint import CheckpointManager
 from distributedtensorflow_tpu.models import LeNet5
