@@ -155,7 +155,7 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
     # cost analysis (a separate lower().compile() for cost analysis alone
     # would pay a second full ResNet-50 compile over the flaky tunnel).
     compiled = step.lower(state, batch, rng).compile()
-    from bench_probe import mfu_from_compiled, timed_steps
+    from bench_probe import mfu_fields, timed_steps
 
     state, dt = timed_steps(compiled, state, batch, rng,
                             n_steps=n_steps, warmup=warmup)
@@ -164,9 +164,9 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
 
     # Model-FLOPs utilization, computed per chip on both sides: XLA's cost
     # analysis counts the PARTITIONED (per-device) module's FLOPs, which is
-    # exactly the per-chip numerator; the analytic fallback is global and
+    # exactly the per-chip numerator; the analytic number is global and
     # divided down by n_chips (224px constant scaled by conv-FLOP area).
-    mfu, flops_source = mfu_from_compiled(
+    mfu = mfu_fields(
         compiled, dt, n_steps, device_kind,
         RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
         * (image_size / 224.0) ** 2 / n_chips,
@@ -178,8 +178,7 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 4),
-        "mfu": round(mfu, 4),
-        "mfu_flops_source": flops_source,
+        **mfu,
         "platform": platform,
         "device_kind": device_kind,
         "n_chips": n_chips,

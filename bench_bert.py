@@ -59,13 +59,13 @@ def main() -> None:
 
     compiled = step.lower(state, batch, rng).compile()
     n_steps = 20
-    from bench_probe import timed_steps, mfu_from_compiled
+    from bench_probe import timed_steps, mfu_fields
 
     state, dt = timed_steps(compiled, state, batch, rng,
                             n_steps=n_steps, warmup=3)
     per_chip = n_steps * wl.global_batch_size / dt / n_chips
 
-    # Analytic fallback honoring the GATHERED head: encoder matmul params
+    # Analytic model FLOPs honoring the GATHERED head: encoder matmul params
     # run at all S positions, the mlm_* head params only at the P gathered
     # positions, and embedding tables are lookups (no matmul FLOPs).
     n_encoder = n_head = 0
@@ -81,12 +81,15 @@ def main() -> None:
     from distributedtensorflow_tpu.models import max_predictions_for
 
     p_gathered = max_predictions_for(seq)  # the preset's gathered-head size
+    # + the quadratic attention term: 12·L·H·S analytic FLOPs per token.
+    cfg = wl.model.cfg
+    attn = 12.0 * cfg.num_layers * cfg.hidden_size * seq * seq
     fallback = (
-        6.0 * wl.global_batch_size
-        * (n_encoder * seq + n_head * p_gathered) / n_chips
+        wl.global_batch_size
+        * (6.0 * (n_encoder * seq + n_head * p_gathered) + attn) / n_chips
     )
     device_kind = jax.devices()[0].device_kind
-    mfu, flops_source = mfu_from_compiled(
+    mfu = mfu_fields(
         compiled, dt, n_steps, device_kind, fallback,
         "analytic_6N_enc_at_S_head_at_P",
     )
@@ -98,8 +101,7 @@ def main() -> None:
         "value": round(per_chip, 2),
         "unit": "examples/sec/chip",
         "vs_baseline": round(per_chip / 200.0, 4),
-        "mfu": round(mfu, 4),
-        "mfu_flops_source": flops_source,
+        **mfu,
         "platform": jax.devices()[0].platform,
         "device_kind": device_kind,
         "seq": seq,

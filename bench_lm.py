@@ -70,24 +70,31 @@ def main() -> None:
     # AOT-compile once; reuse for warmup, timing, and cost analysis.
     compiled = step.lower(state, batch, rng).compile()
     n_steps = 20
-    from bench_probe import mfu_from_compiled, timed_steps
+    from bench_probe import mfu_fields, timed_steps
 
     state, dt = timed_steps(compiled, state, batch, rng,
                             n_steps=n_steps, warmup=3)
     tokens_per_sec = n_steps * wl.global_batch_size * seq / dt
     per_chip = tokens_per_sec / n_chips
 
-    # Analytic fallback: 6N per token fwd+bwd; +2N full-block recompute;
-    # attention-only remat recomputes ~5% of the forward.
+    # Analytic MODEL FLOPs per token, PaLM-style MFU convention: 6N for
+    # the param matmuls fwd+bwd plus the quadratic attention term
+    # 12·L·H·S (Chinchilla appendix accounting — at seq≥4k no longer
+    # negligible against 6N).  Remat RECOMPUTE is deliberately excluded
+    # (that would be HFU): remat configs honestly show a lower MFU for
+    # the same model, keeping the denominator fixed across impl/remat
+    # changes — the stability VERDICT r2 #3 asked for.
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
     )
-    per_token = {False: 6.0, True: 8.0, "attn": 6.3}[remat] * n_params
+    cfg = wl.model.cfg
+    attn_per_token = 12.0 * cfg.num_layers * cfg.hidden_size * seq
+    per_token = 6.0 * n_params + attn_per_token
     device_kind = jax.devices()[0].device_kind
-    mfu, flops_source = mfu_from_compiled(
+    mfu = mfu_fields(
         compiled, dt, n_steps, device_kind,
         per_token * wl.global_batch_size * seq / n_chips,
-        "analytic_6N_per_token",
+        "analytic_model_flops_6N_plus_12LHS_palm_mfu",
     )
 
     # Anchor: an A100 trains GPT-2-small (~124M params) at roughly 150k
@@ -97,8 +104,7 @@ def main() -> None:
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / 150_000.0, 4),
-        "mfu": round(mfu, 4),
-        "mfu_flops_source": flops_source,
+        **mfu,
         "platform": jax.devices()[0].platform,
         "device_kind": device_kind,
         "seq": seq,

@@ -180,22 +180,34 @@ def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
     return state, time.perf_counter() - t0
 
 
-def mfu_from_compiled(compiled, dt: float, n_steps: int, device_kind: str,
-                      fallback_flops_per_step: float,
-                      fallback_source: str) -> tuple[float, str]:
-    """Model-FLOPs utilization from XLA's partitioned-module cost analysis
-    (per-chip FLOPs), falling back to the caller's analytic estimate."""
+def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,
+               analytic_flops_per_step: float,
+               analytic_source: str) -> dict:
+    """Both MFU accountings for a bench result, as emit-ready fields.
+
+    ``mfu_analytic`` divides ANALYTIC per-chip model FLOPs (6·N·D-style,
+    fixed by the model config, independent of the implementation) by peak —
+    the stable round-over-round number, and what ``mfu`` aliases.
+    ``mfu_xla_cost`` divides XLA's partitioned-module cost analysis by peak
+    — it tracks what the compiled program actually executes, so it MOVES
+    when the implementation changes (e.g. the vocab-chunked CE head raised
+    throughput while lowering executed FLOPs, which made the old
+    single-``mfu`` field read as a regression).  Emitting both makes that
+    inversion impossible to misread."""
     from bench import _peak_flops
 
-    flops_per_step = None
-    source = "xla_cost_analysis"
+    peak = _peak_flops(device_kind)
+    xla_mfu = None
     try:
         cost = compiled.cost_analysis()
         if cost and cost.get("flops"):
-            flops_per_step = float(cost["flops"])
+            xla_mfu = (float(cost["flops"]) * n_steps / dt) / peak
     except Exception as e:  # cost analysis is best-effort on the tunnel
         print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
-    if not flops_per_step:
-        flops_per_step = fallback_flops_per_step
-        source = fallback_source
-    return (flops_per_step * n_steps / dt) / _peak_flops(device_kind), source
+    analytic_mfu = (analytic_flops_per_step * n_steps / dt) / peak
+    return {
+        "mfu": round(analytic_mfu, 4),
+        "mfu_analytic": round(analytic_mfu, 4),
+        "mfu_analytic_source": analytic_source,
+        "mfu_xla_cost": round(xla_mfu, 4) if xla_mfu is not None else None,
+    }

@@ -184,9 +184,18 @@ def _mlm_metrics(model: BertForMLM, max_predictions: int | None,
     )
     if rng is not None:
         kwargs["rngs"] = {"dropout": rng}
+    extra = {}
     if max_predictions:
         p = min(max_predictions, labels.shape[1])
         w, pos = jax.lax.top_k(valid.astype(jnp.int32), p)  # (B, P)
+        # Rows with more than P masked positions silently lose the excess
+        # supervision (the reference recipe's max_predictions_per_seq cap);
+        # surface the fraction so user-supplied data masked above ~20%
+        # shows up in the metrics stream instead of quietly changing the
+        # loss vs the dense head.
+        extra["mlm_clipped_rows"] = jnp.mean(
+            (valid.sum(axis=1) > p).astype(jnp.float32)
+        )
         logits = model.apply(
             {"params": params}, batch["input_ids"],
             masked_positions=pos, **kwargs,
@@ -207,7 +216,7 @@ def _mlm_metrics(model: BertForMLM, max_predictions: int | None,
     denom = jnp.maximum(w.sum(), 1.0)
     loss = (per_tok * w).sum() / denom
     acc = ((jnp.argmax(logits, -1) == safe_labels) * w).sum() / denom
-    return loss, acc.astype(jnp.float32)
+    return loss, {"mlm_accuracy": acc.astype(jnp.float32), **extra}
 
 
 def mlm_loss(model: BertForMLM, *, max_predictions: int | None = None):
@@ -218,8 +227,9 @@ def mlm_loss(model: BertForMLM, *, max_predictions: int | None = None):
     """
 
     def loss_fn(params, model_state, batch, rng):
-        loss, acc = _mlm_metrics(model, max_predictions, params, batch, rng)
-        return loss, ({"mlm_accuracy": acc}, model_state)
+        loss, metrics = _mlm_metrics(model, max_predictions, params, batch,
+                                     rng)
+        return loss, (metrics, model_state)
 
     return loss_fn
 
@@ -229,8 +239,9 @@ def mlm_eval(model: BertForMLM, *, max_predictions: int | None = None):
     dispatch as :func:`mlm_loss`."""
 
     def metric_fn(params, model_state, batch):
-        loss, acc = _mlm_metrics(model, max_predictions, params, batch, None)
-        return {"loss": loss, "mlm_accuracy": acc}
+        loss, metrics = _mlm_metrics(model, max_predictions, params, batch,
+                                     None)
+        return {"loss": loss, **metrics}
 
     return metric_fn
 

@@ -80,6 +80,13 @@ class RecordReader:
         reproduces the same stream.
       verify_crc: verify per-record CRCs (cheap: hardware CRC32C where
         available, slice-by-8 fallback; single pass).
+
+    Note: records cross the FFI boundary in batches (up to 4x the
+    producer bounds — ~1024 records / ~8 MB), so a
+    :class:`RecordCorruptionError` surfaces at BATCH granularity — up to
+    one batch later than the corrupt record itself, after earlier records
+    in that window were already yielded.  The trade buys the ~5x
+    batched-FFI throughput win over per-record ctypes calls.
     """
 
     def __init__(

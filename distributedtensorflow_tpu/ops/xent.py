@@ -44,18 +44,23 @@ def chunked_softmax_xent(
     """Mean masked next-token NLL without materializing full logits.
 
     Returns the scalar mean of ``logsumexp(h @ wte.T) - logit[target]``
-    over unmasked positions.  ``targets`` outside ``[0, V)`` are clipped
-    (callers mask them out — e.g. shifted padding).
+    over unmasked positions.  ``targets`` outside ``[0, V)`` (e.g. a
+    -100-style ignore label a caller forgot to mask) contribute ZERO
+    weight — matching optax's integer-label xent — rather than being
+    silently attributed to a clipped token id.
     """
     b, s, d = hidden.shape
     n = b * s
+    v = wte.shape[0]
     x = hidden.reshape(n, d)
-    t = jnp.clip(targets.reshape(n), 0, wte.shape[0] - 1)
+    t_raw = targets.reshape(n)
+    t = jnp.clip(t_raw, 0, v - 1)
     w = (
         mask.reshape(n).astype(jnp.float32)
         if mask is not None
         else jnp.ones((n,), jnp.float32)
     )
+    w = w * ((t_raw >= 0) & (t_raw < v)).astype(jnp.float32)
 
     c = min(chunk_tokens, n)
     n_chunks = -(-n // c)

@@ -241,3 +241,30 @@ def test_chunked_xent_random_shapes():
         jnp.zeros((1, 4), jnp.int32), jnp.zeros((1, 4), jnp.int32),
     )
     assert float(zero) == 0.0
+
+
+def test_chunked_xent_out_of_range_targets_zero_weight():
+    """Targets outside [0, V) — e.g. an unmasked -100 ignore label —
+    contribute zero weight (optax integer-label semantics), not a wrong
+    loss attributed to a clipped token id."""
+    from distributedtensorflow_tpu.ops.xent import chunked_softmax_xent
+
+    r = np.random.default_rng(3)
+    hidden = jnp.asarray(r.normal(size=(2, 6, 8)), jnp.float32)
+    wte = jnp.asarray(r.normal(size=(11, 8)), jnp.float32)
+    targets = np.asarray(r.integers(0, 11, (2, 6)), np.int32)
+    dirty = targets.copy()
+    dirty[0, 1] = -100  # ignore-label convention, caller forgot to mask
+    dirty[1, 4] = 11    # one past the vocab
+    mask = np.ones((2, 6), np.int32)
+    clean_mask = mask.copy()
+    clean_mask[0, 1] = clean_mask[1, 4] = 0
+    got = chunked_softmax_xent(hidden, wte, jnp.asarray(dirty),
+                               jnp.asarray(mask))
+    want = chunked_softmax_xent(hidden, wte, jnp.asarray(targets),
+                                jnp.asarray(clean_mask))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+    # all targets out of range -> 0/0 guard, finite zero loss
+    assert float(chunked_softmax_xent(
+        hidden, wte, jnp.full((2, 6), -100, jnp.int32), jnp.asarray(mask)
+    )) == 0.0

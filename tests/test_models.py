@@ -227,9 +227,12 @@ def test_mlm_gathered_head_matches_dense():
             np.asarray(a, np.float32), np.asarray(c, np.float32),
             atol=2e-4, rtol=2e-3,
         )
-    # excess masked positions are dropped, not crashed on
+    # excess masked positions are dropped, not crashed on — and the drop is
+    # SURFACED via the clipped-rows metric (advisor round-2 finding)
+    assert float(mg["mlm_clipped_rows"]) == 0.0  # P=8 > n_masked=5: none
     overflow_fn = mlm_loss(model, max_predictions=3)  # < n_masked
-    (lo, _), _ = jax.value_and_grad(overflow_fn, has_aux=True)(
+    (lo, (mo, _)), _ = jax.value_and_grad(overflow_fn, has_aux=True)(
         vs["params"], {}, batch, rng
     )
     assert np.isfinite(float(lo))
+    assert float(mo["mlm_clipped_rows"]) == 1.0  # every row masked > P

@@ -21,6 +21,11 @@ import os
 import jax
 
 
+#: --remat CLI choice -> get_workload(remat=...) value; single mapping
+#: shared by the trainer and evaluator roles so their graphs can't diverge.
+REMAT_FLAG = {"on": True, "off": False, "attn": "attn", None: None}
+
+
 def parse_mesh(s: str | None):
     from distributedtensorflow_tpu.parallel import MeshSpec
 
@@ -177,6 +182,7 @@ def run_evaluator(args) -> None:
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual, seq_len=args.seq_len,
         attn_impl=args.attn_impl,
+        remat=REMAT_FLAG[args.remat],
     )
     if wl.eval_fn is None:
         raise SystemExit(f"workload {wl.name!r} has no eval_fn to sidecar")
@@ -390,7 +396,7 @@ def main() -> None:
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual,
         seq_len=args.seq_len,
-        remat={"on": True, "off": False, "attn": "attn", None: None}[args.remat],
+        remat=REMAT_FLAG[args.remat],
         attn_impl=args.attn_impl,
     )
     wl = apply_optimizer_flags(wl, args)
