@@ -49,10 +49,12 @@ class PreemptionHandler:
         signals: tuple[int, ...] = (signal.SIGTERM,),
         mesh=None,
         on_exit: Callable[[], None] | None = None,
+        poll_every: int = 10,
     ):
         self._manager = manager
         self._mesh = mesh
         self._on_exit = on_exit
+        self._poll_every = max(1, poll_every)
         self._flag = threading.Event()
         self._installed = []
         for sig in signals:
@@ -70,32 +72,50 @@ class PreemptionHandler:
     def preempted(self) -> bool:
         return self._flag.is_set()
 
+    @property
+    def manager(self) -> CheckpointManager:
+        """The manager preemption saves go through (callers that attach
+        metrics — e.g. the Trainer for keep-best scoring — must key them
+        to THIS manager, which need not be the periodic checkpointer)."""
+        return self._manager
+
     def trigger(self) -> None:
         """Programmatic preemption (tests / external watchers)."""
         self._flag.set()
 
     def should_save(self, step: int | None = None) -> bool:
-        """Cluster-consistent preemption check.
+        """Cluster-consistent preemption check (call it every step).
 
-        Single-process: just the local flag.  Multi-process: global OR of the
-        per-host flags (one int per *process*, gathered over the coordination
-        transport), so every host gets the same answer at the same step
-        boundary (the reference's cluster-wise gossip,
-        ``failure_handling.py:544``).
+        Single-process: just the local flag.  Multi-process: global OR of
+        the per-host flags (one int per *process*, gathered over the
+        coordination transport), so every host gets the same answer at the
+        same step boundary (the reference's cluster-wise gossip,
+        ``failure_handling.py:544``) — but only on every
+        ``poll_every``-th step: a collective must be entered by ALL hosts
+        in the same sequence, so the poll schedule has to be a pure
+        function of ``step``, and per-step gathers would put a host-sync
+        barrier in the hot loop for a notice window that is tens of
+        seconds long.  A locally-set flag waits (at most ``poll_every``
+        steps) for the next poll boundary.  ``step=None`` polls now.
         """
         local = 1 if self._flag.is_set() else 0
         if jax.process_count() == 1:
             return bool(local)
+        if step is not None and step % self._poll_every != 0:
+            return False
         from jax.experimental import multihost_utils  # noqa: PLC0415
 
         flags = multihost_utils.process_allgather(np.array([local], np.int32))
         return bool(np.asarray(flags).sum() > 0)
 
-    def save_and_exit(self, step: int, state: TrainState) -> None:
+    def save_and_exit(self, step: int, state: TrainState,
+                      metrics: dict | None = None) -> None:
         """Force-save now and run the exit hook (default: nothing; the
 
-        launcher restarts the job, which resumes from this checkpoint)."""
-        self._manager.save(step, state, force=True)
+        launcher restarts the job, which resumes from this checkpoint).
+        ``metrics`` feeds a keep-best manager's retention scoring (required
+        by such managers on every save)."""
+        self._manager.save(step, state, force=True, metrics=metrics)
         self._manager.wait()
         logger.warning("preemption save complete at step %d", step)
         if self._on_exit is not None:

@@ -69,16 +69,19 @@ class Trainer:
         *,
         eval_step: Callable[[TrainState, PyTree], dict] | None = None,
         checkpointer=None,  # checkpoint.CheckpointManager-compatible
+        preemption=None,  # checkpoint.PreemptionHandler-compatible
     ):
         self.train_step = train_step
         self.eval_step = eval_step
         self.config = config
         self.checkpointer = checkpointer
+        self.preemption = preemption
         self.writer = MetricWriter(config.logdir)
         self.meter = ThroughputMeter(config.global_batch_size)
         # Latest eval metrics, threaded into checkpointer.save() so a
         # best_metric (keep-best) manager works under the Trainer.
         self._last_eval_metrics: dict | None = None
+        self._preempted = False
 
     def fit(
         self,
@@ -104,28 +107,32 @@ class Trainer:
             close = getattr(train_iter, "close", None)
             if close is not None:
                 close()
-        if self.checkpointer is not None:
+        if self.checkpointer is not None and not self._preempted:
             # Label with the step actually reached (an accuracy-gate early
-            # stop must not save under the total_steps slot).
+            # stop must not save under the total_steps slot).  A preemption
+            # exit already force-saved inside the loop.
             self.checkpointer.save(
                 int(state.step), state, force=True, metrics=self._ckpt_metrics()
             )
             self.checkpointer.wait()
         return state
 
-    def _ckpt_metrics(self) -> dict | None:
-        """Metrics to attach to a checkpoint save.
+    def _ckpt_metrics(self, manager=None) -> dict | None:
+        """Metrics to attach to a save through ``manager`` (default: the
+        periodic checkpointer; the preemption handler may save through a
+        DIFFERENT manager, whose keep-best key must be honored).
 
         A keep-best manager (``best_metric`` set) requires its metric on
         EVERY save; when eval hasn't run yet — or ran but didn't produce
         that metric (wrong eval_fn, empty eval iterator) — substitute the
         worst possible score rather than killing a long fit mid-run.
         """
+        manager = manager if manager is not None else self.checkpointer
         metrics = dict(self._last_eval_metrics or {})
-        best_metric = getattr(self.checkpointer, "best_metric", None)
+        best_metric = getattr(manager, "best_metric", None)
         if best_metric is not None and best_metric not in metrics:
             worst = float("-inf") if getattr(
-                self.checkpointer, "best_mode", "max"
+                manager, "best_mode", "max"
             ) == "max" else float("inf")
             if self._last_eval_metrics is not None:
                 logger.warning(
@@ -200,6 +207,22 @@ class Trainer:
                     )
                     if watchdog is not None:  # so is a synchronous save
                         watchdog.ping()
+                # Preemption check LAST so a signal landing mid-step is
+                # observed at the next step boundary — every host agrees on
+                # the save step (the reference's cluster-wise gossip).
+                if self.preemption is not None and self.preemption.should_save(
+                    step_i + 1
+                ):
+                    logger.warning(
+                        "preemption: consistent save at step %d, stopping",
+                        step_i + 1,
+                    )
+                    self.preemption.save_and_exit(
+                        step_i + 1, state,
+                        metrics=self._ckpt_metrics(self.preemption.manager),
+                    )
+                    self._preempted = True
+                    return state
         finally:
             if profiling:  # exception mid-window, or window past total_steps
                 jax.profiler.stop_trace()

@@ -1,0 +1,74 @@
+"""Write synthetic classification datasets as record files on disk.
+
+Produces the records-on-disk starting point for ``train.py --data-dir``:
+sharded TFRecord-framed files (native ``RecordWriter``, masked-CRC32C
+framing) of ``.npz`` feature dicts ``{image, label}`` — the same
+class-conditioned Gaussian task the in-memory presets train on (the
+sandbox ships no real datasets; see ARTIFACTS/README.md).
+
+Run (from the repo root, like the other examples):
+    PYTHONPATH=. python examples/make_records.py --out /tmp/mnist_records \
+        --train-examples 4096 --eval-examples 512 --shards 8
+
+Then:
+    python train.py --workload mnist_lenet \
+        --data-dir /tmp/mnist_records --eval-data-dir /tmp/mnist_records/eval \
+        --eval-every 100 --target-metric accuracy --target-value 0.97 ...
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+
+def synthetic_examples(n, *, image_shape, num_classes, seed):
+    """Per-example dicts of the learnable class-conditioned Gaussian task
+    (mirrors data/input_pipeline.synthetic_classification, unbatched)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        label = int(rng.integers(0, num_classes))
+        image = rng.standard_normal(image_shape).astype(np.float32) * 0.1
+        image += label / num_classes
+        yield {
+            "image": image.astype(np.float32),
+            "label": np.int32(label),
+        }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__, allow_abbrev=False)
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--train-examples", type=int, default=4096)
+    p.add_argument("--eval-examples", type=int, default=512)
+    p.add_argument("--shards", type=int, default=8,
+                   help="train record files (eval always writes 2)")
+    p.add_argument("--image-shape", default="28,28,1")
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    from distributedtensorflow_tpu.data import write_record_shards
+
+    shape = tuple(int(d) for d in args.image_shape.split(","))
+    os.makedirs(os.path.join(args.out, "eval"), exist_ok=True)
+    train = write_record_shards(
+        synthetic_examples(args.train_examples, image_shape=shape,
+                           num_classes=args.classes, seed=args.seed),
+        os.path.join(args.out, "train-{:05d}.rec"),
+        num_shards=args.shards,
+    )
+    # Held-out split, disjoint seed stream: --eval-data-dir points here.
+    evals = write_record_shards(
+        synthetic_examples(args.eval_examples, image_shape=shape,
+                           num_classes=args.classes, seed=args.seed + 10_007),
+        os.path.join(args.out, "eval", "eval-{:05d}.rec"),
+        num_shards=2,
+    )
+    print(f"wrote {len(train)} train shards ({args.train_examples} examples) "
+          f"and {len(evals)} eval shards ({args.eval_examples} examples) "
+          f"under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

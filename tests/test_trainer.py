@@ -150,3 +150,45 @@ def test_top5_accuracy_metric(dp_mesh):
     top5 = np.argsort(-logits, axis=-1)[:, :5]
     want = np.mean([l in row for l, row in zip(batch["label"], top5)])
     np.testing.assert_allclose(float(metrics["top5_accuracy"]), want, rtol=1e-6)
+
+
+def test_preemption_stops_fit_with_consistent_save(tmp_path, dp_mesh):
+    """A preemption signal observed mid-fit saves at the NEXT step boundary
+    and stops the loop (the train.py SIGTERM wiring, minus the signal):
+    restart-from-checkpoint resumes exactly there."""
+    from distributedtensorflow_tpu.checkpoint import PreemptionHandler
+
+    _, state, train_step, _ = _setup(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path / "pk"), async_save=False)
+    handler = PreemptionHandler(mgr, mesh=dp_mesh)
+    fired_at = 3
+
+    def step_then_trigger(state, batch, rng):
+        out = train_step(state, batch, rng)
+        if int(out[0].step) == fired_at:
+            handler.trigger()  # programmatic stand-in for SIGTERM
+        return out
+
+    cfg = TrainerConfig(total_steps=10, log_every=0, global_batch_size=16)
+    trainer = Trainer(
+        step_then_trigger, cfg, checkpointer=mgr, preemption=handler,
+    )
+    try:
+        out = trainer.fit(state, _batches(10), jax.random.PRNGKey(1))
+    finally:
+        handler.uninstall()  # never leak a SIGTERM handler into the session
+    # stopped at the boundary after the trigger, not at total_steps
+    assert int(out.step) == fired_at
+    assert trainer._preempted
+    assert mgr.latest_step() == fired_at
+    # the final-save path was skipped (no duplicate/total_steps slot)
+    assert mgr.all_steps() == [fired_at]
+
+    # a restart restores the preemption step and continues to completion
+    # (template = the same state tree; a real restart rebuilds it with
+    # create_sharded_state exactly as train.py does)
+    state2 = mgr.restore_latest(state)
+    assert int(state2.step) == fired_at
+    trainer2 = Trainer(train_step, cfg, checkpointer=mgr)
+    out2 = trainer2.fit(state2, _batches(10 - fired_at), jax.random.PRNGKey(1))
+    assert int(out2.step) == 10

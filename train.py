@@ -459,11 +459,19 @@ def main() -> None:
         raw_iter = wl.input_fn(ctx, args.seed)
 
     checkpointer = None
+    preemption = None
     if args.checkpoint_dir:
-        from distributedtensorflow_tpu.checkpoint import CheckpointManager
+        from distributedtensorflow_tpu.checkpoint import (
+            CheckpointManager,
+            PreemptionHandler,
+        )
         from distributedtensorflow_tpu.data import skip_batches
 
         checkpointer = CheckpointManager(args.checkpoint_dir)
+        # SIGTERM (GCE/Borg preemption notice) -> cluster-consistent save
+        # at the next step boundary, then a clean stop; the launcher's
+        # restart resumes from that exact step + input position.
+        preemption = PreemptionHandler(checkpointer, mesh=mesh)
         state = checkpointer.restore_latest(state) or state
         restored_step = int(state.step)
         if restored_step > 0:
@@ -497,6 +505,7 @@ def main() -> None:
         ),
         eval_step=eval_step,
         checkpointer=checkpointer,
+        preemption=preemption,
     )
     eval_iter_fn = None
     if args.eval_every and eval_step is not None:
