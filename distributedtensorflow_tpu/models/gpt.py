@@ -261,9 +261,13 @@ class GPTLM(nn.Module):
             # materialize.
             return x
         # Tied output head: reuse the embedding table (one less huge
-        # vocab-sharded matrix; standard for decoder LMs).
+        # vocab-sharded matrix; standard for decoder LMs).  Shared dtype
+        # recipe (ops/xent.tied_head_logits): bf16 operands at MXU rate,
+        # fp32 accumulation — identical to the chunked loss head.
+        from ..ops.xent import tied_head_logits
+
         wte = self.variables["params"]["wte"]["embedding"]
-        return (x @ wte.T.astype(jnp.float32)).astype(jnp.float32)
+        return tied_head_logits(x, wte, cfg.dtype)
 
 
 def lm_loss(model: GPTLM):
@@ -292,6 +296,7 @@ def lm_loss(model: GPTLM):
             params["wte"]["embedding"],
             targets,
             mask[:, 1:] if mask is not None else None,
+            compute_dtype=model.cfg.dtype,
         )
         return loss, ({"perplexity": jnp.exp(loss)}, model_state)
 
@@ -317,6 +322,7 @@ def lm_eval(model: GPTLM):
             params["wte"]["embedding"],
             batch["input_ids"][:, 1:],
             mask[:, 1:] if mask is not None else None,
+            compute_dtype=model.cfg.dtype,
         )
         return {"loss": loss, "perplexity": jnp.exp(loss)}
 

@@ -174,9 +174,10 @@ class GPTMoELM(nn.Module):
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:
             return x, aux_total  # loss applies the chunked head (ops/xent)
+        from ..ops.xent import tied_head_logits
+
         wte = self.variables["params"]["wte"]["embedding"]
-        logits = (x @ wte.T.astype(jnp.float32)).astype(jnp.float32)
-        return logits, aux_total
+        return tied_head_logits(x, wte, cfg.dtype), aux_total
 
 
 def moe_lm_loss(model: GPTMoELM):
@@ -198,6 +199,7 @@ def moe_lm_loss(model: GPTMoELM):
             hidden[:, :-1],
             params["wte"]["embedding"],
             batch["input_ids"][:, 1:],
+            compute_dtype=model.cfg.dtype,
         )
         loss = lm + aux_w * aux
         return loss, (
@@ -221,6 +223,7 @@ def moe_lm_eval(model: GPTMoELM):
             hidden[:, :-1],
             params["wte"]["embedding"],
             batch["input_ids"][:, 1:],
+            compute_dtype=model.cfg.dtype,
         )
         return {"loss": lm, "perplexity": jnp.exp(lm), "aux_loss": aux}
 

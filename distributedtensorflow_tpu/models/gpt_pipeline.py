@@ -315,8 +315,10 @@ class PipelinedGPT:
         x = self._ln_f.apply({"params": params["ln_f"]}, x)
         if return_hidden:
             return x  # loss applies the chunked head (ops/xent.py)
+        from ..ops.xent import tied_head_logits
+
         wte = params["wte"]["embedding"]
-        return (x @ wte.T.astype(jnp.float32)).astype(jnp.float32)
+        return tied_head_logits(x, wte, self.cfg.dtype)
 
     def bubble_fraction(self) -> float:
         if self.n_virtual > 1:
@@ -340,6 +342,7 @@ def pipelined_lm_loss(model: PipelinedGPT):
             hidden[:, :-1],
             params["wte"]["embedding"],
             batch["input_ids"][:, 1:],
+            compute_dtype=model.cfg.dtype,
         )
         return loss, ({"perplexity": jnp.exp(loss)}, model_state)
 
@@ -359,6 +362,7 @@ def pipelined_lm_eval(model: PipelinedGPT):
             hidden[:, :-1],
             params["wte"]["embedding"],
             batch["input_ids"][:, 1:],
+            compute_dtype=model.cfg.dtype,
         )
         return {"loss": loss, "perplexity": jnp.exp(loss)}
 

@@ -33,6 +33,26 @@ from jax import lax
 DEFAULT_CHUNK_TOKENS = 4096
 
 
+def tied_head_logits(
+    x: jax.Array,    # (..., D) hidden states (fp32 post-ln_f)
+    wte: jax.Array,  # (V, D) tied embedding table
+    compute_dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """Full logits for a tied-embedding head, fp32 output.
+
+    THE dtype recipe for every vocab matmul in the framework — operands in
+    ``compute_dtype`` (bf16 = full MXU rate; an fp32 x fp32 vocab matmul
+    runs at a fraction of it), fp32 accumulation via
+    ``preferred_element_type``.  :func:`chunked_softmax_xent` uses the
+    identical path per chunk, so the dense and chunked heads agree; model
+    files must call this rather than hand-rolling the matmul."""
+    dt = compute_dtype or jnp.result_type(x, wte)
+    return jnp.matmul(
+        x.astype(dt), wte.T.astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def chunked_softmax_xent(
     hidden: jax.Array,   # (B, S, D) final hidden states (post-ln_f)
     wte: jax.Array,      # (V, D) tied embedding / output head
@@ -40,6 +60,7 @@ def chunked_softmax_xent(
     mask: jax.Array | None = None,  # (B, S) 1 = count this position
     *,
     chunk_tokens: int = DEFAULT_CHUNK_TOKENS,
+    compute_dtype: jnp.dtype | None = None,
 ) -> jax.Array:
     """Mean masked next-token NLL without materializing full logits.
 
@@ -70,14 +91,23 @@ def chunked_softmax_xent(
         t = jnp.pad(t, (0, pad))
         w = jnp.pad(w, (0, pad))  # padded rows weigh 0
 
+    # compute_dtype picks the MATMUL operand dtype for the (C, V) logits
+    # tile; accumulation/reductions stay fp32 via preferred_element_type.
+    # Pass the model's compute dtype (bf16) here: hidden arrives fp32 from
+    # the fp32 ln_f, and an fp32 x fp32 matmul runs at a fraction of the
+    # MXU's bf16 rate — on the v5e this head was the single largest cost
+    # of the GPT-2-small step (the 50k-vocab matmul is ~30% of model
+    # FLOPs).  None = the operands' own dtypes (exact-parity tests).
+    op_dtype = compute_dtype or jnp.result_type(hidden, wte)
+    wte_t = wte.T.astype(op_dtype)
+
     def body(carry, inp):
         nll_sum, w_sum = carry
         x_c, t_c, w_c = inp
-        # Same dtype path as the naive head: fp32 operands (XLA picks the
-        # MXU-friendly internal precision), fp32 reductions.
-        logits = (
-            x_c.astype(jnp.float32) @ wte.T.astype(jnp.float32)
-        )  # (C, V)
+        logits = jnp.matmul(
+            x_c.astype(op_dtype), wte_t,
+            preferred_element_type=jnp.float32,
+        )  # (C, V) fp32
         lse = jax.nn.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, t_c[:, None], axis=1)[:, 0]
         nll = lse - tgt

@@ -268,3 +268,30 @@ def test_chunked_xent_out_of_range_targets_zero_weight():
     assert float(chunked_softmax_xent(
         hidden, wte, jnp.full((2, 6), -100, jnp.int32), jnp.asarray(mask)
     )) == 0.0
+
+
+def test_chunked_xent_bf16_compute_dtype_close_to_fp32():
+    """compute_dtype=bf16 (the training configs' head path: bf16 operand
+    matmul, fp32 accumulation via preferred_element_type) stays within
+    bf16 rounding of the fp32 head, and its grads are finite."""
+    from distributedtensorflow_tpu.ops.xent import chunked_softmax_xent
+
+    r = np.random.default_rng(11)
+    hidden = jnp.asarray(r.normal(size=(2, 32, 64)), jnp.float32)
+    wte = jnp.asarray(r.normal(size=(211, 64)), jnp.float32)
+    targets = jnp.asarray(r.integers(0, 211, (2, 32)), jnp.int32)
+
+    f32 = chunked_softmax_xent(hidden, wte, targets, chunk_tokens=16)
+    bf16 = chunked_softmax_xent(hidden, wte, targets, chunk_tokens=16,
+                                compute_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(float(bf16), float(f32), rtol=2e-2)
+
+    grads = jax.grad(
+        lambda h, w: chunked_softmax_xent(
+            h, w, targets, chunk_tokens=16, compute_dtype=jnp.bfloat16
+        ),
+        argnums=(0, 1),
+    )(hidden, wte)
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g)))
+        assert float(jnp.max(jnp.abs(g))) > 0.0

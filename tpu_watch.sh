@@ -34,6 +34,15 @@ while true; do
     # long-context configs: flash attention auto-dispatches at 4k+ seq
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    # profile a real LM train step on the chip (VERDICT r3 #1: the
+    # throughput gap needs profile-backed evidence of where time goes)
+    if [ ! -d BENCH_RESULTS/profile_lm_tpu ]; then
+      timeout 900 python train.py --workload gpt_lm --steps 25 \
+        --batch-size 16 --seq-len 1024 --remat off \
+        --profile-dir BENCH_RESULTS/profile_lm_tpu --profile-start 8 \
+        --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
+        || rm -rf BENCH_RESULTS/profile_lm_tpu
+    fi
     BENCH_SKIP_PROBE=1 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || ok=0
     BENCH_SKIP_PROBE=1 BENCH_BERT_BATCH=32 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
