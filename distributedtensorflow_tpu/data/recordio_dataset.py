@@ -81,10 +81,15 @@ def record_dataset(
     decode_fn: Callable[[bytes], Example] = decode_example,
     shuffle_buffer: int = 0,
     seed: int = 0,
-    num_threads: int = 4,
+    num_threads: int | None = None,
     drop_remainder: bool = True,
 ) -> Iterator[Example]:
     """Stream batches from record files, sharded per host.
+
+    ``num_threads=None`` (the default) gates reader threads on the host:
+    ``min(4, cpu_count)`` — on a 1-core host extra reader threads only add
+    contention (measured: 4t slower than 1t, bench_input.py).  Pass an
+    explicit value to force it (e.g. for file interleaving semantics).
 
     Yields dicts of stacked arrays with a leading ``batch_size`` dim (the
     per-host batch; pass ``ctx.per_host_batch_size`` upstream).  With
@@ -97,6 +102,13 @@ def record_dataset(
     files = list(files)
     if not files:
         raise ValueError("record_dataset needs at least one file")
+    if num_threads is None:
+        from ..native.recordio import available_cpus
+
+        # CPUs this PROCESS may use (affinity/cgroup-aware), not the
+        # machine's core count — a container pinned to 1 CPU on a 64-core
+        # host must not spawn 4 contending readers.
+        num_threads = max(1, min(4, available_cpus()))
     n_hosts = ctx.num_input_pipelines if ctx else 1
     host = ctx.input_pipeline_id if ctx else 0
     policy = _resolve_policy(policy, len(files), n_hosts)

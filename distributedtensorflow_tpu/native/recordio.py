@@ -66,6 +66,19 @@ class RecordCorruptionError(IOError):
     """A record failed CRC verification or had broken framing."""
 
 
+def available_cpus() -> int:
+    """CPUs THIS PROCESS may use — affinity/cgroup-aware where the OS
+    exposes it (``sched_getaffinity``), else ``cpu_count``.  The single
+    definition behind reader-thread defaults and the bench's
+    ``hw_concurrency`` field, so the two cannot disagree."""
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
 class RecordReader:
     """Iterates records from many files with C++ reader threads.
 
@@ -87,6 +100,12 @@ class RecordReader:
     one batch later than the corrupt record itself, after earlier records
     in that window were already yielded.  The trade buys the ~5x
     batched-FFI throughput win over per-record ctypes calls.
+
+    Shards must be IMMUTABLE while a reader is open: regular files are
+    mmap-ed for speed, and a concurrent truncation faults (SIGBUS) the
+    process instead of surfacing a read error.  (Appending a new shard
+    file alongside is fine; rewriting one being read is not — the same
+    contract as the reference's record readers.)
     """
 
     def __init__(
@@ -164,6 +183,53 @@ class RecordReader:
         self._pending = out
         self._pending_ix = 1
         return out[0]
+
+    def read_batches(self):
+        """Yield ``(payload, lengths)`` batch VIEWS — the zero-copy path.
+
+        ``payload`` is a uint8 numpy view over the C batch buffer
+        (concatenated record bytes); ``lengths`` a uint64 numpy view of
+        per-record lengths (offsets = ``np.cumsum(lengths)``).  One FFI
+        round-trip per producer batch (~256 records) and **no per-record
+        Python object creation** — on a single core the per-record
+        ``bytes`` construction is what pins the iterator API at
+        pure-Python speed (bench_input.py), so fixed-shape/tokenized
+        consumers that can slice numpy views should use this.
+
+        Both views alias memory that is FREED when the generator advances
+        or closes — copy (``payload.copy()``) anything that must outlive
+        the iteration step.  Do not interleave with the per-record
+        iterator on the same reader: both consume the same stream.
+        """
+        import numpy as np
+
+        lib = self._lib
+        while self._h is not None:
+            buf = ctypes.POINTER(ctypes.c_uint8)()
+            lens = ctypes.POINTER(ctypes.c_uint64)()
+            # exact producer bounds -> every pull is a whole-batch handoff
+            n = lib.dtf_reader_next_packed(
+                self._h, ctypes.byref(buf), ctypes.byref(lens),
+                lib.dtf_reader_batch_records(),
+                lib.dtf_reader_batch_bytes(),
+            )
+            if n == 0:
+                self.close()
+                return
+            if n == -2:
+                self.close()
+                raise RecordCorruptionError(
+                    "corrupt record encountered (bad CRC or framing)"
+                )
+            try:
+                lengths = np.ctypeslib.as_array(lens, shape=(n,))
+                payload = np.ctypeslib.as_array(
+                    buf, shape=(int(lengths.sum()),)
+                )
+                yield payload, lengths
+            finally:
+                lib.dtf_free(buf)
+                lib.dtf_free(lens)
 
     def close(self) -> None:
         if self._h is not None:

@@ -25,6 +25,11 @@
 //
 // Exposed as a flat C ABI for ctypes (no pybind11 in this image).
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -149,93 +154,172 @@ class Writer {
   FILE* f_;
 };
 
-// Reads one file sequentially, packing records into batches and pushing
-// them into the shared queue.  Returns false on framing/CRC corruption.
+// Verifies a 12-byte record header; writes the payload length to *len.
+// Returns false on a bad length CRC or an insane length.
+inline bool check_header(const uint8_t* hdr, bool verify_crc, uint64_t* len) {
+  memcpy(len, hdr, 8);
+  if (verify_crc) {
+    uint32_t lc;
+    memcpy(&lc, hdr + 8, 4);
+    if (crc32c_mask(crc32c(0, hdr, 8)) != lc) return false;
+  }
+  // 1 GiB sanity cap: a corrupt length field would otherwise drive a
+  // multi-exabyte allocation.
+  return *len <= (1ull << 30);
+}
+
+inline bool check_payload(const uint8_t* data, uint64_t len, uint32_t dc,
+                          bool verify_crc) {
+  return !verify_crc || crc32c_mask(crc32c(0, data, len)) == dc;
+}
+
+// Assembles verified records into producer batches and pushes them into
+// the queue — THE single batch-packing implementation, shared by the mmap
+// and stdio paths so their bounds semantics cannot diverge.  A batch is
+// pushed once appending would exceed kBatchBytes (or kBatchRecords); a
+// single record larger than kBatchBytes ships as its own oversized batch.
+class BatchBuilder {
+ public:
+  explicit BatchBuilder(BoundedQueue* q) : q_(q) {}
+  ~BatchBuilder() { free_batch(&b_); }  // no-op when shipped/flushed
+
+  // 1 = ok, 0 = consumer gone (stop quietly), -1 = alloc failure.
+  int append(const uint8_t* data, uint64_t len) {
+    if (b_.buf == nullptr) {
+      if (!start(len)) return -1;
+    } else if (used_ + len > cap_ || b_.count >= kBatchRecords) {
+      int fr = flush();
+      if (fr <= 0) return fr;
+      if (!start(len)) return -1;
+    }
+    if (len) memcpy(b_.buf + used_, data, len);
+    b_.lens[b_.count++] = len;
+    used_ += len;
+    return 1;
+  }
+
+  // Push any partial batch.  1 = ok/nothing to do, 0 = consumer gone.
+  int flush() {
+    if (b_.count == 0) return 1;
+    bool pushed = q_->push(b_);  // push frees the batch when closed
+    b_ = Batch{};
+    used_ = cap_ = 0;
+    return pushed ? 1 : 0;
+  }
+
+ private:
+  bool start(uint64_t first_len) {
+    cap_ = kBatchBytes > first_len ? kBatchBytes : first_len;
+    b_.buf = static_cast<uint8_t*>(malloc(cap_ ? cap_ : 1));
+    b_.lens = static_cast<uint64_t*>(malloc(kBatchRecords * sizeof(uint64_t)));
+    b_.count = 0;
+    used_ = 0;
+    if (b_.buf == nullptr || b_.lens == nullptr) {
+      free_batch(&b_);
+      return false;
+    }
+    return true;
+  }
+
+  BoundedQueue* q_;
+  Batch b_{};
+  uint64_t used_ = 0, cap_ = 0;
+};
+
+// Parse records from a contiguous in-memory range (the mmap fast path):
+// batches are assembled DIRECTLY into their final malloc'd buffers — one
+// memcpy per record total (the stdio path below pays file->vector->batch,
+// i.e. two).  Returns false on framing/CRC corruption or alloc failure.
+bool read_range(const uint8_t* p, const uint8_t* end, bool verify_crc,
+                BoundedQueue* q) {
+  BatchBuilder builder(q);
+  while (p < end) {
+    uint64_t len;
+    if (end - p < 12 || !check_header(p, verify_crc, &len) ||
+        static_cast<uint64_t>(end - p - 12) < len + 4) {
+      return false;  // truncated/corrupt framing
+    }
+    const uint8_t* data = p + 12;
+    uint32_t dc;
+    memcpy(&dc, data + len, 4);
+    if (!check_payload(data, len, dc, verify_crc)) return false;
+    int ar = builder.append(data, len);
+    if (ar < 0) return false;   // alloc failure: poison, not clean EOF
+    if (ar == 0) return true;   // consumer gone: stop quietly
+    p = data + len + 4;
+  }
+  return builder.flush() >= 0;  // 0 (consumer gone) is still a quiet stop
+}
+
+// Reads one file via mmap (falling back to stdio when mmap is not
+// possible), pushing packed batches into the shared queue.  Returns false
+// on framing/CRC corruption.
+bool read_file_stdio(const std::string& path, bool verify_crc,
+                     BoundedQueue* q);
+
 bool read_file(const std::string& path, bool verify_crc, BoundedQueue* q) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return false;
+  }
+  // Only regular files are mmap-able with a trustworthy st_size: a pipe /
+  // device reports size 0 (its stream would be silently dropped as a
+  // clean EOF).  NOTE the documented contract (recordio.py): shards must
+  // be immutable while readers are open — truncating a mapped regular
+  // file mid-read raises SIGBUS (process-fatal), where the stdio path
+  // would surface an ordinary read error.
+  if (!S_ISREG(st.st_mode)) {
+    close(fd);
+    return read_file_stdio(path, verify_crc, q);
+  }
+  size_t size = static_cast<size_t>(st.st_size);
+  if (size == 0) {
+    close(fd);
+    return true;
+  }
+  void* map = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (map == MAP_FAILED) return read_file_stdio(path, verify_crc, q);
+  madvise(map, size, MADV_SEQUENTIAL);
+  const uint8_t* base = static_cast<const uint8_t*>(map);
+  bool ok = read_range(base, base + size, verify_crc, q);
+  munmap(map, size);
+  return ok;
+}
+
+// stdio fallback (pipes, devices, failed mmaps): streams records through a
+// scratch buffer into the SAME BatchBuilder/framing helpers as the mmap
+// path — only the byte source differs.  Returns false on corruption.
+bool read_file_stdio(const std::string& path, bool verify_crc,
+                     BoundedQueue* q) {
   // RAII: vector resizes below may throw bad_alloc (caught by the worker
   // thread); the FILE* must not leak on that path.
   std::unique_ptr<FILE, int (*)(FILE*)> holder(fopen(path.c_str(), "rb"),
                                                fclose);
   FILE* f = holder.get();
   if (!f) return false;
-  bool ok = true;
-  std::vector<uint8_t> payload;
-  std::vector<uint64_t> lens;
-  payload.reserve(kBatchBytes);
-  lens.reserve(kBatchRecords);
-
-  // 1 = flushed (or nothing to flush), 0 = reader closed (stop quietly),
-  // -1 = allocation failure (caller must poison the stream — silently
-  // dropping the tail would read as a clean EOF).
-  auto flush = [&]() -> int {
-    if (lens.empty()) return 1;
-    Batch b;
-    b.count = static_cast<int64_t>(lens.size());
-    b.buf = static_cast<uint8_t*>(malloc(payload.empty() ? 1 : payload.size()));
-    b.lens = static_cast<uint64_t*>(malloc(lens.size() * sizeof(uint64_t)));
-    if (b.buf == nullptr || b.lens == nullptr) {
-      free_batch(&b);
-      return -1;
-    }
-    if (!payload.empty()) memcpy(b.buf, payload.data(), payload.size());
-    memcpy(b.lens, lens.data(), lens.size() * sizeof(uint64_t));
-    payload.clear();
-    lens.clear();
-    return q->push(b) ? 1 : 0;
-  };
-
+  BatchBuilder builder(q);
+  std::vector<uint8_t> scratch;
   for (;;) {
     uint8_t hdr[12];
     size_t n = fread(hdr, 1, 12, f);
     if (n == 0) break;  // clean EOF
-    if (n != 12) {
-      ok = false;
-      break;
-    }
     uint64_t len;
-    memcpy(&len, hdr, 8);
-    if (verify_crc) {
-      uint32_t lc;
-      memcpy(&lc, hdr + 8, 4);
-      if (crc32c_mask(crc32c(0, hdr, 8)) != lc) {
-        ok = false;
-        break;
-      }
-    }
-    // 1 GiB sanity cap: a corrupt length field would otherwise drive a
-    // multi-exabyte malloc.
-    if (len > (1ull << 30)) {
-      ok = false;
-      break;
-    }
-    size_t off = payload.size();
-    payload.resize(off + len);
-    if (len && fread(payload.data() + off, 1, len, f) != len) {
-      ok = false;
-      break;
-    }
+    if (n != 12 || !check_header(hdr, verify_crc, &len)) return false;
+    scratch.resize(len ? len : 1);
+    if (len && fread(scratch.data(), 1, len, f) != len) return false;
     uint32_t dc;
-    if (fread(&dc, 1, 4, f) != 4) {
-      ok = false;
-      break;
-    }
-    if (verify_crc &&
-        crc32c_mask(crc32c(0, payload.data() + off, len)) != dc) {
-      ok = false;
-      break;
-    }
-    lens.push_back(len);
-    if (static_cast<int64_t>(lens.size()) >= kBatchRecords ||
-        payload.size() >= kBatchBytes) {
-      int fr = flush();
-      if (fr <= 0) {
-        if (fr < 0) ok = false;  // alloc failure = poisoned, not clean EOF
-        break;
-      }
-    }
+    if (fread(&dc, 1, 4, f) != 4) return false;
+    if (!check_payload(scratch.data(), len, dc, verify_crc)) return false;
+    int ar = builder.append(scratch.data(), len);
+    if (ar < 0) return false;  // alloc failure: poison, not clean EOF
+    if (ar == 0) return true;  // consumer gone: stop quietly
   }
-  if (ok && flush() < 0) ok = false;  // final partial batch
-  return ok;
+  builder.flush();
+  return true;
 }
 
 class Reader {

@@ -264,3 +264,45 @@ def test_reader_batched_pull_matches_streaming(tmp_path):
             w.write(r)
     got = list(RecordReader([str(path)], num_threads=1))
     assert got == records
+
+
+def test_read_batches_zero_copy_api(tmp_path):
+    """read_batches() yields (payload, lengths) views whose concatenated
+    slices equal the per-record stream, including empty records."""
+    paths, expected = _write_shards(tmp_path, n_files=2)
+    # an explicit empty-record shard exercises the len==0 branches of the
+    # mmap batch assembly and zero-length view slicing
+    p_empty = str(tmp_path / "empty_recs.rec")
+    with RecordWriter(p_empty) as w:
+        for rec in (b"", b"tail", b""):
+            w.write(rec)
+    paths = list(paths) + [p_empty]
+    expected = list(expected) + [b"", b"tail", b""]
+    got = []
+    for payload, lengths in RecordReader(paths, num_threads=1).read_batches():
+        off = 0
+        for n in lengths:
+            n = int(n)
+            got.append(payload[off:off + n].tobytes())
+            off += n
+        assert off == payload.shape[0]
+    assert got == expected  # single-threaded order is deterministic
+
+
+def test_read_batches_reports_corruption(tmp_path):
+    import pytest
+
+    from distributedtensorflow_tpu.native.recordio import (
+        RecordCorruptionError,
+    )
+
+    p = str(tmp_path / "c.rec")
+    with RecordWriter(p) as w:
+        for i in range(600):
+            w.write(f"rec{i}".encode() * 20)
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF  # flip one payload byte mid-file
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(RecordCorruptionError):
+        for _ in RecordReader([p], verify_crc=True).read_batches():
+            pass
