@@ -31,6 +31,10 @@ while true; do
     # also record bs32 attention-only-remat (2x batch, ~5% recompute)
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=16 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || ok=0
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    # round-3 candidates: bf16 CE head lands for all; pallas backward
+    # stores no (S,S) tensors, so bs32 may fit remat-free; bs24 middle
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=24 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
     # long-context configs: flash attention auto-dispatches at 4k+ seq
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
