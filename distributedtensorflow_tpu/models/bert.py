@@ -13,6 +13,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import flax.linen as nn
 import jax
@@ -90,7 +91,16 @@ class TransformerBlock(nn.Module):
 
 
 class BertEncoder(nn.Module):
+    """Embedding stack + block stack.
+
+    ``block_fn`` (layer index -> block module) lets variants swap blocks
+    without re-implementing the embedding stack — e.g. BERT-MoE
+    (models/bert_moe.py) interleaves routed-expert blocks.  A block may
+    return ``(x, aux)`` (aux losses are summed) or plain ``x``;
+    ``__call__`` always returns ``(x, aux_total)``."""
+
     cfg: BertConfig
+    block_fn: Any = None  # Callable[[int], nn.Module] | None
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
@@ -118,11 +128,30 @@ class BertEncoder(nn.Module):
         mask = None
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.num_layers):
-            x = TransformerBlock(cfg, name=f"layer_{i}")(
-                x, mask, deterministic, segment_ids
-            )
-        return x
+            block = (self.block_fn(i) if self.block_fn is not None
+                     else TransformerBlock(cfg, name=f"layer_{i}"))
+            out = block(x, mask, deterministic, segment_ids)
+            if isinstance(out, tuple):
+                x, aux = out
+                aux_total = aux_total + aux
+            else:
+                x = out
+        return x, aux_total
+
+
+def mlm_head(cfg: BertConfig, x, masked_positions=None):
+    """Transform + LayerNorm + vocab projection — call from inside a
+    parent module's ``@nn.compact`` (submodules attach to the caller).
+    The single MLM-head definition shared by BertForMLM and the MoE
+    variant so head changes cannot diverge."""
+    if masked_positions is not None:
+        x = jnp.take_along_axis(x, masked_positions[..., None], axis=1)
+    x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+    x = nn.gelu(x)
+    x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
+    return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_out")(x)
 
 
 class BertForMLM(nn.Module):
@@ -142,15 +171,9 @@ class BertForMLM(nn.Module):
         way."""
         cfg = self.cfg
         encoder = BertEncoder(cfg, name="encoder")
-        x = encoder(input_ids, token_type_ids, attention_mask, deterministic,
-                    segment_ids, position_ids)
-        if masked_positions is not None:
-            x = jnp.take_along_axis(x, masked_positions[..., None], axis=1)
-        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
-        x = nn.gelu(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
-        x = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_out")(x)
-        return x
+        x, _ = encoder(input_ids, token_type_ids, attention_mask,
+                       deterministic, segment_ids, position_ids)
+        return mlm_head(cfg, x, masked_positions)
 
 
 def max_predictions_for(seq_len: int) -> int:
@@ -210,6 +233,8 @@ def _mlm_metrics(model: BertForMLM, max_predictions: int | None,
         )  # (B, S, V)
         safe_labels = jnp.where(valid, labels, 0)
         w = valid.astype(jnp.float32)
+    if isinstance(logits, tuple):  # MoE encoders return (logits, router aux)
+        logits, extra["moe_aux_loss"] = logits
     per_tok = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), safe_labels
     )

@@ -21,15 +21,20 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from ..parallel import mesh as mesh_lib
-from ..parallel.moe import local_moe, make_moe_fn
+from ..parallel.moe import local_moe
 from ..parallel.sharding import LayoutMap
 from .gpt import CausalSelfAttention, GPTBlock, GPTConfig, gpt_layout
 
 PyTree = Any
-MoEFn = Callable[[jax.Array, jax.Array, PyTree], tuple[jax.Array, jax.Array]]
+#: (tokens (T, d), router_kernel (d, E), expert_params, token_mask (T,)
+#: or None) -> (out (T, d), aux loss) — the dispatch-region contract
+#: produced by ``parallel.moe.make_moe_fn``.
+MoEFn = Callable[
+    [jax.Array, jax.Array, PyTree, "jax.Array | None"],
+    tuple[jax.Array, jax.Array],
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,7 +76,12 @@ class MoEMLP(nn.Module):
     moe_fn: MoEFn | None = None
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    def __call__(self, x: jax.Array,
+                 token_mask: jax.Array | None = None
+                 ) -> tuple[jax.Array, jax.Array]:
+        """``token_mask`` (B, S): 1 = real token — pads neither consume
+        expert capacity nor dilute the aux loss (see parallel/moe.py
+        routers).  None = all tokens real (the causal-LM presets)."""
         cfg = self.cfg
         router = self.param(
             "router", nn.initializers.normal(0.02),
@@ -91,12 +101,14 @@ class MoEMLP(nn.Module):
         }
         b, s, d = x.shape
         tokens = x.reshape(b * s, d)
+        tmask = None if token_mask is None else token_mask.reshape(b * s)
         if self.moe_fn is not None:
-            out, aux = self.moe_fn(tokens, router, experts)
+            out, aux = self.moe_fn(tokens, router, experts, tmask)
         else:
             out, aux = local_moe(
                 tokens, router, experts, _expert_mlp,
                 capacity_factor=cfg.capacity_factor, router=cfg.router,
+                token_mask=tmask,
             )
         return out.reshape(b, s, d), aux
 
@@ -231,25 +243,16 @@ def moe_lm_eval(model: GPTMoELM):
 
 
 def gpt_moe_layout() -> LayoutMap:
-    """gpt_layout + expert-axis sharding for the expert stacks; the router
-    is tiny and stays replicated."""
-    rules = LayoutMap([
-        (r".*moe_mlp/experts_in", P("expert", None, None)),
-        (r".*moe_mlp/experts_out", P("expert", None, None)),
-        (r".*moe_mlp/router", P()),
-    ])
-    for pat, spec in gpt_layout()._rules:
-        rules._rules.append((pat, spec))
-    return rules
+    """gpt_layout + the shared expert-parallel MoE rules (the router is
+    tiny and stays replicated)."""
+    from ..parallel.moe import with_moe_layout
+
+    return with_moe_layout(gpt_layout())
 
 
 def bind_expert_parallel(cfg: GPTMoEConfig, mesh: Mesh) -> GPTMoELM:
     """Build the model with the expert-parallel shard_map region when the
     mesh has a real ``expert`` axis; local (replicated) experts otherwise."""
-    if dict(mesh.shape).get(mesh_lib.AXIS_EXPERT, 1) > 1:
-        moe_fn = make_moe_fn(
-            mesh, _expert_mlp,
-            capacity_factor=cfg.capacity_factor, router=cfg.router,
-        )
-        return GPTMoELM(cfg, moe_fn)
-    return GPTMoELM(cfg, None)
+    from ..parallel.moe import bind_expert_parallel_model
+
+    return bind_expert_parallel_model(cfg, mesh, GPTMoELM, _expert_mlp)

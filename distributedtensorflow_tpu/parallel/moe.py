@@ -33,9 +33,23 @@ def _capacity_slots(pos: jax.Array, mask: jax.Array, capacity: int) -> jax.Array
     return keep[..., None] * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
 
 
+def _masked_fracs(assign: jax.Array, probs: jax.Array,
+                  token_mask: jax.Array | None):
+    """(frac_tokens, frac_probs) per expert, averaged over VALID tokens
+    only — with padding present, pads must not dilute the aux loss."""
+    if token_mask is None:
+        return jnp.mean(assign, axis=0), jnp.mean(probs, axis=0)
+    w = token_mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    # assign is already zeroed at pad rows by the caller
+    return jnp.sum(assign, axis=0) / denom, \
+        jnp.sum(probs * w[:, None], axis=0) / denom
+
+
 def top1_route(
     logits: jax.Array,  # (T, E) router logits
     capacity: int,
+    token_mask: jax.Array | None = None,  # (T,) 1 = real token, 0 = pad
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Top-1 routing with capacity (Switch Transformer recipe).
 
@@ -43,19 +57,23 @@ def top1_route(
     - dispatch: (T, E, C) one-hot — token t occupies slot c of expert e;
     - combine: (T, E, C) — dispatch weighted by the router probability;
     - aux_loss: scalar load-balancing loss (mean_frac_tokens · mean_probs · E).
+
+    ``token_mask`` excludes padding: pad tokens consume NO capacity slot
+    (they ride the residual path) and do not dilute the aux-loss means.
     """
     t, e = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
     expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
+    if token_mask is not None:
+        expert_onehot = expert_onehot * token_mask.astype(jnp.float32)[:, None]
     # position of each token within its expert's queue
     pos_in_expert = jnp.cumsum(expert_onehot, axis=0) * expert_onehot  # 1-based
     dispatch = _capacity_slots(pos_in_expert, expert_onehot, capacity)
     gate = jnp.sum(probs * expert_onehot, axis=-1, keepdims=True)  # (T, 1)
     combine = dispatch * gate[..., None]
     # Switch aux loss: encourages uniform token/prob mass over experts
-    frac_tokens = jnp.mean(expert_onehot, axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
+    frac_tokens, frac_probs = _masked_fracs(expert_onehot, probs, token_mask)
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return dispatch, combine, aux
 
@@ -63,13 +81,15 @@ def top1_route(
 def top2_route(
     logits: jax.Array,  # (T, E) router logits
     capacity: int,
+    token_mask: jax.Array | None = None,  # (T,) 1 = real token, 0 = pad
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Top-2 routing with capacity (GShard recipe).
 
     Each token goes to its two highest-probability experts; the two gates
     are renormalized to sum to 1.  Top-2 assignments queue AFTER all top-1
     assignments per expert (GShard's priority rule: second choices only
-    take leftover capacity).  Same return contract as :func:`top1_route`.
+    take leftover capacity).  Same return contract (and the same
+    pad-exclusion semantics for ``token_mask``) as :func:`top1_route`.
     """
     t, e = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -78,6 +98,9 @@ def top2_route(
     probs2 = probs * (1.0 - mask1)
     idx2 = jnp.argmax(probs2, axis=-1)
     mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+    if token_mask is not None:
+        w = token_mask.astype(jnp.float32)[:, None]
+        mask1, mask2 = mask1 * w, mask2 * w
 
     g1 = jnp.sum(probs * mask1, axis=-1)
     g2 = jnp.sum(probs * mask2, axis=-1)
@@ -95,8 +118,7 @@ def top2_route(
     dispatch = d1 + d2
     combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
     # GShard aux loss over the FIRST choice (same form as Switch).
-    frac_tokens = jnp.mean(mask1, axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
+    frac_tokens, frac_probs = _masked_fracs(mask1, probs, token_mask)
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return dispatch, combine, aux
 
@@ -104,6 +126,7 @@ def top2_route(
 def expert_choice_route(
     logits: jax.Array,  # (T, E) router logits
     capacity: int,
+    token_mask: jax.Array | None = None,  # (T,) 1 = real token, 0 = pad
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Expert-choice routing (Zhou et al. 2022): each EXPERT selects its
     top-``capacity`` tokens by router probability — the inverted assignment.
@@ -118,15 +141,29 @@ def expert_choice_route(
     **Not causal**: whether token t is selected depends on every other
     token's router score — including future positions.  Use only in
     encoder / non-autoregressive settings (the EC paper's domain);
-    ``models/gpt_moe.py`` rejects it for the causal LM.
+    ``models/gpt_moe.py`` rejects it for the causal LM —
+    ``models/bert_moe.py`` is the encoder workload that uses it.
+
+    **Pool semantics under expert parallelism**: inside ``make_moe_fn``'s
+    shard_map region each token SHARD routes its own pool, so the top-k
+    selection is per-shard (the EC paper's per-device setting), not a
+    global top-k — EC outputs are therefore layout-DEPENDENT by design,
+    unlike the per-token top1/top2 routers.
     """
     t, e = logits.shape
     capacity = min(capacity, t)  # an expert cannot pick more tokens than exist
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+    if token_mask is not None:
+        # pads rank strictly below every real token (softmax probs are
+        # strictly positive); any pad that still lands in a top-k (more
+        # capacity than real tokens) is zeroed via the keep mask below.
+        w = token_mask.astype(jnp.float32)[:, None]
+        probs = probs * w - (1.0 - w)
     gates, token_idx = jax.lax.top_k(probs.T, capacity)  # (E, C) both
-    dispatch = jax.nn.one_hot(token_idx, t, dtype=jnp.float32)  # (E, C, T)
+    keep = (gates > 0.0).astype(jnp.float32)  # (E, C)
+    dispatch = jax.nn.one_hot(token_idx, t, dtype=jnp.float32) * keep[..., None]
     dispatch = dispatch.transpose(2, 0, 1)  # (T, E, C)
-    combine = dispatch * gates[None, :, :]
+    combine = dispatch * jnp.maximum(gates, 0.0)[None, :, :]
     return dispatch, combine, jnp.zeros((), jnp.float32)
 
 
@@ -149,6 +186,7 @@ def expert_parallel_moe(
     axis_name: str = mesh_lib.AXIS_EXPERT,
     capacity_factor: float = 1.25,
     router: str = "top1",
+    token_mask: jax.Array | None = None,  # (T,) 1 = real token, 0 = pad
 ) -> tuple[jax.Array, jax.Array]:
     """MoE layer body (shard_map-internal). Returns (out, aux_loss).
 
@@ -177,7 +215,7 @@ def expert_parallel_moe(
     )
 
     logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
-    dispatch, combine, aux = ROUTERS[router](logits, capacity)
+    dispatch, combine, aux = ROUTERS[router](logits, capacity, token_mask)
 
     # (T, E, C) x (T, d) -> (E, C, d): expert-major send buffer
     send = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(jnp.float32))
@@ -238,11 +276,15 @@ def make_moe_fn(
     batch_axes = mesh_lib.data_axes(mesh)
     tok_axes = tuple(batch_axes) + (axis_name,)
 
-    def run(tokens, router_kernel, expert_params):
-        def body(toks, rk, ep):
+    def run(tokens, router_kernel, expert_params, token_mask=None):
+        if token_mask is None:  # keep the shard_map arity static
+            token_mask = jnp.ones((tokens.shape[0],), jnp.float32)
+
+        def body(toks, rk, ep, tmask):
             out, aux = expert_parallel_moe(
                 toks, rk, ep, expert_fn=expert_fn, axis_name=axis_name,
                 capacity_factor=capacity_factor, router=router,
+                token_mask=tmask,
             )
             if batch_axes:  # make the aux loss a true global scalar
                 aux = lax.pmean(aux, batch_axes)
@@ -251,10 +293,10 @@ def make_moe_fn(
         param_specs = jax.tree.map(lambda _: P(axis_name), expert_params)
         return jax.shard_map(
             body, mesh=mesh,
-            in_specs=(P(tok_axes), P(), param_specs),
+            in_specs=(P(tok_axes), P(), param_specs, P(tok_axes)),
             out_specs=(P(tok_axes), P()),
             check_vma=False,
-        )(tokens, router_kernel, expert_params)
+        )(tokens, router_kernel, expert_params, token_mask)
 
     return run
 
@@ -274,6 +316,36 @@ def make_moe_layer(
     ))
 
 
+def with_moe_layout(base) -> "LayoutMap":
+    """``base`` layout rules + the expert-parallel sharding for MoEMLP
+    params (expert stacks over the ``expert`` axis, router replicated) —
+    THE single definition shared by every MoE model's layout."""
+    from .sharding import LayoutMap  # noqa: PLC0415 (avoid cycle at import)
+
+    rules = LayoutMap([
+        (r".*moe_mlp/experts_in", P("expert", None, None)),
+        (r".*moe_mlp/experts_out", P("expert", None, None)),
+        (r".*moe_mlp/router", P()),
+    ])
+    for pat, spec in base._rules:
+        rules._rules.append((pat, spec))
+    return rules
+
+
+def bind_expert_parallel_model(cfg, mesh: Mesh, model_ctor,
+                               expert_fn) -> Any:
+    """``model_ctor(cfg, moe_fn)`` with the all_to_all dispatch region
+    bound when the mesh has a real ``expert`` axis; local (replicated)
+    experts otherwise — the single bind used by every MoE model family."""
+    if dict(mesh.shape).get(mesh_lib.AXIS_EXPERT, 1) > 1:
+        moe_fn = make_moe_fn(
+            mesh, expert_fn,
+            capacity_factor=cfg.capacity_factor, router=cfg.router,
+        )
+        return model_ctor(cfg, moe_fn)
+    return model_ctor(cfg, None)
+
+
 def local_moe(
     tokens: jax.Array,  # (T, d)
     router_kernel: jax.Array,  # (d, E)
@@ -282,6 +354,7 @@ def local_moe(
     *,
     capacity_factor: float = 1.25,
     router: str = "top1",
+    token_mask: jax.Array | None = None,  # (T,) 1 = real token, 0 = pad
 ) -> tuple[jax.Array, jax.Array]:
     """Single-device MoE (no collectives): every expert lives locally.
 
@@ -293,7 +366,7 @@ def local_moe(
     e = router_kernel.shape[-1]
     capacity = max(1, int(t * capacity_factor * _ASSIGNMENTS[router] / e))
     logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
-    dispatch, combine, aux = ROUTERS[router](logits, capacity)
+    dispatch, combine, aux = ROUTERS[router](logits, capacity, token_mask)
     send = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(jnp.float32))
     out = jax.vmap(expert_fn)(expert_params, send.astype(tokens.dtype))
     combined = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))

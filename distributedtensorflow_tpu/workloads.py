@@ -402,6 +402,59 @@ def get_workload(name: str, *, test_size: bool = False,
             layout=gpt_layout(),
             finalize=finalize,
         )
+    if name == "bert_moe":
+        # Encoder MoE with EXPERT-CHOICE routing — the EC router's valid
+        # domain (acausal; gpt_moe rejects it).  Same MLM task/head as
+        # bert_mlm; every other block's MLP is routed over n_experts, and
+        # a mesh with a real `expert` axis gets all_to_all dispatch.
+        from .models.bert_moe import (
+            BertMoEForMLM,
+            bert_moe_base,
+            bert_moe_layout,
+            bert_moe_tiny,
+            bind_expert_parallel_bert,
+            moe_mlm_loss,
+        )
+
+        cfg = bert_moe_tiny() if test_size else bert_moe_base()
+        gbs = global_batch_size or 256
+        seq = seq_len or (128 if test_size else 512)
+        if seq > cfg.max_position:
+            cfg = dataclasses.replace(cfg, max_position=seq)
+        model = BertMoEForMLM(cfg)  # local experts until for_mesh
+        max_p = max_predictions_for(seq)
+
+        def finalize(wl: Workload, mesh) -> Workload:
+            ep_model = bind_expert_parallel_bert(cfg, mesh)
+            if ep_model.moe_fn is None:
+                return wl
+            return dataclasses.replace(
+                wl,
+                model=ep_model,
+                loss_fn=moe_mlm_loss(ep_model, max_predictions=max_p),
+                eval_fn=mlm_eval(ep_model, max_predictions=max_p),
+            )
+
+        return Workload(
+            name=name, model=model,
+            loss_fn=moe_mlm_loss(model, max_predictions=max_p),
+            eval_fn=mlm_eval(model, max_predictions=max_p),
+            make_optimizer=lambda: optax.adamw(1e-4, weight_decay=0.01),
+            input_fn=lambda ctx, seed: synthetic_mlm(
+                ctx, vocab_size=cfg.vocab_size, seq_len=seq, seed=seed
+            ),
+            init_batch={
+                "input_ids": np.zeros((2, seq), np.int32),
+                "labels": np.zeros((2, seq), np.int32),
+                "attention_mask": np.ones((2, seq), np.int32),
+            },
+            init_fn=lambda r: model.init(r, jnp.zeros((2, seq), jnp.int32)),
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),
+            accum_steps=4,
+            layout=bert_moe_layout(),
+            finalize=finalize,
+        )
     if name == "gpt_moe":
         from .models.gpt_moe import (
             GPTMoELM,
@@ -455,12 +508,13 @@ def get_workload(name: str, *, test_size: bool = False,
         )
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
-        "imagenet_resnet50 imagenet_vit bert_mlm bert_mlm_packed widedeep "
-        "gpt_lm gpt_moe"
+        "imagenet_resnet50 imagenet_vit bert_mlm bert_mlm_packed bert_moe "
+        "widedeep gpt_lm gpt_moe"
     )
 
 
 WORKLOADS = (
     "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "imagenet_vit",
-    "bert_mlm", "bert_mlm_packed", "widedeep", "gpt_lm", "gpt_moe",
+    "bert_mlm", "bert_mlm_packed", "bert_moe", "widedeep", "gpt_lm",
+    "gpt_moe",
 )

@@ -1,0 +1,142 @@
+"""BERT-MoE: expert-choice routing end to end (the EC router's valid,
+acausal domain — round-2 advisor: EC shipped with no workload using it).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.models.bert_moe import (
+    BertMoEForMLM,
+    bert_moe_tiny,
+    bind_expert_parallel_bert,
+    moe_mlm_loss,
+)
+from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+
+
+@pytest.fixture()
+def ep_mesh(devices):
+    """data=2 x expert=4 over the 8 virtual devices."""
+    return build_mesh(MeshSpec(data=2, expert=4), devices)
+
+
+def make_batch(b=8, s=32, vocab=1024, seed=0, mask_rate=0.2):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(4, vocab, size=(b, s))
+    mask = rng.random((b, s)) < mask_rate
+    return {
+        "input_ids": np.where(mask, 3, ids).astype(np.int32),
+        "labels": np.where(mask, ids, -100).astype(np.int32),
+        "attention_mask": np.ones((b, s), np.int32),
+    }
+
+
+def test_expert_choice_aux_is_structurally_zero():
+    """EC balance is by construction: aux loss exactly 0 (vs live for
+    top2), and the router still receives gradients through the gates."""
+    cfg = bert_moe_tiny()
+    model = BertMoEForMLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    batch = make_batch()
+    vs = model.init(rng, jnp.asarray(batch["input_ids"]))
+
+    loss_fn = moe_mlm_loss(model, max_predictions=8)
+    (loss, (metrics, _)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(vs["params"], {}, batch, rng)
+    assert float(metrics["moe_aux_loss"]) == 0.0
+    assert np.isfinite(float(loss))
+    router_g = grads["encoder"]["layer_1"]["moe_mlp"]["router"]
+    assert float(jnp.abs(router_g).sum()) > 0.0
+
+    top2 = BertMoEForMLM(dataclasses.replace(cfg, router="top2"))
+    vs2 = top2.init(rng, jnp.asarray(batch["input_ids"]))
+    _, (m2, _) = moe_mlm_loss(top2, max_predictions=8)(
+        vs2["params"], {}, batch, rng
+    )
+    assert float(m2["moe_aux_loss"]) > 0.0  # live load-balancing loss
+
+
+def test_ec_expert_parallel_matches_per_shard_reference(ep_mesh):
+    """Expert-choice under the all_to_all dispatch selects top-k over each
+    TOKEN SHARD's pool (the EC paper's per-device setting) — so the golden
+    reference is local_moe run independently per shard, not one global
+    pool (which is what makes exact global parity the WRONG oracle for EC,
+    unlike the per-token top1/top2 routers)."""
+    from distributedtensorflow_tpu.models.gpt_moe import _expert_mlp
+    from distributedtensorflow_tpu.parallel.moe import local_moe, make_moe_fn
+
+    rng = np.random.default_rng(0)
+    n_shards, t_shard, d, e, d_ff = 8, 16, 32, 4, 64
+    tokens = jnp.asarray(
+        rng.standard_normal((n_shards * t_shard, d)), jnp.float32
+    )
+    router = jnp.asarray(rng.standard_normal((d, e)) * 0.1, jnp.float32)
+    experts = {
+        "w_in": jnp.asarray(rng.standard_normal((e, d, d_ff)) * 0.05,
+                            jnp.float32),
+        "w_out": jnp.asarray(rng.standard_normal((e, d_ff, d)) * 0.05,
+                             jnp.float32),
+    }
+
+    moe_fn = make_moe_fn(ep_mesh, _expert_mlp, router="expert_choice")
+    out_ep, aux_ep = jax.jit(moe_fn)(tokens, router, experts)
+
+    # per-shard reference: the token dim shards over (data, expert) in
+    # mesh-axis order -> contiguous chunks per (data_idx, expert_idx)
+    chunks = []
+    for k in range(n_shards):
+        chunk = tokens[k * t_shard:(k + 1) * t_shard]
+        out_k, _ = local_moe(chunk, router, experts, _expert_mlp,
+                             router="expert_choice")
+        chunks.append(out_k)
+    np.testing.assert_allclose(
+        np.asarray(out_ep), np.asarray(jnp.concatenate(chunks)),
+        atol=2e-5, rtol=2e-5,
+    )
+    assert abs(float(aux_ep)) < 1e-6  # EC aux is structurally zero
+
+
+def test_workload_trains_on_expert_mesh(ep_mesh):
+    """get_workload('bert_moe').for_mesh(expert mesh) -> EP model, loss
+    falls through the compiled step, EC metrics in the stream."""
+    from distributedtensorflow_tpu.data import device_put_batch
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    wl = get_workload("bert_moe", test_size=True, global_batch_size=16)
+    wl = wl.for_mesh(ep_mesh)
+    assert isinstance(wl.model, BertMoEForMLM)
+    assert wl.model.moe_fn is not None  # expert axis was bound
+
+    import optax
+
+    rng = jax.random.PRNGKey(0)
+    # preset optimizer is pretraining-scale (adamw 1e-4); a 14-step unit
+    # test needs a visible slope, so train with a hotter lr here
+    state, specs = create_sharded_state(
+        wl.init_fn, optax.adamw(3e-3), ep_mesh, rng, rules=wl.layout
+    )
+    # expert stacks shard over the expert axis
+    from jax.sharding import PartitionSpec as P
+
+    spec = jax.tree.leaves_with_path(
+        specs.params, is_leaf=lambda x: isinstance(x, P)
+    )
+    expert_specs = [s for k, s in spec
+                    if "experts_in" in str(k) and isinstance(s, P)]
+    assert expert_specs and all(s[0] == "expert" for s in expert_specs)
+
+    step = make_train_step(wl.loss_fn, ep_mesh, specs)
+    losses = []
+    for i in range(15):
+        batch = device_put_batch(make_batch(b=16, seed=i), ep_mesh)
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    assert "moe_aux_loss" in metrics and "mlm_accuracy" in metrics
+    # mean of last 3 vs first 3: single-step MLM losses are noisy
+    assert sum(losses[-3:]) / 3 < sum(losses[:3]) / 3, losses

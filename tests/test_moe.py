@@ -209,3 +209,47 @@ def test_expert_choice_cross_mesh_machinery(devices):
         assert float(aux) == 0.0
         outs[expert_axis] = np.asarray(out)
     np.testing.assert_allclose(outs[1], outs[4], atol=1e-5, rtol=1e-5)
+
+
+def test_routers_exclude_padding_tokens():
+    """token_mask semantics (round-3 advisor finding): pad tokens must
+    neither consume expert capacity (displacing real tokens) nor dilute
+    the aux-loss means — for all three routers."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedtensorflow_tpu.parallel.moe import (
+        expert_choice_route,
+        top1_route,
+        top2_route,
+    )
+
+    rng = np.random.default_rng(0)
+    t, e, cap = 16, 2, 4
+    logits = jnp.asarray(rng.standard_normal((t, e)) * 2, jnp.float32)
+    # half the tokens are pads, interleaved so pads would often outrank
+    # real tokens if routed
+    mask = jnp.asarray(np.arange(t) % 2 == 0, jnp.float32)
+
+    for route in (top1_route, top2_route, expert_choice_route):
+        dispatch, combine, aux = route(logits, cap, mask)
+        d = np.asarray(dispatch)  # (T, E, C)
+        # every pad row has zero dispatch and zero combine weight
+        pads = np.arange(t)[np.asarray(mask) == 0]
+        assert d[pads].sum() == 0.0, route.__name__
+        assert np.asarray(combine)[pads].sum() == 0.0, route.__name__
+        assert np.isfinite(float(aux))
+
+    # displacement check (the actual bug scenario): with capacity for
+    # every real token, masked top1 dispatches ALL real tokens, while
+    # unmasked routing of the same logits can drop some behind pads.
+    d_masked, _, _ = top1_route(logits, t // 2, mask)
+    reals = np.arange(t)[np.asarray(mask) == 1]
+    assert np.asarray(d_masked)[reals].sum() == len(reals)
+
+    # aux means ignore pads: doubling the pad count must not change aux
+    big_logits = jnp.concatenate([logits, logits])
+    big_mask = jnp.concatenate([mask, jnp.zeros((t,), jnp.float32)])
+    _, _, aux_small = top1_route(logits, cap, mask)
+    _, _, aux_big = top1_route(big_logits, cap, big_mask)
+    np.testing.assert_allclose(float(aux_big), float(aux_small), rtol=1e-6)
