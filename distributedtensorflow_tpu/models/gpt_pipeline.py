@@ -291,11 +291,15 @@ class PipelinedGPT:
             return out.reshape(xl.shape)
 
         # Everything crossing or carried by the partial-manual region is
-        # fp32: jax 0.9's partial-manual shard_map partitioner crashes on
-        # bf16 copies ("invalid binary instruction opcode copy").  Stage
-        # compute is still cfg.dtype (see _stage_fn); the fp32 handoffs are
-        # (mb, S, D) residuals — tiny next to the stage matmuls — and ln_f
-        # upcasts the output anyway.
+        # fp32: jax 0.9's partial-manual shard_map partitioner crashed on
+        # bf16 copies ("invalid binary instruction opcode copy") when the
+        # region composes with GSPMD-auto tensor-parallel kernels inside
+        # (pipe x model).  Plain data x pipe bf16 regions DO compile
+        # (tests/test_jax_workarounds.py pins both facts), but the
+        # boundary dtype is kept uniform across compositions.  Stage
+        # compute is still cfg.dtype (see _stage_fn); the fp32 handoffs
+        # are (mb, S, D) residuals — tiny next to the stage matmuls — and
+        # ln_f upcasts the output anyway.
         # The jit wrapper is load-bearing: partial-manual shard_map has no
         # eager impl path in jax 0.9 (_unmatch_spec only supports
         # all-manual), and grad-of-eager interprets the region the same
