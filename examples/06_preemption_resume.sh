@@ -1,0 +1,50 @@
+#!/bin/bash
+# Preemption + resume, end to end, from record files on disk — the
+# fault-tolerance loop a preemptible-VM / Borg-evicted training job runs:
+#
+#   records -> native reader -> train w/ periodic checkpoints
+#     -> SIGTERM (the platform's preemption notice)
+#     -> cluster-consistent save at the next step boundary, clean exit
+#     -> SAME command again (the launcher restart)
+#     -> restore + input fast-forward -> accuracy gate fires
+#
+# A recorded instance of exactly this flow (logs + continuous
+# metrics.jsonl across the seam) lives in ARTIFACTS/convergence_mnist_records/.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA=${DATA:-/tmp/preempt_demo_data}
+CKPT=${CKPT:-/tmp/preempt_demo_ckpt}
+rm -rf "$CKPT"
+
+# completeness check on the LAST shard, not the bare directory — an
+# interrupted generation run must not poison later invocations
+[ -s "$DATA/train-00007.rec" ] || { rm -rf "$DATA"; \
+  PYTHONPATH=. python examples/make_records.py \
+    --out "$DATA" --train-examples 8192 --eval-examples 512 --shards 8; }
+
+TRAIN=(env XLA_FLAGS=--xla_force_host_platform_device_count=8
+  python train.py --workload mnist_lenet --device cpu --deterministic
+  --seed 0 --batch-size 64 --steps 2000 --optimizer sgd --lr 0.02
+  --data-dir "$DATA" --eval-data-dir "$DATA/eval" --autoshard AUTO
+  --shuffle-buffer 512 --checkpoint-dir "$CKPT" --checkpoint-every 50
+  --eval-every 100 --target-metric accuracy --target-value 0.97
+  --log-every 25)
+
+echo "=== run 1 (will be preempted) ==="
+"${TRAIN[@]}" &
+PID=$!
+# preempt as soon as a couple of periodic checkpoints exist (wall-clock
+# sleeps are machine-speed-dependent; a fast box can finish first)
+for _ in $(seq 600); do
+  [ -d "$CKPT/150" ] && break
+  kill -0 "$PID" 2>/dev/null || break
+  sleep 0.5
+done
+echo "=== sending SIGTERM (preemption notice) ==="
+kill -TERM "$PID" 2>/dev/null || true
+wait "$PID" || true
+
+echo "=== run 2 (the launcher restart — same command) ==="
+"${TRAIN[@]}"
+echo "=== done: restored, fast-forwarded, gate fired ==="
