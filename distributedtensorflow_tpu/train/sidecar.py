@@ -66,8 +66,7 @@ class SidecarEvaluator:
         self.writer = MetricWriter(logdir)
         self.history: dict[int, dict] = {}  # step -> metrics
 
-    def _evaluate_step(self, step: int) -> dict:
-        state = self.checkpointer.restore(step, self.state_template)
+    def _evaluate_state(self, step: int, state) -> dict:
         metrics = weighted_evaluate(
             self.eval_step, state, self.eval_iter_fn(),
             max_steps=self.eval_steps,
@@ -86,10 +85,29 @@ class SidecarEvaluator:
         last_new_ckpt_t = time.monotonic()
         try:
             while True:
-                self.checkpointer.reload()  # other-process writes
-                step = self.checkpointer.latest_step()
-                if step is not None and step > last_evaluated:
-                    self._evaluate_step(step)
+                # A live writer's finalize is multi-file: the step dir can
+                # be listed before its metadata lands, so reload/restore
+                # can raise mid-race.  A polling reader treats that as
+                # "nothing new yet" and FALLS THROUGH to the idle check —
+                # a genuinely broken dir is therefore bounded by
+                # idle_timeout_s instead of retrying forever.  Only the
+                # checkpoint reads are guarded; evaluation and metric
+                # writing must fail loudly.
+                step = state = None
+                try:
+                    self.checkpointer.reload()  # other-process writes
+                    step = self.checkpointer.latest_step()
+                    if step is not None and step > last_evaluated:
+                        state = self.checkpointer.restore(
+                            step, self.state_template
+                        )
+                except OSError as e:
+                    logger.info(
+                        "sidecar: checkpoint not fully visible (%s); retry",
+                        e,
+                    )
+                if state is not None:
+                    self._evaluate_state(step, state)
                     last_evaluated = step
                     last_new_ckpt_t = time.monotonic()
                     if (
