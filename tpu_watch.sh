@@ -1,13 +1,18 @@
 #!/bin/bash
 # Round-long TPU-tunnel watcher: retry the chip until a window opens, then
-# land the three benchmark numbers (headline ResNet-50, LM tokens/sec,
-# flash-attention A/B) into BENCH_RESULTS/.  Exits after a full success or
-# when the deadline passes.  Round-1 lesson: one probe shot at round end =
-# zero perf evidence; this amortizes the flakiness over the whole round.
+# land benchmark evidence into BENCH_RESULTS/.  Exits after a full success
+# or when the deadline passes.  Round-1 lesson: one probe shot at round
+# end = zero perf evidence; this amortizes the flakiness over the round.
+#
+# QUEUE ORDER = evidence priority (round-3): tunnel windows have been
+# ~30 min, shorter than the full queue, so the round's MISSING evidence
+# runs first — LM throughput (the one metric below baseline), the >=8k
+# long-context rows, flash-backward timings, the on-chip profile — and
+# the already-evidenced benches (ResNet 1.07x, BERT) re-run last.
 set -u
 cd "$(dirname "$0")"
 DEADLINE=${TPU_WATCH_DEADLINE_S:-36000}   # default 10h
-SLEEP=${TPU_WATCH_SLEEP_S:-900}           # 15 min between probes
+SLEEP=${TPU_WATCH_SLEEP_S:-600}           # 10 min between probes
 START=$(date +%s)
 LOG=BENCH_RESULTS/tpu_watch.log
 mkdir -p BENCH_RESULTS
@@ -23,23 +28,14 @@ while true; do
       >> "$LOG" 2>&1; then
     echo "$(date -Is) watcher: tunnel UP, running benches" >> "$LOG"
     ok=1
-    BENCH_SKIP_PROBE=1 timeout 1200 python bench.py      >> "$LOG" 2>&1 || ok=0
-    # batch-size sweep: each run persists its own JSON; bench.py's cached
-    # path re-emits the best value
-    BENCH_SKIP_PROBE=1 BENCH_BATCH=256 timeout 1200 python bench.py >> "$LOG" 2>&1 || true
-    # LM: bs16 remat-off + chunked-xent head is the measured best config;
-    # also record bs32 attention-only-remat (2x batch, ~5% recompute)
+    # --- priority 1: LM throughput (VERDICT r2 #1; bf16 head landed) ----
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=16 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || ok=0
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    # round-3 candidates: bf16 CE head lands for all; pallas backward
-    # stores no (S,S) tensors, so bs32 may fit remat-free; bs24 middle
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=24 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    # long-context configs: flash attention auto-dispatches at 4k+ seq
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    # --- priority 2: long-context rows (VERDICT r2 #2) ------------------
     BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    # profile a real LM train step on the chip (VERDICT r3 #1: the
-    # throughput gap needs profile-backed evidence of where time goes)
+    BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
+    BENCH_SKIP_PROBE=1 BENCH_ATTN_SEQS=16384,32768 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || true
+    # --- priority 3: on-chip LM profile (VERDICT r3 #1 evidence) --------
     if [ ! -d BENCH_RESULTS/profile_lm_tpu ]; then
       timeout 900 python train.py --workload gpt_lm --steps 25 \
         --batch-size 16 --seq-len 1024 --remat off \
@@ -47,14 +43,11 @@ while true; do
         --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
         || rm -rf BENCH_RESULTS/profile_lm_tpu
     fi
-    BENCH_SKIP_PROBE=1 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || ok=0
-    BENCH_SKIP_PROBE=1 BENCH_BERT_BATCH=32 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || true
-    BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
-    # long-context tail: 16k/32k where only the flash kernel can run
-    BENCH_SKIP_PROBE=1 BENCH_ATTN_SEQS=16384,32768 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || true
-    # full-stack convergence on the real chip (accuracy gate through the
-    # CLI) — retried each window until one run SUCCEEDS (.done sentinel;
-    # metrics.jsonl alone also exists for timed-out/crashed runs)
+    # --- priority 4: remaining LM sweep + 4k row ------------------------
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=24 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
+    # --- priority 5: TPU convergence artifact (gate via the CLI) --------
     if [ ! -f ARTIFACTS/convergence_mnist_tpu/.done ]; then
       if timeout 900 python train.py --workload mnist_lenet --steps 600 \
         --eval-every 100 --target-metric accuracy --target-value 0.97 \
@@ -64,6 +57,11 @@ while true; do
         echo "$(date -Is) watcher: TPU convergence artifact landed" >> "$LOG"
       fi
     fi
+    # --- priority 6: already-evidenced benches (refresh with MFU pair) --
+    BENCH_SKIP_PROBE=1 timeout 1200 python bench.py      >> "$LOG" 2>&1 || ok=0
+    BENCH_SKIP_PROBE=1 BENCH_BATCH=256 timeout 1200 python bench.py >> "$LOG" 2>&1 || true
+    BENCH_SKIP_PROBE=1 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || ok=0
+    BENCH_SKIP_PROBE=1 BENCH_BERT_BATCH=32 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || true
     if (( ok == 1 )) && [ -f ARTIFACTS/convergence_mnist_tpu/.done ]; then
       echo "$(date -Is) watcher: all benches + convergence landed" >> "$LOG"
       exit 0
