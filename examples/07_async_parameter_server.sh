@@ -1,0 +1,20 @@
+#!/bin/bash
+# Async parameter-server Wide&Deep — reference config #5 with TRUE async
+# semantics: PS shards live in the chief, gradient workers are separate OS
+# processes, pushes apply with no barrier (stale gradients, recorded per
+# push), and the run ends with the accuracy gate on the PS-resident params.
+#
+# The device loop stays sync SPMD on TPU; this is the host-side training
+# mode for the sparse/recsys family the reference runs on parameter
+# servers.  See distributedtensorflow_tpu/parallel/param_server.py.
+set -e
+cd "$(dirname "$0")/.."
+LOGS=$(mktemp -d)
+
+python train.py --job async-ps --workload widedeep --test-size \
+  --device cpu --steps 15 --batch-size 128 --num-ps 2 --num-workers 2 \
+  --logdir "$LOGS" --target-metric accuracy --target-value 0.5
+
+echo "--- async-ps metrics (note staleness_hist: >0 = stale pushes) ---"
+cat "$LOGS/metrics.jsonl"
+rm -rf "$LOGS"

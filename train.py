@@ -236,6 +236,113 @@ def run_evaluator(args) -> None:
     logging.info("evaluator: done; evaluated %d checkpoints", len(history))
 
 
+def run_async_ps(args) -> None:
+    """Async parameter-server role (reference config #5 semantics).
+
+    Chief process: hosts the PS shards, spawns ``--num-workers`` grad-worker
+    processes, and reports progress while pushes are applied barrier-free
+    (stale gradients).  The reference's ``ClusterCoordinator``-driven
+    ``ParameterServerStrategyV2`` path (SURVEY.md §3.3) — host-side by
+    design; the TPU stays with the sync engine (see parallel/param_server.py
+    module docstring)."""
+    import json as jsonlib
+    import time as time_mod
+
+    from distributedtensorflow_tpu.parallel.param_server import AsyncPSTrainer
+    from distributedtensorflow_tpu.parallel.sharding import MinSizePartitioner
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    if args.target_metric and args.target_value is None:
+        raise SystemExit("--target-metric requires --target-value")
+    batch = args.batch_size or 256
+    # Same flag semantics/validation as the train and evaluator roles
+    # (--lr-without---optimizer, --schedule/--warmup-steps, _DECAY_CAPABLE).
+    base_wl = get_workload(
+        args.workload, test_size=args.test_size,
+        global_batch_size=batch * args.num_workers,
+    )
+    flagged_wl = apply_optimizer_flags(base_wl, args)
+    kwargs = {}
+    if flagged_wl is not base_wl:
+        kwargs["make_optimizer"] = flagged_wl.make_optimizer
+    trainer = AsyncPSTrainer(
+        args.workload,
+        num_ps=args.num_ps,
+        num_workers=args.num_workers,
+        steps=args.steps,
+        batch_size=batch,
+        test_size=args.test_size,
+        partitioner=MinSizePartitioner(min_shard_bytes=64 << 10),
+        seed=args.seed,
+        **kwargs,
+    )
+    logging.info(
+        "async-ps: workload=%s ps=%d workers=%d steps=%d batch=%d/worker",
+        args.workload, args.num_ps, args.num_workers, args.steps, batch,
+    )
+    writer = None
+    if args.logdir:
+        os.makedirs(args.logdir, exist_ok=True)
+        writer = open(os.path.join(args.logdir, "metrics.jsonl"), "a")
+    total = args.num_workers * args.num_ps * args.steps
+    with trainer:
+        trainer.start()
+        last = -1
+        while True:
+            try:
+                trainer.join(timeout=2.0)
+                break
+            except TimeoutError:
+                pass
+            v = trainer.global_version()
+            if v != last and writer:
+                writer.write(jsonlib.dumps(
+                    {"time": time_mod.time(), "global_version": v,
+                     "of": total}) + "\n")
+                writer.flush()
+            if v != last:
+                logging.info("async-ps: %d/%d updates applied", v, total)
+            last = v
+        metrics = (
+            trainer.evaluate(batches=4) if trainer.workload.eval_fn else {}
+        )
+        stats = trainer.ps_stats()
+        hist: dict[str, int] = {}
+        for s in stats:
+            for k, n in s["staleness_hist"].items():
+                hist[k] = hist.get(k, 0) + n
+        first, last_loss = trainer.first_last_mean_loss()
+        logging.info(
+            "async-ps: done — %d updates, loss %.4f -> %.4f, staleness %s, "
+            "eval %s",
+            trainer.global_version(), first, last_loss,
+            dict(sorted(hist.items(), key=lambda kv: int(kv[0]))),
+            {k: round(v, 4) for k, v in metrics.items()},
+        )
+        if writer:
+            writer.write(jsonlib.dumps({
+                "time": time_mod.time(), "final": True,
+                "loss_first": first, "loss_last": last_loss,
+                "staleness_hist": hist, **metrics,
+            }) + "\n")
+            writer.close()
+        if args.target_metric:
+            got = metrics.get(args.target_metric)
+            if got is None:
+                raise SystemExit(
+                    f"--target-metric {args.target_metric} not in {metrics}"
+                )
+            ok = (got >= args.target_value if args.target_mode == "max"
+                  else got <= args.target_value)
+            if not ok:
+                raise SystemExit(
+                    f"async-ps: target {args.target_metric}="
+                    f"{args.target_value} not reached (got {got:.4f})"
+                )
+            logging.info("async-ps: target %s=%s reached (%.4f)",
+                         args.target_metric, args.target_value, got)
+
+
 def main() -> None:
     # allow_abbrev=False: apply_config_file detects explicitly-typed flags
     # by matching argv against option strings; prefix abbreviations would
@@ -300,12 +407,19 @@ def main() -> None:
     p.add_argument("--pp-virtual", type=int, default=1,
                    help="virtual pipeline chunks per rank (>1 = circular/"
                         "interleaved schedule, smaller bubble)")
-    p.add_argument("--job", choices=("auto", "train", "evaluator"),
+    p.add_argument("--job", choices=("auto", "train", "evaluator",
+                                     "async-ps"),
                    default="auto",
-                   help="role of this process: train, or sidecar evaluator "
+                   help="role of this process: train, sidecar evaluator "
                         "(polls --checkpoint-dir and evaluates new "
-                        "checkpoints). auto = evaluator iff TF_CONFIG "
+                        "checkpoints), or async-ps (host-side stale-"
+                        "gradient parameter-server training, reference "
+                        "config #5). auto = evaluator iff TF_CONFIG "
                         "task.type == 'evaluator' (reference semantics)")
+    p.add_argument("--num-ps", type=int, default=2,
+                   help="async-ps: number of parameter-server shards")
+    p.add_argument("--num-workers", type=int, default=2,
+                   help="async-ps: number of gradient-worker processes")
     p.add_argument("--poll-interval", type=float, default=10.0,
                    help="evaluator: seconds between checkpoint-dir polls")
     p.add_argument("--max-evaluations", type=int, default=None,
@@ -378,6 +492,9 @@ def main() -> None:
         job = "evaluator" if task_type == "evaluator" else "train"
     if job == "evaluator":
         run_evaluator(args)
+        return
+    if job == "async-ps":
+        run_async_ps(args)
         return
 
     from distributedtensorflow_tpu import parallel
