@@ -47,12 +47,26 @@ def persist_result(prefix: str, result: dict) -> str:
     return path
 
 
+# Unconditional check (not `assert`: PYTHONOPTIMIZE would strip it and
+# silently revert the probe to devices-only).
+_PROBE_COMPUTE = (
+    "import sys as _s; import jax.numpy as _jnp; "
+    "_s.exit(0 if float(_jnp.arange(64.0).sum()) == 2016.0 else 3)"
+)
+
+
 def probe_devices(name: str = "bench", timeout_s: int | None = None) -> bool:
-    """One bounded subprocess probe; True = devices reachable.
+    """One bounded subprocess probe; True = devices reachable AND computing.
 
     Unlike :func:`probe_devices_or_die` this never exits — callers retry
     with backoff (the tunnel flakes in windows; one 180s shot cost round 1
     its entire perf story).
+
+    The probe runs a tiny computation and fetches the result, not just
+    ``jax.devices()``: the tunnel has a half-up failure mode (observed
+    2026-07-31) where device *enumeration* succeeds but any compile/execute
+    hangs — a devices-only probe then reports UP and every queued bench
+    burns its full timeout on a hang.
     """
     if os.environ.get("BENCH_SKIP_PROBE") == "1":
         return True
@@ -66,7 +80,8 @@ def probe_devices(name: str = "bench", timeout_s: int | None = None) -> bool:
     )
     with tempfile.TemporaryFile() as errf:
         probe = subprocess.Popen(
-            [sys.executable, "-c", force + "jax.devices()"],
+            [sys.executable, "-c",
+             force + "jax.devices(); " + _PROBE_COMPUTE],
             stdout=subprocess.DEVNULL,
             stderr=errf,
         )
@@ -120,44 +135,15 @@ def probe_devices_with_retries(name: str = "bench") -> bool:
 
 
 def probe_devices_or_die(name: str = "bench") -> None:
-    """Exit(2) with a diagnostic if first device contact hangs or fails."""
-    if os.environ.get("BENCH_SKIP_PROBE") == "1":
-        return
-    timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "180"))
-    platform = os.environ.get("BENCH_PLATFORM")
-    force = (
-        f"import jax; jax.config.update('jax_platforms', {platform!r}); "
-        if platform
-        else "import jax; "
-    )
-    with tempfile.TemporaryFile() as errf:
-        probe = subprocess.Popen(
-            [sys.executable, "-c", force + "jax.devices()"],
-            stdout=subprocess.DEVNULL,
-            stderr=errf,
-        )
-        try:
-            rc = probe.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            probe.kill()
-            try:
-                probe.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass  # child stuck in D-state; abandon it
-            print(
-                f"{name}: jax device probe unresponsive after {timeout_s}s "
-                "(TPU tunnel down?)",
-                file=sys.stderr,
-            )
-            raise SystemExit(2)
-        if rc != 0:
-            errf.seek(0)
-            print(
-                f"{name}: jax device probe failed:\n"
-                f"{errf.read().decode(errors='replace')}",
-                file=sys.stderr,
-            )
-            raise SystemExit(2)
+    """Exit(2) with a diagnostic if first device contact hangs or fails.
+
+    Same probe as :func:`probe_devices` (one shared implementation so the
+    two can't drift), different failure contract: exit instead of False.
+    """
+    if not probe_devices(
+        name, timeout_s=int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "180"))
+    ):
+        raise SystemExit(2)
 
 
 # --- shared measurement harness (used by bench.py / bench_lm / bench_bert) ---

@@ -23,7 +23,10 @@ while true; do
     echo "$(date -Is) watcher: deadline reached" >> "$LOG"
     exit 1
   fi
-  if BENCH_PROBE_RETRIES=1 BENCH_DEVICE_TIMEOUT_S=60 timeout 90 \
+  # Probe now requires a COMPUTE round-trip (see bench_probe.py): the
+  # half-up tunnel (devices enumerate, compiles hang) must read as DOWN.
+  # 150s budget: a genuinely-up tunnel needs one tiny compile (~10-30s).
+  if BENCH_PROBE_RETRIES=1 BENCH_DEVICE_TIMEOUT_S=120 timeout 150 \
       python -c "from bench_probe import probe_devices; import sys; sys.exit(0 if probe_devices('watch') else 1)" \
       >> "$LOG" 2>&1; then
     echo "$(date -Is) watcher: tunnel UP, running benches" >> "$LOG"
