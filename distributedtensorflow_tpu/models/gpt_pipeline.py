@@ -5,10 +5,12 @@ ever ran a toy Dense stage.  This module makes a *real model* train through
 it, with the heterogeneous structure a decoder LM needs:
 
 - **embed** (token table) and **head** (final LN + tied projection) run
-  OUTSIDE the pipeline, replicated over the ``pipe`` axis and sharded over
-  the batch axes — they are one matmul each, far cheaper than the block
-  stack, and keeping them out preserves the pipeline's shape-preserving
-  handoff invariant;
+  OUTSIDE the pipeline, sharded over the batch axes — they are one matmul
+  each, far cheaper than the block stack, and keeping them out preserves
+  the pipeline's shape-preserving handoff invariant.  The table itself is
+  row-sharded over ``pipe`` when the vocab divides (see :meth:`layout`):
+  compute stays outside the pipeline, but storage (+ optimizer slots) is
+  split ZeRO-style instead of replicated n_stages-fold;
 - the **transformer blocks** — where the FLOPs are — are stacked
   ``(n_stages, layers_per_stage, ...)`` with the leading dim sharded over
   ``pipe``; each stage scans its ``layers_per_stage`` blocks locally, and
@@ -181,8 +183,21 @@ class PipelinedGPT:
         circular = self.n_virtual > 1
         tp = dict(self.mesh.shape).get(mesh_lib.AXIS_MODEL, 1) > 1
 
+        n_stages = self.n_stages
+        vocab = self.cfg.vocab_size
+
         def rule(path: str, shape: tuple) -> P:
             if not (path.startswith("blocks/") or "/blocks/" in path):
+                # The embedding table is the one big non-block tensor
+                # (vocab x hidden; at real scale it IS the per-rank memory
+                # ceiling once the blocks are split pipe-ways).  Shard its
+                # rows over pipe — embed/head run OUTSIDE the manual
+                # region on auto axes, so GSPMD inserts the gather, and
+                # the table + its optimizer slots stop being replicated
+                # n_stages-fold (ZeRO-style placement, not a semantics
+                # change).  ln_f stays replicated (two vectors).
+                if path.endswith("wte/embedding") and vocab % n_stages == 0:
+                    return P(axis, None)
                 return P()
             # stage-stack prefix: (n_stages, lps, ...) or (v, n_stages, lps, ...)
             tail = [None] * (len(shape) - (2 if circular else 1))
