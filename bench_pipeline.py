@@ -78,10 +78,23 @@ def main() -> None:
     ids = rng.integers(0, cfg.vocab_size, size=(global_batch, seq))
     batch = {"input_ids": ids.astype(np.int32)}
 
+    def device0_bytes(tree) -> int:
+        return sum(
+            x.addressable_shards[0].data.nbytes
+            for x in jax.tree.leaves(tree)
+            if hasattr(x, "addressable_shards")
+        )
+
     def measure(mesh, model, loss_fn, init_fn, layout):
         state, specs = create_sharded_state(
             init_fn, optax.sgd(1e-3), mesh, jax.random.PRNGKey(0),
             rules=layout,
+        )
+        # Per-rank state residency (params + optimizer slots on device 0):
+        # evidences the placement story — e.g. the pipe-sharded embedding
+        # table vs n_stages-fold replication (gpt_pipeline.layout).
+        state_bytes = device0_bytes(state.params) + device0_bytes(
+            state.opt_state
         )
         step = make_train_step(loss_fn, mesh, specs)
         key = jax.random.PRNGKey(1)
@@ -94,7 +107,7 @@ def main() -> None:
             state, m = compiled(state, batch, key)
         float(m["loss"])
         dt = time.perf_counter() - t0
-        return n_steps / dt
+        return n_steps / dt, state_bytes
 
     devices = jax.devices()[:8]
     rows = {}
@@ -102,13 +115,15 @@ def main() -> None:
     # dense baseline: pure data parallel
     mesh = build_mesh(MeshSpec(data=8), devices)
     dense = GPTLM(cfg)
+    sps, sbytes = measure(
+        mesh, dense, lm_loss(dense),
+        lambda r: dense.init(r, jax.numpy.zeros((2, seq), jax.numpy.int32)),
+        None,
+    )
     rows["dense_dp8"] = {
-        "steps_per_sec": measure(
-            mesh, dense, lm_loss(dense),
-            lambda r: dense.init(r, jax.numpy.zeros((2, seq), jax.numpy.int32)),
-            None,
-        ),
+        "steps_per_sec": sps,
         "predicted_bubble": 0.0,
+        "state_bytes_per_device": sbytes,
     }
 
     configs = [
@@ -122,12 +137,14 @@ def main() -> None:
         pp = PipelinedGPT(
             cfg, mesh, n_microbatches=n_micro, n_virtual=n_virtual
         )
+        sps, sbytes = measure(
+            mesh, pp, pipelined_lm_loss(pp), pp.init, pp.layout()
+        )
         rows[row] = {
-            "steps_per_sec": measure(
-                mesh, pp, pipelined_lm_loss(pp), pp.init, pp.layout()
-            ),
+            "steps_per_sec": sps,
             # the model's own schedule-aware formula (gpipe vs circular)
             "predicted_bubble": pp.bubble_fraction(),
+            "state_bytes_per_device": sbytes,
         }
 
     base = rows["dense_dp8"]["steps_per_sec"]

@@ -37,6 +37,12 @@ Architecture (all host-side, reusing the data-service wire format —
   the chief, workers as OS processes (real death) computing grads with
   jitted CPU JAX; ``kill_worker`` is the fault-injection path and the
   surviving workers keep the global version advancing (elasticity).
+- **Cluster launcher path** (the reference's legacy TF_CONFIG ps/worker
+  tiers, SURVEY.md §1 L7): :func:`build_cluster_pieces` derives
+  byte-identical shards + placement plan on every task from the shared CLI
+  flags, a ``ps`` task serves its shard via :meth:`PSServer.serve_until`,
+  and a ``worker``/``chief`` task runs :func:`worker_loop` against the
+  ``cluster["ps"]`` addresses — wired in ``train.py`` job auto-detection.
 
 Per-shard optimizer correctness: shards are applied independently, which is
 exact for elementwise transforms (sgd/adagrad/adam/adamw without global-norm
@@ -205,6 +211,7 @@ class PSServer:
         make_optimizer: Callable[[], Any],
         *,
         port: int = 0,
+        bind: str = "127.0.0.1",
     ):
         import jax
         import jax.numpy as jnp
@@ -303,7 +310,8 @@ class PSServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server(("127.0.0.1", port), Handler)
+        self._last_push_t = time.monotonic()
+        self._server = Server((bind, port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
@@ -336,7 +344,37 @@ class PSServer:
             self._updates += 1
             self._staleness[staleness] = self._staleness.get(staleness, 0) + 1
             self._push_by_worker[worker] = self._push_by_worker.get(worker, 0) + 1
+            self._last_push_t = time.monotonic()
         return staleness
+
+    def serve_until(
+        self,
+        total_updates: int | None = None,
+        *,
+        idle_timeout_s: float | None = None,
+        poll_s: float = 0.2,
+    ) -> int:
+        """Block this thread until the shard has absorbed ``total_updates``
+        pushes, ``stop`` arrives, or no push for ``idle_timeout_s`` —
+        measured from serve start when none has landed yet, so a ps task
+        whose workers all died before the first push still exits.  The
+        standalone-PS-task loop for the cluster launcher path (reference: a
+        ps task blocks in ``server.join()``, SURVEY.md §1 L7
+        run_distributed.sh / §5.6 TF_CONFIG).  Returns the final version."""
+        while True:
+            with self._lock:
+                version = self._version
+                last = self._last_push_t
+            if total_updates is not None and version >= total_updates:
+                return version
+            if self._stopping.is_set():
+                return version
+            if (
+                idle_timeout_s is not None
+                and time.monotonic() - last > idle_timeout_s
+            ):
+                return version
+            time.sleep(poll_s)
 
     def params(self) -> FlatParams:
         with self._lock:
@@ -438,28 +476,23 @@ def _unflatten(flat: Mapping[str, Any]) -> dict:
     )
 
 
-def _async_worker_main(
+def worker_loop(
     worker_id: int,
     num_workers: int,
-    addrs: list[str],
-    plan_json: str,
+    addrs: Sequence[str],
+    plan: PlacementPlan,
     spec: dict,
-    queue,
-) -> None:
-    """Child main: pull → grad → push loop (module-level: spawn pickles it).
+) -> tuple[list[float], list[int]]:
+    """The async-PS worker: pull → grad → push for ``spec["steps"]`` steps.
 
-    Rebuilds the workload by name in-process (CPU JAX) — the same pattern
-    the reference uses, where each worker re-traces the train fn against
-    the PS-resident variables.
+    Rebuilds the workload by name in-process (the same pattern the
+    reference uses, where each worker re-traces the train fn against the
+    PS-resident variables) and computes gradients with jitted JAX on the
+    caller's current platform — force CPU before calling if this process
+    must not claim an accelerator (see :func:`_async_worker_main`).
+    Returns ``(per-step losses, per-push staleness)``.
     """
-    # Workers compute grads on host CPU unconditionally: the TPU chip stays
-    # with the sync engine, and the inherited JAX_PLATFORMS=axon (this
-    # image's sitecustomize) must not claim the device from a grad worker —
-    # same override the testing MultiProcessRunner applies to its children.
-    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
-
-    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from ..data.input_pipeline import InputContext
@@ -475,7 +508,6 @@ def _async_worker_main(
         global_batch_size=spec["batch_size"] * num_workers,
     )
     data = wl.input_fn(ctx, spec.get("seed", 0))
-    plan = PlacementPlan.from_json(plan_json)
     client = AsyncPSClient(addrs, plan, worker_id=worker_id)
     rng = jax.random.PRNGKey(1000 + worker_id)
 
@@ -487,7 +519,7 @@ def _async_worker_main(
 
     losses: list[float] = []
     staleness: list[int] = []
-    for step in range(spec["steps"]):
+    for _step in range(spec["steps"]):
         flat, versions = client.pull()
         params = jax.tree.map(jnp.asarray, _unflatten(flat))
         batch = next(data)
@@ -498,7 +530,76 @@ def _async_worker_main(
         staleness.extend(stats["staleness"])
         if spec.get("sleep_s"):
             time.sleep(spec["sleep_s"])
+    return losses, staleness
+
+
+def _async_worker_main(
+    worker_id: int,
+    num_workers: int,
+    addrs: list[str],
+    plan_json: str,
+    spec: dict,
+    queue,
+) -> None:
+    """Child main for spawned workers (module-level: spawn pickles it)."""
+    # Workers compute grads on host CPU unconditionally: the TPU chip stays
+    # with the sync engine, and the inherited JAX_PLATFORMS=axon (this
+    # image's sitecustomize) must not claim the device from a grad worker —
+    # same override the testing MultiProcessRunner applies to its children.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    losses, staleness = worker_loop(
+        worker_id, num_workers, addrs,
+        PlacementPlan.from_json(plan_json), spec,
+    )
     queue.put((worker_id, losses, staleness))
+
+
+def build_cluster_pieces(
+    spec: dict,
+    num_ps: int,
+    num_workers: int,
+    partitioner: Partitioner | None = None,
+    make_optimizer: Callable[[], Any] | None = None,
+    *,
+    workload_obj=None,
+):
+    """Deterministic (workload, shards, plan, make_optimizer) for a PS job.
+
+    Every task of a TF_CONFIG-launched PS cluster (ps tasks, worker tasks,
+    the chief) calls this with the SAME CLI flags and seed and gets
+    byte-identical initial shards and an identical placement plan — so no
+    plan/params wire transfer is needed at bootstrap, exactly the
+    launcher contract the reference's per-task TF_CONFIG scripts rely on
+    (same flags on every task, SURVEY.md §5.6).
+    """
+    import jax
+
+    if workload_obj is not None:
+        wl = workload_obj  # caller already built it (same spec fields)
+    else:
+        from ..workloads import get_workload
+
+        wl = get_workload(
+            spec["workload"], test_size=spec.get("test_size", True),
+            global_batch_size=spec["batch_size"] * num_workers,
+        )
+    variables = wl.init_fn(jax.random.PRNGKey(spec.get("seed", 0)))
+    extra = set(variables) - {"params"}
+    if extra:
+        # Mutable collections (batch_stats etc.) have no PS placement
+        # story — the reference's PS path is likewise params-only
+        # (BN-free sparse/recsys models). Fail here, not in every worker.
+        raise ValueError(
+            f"async-PS supports params-only workloads; "
+            f"{spec['workload']!r} also has collections {sorted(extra)} "
+            "(e.g. batch norm) — use the sync engine for it"
+        )
+    flat = _flatten(variables["params"])
+    shards, plan = partition_params(flat, num_ps, partitioner)
+    return wl, shards, plan, (make_optimizer or wl.make_optimizer)
 
 
 # --- orchestration ----------------------------------------------------------
@@ -537,33 +638,14 @@ class AsyncPSTrainer:
         seed: int = 0,
         worker_sleep_s: float = 0.0,
     ):
-        from ..workloads import get_workload
-
         self._spec = {
             "workload": workload, "steps": steps, "batch_size": batch_size,
             "test_size": test_size, "seed": seed, "sleep_s": worker_sleep_s,
         }
         self._num_workers = num_workers
-        wl = get_workload(
-            workload, test_size=test_size,
-            global_batch_size=batch_size * num_workers,
+        wl, shards, self._plan, self._make_opt = build_cluster_pieces(
+            self._spec, num_ps, num_workers, partitioner, make_optimizer
         )
-        import jax
-
-        variables = wl.init_fn(jax.random.PRNGKey(seed))
-        extra = set(variables) - {"params"}
-        if extra:
-            # Mutable collections (batch_stats etc.) have no PS placement
-            # story — the reference's PS path is likewise params-only
-            # (BN-free sparse/recsys models). Fail here, not in every worker.
-            raise ValueError(
-                f"async-PS supports params-only workloads; {workload!r} "
-                f"also has collections {sorted(extra)} (e.g. batch norm) — "
-                "use the sync engine for it"
-            )
-        flat = _flatten(variables["params"])
-        self._make_opt = make_optimizer or wl.make_optimizer
-        shards, self._plan = partition_params(flat, num_ps, partitioner)
         self._servers = [
             PSServer(shard, self._make_opt) for shard in shards
         ]

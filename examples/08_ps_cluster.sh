@@ -1,0 +1,24 @@
+#!/bin/bash
+# The reference's LEGACY parameter-server launcher path: one process per
+# TF_CONFIG cluster task (SURVEY.md §1 L7 run_distributed.sh semantics),
+# with a "ps" job in the cluster spec routing every task to the async-PS
+# tier — ps tasks serve parameter shards, chief/worker tasks run the
+# stale-gradient pull->push loop.  No parameters cross the wire at
+# bootstrap: every task derives identical shards from the shared flags.
+set -e
+cd "$(dirname "$0")/.."
+
+P0=21710; P1=21711; C0=21712; W0=21713
+CLUSTER='{"ps": ["127.0.0.1:'$P0'", "127.0.0.1:'$P1'"], "chief": ["127.0.0.1:'$C0'"], "worker": ["127.0.0.1:'$W0'"]}'
+FLAGS="--workload widedeep --test-size --steps 8 --batch-size 64"
+
+pids=()
+for task in '"ps", "index": 0' '"ps", "index": 1' \
+            '"chief", "index": 0' '"worker", "index": 0'; do
+  TF_CONFIG='{"cluster": '"$CLUSTER"', "task": {"type": '"$task"'}}' \
+    python train.py $FLAGS --idle-timeout 120 &
+  pids+=($!)
+done
+status=0
+for pid in "${pids[@]}"; do wait "$pid" || status=$?; done
+exit "$status"

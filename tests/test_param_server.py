@@ -175,6 +175,61 @@ def test_worker_crash_raises_at_join():
             t.join(timeout=120)
 
 
+# --- TF_CONFIG ps/worker cluster launcher (legacy PS path) -------------------
+
+
+def test_tf_config_ps_cluster_end_to_end():
+    """One process per TF_CONFIG task: 2 ps + chief + worker, all rc=0,
+    ps tasks absorb exactly the push budget, workers observe staleness."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from distributedtensorflow_tpu.testing import pick_unused_port
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ports = [pick_unused_port() for _ in range(4)]
+    cluster = {
+        "ps": [f"127.0.0.1:{ports[0]}", f"127.0.0.1:{ports[1]}"],
+        "chief": [f"127.0.0.1:{ports[2]}"],
+        "worker": [f"127.0.0.1:{ports[3]}"],
+    }
+    flags = ["--workload", "widedeep", "--test-size", "--steps", "4",
+             "--batch-size", "32", "--idle-timeout", "120"]
+    procs = []
+    outs = []
+    try:
+        for task_type, index in (("ps", 0), ("ps", 1), ("chief", 0),
+                                 ("worker", 0)):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # no virtual devices in the children
+            env["TF_CONFIG"] = json.dumps(
+                {"cluster": cluster,
+                 "task": {"type": task_type, "index": index}}
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "train.py", *flags], cwd=repo, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+            assert p.returncode == 0, out[-1500:]
+    finally:  # a hung/failed task must not orphan its peers
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=10)
+    # each ps shard absorbed exactly workers*steps pushes
+    assert "done at version 8" in outs[0], outs[0][-800:]
+    assert "done at version 8" in outs[1], outs[1][-800:]
+    # chief is worker 0, worker task is worker 1; both report staleness
+    assert "chief task 0 = async worker 0/2" in outs[2]
+    assert "worker task 0 = async worker 1/2" in outs[3]
+    assert "staleness" in outs[2] and "staleness" in outs[3]
+
+
 # --- end-to-end async training (Wide&Deep, reference config #5) -------------
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import time
 
 import jax
 
@@ -343,6 +344,112 @@ def run_async_ps(args) -> None:
                          args.target_metric, args.target_value, got)
 
 
+def run_ps_cluster_task(args, cluster, task_type, task_index) -> None:
+    """One task of a TF_CONFIG parameter-server cluster.
+
+    The reference's legacy launcher path (SURVEY.md §1 L7: one process per
+    ``tf.train.ClusterSpec`` task via run_distributed.sh + per-task
+    TF_CONFIG): a ``ps`` task serves its parameter shard until the job's
+    push budget is absorbed; ``chief``/``worker`` tasks run the async
+    pull → grad → push loop.  All tasks derive byte-identical shards and
+    placement from the shared CLI flags (``build_cluster_pieces``), so
+    bootstrap needs no parameter transfer — the same same-flags-per-task
+    contract the reference's TF_CONFIG scripts rely on.
+    """
+    from distributedtensorflow_tpu.parallel.param_server import (
+        AsyncPSClient,
+        PSServer,
+        PSUnavailableError,
+        build_cluster_pieces,
+        worker_loop,
+    )
+    from distributedtensorflow_tpu.parallel.sharding import MinSizePartitioner
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    # The PS tier is host-side by design: every role computes on CPU and
+    # the accelerator stays with the sync engine (param_server.py docs).
+    jax.config.update("jax_platforms", "cpu")
+
+    if task_type not in ("ps", "chief", "worker"):
+        raise SystemExit(
+            f"TF_CONFIG task.type {task_type!r} has no role in a ps "
+            "cluster (expected ps, chief, or worker)"
+        )
+    ps_addrs = list(cluster["ps"])
+    chiefs = list(cluster.get("chief", []))
+    workers = chiefs + list(cluster.get("worker", []))
+    num_ps, num_workers = len(ps_addrs), len(workers)
+    if num_workers == 0:
+        raise SystemExit("TF_CONFIG ps cluster has no chief/worker tasks")
+    batch = args.batch_size or 256
+    spec = {
+        "workload": args.workload, "steps": args.steps,
+        "batch_size": batch, "test_size": args.test_size,
+        "seed": args.seed, "sleep_s": 0.0,
+    }
+    base_wl = get_workload(
+        args.workload, test_size=args.test_size,
+        global_batch_size=batch * num_workers,
+    )
+    flagged = apply_optimizer_flags(base_wl, args)
+    make_opt = flagged.make_optimizer if flagged is not base_wl else None
+    _wl, shards, plan, make_opt = build_cluster_pieces(
+        spec, num_ps, num_workers,
+        MinSizePartitioner(min_shard_bytes=64 << 10), make_opt,
+        workload_obj=base_wl,
+    )
+
+    if task_type == "ps":
+        host, port = ps_addrs[task_index].rsplit(":", 1)
+        bind = host if host in ("127.0.0.1", "localhost") else "0.0.0.0"
+        server = PSServer(shards[task_index], make_opt,
+                          port=int(port), bind=bind)
+        total = num_workers * args.steps  # one push per worker-step
+        logging.info(
+            "ps task %d/%d serving %d vars on %s (budget %d pushes)",
+            task_index, num_ps, len(shards[task_index]),
+            ps_addrs[task_index], total,
+        )
+        version = server.serve_until(
+            total, idle_timeout_s=args.idle_timeout
+        )
+        logging.info("ps task %d done at version %d", task_index, version)
+        server.stop()
+        return
+
+    # chief/worker: run the async loop.  chief is worker 0 (trains too,
+    # the common TF arrangement); "worker" indices shift past the chiefs.
+    worker_id = (
+        task_index if task_type == "chief"
+        else task_index + len(chiefs)
+    )
+    # Bounded wait for the PS tier to come up (tasks start unordered).
+    client = AsyncPSClient(ps_addrs, plan, worker_id=worker_id)
+    deadline = time.time() + 60
+    while True:
+        try:
+            client.stats()
+            break
+        except PSUnavailableError:
+            if time.time() > deadline:
+                raise SystemExit("PS tasks unreachable after 60s")
+            time.sleep(0.5)
+    logging.info(
+        "%s task %d = async worker %d/%d against ps=%s",
+        task_type, task_index, worker_id, num_workers, ps_addrs,
+    )
+    losses, staleness = worker_loop(
+        worker_id, num_workers, ps_addrs, plan, spec
+    )
+    hist: dict[int, int] = {}
+    for s in staleness:
+        hist[s] = hist.get(s, 0) + 1
+    logging.info(
+        "worker %d done: loss %.4f -> %.4f over %d steps, staleness %s",
+        worker_id, losses[0], losses[-1], len(losses), dict(sorted(hist.items())),
+    )
+
+
 def main() -> None:
     # allow_abbrev=False: apply_config_file detects explicitly-typed flags
     # by matching argv against option strings; prefix abbreviations would
@@ -415,7 +522,9 @@ def main() -> None:
                         "checkpoints), or async-ps (host-side stale-"
                         "gradient parameter-server training, reference "
                         "config #5). auto = evaluator iff TF_CONFIG "
-                        "task.type == 'evaluator' (reference semantics)")
+                        "task.type == 'evaluator'; a TF_CONFIG cluster "
+                        "WITH a 'ps' job routes ps/chief/worker tasks to "
+                        "the async-PS tier (legacy PS launcher semantics)")
     p.add_argument("--num-ps", type=int, default=2,
                    help="async-ps: number of parameter-server shards")
     p.add_argument("--num-workers", type=int, default=2,
@@ -476,25 +585,44 @@ def main() -> None:
         enable_determinism()
 
     job = args.job
+    ps_cluster = None
     if job == "auto":
-        # Reference semantics: an "evaluator" task in TF_CONFIG is outside
-        # the training cluster and runs the sidecar-evaluation loop.
+        # Reference semantics (SURVEY.md §5.6): an "evaluator" task in
+        # TF_CONFIG is outside the training cluster and runs the sidecar
+        # loop; a cluster WITH a "ps" job is the legacy parameter-server
+        # launcher path — ps tasks serve shards, worker/chief tasks run the
+        # async pull/push loop.  Clusters without "ps" stay sync SPMD.
         import json as jsonlib
 
         tf_config = os.environ.get("TF_CONFIG")
+        task_type, task_index, cluster = None, 0, {}
         try:
-            task_type = (
-                jsonlib.loads(tf_config).get("task", {}).get("type")
-                if tf_config else None
-            )
-        except (ValueError, AttributeError):
-            task_type = None
-        job = "evaluator" if task_type == "evaluator" else "train"
+            if tf_config:
+                parsed = jsonlib.loads(tf_config)
+                cluster = parsed.get("cluster", {}) or {}
+                task = parsed.get("task", {}) or {}
+                task_type = task.get("type")
+                task_index = int(task.get("index", 0))
+        except (ValueError, AttributeError, TypeError):
+            # Malformed TF_CONFIG: fall through to plain training (the
+            # long-standing evaluator-detection behavior) — including NOT
+            # routing into the PS tier on a half-parsed cluster.
+            task_type, task_index, cluster = None, 0, {}
+        if task_type == "evaluator":
+            job = "evaluator"
+        elif cluster.get("ps"):
+            job = "ps-cluster"
+            ps_cluster = (cluster, task_type, task_index)
+        else:
+            job = "train"
     if job == "evaluator":
         run_evaluator(args)
         return
     if job == "async-ps":
         run_async_ps(args)
+        return
+    if job == "ps-cluster":
+        run_ps_cluster_task(args, *ps_cluster)
         return
 
     from distributedtensorflow_tpu import parallel
