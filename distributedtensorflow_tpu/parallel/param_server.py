@@ -241,6 +241,7 @@ class PSServer:
         self._cpu = cpu
         self._version = 0
         self._updates = 0
+        self._inflight = 0  # requests mid-handler (serve_until drains)
         self._staleness: dict[int, int] = {}
         self._push_by_worker: dict[int, int] = {}
         self._stopping = threading.Event()
@@ -253,6 +254,15 @@ class PSServer:
                     header, data = _recv_msg(self.request)
                 except (ConnectionError, json.JSONDecodeError):
                     return
+                with outer._lock:
+                    outer._inflight += 1
+                try:
+                    self._handle(header, data)
+                finally:
+                    with outer._lock:
+                        outer._inflight -= 1
+
+            def _handle(self, header, data) -> None:
                 op = header.get("op")
                 if op == "pull":
                     # _push REPLACES the params dict (never mutates), so a
@@ -365,16 +375,22 @@ class PSServer:
             with self._lock:
                 version = self._version
                 last = self._last_push_t
-            if total_updates is not None and version >= total_updates:
+                inflight = self._inflight
+            # Drain before returning: the budget-completing push's handler
+            # may still be writing its response, and returning here lets
+            # the caller stop()/exit and tear the daemon thread down
+            # mid-send (the worker would see a connection reset).
+            done = (
+                (total_updates is not None and version >= total_updates)
+                or self._stopping.is_set()
+                or (
+                    idle_timeout_s is not None
+                    and time.monotonic() - last > idle_timeout_s
+                )
+            )
+            if done and inflight == 0:
                 return version
-            if self._stopping.is_set():
-                return version
-            if (
-                idle_timeout_s is not None
-                and time.monotonic() - last > idle_timeout_s
-            ):
-                return version
-            time.sleep(poll_s)
+            time.sleep(poll_s if not done else 0.01)
 
     def params(self) -> FlatParams:
         with self._lock:

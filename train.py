@@ -446,7 +446,10 @@ def run_ps_cluster_task(args, cluster, task_type, task_index) -> None:
         hist[s] = hist.get(s, 0) + 1
     logging.info(
         "worker %d done: loss %.4f -> %.4f over %d steps, staleness %s",
-        worker_id, losses[0], losses[-1], len(losses), dict(sorted(hist.items())),
+        worker_id,
+        losses[0] if losses else float("nan"),
+        losses[-1] if losses else float("nan"),
+        len(losses), dict(sorted(hist.items())),
     )
 
 
@@ -535,7 +538,8 @@ def main() -> None:
                    help="evaluator: stop after N evaluations")
     p.add_argument("--idle-timeout", type=float, default=600.0,
                    help="evaluator: stop after this long with no new "
-                        "checkpoint")
+                        "checkpoint; ps-cluster ps task: exit after this "
+                        "long with no gradient push")
     p.add_argument("--seq-len", type=int, default=None,
                    help="LM presets: override sequence length")
     from distributedtensorflow_tpu.train.optimizers import (
