@@ -80,6 +80,13 @@ logger = logging.getLogger("distributedtensorflow_tpu")
 
 FlatParams = dict[str, np.ndarray]
 
+#: Per-connection socket timeout inside the PS request handler: bounds how
+#: long a wedged peer (half-open TCP) can occupy a handler thread.
+_HANDLER_SOCKET_TIMEOUT_S = 30.0
+#: serve_until's post-done drain cap: after the exit condition holds, wait
+#: at most this long for inflight handlers before returning anyway.
+_DRAIN_CAP_S = 5.0
+
 
 # --- placement plan ---------------------------------------------------------
 
@@ -250,14 +257,24 @@ class PSServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one request per connection
-                try:
-                    header, data = _recv_msg(self.request)
-                except (ConnectionError, json.JSONDecodeError):
-                    return
+                # Count the request from BEFORE the receive: if inflight
+                # were only incremented after _recv_msg returned, a push
+                # that has been fully received but not yet counted could
+                # still be torn down by a stop() racing serve_until's
+                # drain.  The socket timeout bounds how long a wedged peer
+                # can hold the inflight count (serve_until additionally
+                # caps its drain, so a dead client can never pin the task).
+                self.request.settimeout(_HANDLER_SOCKET_TIMEOUT_S)
                 with outer._lock:
                     outer._inflight += 1
                 try:
+                    try:
+                        header, data = _recv_msg(self.request)
+                    except (ConnectionError, json.JSONDecodeError, OSError):
+                        return
                     self._handle(header, data)
+                except OSError:
+                    return  # peer vanished mid-response; nothing to unwind
                 finally:
                     with outer._lock:
                         outer._inflight -= 1
@@ -371,6 +388,7 @@ class PSServer:
         standalone-PS-task loop for the cluster launcher path (reference: a
         ps task blocks in ``server.join()``, SURVEY.md §1 L7
         run_distributed.sh / §5.6 TF_CONFIG).  Returns the final version."""
+        done_since: float | None = None
         while True:
             with self._lock:
                 version = self._version
@@ -379,7 +397,10 @@ class PSServer:
             # Drain before returning: the budget-completing push's handler
             # may still be writing its response, and returning here lets
             # the caller stop()/exit and tear the daemon thread down
-            # mid-send (the worker would see a connection reset).
+            # mid-send (the worker would see a connection reset).  The
+            # drain is CAPPED: a peer that wedged mid-request (half-open
+            # TCP, stalled host) must not pin the ps task forever — after
+            # _DRAIN_CAP_S we return anyway and let stop() reset it.
             done = (
                 (total_updates is not None and version >= total_updates)
                 or self._stopping.is_set()
@@ -388,8 +409,16 @@ class PSServer:
                     and time.monotonic() - last > idle_timeout_s
                 )
             )
-            if done and inflight == 0:
-                return version
+            if done:
+                if done_since is None:
+                    done_since = time.monotonic()
+                if (
+                    inflight == 0
+                    or time.monotonic() - done_since > _DRAIN_CAP_S
+                ):
+                    return version
+            else:
+                done_since = None
             time.sleep(poll_s if not done else 0.01)
 
     def params(self) -> FlatParams:

@@ -1,77 +1,143 @@
 #!/bin/bash
 # Round-long TPU-tunnel watcher: retry the chip until a window opens, then
-# land benchmark evidence into BENCH_RESULTS/.  Exits after a full success
-# or when the deadline passes.  Round-1 lesson: one probe shot at round
-# end = zero perf evidence; this amortizes the flakiness over the round.
+# land benchmark evidence into BENCH_RESULTS/.
 #
-# QUEUE ORDER = evidence priority (round-3): tunnel windows have been
-# ~30 min, shorter than the full queue, so the round's MISSING evidence
-# runs first — LM throughput (the one metric below baseline), the >=8k
-# long-context rows, flash-backward timings, the on-chip profile — and
-# the already-evidenced benches (ResNet 1.07x, BERT) re-run last.
+# Round-3 lesson (2026-07-31 03:18 window): the first window of the round
+# lasted ~45 min and the old fixed-sequence queue burned 40 of them on two
+# Pallas compiles that hung against a tunnel that had ALREADY died — the
+# 1200s per-item timeouts ran back to back with no liveness re-check in
+# between.  This version:
+#   - re-probes the tunnel (compute round-trip) after ANY item failure and
+#     drops back to the sleep loop if it is gone, instead of letting the
+#     rest of the queue time out serially;
+#   - stamps every landed item under BENCH_RESULTS/.landed/ so a re-entered
+#     window resumes at the first UN-landed item (priority order preserved
+#     across windows) rather than re-running what already succeeded;
+#   - gates all Pallas-compiling rows behind a 90s tiny-kernel canary and
+#     gives them the LAST queue slots: they are the only rows that have
+#     ever hung, so they must never again sit in front of cheap evidence.
 set -u
 cd "$(dirname "$0")"
 DEADLINE=${TPU_WATCH_DEADLINE_S:-36000}   # default 10h
-SLEEP=${TPU_WATCH_SLEEP_S:-600}           # 10 min between probes
+SLEEP=${TPU_WATCH_SLEEP_S:-300}
 START=$(date +%s)
 LOG=BENCH_RESULTS/tpu_watch.log
-mkdir -p BENCH_RESULTS
+STAMPS=BENCH_RESULTS/.landed
+mkdir -p BENCH_RESULTS "$STAMPS"
+
+log() { echo "$(date -Is) watcher: $*" >> "$LOG"; }
+
+probe() {
+  BENCH_PROBE_RETRIES=1 BENCH_DEVICE_TIMEOUT_S=120 timeout 150 \
+    python -c "from bench_probe import probe_devices; import sys; sys.exit(0 if probe_devices('watch') else 1)" \
+    >> "$LOG" 2>&1
+}
+
+# run <stamp> <timeout_s> <cmd...>: skip if landed; stamp on success.
+# On failure returns 1 so the caller can re-probe.
+run() {
+  local stamp="$1" to="$2"; shift 2
+  [ -f "$STAMPS/$stamp" ] && return 0
+  log "item $stamp: start"
+  if timeout "$to" env BENCH_SKIP_PROBE=1 "$@" >> "$LOG" 2>&1; then
+    touch "$STAMPS/$stamp"
+    log "item $stamp: LANDED"
+    return 0
+  fi
+  log "item $stamp: failed/timeout"
+  return 1
+}
+
+# Pallas canary: a tiny pallas_call must compile+run in 90s, else every
+# Pallas row this window would hang to its timeout — skip them all.
+pallas_ok() {
+  timeout 90 python - >> "$LOG" 2>&1 <<'EOF'
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+def k(x_ref, o_ref): o_ref[...] = x_ref[...] + 1.0
+x = jnp.ones((256, 256), jnp.float32)
+f = pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))
+assert float(jax.jit(f)(x)[0, 0]) == 2.0
+EOF
+}
 
 while true; do
   now=$(date +%s)
-  if (( now - START > DEADLINE )); then
-    echo "$(date -Is) watcher: deadline reached" >> "$LOG"
-    exit 1
-  fi
-  # Probe now requires a COMPUTE round-trip (see bench_probe.py): the
-  # half-up tunnel (devices enumerate, compiles hang) must read as DOWN.
-  # 150s budget: a genuinely-up tunnel needs one tiny compile (~10-30s).
-  if BENCH_PROBE_RETRIES=1 BENCH_DEVICE_TIMEOUT_S=120 timeout 150 \
-      python -c "from bench_probe import probe_devices; import sys; sys.exit(0 if probe_devices('watch') else 1)" \
-      >> "$LOG" 2>&1; then
-    echo "$(date -Is) watcher: tunnel UP, running benches" >> "$LOG"
-    ok=1
-    # --- priority 1: LM throughput (VERDICT r2 #1; bf16 head landed) ----
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=16 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || ok=0
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    # --- priority 2: long-context rows (VERDICT r2 #2) ------------------
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    BENCH_SKIP_PROBE=1 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || ok=0
-    BENCH_SKIP_PROBE=1 BENCH_ATTN_SEQS=16384,32768 timeout 1800 python bench_attn.py >> "$LOG" 2>&1 || true
-    # --- priority 3: on-chip LM profile (VERDICT r3 #1 evidence) --------
-    if [ ! -d BENCH_RESULTS/profile_lm_tpu ]; then
-      timeout 900 python train.py --workload gpt_lm --steps 25 \
-        --batch-size 16 --seq-len 1024 --remat off \
-        --profile-dir BENCH_RESULTS/profile_lm_tpu --profile-start 8 \
-        --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
-        || rm -rf BENCH_RESULTS/profile_lm_tpu
-    fi
-    # --- priority 4: remaining LM sweep + 4k row ------------------------
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=24 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    BENCH_SKIP_PROBE=1 BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 timeout 1200 python bench_lm.py >> "$LOG" 2>&1 || true
-    # --- priority 5: TPU convergence artifact (gate via the CLI) --------
-    if [ ! -f ARTIFACTS/convergence_mnist_tpu/.done ]; then
-      if timeout 900 python train.py --workload mnist_lenet --steps 600 \
-        --eval-every 100 --target-metric accuracy --target-value 0.97 \
-        --logdir ARTIFACTS/convergence_mnist_tpu --log-every 100 \
-        >> "$LOG" 2>&1; then
-        touch ARTIFACTS/convergence_mnist_tpu/.done
-        echo "$(date -Is) watcher: TPU convergence artifact landed" >> "$LOG"
+  if (( now - START > DEADLINE )); then log "deadline reached"; exit 1; fi
+  if ! probe; then log "tunnel down"; sleep "$SLEEP"; continue; fi
+  log "tunnel UP, running queue"
+
+  while true; do   # single-pass queue; break on tunnel death
+    # -- p1: on-chip LM profile (VERDICT r2 #1's instrument) -------------
+    if [ ! -f "$STAMPS/profile_lm" ]; then
+      if timeout 900 python train.py --workload gpt_lm --steps 25 \
+          --batch-size 16 --seq-len 1024 --remat off \
+          --profile-dir BENCH_RESULTS/profile_lm_tpu --profile-start 8 \
+          --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
+          && find BENCH_RESULTS/profile_lm_tpu -name '*.xplane.pb' | grep -q .; then
+        touch "$STAMPS/profile_lm"; log "item profile_lm: LANDED"
+      else
+        rm -rf BENCH_RESULTS/profile_lm_tpu
+        log "item profile_lm: failed"; probe || break
       fi
     fi
-    # --- priority 6: already-evidenced benches (refresh with MFU pair) --
-    BENCH_SKIP_PROBE=1 timeout 1200 python bench.py      >> "$LOG" 2>&1 || ok=0
-    BENCH_SKIP_PROBE=1 BENCH_BATCH=256 timeout 1200 python bench.py >> "$LOG" 2>&1 || true
-    BENCH_SKIP_PROBE=1 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || ok=0
-    BENCH_SKIP_PROBE=1 BENCH_BERT_BATCH=32 timeout 1200 python bench_bert.py >> "$LOG" 2>&1 || true
-    if (( ok == 1 )) && [ -f ARTIFACTS/convergence_mnist_tpu/.done ]; then
-      echo "$(date -Is) watcher: all benches + convergence landed" >> "$LOG"
-      exit 0
+    # -- p2: non-Pallas LM sweep (throughput evidence, cheap) ------------
+    run lm_bs16       600 python bench_lm.py \
+      || { probe || break; }
+    run lm_bs24       600 env BENCH_LM_BATCH=24 python bench_lm.py \
+      || { probe || break; }
+    run lm_bs32_rattn 600 env BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn python bench_lm.py \
+      || { probe || break; }
+    # 4k/8k rows on the XLA path: long-context numbers that cannot hang
+    # in a Pallas compile (remat=attn keeps the (S,S) out of residuals).
+    run lm_s4096_xla  900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
+      || { probe || break; }
+    run lm_s8192_xla  900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
+      || { probe || break; }
+    # -- p3: TPU convergence artifact (gate via the CLI) -----------------
+    if [ ! -f "$STAMPS/conv_tpu" ]; then
+      if timeout 900 python train.py --workload mnist_lenet --steps 600 \
+          --eval-every 100 --target-metric accuracy --target-value 0.97 \
+          --logdir ARTIFACTS/convergence_mnist_tpu --log-every 100 >> "$LOG" 2>&1; then
+        touch "$STAMPS/conv_tpu" ARTIFACTS/convergence_mnist_tpu/.done
+        log "item conv_tpu: LANDED"
+      else
+        log "item conv_tpu: failed"; probe || break
+      fi
     fi
-    echo "$(date -Is) watcher: partial success, will retry" >> "$LOG"
-  else
-    echo "$(date -Is) watcher: tunnel down" >> "$LOG"
-  fi
+    # -- p4: headline refresh with the MFU pair --------------------------
+    run resnet        900 python bench.py            || { probe || break; }
+    run resnet_bs256  900 env BENCH_BATCH=256 python bench.py || { probe || break; }
+    run bert          900 python bench_bert.py       || { probe || break; }
+    # -- p5: Pallas rows, canary-gated, LAST -----------------------------
+    pallas_missing=0
+    for s in attn_4k lm_bs32_pl lm_s8192_pl attn_16k32k; do
+      [ -f "$STAMPS/$s" ] || pallas_missing=1
+    done
+    if (( pallas_missing == 0 )); then
+      :  # all Pallas rows landed — don't spend window time on the canary
+    elif pallas_ok; then
+      log "pallas canary ok"
+      run attn_4k     900 python bench_attn.py       || { probe || break; }
+      run lm_bs32_pl  900 env BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas python bench_lm.py \
+        || { probe || break; }
+      run lm_s8192_pl 900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn python bench_lm.py \
+        || { probe || break; }
+      run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
+        || { probe || break; }
+    else
+      log "pallas canary FAILED — skipping Pallas rows this window"
+    fi
+    break
+  done
+
+  missing=0
+  for s in profile_lm lm_bs16 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
+           conv_tpu resnet resnet_bs256 bert attn_4k lm_bs32_pl lm_s8192_pl \
+           attn_16k32k; do
+    [ -f "$STAMPS/$s" ] || missing=$((missing+1))
+  done
+  if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
+  log "window done, $missing items still missing; sleeping"
   sleep "$SLEEP"
 done
