@@ -50,10 +50,11 @@ def main() -> None:
     remat_env = os.environ.get("BENCH_LM_REMAT", "0")
     remat = {"0": False, "1": True}.get(remat_env, remat_env)
     attn_impl = os.environ.get("BENCH_LM_ATTN") or None
+    xent_impl = os.environ.get("BENCH_LM_XENT") or None
     wl = get_workload(
         "gpt_lm", test_size=test_size,
         global_batch_size=per_chip_batch * n_chips,
-        seq_len=seq, remat=remat, attn_impl=attn_impl,
+        seq_len=seq, remat=remat, attn_impl=attn_impl, xent_impl=xent_impl,
     )
     wl = wl.for_mesh(mesh)
 
@@ -111,6 +112,7 @@ def main() -> None:
         "global_batch": wl.global_batch_size,
         "remat": remat,
         "attn_impl": attn_impl or "auto",
+        "xent_impl": xent_impl or "chunked",
         "step_time_ms": round(1000 * dt / n_steps, 2),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }

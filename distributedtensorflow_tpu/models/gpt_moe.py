@@ -198,7 +198,7 @@ def moe_lm_loss(model: GPTMoELM):
     Cross-entropy uses the vocab-chunked head (``ops/xent.py``) like the
     dense GPT's ``lm_loss``: full-vocab fp32 logits never materialize.
     """
-    from ..ops.xent import chunked_softmax_xent
+    from .gpt import _pick_xent
 
     aux_w = model.cfg.aux_loss_weight
 
@@ -207,7 +207,7 @@ def moe_lm_loss(model: GPTMoELM):
             {"params": params}, batch["input_ids"], deterministic=False,
             return_hidden=True,
         )
-        lm = chunked_softmax_xent(
+        lm = _pick_xent(model.cfg)(
             hidden[:, :-1],
             params["wte"]["embedding"],
             batch["input_ids"][:, 1:],
@@ -224,14 +224,14 @@ def moe_lm_loss(model: GPTMoELM):
 def moe_lm_eval(model: GPTMoELM):
     """Eval metric_fn: deterministic forward, router aux reported but not
     folded into the eval loss (it is a training regularizer)."""
-    from ..ops.xent import chunked_softmax_xent
+    from .gpt import _pick_xent
 
     def metric_fn(params, model_state, batch):
         hidden, aux = model.apply(
             {"params": params}, batch["input_ids"], deterministic=True,
             return_hidden=True,
         )
-        lm = chunked_softmax_xent(
+        lm = _pick_xent(model.cfg)(
             hidden[:, :-1],
             params["wte"]["embedding"],
             batch["input_ids"][:, 1:],

@@ -1,0 +1,352 @@
+"""Pallas fused linear + softmax cross-entropy: the LM-head hot op.
+
+:func:`ops.xent.chunked_softmax_xent` already keeps the full ``(B*S, V)``
+logits out of the *residual* set, but every chunk's ``(C, V)`` logits tile
+still round-trips HBM — materialized by the matmul, re-read by logsumexp,
+re-materialized and re-read twice more in the checkpointed backward.  On
+the v5e that is ~20 GB of HBM traffic per GPT-2-small step (B=16, S=1024:
+the single largest non-matmul cost of the step — see docs/LM_PERF.md).
+
+This module fuses the head end-to-end in Pallas so logits live only in
+VMEM, tile by tile, and HBM sees just ``x``, ``wte``, and the O(N)
+outputs (~3 GB/step for the same shapes):
+
+- **forward** — grid (vocab-blocks OUTER, token-blocks inner): the weight
+  tile is fetched once per vocab block and stays in VMEM for the whole
+  token sweep; per-token online-logsumexp state (m, s) and the gathered
+  target logit accumulate in VMEM scratch sized (n_token_blocks, block_n)
+  across the outer sweeps.  Logits are computed TRANSPOSED — (block_v,
+  block_n), vocab on sublanes, tokens on lanes — so every per-token
+  reduction lands as a lane-major (1, block_n) row that indexes straight
+  into the scratch with no relayout.
+- **backward** — two kernels, mirroring the flash-attention dq/dkv split
+  (`ops/flash_attention.py`): ``dx`` with token-blocks outer (dx tile
+  accumulates in scratch over the vocab sweep), ``dwte`` with vocab-blocks
+  outer (accumulating directly into its output block, which is revisited
+  consecutively across the inner token sweep — the only revisit pattern
+  Pallas TPU guarantees stays resident in VMEM).  Both recompute the
+  logits tile from the saved (x, wte, lse): softmax probabilities are
+  ``exp(logit - lse)``, no renormalization pass needed.
+
+Semantics match :func:`ops.xent.chunked_softmax_xent` exactly (same
+masked-mean reduction; out-of-range targets contribute zero weight);
+``tests/test_fused_xent.py`` asserts value and gradient equivalence in
+interpret mode.
+
+Reference anchor: the reference stack has no such op — Keras
+``SparseCategoricalCrossentropy`` materializes full logits (SURVEY.md
+§2.3 Keras trainer row).  This is the TPU-first "Pallas kernels for the
+hot ops" obligation (SURVEY.md §2.4 native-code notes) applied to the
+LM head.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF
+
+#: Default tile sizes.  block_v x block_n fp32 logits is the dominant VMEM
+#: tenant (2048 x 512 x 4 B = 4 MB); weight tiles ride at bf16.
+BLOCK_TOKENS = 512
+BLOCK_VOCAB = 2048
+#: dx backward uses a bigger token tile: its dominant HBM cost is the full
+#: weight-table re-read per token block, so fewer/bigger token sweeps win.
+BLOCK_TOKENS_DX = 1024
+BLOCK_VOCAB_DX = 1024
+
+
+def _transposed_logits(w_ref, x_ref):
+    """(block_v, block_n) fp32 logits tile: rows = vocab, cols = tokens."""
+    return jax.lax.dot_general(
+        w_ref[...], x_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_sc, s_sc, g_sc,
+                *, block_v, v_true):
+    j = pl.program_id(0)   # vocab block (outer)
+    i = pl.program_id(1)   # token block (inner)
+    n_j = pl.num_programs(0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[pl.ds(i, 1), :] = jnp.full_like(m_sc[pl.ds(i, 1), :], NEG_INF)
+        s_sc[pl.ds(i, 1), :] = jnp.zeros_like(s_sc[pl.ds(i, 1), :])
+        g_sc[pl.ds(i, 1), :] = jnp.zeros_like(g_sc[pl.ds(i, 1), :])
+
+    logits = _transposed_logits(w_ref, x_ref)  # (block_v, block_n)
+    row = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+    logits = jnp.where(row < v_true, logits, NEG_INF)
+
+    t = t_ref[...]                      # (1, block_n) int32
+    match = row == t                    # broadcasts over sublanes
+    # Out-of-range targets (ignore labels) match no row of any block: the
+    # gathered logit stays 0 and the caller's weight for the row is 0.
+    g_part = jnp.sum(jnp.where(match, logits, 0.0), axis=0, keepdims=True)
+
+    m_prev = m_sc[pl.ds(i, 1), :]       # (1, block_n)
+    s_prev = s_sc[pl.ds(i, 1), :]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=0, keepdims=True))
+    s_new = s_prev * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(logits - m_new), axis=0, keepdims=True
+    )
+    m_sc[pl.ds(i, 1), :] = m_new
+    s_sc[pl.ds(i, 1), :] = s_new
+    g_sc[pl.ds(i, 1), :] = g_sc[pl.ds(i, 1), :] + g_part
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        lse_ref[...] = m_sc[pl.ds(i, 1), :] + jnp.log(s_sc[pl.ds(i, 1), :])
+        tgt_ref[...] = g_sc[pl.ds(i, 1), :]
+
+
+def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, c_ref, dx_ref, acc_sc,
+                   *, block_v, v_true):
+    i = pl.program_id(0)   # token block (outer)
+    j = pl.program_id(1)   # vocab block (inner)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    logits = _transposed_logits(w_ref, x_ref)
+    row = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+    logits = jnp.where(row < v_true, logits, NEG_INF)
+    p = jnp.exp(logits - lse_ref[...])          # (block_v, block_n)
+    match = row == t_ref[...]
+    dlog = c_ref[...] * (p - match.astype(jnp.float32))
+    # dx_i += sum_j dlogits_ji * wte_j : contract the vocab sublanes.
+    acc_sc[...] += jax.lax.dot_general(
+        dlog, w_ref[...].astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        dx_ref[...] = acc_sc[...]
+
+
+def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, c_ref, dw_ref,
+                   *, block_v, v_true):
+    j = pl.program_id(0)   # vocab block (outer)
+    i = pl.program_id(1)   # token block (inner)
+    n_i = pl.num_programs(1)
+
+    logits = _transposed_logits(w_ref, x_ref)
+    row = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+    logits = jnp.where(row < v_true, logits, NEG_INF)
+    p = jnp.exp(logits - lse_ref[...])
+    match = row == t_ref[...]
+    dlog = c_ref[...] * (p - match.astype(jnp.float32))
+    # dwte_j += sum_i dlogits_ji * x_i : contract the token lanes.  The
+    # output block's index depends only on j (outer), so the accumulation
+    # target stays resident across the whole inner sweep.
+    part = jax.lax.dot_general(
+        dlog, x_ref[...].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == 0)
+    def _first():
+        dw_ref[...] = part
+
+    @pl.when(i != 0)
+    def _rest():
+        dw_ref[...] = dw_ref[...] + part
+
+
+def _pad_to(x, mult, axis):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _fused_fwd_arrays(x, w, t, *, block_n, block_v, v_true, interpret):
+    """Run the forward kernel on padded 2-D operands.
+
+    x (N, D) compute-dtype, w (Vp, D) compute-dtype, t (N,) int32; N, Vp
+    already padded to the block sizes.  Returns (lse, tgt) fp32 (N,).
+    """
+    n, d = x.shape
+    vp = w.shape[0]
+    n_i, n_j = n // block_n, vp // block_v
+    mem = pl.ANY if interpret else pltpu.VMEM
+    t2 = t.reshape(n_i, block_n)
+
+    lse, tgt = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=block_v, v_true=v_true),
+        grid=(n_j, n_i),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda j, i: (i, 0), memory_space=mem),
+            pl.BlockSpec((block_v, d), lambda j, i: (j, 0), memory_space=mem),
+            pl.BlockSpec((1, block_n), lambda j, i: (i, 0), memory_space=mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_n), lambda j, i: (i, 0), memory_space=mem),
+            pl.BlockSpec((1, block_n), lambda j, i: (i, 0), memory_space=mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
+            jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((n_i, block_n), jnp.float32)] * 3,
+        interpret=interpret,
+    )(x, w, t2)
+    return lse.reshape(n), tgt.reshape(n)
+
+
+def _fused_bwd_arrays(x, w, t, lse, c, *, block_n_dx, block_v_dx,
+                      block_n_dw, block_v_dw, v_true, interpret):
+    """dx (N, D) and dw (Vp, D), both fp32, from padded operands."""
+    n, d = x.shape
+    vp = w.shape[0]
+    mem = pl.ANY if interpret else pltpu.VMEM
+
+    def common_specs(block_n, block_v, idx_x, idx_w, idx_row):
+        return [
+            pl.BlockSpec((block_n, d), idx_x, memory_space=mem),
+            pl.BlockSpec((block_v, d), idx_w, memory_space=mem),
+            pl.BlockSpec((1, block_n), idx_row, memory_space=mem),
+            pl.BlockSpec((1, block_n), idx_row, memory_space=mem),
+            pl.BlockSpec((1, block_n), idx_row, memory_space=mem),
+        ]
+
+    n_i, n_j = n // block_n_dx, vp // block_v_dx
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, block_v=block_v_dx, v_true=v_true),
+        grid=(n_i, n_j),
+        in_specs=common_specs(
+            block_n_dx, block_v_dx,
+            lambda i, j: (i, 0), lambda i, j: (j, 0), lambda i, j: (i, 0),
+        ),
+        out_specs=pl.BlockSpec((block_n_dx, d), lambda i, j: (i, 0),
+                               memory_space=mem),
+        out_shape=jax.ShapeDtypeStruct((n, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_n_dx, d), jnp.float32)],
+        interpret=interpret,
+    )(x, w, t.reshape(n_i, block_n_dx), lse.reshape(n_i, block_n_dx),
+      c.reshape(n_i, block_n_dx))
+
+    n_i, n_j = n // block_n_dw, vp // block_v_dw
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, block_v=block_v_dw, v_true=v_true),
+        grid=(n_j, n_i),
+        in_specs=common_specs(
+            block_n_dw, block_v_dw,
+            lambda j, i: (i, 0), lambda j, i: (j, 0), lambda j, i: (i, 0),
+        ),
+        out_specs=pl.BlockSpec((block_v_dw, d), lambda j, i: (j, 0),
+                               memory_space=mem),
+        out_shape=jax.ShapeDtypeStruct((vp, d), jnp.float32),
+        interpret=interpret,
+    )(x, w, t.reshape(n_i, block_n_dw), lse.reshape(n_i, block_n_dw),
+      c.reshape(n_i, block_n_dw))
+    return dx, dw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused(hidden2d, wte, t, w_row, compute_dtype, block_sizes, interpret):
+    out, _ = _fused_fwd(hidden2d, wte, t, w_row, compute_dtype, block_sizes,
+                        interpret)
+    return out
+
+
+def _fused_fwd(hidden2d, wte, t, w_row, compute_dtype, block_sizes,
+               interpret):
+    block_n, block_v = block_sizes[0], block_sizes[1]
+    n, _ = hidden2d.shape
+    v = wte.shape[0]
+    xc = _pad_to(hidden2d.astype(compute_dtype), block_n, 0)
+    wc = _pad_to(wte.astype(compute_dtype), block_v, 0)
+    tp = _pad_to(t, block_n, 0)
+    lse, tgt = _fused_fwd_arrays(
+        xc, wc, tp, block_n=block_n, block_v=block_v, v_true=v,
+        interpret=interpret,
+    )
+    lse, tgt = lse[:n], tgt[:n]
+    w_sum = jnp.maximum(jnp.sum(w_row), 1.0)
+    loss = jnp.sum((lse - tgt) * w_row) / w_sum
+    return loss, (hidden2d, wte, t, w_row, lse, w_sum)
+
+
+def _fused_bwd(compute_dtype, block_sizes, interpret, res, g):
+    hidden2d, wte, t, w_row, lse, w_sum = res
+    block_n_dx, block_v_dx = block_sizes[2], block_sizes[3]
+    # dw uses the forward's tiling (vocab outer); dx its own.
+    block_n_dw, block_v_dw = block_sizes[0], block_sizes[1]
+    block_n_pad = math.lcm(block_n_dx, block_n_dw)
+    n, _ = hidden2d.shape
+    v = wte.shape[0]
+    xc = _pad_to(hidden2d.astype(compute_dtype), block_n_pad, 0)
+    wc = _pad_to(wte.astype(compute_dtype),
+                 math.lcm(block_v_dx, block_v_dw), 0)
+    tp = _pad_to(t, block_n_pad, 0)
+    c = g * w_row / w_sum                       # (N,) fp32
+    cp = _pad_to(c.astype(jnp.float32), block_n_pad, 0)
+    lsep = _pad_to(lse, block_n_pad, 0)
+    dx, dw = _fused_bwd_arrays(
+        xc, wc, tp, lsep, cp,
+        block_n_dx=block_n_dx, block_v_dx=block_v_dx,
+        block_n_dw=block_n_dw, block_v_dw=block_v_dw,
+        v_true=v, interpret=interpret,
+    )
+    dx = dx[:n].astype(hidden2d.dtype)
+    dw = dw[:v].astype(wte.dtype)
+    # d(loss)/d(w_row) = g * (lse - tgt - loss)/w_sum; training never
+    # differentiates wrt the mask, so skip the extra tgt residual and
+    # return a zero cotangent of the right shape.
+    return dx, dw, None, jnp.zeros_like(w_row)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_softmax_xent(
+    hidden: jax.Array,   # (B, S, D) or (N, D) final hidden states
+    wte: jax.Array,      # (V, D) tied embedding / output head
+    targets: jax.Array,  # (B, S) / (N,) int labels
+    mask: jax.Array | None = None,  # same shape as targets; 1 = count
+    *,
+    compute_dtype: jnp.dtype | None = None,
+    block_tokens: int = BLOCK_TOKENS,
+    block_vocab: int = BLOCK_VOCAB,
+    block_tokens_dx: int = BLOCK_TOKENS_DX,
+    block_vocab_dx: int = BLOCK_VOCAB_DX,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Mean masked next-token NLL; logits never leave VMEM.
+
+    Drop-in for :func:`ops.xent.chunked_softmax_xent` — same reduction,
+    same out-of-range-target semantics, Pallas execution.  ``interpret``
+    defaults to auto (interpreter off-TPU so CPU tests and the virtual
+    mesh work).
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    v = wte.shape[0]
+    d = hidden.shape[-1]
+    x2 = hidden.reshape(-1, d)
+    n = x2.shape[0]
+    t = targets.reshape(n).astype(jnp.int32)
+    w_row = (
+        mask.reshape(n).astype(jnp.float32)
+        if mask is not None
+        else jnp.ones((n,), jnp.float32)
+    )
+    w_row = w_row * ((t >= 0) & (t < v)).astype(jnp.float32)
+    op_dtype = compute_dtype or jnp.result_type(hidden, wte)
+    blocks = (block_tokens, block_vocab, block_tokens_dx, block_vocab_dx)
+    return _fused(x2, wte, t, w_row, op_dtype, blocks, interpret)

@@ -83,6 +83,11 @@ FlatParams = dict[str, np.ndarray]
 #: Per-connection socket timeout inside the PS request handler: bounds how
 #: long a wedged peer (half-open TCP) can occupy a handler thread.
 _HANDLER_SOCKET_TIMEOUT_S = 30.0
+#: Response-send timeout.  settimeout() is a TOTAL deadline for sendall
+#: (not an idle bound), so a live-but-slow worker pulling a large shard
+#: over a thin link needs far more than the receive bound; this only
+#: exists to eventually unstick a truly dead peer.
+_HANDLER_SEND_TIMEOUT_S = 600.0
 #: serve_until's post-done drain cap: after the exit condition holds, wait
 #: at most this long for inflight handlers before returning anyway.
 _DRAIN_CAP_S = 5.0
@@ -272,6 +277,9 @@ class PSServer:
                         header, data = _recv_msg(self.request)
                     except (ConnectionError, json.JSONDecodeError, OSError):
                         return
+                    # Request received — switch to the (much longer) send
+                    # deadline before building/streaming the response.
+                    self.request.settimeout(_HANDLER_SEND_TIMEOUT_S)
                     self._handle(header, data)
                 except OSError:
                     return  # peer vanished mid-response; nothing to unwind

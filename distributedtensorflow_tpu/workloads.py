@@ -165,7 +165,8 @@ def get_workload(name: str, *, test_size: bool = False,
                  pp_virtual: int = 1,
                  seq_len: int | None = None,
                  remat: bool | str | None = None,
-                 attn_impl: str | None = None) -> Workload:
+                 attn_impl: str | None = None,
+                 xent_impl: str | None = None) -> Workload:
     """Build a preset by name.  ``test_size`` shrinks models for CI.
 
     ``sp_scheme`` picks the sequence-parallel attention used by ``gpt_lm``
@@ -324,13 +325,15 @@ def get_workload(name: str, *, test_size: bool = False,
 
         cfg = gpt_tiny() if test_size else gpt_small()
         seq = seq_len or (64 if test_size else 2048)
-        if remat is not None or attn_impl is not None or seq > cfg.max_seq:
+        if (remat is not None or attn_impl is not None
+                or xent_impl is not None or seq > cfg.max_seq):
             # remat: True/False = whole blocks; "attn" = attention-only.
             cfg = dataclasses.replace(
                 cfg,
                 remat=cfg.remat if remat is None else remat is True,
                 remat_attn=remat == "attn",
                 attn_impl=attn_impl or cfg.attn_impl,
+                xent_impl=xent_impl or cfg.xent_impl,
                 max_seq=max(cfg.max_seq, seq),
             )
         gbs = global_batch_size or (8 if test_size else 64)
@@ -468,13 +471,15 @@ def get_workload(name: str, *, test_size: bool = False,
 
         cfg = gpt_moe_tiny() if test_size else gpt_moe_small()
         seq = seq_len or (64 if test_size else 2048)
-        if remat is not None or attn_impl is not None or seq > cfg.max_seq:
+        if (remat is not None or attn_impl is not None
+                or xent_impl is not None or seq > cfg.max_seq):
             # remat: True/False = whole blocks; "attn" = attention-only.
             cfg = dataclasses.replace(
                 cfg,
                 remat=cfg.remat if remat is None else remat is True,
                 remat_attn=remat == "attn",
                 attn_impl=attn_impl or cfg.attn_impl,
+                xent_impl=xent_impl or cfg.xent_impl,
                 max_seq=max(cfg.max_seq, seq),
             )
         gbs = global_batch_size or (8 if test_size else 64)

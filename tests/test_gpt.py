@@ -295,3 +295,30 @@ def test_chunked_xent_bf16_compute_dtype_close_to_fp32():
     for g in grads:
         assert bool(jnp.all(jnp.isfinite(g)))
         assert float(jnp.max(jnp.abs(g))) > 0.0
+
+
+def test_workload_trains_with_fused_xent(devices):
+    """gpt_lm with xent_impl="fused" (Pallas head, interpret mode on CPU)
+    trains through the full engine path and the loss falls — the
+    integration guard for the BENCH_LM_XENT=fused / --xent-impl=fused
+    on-chip A/B."""
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=8,
+                      xent_impl="fused")
+    assert wl.model.cfg.xent_impl == "fused"
+    from distributedtensorflow_tpu.data import InputContext, device_put_batch
+    from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+
+    mesh = build_mesh(MeshSpec(data=-1), devices)
+    wl = wl.for_mesh(mesh)
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    it = wl.input_fn(InputContext(1, 0, wl.global_batch_size), 0)
+    losses = []
+    for _ in range(12):
+        batch = device_put_batch(next(it), mesh)
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.15, losses

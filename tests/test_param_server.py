@@ -332,3 +332,32 @@ def test_single_worker_async_matches_sequential_sgd():
         np.testing.assert_allclose(
             async_params[k], seq_flat[k], rtol=1e-5, atol=1e-6
         )
+
+
+def test_wedged_peer_cannot_pin_serve_until():
+    """A client that connects and then never sends its request must not
+    block serve_until past the bounded drain: the handler counts the
+    connection as inflight from accept (so stop() can't race a received
+    push), and the post-done drain is capped (_DRAIN_CAP_S) so a
+    half-open peer can't pin the ps task past its exit condition."""
+    import socket
+
+    from distributedtensorflow_tpu.parallel import param_server as ps_mod
+
+    server = PSServer(_toy_params(), lambda: optax.sgd(0.1))
+    try:
+        # Wedge: open the connection, send nothing, keep it alive.
+        wedge = socket.create_connection(("127.0.0.1", server.port))
+        time.sleep(0.3)  # let the handler thread enter its blocking recv
+        t0 = time.monotonic()
+        # total_updates=0 holds immediately; only the wedged connection
+        # keeps inflight nonzero.  Must return within the drain cap.
+        version = server.serve_until(0, poll_s=0.01)
+        elapsed = time.monotonic() - t0
+        assert version == 0
+        assert elapsed < ps_mod._DRAIN_CAP_S + 2.0, (
+            f"serve_until took {elapsed:.1f}s — drain cap not applied"
+        )
+        wedge.close()
+    finally:
+        server.stop()

@@ -82,7 +82,7 @@ while true; do
       fi
     fi
     # -- p2: non-Pallas LM sweep (throughput evidence, cheap) ------------
-    run lm_bs16       600 python bench_lm.py \
+    run lm_bs16       600 env BENCH_LM_BATCH=16 python bench_lm.py \
       || { probe || break; }
     run lm_bs24       600 env BENCH_LM_BATCH=24 python bench_lm.py \
       || { probe || break; }
@@ -111,7 +111,7 @@ while true; do
     run bert          900 python bench_bert.py       || { probe || break; }
     # -- p5: Pallas rows, canary-gated, LAST -----------------------------
     pallas_missing=0
-    for s in attn_4k lm_bs32_pl lm_s8192_pl attn_16k32k; do
+    for s in attn_4k lm_bs16_fx lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
       [ -f "$STAMPS/$s" ] || pallas_missing=1
     done
     if (( pallas_missing == 0 )); then
@@ -119,7 +119,13 @@ while true; do
     elif pallas_ok; then
       log "pallas canary ok"
       run attn_4k     900 python bench_attn.py       || { probe || break; }
+      # fused-vs-chunked head A/B at the headline config (the reason
+      # ops/fused_xent.py exists) — Pallas-compiling, so canary-gated.
+      run lm_bs16_fx  900 env BENCH_LM_BATCH=16 BENCH_LM_XENT=fused python bench_lm.py \
+        || { probe || break; }
       run lm_bs32_pl  900 env BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas python bench_lm.py \
+        || { probe || break; }
+      run lm_bs32_plfx 900 env BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas BENCH_LM_XENT=fused python bench_lm.py \
         || { probe || break; }
       run lm_s8192_pl 900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn python bench_lm.py \
         || { probe || break; }
@@ -133,8 +139,8 @@ while true; do
 
   missing=0
   for s in profile_lm lm_bs16 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
-           conv_tpu resnet resnet_bs256 bert attn_4k lm_bs32_pl lm_s8192_pl \
-           attn_16k32k; do
+           conv_tpu resnet resnet_bs256 bert attn_4k lm_bs16_fx lm_bs32_pl \
+           lm_bs32_plfx lm_s8192_pl attn_16k32k; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi

@@ -183,6 +183,7 @@ def run_evaluator(args) -> None:
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual, seq_len=args.seq_len,
         attn_impl=args.attn_impl,
+        xent_impl=args.xent_impl,
         remat=REMAT_FLAG[args.remat],
     )
     if wl.eval_fn is None:
@@ -565,6 +566,10 @@ def main() -> None:
                    default=None,
                    help="LM presets: attention kernel (auto = Pallas flash"
                         " on TPU past the evidenced seq threshold)")
+    p.add_argument("--xent-impl", choices=("chunked", "fused"), default=None,
+                   help="LM presets: head-loss kernel (chunked = lax.scan"
+                        " over token chunks; fused = Pallas fused_xent,"
+                        " logits never leave VMEM)")
     args = p.parse_args()
     if args.config:
         import sys
@@ -647,6 +652,7 @@ def main() -> None:
         seq_len=args.seq_len,
         remat=REMAT_FLAG[args.remat],
         attn_impl=args.attn_impl,
+        xent_impl=args.xent_impl,
     )
     wl = apply_optimizer_flags(wl, args)
     spec = parse_mesh(args.mesh) or wl.mesh_spec
