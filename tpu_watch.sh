@@ -109,6 +109,19 @@ while true; do
     run resnet        900 python bench.py            || { probe || break; }
     run resnet_bs256  900 env BENCH_BATCH=256 python bench.py || { probe || break; }
     run bert          900 python bench_bert.py       || { probe || break; }
+    # ResNet step profile: the instrument for pushing past 1.07x (same
+    # role as profile_lm for the LM row).
+    if [ ! -f "$STAMPS/profile_resnet" ]; then
+      if timeout 900 python train.py --workload imagenet_resnet50 --steps 20 \
+          --batch-size 128 --profile-dir BENCH_RESULTS/profile_resnet_tpu \
+          --profile-start 8 --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
+          && find BENCH_RESULTS/profile_resnet_tpu -name '*.xplane.pb' | grep -q .; then
+        touch "$STAMPS/profile_resnet"; log "item profile_resnet: LANDED"
+      else
+        rm -rf BENCH_RESULTS/profile_resnet_tpu
+        log "item profile_resnet: failed"; probe || break
+      fi
+    fi
     # -- p5: Pallas rows, canary-gated, LAST -----------------------------
     pallas_missing=0
     for s in attn_4k lm_bs16_fx lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
@@ -139,8 +152,8 @@ while true; do
 
   missing=0
   for s in profile_lm lm_bs16 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
-           conv_tpu resnet resnet_bs256 bert attn_4k lm_bs16_fx lm_bs32_pl \
-           lm_bs32_plfx lm_s8192_pl attn_16k32k; do
+           conv_tpu resnet resnet_bs256 bert profile_resnet attn_4k \
+           lm_bs16_fx lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
