@@ -24,6 +24,7 @@ if not probe_devices_with_retries("bench_lm"):
     raise SystemExit(2)
 
 import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 # The axon sitecustomize force-selects the TPU platform over JAX_PLATFORMS;
@@ -69,13 +70,29 @@ def main() -> None:
     batch = device_put_batch({"input_ids": ids}, mesh)
 
     # AOT-compile once; reuse for warmup, timing, and cost analysis.
-    compiled = step.lower(state, batch, rng).compile()
+    # BENCH_LM_INNER=K bundles K optimizer steps into one dispatch
+    # (engine.make_multi_train_step): the A/B against the default
+    # measures how much of the step time is host dispatch / tunnel RTT
+    # rather than chip time.
+    inner = int(os.environ.get("BENCH_LM_INNER", "1"))
     n_steps = 20
+    if inner > 1:
+        from distributedtensorflow_tpu.train import make_multi_train_step
+
+        step = make_multi_train_step(
+            wl.loss_fn, mesh, specs, steps_per_call=inner
+        )
+        batch = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (inner,) + x.shape), batch
+        )
+        n_steps = -(-n_steps // inner)  # outer dispatches
+    compiled = step.lower(state, batch, rng).compile()
     from bench_probe import mfu_fields, timed_steps
 
     state, dt = timed_steps(compiled, state, batch, rng,
-                            n_steps=n_steps, warmup=3)
-    tokens_per_sec = n_steps * wl.global_batch_size * seq / dt
+                            n_steps=n_steps, warmup=max(1, 3 // inner))
+    n_opt_steps = n_steps * inner
+    tokens_per_sec = n_opt_steps * wl.global_batch_size * seq / dt
     per_chip = tokens_per_sec / n_chips
 
     # Analytic MODEL FLOPs per token, PaLM-style MFU convention: 6N for
@@ -94,7 +111,7 @@ def main() -> None:
     device_kind = jax.devices()[0].device_kind
     mfu = mfu_fields(
         compiled, dt, n_steps, device_kind,
-        per_token * wl.global_batch_size * seq / n_chips,
+        inner * per_token * wl.global_batch_size * seq / n_chips,
         "analytic_model_flops_6N_plus_12LHS_palm_mfu",
     )
 
@@ -113,7 +130,8 @@ def main() -> None:
         "remat": remat,
         "attn_impl": attn_impl or "auto",
         "xent_impl": xent_impl or "chunked",
-        "step_time_ms": round(1000 * dt / n_steps, 2),
+        "step_time_ms": round(1000 * dt / n_opt_steps, 2),
+        "steps_per_call": inner,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     from bench_probe import is_tpu_platform, persist_result

@@ -156,13 +156,19 @@ def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
     Returns ``(state, dt_seconds)``."""
     import time
 
+    import jax
+    import numpy as _np
+
+    def sync(m):  # scalar loss, or (steps_per_call,) stacked losses
+        float(_np.asarray(jax.device_get(m["loss"])).ravel()[-1])
+
     for _ in range(warmup):
         state, metrics = compiled(state, batch, rng)
-        float(metrics["loss"])
+        sync(metrics)
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = compiled(state, batch, rng)
-    float(metrics["loss"])
+    sync(metrics)
     return state, time.perf_counter() - t0
 
 

@@ -4,6 +4,7 @@ from .state import TrainState, create_sharded_state, split_variables  # noqa: F4
 from .engine import (  # noqa: F401
     accumulate_gradients,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
     split_microbatches,
 )

@@ -13,6 +13,7 @@ import logging
 from typing import Any, Callable, Iterable
 
 import jax
+import jax.numpy as jnp
 
 from ..utils.metrics import MetricWriter, ThroughputMeter
 from .state import TrainState
@@ -29,6 +30,10 @@ class TrainerConfig:
     eval_every: int = 0  # 0 = no eval
     eval_steps: int = 10
     checkpoint_every: int = 0  # 0 = no checkpointing
+    #: Optimizer steps bundled into one dispatch (Keras steps_per_execution
+    #: analogue).  > 1 requires a make_multi_train_step-built train_step;
+    #: hooks fire on period boundary-crossings with up-to-k-step latency.
+    steps_per_call: int = 1
     global_batch_size: int = 0
     logdir: str | None = None
     # Profiling window (SURVEY.md §5.1): capture a jax.profiler trace of
@@ -146,21 +151,52 @@ class Trainer:
     def _fit_loop(self, state, it, rng, eval_iter_fn, watchdog=None):
         cfg = self.config
         start_step = int(state.step)
+        # steps_per_call > 1: self.train_step is a multi-step executable
+        # (engine.make_multi_train_step) consuming k stacked batches per
+        # dispatch; every hook below fires on BOUNDARY CROSSINGS of its
+        # period, which reduces to the classic (step+1) % every == 0 at
+        # k = 1.  The final chunk clamps to the steps remaining, so
+        # total_steps is always exact; hook latency (log/eval/checkpoint/
+        # preemption reaction) becomes up to k steps — the same trade
+        # Keras documents for steps_per_execution.
+        k = max(1, cfg.steps_per_call)
+
+        def crosses(lo, hi, every):  # does (lo, hi] contain a multiple?
+            return every and (hi // every) > (lo // every)
+
         # Profile window is relative to THIS run's first step, so resuming
         # from a checkpoint past profile_start still produces a trace.
         profile_at = start_step + cfg.profile_start
         profiling = False
         try:
-            for step_i in range(start_step, cfg.total_steps):
-                if cfg.profile_dir and step_i == profile_at:
+            step_i = start_step
+            while step_i < cfg.total_steps:
+                # Clamp the final chunk so a resume at an unaligned step or
+                # a non-divisible total never overruns total_steps (the
+                # shorter stack recompiles the scanned program once).
+                k_eff = min(k, cfg.total_steps - step_i)
+                step_next = step_i + k_eff
+                if (cfg.profile_dir and not profiling
+                        and step_i <= profile_at < step_next):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
-                batch = next(it)
+                if k == 1:
+                    batch = next(it)
+                else:
+                    # Explicit loop, not a genexp: an exhausted iterator
+                    # must surface as StopIteration (the k=1 behavior),
+                    # not PEP-479's RuntimeError.
+                    bundle = []
+                    for _ in range(k_eff):
+                        bundle.append(next(it))
+                    batch = jax.tree.map(lambda *xs: jnp.stack(xs), *bundle)
                 state, metrics = self.train_step(state, batch, rng)
-                self.meter.update()
+                if k > 1:  # stacked (k_eff, ...) metrics; report the last
+                    metrics = jax.tree.map(lambda v: v[-1], metrics)
+                self.meter.update(k_eff)
                 if watchdog is not None:
                     watchdog.ping()
-                if profiling and step_i + 1 >= profile_at + cfg.profile_steps:
+                if profiling and step_next >= profile_at + cfg.profile_steps:
                     # Force the profiled steps to actually execute before
                     # closing the trace (fetch, not block_until_ready — see
                     # bench.py note on the axon backend).
@@ -170,7 +206,8 @@ class Trainer:
                     logger.info(
                         "profiler trace written to %s", cfg.profile_dir
                     )
-                if cfg.log_every and (step_i + 1) % cfg.log_every == 0:
+                step_i = step_next - 1  # hooks below address the last step
+                if crosses(step_next - k_eff, step_next, cfg.log_every):
                     # jax.Array fetches sync here, off the critical cadence
                     last_metrics = {k: float(v) for k, v in metrics.items()}
                     last_metrics.update(self.meter.rates())
@@ -179,10 +216,9 @@ class Trainer:
                     logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
                     self.meter.start()
                 if (
-                    cfg.eval_every
-                    and self.eval_step is not None
+                    self.eval_step is not None
                     and eval_iter_fn is not None
-                    and (step_i + 1) % cfg.eval_every == 0
+                    and crosses(step_next - k_eff, step_next, cfg.eval_every)
                 ):
                     eval_metrics = self.evaluate(state, eval_iter_fn())
                     self._last_eval_metrics = eval_metrics
@@ -198,9 +234,9 @@ class Trainer:
                     ):
                         return state
                 if (
-                    cfg.checkpoint_every
-                    and self.checkpointer is not None
-                    and (step_i + 1) % cfg.checkpoint_every == 0
+                    self.checkpointer is not None
+                    and crosses(step_next - k_eff, step_next,
+                                cfg.checkpoint_every)
                 ):
                     self.checkpointer.save(
                         step_i + 1, state, metrics=self._ckpt_metrics()
@@ -223,6 +259,7 @@ class Trainer:
                     )
                     self._preempted = True
                     return state
+                step_i = step_next
         finally:
             if profiling:  # exception mid-window, or window past total_steps
                 jax.profiler.stop_trace()

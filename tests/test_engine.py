@@ -138,3 +138,52 @@ def test_batchnorm_model_state_updates(dp_mesh):
     state, _ = step(state, batch, jax.random.PRNGKey(0))
     after = jax.tree.leaves(jax.device_get(state.model_state))
     assert any(not np.allclose(a, b) for a, b in zip(before, after))
+
+
+def test_multi_step_matches_single_steps(devices):
+    """make_multi_train_step(steps_per_call=K): one dispatch of K scanned
+    optimizer steps follows the same trajectory as K single-step
+    dispatches (same rng fold-in of the step counter; tolerances cover
+    XLA re-fusing the scanned program), with metrics stacked (K, ...).  The host-bound analogue of Keras
+    steps_per_execution."""
+    from distributedtensorflow_tpu.train import make_multi_train_step
+
+    mesh = build_mesh(MeshSpec(data=2, model=2), devices[:4])
+    model, state0, specs = make_lenet_setup(mesh)
+    state_a = state_b = state0  # immutable; both runs start identical
+    loss_fn = classification_loss(model)
+    rng = jax.random.PRNGKey(7)
+    k = 4
+    batches = [synthetic_batch(i) for i in range(k)]
+
+    single = make_train_step(loss_fn, mesh, specs, donate=False)
+    for b in batches:
+        state_a, m_single = single(state_a, b, rng)
+
+    multi = make_multi_train_step(loss_fn, mesh, specs, steps_per_call=k,
+                                  donate=False)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+    state_b, m_multi = multi(state_b, stacked, rng)
+
+    assert int(state_b.step) == int(state_a.step) == k
+    assert m_multi["loss"].shape == (k,)
+    np.testing.assert_allclose(
+        np.asarray(m_multi["loss"][-1]), np.asarray(m_single["loss"]),
+        rtol=1e-6,
+    )
+    for pa, pb in zip(jax.tree.leaves(state_a.params),
+                      jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-4, atol=1e-7)
+
+
+def test_multi_step_one_is_single(devices):
+    from distributedtensorflow_tpu.train import make_multi_train_step
+
+    mesh = build_mesh(MeshSpec(data=2), devices[:2])
+    model, state, specs = make_lenet_setup(mesh)
+    step = make_multi_train_step(
+        classification_loss(model), mesh, specs, steps_per_call=1
+    )
+    state, metrics = step(state, synthetic_batch(0), jax.random.PRNGKey(0))
+    assert int(state.step) == 1 and np.isfinite(float(metrics["loss"]))

@@ -192,3 +192,45 @@ def test_preemption_stops_fit_with_consistent_save(tmp_path, dp_mesh):
     trainer2 = Trainer(train_step, cfg, checkpointer=mgr)
     out2 = trainer2.fit(state2, _batches(10 - fired_at), jax.random.PRNGKey(1))
     assert int(out2.step) == 10
+
+
+def test_steps_per_call_bundles_dispatches(tmp_path, dp_mesh):
+    """steps_per_call=3: the fit loop consumes 3 batches per dispatch,
+    fires log/eval hooks on boundary crossings, reaches total_steps
+    (rounded up to whole calls), and follows the single-step trajectory."""
+    from distributedtensorflow_tpu.models import LeNet5
+    from distributedtensorflow_tpu.train import make_multi_train_step
+
+    model = LeNet5()
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(0.05, momentum=0.9), dp_mesh, jax.random.PRNGKey(0)
+    )
+    loss_fn = classification_loss(model)
+    eval_step = make_eval_step(classification_eval(model), dp_mesh, specs)
+
+    multi = make_multi_train_step(loss_fn, dp_mesh, specs, steps_per_call=3,
+                                  donate=False)
+    cfg = TrainerConfig(
+        total_steps=6, log_every=3, eval_every=3, eval_steps=1,
+        steps_per_call=3, global_batch_size=16,
+        logdir=str(tmp_path / "logs"),
+    )
+    trainer = Trainer(multi, cfg, eval_step=eval_step)
+    out = trainer.fit(
+        state, _batches(6), jax.random.PRNGKey(1),
+        eval_iter_fn=lambda: _batches(1, seed=99),
+    )
+    assert int(out.step) == 6
+    assert trainer._last_eval_metrics is not None
+
+    # trajectory equivalence vs the single-step loop on the same batches
+    single = make_train_step(loss_fn, dp_mesh, specs, donate=False)
+    cfg1 = TrainerConfig(total_steps=6, log_every=0, global_batch_size=16)
+    out1 = Trainer(single, cfg1).fit(
+        state, _batches(6), jax.random.PRNGKey(1)
+    )
+    for pa, pb in zip(jax.tree.leaves(out.params),
+                      jax.tree.leaves(out1.params)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-4, atol=1e-7)

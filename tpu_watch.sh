@@ -84,6 +84,10 @@ while true; do
     # -- p2: non-Pallas LM sweep (throughput evidence, cheap) ------------
     run lm_bs16       600 env BENCH_LM_BATCH=16 python bench_lm.py \
       || { probe || break; }
+    # 20 optimizer steps per dispatch: the A/B vs lm_bs16 splits chip
+    # time from host-dispatch/tunnel-RTT time (engine.make_multi_train_step).
+    run lm_bs16_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
+      || { probe || break; }
     run lm_bs24       600 env BENCH_LM_BATCH=24 python bench_lm.py \
       || { probe || break; }
     run lm_bs32_rattn 600 env BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn python bench_lm.py \
@@ -151,7 +155,7 @@ while true; do
   done
 
   missing=0
-  for s in profile_lm lm_bs16 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
+  for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
            conv_tpu resnet resnet_bs256 bert profile_resnet attn_4k \
            lm_bs16_fx lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))

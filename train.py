@@ -470,6 +470,10 @@ def main() -> None:
                    help="mesh axes, e.g. 'data=-1' or 'data=2,model=4' "
                         "(default: workload preset = its reference strategy)")
     p.add_argument("--accum-steps", type=int, default=None)
+    p.add_argument("--steps-per-call", type=int, default=1,
+                   help="optimizer steps bundled into one XLA dispatch"
+                        " (Keras steps_per_execution analogue; amortizes"
+                        " host dispatch/RTT, hooks fire every k steps)")
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--eval-every", type=int, default=0)
     p.add_argument("--target-metric", default=None,
@@ -679,9 +683,17 @@ def main() -> None:
         wl.init_fn, wl.make_optimizer(), mesh, rng,
         rules=wl.layout, fsdp=wl.fsdp,
     )
-    train_step = make_train_step(
-        wl.loss_fn, mesh, specs, accum_steps=accum
-    )
+    if args.steps_per_call > 1:
+        from distributedtensorflow_tpu.train import make_multi_train_step
+
+        train_step = make_multi_train_step(
+            wl.loss_fn, mesh, specs,
+            steps_per_call=args.steps_per_call, accum_steps=accum,
+        )
+    else:
+        train_step = make_train_step(
+            wl.loss_fn, mesh, specs, accum_steps=accum
+        )
     eval_step = (
         make_eval_step(wl.eval_fn, mesh, specs) if wl.eval_fn else None
     )
@@ -735,7 +747,12 @@ def main() -> None:
             # checkpoint semantics)
             logging.info("fast-forwarding input %d batches", restored_step)
             raw_iter = skip_batches(iter(raw_iter), restored_step)
-    train_iter = Prefetcher(raw_iter, mesh)
+    # steps_per_call pops k batches back-to-back after each multi-step
+    # dispatch returns; scale the prefetch depth so those pops hit buffered
+    # transfers instead of serializing host→device I/O with compute.
+    train_iter = Prefetcher(
+        raw_iter, mesh, buffer_size=max(2, 2 * args.steps_per_call)
+    )
 
     trainer = Trainer(
         train_step,
@@ -748,6 +765,7 @@ def main() -> None:
             # datasets don't pay a full re-read every eval_every steps
             eval_steps=0 if args.eval_data_dir else 10,
             checkpoint_every=args.checkpoint_every,
+            steps_per_call=args.steps_per_call,
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
             profile_dir=args.profile_dir,
