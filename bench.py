@@ -131,9 +131,17 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
     state, specs = create_sharded_state(
         init_fn, optax.sgd(0.1, momentum=0.9, nesterov=True), mesh, rng
     )
-    step = make_train_step(
-        classification_loss(model, weight_decay=1e-4), mesh, specs
-    )
+    # BENCH_INNER=K bundles K optimizer steps per dispatch (the same
+    # host-dispatch/RTT A/B bench_lm runs via BENCH_LM_INNER).
+    inner = int(os.environ.get("BENCH_INNER", "1"))
+    loss_fn = classification_loss(model, weight_decay=1e-4)
+    if inner > 1:
+        from distributedtensorflow_tpu.train import make_multi_train_step
+
+        step = make_multi_train_step(loss_fn, mesh, specs,
+                                     steps_per_call=inner)
+    else:
+        step = make_train_step(loss_fn, mesh, specs)
 
     # Device-resident synthetic batch: measures the compute+collective path
     # (host input is benchmarked separately by the input-pipeline tests).
@@ -154,12 +162,18 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
     # AOT-compile ONCE and reuse the executable for warmup, timing, and
     # cost analysis (a separate lower().compile() for cost analysis alone
     # would pay a second full ResNet-50 compile over the flaky tunnel).
+    if inner > 1:
+        batch = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (inner,) + x.shape), batch
+        )
+        n_steps = -(-n_steps // inner)
+        warmup = max(1, warmup // inner)
     compiled = step.lower(state, batch, rng).compile()
     from bench_probe import mfu_fields, timed_steps
 
     state, dt = timed_steps(compiled, state, batch, rng,
                             n_steps=n_steps, warmup=warmup)
-    images_per_sec = n_steps * global_batch / dt
+    images_per_sec = n_steps * inner * global_batch / dt
     per_chip = images_per_sec / n_chips
 
     # Model-FLOPs utilization, computed per chip on both sides: XLA's cost
@@ -168,7 +182,7 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
     # divided down by n_chips (224px constant scaled by conv-FLOP area).
     mfu = mfu_fields(
         compiled, dt, n_steps, device_kind,
-        RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
+        inner * RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
         * (image_size / 224.0) ** 2 / n_chips,
         "analytic_12.3GF_per_image",
     )
@@ -183,9 +197,10 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         "device_kind": device_kind,
         "n_chips": n_chips,
         "global_batch": global_batch,
-        "n_steps": n_steps,
+        "n_steps": n_steps * inner,
         "image_size": image_size,
-        "step_time_ms": round(1000 * dt / n_steps, 2),
+        "step_time_ms": round(1000 * dt / (n_steps * inner), 2),
+        "steps_per_call": inner,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
 

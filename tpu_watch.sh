@@ -111,6 +111,7 @@ while true; do
     fi
     # -- p4: headline refresh with the MFU pair --------------------------
     run resnet        900 python bench.py            || { probe || break; }
+    run resnet_in10   900 env BENCH_INNER=10 python bench.py || { probe || break; }
     run resnet_bs256  900 env BENCH_BATCH=256 python bench.py || { probe || break; }
     run bert          900 python bench_bert.py       || { probe || break; }
     # ResNet step profile: the instrument for pushing past 1.07x (same
@@ -156,7 +157,7 @@ while true; do
 
   missing=0
   for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
-           conv_tpu resnet resnet_bs256 bert profile_resnet attn_4k \
+           conv_tpu resnet resnet_in10 resnet_bs256 bert profile_resnet attn_4k \
            lm_bs16_fx lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
