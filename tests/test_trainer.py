@@ -234,3 +234,21 @@ def test_steps_per_call_bundles_dispatches(tmp_path, dp_mesh):
                       jax.tree.leaves(out1.params)):
         np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
                                    rtol=1e-4, atol=1e-7)
+
+
+def test_profile_window_writes_xplane(tmp_path, dp_mesh):
+    """--profile-dir plumbing: the fit loop opens the jax.profiler window
+    at profile_start, closes it after profile_steps, and an *.xplane.pb
+    lands on disk (what tools/profile_summary.py and the watcher's
+    profile_lm/profile_resnet items consume)."""
+    import glob
+
+    _, state, train_step, _ = _setup(dp_mesh)
+    prof = tmp_path / "prof"
+    cfg = TrainerConfig(
+        total_steps=8, log_every=0, global_batch_size=16,
+        profile_dir=str(prof), profile_start=3, profile_steps=2,
+    )
+    Trainer(train_step, cfg).fit(state, _batches(8), jax.random.PRNGKey(1))
+    hits = glob.glob(str(prof / "**" / "*.xplane.pb"), recursive=True)
+    assert hits, f"no xplane.pb under {prof}"
