@@ -70,17 +70,32 @@ def _transposed_logits(w_ref, x_ref):
     )
 
 
+#: Sublane depth of the forward scratch accumulators.  The per-token-block
+#: state lives in (n_token_blocks, _SUB, block_n) scratch: the dynamically
+#: indexed dimension is the UNTILED leading one (tiling applies to the
+#: trailing (_SUB, block_n) = (8, lanes) pair), so ``pl.ds(i, 1)`` never
+#: asks Mosaic for an unaligned dynamic sublane slice — which interpret
+#: mode would happily accept and the real TPU lowering may not.
+_SUB = 8
+
+
 def _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_sc, s_sc, g_sc,
                 *, block_v, v_true):
     j = pl.program_id(0)   # vocab block (outer)
     i = pl.program_id(1)   # token block (inner)
     n_j = pl.num_programs(0)
 
+    def read(sc):          # (1, block_n) row of token-block i's state
+        return sc[pl.ds(i, 1)][0, :1, :].reshape(1, -1)
+
+    def write(sc, val):    # broadcast the (1, block_n) row over _SUB
+        sc[pl.ds(i, 1)] = jnp.broadcast_to(val, (1, _SUB, val.shape[-1]))
+
     @pl.when(j == 0)
     def _init():
-        m_sc[pl.ds(i, 1), :] = jnp.full_like(m_sc[pl.ds(i, 1), :], NEG_INF)
-        s_sc[pl.ds(i, 1), :] = jnp.zeros_like(s_sc[pl.ds(i, 1), :])
-        g_sc[pl.ds(i, 1), :] = jnp.zeros_like(g_sc[pl.ds(i, 1), :])
+        write(m_sc, jnp.full((1, m_sc.shape[-1]), NEG_INF, m_sc.dtype))
+        write(s_sc, jnp.zeros((1, s_sc.shape[-1]), s_sc.dtype))
+        write(g_sc, jnp.zeros((1, g_sc.shape[-1]), g_sc.dtype))
 
     logits = _transposed_logits(w_ref, x_ref)  # (block_v, block_n)
     row = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
@@ -92,20 +107,20 @@ def _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_sc, s_sc, g_sc,
     # gathered logit stays 0 and the caller's weight for the row is 0.
     g_part = jnp.sum(jnp.where(match, logits, 0.0), axis=0, keepdims=True)
 
-    m_prev = m_sc[pl.ds(i, 1), :]       # (1, block_n)
-    s_prev = s_sc[pl.ds(i, 1), :]
+    m_prev = read(m_sc)                 # (1, block_n)
+    s_prev = read(s_sc)
     m_new = jnp.maximum(m_prev, jnp.max(logits, axis=0, keepdims=True))
     s_new = s_prev * jnp.exp(m_prev - m_new) + jnp.sum(
         jnp.exp(logits - m_new), axis=0, keepdims=True
     )
-    m_sc[pl.ds(i, 1), :] = m_new
-    s_sc[pl.ds(i, 1), :] = s_new
-    g_sc[pl.ds(i, 1), :] = g_sc[pl.ds(i, 1), :] + g_part
+    write(m_sc, m_new)
+    write(s_sc, s_new)
+    write(g_sc, read(g_sc) + g_part)
 
     @pl.when(j == n_j - 1)
     def _finalize():
-        lse_ref[...] = m_sc[pl.ds(i, 1), :] + jnp.log(s_sc[pl.ds(i, 1), :])
-        tgt_ref[...] = g_sc[pl.ds(i, 1), :]
+        lse_ref[...] = read(m_sc) + jnp.log(read(s_sc))
+        tgt_ref[...] = read(g_sc)
 
 
 def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, c_ref, dx_ref, acc_sc,
@@ -202,7 +217,7 @@ def _fused_fwd_arrays(x, w, t, *, block_n, block_v, v_true, interpret):
             jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
             jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((n_i, block_n), jnp.float32)] * 3,
+        scratch_shapes=[pltpu.VMEM((n_i, _SUB, block_n), jnp.float32)] * 3,
         interpret=interpret,
     )(x, w, t2)
     return lse.reshape(n), tgt.reshape(n)
