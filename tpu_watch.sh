@@ -129,7 +129,7 @@ while true; do
     fi
     # -- p5: Pallas rows, canary-gated, LAST -----------------------------
     pallas_missing=0
-    for s in attn_4k lm_bs16_fx lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
+    for s in attn_4k lm_bs16_fx lm_bs16_fx20 lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
       [ -f "$STAMPS/$s" ] || pallas_missing=1
     done
     if (( pallas_missing == 0 )); then
@@ -140,6 +140,8 @@ while true; do
       # fused-vs-chunked head A/B at the headline config (the reason
       # ops/fused_xent.py exists) — Pallas-compiling, so canary-gated.
       run lm_bs16_fx  900 env BENCH_LM_BATCH=16 BENCH_LM_XENT=fused python bench_lm.py \
+        || { probe || break; }
+      run lm_bs16_fx20 900 env BENCH_LM_BATCH=16 BENCH_LM_XENT=fused BENCH_LM_INNER=20 python bench_lm.py \
         || { probe || break; }
       run lm_bs32_pl  900 env BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas python bench_lm.py \
         || { probe || break; }
@@ -158,7 +160,8 @@ while true; do
   missing=0
   for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
            conv_tpu resnet resnet_in10 resnet_bs256 bert profile_resnet attn_4k \
-           lm_bs16_fx lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
+           lm_bs16_fx lm_bs16_fx20 lm_bs32_pl lm_bs32_plfx lm_s8192_pl \
+           attn_16k32k; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
