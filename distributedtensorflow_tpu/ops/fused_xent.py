@@ -52,14 +52,25 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import NEG_INF
 
+def _env_int(name: str, default: int) -> int:
+    """Bench/debug override for a tile size (read once at import).
+
+    The defaults below are VMEM-budget reasoning, not measurements; the
+    ``DTFT_XENT_*`` envs let an on-chip sweep retune them without code
+    edits mid-tunnel-window."""
+    import os
+
+    return int(os.environ.get(name, default))
+
+
 #: Default tile sizes.  block_v x block_n fp32 logits is the dominant VMEM
 #: tenant (2048 x 512 x 4 B = 4 MB); weight tiles ride at bf16.
-BLOCK_TOKENS = 512
-BLOCK_VOCAB = 2048
+BLOCK_TOKENS = _env_int("DTFT_XENT_BLOCK_TOKENS", 512)
+BLOCK_VOCAB = _env_int("DTFT_XENT_BLOCK_VOCAB", 2048)
 #: dx backward uses a bigger token tile: its dominant HBM cost is the full
 #: weight-table re-read per token block, so fewer/bigger token sweeps win.
-BLOCK_TOKENS_DX = 1024
-BLOCK_VOCAB_DX = 1024
+BLOCK_TOKENS_DX = _env_int("DTFT_XENT_BLOCK_TOKENS_DX", 1024)
+BLOCK_VOCAB_DX = _env_int("DTFT_XENT_BLOCK_VOCAB_DX", 1024)
 
 
 def _transposed_logits(w_ref, x_ref):
