@@ -1,10 +1,12 @@
-"""Write synthetic classification datasets as record files on disk.
+"""Write synthetic datasets as record files on disk.
 
 Produces the records-on-disk starting point for ``train.py --data-dir``:
 sharded TFRecord-framed files (native ``RecordWriter``, masked-CRC32C
-framing) of ``.npz`` feature dicts ``{image, label}`` — the same
-class-conditioned Gaussian task the in-memory presets train on (the
-sandbox ships no real datasets; see ARTIFACTS/README.md).
+framing) of ``.npz`` feature dicts — ``{image, label}`` for the
+classification presets (``--kind image``, default) or ``{input_ids}``
+token sequences for the LM presets (``--kind lm``; same learnable
+arithmetic-sequence task as workloads.synthetic_lm).  The sandbox ships
+no real datasets; see ARTIFACTS/README.md.
 
 Run (from the repo root, like the other examples):
     PYTHONPATH=. python examples/make_records.py --out /tmp/mnist_records \
@@ -14,6 +16,11 @@ Then:
     python train.py --workload mnist_lenet \
         --data-dir /tmp/mnist_records --eval-data-dir /tmp/mnist_records/eval \
         --eval-every 100 --target-metric accuracy --target-value 0.97 ...
+
+LM variant:
+    PYTHONPATH=. python examples/make_records.py --out /tmp/lm_records \
+        --kind lm --seq-len 64 --vocab 512
+    python train.py --workload gpt_lm --test-size --data-dir /tmp/lm_records
 """
 
 import argparse
@@ -36,6 +43,18 @@ def synthetic_examples(n, *, image_shape, num_classes, seed):
         }
 
 
+def synthetic_lm_examples(n, *, vocab_size, seq_len, seed):
+    """Per-example {input_ids} of the learnable arithmetic-sequence LM
+    task (mirrors workloads.synthetic_lm, unbatched): next token is
+    predictable from the previous two, so records-trained loss falls."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        start = int(rng.integers(0, vocab_size))
+        step = int(rng.integers(1, 7))
+        ids = (start + step * np.arange(seq_len)) % vocab_size
+        yield {"input_ids": ids.astype(np.int32)}
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__, allow_abbrev=False)
     p.add_argument("--out", required=True, help="output directory")
@@ -45,23 +64,34 @@ def main():
                    help="train record files (eval always writes 2)")
     p.add_argument("--image-shape", default="28,28,1")
     p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--kind", choices=("image", "lm"), default="image")
+    p.add_argument("--seq-len", type=int, default=64,
+                   help="--kind lm: tokens per example")
+    p.add_argument("--vocab", type=int, default=512,
+                   help="--kind lm: vocabulary size (gpt_tiny uses 512)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
     from distributedtensorflow_tpu.data import write_record_shards
 
-    shape = tuple(int(d) for d in args.image_shape.split(","))
+    if args.kind == "lm":
+        gen = lambda n, seed: synthetic_lm_examples(
+            n, vocab_size=args.vocab, seq_len=args.seq_len, seed=seed
+        )
+    else:
+        shape = tuple(int(d) for d in args.image_shape.split(","))
+        gen = lambda n, seed: synthetic_examples(
+            n, image_shape=shape, num_classes=args.classes, seed=seed
+        )
     os.makedirs(os.path.join(args.out, "eval"), exist_ok=True)
     train = write_record_shards(
-        synthetic_examples(args.train_examples, image_shape=shape,
-                           num_classes=args.classes, seed=args.seed),
+        gen(args.train_examples, args.seed),
         os.path.join(args.out, "train-{:05d}.rec"),
         num_shards=args.shards,
     )
     # Held-out split, disjoint seed stream: --eval-data-dir points here.
     evals = write_record_shards(
-        synthetic_examples(args.eval_examples, image_shape=shape,
-                           num_classes=args.classes, seed=args.seed + 10_007),
+        gen(args.eval_examples, args.seed + 10_007),
         os.path.join(args.out, "eval", "eval-{:05d}.rec"),
         num_shards=2,
     )
