@@ -53,17 +53,33 @@ def main() -> None:
     state, specs = create_sharded_state(
         wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
     )
-    step = make_train_step(wl.loss_fn, mesh, specs)
+    # BENCH_BERT_INNER=K: K optimizer steps per dispatch (the same
+    # host-dispatch A/B bench_lm/bench.py run via their INNER knobs).
+    inner = int(os.environ.get("BENCH_BERT_INNER", "1"))
+    if inner > 1:
+        from distributedtensorflow_tpu.train import make_multi_train_step
+
+        step = make_multi_train_step(wl.loss_fn, mesh, specs,
+                                     steps_per_call=inner)
+    else:
+        step = make_train_step(wl.loss_fn, mesh, specs)
     ctx = InputContext(1, 0, wl.global_batch_size)
     batch = device_put_batch(next(iter(wl.input_fn(ctx, 0))), mesh)
+    if inner > 1:
+        import jax.numpy as jnp
+
+        batch = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (inner,) + x.shape), batch
+        )
 
     compiled = step.lower(state, batch, rng).compile()
-    n_steps = 20
+    n_steps = -(-20 // inner)
     from bench_probe import timed_steps, mfu_fields
 
     state, dt = timed_steps(compiled, state, batch, rng,
-                            n_steps=n_steps, warmup=3)
-    per_chip = n_steps * wl.global_batch_size / dt / n_chips
+                            n_steps=n_steps, warmup=max(1, 3 // inner))
+    n_opt = n_steps * inner
+    per_chip = n_opt * wl.global_batch_size / dt / n_chips
 
     # Analytic model FLOPs honoring the GATHERED head: encoder matmul params
     # run at all S positions, the mlm_* head params only at the P gathered
@@ -90,7 +106,7 @@ def main() -> None:
     )
     device_kind = jax.devices()[0].device_kind
     mfu = mfu_fields(
-        compiled, dt, n_steps, device_kind, fallback,
+        compiled, dt, n_steps, device_kind, inner * fallback,
         "analytic_6N_enc_at_S_head_at_P",
     )
 
@@ -106,7 +122,8 @@ def main() -> None:
         "device_kind": device_kind,
         "seq": seq,
         "global_batch": wl.global_batch_size,
-        "step_time_ms": round(1000 * dt / n_steps, 2),
+        "step_time_ms": round(1000 * dt / n_opt, 2),
+        "steps_per_call": inner,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     from bench_probe import is_tpu_platform, persist_result
