@@ -1,0 +1,50 @@
+"""Every bench env-combo the TPU watcher queues must run on CPU first.
+
+TPU tunnel windows are the round's scarcest resource (see tpu_watch.sh's
+header); a bench row that crashes on a bad env combination wastes a
+whole window slot discovering it.  This matrix runs each queued
+combination at TEST size on the CPU backend and asserts one parseable
+JSON result line — the same contract the watcher and the driver consume.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MATRIX = [
+    ("bench_lm.py", {"BENCH_LM_TEST": "1"}),
+    ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_INNER": "4"}),
+    ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_XENT": "fused"}),
+    ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_ATTN": "xla",
+                     "BENCH_LM_REMAT": "attn"}),
+    ("bench.py", {"BENCH_TEST": "1", "BENCH_INNER": "2"}),
+    ("bench_bert.py", {"BENCH_BERT_TEST": "1", "BENCH_BERT_INNER": "2"}),
+]
+
+
+@pytest.mark.parametrize(
+    "script,extra",
+    MATRIX,
+    ids=[f"{s}:{'+'.join(sorted(e))}" for s, e in MATRIX],
+)
+def test_bench_combo_emits_json(script, extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(extra)
+    env.update({"BENCH_PLATFORM": "cpu", "BENCH_SKIP_PROBE": "1"})
+    res = subprocess.run(
+        [sys.executable, script], cwd=REPO,
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert res.returncode == 0, (res.stderr or res.stdout)[-1500:]
+    line = res.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["metric"]
+    assert result["value"] is not None and result["value"] > 0
+    if "steps_per_call" in result and "INNER" in " ".join(extra):
+        assert result["steps_per_call"] > 1
