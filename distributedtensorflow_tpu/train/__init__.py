@@ -10,4 +10,4 @@ from .engine import (  # noqa: F401
 )
 from .losses import classification_eval, classification_loss  # noqa: F401
 from .sidecar import SidecarEvaluator  # noqa: F401
-from .trainer import weighted_evaluate  # noqa: F401
+from .trainer import Callback, Trainer, TrainerConfig, weighted_evaluate  # noqa: F401

@@ -66,6 +66,33 @@ class TrainerConfig:
             raise ValueError(f"target_mode must be max|min, got {self.target_mode!r}")
 
 
+class Callback:
+    """Trainer extension hook — the Keras-callbacks analogue (SURVEY.md
+    §5.5: "Keras callbacks drive per-epoch logging").  Subclass and
+    override any subset; every method is a no-op by default.
+
+    Granularity contract: ``on_step_end`` fires once per DISPATCH (so
+    every ``steps_per_call`` optimizer steps when step-bundling is on)
+    with the just-completed global step count and that step's metrics
+    (device arrays — call ``float()`` to fetch).  Set
+    ``trainer.stop_training = True`` from any hook to end the fit after
+    the current dispatch (the Keras ``model.stop_training`` contract);
+    the final checkpoint still saves.
+    """
+
+    def on_fit_begin(self, trainer: "Trainer", state) -> None: ...
+
+    def on_step_end(self, trainer: "Trainer", step: int, state,
+                    metrics: dict) -> None: ...
+
+    def on_eval_end(self, trainer: "Trainer", step: int, state,
+                    eval_metrics: dict) -> None: ...
+
+    def on_checkpoint(self, trainer: "Trainer", step: int, state) -> None: ...
+
+    def on_fit_end(self, trainer: "Trainer", state) -> None: ...
+
+
 class Trainer:
     def __init__(
         self,
@@ -75,12 +102,16 @@ class Trainer:
         eval_step: Callable[[TrainState, PyTree], dict] | None = None,
         checkpointer=None,  # checkpoint.CheckpointManager-compatible
         preemption=None,  # checkpoint.PreemptionHandler-compatible
+        callbacks: list[Callback] | None = None,
     ):
         self.train_step = train_step
         self.eval_step = eval_step
         self.config = config
         self.checkpointer = checkpointer
         self.preemption = preemption
+        self.callbacks = list(callbacks or [])
+        #: Callbacks set this to end the fit after the current dispatch.
+        self.stop_training = False
         self.writer = MetricWriter(config.logdir)
         self.meter = ThroughputMeter(config.global_batch_size)
         # Latest eval metrics, threaded into checkpointer.save() so a
@@ -98,12 +129,17 @@ class Trainer:
     ) -> TrainState:
         cfg = self.config
         it = iter(train_iter)
+        # A fresh fit clears a prior run's early-stop request (the Keras
+        # Model.fit contract: stop_training resets on entry).
+        self.stop_training = False
         self.meter.start()
         watchdog = None
         if cfg.watchdog_timeout > 0:
             from ..utils.watchdog import Watchdog
 
             watchdog = Watchdog(cfg.watchdog_timeout)
+        for cb in self.callbacks:
+            cb.on_fit_begin(self, state)
         try:
             state = self._fit_loop(state, it, rng, eval_iter_fn, watchdog)
         finally:
@@ -120,6 +156,8 @@ class Trainer:
                 int(state.step), state, force=True, metrics=self._ckpt_metrics()
             )
             self.checkpointer.wait()
+        for cb in self.callbacks:
+            cb.on_fit_end(self, state)
         return state
 
     def _ckpt_metrics(self, manager=None) -> dict | None:
@@ -194,6 +232,8 @@ class Trainer:
                 if k > 1:  # stacked (k_eff, ...) metrics; report the last
                     metrics = jax.tree.map(lambda v: v[-1], metrics)
                 self.meter.update(k_eff)
+                for cb in self.callbacks:
+                    cb.on_step_end(self, step_next, state, metrics)
                 if watchdog is not None:
                     watchdog.ping()
                 if profiling and step_next >= profile_at + cfg.profile_steps:
@@ -227,6 +267,8 @@ class Trainer:
                         {f"eval_{k}": v for k, v in eval_metrics.items()},
                     )
                     logger.info("eval @ %d: %s", step_i + 1, _fmt(eval_metrics))
+                    for cb in self.callbacks:
+                        cb.on_eval_end(self, step_i + 1, state, eval_metrics)
                     if watchdog is not None:  # a long eval is progress
                         watchdog.ping()
                     if cfg.target_metric and self._target_reached(
@@ -241,6 +283,8 @@ class Trainer:
                     self.checkpointer.save(
                         step_i + 1, state, metrics=self._ckpt_metrics()
                     )
+                    for cb in self.callbacks:
+                        cb.on_checkpoint(self, step_i + 1, state)
                     if watchdog is not None:  # so is a synchronous save
                         watchdog.ping()
                 # Preemption check LAST so a signal landing mid-step is
@@ -258,6 +302,11 @@ class Trainer:
                         metrics=self._ckpt_metrics(self.preemption.manager),
                     )
                     self._preempted = True
+                    return state
+                if self.stop_training:
+                    logger.info(
+                        "callback requested stop at step %d", step_i + 1
+                    )
                     return state
                 step_i = step_next
         finally:

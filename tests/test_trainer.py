@@ -252,3 +252,52 @@ def test_profile_window_writes_xplane(tmp_path, dp_mesh):
     Trainer(train_step, cfg).fit(state, _batches(8), jax.random.PRNGKey(1))
     hits = glob.glob(str(prof / "**" / "*.xplane.pb"), recursive=True)
     assert hits, f"no xplane.pb under {prof}"
+
+
+def test_callbacks_fire_and_can_stop(dp_mesh):
+    """The Keras-callbacks analogue: every hook fires with the right step
+    labels, and stop_training ends the fit after the current dispatch."""
+    from distributedtensorflow_tpu.train.trainer import Callback
+
+    _, state, train_step, eval_step = _setup(dp_mesh)
+
+    class Recorder(Callback):
+        def __init__(self):
+            self.events = []
+
+        def on_fit_begin(self, trainer, state):
+            self.events.append(("fit_begin",))
+
+        def on_step_end(self, trainer, step, state, metrics):
+            self.events.append(("step", step))
+            assert "loss" in metrics
+
+        def on_eval_end(self, trainer, step, state, eval_metrics):
+            self.events.append(("eval", step))
+
+        def on_fit_end(self, trainer, state):
+            self.events.append(("fit_end",))
+
+    class StopAt(Callback):
+        def __init__(self, at):
+            self.at = at
+
+        def on_step_end(self, trainer, step, state, metrics):
+            if step >= self.at:
+                trainer.stop_training = True
+
+    rec, stop = Recorder(), StopAt(3)
+    cfg = TrainerConfig(total_steps=10, log_every=0, eval_every=2,
+                        eval_steps=1, global_batch_size=16)
+    trainer = Trainer(train_step, cfg, eval_step=eval_step,
+                      callbacks=[rec, stop])
+    out = trainer.fit(
+        state, _batches(10), jax.random.PRNGKey(1),
+        eval_iter_fn=lambda: _batches(1, seed=99),
+    )
+    assert int(out.step) == 3  # stopped after the step-3 dispatch
+    steps = [e[1] for e in rec.events if e[0] == "step"]
+    evals = [e[1] for e in rec.events if e[0] == "eval"]
+    assert steps == [1, 2, 3] and evals == [2]
+    assert rec.events[0] == ("fit_begin",)
+    assert rec.events[-1] == ("fit_end",)
