@@ -66,18 +66,31 @@ def build_optimizer(
     *,
     weight_decay: float = 0.0,
     momentum: float = 0.9,
+    global_clipnorm: float = 0.0,
 ) -> optax.GradientTransformation:
     """Build an optax chain by name (the --optimizer CLI surface).
 
     ``weight_decay`` is rejected (not silently dropped) for optimizers
     without a decoupled-decay parameter — put L2 in the loss for those
     (``classification_loss(weight_decay=...)``).
+
+    ``global_clipnorm > 0`` prepends ``optax.clip_by_global_norm`` —
+    Keras's ``global_clipnorm`` (the BERT-pretraining recipe's clip-to-1
+    knob), applied to the ALREADY cross-replica-averaged gradients since
+    the mean is compiled into the step before the optimizer runs.
     """
     if weight_decay and name not in _DECAY_CAPABLE:
         raise ValueError(
             f"optimizer {name!r} has no decoupled weight decay "
             f"(supported: {_DECAY_CAPABLE}); use the loss-side L2 instead"
         )
+    if global_clipnorm:
+        if global_clipnorm < 0:
+            raise ValueError(f"global_clipnorm must be > 0, got {global_clipnorm}")
+        inner = build_optimizer(
+            name, lr, weight_decay=weight_decay, momentum=momentum
+        )
+        return optax.chain(optax.clip_by_global_norm(global_clipnorm), inner)
     if name == "sgd":
         return optax.sgd(lr)
     if name == "momentum":

@@ -138,9 +138,9 @@ class Trainer:
             from ..utils.watchdog import Watchdog
 
             watchdog = Watchdog(cfg.watchdog_timeout)
-        for cb in self.callbacks:
-            cb.on_fit_begin(self, state)
         try:
+            for cb in self.callbacks:
+                cb.on_fit_begin(self, state)
             state = self._fit_loop(state, it, rng, eval_iter_fn, watchdog)
         finally:
             if watchdog is not None:

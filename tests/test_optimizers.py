@@ -113,3 +113,33 @@ def test_pipelined_eval_fn(devices):
     )
     metrics = eval_step(state, batch)
     assert np.isfinite(float(metrics["perplexity"]))
+
+
+def test_global_clipnorm_bounds_update_norm():
+    """global_clipnorm: the pre-optimizer gradient global norm is clipped,
+    so an sgd update from a huge gradient has norm <= clip * lr."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributedtensorflow_tpu.train.optimizers import build_optimizer
+
+    params = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}
+    grads = {"w": jnp.full((4, 4), 100.0), "b": jnp.full((4,), 100.0)}
+    opt = build_optimizer("sgd", 0.1, global_clipnorm=1.0)
+    updates, _ = opt.update(grads, opt.init(params), params)
+    gnorm = float(optax.global_norm(updates))
+    np.testing.assert_allclose(gnorm, 0.1, rtol=1e-5)  # lr * clip
+
+    plain = build_optimizer("sgd", 0.1)
+    u2, _ = plain.update(grads, plain.init(params), params)
+    assert float(optax.global_norm(u2)) > 1.0
+
+
+def test_clipnorm_rejects_negative():
+    import pytest
+
+    from distributedtensorflow_tpu.train.optimizers import build_optimizer
+
+    with pytest.raises(ValueError):
+        build_optimizer("sgd", 0.1, global_clipnorm=-1.0)

@@ -123,11 +123,12 @@ def apply_optimizer_flags(wl, args):
             raise SystemExit(
                 "--lr requires --optimizer (which family to build)"
             )
-        if args.schedule != "constant" or args.warmup_steps or args.weight_decay:
+        if (args.schedule != "constant" or args.warmup_steps
+                or args.weight_decay or args.clipnorm):
             raise SystemExit(
-                "--schedule/--warmup-steps/--weight-decay require "
-                "--optimizer (they parameterize the override, not the "
-                "preset's own optax chain)"
+                "--schedule/--warmup-steps/--weight-decay/--clipnorm "
+                "require --optimizer (they parameterize the override, not "
+                "the preset's own optax chain)"
             )
         return wl
     if args.lr is None:
@@ -147,6 +148,8 @@ def apply_optimizer_flags(wl, args):
             f"--optimizer {args.optimizer} has no decoupled weight decay "
             f"(supported: {', '.join(_DECAY_CAPABLE)})"
         )
+    if args.clipnorm < 0:
+        raise SystemExit(f"--clipnorm must be > 0, got {args.clipnorm}")
     try:
         lr = build_schedule(
             args.schedule, args.lr,
@@ -154,10 +157,12 @@ def apply_optimizer_flags(wl, args):
         )
     except ValueError as e:
         raise SystemExit(str(e)) from None
-    opt_name, wd = args.optimizer, args.weight_decay
+    opt_name, wd, clip = args.optimizer, args.weight_decay, args.clipnorm
     return dataclasses.replace(
         wl,
-        make_optimizer=lambda: build_optimizer(opt_name, lr, weight_decay=wd),
+        make_optimizer=lambda: build_optimizer(
+            opt_name, lr, weight_decay=wd, global_clipnorm=clip
+        ),
     )
 
 
@@ -560,6 +565,9 @@ def main() -> None:
                    help="LR schedule for --optimizer (decay over --steps)")
     p.add_argument("--warmup-steps", type=int, default=0,
                    help="linear LR warmup steps for --optimizer")
+    p.add_argument("--clipnorm", type=float, default=0.0,
+                   help="clip gradients by GLOBAL norm before the optimizer"
+                        " (Keras global_clipnorm; BERT recipes use 1.0)")
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help="weight decay for --optimizer (adamw/lamb/lars/lion)")
     p.add_argument("--remat", choices=("on", "off", "attn"), default=None,
