@@ -324,6 +324,13 @@ def get_workload(name: str, *, test_size: bool = False,
         from .models import GPTLM, gpt_layout, gpt_small, gpt_tiny, lm_eval, lm_loss
 
         cfg = gpt_tiny() if test_size else gpt_small()
+        if name == "lm_long_context" and not test_size:
+            # The long-context flagship preset: 8k tokens by default, the
+            # flash/ring attention path (its backward stores no (S, S)
+            # tensors), attention-only remat.  Any knob still overrides.
+            seq_len = seq_len or 8192
+            remat = "attn" if remat is None else remat
+            attn_impl = attn_impl or "pallas"
         seq = seq_len or (64 if test_size else 2048)
         if (remat is not None or attn_impl is not None
                 or xent_impl is not None or seq > cfg.max_seq):
@@ -514,12 +521,12 @@ def get_workload(name: str, *, test_size: bool = False,
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
         "imagenet_resnet50 imagenet_vit bert_mlm bert_mlm_packed bert_moe "
-        "widedeep gpt_lm gpt_moe"
+        "widedeep gpt_lm lm_long_context gpt_moe"
     )
 
 
 WORKLOADS = (
     "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "imagenet_vit",
     "bert_mlm", "bert_mlm_packed", "bert_moe", "widedeep", "gpt_lm",
-    "gpt_moe",
+    "lm_long_context", "gpt_moe",
 )

@@ -120,3 +120,24 @@ def test_bert_mlm_packed_trains(devices):
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0], losses
+
+
+def test_lm_long_context_preset_defaults():
+    """The long-context flagship preset: 8k seq, flash attention,
+    attention-only remat by default; explicit knobs still override."""
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    wl = get_workload("lm_long_context", global_batch_size=2)
+    cfg = wl.model.cfg
+    assert cfg.max_seq >= 8192
+    assert cfg.attn_impl == "pallas"
+    assert cfg.remat_attn and not cfg.remat
+
+    wl2 = get_workload("lm_long_context", global_batch_size=2,
+                       seq_len=4096, attn_impl="xla")
+    assert wl2.model.cfg.attn_impl == "xla"
+    assert wl2.model.cfg.max_seq >= 4096
+
+    # test_size keeps CI shapes tiny (same path as gpt_lm)
+    wl3 = get_workload("lm_long_context", test_size=True, global_batch_size=4)
+    assert wl3.model.cfg.max_seq <= 256
