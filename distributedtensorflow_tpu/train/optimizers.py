@@ -60,6 +60,29 @@ def build_schedule(
 _DECAY_CAPABLE = ("adamw", "lamb", "lars", "lion")
 
 
+def exclude_bias_and_norm_mask(params) -> object:
+    """Weight-decay mask: True = decay this leaf.
+
+    The reference recipes' ``exclude_from_weight_decay``: biases and
+    normalization scales (LayerNorm/BatchNorm ``scale``/``bias``) carry no
+    decay — decaying a 1-D normalization parameter toward zero fights the
+    normalization itself.  Matches by parameter-tree path: any leaf whose
+    final key is ``bias`` or ``scale``, or that is 1-D, is excluded.
+    """
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def keep(path, leaf):
+        last = path[-1]
+        key = getattr(last, "key", getattr(last, "name", str(last)))
+        return leaf.ndim > 1 and key not in ("bias", "scale")
+
+    mask_flat = [keep(p, l) for p, l in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, mask_flat)
+
+
 def build_optimizer(
     name: str,
     lr: float | optax.Schedule,
@@ -67,6 +90,7 @@ def build_optimizer(
     weight_decay: float = 0.0,
     momentum: float = 0.9,
     global_clipnorm: float = 0.0,
+    decay_mask: object | None = None,
 ) -> optax.GradientTransformation:
     """Build an optax chain by name (the --optimizer CLI surface).
 
@@ -78,6 +102,11 @@ def build_optimizer(
     Keras's ``global_clipnorm`` (the BERT-pretraining recipe's clip-to-1
     knob), applied to the ALREADY cross-replica-averaged gradients since
     the mean is compiled into the step before the optimizer runs.
+
+    ``decay_mask`` scopes the decoupled weight decay (the reference's
+    ``exclude_from_weight_decay``): pass
+    :func:`exclude_bias_and_norm_mask` (or any params -> bool-pytree
+    callable / pytree optax accepts) to skip biases and norm scales.
     """
     if weight_decay and name not in _DECAY_CAPABLE:
         raise ValueError(
@@ -88,9 +117,15 @@ def build_optimizer(
         if global_clipnorm < 0:
             raise ValueError(f"global_clipnorm must be > 0, got {global_clipnorm}")
         inner = build_optimizer(
-            name, lr, weight_decay=weight_decay, momentum=momentum
+            name, lr, weight_decay=weight_decay, momentum=momentum,
+            decay_mask=decay_mask,
         )
         return optax.chain(optax.clip_by_global_norm(global_clipnorm), inner)
+    mask_kw = {} if decay_mask is None else {"mask": decay_mask}
+    if decay_mask is not None and name not in ("adamw", "lamb", "lion"):
+        raise ValueError(
+            f"decay_mask is supported for adamw/lamb/lion, not {name!r}"
+        )
     if name == "sgd":
         return optax.sgd(lr)
     if name == "momentum":
@@ -98,9 +133,9 @@ def build_optimizer(
     if name == "adam":
         return optax.adam(lr)
     if name == "adamw":
-        return optax.adamw(lr, weight_decay=weight_decay)
+        return optax.adamw(lr, weight_decay=weight_decay, **mask_kw)
     if name == "lamb":
-        return optax.lamb(lr, weight_decay=weight_decay)
+        return optax.lamb(lr, weight_decay=weight_decay, **mask_kw)
     if name == "lars":
         return optax.lars(lr, weight_decay=weight_decay, momentum=momentum)
     if name == "adagrad":
@@ -108,5 +143,5 @@ def build_optimizer(
     if name == "adafactor":
         return optax.adafactor(lr)
     if name == "lion":
-        return optax.lion(lr, weight_decay=weight_decay)
+        return optax.lion(lr, weight_decay=weight_decay, **mask_kw)
     raise ValueError(f"optimizer must be one of {OPTIMIZERS}, got {name!r}")

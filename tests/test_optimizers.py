@@ -143,3 +143,45 @@ def test_clipnorm_rejects_negative():
 
     with pytest.raises(ValueError):
         build_optimizer("sgd", 0.1, global_clipnorm=-1.0)
+
+
+def test_decay_mask_excludes_bias_and_norm():
+    """exclude_bias_and_norm_mask: 2-D kernels decay, biases/scales and
+    1-D leaves do not (the reference's exclude_from_weight_decay)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedtensorflow_tpu.train.optimizers import (
+        build_optimizer,
+        exclude_bias_and_norm_mask,
+    )
+
+    params = {
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+        "ln": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+    }
+    mask = exclude_bias_and_norm_mask(params)
+    assert mask["dense"]["kernel"] is True
+    assert mask["dense"]["bias"] is False
+    assert mask["ln"]["scale"] is False
+
+    # zero gradients isolate the decay term: masked leaves must not move
+    opt = build_optimizer("adamw", 0.1, weight_decay=0.1,
+                          decay_mask=exclude_bias_and_norm_mask)
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = opt.update(zeros, opt.init(params), params)
+    assert float(jnp.max(jnp.abs(updates["dense"]["kernel"]))) > 0.0
+    np.testing.assert_array_equal(np.asarray(updates["dense"]["bias"]), 0.0)
+    np.testing.assert_array_equal(np.asarray(updates["ln"]["scale"]), 0.0)
+
+
+def test_decay_mask_rejected_for_unsupported():
+    import pytest
+
+    from distributedtensorflow_tpu.train.optimizers import (
+        build_optimizer,
+        exclude_bias_and_norm_mask,
+    )
+
+    with pytest.raises(ValueError):
+        build_optimizer("sgd", 0.1, decay_mask=exclude_bias_and_norm_mask)

@@ -124,11 +124,12 @@ def apply_optimizer_flags(wl, args):
                 "--lr requires --optimizer (which family to build)"
             )
         if (args.schedule != "constant" or args.warmup_steps
-                or args.weight_decay or args.clipnorm):
+                or args.weight_decay or args.clipnorm
+                or args.decay_mask != "none"):
             raise SystemExit(
-                "--schedule/--warmup-steps/--weight-decay/--clipnorm "
-                "require --optimizer (they parameterize the override, not "
-                "the preset's own optax chain)"
+                "--schedule/--warmup-steps/--weight-decay/--clipnorm/"
+                "--decay-mask require --optimizer (they parameterize the "
+                "override, not the preset's own optax chain)"
             )
         return wl
     if args.lr is None:
@@ -157,11 +158,24 @@ def apply_optimizer_flags(wl, args):
         )
     except ValueError as e:
         raise SystemExit(str(e)) from None
+    mask = None
+    if args.decay_mask == "bias-norm":
+        if not args.weight_decay:
+            raise SystemExit("--decay-mask requires --weight-decay > 0")
+        if args.optimizer not in ("adamw", "lamb", "lion"):
+            raise SystemExit(
+                f"--decay-mask is supported for adamw/lamb/lion, not "
+                f"{args.optimizer}"
+            )
+        from distributedtensorflow_tpu.train.optimizers import (
+            exclude_bias_and_norm_mask as mask,
+        )
     opt_name, wd, clip = args.optimizer, args.weight_decay, args.clipnorm
     return dataclasses.replace(
         wl,
         make_optimizer=lambda: build_optimizer(
-            opt_name, lr, weight_decay=wd, global_clipnorm=clip
+            opt_name, lr, weight_decay=wd, global_clipnorm=clip,
+            decay_mask=mask,
         ),
     )
 
@@ -565,6 +579,10 @@ def main() -> None:
                    help="LR schedule for --optimizer (decay over --steps)")
     p.add_argument("--warmup-steps", type=int, default=0,
                    help="linear LR warmup steps for --optimizer")
+    p.add_argument("--decay-mask", choices=("none", "bias-norm"),
+                   default="none",
+                   help="scope --weight-decay: bias-norm = skip biases and"
+                        " norm scales (exclude_from_weight_decay semantics)")
     p.add_argument("--clipnorm", type=float, default=0.0,
                    help="clip gradients by GLOBAL norm before the optimizer"
                         " (Keras global_clipnorm; BERT recipes use 1.0)")
