@@ -1,0 +1,15 @@
+#!/bin/bash
+# One-shot analysis of a just-landed TPU bench window: the evidence
+# table, both step profiles, and the A/B deltas the round-3 attack cares
+# about (dispatch bundling, fused head).  Run after tpu_watch.sh lands
+# rows; writes nothing, prints markdown.
+cd "$(dirname "$0")/.."
+echo "# Window report $(date -Is)"
+python tools/bench_table.py --latest-only
+for prof in BENCH_RESULTS/profile_lm_tpu BENCH_RESULTS/profile_resnet_tpu; do
+  if [ -d "$prof" ]; then
+    echo; echo "## $(basename "$prof") top ops"; echo
+    python tools/profile_summary.py "$prof" --top 20 2>/dev/null | grep -v "oneDNN\|cuda\|absl::"
+  fi
+done
+echo; echo "## landed stamps"; ls BENCH_RESULTS/.landed/ 2>/dev/null
