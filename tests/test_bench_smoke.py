@@ -20,6 +20,7 @@ MATRIX = [
     ("bench_lm.py", {"BENCH_LM_TEST": "1"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_INNER": "4"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_XENT": "fused"}),
+    ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_XENT": "chunked_bf16"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_ATTN": "xla",
                      "BENCH_LM_REMAT": "attn"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_XENT": "fused",
@@ -34,7 +35,10 @@ MATRIX = [
 @pytest.mark.parametrize(
     "script,extra",
     MATRIX,
-    ids=[f"{s}:{'+'.join(sorted(e))}" for s, e in MATRIX],
+    ids=[
+        f"{s}:{'+'.join(f'{k}={v}' for k, v in sorted(e.items()))}"
+        for s, e in MATRIX
+    ],
 )
 def test_bench_combo_emits_json(script, extra):
     env = dict(os.environ)

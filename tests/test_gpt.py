@@ -322,3 +322,42 @@ def test_workload_trains_with_fused_xent(devices):
         state, metrics = step(state, batch, rng)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] - 0.15, losses
+
+
+def test_chunked_bf16_logits_close_to_fp32():
+    """logits_dtype=bf16 (half the head HBM traffic): NLL within bf16
+    tolerance of the fp32-tile head, gradients finite and aligned."""
+    from distributedtensorflow_tpu.ops.xent import chunked_softmax_xent
+
+    r = np.random.default_rng(5)
+    hidden = jnp.asarray(r.normal(size=(2, 32, 64)), jnp.float32)
+    wte = jnp.asarray(r.normal(size=(211, 64)) * 0.3, jnp.float32)
+    targets = jnp.asarray(r.integers(0, 211, (2, 32)), jnp.int32)
+
+    f32 = chunked_softmax_xent(hidden, wte, targets, chunk_tokens=16)
+    b16 = chunked_softmax_xent(hidden, wte, targets, chunk_tokens=16,
+                               logits_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(float(b16), float(f32), rtol=2e-2)
+
+    g32 = jax.grad(lambda h: chunked_softmax_xent(
+        h, wte, targets, chunk_tokens=16))(hidden)
+    g16 = jax.grad(lambda h: chunked_softmax_xent(
+        h, wte, targets, chunk_tokens=16, logits_dtype=jnp.bfloat16))(hidden)
+    assert bool(jnp.all(jnp.isfinite(g16)))
+    # direction agreement: gradient cosine similarity near 1
+    cos = float(
+        jnp.vdot(g32, g16)
+        / (jnp.linalg.norm(g32) * jnp.linalg.norm(g16))
+    )
+    assert cos > 0.999, cos
+
+
+def test_workload_accepts_chunked_bf16():
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=8,
+                      xent_impl="chunked_bf16")
+    assert wl.model.cfg.xent_impl == "chunked_bf16"
+    variables = wl.init_fn(jax.random.PRNGKey(0))
+    batch = {k: jnp.asarray(v) for k, v in wl.init_batch.items()}
+    loss, _ = wl.loss_fn(variables["params"], {}, batch,
+                         jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))

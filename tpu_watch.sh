@@ -88,6 +88,11 @@ while true; do
     # time from host-dispatch/tunnel-RTT time (engine.make_multi_train_step).
     run lm_bs16_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
       || { probe || break; }
+    # bf16 logits tiles in the chunked head: the non-Pallas half of the
+    # head-HBM attack (xent_impl=chunked_bf16) — runs even when the
+    # Pallas canary fails.
+    run lm_bs16_cb16  600 env BENCH_LM_BATCH=16 BENCH_LM_XENT=chunked_bf16 python bench_lm.py \
+      || { probe || break; }
     run lm_bs24       600 env BENCH_LM_BATCH=24 python bench_lm.py \
       || { probe || break; }
     run lm_bs32_rattn 600 env BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn python bench_lm.py \
@@ -158,7 +163,7 @@ while true; do
   done
 
   missing=0
-  for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
+  for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs16_cb16 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
            conv_tpu resnet resnet_in10 resnet_bs256 bert profile_resnet attn_4k \
            lm_bs16_fx lm_bs16_fx20 lm_bs32_pl lm_bs32_plfx lm_s8192_pl \
            attn_16k32k; do

@@ -596,7 +596,8 @@ def main() -> None:
                    default=None,
                    help="LM presets: attention kernel (auto = Pallas flash"
                         " on TPU past the evidenced seq threshold)")
-    p.add_argument("--xent-impl", choices=("chunked", "fused"), default=None,
+    p.add_argument("--xent-impl",
+                   choices=("chunked", "chunked_bf16", "fused"), default=None,
                    help="LM presets: head-loss kernel (chunked = lax.scan"
                         " over token chunks; fused = Pallas fused_xent,"
                         " logits never leave VMEM)")
