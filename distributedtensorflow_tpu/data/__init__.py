@@ -18,6 +18,7 @@ from .input_pipeline import (  # noqa: F401
     Prefetcher,
     current_input_context,
     device_put_batch,
+    device_put_bundle,
     make_input_fn_dataset,
     pack_sequences,
     shard_dataset,

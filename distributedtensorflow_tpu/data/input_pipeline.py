@@ -20,6 +20,7 @@ vs compute-bound separation).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import queue
 import threading
@@ -87,6 +88,29 @@ def device_put_batch(batch: PyTree, mesh: Mesh) -> PyTree:
     return jax.tree.map(put, batch)
 
 
+def device_put_bundle(batches: Sequence[PyTree], mesh: Mesh) -> PyTree:
+    """Stack ``k`` host-local batches and place them as ONE global array
+    per leaf with shape ``(k, B, ...)`` — the input contract of
+    ``engine.make_multi_train_step`` (leading step dim REPLICATED, batch
+    dim sharded over the mesh batch axes).
+
+    The stack happens on host numpy BEFORE placement: stacking k
+    already-placed global arrays would put the step dim under the batch
+    sharding, which a multi-controller jit rejects (shardings of committed
+    arguments must match exactly — there is no implicit cross-process
+    reshard).
+    """
+    sharding = NamedSharding(
+        mesh, shardlib.batch_spec(mesh, leading_unsharded=1)
+    )
+
+    def put(*xs):
+        x = np.stack([np.asarray(v) for v in xs])
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(put, *batches)
+
+
 class Prefetcher:
     """Background-thread host→device prefetch (reference:
     ``_SingleWorkerOwnedDatasetIterator`` prefetch-to-device, SURVEY.md §3.4).
@@ -94,12 +118,22 @@ class Prefetcher:
     Keeps ``buffer_size`` batches in flight so host input overlaps TPU step
     time.  Device transfer happens on the worker thread; the training loop
     pops ready global arrays.
+
+    ``bundle > 1`` stacks that many consecutive host batches into one
+    ``(bundle, B, ...)`` global array per pop (:func:`device_put_bundle`)
+    — feeding ``steps_per_call`` training without any device-side
+    restacking.  A trailing partial group (source ended mid-bundle) is
+    yielded at its true (shorter) length so the consumer sees exactly the
+    batches that exist; the Trainer treats a too-short final bundle as
+    end-of-data (StopIteration parity with per-step iteration).
     """
 
     _DONE = object()
 
-    def __init__(self, it: Iterable[PyTree], mesh: Mesh, buffer_size: int = 2):
+    def __init__(self, it: Iterable[PyTree], mesh: Mesh, buffer_size: int = 2,
+                 *, bundle: int = 1):
         self._mesh = mesh
+        self._bundle = bundle
         self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
         self._err: BaseException | None = None
         self._stop = threading.Event()
@@ -108,12 +142,27 @@ class Prefetcher:
         )
         self._thread.start()
 
+    def _batches(self, it: Iterator[PyTree]) -> Iterator[PyTree]:
+        if self._bundle <= 1:
+            yield from it
+            return
+        while True:
+            group = list(itertools.islice(it, self._bundle))
+            if group:
+                yield group
+            if len(group) < self._bundle:
+                return
+
     def _run(self, it: Iterator[PyTree]):
         try:
-            for batch in it:
+            for batch in self._batches(it):
                 if self._stop.is_set():
                     return
-                out = device_put_batch(batch, self._mesh)
+                out = (
+                    device_put_bundle(batch, self._mesh)
+                    if self._bundle > 1
+                    else device_put_batch(batch, self._mesh)
+                )
                 # bounded put that re-checks stop, so close() can't deadlock
                 # against a full queue
                 while not self._stop.is_set():

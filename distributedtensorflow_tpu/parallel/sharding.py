@@ -256,10 +256,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_spec(mesh: Mesh, *, extra_dims: int = 0) -> P:
-    """PartitionSpec for a batch: leading dim sharded over all batch axes."""
+def batch_spec(mesh: Mesh, *, extra_dims: int = 0,
+               leading_unsharded: int = 0) -> P:
+    """PartitionSpec for a batch: leading dim sharded over all batch axes.
+
+    ``leading_unsharded`` prepends that many replicated dims — e.g. the
+    step dimension of a ``steps_per_call`` bundle ``(k, B, ...)``.
+    """
     axes = mesh_lib.data_axes(mesh)
-    return P(axes if axes else None, *([None] * extra_dims))
+    return P(*([None] * leading_unsharded),
+             axes if axes else None, *([None] * extra_dims))
 
 
 def shard_batch(batch: PyTree, mesh: Mesh) -> PyTree:

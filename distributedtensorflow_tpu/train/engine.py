@@ -195,7 +195,7 @@ def make_multi_train_step(
             donate=donate,
         )
     batch_sharding = NamedSharding(
-        mesh, P(None, *shardlib.batch_spec(mesh))
+        mesh, shardlib.batch_spec(mesh, leading_unsharded=1)
     )
     state_shardings = shardlib.named_shardings(mesh, state_specs)
     repl = NamedSharding(mesh, P())

@@ -14,6 +14,7 @@ from typing import Any, Callable, Iterable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..utils.metrics import MetricWriter, ThroughputMeter
 from .state import TrainState
@@ -34,6 +35,12 @@ class TrainerConfig:
     #: analogue).  > 1 requires a make_multi_train_step-built train_step;
     #: hooks fire on period boundary-crossings with up-to-k-step latency.
     steps_per_call: int = 1
+    #: The input iterator already yields (steps_per_call, B, ...) bundles
+    #: (data.device_put_bundle / Prefetcher(bundle=k)).  REQUIRED for
+    #: multi-host steps_per_call: stacking k already-placed global arrays
+    #: host-side is impossible, and the trainer's own stacking is only
+    #: correct for host-numpy batches.
+    input_prebundled: bool = False
     global_batch_size: int = 0
     logdir: str | None = None
     # Profiling window (SURVEY.md §5.1): capture a jax.profiler trace of
@@ -220,14 +227,41 @@ class Trainer:
                     profiling = True
                 if k == 1:
                     batch = next(it)
+                elif cfg.input_prebundled:
+                    batch = next(it)  # already (k', B, ...) global arrays
+                    k_have = jax.tree.leaves(batch)[0].shape[0]
+                    if k_have < k_eff:
+                        # data genuinely exhausted mid-tail: surface the
+                        # same way per-step iteration does
+                        raise StopIteration
+                    if k_have > k_eff:
+                        # Tail: slice the REPLICATED leading step dim.
+                        # Under jit (one extra tail compile) because an
+                        # eager slice of a non-fully-addressable global
+                        # array is illegal in multi-controller JAX.
+                        batch = jax.jit(
+                            lambda b: jax.tree.map(
+                                lambda x: x[:k_eff], b
+                            )
+                        )(batch)
                 else:
                     # Explicit loop, not a genexp: an exhausted iterator
                     # must surface as StopIteration (the k=1 behavior),
-                    # not PEP-479's RuntimeError.
+                    # not PEP-479's RuntimeError.  np.stack for host
+                    # batches (keeps them uncommitted so the jit can shard
+                    # them); jnp.stack only for already-device single-
+                    # process arrays.
                     bundle = []
                     for _ in range(k_eff):
                         bundle.append(next(it))
-                    batch = jax.tree.map(lambda *xs: jnp.stack(xs), *bundle)
+                    batch = jax.tree.map(
+                        lambda *xs: (
+                            np.stack(xs)
+                            if isinstance(xs[0], np.ndarray)
+                            else jnp.stack(xs)
+                        ),
+                        *bundle,
+                    )
                 state, metrics = self.train_step(state, batch, rng)
                 if k > 1:  # stacked (k_eff, ...) metrics; report the last
                     metrics = jax.tree.map(lambda v: v[-1], metrics)

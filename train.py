@@ -774,11 +774,12 @@ def main() -> None:
             # checkpoint semantics)
             logging.info("fast-forwarding input %d batches", restored_step)
             raw_iter = skip_batches(iter(raw_iter), restored_step)
-    # steps_per_call pops k batches back-to-back after each multi-step
-    # dispatch returns; scale the prefetch depth so those pops hit buffered
-    # transfers instead of serializing host→device I/O with compute.
+    # steps_per_call: the Prefetcher stacks k host batches into one
+    # (k, B, ...) bundle per dispatch (host-side, BEFORE placement — the
+    # only ordering that works multi-host) and buffers 2 bundles so the
+    # transfer overlaps compute.
     train_iter = Prefetcher(
-        raw_iter, mesh, buffer_size=max(2, 2 * args.steps_per_call)
+        raw_iter, mesh, buffer_size=2, bundle=args.steps_per_call
     )
 
     trainer = Trainer(
@@ -793,6 +794,7 @@ def main() -> None:
             eval_steps=0 if args.eval_data_dir else 10,
             checkpoint_every=args.checkpoint_every,
             steps_per_call=args.steps_per_call,
+            input_prebundled=args.steps_per_call > 1,
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
             profile_dir=args.profile_dir,
