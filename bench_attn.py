@@ -9,7 +9,10 @@ sequence lengths on whatever backend is up, persists per-run JSON to
 crossover summary.
 
 Knobs: ``BENCH_ATTN_SEQS`` (comma list, default "1024,2048,4096,8192"),
-``BENCH_ATTN_STEPS`` (default 10).
+``BENCH_ATTN_STEPS`` (default 10), ``BENCH_ATTN_IMPLS`` (comma subset of
+"flash,xla", default both — ``xla`` alone lands the dense-OOM record
+without compiling any Pallas kernel, so it can run canary-free on a
+window where Pallas compiles hang).
 """
 
 from __future__ import annotations
@@ -96,6 +99,17 @@ def main() -> None:
         for s in os.environ.get("BENCH_ATTN_SEQS", "1024,2048,4096,8192").split(",")
     ]
     n_steps = int(os.environ.get("BENCH_ATTN_STEPS", "10"))
+    impls = [
+        s.strip()
+        for s in os.environ.get("BENCH_ATTN_IMPLS", "flash,xla").split(",")
+        if s.strip()
+    ]
+    unknown = set(impls) - {"flash", "xla"}
+    if unknown or not impls:
+        raise SystemExit(
+            f"BENCH_ATTN_IMPLS must be a non-empty subset of flash,xla; "
+            f"got {os.environ.get('BENCH_ATTN_IMPLS')!r}"
+        )
     b, h, d = 4, 8, 64
     platform = jax.devices()[0].platform
     interpret = not is_tpu_platform(platform)
@@ -126,17 +140,22 @@ def main() -> None:
         # ran after the dense failure) nor smear a multi-KB compiler/HTTP
         # tail into the artifact — failures become one clean classified
         # token per measurement, e.g. {"xla_fwd": "oom"}.
-        measurements = [
-            ("flash_fwd_ms", flash_f, (q, k, v)),
-            ("flash_bwd_ms",
-             loss(lambda q, k, v: flash_attention(
-                 q, k, v, causal=True, interpret=interpret)),
-             (q, k, v)),
-            ("xla_fwd_ms", xla_f, (q, k, v)),
-            ("xla_bwd_ms",
-             loss(lambda q, k, v: xla_attention(q, k, v, causal=True)),
-             (q, k, v)),
-        ]
+        measurements = []
+        if "flash" in impls:
+            measurements += [
+                ("flash_fwd_ms", flash_f, (q, k, v)),
+                ("flash_bwd_ms",
+                 loss(lambda q, k, v: flash_attention(
+                     q, k, v, causal=True, interpret=interpret)),
+                 (q, k, v)),
+            ]
+        if "xla" in impls:
+            measurements += [
+                ("xla_fwd_ms", xla_f, (q, k, v)),
+                ("xla_bwd_ms",
+                 loss(lambda q, k, v: xla_attention(q, k, v, causal=True)),
+                 (q, k, v)),
+            ]
         row = {"seq": seq}
         for key, fn, fargs in measurements:
             try:
@@ -154,6 +173,7 @@ def main() -> None:
         "metric": "flash_attention_speedup_vs_xla",
         "rows": rows,
         "batch": b, "heads": h, "head_dim": d,
+        "impls": impls,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -23,6 +23,42 @@ import tempfile
 RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_RESULTS")
 
+# --- persistent compilation cache (VERDICT r3 #1) ---------------------------
+# Round 3 lost its one tunnel window to compiles; with the persistent cache
+# every compile survives across processes AND windows, so a re-opened window
+# starts from warm XLA binaries.  bench_probe is imported BEFORE jax by every
+# bench script, so setdefault here wires the whole bench/watcher fleet (env
+# beats config-update: it reaches the probe subprocesses too).  min-compile-
+# time/entry-size 0 = cache everything, incl. the probe's tiny canary (whose
+# cross-process cache hit is the liveness proof for the wiring itself).
+_CACHE_DIR = os.path.join(RESULTS_DIR, ".jax_cache")
+if os.environ.get("BENCH_NO_COMPILE_CACHE") != "1":
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    # ~2 GB LRU bound so the cache can't eat the disk over a long round.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_MAX_SIZE", str(2 * 1024**3))
+    # The axon sitecustomize imports jax BEFORE any user module, so config
+    # defaults are already frozen from the pre-bench_probe environment —
+    # env vars alone land only in subprocesses (the probe children).  Push
+    # the values into the live config too.
+    if "jax" in __import__("sys").modules:
+        import jax
+
+        _cfg = {
+            "jax_compilation_cache_dir":
+                os.environ["JAX_COMPILATION_CACHE_DIR"],
+            "jax_persistent_cache_min_compile_time_secs":
+                float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+            "jax_persistent_cache_min_entry_size_bytes":
+                int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
+            "jax_compilation_cache_max_size":
+                int(os.environ["JAX_COMPILATION_CACHE_MAX_SIZE"]),
+        }
+        for _k, _v in _cfg.items():
+            if getattr(jax.config, _k, _v) != _v:
+                jax.config.update(_k, _v)
+
 
 def is_tpu_platform(platform: str) -> bool:
     """True for real-chip platforms (direct TPU or the axon PJRT tunnel)."""
@@ -78,12 +114,20 @@ def probe_devices(name: str = "bench", timeout_s: int | None = None) -> bool:
         if platform
         else "import jax; "
     )
+    # The child logs jax._src.compiler at DEBUG so "Persistent compilation
+    # cache hit" lines land on its stderr: a hit on the probe's own tiny
+    # computation across two probe cycles is the recorded proof that the
+    # persistent cache is wired (VERDICT r3 #1 done-criterion).
+    child_env = dict(os.environ)
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        child_env.setdefault("JAX_DEBUG_LOG_MODULES", "jax._src.compiler")
     with tempfile.TemporaryFile() as errf:
         probe = subprocess.Popen(
             [sys.executable, "-c",
              force + "jax.devices(); " + _PROBE_COMPUTE],
             stdout=subprocess.DEVNULL,
             stderr=errf,
+            env=child_env,
         )
         try:
             rc = probe.wait(timeout=timeout_s)
@@ -99,14 +143,21 @@ def probe_devices(name: str = "bench", timeout_s: int | None = None) -> bool:
                 file=sys.stderr,
             )
             return False
+        errf.seek(0)
+        err_text = errf.read().decode(errors="replace")
         if rc != 0:
-            errf.seek(0)
             print(
-                f"{name}: jax device probe failed:\n"
-                f"{errf.read().decode(errors='replace')}",
+                f"{name}: jax device probe failed:\n{err_text}",
                 file=sys.stderr,
             )
             return False
+        hits = err_text.count("Persistent compilation cache hit")
+        if hits:
+            print(
+                f"{name}: probe ok; persistent compile cache HIT "
+                f"({hits} reused executables)",
+                file=sys.stderr,
+            )
     return True
 
 

@@ -200,38 +200,82 @@ def _pad_to(x, mult, axis):
     return jnp.pad(x, widths)
 
 
+#: VMEM budget for the forward's per-token-block scratch accumulators.
+#: The three (n_i, _SUB, block_n) fp32 buffers cost 96 B per token, i.e.
+#: O(N) — unbounded, a 64x8192-token long-context head would ask for
+#: ~48 MB of VMEM and fail to compile.  Token super-chunks of at most
+#: ``budget // (3*_SUB*block_n*4)`` blocks keep scratch bounded; each
+#: extra chunk re-reads the weight table once (~77 MB bf16 at GPT-2
+#: vocab), which at the default 4 MiB budget (~43k tokens/chunk) stays
+#: far below the ~20 GB logits round-trip the kernel exists to avoid.
+#: Override: ``DTFT_XENT_FWD_SCRATCH_BYTES`` (read per call, testable).
+FWD_SCRATCH_BUDGET_BYTES = 4 * 2**20
+
+
+def _max_fwd_token_blocks(block_n: int) -> int:
+    import os
+
+    budget = int(
+        os.environ.get("DTFT_XENT_FWD_SCRATCH_BYTES", FWD_SCRATCH_BUDGET_BYTES)
+    )
+    return max(1, budget // (3 * _SUB * block_n * 4))
+
+
 def _fused_fwd_arrays(x, w, t, *, block_n, block_v, v_true, interpret):
     """Run the forward kernel on padded 2-D operands.
 
     x (N, D) compute-dtype, w (Vp, D) compute-dtype, t (N,) int32; N, Vp
     already padded to the block sizes.  Returns (lse, tgt) fp32 (N,).
+
+    Token super-chunking: the per-token-block online-softmax state lives
+    in VMEM scratch, so one pallas_call is bounded to
+    :func:`_max_fwd_token_blocks` token blocks; larger N runs as a host
+    loop of identical calls (at most two distinct shapes, so at most two
+    kernel compiles) whose outputs concatenate.
     """
     n, d = x.shape
     vp = w.shape[0]
-    n_i, n_j = n // block_n, vp // block_v
+    n_j = vp // block_v
     mem = pl.ANY if interpret else pltpu.VMEM
-    t2 = t.reshape(n_i, block_n)
 
-    lse, tgt = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_v=block_v, v_true=v_true),
-        grid=(n_j, n_i),
-        in_specs=[
-            pl.BlockSpec((block_n, d), lambda j, i: (i, 0), memory_space=mem),
-            pl.BlockSpec((block_v, d), lambda j, i: (j, 0), memory_space=mem),
-            pl.BlockSpec((1, block_n), lambda j, i: (i, 0), memory_space=mem),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_n), lambda j, i: (i, 0), memory_space=mem),
-            pl.BlockSpec((1, block_n), lambda j, i: (i, 0), memory_space=mem),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
-            jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
-        ],
-        scratch_shapes=[pltpu.VMEM((n_i, _SUB, block_n), jnp.float32)] * 3,
-        interpret=interpret,
-    )(x, w, t2)
-    return lse.reshape(n), tgt.reshape(n)
+    def one_call(xc, tc):
+        n_c = xc.shape[0]
+        n_i = n_c // block_n
+        lse, tgt = pl.pallas_call(
+            functools.partial(_fwd_kernel, block_v=block_v, v_true=v_true),
+            grid=(n_j, n_i),
+            in_specs=[
+                pl.BlockSpec((block_n, d), lambda j, i: (i, 0),
+                             memory_space=mem),
+                pl.BlockSpec((block_v, d), lambda j, i: (j, 0),
+                             memory_space=mem),
+                pl.BlockSpec((1, block_n), lambda j, i: (i, 0),
+                             memory_space=mem),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_n), lambda j, i: (i, 0),
+                             memory_space=mem),
+                pl.BlockSpec((1, block_n), lambda j, i: (i, 0),
+                             memory_space=mem),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
+                jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((n_i, _SUB, block_n), jnp.float32)] * 3,
+            interpret=interpret,
+        )(xc, w, tc.reshape(n_i, block_n))
+        return lse.reshape(n_c), tgt.reshape(n_c)
+
+    chunk_tokens = _max_fwd_token_blocks(block_n) * block_n
+    if n <= chunk_tokens:
+        return one_call(x, t)
+    lses, tgts = [], []
+    for s in range(0, n, chunk_tokens):
+        lse_c, tgt_c = one_call(x[s:s + chunk_tokens], t[s:s + chunk_tokens])
+        lses.append(lse_c)
+        tgts.append(tgt_c)
+    return jnp.concatenate(lses), jnp.concatenate(tgts)
 
 
 def _fused_bwd_arrays(x, w, t, lse, c, *, block_n_dx, block_v_dx,

@@ -66,8 +66,16 @@ def exclude_bias_and_norm_mask(params) -> object:
     The reference recipes' ``exclude_from_weight_decay``: biases and
     normalization scales (LayerNorm/BatchNorm ``scale``/``bias``) carry no
     decay — decaying a 1-D normalization parameter toward zero fights the
-    normalization itself.  Matches by parameter-tree path: any leaf whose
-    final key is ``bias`` or ``scale``, or that is 1-D, is excluded.
+    normalization itself.
+
+    Scope (deliberately BROADER than the reference's name-list matching):
+    a leaf is excluded if its path's final key is ``bias`` or ``scale``,
+    OR if it has rank <= 1.  The rank rule is the big_vision-style
+    convention — it sweeps in every 1-D parameter (e.g. a custom gate or
+    temperature vector) regardless of name, where the reference's
+    name-based list would decay an unlisted 1-D parameter.  If you need
+    name-exact reference semantics, pass your own mask pytree to
+    ``build_optimizer(decay_mask=...)``.
     """
     import jax
 
@@ -115,7 +123,10 @@ def build_optimizer(
         )
     if global_clipnorm:
         if global_clipnorm < 0:
-            raise ValueError(f"global_clipnorm must be > 0, got {global_clipnorm}")
+            raise ValueError(
+                f"global_clipnorm must be >= 0 (0 disables clipping), "
+                f"got {global_clipnorm}"
+            )
         inner = build_optimizer(
             name, lr, weight_decay=weight_decay, momentum=momentum,
             decay_mask=decay_mask,

@@ -40,6 +40,15 @@ class TrainerConfig:
     #: multi-host steps_per_call: stacking k already-placed global arrays
     #: host-side is impossible, and the trainer's own stacking is only
     #: correct for host-numpy batches.
+    #:
+    #: Tail semantics: a SHORT trailing bundle (< steps_per_call) is
+    #: trained as a shrunk dispatch (no data discarded).  A bundle LONGER
+    #: than the steps remaining before total_steps has its excess sliced
+    #: off — those batches are consumed from the stream but never trained,
+    #: so a resume whose fast-forward assumes one consumed batch per
+    #: optimizer step can sit up to steps_per_call-1 batches ahead of the
+    #: per-step-equivalent position at that final boundary.  Keep
+    #: total_steps a multiple of steps_per_call to avoid the drift.
     input_prebundled: bool = False
     global_batch_size: int = 0
     logdir: str | None = None
@@ -220,9 +229,14 @@ class Trainer:
                 # a non-divisible total never overruns total_steps (the
                 # shorter stack recompiles the scanned program once).
                 k_eff = min(k, cfg.total_steps - step_i)
-                step_next = step_i + k_eff
+                # Trace starts BEFORE the host batch fetch/stacking so the
+                # profile captures input-pipeline time (its purpose is to
+                # split host from chip time).  Uses the pre-shrink k_eff
+                # bound: a short prebundled tail can only shrink the
+                # dispatch, which at worst opens the trace one dispatch
+                # early — never skips the window.
                 if (cfg.profile_dir and not profiling
-                        and step_i <= profile_at < step_next):
+                        and step_i <= profile_at < step_i + k_eff):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
                 if k == 1:
@@ -230,11 +244,16 @@ class Trainer:
                 elif cfg.input_prebundled:
                     batch = next(it)  # already (k', B, ...) global arrays
                     k_have = jax.tree.leaves(batch)[0].shape[0]
-                    if k_have < k_eff:
-                        # data genuinely exhausted mid-tail: surface the
-                        # same way per-step iteration does
+                    if k_have == 0:
                         raise StopIteration
-                    if k_have > k_eff:
+                    if k_have < k_eff:
+                        # Short trailing bundle: TRAIN it (shrinking this
+                        # dispatch; one extra compile) rather than raising
+                        # StopIteration and silently discarding up to k-1
+                        # trainable batches.  The stream then surfaces its
+                        # genuine end on the next next(it).
+                        k_eff = k_have
+                    elif k_have > k_eff:
                         # Tail: slice the REPLICATED leading step dim.
                         # Under jit (one extra tail compile) because an
                         # eager slice of a non-fully-addressable global
@@ -262,6 +281,7 @@ class Trainer:
                         ),
                         *bundle,
                     )
+                step_next = step_i + k_eff
                 state, metrics = self.train_step(state, batch, rng)
                 if k > 1:  # stacked (k_eff, ...) metrics; report the last
                     metrics = jax.tree.map(lambda v: v[-1], metrics)

@@ -88,6 +88,39 @@ def test_fused_bf16_compute_dtype():
                                rtol=2e-3, atol=2e-3)
 
 
+def test_fused_forward_scratch_chunking(monkeypatch):
+    """A tiny scratch budget forces the token-super-chunk path.
+
+    The forward's VMEM scratch is O(tokens); over budget the host loop
+    splits the token axis across several pallas_calls.  Value AND grads
+    must be bit-identical to the single-call path (the split is purely a
+    scheduling decision — every per-token quantity is independent across
+    chunks).
+    """
+    from distributedtensorflow_tpu.ops import fused_xent as fx
+
+    hidden, wte, targets, mask = _setup(b=2, s=40, mask_frac=0.2,
+                                        bad_frac=0.1)
+
+    def run():
+        return jax.value_and_grad(
+            lambda h, w: fused_softmax_xent(h, w, targets, mask,
+                                            interpret=True, **BLOCKS),
+            argnums=(0, 1),
+        )(hidden, wte)
+
+    loss_one, (gh_one, gw_one) = run()
+    # block_tokens=16 -> per-block scratch = 3*8*16*4 = 1536 B; budget 2000
+    # allows exactly 1 block per call -> 80 tokens = 5 chunks.
+    monkeypatch.setenv("DTFT_XENT_FWD_SCRATCH_BYTES", "2000")
+    assert fx._max_fwd_token_blocks(16) == 1
+    loss_chunked, (gh_c, gw_c) = run()
+    np.testing.assert_array_equal(np.asarray(loss_one),
+                                  np.asarray(loss_chunked))
+    np.testing.assert_array_equal(np.asarray(gh_one), np.asarray(gh_c))
+    np.testing.assert_array_equal(np.asarray(gw_one), np.asarray(gw_c))
+
+
 def test_fused_grad_under_jit_and_vjp_dtype():
     hidden, wte, targets, mask = _setup()
 

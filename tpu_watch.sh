@@ -25,6 +25,25 @@ LOG=BENCH_RESULTS/tpu_watch.log
 STAMPS=BENCH_RESULTS/.landed
 mkdir -p BENCH_RESULTS "$STAMPS"
 
+# Persistent XLA compilation cache (VERDICT r3 #1): round 3's only window
+# died in compiles.  Exported HERE (not just in bench_probe) so the direct
+# train.py items and the Pallas canary inherit it too; every compile any
+# window pays for is banked for the next.  bench_probe.py sets the same
+# defaults for bench scripts run outside the watcher.
+if [ "${BENCH_NO_COMPILE_CACHE:-0}" != "1" ]; then
+  export JAX_COMPILATION_CACHE_DIR="$PWD/BENCH_RESULTS/.jax_cache"
+  export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+  export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
+  export JAX_COMPILATION_CACHE_MAX_SIZE=$((2 * 1024 * 1024 * 1024))
+  mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+fi
+
+cache_stat() {
+  local d="${JAX_COMPILATION_CACHE_DIR:-}"
+  if [ -z "$d" ]; then echo "compile cache: disabled"; return; fi
+  echo "compile cache: $(find "$d" -type f 2>/dev/null | wc -l) entries, $(du -sh "$d" 2>/dev/null | cut -f1)"
+}
+
 log() { echo "$(date -Is) watcher: $*" >> "$LOG"; }
 
 probe() {
@@ -65,10 +84,59 @@ while true; do
   now=$(date +%s)
   if (( now - START > DEADLINE )); then log "deadline reached"; exit 1; fi
   if ! probe; then log "tunnel down"; sleep "$SLEEP"; continue; fi
-  log "tunnel UP, running queue"
+  log "tunnel UP, running queue ($(cache_stat))"
 
   while true; do   # single-pass queue; break on tunnel death
-    # -- p1: on-chip LM profile (VERDICT r2 #1's instrument) -------------
+    # Queue order = VERDICT r3 "what's missing" rank: cheap LM throughput
+    # rows first (missing #1), long-context XLA rows (missing #2), the
+    # convergence artifact (missing #3), headline refresh (next #9),
+    # profiles (the instruments), and Pallas rows canary-gated LAST.
+    # -- p1: non-Pallas LM throughput (missing #1, cheapest evidence) ----
+    run lm_bs16       600 env BENCH_LM_BATCH=16 python bench_lm.py \
+      || { probe || break; }
+    # bf16 logits tiles in the chunked head: the non-Pallas half of the
+    # head-HBM attack (xent_impl=chunked_bf16) — runs even when the
+    # Pallas canary fails.
+    run lm_bs16_cb16  600 env BENCH_LM_BATCH=16 BENCH_LM_XENT=chunked_bf16 python bench_lm.py \
+      || { probe || break; }
+    # 20 optimizer steps per dispatch: the A/B vs lm_bs16 splits chip
+    # time from host-dispatch/tunnel-RTT time (engine.make_multi_train_step).
+    run lm_bs16_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
+      || { probe || break; }
+    # cb16 + multi-step dispatch: the full non-Pallas stack in one row.
+    run lm_bs16_cb16_in20 600 env BENCH_LM_BATCH=16 BENCH_LM_XENT=chunked_bf16 BENCH_LM_INNER=20 python bench_lm.py \
+      || { probe || break; }
+    # -- p2: long-context ladder, XLA attention (missing #2; cannot hang
+    #        in a Pallas compile — remat=attn keeps (S,S) out of residuals)
+    run lm_s4096_xla  900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
+      || { probe || break; }
+    run lm_s8192_xla  900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
+      || { probe || break; }
+    # Dense-only 8k attention: the clean machine-readable dense-OOM record
+    # (r3 weak #3) — no Pallas kernel compiles, so it never needs the canary.
+    run attn_8k_dense 600 env BENCH_ATTN_SEQS=8192 BENCH_ATTN_IMPLS=xla python bench_attn.py \
+      || { probe || break; }
+    # -- p3: TPU convergence artifact (missing #3; gate via the CLI) -----
+    if [ ! -f "$STAMPS/conv_tpu" ]; then
+      if timeout 900 python train.py --workload mnist_lenet --steps 600 \
+          --eval-every 100 --target-metric accuracy --target-value 0.97 \
+          --logdir ARTIFACTS/convergence_mnist_tpu --log-every 100 >> "$LOG" 2>&1; then
+        touch "$STAMPS/conv_tpu" ARTIFACTS/convergence_mnist_tpu/.done
+        log "item conv_tpu: LANDED"
+      else
+        log "item conv_tpu: failed"; probe || break
+      fi
+    fi
+    # -- p4: headline refresh with the MFU pair (next #9) ----------------
+    run resnet        900 python bench.py            || { probe || break; }
+    run resnet_in10   900 env BENCH_INNER=10 python bench.py || { probe || break; }
+    run resnet_bs256  900 env BENCH_BATCH=256 python bench.py || { probe || break; }
+    run bert          900 python bench_bert.py       || { probe || break; }
+    run lm_bs24       600 env BENCH_LM_BATCH=24 python bench_lm.py \
+      || { probe || break; }
+    run lm_bs32_rattn 600 env BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn python bench_lm.py \
+      || { probe || break; }
+    # -- p5: profiles (the instruments for the next push) ----------------
     if [ ! -f "$STAMPS/profile_lm" ]; then
       if timeout 900 python train.py --workload gpt_lm --steps 25 \
           --batch-size 16 --seq-len 1024 --remat off \
@@ -81,46 +149,7 @@ while true; do
         log "item profile_lm: failed"; probe || break
       fi
     fi
-    # -- p2: non-Pallas LM sweep (throughput evidence, cheap) ------------
-    run lm_bs16       600 env BENCH_LM_BATCH=16 python bench_lm.py \
-      || { probe || break; }
-    # 20 optimizer steps per dispatch: the A/B vs lm_bs16 splits chip
-    # time from host-dispatch/tunnel-RTT time (engine.make_multi_train_step).
-    run lm_bs16_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
-      || { probe || break; }
-    # bf16 logits tiles in the chunked head: the non-Pallas half of the
-    # head-HBM attack (xent_impl=chunked_bf16) — runs even when the
-    # Pallas canary fails.
-    run lm_bs16_cb16  600 env BENCH_LM_BATCH=16 BENCH_LM_XENT=chunked_bf16 python bench_lm.py \
-      || { probe || break; }
-    run lm_bs24       600 env BENCH_LM_BATCH=24 python bench_lm.py \
-      || { probe || break; }
-    run lm_bs32_rattn 600 env BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn python bench_lm.py \
-      || { probe || break; }
-    # 4k/8k rows on the XLA path: long-context numbers that cannot hang
-    # in a Pallas compile (remat=attn keeps the (S,S) out of residuals).
-    run lm_s4096_xla  900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
-      || { probe || break; }
-    run lm_s8192_xla  900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
-      || { probe || break; }
-    # -- p3: TPU convergence artifact (gate via the CLI) -----------------
-    if [ ! -f "$STAMPS/conv_tpu" ]; then
-      if timeout 900 python train.py --workload mnist_lenet --steps 600 \
-          --eval-every 100 --target-metric accuracy --target-value 0.97 \
-          --logdir ARTIFACTS/convergence_mnist_tpu --log-every 100 >> "$LOG" 2>&1; then
-        touch "$STAMPS/conv_tpu" ARTIFACTS/convergence_mnist_tpu/.done
-        log "item conv_tpu: LANDED"
-      else
-        log "item conv_tpu: failed"; probe || break
-      fi
-    fi
-    # -- p4: headline refresh with the MFU pair --------------------------
-    run resnet        900 python bench.py            || { probe || break; }
-    run resnet_in10   900 env BENCH_INNER=10 python bench.py || { probe || break; }
-    run resnet_bs256  900 env BENCH_BATCH=256 python bench.py || { probe || break; }
-    run bert          900 python bench_bert.py       || { probe || break; }
-    # ResNet step profile: the instrument for pushing past 1.07x (same
-    # role as profile_lm for the LM row).
+    # ResNet step profile: the instrument for pushing past 1.07x.
     if [ ! -f "$STAMPS/profile_resnet" ]; then
       if timeout 900 python train.py --workload imagenet_resnet50 --steps 20 \
           --batch-size 128 --profile-dir BENCH_RESULTS/profile_resnet_tpu \
@@ -163,13 +192,14 @@ while true; do
   done
 
   missing=0
-  for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs16_cb16 lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla \
+  for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs16_cb16 lm_bs16_cb16_in20 \
+           lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla attn_8k_dense \
            conv_tpu resnet resnet_in10 resnet_bs256 bert profile_resnet attn_4k \
            lm_bs16_fx lm_bs16_fx20 lm_bs32_pl lm_bs32_plfx lm_s8192_pl \
            attn_16k32k; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
-  log "window done, $missing items still missing; sleeping"
+  log "window done, $missing items still missing ($(cache_stat)); sleeping"
   sleep "$SLEEP"
 done

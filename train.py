@@ -150,7 +150,9 @@ def apply_optimizer_flags(wl, args):
             f"(supported: {', '.join(_DECAY_CAPABLE)})"
         )
     if args.clipnorm < 0:
-        raise SystemExit(f"--clipnorm must be > 0, got {args.clipnorm}")
+        raise SystemExit(
+            f"--clipnorm must be >= 0 (0 disables clipping), got {args.clipnorm}"
+        )
     try:
         lr = build_schedule(
             args.schedule, args.lr,
