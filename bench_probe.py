@@ -42,7 +42,7 @@ if os.environ.get("BENCH_NO_COMPILE_CACHE") != "1":
     # defaults are already frozen from the pre-bench_probe environment —
     # env vars alone land only in subprocesses (the probe children).  Push
     # the values into the live config too.
-    if "jax" in __import__("sys").modules:
+    if "jax" in sys.modules:
         import jax
 
         _cfg = {

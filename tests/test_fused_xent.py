@@ -121,6 +121,55 @@ def test_fused_forward_scratch_chunking(monkeypatch):
     np.testing.assert_array_equal(np.asarray(gw_one), np.asarray(gw_c))
 
 
+def test_fused_hbm_traffic_bound(monkeypatch):
+    """Chip-free check of the kernel's headline HBM claim (VERDICT r3 #5).
+
+    The module docstring claims ~3 GB/step of head HBM traffic at the
+    GPT-2-small headline config vs ~20 GB for the logits-materializing
+    chunked head.  estimate_hbm_bytes derives traffic by walking the
+    kernels' actual (grid, index_map) pairs, so this test breaks if a
+    tiling/loop-order change silently regresses the traffic pattern —
+    the Pallas-free verification story for a kernel the TPU tunnel may
+    never compile.
+    """
+    from distributedtensorflow_tpu.ops.fused_xent import (
+        _max_fwd_token_blocks,
+        _walk_fetches,
+        estimate_hbm_bytes,
+    )
+
+    # Headline config: B=16, S=1024, GPT-2-small head.  Pin the default
+    # scratch budget: an ambient DTFT_XENT_FWD_SCRATCH_BYTES would change
+    # the chunking and fail the magnitude window spuriously.
+    monkeypatch.delenv("DTFT_XENT_FWD_SCRATCH_BYTES", raising=False)
+    e = estimate_hbm_bytes(16 * 1024, 768, 50257)
+    assert 2e9 < e["total_bytes"] < 4e9, e
+    assert e["chunked_head_bytes"] > 5 * e["total_bytes"], e
+
+    # Structural invariants of the design (not just magnitudes):
+    # fwd reads the weight table exactly ONCE per token super-chunk
+    # (vocab-outer: each w block is fetched once and stays resident for
+    # the whole inner token sweep).
+    n_j, n_i = 25, 32  # 50257/2048 vocab blocks (padded), 16384/512 tokens
+    assert _walk_fetches((n_j, n_i), lambda j, i: (j, 0)) == n_j
+    # dx (token-outer) re-reads the whole table once per token block.
+    assert _walk_fetches((n_i, n_j), lambda i, j: (j, 0)) == n_i * n_j
+    # Token super-chunking multiplies only the fwd weight stream: at a
+    # quarter of the single-call chunk size, fwd re-reads w 4x.  Budgets
+    # chosen so both runs chunk WITHOUT a ragged tail (a 1-block tail
+    # chunk legitimately fetches x only once, which would perturb the
+    # x stream and obscure the w-only invariant).
+    n_tok = 80 * 512  # 40960: multiple of both chunk sizes below
+    per_block = 3 * 8 * 512 * 4
+    monkeypatch.setenv("DTFT_XENT_FWD_SCRATCH_BYTES", str(80 * per_block))
+    assert _max_fwd_token_blocks(512) == 80
+    one = estimate_hbm_bytes(n_tok, 768, 50257)   # 1 chunk of 80
+    monkeypatch.setenv("DTFT_XENT_FWD_SCRATCH_BYTES", str(20 * per_block))
+    four = estimate_hbm_bytes(n_tok, 768, 50257)  # 4 chunks of 20
+    w_stream = 25 * 2048 * 768 * 2  # one full bf16 table read
+    assert four["fwd_bytes"] - one["fwd_bytes"] == 3 * w_stream
+
+
 def test_fused_grad_under_jit_and_vjp_dtype():
     hidden, wte, targets, mask = _setup()
 
