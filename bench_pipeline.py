@@ -147,6 +147,64 @@ def main() -> None:
             "state_bytes_per_device": sbytes,
         }
 
+    memory = None
+    if os.environ.get("BENCH_PIPE_MEM") == "1":
+        # Memory-headroom row (VERDICT r3 #6): compile — don't run — the
+        # dp2 x pipe4 train step at REAL GPT-2 vocab with the table (a)
+        # row-sharded over pipe (gpt_pipeline.layout's ZeRO-style
+        # placement) and (b) replicated, and read XLA's per-device memory
+        # analysis.  Headroom is quoted against the v5e's 16 GB HBM.
+        import re
+
+        from jax.sharding import PartitionSpec as P
+
+        v5e_hbm = 16 * 1024**3
+        mem_cfg = dataclasses.replace(
+            cfg, vocab_size=50264, hidden_size=256, num_layers=4,
+        )
+        mesh = build_mesh(MeshSpec(data=2, pipe=4), devices)
+        pp = PipelinedGPT(mem_cfg, mesh, n_microbatches=4)
+        base_rule = pp.layout()
+
+        def replicated_rule(path, shape):
+            if path.endswith("wte/embedding"):
+                return P()
+            return base_rule(path, shape)
+
+        mem_batch = {
+            "input_ids": np.zeros((8, seq), np.int32)
+        }
+        memory = {
+            "config": "gpt_vocab50264_h256_L4_dp2xpipe4_b8",
+            "v5e_hbm_bytes": v5e_hbm,
+        }
+        for name, rule in [("table_sharded_pipe", base_rule),
+                           ("table_replicated", replicated_rule)]:
+            state, specs = create_sharded_state(
+                pp.init, optax.adamw(1e-3), mesh, jax.random.PRNGKey(0),
+                rules=rule,
+            )
+            comp = make_train_step(
+                pipelined_lm_loss(pp), mesh, specs
+            ).lower(state, mem_batch, jax.random.PRNGKey(1)).compile()
+            ma = comp.memory_analysis()
+            full_vocab = sorted(set(re.findall(
+                r"\w+\[[\d,]*\b50264\b[\d,]*\]", comp.as_text()
+            )))
+            per_dev = ma.argument_size_in_bytes + ma.temp_size_in_bytes
+            memory[name] = {
+                "argument_bytes_per_device": ma.argument_size_in_bytes,
+                "temp_bytes_per_device": ma.temp_size_in_bytes,
+                "full_vocab_tensors_in_hlo": full_vocab[:4],
+                "headroom_vs_v5e_16gb": round(v5e_hbm / per_dev, 1),
+            }
+        sh, rp = memory["table_sharded_pipe"], memory["table_replicated"]
+        memory["sharded_saves_factor"] = round(
+            (rp["argument_bytes_per_device"] + rp["temp_bytes_per_device"])
+            / (sh["argument_bytes_per_device"] + sh["temp_bytes_per_device"]),
+            2,
+        )
+
     base = rows["dense_dp8"]["steps_per_sec"]
     for row in rows.values():
         row["vs_dense"] = round(row["steps_per_sec"] / base, 4)
@@ -162,6 +220,7 @@ def main() -> None:
         "n_microbatches": n_micro,
         "global_batch": global_batch,
         "seq": seq,
+        "memory": memory,
         "host_oversubscribed": True,
         "note": (
             "8 virtual devices on one core: ratios measure pipelining "

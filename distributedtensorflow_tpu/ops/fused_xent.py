@@ -439,17 +439,22 @@ def estimate_hbm_bytes(
     def pad(x, m):
         return x + (-x) % m
 
-    n = pad(n_tokens, math.lcm(block_tokens, block_tokens_dx))
-    vp = pad(v, math.lcm(block_vocab, block_vocab_dx))
+    # Padding mirrors the real call path exactly: forward pads to ITS
+    # block sizes only (`_fused_fwd` -> `_pad_to(..., block_n)`), while
+    # backward pads to the lcm of the dx and dw tilings (`_fused_bwd`).
+    n_fwd = pad(n_tokens, block_tokens)
+    vp_fwd = pad(v, block_vocab)
+    n = pad(n_tokens, math.lcm(block_tokens_dx, block_tokens))
+    vp = pad(v, math.lcm(block_vocab_dx, block_vocab))
     row_b = 4  # fp32 (1, block_n) rows: t/lse/tgt/c
     out = {}
 
     # forward (per token super-chunk): grid (n_j, n_i), j outer
     chunk_tokens = _max_fwd_token_blocks(block_tokens) * block_tokens
     fwd = 0
-    for s in range(0, n, chunk_tokens):
-        n_c = min(chunk_tokens, n - s)
-        n_i, n_j = n_c // block_tokens, vp // block_vocab
+    for s in range(0, n_fwd, chunk_tokens):
+        n_c = min(chunk_tokens, n_fwd - s)
+        n_i, n_j = n_c // block_tokens, vp_fwd // block_vocab
         grid = (n_j, n_i)
         x_f = _walk_fetches(grid, lambda j, i: (i, 0))
         w_f = _walk_fetches(grid, lambda j, i: (j, 0))
