@@ -67,6 +67,17 @@ class PipelinedGPT:
     #: real ``seq`` axis: "ring" (ppermute KV rotation) or "ulysses"
     #: (all_to_all head<->sequence reshard).
     sp_scheme: str = "ring"
+    #: Dtype of the inter-stage ppermute PAYLOAD (the wire).  None = the
+    #: fp32 schedule dtype end to end.  "bfloat16" halves the per-handoff
+    #: ICI traffic by casting down just before the collective and back up
+    #: after; with a bf16 model the stage output is an upcast bf16 value,
+    #: so the roundtrip is BIT-EXACT (asserted by test) — requires
+    #: cfg.dtype=bfloat16 for that reason.  Scan carries, schedule
+    #: buffers, and the region boundary stay fp32: jax 0.9's
+    #: partial-manual partitioner hard-aborts on bf16 region boundaries
+    #: under autodiff (the wire cast is the safe subset of the
+    #: optimization; see :meth:`apply`).
+    handoff_dtype: str | None = None
 
     def __post_init__(self):
         cfg = self.cfg
@@ -103,6 +114,22 @@ class PipelinedGPT:
             raise NotImplementedError(
                 "dropout inside the pipeline needs per-stage rng plumbing; "
                 "set dropout_rate=0 for pipeline parallelism"
+            )
+        if self.handoff_dtype is None:
+            self._wire = None
+        elif self.handoff_dtype in ("bfloat16", "bf16"):
+            if cfg.dtype != jnp.bfloat16:
+                raise ValueError(
+                    "handoff_dtype=bfloat16 requires cfg.dtype=bfloat16 — "
+                    "a bf16 wire under an fp32 model would silently round "
+                    "every cross-stage residual (with a bf16 model the "
+                    "cast is exact)"
+                )
+            self._wire = jnp.bfloat16
+        else:
+            raise ValueError(
+                f"handoff_dtype must be None or 'bfloat16', "
+                f"got {self.handoff_dtype!r}"
             )
         self.layers_per_stage = cfg.num_layers // total_stages
         self._embed = nn.Embed(
@@ -296,12 +323,13 @@ class PipelinedGPT:
                 local = jax.tree.map(lambda p: p[:, 0], block_params)
                 out = circular_pipeline_apply(
                     self._stage_fn, local, mb, n_virtual=n_virtual,
-                    axis_name=self.axis_name,
+                    axis_name=self.axis_name, wire_dtype=self._wire,
                 )
             else:
                 local = jax.tree.map(lambda p: p[0], block_params)
                 out = pipeline_apply(
-                    self._stage_fn, local, mb, axis_name=self.axis_name
+                    self._stage_fn, local, mb, axis_name=self.axis_name,
+                    wire_dtype=self._wire,
                 )
             return out.reshape(xl.shape)
 
@@ -309,12 +337,17 @@ class PipelinedGPT:
         # fp32: jax 0.9's partial-manual shard_map partitioner crashed on
         # bf16 copies ("invalid binary instruction opcode copy") when the
         # region composes with GSPMD-auto tensor-parallel kernels inside
-        # (pipe x model).  Plain data x pipe bf16 regions DO compile
-        # (tests/test_jax_workarounds.py pins both facts), but the
-        # boundary dtype is kept uniform across compositions.  Stage
-        # compute is still cfg.dtype (see _stage_fn); the fp32 handoffs
-        # are (mb, S, D) residuals — tiny next to the stage matmuls — and
-        # ln_f upcasts the output anyway.
+        # (pipe x model), and hard-ABORTS the process under autodiff of a
+        # bf16-boundary region on every composition (probed round 4).
+        # Plain data x pipe bf16 FORWARD regions do compile
+        # (tests/test_jax_workarounds.py pins the facts), but training is
+        # the product, so the boundary stays fp32 unconditionally; the
+        # safe subset of the bf16 optimization is the ppermute PAYLOAD
+        # cast (``handoff_dtype="bfloat16"`` -> pipeline wire_dtype),
+        # which is bit-exact for bf16 models.  Stage compute is still
+        # cfg.dtype (see _stage_fn); fp32 handoffs are (mb, S, D)
+        # residuals — tiny next to the stage matmuls — and ln_f upcasts
+        # the output anyway.
         # The jit wrapper is load-bearing: partial-manual shard_map has no
         # eager impl path in jax 0.9 (_unmatch_spec only supports
         # all-manual), and grad-of-eager interprets the region the same

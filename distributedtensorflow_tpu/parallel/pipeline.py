@@ -41,6 +41,16 @@ def gpipe_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
     return (n_stages - 1) / (n_microbatches + n_stages - 1)
 
 
+def _wire_ppermute(y, axis_name, perm, wire_dtype):
+    """ppermute with an optional payload-only downcast (see wire_dtype in
+    :func:`pipeline_apply`)."""
+    if wire_dtype is None or jnp.dtype(wire_dtype) == y.dtype:
+        return lax.ppermute(y, axis_name, perm)
+    return lax.ppermute(
+        y.astype(wire_dtype), axis_name, perm
+    ).astype(y.dtype)
+
+
 def pipeline_apply(
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
     stage_params: PyTree,
@@ -48,6 +58,7 @@ def pipeline_apply(
     *,
     axis_name: str = mesh_lib.AXIS_PIPE,
     remat: bool = False,
+    wire_dtype: object | None = None,
 ) -> jax.Array:
     """Run the microbatch pipeline (shard_map-internal).
 
@@ -61,6 +72,15 @@ def pipeline_apply(
     ``n_micro + n_stages - 1`` of them — the activation-memory control that
     motivates 1F1B schedules, obtained here by rematerialization (GPipe's
     bubble fraction is unchanged; see :func:`gpipe_bubble_fraction`).
+
+    ``wire_dtype`` casts ONLY the ppermute payload (cast down before the
+    collective, back up after): with a bf16 model whose stage outputs are
+    upcast bf16 values the roundtrip is bit-exact while the inter-stage
+    wire traffic halves.  Scan carries, schedule buffers, and the region
+    boundary keep the microbatches' dtype — jax 0.9's partial-manual
+    partitioner aborts on bf16 region boundaries under autodiff
+    (tests/test_jax_workarounds.py), which is why the cast lives HERE and
+    not at the boundary.
     """
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
@@ -86,7 +106,7 @@ def pipeline_apply(
             + out_update,
             mb_idx, axis=0,
         )
-        recv = lax.ppermute(y, axis_name, perm_fwd)
+        recv = _wire_ppermute(y, axis_name, perm_fwd, wire_dtype)
         return (recv, outputs), None
 
     recv0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
@@ -219,6 +239,7 @@ def circular_pipeline_apply(
     n_virtual: int,
     axis_name: str = mesh_lib.AXIS_PIPE,
     remat: bool = False,
+    wire_dtype: object | None = None,
 ) -> jax.Array:
     """Interleaved-pipeline microbatch loop (shard_map-internal).
 
@@ -228,7 +249,8 @@ def circular_pipeline_apply(
     per-rank circular buffer for ``M - n`` ticks and re-enters rank 0 for
     its next chunk.  Requires ``n_micro >= n_ranks`` (the wrap-around
     arrives before its re-entry slot).  ``stage_fn`` must be
-    shape-preserving, as in :func:`pipeline_apply`.
+    shape-preserving and ``wire_dtype`` casts the ppermute payload only,
+    both as in :func:`pipeline_apply`.
     """
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
@@ -275,7 +297,7 @@ def circular_pipeline_apply(
             + jnp.where(done, y, 0.0),
             m, axis=0,
         )
-        recv = lax.ppermute(y, axis_name, perm_fwd)
+        recv = _wire_ppermute(y, axis_name, perm_fwd, wire_dtype)
         return (recv, circ, outputs), None
 
     recv0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)

@@ -163,6 +163,7 @@ def get_workload(name: str, *, test_size: bool = False,
                  global_batch_size: int | None = None,
                  sp_scheme: str = "ring",
                  pp_virtual: int = 1,
+                 pp_handoff: str | None = None,
                  seq_len: int | None = None,
                  remat: bool | str | None = None,
                  attn_impl: str | None = None,
@@ -173,7 +174,11 @@ def get_workload(name: str, *, test_size: bool = False,
     on meshes with a ``seq`` axis: ``"ring"`` (ppermute KV rotation, flash
     chunk kernels) or ``"ulysses"`` (all_to_all head<->sequence reshard).
     ``pp_virtual > 1`` selects the circular (interleaved) pipeline schedule
-    for ``gpt_lm`` on meshes with a ``pipe`` axis.  ``seq_len`` / ``remat``
+    for ``gpt_lm`` on meshes with a ``pipe`` axis.  ``pp_handoff``
+    ("bfloat16" or None) sets the dtype of the pipeline's inter-stage
+    ppermute payload — bf16 halves the wire (ICI) traffic, bit-exactly
+    for bf16 models; carries/buffers stay fp32 (see
+    PipelinedGPT.handoff_dtype).  ``seq_len`` / ``remat``
     override the LM presets' sequence length and rematerialization (remat
     trades ~1/3 extra FLOPs for activation memory; benches turn it off when
     the batch fits).
@@ -372,7 +377,8 @@ def get_workload(name: str, *, test_size: bool = False,
                 while n_micro > 1 and local_batch % n_micro:
                     n_micro //= 2
                 pp = PipelinedGPT(cfg, mesh, n_microbatches=n_micro,
-                                  n_virtual=pp_virtual, sp_scheme=sp_scheme)
+                                  n_virtual=pp_virtual, sp_scheme=sp_scheme,
+                                  handoff_dtype=pp_handoff)
                 return dataclasses.replace(
                     wl,
                     model=pp,

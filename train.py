@@ -25,6 +25,7 @@ import jax
 #: --remat CLI choice -> get_workload(remat=...) value; single mapping
 #: shared by the trainer and evaluator roles so their graphs can't diverge.
 REMAT_FLAG = {"on": True, "off": False, "attn": "attn", None: None}
+_PP_HANDOFF = {"fp32": None, "bf16": "bfloat16"}
 
 
 def parse_mesh(s: str | None):
@@ -203,6 +204,7 @@ def run_evaluator(args) -> None:
         args.workload, test_size=args.test_size,
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual, seq_len=args.seq_len,
+        pp_handoff=_PP_HANDOFF[args.pp_handoff_dtype],
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
         remat=REMAT_FLAG[args.remat],
@@ -543,6 +545,13 @@ def main() -> None:
     p.add_argument("--pp-virtual", type=int, default=1,
                    help="virtual pipeline chunks per rank (>1 = circular/"
                         "interleaved schedule, smaller bubble)")
+    p.add_argument("--pp-handoff-dtype", choices=("fp32", "bf16"),
+                   default="fp32",
+                   help="dtype of the inter-stage ppermute PAYLOAD: bf16 "
+                        "halves the pipeline's wire (ICI) traffic and is "
+                        "bit-exact for bf16 models (requires one); scan "
+                        "carries and schedule buffers stay fp32 — a jax "
+                        "0.9 partial-manual partitioner limitation")
     p.add_argument("--job", choices=("auto", "train", "evaluator",
                                      "async-ps"),
                    default="auto",
@@ -682,6 +691,7 @@ def main() -> None:
         args.workload, test_size=args.test_size,
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual,
+        pp_handoff=_PP_HANDOFF[args.pp_handoff_dtype],
         seq_len=args.seq_len,
         remat=REMAT_FLAG[args.remat],
         attn_impl=args.attn_impl,
