@@ -1,0 +1,320 @@
+"""Encoder-decoder seq2seq LM — the T5-class model family, redesigned
+TPU-first.
+
+Reference anchor: the reference stack's encoder-decoder coverage is the
+Keras seq2seq family (TF model-garden T5/transformer: encoder stack +
+causal decoder stack + cross-attention + teacher forcing).  SURVEY.md
+§2.3's zoo row names encoder-only (BERT), decoder-only (GPT), conv and
+recsys families; this adds the remaining transformer family so a
+reference user's seq2seq workloads have a home.
+
+TPU-first deviations from the T5 paper (deliberate — this is a redesign,
+not a port):
+
+- **RoPE instead of relative-position bias buckets**: T5's learned
+  bucketed bias adds a (H, Sq, Sk) tensor to every score matrix, which
+  blocks the flash-attention kernels (they support masks/segments, not
+  additive bias) and costs HBM at long sequence.  Rotary embeddings are
+  position-relative too, compose with every kernel in ``ops/attention``,
+  and add zero parameters.  Cross-attention uses each side's OWN
+  positions (decoder positions rotate q, encoder positions rotate k) —
+  relative offsets between the streams are meaningful.
+- **Pre-RMSNorm** (fp32 math, like T5 1.1) everywhere; bf16 matmuls with
+  the same dtype discipline as ``models/gpt.py``.
+- **Tied embedding + chunked CE head**: one (V, D) table serves encoder
+  input, decoder input, and the output head via
+  :func:`..ops.xent.chunked_softmax_xent` — full (B, S, V) logits never
+  materialize, and the table row-shards over ``model`` exactly like the
+  GPT/BERT layouts (the head is TP-clean under GSPMD, ops/xent.py note).
+- Attention kernels route through :func:`..ops.attention
+  .dot_product_attention`, so Pallas flash drops in on TPU for the
+  causal decoder self-attention.
+
+Naming mirrors models/bert.py (``query``/``key``/``value``/``out``,
+``mlp_in``/``mlp_out``) so :func:`seq2seq_layout` reuses the proven
+Megatron column/row-parallel rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..ops.xent import chunked_softmax_xent
+from ..parallel.sharding import LayoutMap
+from .gpt import rope
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    num_heads: int = 8
+    enc_layers: int = 6
+    dec_layers: int = 6
+    intermediate_size: int = 2048
+    max_seq: int = 512
+    dropout_rate: float = 0.0
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.bfloat16
+    #: id that starts every decoder input (teacher forcing shift-in).
+    bos_id: int = 0
+    #: padding id — excluded from the loss and from encoder attention.
+    pad_id: int = 1
+
+
+def seq2seq_small() -> Seq2SeqConfig:
+    """T5-small-scale (~60M params with a 32k vocab)."""
+    return Seq2SeqConfig()
+
+
+def seq2seq_tiny() -> Seq2SeqConfig:
+    """Test-size config (2+2 layers, 128 hidden)."""
+    return Seq2SeqConfig(
+        vocab_size=512, hidden_size=128, num_heads=4, enc_layers=2,
+        dec_layers=2, intermediate_size=256, max_seq=128,
+    )
+
+
+class _Attention(nn.Module):
+    """Self- or cross-attention with per-stream RoPE.
+
+    ``kv`` is the key/value source (== ``x`` for self-attention).
+    ``q_positions``/``kv_positions`` rotate q and k with their own
+    stream's positions; cross-attention passes encoder positions for k.
+    """
+
+    cfg: Seq2SeqConfig
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv, *, q_positions, kv_positions, mask,
+                 deterministic: bool):
+        cfg = self.cfg
+        if kv is None:  # self-attention
+            kv = x
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(
+            (cfg.num_heads, head_dim), dtype=cfg.dtype, use_bias=False,
+            name=name,
+        )
+        q = rope(dense("query")(x), q_positions, cfg.rope_theta)
+        k = rope(dense("key")(kv), kv_positions, cfg.rope_theta)
+        v = dense("value")(kv)
+        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+        out = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, use_bias=False,
+            name="out",
+        )(out)
+        if not deterministic:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=False)
+        return out
+
+
+class _MLP(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.cfg
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, use_bias=False,
+                     name="mlp_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, use_bias=False,
+                     name="mlp_out")(h)
+        if not deterministic:
+            h = nn.Dropout(cfg.dropout_rate)(h, deterministic=False)
+        return h
+
+
+class EncoderBlock(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x, *, positions, mask, deterministic):
+        cfg = self.cfg
+        norm = lambda name: nn.RMSNorm(dtype=jnp.float32, name=name)
+        x = x + _Attention(cfg, name="attention")(
+            norm("ln_attn")(x).astype(cfg.dtype), None,
+            q_positions=positions, kv_positions=positions, mask=mask,
+            deterministic=deterministic,
+        )
+        x = x + _MLP(cfg, name="mlp")(
+            norm("ln_mlp")(x).astype(cfg.dtype), deterministic
+        )
+        return x
+
+
+class DecoderBlock(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x, enc_out, *, positions, enc_positions, cross_mask,
+                 deterministic):
+        cfg = self.cfg
+        norm = lambda name: nn.RMSNorm(dtype=jnp.float32, name=name)
+        x = x + _Attention(cfg, causal=True, name="attention")(
+            norm("ln_attn")(x).astype(cfg.dtype), None,
+            q_positions=positions, kv_positions=positions, mask=None,
+            deterministic=deterministic,
+        )
+        x = x + _Attention(cfg, name="cross_attention")(
+            norm("ln_cross")(x).astype(cfg.dtype), enc_out,
+            q_positions=positions, kv_positions=enc_positions,
+            mask=cross_mask, deterministic=deterministic,
+        )
+        x = x + _MLP(cfg, name="mlp")(
+            norm("ln_mlp")(x).astype(cfg.dtype), deterministic
+        )
+        return x
+
+
+class Seq2SeqLM(nn.Module):
+    """Tied-embedding encoder-decoder; ``__call__`` returns the decoder's
+    final hidden states (the loss applies the chunked tied head)."""
+
+    cfg: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.shared_embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="shared"
+        )
+        self.enc_blocks = [
+            EncoderBlock(cfg, name=f"enc_{i}") for i in range(cfg.enc_layers)
+        ]
+        self.dec_blocks = [
+            DecoderBlock(cfg, name=f"dec_{i}") for i in range(cfg.dec_layers)
+        ]
+        self.enc_norm = nn.RMSNorm(dtype=jnp.float32, name="enc_norm")
+        self.dec_norm = nn.RMSNorm(dtype=jnp.float32, name="dec_norm")
+
+    def _check_len(self, ids, stream: str):
+        # RoPE itself is unbounded but extrapolates poorly past trained
+        # lengths; max_seq is the declared training envelope and the
+        # workload preset grows it with seq_len overrides.
+        if ids.shape[-1] > self.cfg.max_seq:
+            raise ValueError(
+                f"{stream} length {ids.shape[-1]} exceeds "
+                f"cfg.max_seq={self.cfg.max_seq}; raise max_seq (RoPE has "
+                "no table to outgrow, but lengths beyond the trained "
+                "envelope degrade)"
+            )
+
+    def encode(self, encoder_ids, deterministic: bool = True):
+        cfg = self.cfg
+        self._check_len(encoder_ids, "encoder")
+        positions = jnp.broadcast_to(
+            jnp.arange(encoder_ids.shape[-1]), encoder_ids.shape
+        )
+        pad = encoder_ids != cfg.pad_id  # (B, Senc) True = real token
+        # keys masked everywhere a pad sits; every query row stays valid
+        # (padded QUERY rows produce garbage that the loss never reads).
+        mask = pad[:, None, None, :]
+        x = self.shared_embed(encoder_ids).astype(jnp.float32)
+        for block in self.enc_blocks:
+            x = block(x, positions=positions, mask=mask,
+                      deterministic=deterministic)
+        return self.enc_norm(x), pad, positions
+
+    def decode(self, decoder_ids, enc_out, enc_pad, enc_positions,
+               deterministic: bool = True):
+        self._check_len(decoder_ids, "decoder")
+        positions = jnp.broadcast_to(
+            jnp.arange(decoder_ids.shape[-1]), decoder_ids.shape
+        )
+        cross_mask = enc_pad[:, None, None, :]
+        x = self.shared_embed(decoder_ids).astype(jnp.float32)
+        for block in self.dec_blocks:
+            x = block(x, enc_out.astype(self.cfg.dtype),
+                      positions=positions, enc_positions=enc_positions,
+                      cross_mask=cross_mask, deterministic=deterministic)
+        return self.dec_norm(x)
+
+    def __call__(self, encoder_ids, decoder_ids, deterministic: bool = True):
+        enc_out, enc_pad, enc_positions = self.encode(
+            encoder_ids, deterministic
+        )
+        return self.decode(
+            decoder_ids, enc_out, enc_pad, enc_positions, deterministic
+        )
+
+
+def shift_right(targets: jax.Array, bos_id: int) -> jax.Array:
+    """Teacher-forcing decoder input: [BOS, t0, t1, ...] (drops the last)."""
+    return jnp.concatenate(
+        [jnp.full_like(targets[:, :1], bos_id), targets[:, :-1]], axis=1
+    )
+
+
+def seq2seq_loss(model: Seq2SeqLM):
+    """Mean next-token NLL over non-pad target positions, tied chunked
+    head (same reduction semantics as gpt.lm_loss)."""
+    cfg = model.cfg
+
+    def loss_fn(params, model_state, batch, rng):
+        targets = batch["targets"]
+        dec_in = shift_right(targets, cfg.bos_id)
+        hidden = model.apply(
+            {"params": params}, batch["encoder_ids"], dec_in,
+            deterministic=not cfg.dropout_rate,
+            rngs={"dropout": rng} if cfg.dropout_rate else None,
+        )
+        mask = (targets != cfg.pad_id).astype(jnp.float32)
+        loss = chunked_softmax_xent(
+            hidden, params["shared"]["embedding"], targets, mask,
+            compute_dtype=cfg.dtype,
+        )
+        return loss, ({"perplexity": jnp.exp(loss)}, model_state)
+
+    return loss_fn
+
+
+def seq2seq_eval(model: Seq2SeqLM):
+    """Teacher-forced token accuracy + loss/perplexity; the argmax
+    streams token chunks (:func:`..ops.xent.chunked_argmax`) so eval,
+    like training, never materializes (B, S, V) logits."""
+    cfg = model.cfg
+
+    def metric_fn(params, model_state, batch):
+        targets = batch["targets"]
+        dec_in = shift_right(targets, cfg.bos_id)
+        hidden = model.apply(
+            {"params": params}, batch["encoder_ids"], dec_in,
+            deterministic=True,
+        )
+        mask = (targets != cfg.pad_id).astype(jnp.float32)
+        loss = chunked_softmax_xent(
+            hidden, params["shared"]["embedding"], targets, mask,
+            compute_dtype=cfg.dtype,
+        )
+        from ..ops.xent import chunked_argmax
+
+        pred = chunked_argmax(
+            hidden, params["shared"]["embedding"], compute_dtype=cfg.dtype
+        )
+        correct = (pred == targets).astype(jnp.float32)
+        acc = jnp.sum(correct * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return {"loss": loss, "accuracy": acc,
+                "perplexity": jnp.exp(loss)}
+
+    return metric_fn
+
+
+def seq2seq_layout() -> LayoutMap:
+    """Megatron TP rules over ``model`` — same column/row split as
+    :func:`..models.bert.bert_layout`, applied to self-, cross-, and MLP
+    kernels in both stacks; the shared table row-shards (vocab) so the
+    chunked head partitions cleanly (ops/xent.py TP note)."""
+    return LayoutMap([
+        (r"(query|key|value)/kernel", P(None, "model", None)),
+        (r"(attention|cross_attention)/out/kernel", P("model", None, None)),
+        (r"mlp_in/kernel", P(None, "model")),
+        (r"mlp_out/kernel", P("model", None)),
+        (r"shared/embedding", P("model", None)),
+    ])

@@ -41,6 +41,44 @@ from jax import lax
 DEFAULT_CHUNK_TOKENS = 4096
 
 
+def chunked_argmax(
+    hidden: jax.Array,   # (B, S, D) final hidden states
+    wte: jax.Array,      # (V, D) tied table
+    *,
+    chunk_tokens: int = DEFAULT_CHUNK_TOKENS,
+    compute_dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """Greedy token ids from the tied head WITHOUT full logits.
+
+    The eval-side sibling of :func:`chunked_softmax_xent`: argmax needs
+    the whole vocab row per token but not the whole (B, S, V) tensor —
+    streaming (C, V) tiles through a scan keeps eval's peak memory at the
+    training step's level (a sidecar evaluator must never OOM where the
+    trainer fits).  Returns int32 (B, S).
+    """
+    b, s, d = hidden.shape
+    n = b * s
+    x = hidden.reshape(n, d)
+    op_dtype = compute_dtype or jnp.result_type(hidden, wte)
+    wte_t = wte.T.astype(op_dtype)
+
+    c = min(chunk_tokens, n)
+    n_chunks = -(-n // c)
+    pad = n_chunks * c - n
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+
+    def body(_, x_c):
+        logits = jnp.matmul(
+            x_c.astype(op_dtype), wte_t,
+            preferred_element_type=jnp.float32,
+        )
+        return None, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    _, ids = lax.scan(body, None, x.reshape(n_chunks, c, d))
+    return ids.reshape(n_chunks * c)[:n].reshape(b, s)
+
+
 def tied_head_logits(
     x: jax.Array,    # (..., D) hidden states (fp32 post-ln_f)
     wte: jax.Array,  # (V, D) tied embedding table

@@ -147,6 +147,25 @@ def synthetic_lm(ctx: InputContext, *, vocab_size: int, seq_len: int,
         yield {"input_ids": ids.astype(np.int32)}
 
 
+def synthetic_seq2seq(ctx: InputContext, *, vocab_size: int, seq_len: int,
+                      pad_id: int, seed: int = 0):
+    """Synthetic copy-task batches for the encoder-decoder preset.
+
+    Targets are the encoder stream itself with a random-length pad tail —
+    the decoder can only learn it through cross-attention, so a falling
+    loss certifies the enc→dec path end to end (same philosophy as
+    synthetic_lm's arithmetic sequences).  Token ids avoid pad_id.
+    """
+    rng = np.random.default_rng(seed + ctx.input_pipeline_id)
+    n = ctx.per_host_batch_size
+    while True:
+        ids = rng.integers(2, vocab_size, size=(n, seq_len))
+        lengths = rng.integers(seq_len // 2, seq_len + 1, size=(n, 1))
+        keep = np.arange(seq_len) < lengths
+        ids = np.where(keep, ids, pad_id).astype(np.int32)
+        yield {"encoder_ids": ids, "targets": ids.copy()}
+
+
 def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
     rng = np.random.default_rng(seed + ctx.input_pipeline_id)
     n = ctx.per_host_batch_size
@@ -524,15 +543,58 @@ def get_workload(name: str, *, test_size: bool = False,
             layout=gpt_moe_layout(),
             finalize=finalize,
         )
+    if name == "t5_seq2seq":
+        # Encoder-decoder seq2seq (the T5-class family; models/seq2seq.py
+        # docstring records the TPU-first deviations).  Synthetic copy
+        # task: the decoder must reproduce the encoder stream, which is
+        # unlearnable without working cross-attention.
+        from .models.seq2seq import (
+            Seq2SeqLM,
+            seq2seq_eval,
+            seq2seq_layout,
+            seq2seq_loss,
+            seq2seq_small,
+            seq2seq_tiny,
+        )
+
+        cfg = seq2seq_tiny() if test_size else seq2seq_small()
+        seq = seq_len or (32 if test_size else 256)
+        if seq > cfg.max_seq:  # grow the declared envelope with overrides
+            cfg = dataclasses.replace(cfg, max_seq=seq)
+        model = Seq2SeqLM(cfg)
+        gbs = global_batch_size or (8 if test_size else 64)
+
+        def s2s_init(r):
+            z = jnp.zeros((2, seq), jnp.int32)
+            return model.init(r, z, z)
+
+        return Workload(
+            name=name, model=model,
+            loss_fn=seq2seq_loss(model),
+            eval_fn=seq2seq_eval(model),
+            make_optimizer=lambda: optax.adamw(3e-4, weight_decay=0.1),
+            input_fn=lambda ctx, seed: synthetic_seq2seq(
+                ctx, vocab_size=cfg.vocab_size, seq_len=seq,
+                pad_id=cfg.pad_id, seed=seed,
+            ),
+            init_batch={
+                "encoder_ids": np.zeros((2, seq), np.int32),
+                "targets": np.zeros((2, seq), np.int32),
+            },
+            init_fn=s2s_init,
+            global_batch_size=gbs,
+            mesh_spec=MeshSpec(data=-1),
+            layout=seq2seq_layout(),
+        )
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
         "imagenet_resnet50 imagenet_vit bert_mlm bert_mlm_packed bert_moe "
-        "widedeep gpt_lm lm_long_context gpt_moe"
+        "widedeep gpt_lm lm_long_context gpt_moe t5_seq2seq"
     )
 
 
 WORKLOADS = (
     "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "imagenet_vit",
     "bert_mlm", "bert_mlm_packed", "bert_moe", "widedeep", "gpt_lm",
-    "lm_long_context", "gpt_moe",
+    "lm_long_context", "gpt_moe", "t5_seq2seq",
 )
