@@ -129,8 +129,12 @@ class CausalSelfAttention(nn.Module):
         )(out)
 
     def _cached_attention(self, q, k, v):
-        """One-token decode step against the KV cache (static shapes: the
-        cache is ``max_seq`` long; future slots are masked out)."""
+        """One decode step against the KV cache — flax variable plumbing
+        around the shared :func:`..ops.attention.cached_decode_attention`
+        (one implementation for every serving path; seq2seq uses the same
+        helper)."""
+        from ..ops.attention import cached_decode_attention
+
         cfg = self.cfg
         b, s_new, h, d = q.shape
         cached_k = self.variable(
@@ -144,29 +148,12 @@ class CausalSelfAttention(nn.Module):
         cache_ix = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
-        ix = cache_ix.value
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k, (0, ix, 0, 0)
+        out, cached_k.value, cached_v.value, cache_ix.value = (
+            cached_decode_attention(
+                q, k, v, cached_k.value, cached_v.value, cache_ix.value
+            )
         )
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v, (0, ix, 0, 0)
-        )
-        cache_ix.value = ix + s_new
-        # Causal validity per query: query at absolute position ix+i sees
-        # keys at positions <= ix+i.  (Also correct for multi-token chunked
-        # prefill, not just one-token decode.)
-        q_pos = ix + jnp.arange(s_new)
-        k_idx = jnp.arange(cfg.max_seq)
-        valid = k_idx[None, :] <= q_pos[:, None]  # (s_new, max_seq)
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
-            cached_k.value.astype(jnp.float32),
-        ) / (d ** 0.5)
-        scores = jnp.where(valid[None, None, :, :], scores, -1e9)
-        weights = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum(
-            "bhqk,bkhd->bqhd", weights, cached_v.value.astype(jnp.float32)
-        ).astype(q.dtype)
+        return out
 
 
 class GPTBlock(nn.Module):

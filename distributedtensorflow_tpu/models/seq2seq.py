@@ -38,14 +38,15 @@ Megatron column/row-parallel rules.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import dot_product_attention
-from ..ops.xent import chunked_softmax_xent
+from ..ops.attention import cached_decode_attention, dot_product_attention
+from ..ops.xent import chunked_argmax, chunked_softmax_xent, tied_head_logits
 from ..parallel.sharding import LayoutMap
 from .gpt import rope
 
@@ -87,10 +88,20 @@ class _Attention(nn.Module):
     ``kv`` is the key/value source (== ``x`` for self-attention).
     ``q_positions``/``kv_positions`` rotate q and k with their own
     stream's positions; cross-attention passes encoder positions for k.
+
+    ``decode=True`` (causal self-attention only) runs the KV-cache
+    incremental path: new keys/values land in a flax "cache" collection
+    at ``cache_index`` and attention reads the whole cache with validity
+    masking — the same serving idiom as ``models/gpt.py``.  Cross-
+    attention needs no cache machinery in decode: its K/V come from the
+    fixed encoder output and each step's (1, S_enc) attention is already
+    cheap (the K/V projections are recomputed per step; caching them is
+    a future optimization, not a semantics change).
     """
 
     cfg: Seq2SeqConfig
     causal: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, kv, *, q_positions, kv_positions, mask,
@@ -106,13 +117,47 @@ class _Attention(nn.Module):
         q = rope(dense("query")(x), q_positions, cfg.rope_theta)
         k = rope(dense("key")(kv), kv_positions, cfg.rope_theta)
         v = dense("value")(kv)
-        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+        if self.decode:
+            if not self.causal:
+                raise ValueError(
+                    "decode caching applies to the causal self-attention; "
+                    "cross-attention runs the normal path in decode mode"
+                )
+            out = self._cached_attention(q, k, v)
+        else:
+            out = dot_product_attention(
+                q, k, v, mask=mask, causal=self.causal
+            )
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, use_bias=False,
             name="out",
         )(out)
         if not deterministic:
             out = nn.Dropout(cfg.dropout_rate)(out, deterministic=False)
+        return out
+
+    def _cached_attention(self, q, k, v):
+        """Flax variable plumbing around the shared
+        :func:`..ops.attention.cached_decode_attention` (same helper as
+        ``models/gpt.py`` — the serving paths cannot diverge)."""
+        cfg = self.cfg
+        b, s_new, h, d = q.shape
+        cached_k = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, cfg.max_seq, h, d), k.dtype),
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, cfg.max_seq, h, d), v.dtype),
+        )
+        cache_ix = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        out, cached_k.value, cached_v.value, cache_ix.value = (
+            cached_decode_attention(
+                q, k, v, cached_k.value, cached_v.value, cache_ix.value
+            )
+        )
         return out
 
 
@@ -152,13 +197,15 @@ class EncoderBlock(nn.Module):
 
 class DecoderBlock(nn.Module):
     cfg: Seq2SeqConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, enc_out, *, positions, enc_positions, cross_mask,
                  deterministic):
         cfg = self.cfg
         norm = lambda name: nn.RMSNorm(dtype=jnp.float32, name=name)
-        x = x + _Attention(cfg, causal=True, name="attention")(
+        x = x + _Attention(cfg, causal=True, decode=self.decode,
+                           name="attention")(
             norm("ln_attn")(x).astype(cfg.dtype), None,
             q_positions=positions, kv_positions=positions, mask=None,
             deterministic=deterministic,
@@ -176,9 +223,14 @@ class DecoderBlock(nn.Module):
 
 class Seq2SeqLM(nn.Module):
     """Tied-embedding encoder-decoder; ``__call__`` returns the decoder's
-    final hidden states (the loss applies the chunked tied head)."""
+    final hidden states (the loss applies the chunked tied head).
+    ``decode_cache=True`` switches the decoder self-attention to the
+    KV-cache incremental path (:func:`seq2seq_generate`)."""
 
     cfg: Seq2SeqConfig
+    #: KV-cache incremental decoding for the decoder self-attention
+    #: (named to avoid shadowing the ``decode`` method).
+    decode_cache: bool = False
 
     def setup(self):
         cfg = self.cfg
@@ -189,7 +241,8 @@ class Seq2SeqLM(nn.Module):
             EncoderBlock(cfg, name=f"enc_{i}") for i in range(cfg.enc_layers)
         ]
         self.dec_blocks = [
-            DecoderBlock(cfg, name=f"dec_{i}") for i in range(cfg.dec_layers)
+            DecoderBlock(cfg, decode=self.decode_cache, name=f"dec_{i}")
+            for i in range(cfg.dec_layers)
         ]
         self.enc_norm = nn.RMSNorm(dtype=jnp.float32, name="enc_norm")
         self.dec_norm = nn.RMSNorm(dtype=jnp.float32, name="dec_norm")
@@ -223,11 +276,12 @@ class Seq2SeqLM(nn.Module):
         return self.enc_norm(x), pad, positions
 
     def decode(self, decoder_ids, enc_out, enc_pad, enc_positions,
-               deterministic: bool = True):
+               deterministic: bool = True, positions=None):
         self._check_len(decoder_ids, "decoder")
-        positions = jnp.broadcast_to(
-            jnp.arange(decoder_ids.shape[-1]), decoder_ids.shape
-        )
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(decoder_ids.shape[-1]), decoder_ids.shape
+            )
         cross_mask = enc_pad[:, None, None, :]
         x = self.shared_embed(decoder_ids).astype(jnp.float32)
         for block in self.dec_blocks:
@@ -293,8 +347,6 @@ def seq2seq_eval(model: Seq2SeqLM):
             hidden, params["shared"]["embedding"], targets, mask,
             compute_dtype=cfg.dtype,
         )
-        from ..ops.xent import chunked_argmax
-
         pred = chunked_argmax(
             hidden, params["shared"]["embedding"], compute_dtype=cfg.dtype
         )
@@ -304,6 +356,96 @@ def seq2seq_eval(model: Seq2SeqLM):
                 "perplexity": jnp.exp(loss)}
 
     return metric_fn
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "greedy", "eos_token_id"),
+)
+def _s2s_generate_impl(params, encoder_ids, rng, temperature, *,
+                       cfg: Seq2SeqConfig, max_new_tokens: int,
+                       greedy: bool, eos_token_id: int):
+    from .generate import _sample
+
+    b = encoder_ids.shape[0]
+    wte = params["shared"]["embedding"]
+
+    enc_model = Seq2SeqLM(cfg)
+    enc_out, enc_pad, enc_pos = enc_model.apply(
+        {"params": params}, encoder_ids, method=enc_model.encode
+    )
+
+    model = Seq2SeqLM(cfg, decode_cache=True)
+    tokens = jnp.full((b, max_new_tokens + 1), cfg.bos_id, jnp.int32)
+    # Prime the cache with BOS at position 0.
+    hidden0, vars0 = model.apply(
+        {"params": params}, tokens[:, :1], enc_out, enc_pad, enc_pos,
+        positions=jnp.zeros((b, 1), jnp.int32),
+        method=model.decode, mutable=["cache"],
+    )
+    eos = eos_token_id
+
+    def step(carry, t):
+        tokens, cache, rng, hidden, done = carry
+        rng, sub = jax.random.split(rng)
+        logits = tied_head_logits(hidden[:, -1], wte, cfg.dtype)  # (B, V)
+        nxt = _sample(logits, sub, temperature, greedy=greedy, top_k=0)
+        if eos >= 0:
+            nxt = jnp.where(done, eos, nxt)
+            done = done | (nxt == eos)
+        nxt = nxt.astype(jnp.int32)
+        tokens = jax.lax.dynamic_update_slice_in_dim(
+            tokens, nxt[:, None], t + 1, axis=1
+        )
+        hidden, vars_out = model.apply(
+            {"params": params, "cache": cache}, nxt[:, None],
+            enc_out, enc_pad, enc_pos,
+            positions=jnp.full((b, 1), t + 1, jnp.int32),
+            method=model.decode, mutable=["cache"],
+        )
+        return (tokens, vars_out["cache"], rng, hidden, done), None
+
+    (tokens, _, _, _, _), _ = jax.lax.scan(
+        step,
+        (tokens, vars0["cache"], rng, hidden0,
+         jnp.zeros((b,), bool)),
+        jnp.arange(max_new_tokens),
+    )
+    return tokens[:, 1:]
+
+
+def seq2seq_generate(
+    params,
+    encoder_ids: jax.Array,  # (B, S_enc) with pad_id padding
+    *,
+    cfg: Seq2SeqConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    eos_token_id: int | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressive decoding: encode once, then KV-cache decoder steps.
+
+    Returns (B, max_new_tokens) generated ids (BOS excluded).
+    ``temperature=0`` is greedy; ``eos_token_id`` freezes a sequence from
+    its first eos on (static shapes).  Mirrors ``models/generate.py``'s
+    GPT loop: encoder forward, cache priming, and the whole decode scan
+    compile as ONE jitted program — no host round-trips per token.
+    """
+    if cfg.max_seq < max_new_tokens + 1:
+        raise ValueError(
+            f"cfg.max_seq={cfg.max_seq} < 1+max_new_tokens="
+            f"{max_new_tokens + 1}; raise max_seq"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _s2s_generate_impl(
+        params, encoder_ids.astype(jnp.int32), rng,
+        jnp.asarray(max(temperature, 0.0), jnp.float32),
+        cfg=cfg, max_new_tokens=int(max_new_tokens),
+        greedy=float(temperature) <= 0.0,
+        eos_token_id=-1 if eos_token_id is None else int(eos_token_id),
+    )
 
 
 def seq2seq_layout() -> LayoutMap:

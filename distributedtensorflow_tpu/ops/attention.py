@@ -48,6 +48,44 @@ def dot_product_attention(
     return xla_attention(q, k, v, mask=mask, causal=causal)
 
 
+def cached_decode_attention(
+    q: jax.Array,         # (B, s_new, H, D) new queries
+    k_new: jax.Array,     # (B, s_new, H, D) new keys
+    v_new: jax.Array,     # (B, s_new, H, D) new values
+    cached_k: jax.Array,  # (B, max_seq, H, D) cache
+    cached_v: jax.Array,  # (B, max_seq, H, D)
+    cache_index: jax.Array,  # () int32 — next write slot
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One KV-cache decode step, shared by every serving path.
+
+    Pure function (caller owns the cache state, e.g. a flax "cache"
+    collection): writes the new K/V at ``cache_index``, attends the new
+    queries against the whole static-shape cache with validity masking —
+    a query at absolute position ``ix+i`` sees keys at positions
+    ``<= ix+i``, which is also correct for multi-token chunked prefill —
+    and returns ``(out, cached_k, cached_v, cache_index)`` updated.
+    Scores run fp32 (matching :func:`xla_attention`'s softmax dtype).
+    """
+    b, s_new, h, d = q.shape
+    max_seq = cached_k.shape[1]
+    ix = cache_index
+    cached_k = jax.lax.dynamic_update_slice(cached_k, k_new, (0, ix, 0, 0))
+    cached_v = jax.lax.dynamic_update_slice(cached_v, v_new, (0, ix, 0, 0))
+    q_pos = ix + jnp.arange(s_new)
+    k_idx = jnp.arange(max_seq)
+    valid = k_idx[None, :] <= q_pos[:, None]  # (s_new, max_seq)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+        cached_k.astype(jnp.float32),
+    ) / (d ** 0.5)
+    scores = jnp.where(valid[None, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights, cached_v.astype(jnp.float32)
+    ).astype(q.dtype)
+    return out, cached_k, cached_v, ix + s_new
+
+
 def xla_attention(q, k, v, *, mask=None, causal=False):
     orig_dtype = q.dtype
     depth = q.shape[-1]
