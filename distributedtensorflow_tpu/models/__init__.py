@@ -36,6 +36,16 @@ from .vit import (  # noqa: F401
     vit_s16,
     vit_tiny,
 )
+from .seq2seq import (  # noqa: F401
+    Seq2SeqConfig,
+    Seq2SeqLM,
+    seq2seq_eval,
+    seq2seq_generate,
+    seq2seq_layout,
+    seq2seq_loss,
+    seq2seq_small,
+    seq2seq_tiny,
+)
 from .widedeep import (  # noqa: F401
     WideDeep,
     WideDeepConfig,

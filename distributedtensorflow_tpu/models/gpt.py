@@ -77,6 +77,32 @@ def gpt_tiny() -> GPTConfig:
     )
 
 
+def cached_attention_with_vars(module: nn.Module, q, k, v,
+                               max_seq: int) -> jax.Array:
+    """Flax "cache"-collection plumbing around
+    :func:`..ops.attention.cached_decode_attention` — the ONE place the
+    cache layout (cached_key/cached_value/cache_index) is defined, shared
+    by every serving path (GPT and seq2seq decoder self-attention)."""
+    from ..ops.attention import cached_decode_attention
+
+    b, _, h, d = q.shape
+    cached_k = module.variable(
+        "cache", "cached_key", lambda: jnp.zeros((b, max_seq, h, d), k.dtype)
+    )
+    cached_v = module.variable(
+        "cache", "cached_value", lambda: jnp.zeros((b, max_seq, h, d), v.dtype)
+    )
+    cache_ix = module.variable(
+        "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+    )
+    out, cached_k.value, cached_v.value, cache_ix.value = (
+        cached_decode_attention(
+            q, k, v, cached_k.value, cached_v.value, cache_ix.value
+        )
+    )
+    return out
+
+
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding, (B, S, H, D) with D even; fp32 trig, cast back."""
     d_half = x.shape[-1] // 2
@@ -129,31 +155,8 @@ class CausalSelfAttention(nn.Module):
         )(out)
 
     def _cached_attention(self, q, k, v):
-        """One decode step against the KV cache — flax variable plumbing
-        around the shared :func:`..ops.attention.cached_decode_attention`
-        (one implementation for every serving path; seq2seq uses the same
-        helper)."""
-        from ..ops.attention import cached_decode_attention
-
-        cfg = self.cfg
-        b, s_new, h, d = q.shape
-        cached_k = self.variable(
-            "cache", "cached_key",
-            lambda: jnp.zeros((b, cfg.max_seq, h, d), k.dtype),
-        )
-        cached_v = self.variable(
-            "cache", "cached_value",
-            lambda: jnp.zeros((b, cfg.max_seq, h, d), v.dtype),
-        )
-        cache_ix = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
-        )
-        out, cached_k.value, cached_v.value, cache_ix.value = (
-            cached_decode_attention(
-                q, k, v, cached_k.value, cached_v.value, cache_ix.value
-            )
-        )
-        return out
+        """One decode step against the KV cache (shared helper)."""
+        return cached_attention_with_vars(self, q, k, v, self.cfg.max_seq)
 
 
 class GPTBlock(nn.Module):

@@ -45,10 +45,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import cached_decode_attention, dot_product_attention
+from ..ops.attention import dot_product_attention
 from ..ops.xent import chunked_argmax, chunked_softmax_xent, tied_head_logits
 from ..parallel.sharding import LayoutMap
-from .gpt import rope
+from .gpt import cached_attention_with_vars, rope
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,28 +137,9 @@ class _Attention(nn.Module):
         return out
 
     def _cached_attention(self, q, k, v):
-        """Flax variable plumbing around the shared
-        :func:`..ops.attention.cached_decode_attention` (same helper as
-        ``models/gpt.py`` — the serving paths cannot diverge)."""
-        cfg = self.cfg
-        b, s_new, h, d = q.shape
-        cached_k = self.variable(
-            "cache", "cached_key",
-            lambda: jnp.zeros((b, cfg.max_seq, h, d), k.dtype),
-        )
-        cached_v = self.variable(
-            "cache", "cached_value",
-            lambda: jnp.zeros((b, cfg.max_seq, h, d), v.dtype),
-        )
-        cache_ix = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
-        )
-        out, cached_k.value, cached_v.value, cache_ix.value = (
-            cached_decode_attention(
-                q, k, v, cached_k.value, cached_v.value, cache_ix.value
-            )
-        )
-        return out
+        """One decode step against the KV cache (the same shared helper
+        as ``models/gpt.py`` — serving paths cannot diverge)."""
+        return cached_attention_with_vars(self, q, k, v, self.cfg.max_seq)
 
 
 class _MLP(nn.Module):

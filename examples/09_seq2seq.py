@@ -1,0 +1,56 @@
+"""Encoder-decoder seq2seq: train the copy task, then decode with the
+KV cache.
+
+The synthetic copy task (decoder must reproduce the encoder stream) is
+unlearnable without cross-attention, so a falling loss plus a correct
+greedy decode demonstrates the whole enc->dec->generate path.  Decoding
+compiles as ONE jitted program (encoder forward + cache priming + the
+decode scan).
+
+Run: python examples/09_seq2seq.py   (any platform; tiny model, ~1 min)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributedtensorflow_tpu.data import InputContext
+from distributedtensorflow_tpu.models import seq2seq_generate
+from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributedtensorflow_tpu.train import create_sharded_state, make_train_step
+from distributedtensorflow_tpu.workloads import get_workload
+
+
+def main():
+    mesh = build_mesh(MeshSpec(data=1), jax.devices()[:1])
+    wl = get_workload("t5_seq2seq", test_size=True, global_batch_size=32,
+                      seq_len=12)
+    state, specs = create_sharded_state(
+        wl.init_fn, optax.adamw(3e-3), mesh, jax.random.PRNGKey(0),
+        rules=wl.layout,
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    it = wl.input_fn(InputContext(1, 0, wl.global_batch_size), 0)
+    rng = jax.random.PRNGKey(1)
+    for i in range(200):
+        batch = {k: jnp.asarray(v) for k, v in next(it).items()}
+        state, metrics = step(state, batch, rng)
+        if (i + 1) % 50 == 0:
+            print(f"step {i + 1}: loss={float(metrics['loss']):.3f}")
+
+    cfg = wl.model.cfg
+    enc = jnp.asarray(
+        np.random.default_rng(7).integers(2, cfg.vocab_size, (2, 12)),
+        jnp.int32,
+    )
+    out = seq2seq_generate(
+        jax.device_get(state.params), enc, cfg=cfg, max_new_tokens=12
+    )
+    match = float((np.asarray(out) == np.asarray(enc)).mean())
+    print(f"greedy copy fidelity after 200 steps: {match:.0%}")
+    print("seq2seq example: ok")
+
+
+if __name__ == "__main__":
+    main()
