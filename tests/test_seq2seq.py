@@ -156,6 +156,21 @@ def test_greedy_generate_matches_stepwise_full_forward():
 
     got = seq2seq_generate(params, enc, cfg=cfg, max_new_tokens=n_new)
 
+    # The priming apply must bank the projected encoder K/V in the cache
+    # (steps reuse them; the key/value kernels run exactly once).
+    dmodel = type(model)(cfg, decode_cache=True)
+    enc_out, enc_pad, enc_pos = model.apply(
+        {"params": params}, enc, method=model.encode
+    )
+    _, vars0 = dmodel.apply(
+        {"params": params}, dec0, enc_out, enc_pad, enc_pos,
+        positions=jnp.zeros((2, 1), jnp.int32),
+        method=dmodel.decode, mutable=["cache"],
+    )
+    cross = [k for k, _ in jax.tree_util.tree_leaves_with_path(
+        vars0["cache"]) if "cross_key" in jax.tree_util.keystr(k)]
+    assert len(cross) == cfg.dec_layers
+
     # Reference: grow the decoder input and rerun the FULL forward.
     dec = dec0
     want = []
