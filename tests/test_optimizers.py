@@ -61,7 +61,8 @@ def test_schedules():
         build_schedule("exp", lr, total_steps=10)
 
 
-@pytest.mark.parametrize("name", ["gpt_lm", "gpt_moe", "bert_mlm"])
+@pytest.mark.parametrize("name", ["gpt_lm", "gpt_moe", "bert_mlm",
+                                  "t5_seq2seq"])
 def test_lm_presets_have_eval_fns(name, dp_mesh):
     """Every LM preset evaluates: finite loss, keys as documented."""
     from distributedtensorflow_tpu.data import InputContext, device_put_batch
@@ -84,7 +85,7 @@ def test_lm_presets_have_eval_fns(name, dp_mesh):
     )
     metrics = eval_step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
-    if name.startswith("gpt"):
+    if name.startswith("gpt") or name == "t5_seq2seq":
         assert "perplexity" in metrics
     else:
         assert "mlm_accuracy" in metrics

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from distributedtensorflow_tpu.checkpoint import CheckpointManager
 from distributedtensorflow_tpu.models import LeNet5
@@ -192,6 +193,50 @@ def test_preemption_stops_fit_with_consistent_save(tmp_path, dp_mesh):
     trainer2 = Trainer(train_step, cfg, checkpointer=mgr)
     out2 = trainer2.fit(state2, _batches(10 - fired_at), jax.random.PRNGKey(1))
     assert int(out2.step) == 10
+
+
+def test_prebundled_short_tail_is_trained(tmp_path, dp_mesh):
+    """A prebundled trailing bundle SHORTER than steps_per_call is
+    trained as a shrunk dispatch (advisor r3: the old path raised
+    StopIteration and silently discarded those batches).  The genuine
+    stream end still surfaces as StopIteration on the NEXT fetch — but
+    only after the tail's steps landed, which the metrics log proves."""
+    import json
+
+    from distributedtensorflow_tpu.models import LeNet5
+    from distributedtensorflow_tpu.train import make_multi_train_step
+
+    model = LeNet5()
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(0.05), dp_mesh, jax.random.PRNGKey(0)
+    )
+    multi = make_multi_train_step(
+        classification_loss(model), dp_mesh, specs, steps_per_call=3,
+        donate=False,
+    )
+    cfg = TrainerConfig(
+        total_steps=6, steps_per_call=3, input_prebundled=True,
+        log_every=1, global_batch_size=16, logdir=str(tmp_path / "logs"),
+    )
+
+    def bundles():
+        batches = list(_batches(5))
+        stack = lambda bs: jax.tree.map(lambda *xs: np.stack(xs), *bs)
+        yield stack(batches[:3])   # full bundle: steps 1-3
+        yield stack(batches[3:5])  # SHORT tail (2 < 3): steps 4-5
+
+    trainer = Trainer(multi, cfg)
+    with pytest.raises(StopIteration):  # stream genuinely ends before 6
+        trainer.fit(state, bundles(), jax.random.PRNGKey(1))
+    steps_logged = [
+        json.loads(line)["step"]
+        for line in (tmp_path / "logs" / "metrics.jsonl").read_text()
+        .splitlines()
+    ]
+    # Step 5 in the log == the 2-batch tail TRAINED before the stream end
+    # (the discarded-tail behavior would stop the log at step 3).
+    assert steps_logged == [3, 5]
 
 
 def test_steps_per_call_bundles_dispatches(tmp_path, dp_mesh):
