@@ -97,12 +97,14 @@ def _best_recent_persisted_tpu() -> dict | None:
     return max(recent, key=lambda r: r.get("value", 0))
 
 
-def _tunnel_outage_evidence() -> dict | None:
+def _tunnel_outage_evidence(path: str | None = None) -> dict | None:
     """Summarize the watcher log so a cached re-emission carries PROOF of
     the outage: when the tunnel was last up and how many probe cycles have
     failed since.  A cached headline without this is indistinguishable
-    from a bench that simply never tried (VERDICT r3 weak #1)."""
-    path = os.path.join(RESULTS_DIR, "tpu_watch.log")
+    from a bench that simply never tried (VERDICT r3 weak #1).
+    ``path`` overrides the default watcher log (tests)."""
+    if path is None:
+        path = os.path.join(RESULTS_DIR, "tpu_watch.log")
     try:
         with open(path, errors="replace") as f:
             lines = f.readlines()[-5000:]
@@ -125,7 +127,7 @@ def _tunnel_outage_evidence() -> dict | None:
         "last_tunnel_up": last_up,
         "down_since": down_since,
         "failed_probe_cycles_since": down_count,
-        "source": "BENCH_RESULTS/tpu_watch.log",
+        "source": os.path.relpath(path, REPO),
     }
 
 

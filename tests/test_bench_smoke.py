@@ -16,6 +16,28 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+def test_tunnel_outage_evidence_parses_watcher_log(tmp_path):
+    """The outage summary attached to cached bench emissions must track
+    UP/down transitions from watcher lines only (the probe's own stderr
+    also says "tunnel down" and must not be counted)."""
+    import bench
+
+    log = tmp_path / "watch.log"
+    log.write_text(
+        "watch: jax device probe unresponsive after 120s (TPU tunnel down?)\n"
+        "2026-07-31T01:00:00+00:00 watcher: tunnel down\n"
+        "2026-07-31T02:00:00+00:00 watcher: tunnel UP, running queue\n"
+        "watch: jax device probe unresponsive after 120s (TPU tunnel down?)\n"
+        "2026-07-31T03:00:00+00:00 watcher: tunnel down\n"
+        "2026-07-31T04:00:00+00:00 watcher: tunnel down\n"
+    )
+    e = bench._tunnel_outage_evidence(str(log))
+    assert e["last_tunnel_up"] == "2026-07-31T02:00:00+00:00"
+    assert e["down_since"] == "2026-07-31T03:00:00+00:00"
+    assert e["failed_probe_cycles_since"] == 2
+    assert bench._tunnel_outage_evidence(str(tmp_path / "missing.log")) is None
+
+
 MATRIX = [
     ("bench_lm.py", {"BENCH_LM_TEST": "1"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_INNER": "4"}),
