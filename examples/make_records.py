@@ -55,6 +55,20 @@ def synthetic_lm_examples(n, *, vocab_size, seq_len, seed):
         yield {"input_ids": ids.astype(np.int32)}
 
 
+def synthetic_seq2seq_examples(n, *, vocab_size, seq_len, seed):
+    """Per-example {encoder_ids, targets} copy-task records (mirrors
+    workloads.synthetic_seq2seq, unbatched): targets are the encoder
+    stream with a pad tail, so records-trained seq2seq loss falls only
+    through working cross-attention.  pad_id=1, ids in [2, vocab)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        ids = rng.integers(2, vocab_size, size=seq_len)
+        length = int(rng.integers(seq_len // 2, seq_len + 1))
+        ids[length:] = 1
+        ids = ids.astype(np.int32)
+        yield {"encoder_ids": ids, "targets": ids.copy()}
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__, allow_abbrev=False)
     p.add_argument("--out", required=True, help="output directory")
@@ -64,11 +78,13 @@ def main():
                    help="train record files (eval always writes 2)")
     p.add_argument("--image-shape", default="28,28,1")
     p.add_argument("--classes", type=int, default=10)
-    p.add_argument("--kind", choices=("image", "lm"), default="image")
+    p.add_argument("--kind", choices=("image", "lm", "seq2seq"),
+                   default="image")
     p.add_argument("--seq-len", type=int, default=64,
-                   help="--kind lm: tokens per example")
+                   help="--kind lm/seq2seq: tokens per example")
     p.add_argument("--vocab", type=int, default=512,
-                   help="--kind lm: vocabulary size (gpt_tiny uses 512)")
+                   help="--kind lm/seq2seq: vocabulary size (gpt_tiny and "
+                        "seq2seq_tiny use 512)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -76,6 +92,13 @@ def main():
 
     if args.kind == "lm":
         gen = lambda n, seed: synthetic_lm_examples(
+            n, vocab_size=args.vocab, seq_len=args.seq_len, seed=seed
+        )
+    elif args.kind == "seq2seq":
+        if args.vocab < 3:
+            p.error("--kind seq2seq needs --vocab >= 3 "
+                    "(ids 0/1 are reserved for bos/pad)")
+        gen = lambda n, seed: synthetic_seq2seq_examples(
             n, vocab_size=args.vocab, seq_len=args.seq_len, seed=seed
         )
     else:

@@ -235,3 +235,54 @@ def test_lm_trains_from_record_files(tmp_path, devices):
         state, metrics = step(state, next(it), rng)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] - 0.3, losses[::6]
+
+
+def test_seq2seq_trains_from_record_files(tmp_path, devices):
+    """seq2seq records (examples/make_records.py --kind seq2seq):
+    {encoder_ids, targets} copy-task records feed t5_seq2seq through the
+    same --data-dir path, and the loss falls — the record layer is
+    schema-generic, so the new family costs zero reader changes."""
+    import jax
+
+    from distributedtensorflow_tpu.data import (
+        repeated_record_dataset,
+        write_record_shards,
+    )
+    from distributedtensorflow_tpu.data.input_pipeline import InputContext
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.train import (
+        create_sharded_state,
+        make_train_step,
+    )
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    rng_np = np.random.default_rng(0)
+
+    def examples():
+        for _ in range(256):
+            ids = rng_np.integers(2, 512, size=12)
+            ids[int(rng_np.integers(6, 13)):] = 1  # pad tail
+            ids = ids.astype(np.int32)
+            yield {"encoder_ids": ids, "targets": ids.copy()}
+
+    files = write_record_shards(
+        examples(), str(tmp_path / "s2s-{:03d}.rio"), num_shards=2
+    )
+    mesh = build_mesh(MeshSpec(data=2), devices[:2])
+    wl = get_workload("t5_seq2seq", test_size=True, global_batch_size=16,
+                      seq_len=12)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, jax.random.PRNGKey(0),
+        rules=wl.layout,
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    ctx = InputContext(1, 0, 16)
+    it = repeated_record_dataset(files, ctx,
+                                 batch_size=ctx.per_host_batch_size,
+                                 shuffle_buffer=64, seed=0)
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(30):
+        state, metrics = step(state, next(it), rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.2, losses[::8]
