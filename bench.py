@@ -219,6 +219,7 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         inner * RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
         * (image_size / 224.0) ** 2 / n_chips,
         "analytic_12.3GF_per_image",
+        xla_flops_scale=inner,
     )
 
     return {

@@ -108,6 +108,7 @@ def main() -> None:
     mfu = mfu_fields(
         compiled, dt, n_steps, device_kind, inner * fallback,
         "analytic_6N_enc_at_S_head_at_P",
+        xla_flops_scale=inner,
     )
 
     # Anchor: an A100 pretrains BERT-base (seq 512) at roughly 200

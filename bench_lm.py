@@ -138,6 +138,7 @@ def main() -> None:
         compiled, dt, n_steps, device_kind,
         inner * per_token * wl.global_batch_size * seq / n_chips,
         "analytic_model_flops_6N_plus_12LHS_palm_mfu",
+        xla_flops_scale=inner,
     )
 
     # Anchor: an A100 trains GPT-2-small (~124M params) at roughly 150k

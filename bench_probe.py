@@ -225,7 +225,7 @@ def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
 
 def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,
                analytic_flops_per_step: float,
-               analytic_source: str) -> dict:
+               analytic_source: str, xla_flops_scale: float = 1.0) -> dict:
     """Both MFU accountings for a bench result, as emit-ready fields.
 
     ``mfu_analytic`` divides ANALYTIC per-chip model FLOPs (6·N·D-style,
@@ -236,7 +236,14 @@ def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,
     when the implementation changes (e.g. the vocab-chunked CE head raised
     throughput while lowering executed FLOPs, which made the old
     single-``mfu`` field read as a regression).  Emitting both makes that
-    inversion impossible to misread."""
+    inversion impossible to misread.
+
+    ``xla_flops_scale``: XLA's cost analysis counts a ``lax.scan`` body
+    ONCE regardless of trip count, so a k-steps-per-dispatch executable
+    (engine.make_multi_train_step) under-reports executed FLOPs by ~k —
+    measured 2026-08-01: the spc=20 LM row printed mfu_xla_cost 0.0142
+    vs 0.2806 for the identical spc=1 program.  Callers bundling k steps
+    per call pass ``xla_flops_scale=k``."""
     from bench import _peak_flops
 
     peak = _peak_flops(device_kind)
@@ -244,7 +251,7 @@ def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,
     try:
         cost = compiled.cost_analysis()
         if cost and cost.get("flops"):
-            xla_mfu = (float(cost["flops"]) * n_steps / dt) / peak
+            xla_mfu = (float(cost["flops"]) * xla_flops_scale * n_steps / dt) / peak
     except Exception as e:  # cost analysis is best-effort on the tunnel
         print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
     analytic_mfu = (analytic_flops_per_step * n_steps / dt) / peak

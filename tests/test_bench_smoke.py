@@ -16,6 +16,30 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+def test_mfu_xla_cost_scales_with_steps_per_call():
+    """XLA cost analysis counts a lax.scan body once, so a k-steps-per-
+    dispatch executable under-reports executed FLOPs by ~k (measured
+    2026-08-01: spc=20 LM row printed 0.0142 vs 0.2806 for the identical
+    spc=1 program).  mfu_fields must honour xla_flops_scale=k."""
+    from bench_probe import mfu_fields
+
+    class FakeCompiled:
+        def cost_analysis(self):
+            return {"flops": 1e12}
+
+    base = mfu_fields(FakeCompiled(), dt=1.0, n_steps=10,
+                      device_kind="TPU v5 lite",
+                      analytic_flops_per_step=2e12,
+                      analytic_source="test")
+    scaled = mfu_fields(FakeCompiled(), dt=1.0, n_steps=10,
+                        device_kind="TPU v5 lite",
+                        analytic_flops_per_step=2e12,
+                        analytic_source="test", xla_flops_scale=20.0)
+    assert scaled["mfu_xla_cost"] == pytest.approx(
+        20.0 * base["mfu_xla_cost"], rel=1e-2)  # fields round to 4 places
+    assert scaled["mfu_analytic"] == base["mfu_analytic"]
+
+
 def test_tunnel_outage_evidence_parses_watcher_log(tmp_path):
     """The outage summary attached to cached bench emissions must track
     UP/down transitions from watcher lines only (the probe's own stderr
