@@ -9,7 +9,8 @@ the single largest non-matmul cost of the step — see docs/LM_PERF.md).
 
 This module fuses the head end-to-end in Pallas so logits live only in
 VMEM, tile by tile, and HBM sees just ``x``, ``wte``, and the O(N)
-outputs (~3 GB/step for the same shapes):
+outputs (~4.2 GB/step for the same shapes at the on-chip-validated tile
+sizes — 4.1x less than chunked; see ``estimate_hbm_bytes``):
 
 - **forward** — grid (vocab-blocks OUTER, token-blocks inner): the weight
   tile is fetched once per vocab block and stays in VMEM for the whole
@@ -63,14 +64,26 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
-#: Default tile sizes.  block_v x block_n fp32 logits is the dominant VMEM
-#: tenant (2048 x 512 x 4 B = 4 MB); weight tiles ride at bf16.
+#: Default tile sizes.  The binding constraint is Mosaic's 16 MB scoped-
+#: VMEM stack: the (block_v, block_n) fp32 logits tile plus its
+#: elementwise temporaries (iota/mask/exp) dominate, alongside the
+#: double-buffered operand blocks.  Measured on the v5e 2026-08-01:
+#: block_v=2048 x block_n=512 compiled to a 16.71 MB stack — 724 KB OVER
+#: the limit; 1024 x 512 fits with ~2x headroom.  The trade is NOT free:
+#: the w table streams once per token chunk regardless of block_v, but x
+#: restreams once PER VOCAB BLOCK (vocab-outer sweep), so halving block_v
+#: doubles the fwd/dw x-restream — estimate_hbm_bytes puts the move at
+#: 2.92 -> 4.18 GB/step at the headline config, ~1.5 ms @ 819 GB/s,
+#: against a kernel that otherwise does not compile at all.
 BLOCK_TOKENS = _env_int("DTFT_XENT_BLOCK_TOKENS", 512)
-BLOCK_VOCAB = _env_int("DTFT_XENT_BLOCK_VOCAB", 2048)
+BLOCK_VOCAB = _env_int("DTFT_XENT_BLOCK_VOCAB", 1024)
 #: dx backward uses a bigger token tile: its dominant HBM cost is the full
 #: weight-table re-read per token block, so fewer/bigger token sweeps win.
+#: Its vocab tile is the smallest: the dx kernel carries the most live
+#: fp32 temporaries (p, dlog, the fp32-cast weight tile, the fp32 dx
+#: accumulator), so it hits the same 16 MB stack wall soonest.
 BLOCK_TOKENS_DX = _env_int("DTFT_XENT_BLOCK_TOKENS_DX", 1024)
-BLOCK_VOCAB_DX = _env_int("DTFT_XENT_BLOCK_VOCAB_DX", 1024)
+BLOCK_VOCAB_DX = _env_int("DTFT_XENT_BLOCK_VOCAB_DX", 512)
 
 
 def _transposed_logits(w_ref, x_ref):
@@ -241,6 +254,11 @@ def _fused_fwd_arrays(x, w, t, *, block_n, block_v, v_true, interpret):
     def one_call(xc, tc):
         n_c = xc.shape[0]
         n_i = n_c // block_n
+        # Row operands/outputs are laid out (1, N) with block (1, block_n):
+        # a (1, block_n) block over an (n_i, block_n) array would put a
+        # sublane block of 1 over an array dim > 1, which the real Mosaic
+        # lowering rejects ("block shape ... divisible by 8 and 128") even
+        # though interpret mode accepts it — found on-chip 2026-08-01.
         lse, tgt = pl.pallas_call(
             functools.partial(_fwd_kernel, block_v=block_v, v_true=v_true),
             grid=(n_j, n_i),
@@ -249,22 +267,22 @@ def _fused_fwd_arrays(x, w, t, *, block_n, block_v, v_true, interpret):
                              memory_space=mem),
                 pl.BlockSpec((block_v, d), lambda j, i: (j, 0),
                              memory_space=mem),
-                pl.BlockSpec((1, block_n), lambda j, i: (i, 0),
+                pl.BlockSpec((1, block_n), lambda j, i: (0, i),
                              memory_space=mem),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_n), lambda j, i: (i, 0),
+                pl.BlockSpec((1, block_n), lambda j, i: (0, i),
                              memory_space=mem),
-                pl.BlockSpec((1, block_n), lambda j, i: (i, 0),
+                pl.BlockSpec((1, block_n), lambda j, i: (0, i),
                              memory_space=mem),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
-                jax.ShapeDtypeStruct((n_i, block_n), jnp.float32),
+                jax.ShapeDtypeStruct((1, n_c), jnp.float32),
+                jax.ShapeDtypeStruct((1, n_c), jnp.float32),
             ],
             scratch_shapes=[pltpu.VMEM((n_i, _SUB, block_n), jnp.float32)] * 3,
             interpret=interpret,
-        )(xc, w, tc.reshape(n_i, block_n))
+        )(xc, w, tc.reshape(1, n_c))
         return lse.reshape(n_c), tgt.reshape(n_c)
 
     chunk_tokens = _max_fwd_token_blocks(block_n) * block_n
@@ -294,21 +312,22 @@ def _fused_bwd_arrays(x, w, t, lse, c, *, block_n_dx, block_v_dx,
             pl.BlockSpec((1, block_n), idx_row, memory_space=mem),
         ]
 
+    # Row operands ride as (1, N) for the same Mosaic sublane-tiling
+    # reason as the forward (see one_call above).
     n_i, n_j = n // block_n_dx, vp // block_v_dx
     dx = pl.pallas_call(
         functools.partial(_bwd_dx_kernel, block_v=block_v_dx, v_true=v_true),
         grid=(n_i, n_j),
         in_specs=common_specs(
             block_n_dx, block_v_dx,
-            lambda i, j: (i, 0), lambda i, j: (j, 0), lambda i, j: (i, 0),
+            lambda i, j: (i, 0), lambda i, j: (j, 0), lambda i, j: (0, i),
         ),
         out_specs=pl.BlockSpec((block_n_dx, d), lambda i, j: (i, 0),
                                memory_space=mem),
         out_shape=jax.ShapeDtypeStruct((n, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_n_dx, d), jnp.float32)],
         interpret=interpret,
-    )(x, w, t.reshape(n_i, block_n_dx), lse.reshape(n_i, block_n_dx),
-      c.reshape(n_i, block_n_dx))
+    )(x, w, t.reshape(1, n), lse.reshape(1, n), c.reshape(1, n))
 
     n_i, n_j = n // block_n_dw, vp // block_v_dw
     dw = pl.pallas_call(
@@ -316,14 +335,13 @@ def _fused_bwd_arrays(x, w, t, lse, c, *, block_n_dx, block_v_dx,
         grid=(n_j, n_i),
         in_specs=common_specs(
             block_n_dw, block_v_dw,
-            lambda j, i: (i, 0), lambda j, i: (j, 0), lambda j, i: (i, 0),
+            lambda j, i: (i, 0), lambda j, i: (j, 0), lambda j, i: (0, i),
         ),
         out_specs=pl.BlockSpec((block_v_dw, d), lambda j, i: (j, 0),
                                memory_space=mem),
         out_shape=jax.ShapeDtypeStruct((vp, d), jnp.float32),
         interpret=interpret,
-    )(x, w, t.reshape(n_i, block_n_dw), lse.reshape(n_i, block_n_dw),
-      c.reshape(n_i, block_n_dw))
+    )(x, w, t.reshape(1, n), lse.reshape(1, n), c.reshape(1, n))
     return dx, dw
 
 
@@ -392,7 +410,7 @@ def _walk_fetches(grid, index_map) -> int:
     traffic); an index change is one block fetch.  Counting index changes
     over the kernel's actual grid order therefore gives the kernel's HBM
     read traffic in blocks — the same model the module docstring's
-    "~3 GB/step" claim rests on, now computed instead of asserted.
+    "~4.2 GB/step" claim rests on, now computed instead of asserted.
     """
     import itertools
 
@@ -458,8 +476,8 @@ def estimate_hbm_bytes(
         grid = (n_j, n_i)
         x_f = _walk_fetches(grid, lambda j, i: (i, 0))
         w_f = _walk_fetches(grid, lambda j, i: (j, 0))
-        t_f = _walk_fetches(grid, lambda j, i: (i, 0))
-        o_f = _walk_fetches(grid, lambda j, i: (i, 0))  # lse and tgt
+        t_f = _walk_fetches(grid, lambda j, i: (0, i))
+        o_f = _walk_fetches(grid, lambda j, i: (0, i))  # lse and tgt
         fwd += (
             x_f * block_tokens * d * compute_bytes
             + w_f * block_vocab * d * compute_bytes
@@ -476,7 +494,7 @@ def estimate_hbm_bytes(
         * compute_bytes
         + _walk_fetches(grid, lambda i, j: (j, 0)) * block_vocab_dx * d
         * compute_bytes
-        + 3 * _walk_fetches(grid, lambda i, j: (i, 0)) * block_tokens_dx
+        + 3 * _walk_fetches(grid, lambda i, j: (0, i)) * block_tokens_dx
         * row_b                                        # t, lse, c rows
         + _walk_fetches(grid, lambda i, j: (i, 0)) * block_tokens_dx * d * 4
     )                                                  # dx out, fp32
@@ -489,7 +507,7 @@ def estimate_hbm_bytes(
         * compute_bytes
         + _walk_fetches(grid, lambda j, i: (j, 0)) * block_vocab * d
         * compute_bytes
-        + 3 * _walk_fetches(grid, lambda j, i: (i, 0)) * block_tokens * row_b
+        + 3 * _walk_fetches(grid, lambda j, i: (0, i)) * block_tokens * row_b
         + _walk_fetches(grid, lambda j, i: (j, 0)) * block_vocab * d * 4
     )                                                  # dw out, fp32
 

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Follow-up evidence rows, run AFTER tpu_watch.sh's queue drains (kept in
+# a separate file so the running watcher's bash never re-reads a changed
+# script mid-execution).  Same stamp/cache discipline as the watcher.
+#
+# Motivation (2026-08-01 window, first rows):
+#   - steps_per_call=20 moved nothing (76.4k vs 76.5k) -> the step is
+#     chip-bound; dispatch/tunnel RTT is NOT a suspect.
+#   - chunked_bf16 head: +2.5k tok/s (209.2ms vs 214.2ms).
+#   - the remaining levers are the Pallas rows; these extras complete the
+#     A/B matrix at the HEADLINE config (bs16) and add the missing
+#     flash-4k ladder row (the watcher's 4k row forces ATTN=xla).
+set -u
+cd "$(dirname "$0")"
+LOG=BENCH_RESULTS/tpu_watch.log
+STAMPS=BENCH_RESULTS/.landed
+mkdir -p "$STAMPS"
+if [ "${BENCH_NO_COMPILE_CACHE:-0}" != "1" ]; then
+  export JAX_COMPILATION_CACHE_DIR="$PWD/BENCH_RESULTS/.jax_cache"
+  export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+  export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
+  export JAX_COMPILATION_CACHE_MAX_SIZE=$((2 * 1024 * 1024 * 1024))
+  mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+fi
+log() { echo "$(date -Is) extra: $*" >> "$LOG"; }
+run() {
+  local stamp="$1" to="$2"; shift 2
+  [ -f "$STAMPS/$stamp" ] && return 0
+  log "item $stamp: start"
+  if timeout "$to" env BENCH_SKIP_PROBE=1 "$@" >> "$LOG" 2>&1; then
+    touch "$STAMPS/$stamp"; log "item $stamp: LANDED"; return 0
+  fi
+  log "item $stamp: failed/timeout"; return 1
+}
+
+# Flash attention at the headline config: bs16 seq1024, remat off.
+run lm_bs16_pl    900 env BENCH_LM_BATCH=16 BENCH_LM_ATTN=pallas python bench_lm.py
+# The full stack at the headline config: flash attn + fused CE head.
+run lm_bs16_plfx  900 env BENCH_LM_BATCH=16 BENCH_LM_ATTN=pallas BENCH_LM_XENT=fused python bench_lm.py
+# Flash + bf16 chunked head (the non-Pallas-head winner so far).
+run lm_bs16_plcb16 900 env BENCH_LM_BATCH=16 BENCH_LM_ATTN=pallas BENCH_LM_XENT=chunked_bf16 python bench_lm.py
+# Long-context ladder with flash: 4k (auto picks the Pallas kernel at 4k).
+run lm_s4096_pl   900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn python bench_lm.py
+log "extras pass done"
