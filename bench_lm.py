@@ -108,7 +108,7 @@ def main() -> None:
             "global_batch": wl.global_batch_size,
             "remat": remat,
             "attn_impl": attn_impl or "auto",
-            "xent_impl": xent_impl or "chunked",
+            "xent_impl": xent_impl or "auto",
             "steps_per_call": inner,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }

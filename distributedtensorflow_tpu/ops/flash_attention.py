@@ -32,11 +32,39 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e9
 
-DEFAULT_BLOCK_Q = 128
+#: Block tiling.  Retuned on the v5e 2026-08-01 (tools/sweep_flash_blocks.py,
+#: artifacts BENCH_RESULTS/flashsweep_20260801_*.json): 1024x1024 q/k blocks
+#: win at EVERY swept length — fwd+bwd vs the old 128x512 default:
+#: 9.11 vs 12.57 ms at seq 1024 (B16 H12 D64), 17.1 vs 28.3 ms at 4k,
+#: 25.8 vs 49.0 ms at 8k.  The kernel is VPU/softmax-bound, not matmul-
+#: bound, so fewer+bigger grid steps amortize per-step scalar/DMA overhead;
+#: (1024, 1024) fp32 score tiles (+temps) still fit Mosaic's 16 MB stack
+#: (1024x2048 does not — compile-checked on chip).
+DEFAULT_BLOCK_Q = 1024
+
+
+def _env_block(name: str) -> int | None:
+    """On-chip sweep override for a block size (read per call so one
+    process can A/B several tilings; see tools/sweep_flash_blocks.py)."""
+    import os
+
+    v = os.environ.get(name)
+    if not v:
+        return None
+    try:
+        n = int(v)
+    except ValueError as e:
+        raise ValueError(f"{name}={v!r}: expected a positive integer") from e
+    if n <= 0:
+        raise ValueError(f"{name}={v!r}: expected a positive integer")
+    return n
 
 
 def _pick_block_q(seq_len: int) -> int | None:
-    for b in (DEFAULT_BLOCK_Q, 64, 32, 16, 8):
+    o = _env_block("DTFT_FLASH_BLOCK_Q")
+    if o and seq_len % o == 0:
+        return o
+    for b in (DEFAULT_BLOCK_Q, 512, 256, 128, 64, 32, 16, 8):
         if seq_len % b == 0:
             return b
     return None
@@ -49,14 +77,17 @@ def _on_tpu() -> bool:
         return False
 
 
-#: Auto-dispatch threshold.  Measured on the real TPU v5e chip by
-#: ``bench_attn.py`` (artifact: BENCH_RESULTS/attn_20260729_204857.json,
-#: B=4 H=8 D=64 bf16): at 1k-2k XLA's fused dense attention is on par
-#: (fwd 1.00-1.06x, bwd 1.10-1.31x in the kernel's favor); at 4k the Pallas
-#: kernel wins 2.09x fwd / 2.03x bwd; at 8k the dense path cannot even
-#: compile (XLA OOM: 2 x 8 GB (B,H,S,S) score temporaries vs 15.75 GB HBM)
-#: while the flash forward runs in 26 ms.
-MIN_SEQ_FOR_PALLAS = 4096
+#: Auto-dispatch threshold.  Re-measured on the real v5e 2026-08-01 after
+#: the 1024x1024 block retune (tools/sweep_flash_blocks.py, artifact
+#: flashsweep_20260801_023237.json, B=16 H=12 D=64 bf16 causal — the GPT
+#: headline shapes): at seq 1024 the kernel now beats XLA's fused dense
+#: attention 1.22x fwd / 1.60x fwd+full-bwd (6.46/9.11 ms vs 7.94/14.61),
+#: where the OLD 128x512 tiling only managed 1.16x fwd+bwd — which is why
+#: this threshold used to sit at 4096.  At 4k the win is 3.3x, at 8k the
+#: dense path OOMs (attn_20260801_014350.json).  Below 1024 the dense
+#: path keeps the job: score tensors are small enough that XLA's fusion
+#: is competitive and the kernel's fixed overhead dominates.
+MIN_SEQ_FOR_PALLAS = 1024
 
 
 def supported(q, k, v, *, mask=None, segment_ids=None) -> bool:
@@ -100,11 +131,14 @@ def _is_segment_ids(segment_ids, qshape) -> bool:
 # --- Forward kernel ---------------------------------------------------------
 
 
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_K = 1024  # see the DEFAULT_BLOCK_Q sweep note
 
 
 def _pick_block_k(seq_len: int) -> int | None:
-    for b in (DEFAULT_BLOCK_K, 256, 128, 64, 32, 16, 8):
+    o = _env_block("DTFT_FLASH_BLOCK_K")
+    if o and seq_len % o == 0:
+        return o
+    for b in (DEFAULT_BLOCK_K, 512, 256, 128, 64, 32, 16, 8):
         if seq_len % b == 0:
             return b
     return None

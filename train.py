@@ -608,10 +608,12 @@ def main() -> None:
                    help="LM presets: attention kernel (auto = Pallas flash"
                         " on TPU past the evidenced seq threshold)")
     p.add_argument("--xent-impl",
-                   choices=("chunked", "chunked_bf16", "fused"), default=None,
-                   help="LM presets: head-loss kernel (chunked = lax.scan"
-                        " over token chunks; fused = Pallas fused_xent,"
-                        " logits never leave VMEM)")
+                   choices=("auto", "chunked", "chunked_bf16", "fused"),
+                   default=None,
+                   help="LM presets: head-loss kernel (auto = Pallas"
+                        " fused_xent on TPU / chunked elsewhere; chunked ="
+                        " lax.scan over token chunks; fused = fused_xent"
+                        " unconditionally, logits never leave VMEM)")
     args = p.parse_args()
     if args.config:
         import sys
