@@ -62,8 +62,13 @@ def _env_block(name: str) -> int | None:
 
 def _pick_block_q(seq_len: int) -> int | None:
     o = _env_block("DTFT_FLASH_BLOCK_Q")
-    if o and seq_len % o == 0:
-        return o
+    if o:
+        if seq_len % o == 0:
+            return o
+        import sys
+
+        print(f"flash_attention: DTFT_FLASH_BLOCK_Q={o} does not divide "
+              f"seq {seq_len}; using the default chain", file=sys.stderr)
     for b in (DEFAULT_BLOCK_Q, 512, 256, 128, 64, 32, 16, 8):
         if seq_len % b == 0:
             return b
@@ -136,8 +141,13 @@ DEFAULT_BLOCK_K = 1024  # see the DEFAULT_BLOCK_Q sweep note
 
 def _pick_block_k(seq_len: int) -> int | None:
     o = _env_block("DTFT_FLASH_BLOCK_K")
-    if o and seq_len % o == 0:
-        return o
+    if o:
+        if seq_len % o == 0:
+            return o
+        import sys
+
+        print(f"flash_attention: DTFT_FLASH_BLOCK_K={o} does not divide "
+              f"seq {seq_len}; using the default chain", file=sys.stderr)
     for b in (DEFAULT_BLOCK_K, 512, 256, 128, 64, 32, 16, 8):
         if seq_len % b == 0:
             return b
@@ -590,7 +600,15 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
 def _flash_backward_xla(res, g, *, causal):
     q, k, v, mask, segment_ids, o, lse = res
     batch, seq, heads, depth = q.shape
-    block_q = _pick_block_q(seq)
+    # Fixed 128-row blocks, deliberately NOT _pick_block_q: this path's
+    # per-scan-step (B, H, block_q, S) fp32 score/p/ds temporaries scale
+    # with block_q, and the 1024-block Pallas retune (or a sweep env
+    # override) would inflate them 8x — at 32k seq that is ~1.6 GB per
+    # live temporary, an HBM OOM on exactly the long sequences this
+    # recompute fallback exists to fit.
+    block_q = next(
+        (b for b in (128, 64, 32, 16, 8) if seq % b == 0), None
+    )
     scale = 1.0 / (depth ** 0.5)
     n_blocks = seq // block_q
 

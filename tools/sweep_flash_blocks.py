@@ -21,7 +21,6 @@ block_until_ready a no-op, so every measurement chains the op k times
 end; dispatch RTT amortizes over the chain.
 """
 
-import json
 import os
 import sys
 import time
@@ -118,11 +117,10 @@ def main():
         "rows": rows,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
-    path = f"BENCH_RESULTS/flashsweep_{time.strftime('%Y%m%d_%H%M%S')}.json"
     if os.environ.get("SWEEP_PERSIST", "1") == "1":
-        with open(path, "w") as f:
-            json.dump(out, f, indent=2)
-        print(f"persisted {path}", flush=True)
+        from bench_probe import persist_result
+
+        print(f"persisted {persist_result('flashsweep', out)}", flush=True)
 
 
 if __name__ == "__main__":

@@ -87,34 +87,13 @@ while true; do
   log "tunnel UP, running queue ($(cache_stat))"
 
   while true; do   # single-pass queue; break on tunnel death
-    # Queue order = VERDICT r3 "what's missing" rank: cheap LM throughput
-    # rows first (missing #1), long-context XLA rows (missing #2), the
-    # convergence artifact (missing #3), headline refresh (next #9),
-    # profiles (the instruments), and Pallas rows canary-gated LAST.
-    # -- p1: non-Pallas LM throughput (missing #1, cheapest evidence) ----
-    run lm_bs16       600 env BENCH_LM_BATCH=16 python bench_lm.py \
-      || { probe || break; }
-    # bf16 logits tiles in the chunked head: the non-Pallas half of the
-    # head-HBM attack (xent_impl=chunked_bf16) — runs even when the
-    # Pallas canary fails.
-    run lm_bs16_cb16  600 env BENCH_LM_BATCH=16 BENCH_LM_XENT=chunked_bf16 python bench_lm.py \
-      || { probe || break; }
-    # 20 optimizer steps per dispatch: the A/B vs lm_bs16 splits chip
-    # time from host-dispatch/tunnel-RTT time (engine.make_multi_train_step).
-    run lm_bs16_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
-      || { probe || break; }
-    # cb16 + multi-step dispatch: the full non-Pallas stack in one row.
-    run lm_bs16_cb16_in20 600 env BENCH_LM_BATCH=16 BENCH_LM_XENT=chunked_bf16 BENCH_LM_INNER=20 python bench_lm.py \
-      || { probe || break; }
-    # -- p2: long-context ladder, XLA attention (missing #2; cannot hang
-    #        in a Pallas compile — remat=attn keeps (S,S) out of residuals)
-    run lm_s4096_xla  900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
-      || { probe || break; }
-    run lm_s8192_xla  900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn BENCH_LM_ATTN=xla python bench_lm.py \
-      || { probe || break; }
-    # Dense-only 8k attention: the clean machine-readable dense-OOM record
-    # (r3 weak #3) — no Pallas kernel compiles, so it never needs the canary.
-    run attn_8k_dense 600 env BENCH_ATTN_SEQS=8192 BENCH_ATTN_IMPLS=xla python bench_attn.py \
+    # Round-5 queue (2026-08-01 refresh, after the round-4 evidence all
+    # landed): default paths are now Pallas (attn auto = flash >= 1024,
+    # xent auto = fused on TPU), so only the explicitly-XLA fallback rows
+    # are canary-free.  Compile cache is warm from round 4; stamps are
+    # per-round (BENCH_RESULTS/.landed is gitignored).
+    # -- p1: canary-free fallback evidence (cannot hang in Pallas) -------
+    run lm_xla_cb16   600 env BENCH_LM_BATCH=16 BENCH_LM_ATTN=xla BENCH_LM_XENT=chunked_bf16 python bench_lm.py \
       || { probe || break; }
     # -- p3: TPU convergence artifact (missing #3; gate via the CLI) -----
     if [ ! -f "$STAMPS/conv_tpu" ]; then
@@ -127,64 +106,50 @@ while true; do
         log "item conv_tpu: failed"; probe || break
       fi
     fi
-    # -- p4: headline refresh with the MFU pair (next #9) ----------------
+    # -- p2: headline refresh (non-LM benches are Pallas-free) -----------
     run resnet        900 python bench.py            || { probe || break; }
-    run resnet_in10   900 env BENCH_INNER=10 python bench.py || { probe || break; }
-    run resnet_bs256  900 env BENCH_BATCH=256 python bench.py || { probe || break; }
     run bert          900 python bench_bert.py       || { probe || break; }
-    run lm_bs24       600 env BENCH_LM_BATCH=24 python bench_lm.py \
-      || { probe || break; }
-    run lm_bs32_rattn 600 env BENCH_LM_BATCH=32 BENCH_LM_REMAT=attn python bench_lm.py \
-      || { probe || break; }
-    # -- p5: profiles (the instruments for the next push) ----------------
-    if [ ! -f "$STAMPS/profile_lm" ]; then
-      if timeout 900 python train.py --workload gpt_lm --steps 25 \
-          --batch-size 16 --seq-len 1024 --remat off \
-          --profile-dir BENCH_RESULTS/profile_lm_tpu --profile-start 8 \
-          --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
-          && find BENCH_RESULTS/profile_lm_tpu -name '*.xplane.pb' | grep -q .; then
-        touch "$STAMPS/profile_lm"; log "item profile_lm: LANDED"
-      else
-        rm -rf BENCH_RESULTS/profile_lm_tpu
-        log "item profile_lm: failed"; probe || break
-      fi
-    fi
-    # ResNet step profile: the instrument for pushing past 1.07x.
-    if [ ! -f "$STAMPS/profile_resnet" ]; then
-      if timeout 900 python train.py --workload imagenet_resnet50 --steps 20 \
-          --batch-size 128 --profile-dir BENCH_RESULTS/profile_resnet_tpu \
-          --profile-start 8 --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
-          && find BENCH_RESULTS/profile_resnet_tpu -name '*.xplane.pb' | grep -q .; then
-        touch "$STAMPS/profile_resnet"; log "item profile_resnet: LANDED"
-      else
-        rm -rf BENCH_RESULTS/profile_resnet_tpu
-        log "item profile_resnet: failed"; probe || break
-      fi
-    fi
-    # -- p5: Pallas rows, canary-gated, LAST -----------------------------
+    # -- p3: Pallas rows (the default stack), canary-gated ---------------
     pallas_missing=0
-    for s in attn_4k lm_bs16_fx lm_bs16_fx20 lm_bs32_pl lm_bs32_plfx lm_s8192_pl attn_16k32k; do
+    for s in lm_auto lm_auto_in20 lm_s4096 lm_s8192 lm_s16k lm_s32k \
+             attn_4k attn_16k32k profile_lm; do
       [ -f "$STAMPS/$s" ] || pallas_missing=1
     done
     if (( pallas_missing == 0 )); then
       :  # all Pallas rows landed — don't spend window time on the canary
     elif pallas_ok; then
       log "pallas canary ok"
+      # The round-4 headline stack IS the default: flash 1024-blocks +
+      # fused CE head (112.9k tokens/s with in20 on 2026-08-01).
+      run lm_auto       600 env BENCH_LM_BATCH=16 python bench_lm.py \
+        || { probe || break; }
+      run lm_auto_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
+        || { probe || break; }
+      # Long-context ladder, defaults end-to-end.
+      run lm_s4096    900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn python bench_lm.py \
+        || { probe || break; }
+      run lm_s8192    900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn python bench_lm.py \
+        || { probe || break; }
+      run lm_s16k     900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=16384 BENCH_LM_REMAT=attn python bench_lm.py \
+        || { probe || break; }
+      run lm_s32k     900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=32768 BENCH_LM_REMAT=on python bench_lm.py \
+        || { probe || break; }
       run attn_4k     900 python bench_attn.py       || { probe || break; }
-      # fused-vs-chunked head A/B at the headline config (the reason
-      # ops/fused_xent.py exists) — Pallas-compiling, so canary-gated.
-      run lm_bs16_fx  900 env BENCH_LM_BATCH=16 BENCH_LM_XENT=fused python bench_lm.py \
-        || { probe || break; }
-      run lm_bs16_fx20 900 env BENCH_LM_BATCH=16 BENCH_LM_XENT=fused BENCH_LM_INNER=20 python bench_lm.py \
-        || { probe || break; }
-      run lm_bs32_pl  900 env BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas python bench_lm.py \
-        || { probe || break; }
-      run lm_bs32_plfx 900 env BENCH_LM_BATCH=32 BENCH_LM_ATTN=pallas BENCH_LM_XENT=fused python bench_lm.py \
-        || { probe || break; }
-      run lm_s8192_pl 900 env BENCH_LM_BATCH=2 BENCH_LM_SEQ=8192 BENCH_LM_REMAT=attn python bench_lm.py \
-        || { probe || break; }
       run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
         || { probe || break; }
+      # Fresh profile of the current default step (the instrument).
+      if [ ! -f "$STAMPS/profile_lm" ]; then
+        if timeout 900 python train.py --workload gpt_lm --steps 25 \
+            --batch-size 16 --seq-len 1024 --remat off \
+            --profile-dir BENCH_RESULTS/profile_lm_tpu --profile-start 8 \
+            --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
+            && find BENCH_RESULTS/profile_lm_tpu -name '*.xplane.pb' | grep -q .; then
+          touch "$STAMPS/profile_lm"; log "item profile_lm: LANDED"
+        else
+          rm -rf BENCH_RESULTS/profile_lm_tpu
+          log "item profile_lm: failed"; probe || break
+        fi
+      fi
     else
       log "pallas canary FAILED — skipping Pallas rows this window"
     fi
@@ -192,11 +157,9 @@ while true; do
   done
 
   missing=0
-  for s in profile_lm lm_bs16 lm_bs16_in20 lm_bs16_cb16 lm_bs16_cb16_in20 \
-           lm_bs24 lm_bs32_rattn lm_s4096_xla lm_s8192_xla attn_8k_dense \
-           conv_tpu resnet resnet_in10 resnet_bs256 bert profile_resnet attn_4k \
-           lm_bs16_fx lm_bs16_fx20 lm_bs32_pl lm_bs32_plfx lm_s8192_pl \
-           attn_16k32k; do
+  for s in lm_xla_cb16 conv_tpu resnet bert lm_auto lm_auto_in20 \
+           lm_s4096 lm_s8192 lm_s16k lm_s32k attn_4k attn_16k32k \
+           profile_lm; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
