@@ -47,8 +47,13 @@ def main() -> None:
     per_chip_batch = int(
         os.environ.get("BENCH_LM_BATCH", "2" if test_size else "8")
     )
-    # "0"/"1"/"attn" — attn = checkpoint only the attention op per block
+    # "0"/"1"/"attn" — attn = checkpoint only the attention op per block.
+    # Unknown values must FAIL here: workloads' remat plumbing treats any
+    # other string as remat-off, which once mislabeled a 32k artifact as
+    # "remat on" (BENCH_LM_REMAT=on, 2026-08-01).
     remat_env = os.environ.get("BENCH_LM_REMAT", "0")
+    if remat_env not in ("0", "1", "attn"):
+        raise SystemExit(f"BENCH_LM_REMAT={remat_env!r}: expected 0, 1, or attn")
     remat = {"0": False, "1": True}.get(remat_env, remat_env)
     attn_impl = os.environ.get("BENCH_LM_ATTN") or None
     xent_impl = os.environ.get("BENCH_LM_XENT") or None

@@ -335,14 +335,118 @@ def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
     return o.transpose(0, 2, 1, 3), lse[:, :, 0, :]
 
 
-# --- Backward: Pallas kernels (dq sweep + dkv sweep) ------------------------
+# --- Backward: Pallas kernels (fused single sweep, or dq + dkv split) -------
 
-#: "pallas" (default) or "xla" — the XLA blockwise recompute kept as the
+#: "pallas" (default: the fused single-sweep kernel when the dq scratch
+#: fits VMEM, else the split pair), "pallas_split" (force the two-kernel
+#: dq/dkv path), or "xla" — the XLA blockwise recompute kept as the
 #: golden reference for A/B numerics and as an escape hatch.  Read at TRACE
 #: time: a function jitted before flipping this keeps its compiled backward
 #: (jit caching) — for a reliable A/B pass ``backward_impl=`` to
 #: :func:`flash_attention` and re-jit instead of mutating mid-run.
 BACKWARD_IMPL = "pallas"
+
+#: The fused backward keeps the WHOLE (S, D) fp32 dq for the current
+#: (batch, head) in VMEM scratch; above this budget the split pair runs
+#: instead (at D=64 the cutoff is seq 8192).  2 MiB, not 4: the scratch
+#: shares the 16 MB VMEM with the (1024, 1024) fp32 score/p/dp/ds tiles,
+#: and a 4 MiB scratch compiled but OOM'd AT RUN TIME on the v5e at
+#: seq 16384 (measured 2026-08-01; 8192 runs and is 11% faster than
+#: split end-to-end).
+FUSED_BWD_DQ_SCRATCH_BYTES = 2 * 2**20
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_all_scr, dk_scr, dv_scr,
+                      *, scale, block_q, block_k, causal,
+                      have_mask, mask_ref=None, qseg_ref=None,
+                      kseg_ref=None):
+    """dq, dk and dv in ONE sweep — the p-tile is recomputed once.
+
+    The split pair pays 7 matmuls + 2 exp-of-score-tile passes per
+    (q-block, k-block) pair (each kernel recomputes s and p); this kernel
+    pays 5 matmuls + 1 exp.  Grid (B, H, n_k, n_q), q innermost:
+
+    - dk/dv accumulate per-k-block in scratch, flushed at the last
+      q-block — the same consecutive-revisit pattern as the split dkv
+      kernel;
+    - dq accumulates into a full (S, D) fp32 scratch for the current
+      (b, h) (zeroed at the slice's first grid step).  Its output block
+      is indexed by the INNER axis, so every visit writes the running
+      partial sum unconditionally — Pallas flushes an output buffer
+      whenever its index changes, and a visit that skipped the write
+      (e.g. under the causal guard) would flush stale bytes from the
+      previous q-block.  The final sweep (j == n_k-1) overwrites every
+      block with the completed sum.
+    """
+    j = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when((j == 0) & (i == 0))
+    def _init_dq():
+        dq_all_scr[:, :] = jnp.zeros_like(dq_all_scr)
+
+    @pl.when(i == 0)
+    def _init_dkv():
+        dk_scr[:, :] = jnp.zeros_like(dk_scr)
+        dv_scr[:, :] = jnp.zeros_like(dv_scr)
+
+    run = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        gq = g_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if have_mask:
+            keep = mask_ref[0, 0, :]  # (block_k,)
+            s = jnp.where(keep[None, :], s, NEG_INF)
+        if qseg_ref is not None:
+            s = _segment_mask(s, qseg_ref, kseg_ref)
+        lse = lse_ref[0, 0, 0, :]  # (block_q,)
+        p = jnp.exp(s - lse[:, None])
+        dv_scr[:, :] = dv_scr[:, :] + jax.lax.dot_general(
+            p.astype(gq.dtype), gq, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, D)
+        dp = jax.lax.dot_general(
+            gq, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        delta = delta_ref[0, 0, 0, :]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:, :] = dk_scr[:, :] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, D)
+        row = pl.ds(i * block_q, block_q)
+        dq_all_scr[row] = dq_all_scr[row] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, D)
+
+    # Unconditional writes: see the docstring on flush semantics.
+    dq_ref[0, 0, :, :] = dq_all_scr[pl.ds(i * block_q, block_q)].astype(
+        dq_ref.dtype
+    )
+    n_q = pl.num_programs(3)
+
+    @pl.when(i == n_q - 1)
+    def _flush_dkv():
+        dk_ref[0, 0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
@@ -472,7 +576,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
-def _flash_backward_pallas(res, g, *, causal, interpret):
+def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False):
     q, k, v, mask, segment_ids, o, lse = res
     # delta = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it.
     delta = jnp.einsum(
@@ -480,18 +584,22 @@ def _flash_backward_pallas(res, g, *, causal, interpret):
     )
     return _flash_backward_pallas_core(
         q, k, v, mask, g, lse, delta, segment_ids=segment_ids,
-        causal=causal, interpret=interpret
+        causal=causal, interpret=interpret, force_split=force_split
     )
 
 
 def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
                                 segment_ids=None, kv_segment_ids=None,
-                                causal, interpret):
+                                causal, interpret, force_split=False):
     """dq/dk/dv kernels from externally-supplied LSE and delta rows.
 
     Split out so ring attention (``parallel/ring_attention.py``) can drive
     the same kernels per K/V chunk with the *global* (cross-chunk) LSE.
     ``lse``/``delta`` are (B, H, S) fp32.
+
+    Dispatch: the fused single-sweep kernel (one p-recompute) when the
+    (S, D) fp32 dq scratch fits ``FUSED_BWD_DQ_SCRATCH_BYTES``, else —
+    or under ``force_split`` — the original dq + dkv pair.
     """
     batch, seq, heads, depth = q.shape
     block_q = _pick_block_q(seq)
@@ -504,6 +612,68 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
     lse4 = lse[:, :, None, :]  # (B, H, 1, S)
 
     qt, kt, vt, gt = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
+
+    if not force_split and seq * depth * 4 <= FUSED_BWD_DQ_SCRATCH_BYTES:
+        fused_specs = [
+            pl.BlockSpec((1, 1, block_q, depth),
+                         lambda b, h, j, i: (b, h, i, 0),
+                         memory_space=mem),  # q
+            pl.BlockSpec((1, 1, block_k, depth),
+                         lambda b, h, j, i: (b, h, j, 0),
+                         memory_space=mem),  # k
+            pl.BlockSpec((1, 1, block_k, depth),
+                         lambda b, h, j, i: (b, h, j, 0),
+                         memory_space=mem),  # v
+            pl.BlockSpec((1, 1, block_q, depth),
+                         lambda b, h, j, i: (b, h, i, 0),
+                         memory_space=mem),  # g
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, h, j, i: (b, h, 0, i),
+                         memory_space=mem),  # lse
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, h, j, i: (b, h, 0, i),
+                         memory_space=mem),  # delta
+        ]
+        extra_specs, extra_args, extra_names = _extra_specs_and_args(
+            mask, segment_ids, batch, seq, block_q, block_k, mem,
+            swap_grid=True, kv_segment_ids=kv_segment_ids,
+        )
+        kernel = _wrap_kernel(
+            _bwd_fused_kernel, 6, extra_names,
+            scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+        )
+        dqt, dkt, dvt = pl.pallas_call(
+            kernel,
+            grid=(batch, heads, seq // block_k, seq // block_q),
+            in_specs=fused_specs + extra_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, depth),
+                             lambda b, h, j, i: (b, h, i, 0),
+                             memory_space=mem),
+                pl.BlockSpec((1, 1, block_k, depth),
+                             lambda b, h, j, i: (b, h, j, 0),
+                             memory_space=mem),
+                pl.BlockSpec((1, 1, block_k, depth),
+                             lambda b, h, j, i: (b, h, j, 0),
+                             memory_space=mem),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qt.shape, q.dtype),
+                jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((seq, depth), jnp.float32),     # dq, whole (b,h)
+                pltpu.VMEM((block_k, depth), jnp.float32),  # dk
+                pltpu.VMEM((block_k, depth), jnp.float32),  # dv
+            ],
+            interpret=interpret,
+        )(qt, kt, vt, gt, lse4, delta, *extra_args)
+        return (
+            dqt.transpose(0, 2, 1, 3),
+            dkt.transpose(0, 2, 1, 3),
+            dvt.transpose(0, 2, 1, 3),
+        )
 
     # --- dq kernel: grid (B, H, n_q, n_k), k innermost ---
     dq_in_specs = [
@@ -686,9 +856,10 @@ def _flash_fwd(q, k, v, mask, segment_ids, causal, interpret, backward_impl):
 
 def _flash_bwd(causal, interpret, backward_impl, res, g):
     impl = backward_impl or BACKWARD_IMPL
-    if impl == "pallas":
+    if impl in ("pallas", "pallas_split"):
         dq, dk, dv = _flash_backward_pallas(
-            res, g, causal=causal, interpret=interpret
+            res, g, causal=causal, interpret=interpret,
+            force_split=(impl == "pallas_split"),
         )
     else:
         dq, dk, dv = _flash_backward_xla(res, g, causal=causal)
@@ -708,7 +879,9 @@ def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
     with ``mask`` and ``causal``.
     ``interpret=None`` auto-selects interpreter mode off-TPU (for tests).
     ``backward_impl`` picks the backward: None = module ``BACKWARD_IMPL``
-    default, "pallas" = kernel, "xla" = blockwise-recompute golden path.
+    default, "pallas" = fused single-sweep kernel (split pair when the dq
+    scratch exceeds VMEM budget), "pallas_split" = force the dq + dkv
+    pair, "xla" = blockwise-recompute golden path.
     Raises ValueError for shapes/masks the kernel cannot handle (callers
     wanting silent fallback should go through
     ``ops.attention.dot_product_attention`` with ``implementation="auto"``).

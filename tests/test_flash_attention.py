@@ -162,7 +162,7 @@ def test_forward_with_segment_ids(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("backward_impl", ["pallas", "xla"])
+@pytest.mark.parametrize("backward_impl", ["pallas", "pallas_split", "xla"])
 def test_gradients_with_segment_ids(backward_impl, causal):
     q, k, v = make_qkv(b=1, s=128, h=2, d=16)
     seg = make_segments(b=1, s=128, n_segments=2)
@@ -222,3 +222,78 @@ def test_dispatch_segment_ids_xla_path_matches_flash():
         q, k, v, segment_ids=seg, implementation="pallas"
     )
     np.testing.assert_allclose(via_flash, via_xla, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_backward_matches_split(causal, monkeypatch):
+    """The fused single-sweep backward (one p-recompute, dq in a whole-
+    (b,h) VMEM scratch) must agree with the original dq+dkv pair to
+    fp32 tolerance, including under causal skipping — where the fused
+    kernel's unconditional dq out-block writes are load-bearing (a
+    skipped pair still flushes the running partial sum, never stale
+    bytes).
+
+    Blocks are pinned to 64 so s=256 yields a 4x4 block grid — without
+    this the default chain picks 256-blocks and the grid is (.., 1, 1),
+    which never exercises causal block skipping, cross-j dq
+    accumulation, or the out-block revisit flushes."""
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_K", "64")
+    q, k, v = make_qkv(b=2, s=256, h=2, d=32, seed=7)
+
+    def loss(impl):
+        def f(q, k, v):
+            out = flash_attention(q, k, v, causal=causal, interpret=True,
+                                  backward_impl=impl)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return f
+
+    g_fused = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_split = jax.grad(loss("pallas_split"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_split):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_backward_multiblock_matches_xla(causal, monkeypatch):
+    """Multi-block fused backward vs the XLA golden path, with a padding
+    mask riding along — covers the masked + multi-block combination."""
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_K", "64")
+    q, k, v = make_qkv(b=1, s=256, h=2, d=16, seed=9)
+    mask = np.ones((1, 256), bool)
+    mask[:, 230:] = False
+    mask = jnp.asarray(mask)
+
+    def loss(impl):
+        def f(q, k, v):
+            out = flash_attention(q, k, v, mask=mask, causal=causal,
+                                  interpret=True, backward_impl=impl)
+            return jnp.sum((out * mask[:, :, None, None]) ** 2)
+        return f
+
+    g_fused = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_xla):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_fused_backward_dispatch_budget(monkeypatch):
+    """Above FUSED_BWD_DQ_SCRATCH_BYTES the default backward must fall
+    back to the split pair (the (S, D) fp32 dq scratch would not fit);
+    equality of gradients across the boundary proves the dispatch is
+    semantics-free."""
+    import distributedtensorflow_tpu.ops.flash_attention as fa
+
+    q, k, v = make_qkv(b=1, s=256, h=2, d=32, seed=11)
+
+    def g(q, k, v):
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    grad_fused = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    # Shrink the budget below S*D*4 = 32 KiB so dispatch flips to split.
+    monkeypatch.setattr(fa, "FUSED_BWD_DQ_SCRATCH_BYTES", 1024)
+    grad_split = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(grad_fused, grad_split):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)

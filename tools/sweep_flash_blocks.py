@@ -120,7 +120,7 @@ def main():
     if os.environ.get("SWEEP_PERSIST", "1") == "1":
         from bench_probe import persist_result
 
-        print(f"persisted {persist_result('flashsweep', out)}", flush=True)
+        persist_result("flashsweep", out)
 
 
 if __name__ == "__main__":

@@ -132,7 +132,9 @@ while true; do
         || { probe || break; }
       run lm_s16k     900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=16384 BENCH_LM_REMAT=attn python bench_lm.py \
         || { probe || break; }
-      run lm_s32k     900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=32768 BENCH_LM_REMAT=on python bench_lm.py \
+      # remat OFF at 32k: flash stores no (S,S), bs1 activations fit, and
+      # remat-free is the fastest measured config (21.2k tok/s).
+      run lm_s32k     900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=32768 BENCH_LM_REMAT=0 python bench_lm.py \
         || { probe || break; }
       run attn_4k     900 python bench_attn.py       || { probe || break; }
       run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
