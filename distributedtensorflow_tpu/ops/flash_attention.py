@@ -162,6 +162,33 @@ def _segment_mask(s, qseg_ref, kseg_ref):
     return jnp.where(qseg[:, None] == kseg[None, :], s, NEG_INF)
 
 
+def _masked_scores(q, k, qi, kj, *, scale, block_q, block_k, causal,
+                   have_mask, mask_ref, qseg_ref, kseg_ref):
+    """The (block_q, block_k) fp32 score tile with every mask applied.
+
+    THE shared recompute of all four kernels (fwd, dq, dkv, fused bwd):
+    qk^T contraction, causal iota mask, padding mask, packed-segment
+    mask.  One definition so a masking-semantics change cannot
+    desynchronize the forward from one of the backward variants."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if have_mask:
+        keep = mask_ref[0, 0, :]  # (block_k,)
+        s = jnp.where(keep[None, :], s, NEG_INF)
+    if qseg_ref is not None:
+        s = _segment_mask(s, qseg_ref, kseg_ref)
+    return s
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, block_q, block_k, causal,
                 have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None):
@@ -190,22 +217,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0, 0, :, :]  # (block_q, D)
         k = k_ref[0, 0, :, :]  # (block_k, D)
         v = v_ref[0, 0, :, :]  # (block_k, D)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (block_q, block_k)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if have_mask:
-            keep = mask_ref[0, 0, :]  # (block_k,)
-            s = jnp.where(keep[None, :], s, NEG_INF)
-        if qseg_ref is not None:
-            s = _segment_mask(s, qseg_ref, kseg_ref)
+        s = _masked_scores(
+            q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        )
         m_prev = m_scr[:, :1]  # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -399,22 +415,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         gq = g_ref[0, 0, :, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (block_q, block_k)
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if have_mask:
-            keep = mask_ref[0, 0, :]  # (block_k,)
-            s = jnp.where(keep[None, :], s, NEG_INF)
-        if qseg_ref is not None:
-            s = _segment_mask(s, qseg_ref, kseg_ref)
+        s = _masked_scores(
+            q, k, i, j, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])
         dv_scr[:, :] = dv_scr[:, :] + jax.lax.dot_general(
@@ -475,22 +480,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         gq = g_ref[0, 0, :, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (block_q, block_k)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if have_mask:
-            keep = mask_ref[0, 0, :]  # (block_k,)
-            s = jnp.where(keep[None, :], s, NEG_INF)
-        if qseg_ref is not None:
-            s = _segment_mask(s, qseg_ref, kseg_ref)
+        s = _masked_scores(
+            q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
@@ -537,22 +531,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         gq = g_ref[0, 0, :, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (block_q, block_k)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if have_mask:
-            keep = mask_ref[0, 0, :]  # (block_k,)
-            s = jnp.where(keep[None, :], s, NEG_INF)
-        if qseg_ref is not None:
-            s = _segment_mask(s, qseg_ref, kseg_ref)
+        s = _masked_scores(
+            q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
         dv_scr[:, :] = dv_scr[:, :] + jax.lax.dot_general(

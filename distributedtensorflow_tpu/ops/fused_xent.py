@@ -82,7 +82,10 @@ BLOCK_VOCAB = _env_int("DTFT_XENT_BLOCK_VOCAB", 1024)
 #: Its vocab tile is the smallest: the dx kernel carries the most live
 #: fp32 temporaries (p, dlog, the fp32-cast weight tile, the fp32 dx
 #: accumulator), so it hits the same 16 MB stack wall soonest.
-BLOCK_TOKENS_DX = _env_int("DTFT_XENT_BLOCK_TOKENS_DX", 1024)
+#: On-chip sweep 2026-08-01 (bs16 seq1024 headline): token tile 2048 =
+#: 118.7k tok/s vs 116.8k at 1024; 4096, or 2048 paired with vocab 1024,
+#: runtime-OOMs.
+BLOCK_TOKENS_DX = _env_int("DTFT_XENT_BLOCK_TOKENS_DX", 2048)
 BLOCK_VOCAB_DX = _env_int("DTFT_XENT_BLOCK_VOCAB_DX", 512)
 
 
