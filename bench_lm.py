@@ -43,7 +43,23 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(data=-1))
     n_chips = mesh.size
     test_size = os.environ.get("BENCH_LM_TEST") == "1"  # CPU smoke mode
-    seq = int(os.environ.get("BENCH_LM_SEQ", "128" if test_size else "1024"))
+    # BENCH_LM_WORKLOAD: gpt_lm (default) | gpt_medium_lm | lm_long_context
+    workload = os.environ.get("BENCH_LM_WORKLOAD", "gpt_lm")
+    model_tag = {"gpt_lm": "gpt_small",
+                 "gpt_medium_lm": "gpt_medium"}.get(workload, workload)
+    # seq/remat: only override the preset when EXPLICITLY set — always
+    # passing bench defaults would silently defeat lm_long_context's own
+    # seq-8192/remat-attn defaults while labeling the record with the
+    # preset's name.  gpt_lm keeps the historical bench default of 1024.
+    seq_env = os.environ.get("BENCH_LM_SEQ")
+    if seq_env:
+        seq = int(seq_env)
+    elif test_size:
+        seq = 128
+    elif workload == "lm_long_context":
+        seq = None  # the preset's default (8192)
+    else:
+        seq = 1024
     per_chip_batch = int(
         os.environ.get("BENCH_LM_BATCH", "2" if test_size else "8")
     )
@@ -51,18 +67,32 @@ def main() -> None:
     # Unknown values must FAIL here: workloads' remat plumbing treats any
     # other string as remat-off, which once mislabeled a 32k artifact as
     # "remat on" (BENCH_LM_REMAT=on, 2026-08-01).
-    remat_env = os.environ.get("BENCH_LM_REMAT", "0")
-    if remat_env not in ("0", "1", "attn"):
+    remat_env = os.environ.get("BENCH_LM_REMAT")
+    if remat_env is None:
+        remat = False if workload != "lm_long_context" else None
+    elif remat_env in ("0", "1", "attn"):
+        remat = {"0": False, "1": True}.get(remat_env, remat_env)
+    else:
         raise SystemExit(f"BENCH_LM_REMAT={remat_env!r}: expected 0, 1, or attn")
-    remat = {"0": False, "1": True}.get(remat_env, remat_env)
     attn_impl = os.environ.get("BENCH_LM_ATTN") or None
     xent_impl = os.environ.get("BENCH_LM_XENT") or None
     wl = get_workload(
-        "gpt_lm", test_size=test_size,
+        workload, test_size=test_size,
         global_batch_size=per_chip_batch * n_chips,
         seq_len=seq, remat=remat, attn_impl=attn_impl, xent_impl=xent_impl,
     )
     wl = wl.for_mesh(mesh)
+    if seq is None:  # resolved by the preset; recover it for data + MFU
+        seq = int(wl.init_batch["input_ids"].shape[1])
+    # Record labels must reflect what the preset RESOLVED, not what the
+    # envs happened to pass (an lm_long_context record with remat null
+    # while the run used remat="attn" is the mislabeling class the
+    # BENCH_LM_REMAT validation above exists to prevent).
+    _cfg = wl.model.cfg
+    if remat is None:
+        remat = "attn" if _cfg.remat_attn else bool(_cfg.remat)
+    attn_label = attn_impl or _cfg.attn_impl
+    xent_label = xent_impl or _cfg.xent_impl
 
     rng = jax.random.PRNGKey(0)
     state, specs = create_sharded_state(
@@ -105,15 +135,15 @@ def main() -> None:
         from bench_probe import is_tpu_platform, persist_result
 
         result = {
-            "metric": "gpt_small_train_tokens_per_sec_per_chip",
+            "metric": f"{model_tag}_train_tokens_per_sec_per_chip",
             "value": None,
             "error": _classify_failure(e),
             "platform": jax.devices()[0].platform,
             "seq": seq,
             "global_batch": wl.global_batch_size,
             "remat": remat,
-            "attn_impl": attn_impl or "auto",
-            "xent_impl": xent_impl or "auto",
+            "attn_impl": attn_label,
+            "xent_impl": xent_label,
             "steps_per_call": inner,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
@@ -147,20 +177,23 @@ def main() -> None:
     )
 
     # Anchor: an A100 trains GPT-2-small (~124M params) at roughly 150k
-    # tokens/sec with remat off; used as the vs_baseline denominator.
+    # tokens/sec with remat off; used as the vs_baseline denominator for
+    # the gpt_lm preset (other workloads have no public anchor — their
+    # vs_baseline is null and the metric name carries the model size).
     result = {
-        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "metric": f"{model_tag}_train_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(per_chip / 150_000.0, 4),
+        "vs_baseline": (round(per_chip / 150_000.0, 4)
+                        if workload == "gpt_lm" else None),
         **mfu,
         "platform": jax.devices()[0].platform,
         "device_kind": device_kind,
         "seq": seq,
         "global_batch": wl.global_batch_size,
         "remat": remat,
-        "attn_impl": attn_impl or "auto",
-        "xent_impl": xent_impl or "chunked",
+        "attn_impl": attn_label,
+        "xent_impl": xent_label,
         "step_time_ms": round(1000 * dt / n_opt_steps, 2),
         "steps_per_call": inner,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -6,6 +6,7 @@ from .gpt import (  # noqa: F401
     GPTConfig,
     GPTLM,
     gpt_layout,
+    gpt_medium,
     gpt_small,
     gpt_tiny,
     lm_eval,

@@ -73,6 +73,15 @@ def gpt_small() -> GPTConfig:
     return GPTConfig()
 
 
+def gpt_medium() -> GPTConfig:
+    """GPT-2-medium (~350M params): 24 layers, hidden 1024, 16 heads.
+
+    Wider matmuls (K=1024 = 8 full MXU passes vs small's 6) raise MXU
+    efficiency; the measured single-chip MFU exceeds gpt_small's."""
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                     intermediate_size=4096)
+
+
 def gpt_tiny() -> GPTConfig:
     """Test-size config (2 layers, 128 hidden, short context)."""
     return GPTConfig(

@@ -82,11 +82,38 @@ BLOCK_VOCAB = _env_int("DTFT_XENT_BLOCK_VOCAB", 1024)
 #: Its vocab tile is the smallest: the dx kernel carries the most live
 #: fp32 temporaries (p, dlog, the fp32-cast weight tile, the fp32 dx
 #: accumulator), so it hits the same 16 MB stack wall soonest.
-#: On-chip sweep 2026-08-01 (bs16 seq1024 headline): token tile 2048 =
-#: 118.7k tok/s vs 116.8k at 1024; 4096, or 2048 paired with vocab 1024,
-#: runtime-OOMs.
-BLOCK_TOKENS_DX = _env_int("DTFT_XENT_BLOCK_TOKENS_DX", 2048)
+#: On-chip sweep 2026-08-01 (bs16 seq1024 headline): token tile 2048
+#: first measured 118.7k tok/s vs 116.8k at 1024, but (a) 2048's ~18 MB
+#: Mosaic stack only fits in SOME surrounding programs — it compiled
+#: inside the seq-1024 train step yet fails in isolation AND inside the
+#: seq-8192 step with the SAME padded (16384, 768) operands (scoped-
+#: stack accounting is context-dependent), and (b) a re-measure of the
+#: 1024 default landed 118.6k: the apparent tile win was mostly run
+#: variance.  1024 is robust everywhere and costs nothing measurable.
+BLOCK_TOKENS_DX = _env_int("DTFT_XENT_BLOCK_TOKENS_DX", 1024)
 BLOCK_VOCAB_DX = _env_int("DTFT_XENT_BLOCK_VOCAB_DX", 512)
+
+
+def _blocks_for_dim(d: int) -> tuple[int, int, int, int]:
+    """(block_tokens, block_vocab, block_tokens_dx, block_vocab_dx) for
+    hidden size ``d``.
+
+    Every kernel tile is (block, d)- or (block_v, block_n)-shaped, so the
+    VMEM stack scales with d: the d<=768 defaults above (on-chip-tuned at
+    GPT-2-small) VMEM-OOM at d=1024 (GPT-2-medium), where the measured
+    fitting set is 512 across the board (46.0k tok/s, MFU 0.566 —
+    still ahead of the chunked_bf16 head's 44.1k).  Env overrides win
+    unconditionally at every d."""
+    if d <= 768:
+        # The module constants above ARE the d<=768 defaults (env already
+        # applied at import) — single source of truth for the tuned set.
+        defaults = (BLOCK_TOKENS, BLOCK_VOCAB, BLOCK_TOKENS_DX,
+                    BLOCK_VOCAB_DX)
+    else:
+        defaults = (512, 512, 512, 512)
+    names = ("DTFT_XENT_BLOCK_TOKENS", "DTFT_XENT_BLOCK_VOCAB",
+             "DTFT_XENT_BLOCK_TOKENS_DX", "DTFT_XENT_BLOCK_VOCAB_DX")
+    return tuple(_env_int(n, v) for n, v in zip(names, defaults))
 
 
 def _transposed_logits(w_ref, x_ref):
@@ -432,10 +459,10 @@ def estimate_hbm_bytes(
     d: int,
     v: int,
     *,
-    block_tokens: int = BLOCK_TOKENS,
-    block_vocab: int = BLOCK_VOCAB,
-    block_tokens_dx: int = BLOCK_TOKENS_DX,
-    block_vocab_dx: int = BLOCK_VOCAB_DX,
+    block_tokens: int | None = None,
+    block_vocab: int | None = None,
+    block_tokens_dx: int | None = None,
+    block_vocab_dx: int | None = None,
     compute_bytes: int = 2,  # bf16 operands
 ) -> dict:
     """Analytic HBM traffic of one fused fwd+bwd head pass, in bytes.
@@ -455,7 +482,17 @@ def estimate_hbm_bytes(
     reads them twice more (softmax grad + matmul operands) → 5 passes
     over an (N, V) fp32 array, plus the same x/w streams the fused path
     pays.  ``tests/test_fused_xent.py`` pins the headline-config ratio.
+
+    Block defaults resolve through :func:`_blocks_for_dim` — the SAME
+    selection ``fused_softmax_xent`` makes — so the estimate models the
+    tiling the kernel actually runs at this ``d`` (the d=768 defaults
+    would describe a nonexistent, VMEM-OOM config at d=1024).
     """
+    _dt, _dv, _dtx, _dvx = _blocks_for_dim(d)
+    block_tokens = block_tokens or _dt
+    block_vocab = block_vocab or _dv
+    block_tokens_dx = block_tokens_dx or _dtx
+    block_vocab_dx = block_vocab_dx or _dvx
 
     def pad(x, m):
         return x + (-x) % m
@@ -531,10 +568,10 @@ def fused_softmax_xent(
     mask: jax.Array | None = None,  # same shape as targets; 1 = count
     *,
     compute_dtype: jnp.dtype | None = None,
-    block_tokens: int = BLOCK_TOKENS,
-    block_vocab: int = BLOCK_VOCAB,
-    block_tokens_dx: int = BLOCK_TOKENS_DX,
-    block_vocab_dx: int = BLOCK_VOCAB_DX,
+    block_tokens: int | None = None,
+    block_vocab: int | None = None,
+    block_tokens_dx: int | None = None,
+    block_vocab_dx: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Mean masked next-token NLL; logits never leave VMEM.
@@ -558,5 +595,7 @@ def fused_softmax_xent(
     )
     w_row = w_row * ((t >= 0) & (t < v)).astype(jnp.float32)
     op_dtype = compute_dtype or jnp.result_type(hidden, wte)
-    blocks = (block_tokens, block_vocab, block_tokens_dx, block_vocab_dx)
+    dt, dv, dtx, dvx = _blocks_for_dim(d)
+    blocks = (block_tokens or dt, block_vocab or dv,
+              block_tokens_dx or dtx, block_vocab_dx or dvx)
     return _fused(x2, wte, t, w_row, op_dtype, blocks, interpret)

@@ -344,10 +344,23 @@ def get_workload(name: str, *, test_size: bool = False,
             mesh_spec=MeshSpec(data=-1),
             layout=widedeep_layout(),
         )
-    if name in ("gpt_lm", "lm_long_context"):
-        from .models import GPTLM, gpt_layout, gpt_small, gpt_tiny, lm_eval, lm_loss
+    if name in ("gpt_lm", "gpt_medium_lm", "lm_long_context"):
+        from .models import (
+            GPTLM,
+            gpt_layout,
+            gpt_medium,
+            gpt_small,
+            gpt_tiny,
+            lm_eval,
+            lm_loss,
+        )
 
-        cfg = gpt_tiny() if test_size else gpt_small()
+        if test_size:
+            cfg = gpt_tiny()
+        elif name == "gpt_medium_lm":
+            cfg = gpt_medium()
+        else:
+            cfg = gpt_small()
         if name == "lm_long_context" and not test_size:
             # The long-context flagship preset: 8k tokens by default, the
             # flash/ring attention path (its backward stores no (S, S)
@@ -589,12 +602,12 @@ def get_workload(name: str, *, test_size: bool = False,
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
         "imagenet_resnet50 imagenet_vit bert_mlm bert_mlm_packed bert_moe "
-        "widedeep gpt_lm lm_long_context gpt_moe t5_seq2seq"
+        "widedeep gpt_lm gpt_medium_lm lm_long_context gpt_moe t5_seq2seq"
     )
 
 
 WORKLOADS = (
     "mnist_lenet", "cifar_resnet20", "imagenet_resnet50", "imagenet_vit",
     "bert_mlm", "bert_mlm_packed", "bert_moe", "widedeep", "gpt_lm",
-    "lm_long_context", "gpt_moe", "t5_seq2seq",
+    "gpt_medium_lm", "lm_long_context", "gpt_moe", "t5_seq2seq",
 )

@@ -71,6 +71,8 @@ MATRIX = [
                      "BENCH_LM_REMAT": "attn"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_XENT": "fused",
                      "BENCH_LM_INNER": "4"}),
+    ("bench_lm.py", {"BENCH_LM_TEST": "1",
+                     "BENCH_LM_WORKLOAD": "gpt_medium_lm"}),
     ("bench.py", {"BENCH_TEST": "1"}),
     ("bench.py", {"BENCH_TEST": "1", "BENCH_INNER": "2"}),
     ("bench_bert.py", {"BENCH_BERT_TEST": "1"}),

@@ -192,3 +192,49 @@ def test_fused_grad_under_jit_and_vjp_dtype():
     assert gh.dtype == hidden.dtype and gw.dtype == wte.dtype
     assert gh.shape == hidden.shape
     assert gw.shape == wte.shape
+
+
+def test_blocks_for_dim_adaptive(monkeypatch):
+    """Tile defaults adapt to hidden size: the d<=768 set comes from the
+    module constants (single source of truth); d>768 drops to the
+    512-across set that fits Mosaic's 16 MB stack at GPT-2-medium
+    (d=1024 with the d<=768 tiles VMEM-OOMs on the chip).  Env overrides
+    win at every d."""
+    import distributedtensorflow_tpu.ops.fused_xent as fx
+
+    for name in ("DTFT_XENT_BLOCK_TOKENS", "DTFT_XENT_BLOCK_VOCAB",
+                 "DTFT_XENT_BLOCK_TOKENS_DX", "DTFT_XENT_BLOCK_VOCAB_DX"):
+        monkeypatch.delenv(name, raising=False)
+    assert fx._blocks_for_dim(768) == (
+        fx.BLOCK_TOKENS, fx.BLOCK_VOCAB, fx.BLOCK_TOKENS_DX,
+        fx.BLOCK_VOCAB_DX,
+    )
+    assert fx._blocks_for_dim(1024) == (512, 512, 512, 512)
+    monkeypatch.setenv("DTFT_XENT_BLOCK_TOKENS_DX", "256")
+    assert fx._blocks_for_dim(1024)[2] == 256
+
+
+def test_fused_wide_hidden_matches_chunked():
+    """d=1024 (> the 768 tile-default boundary) through the REAL default
+    block resolution — value + grads vs the chunked golden path.  This is
+    the adaptive-tile branch gpt_medium runs on TPU, exercised on CPU in
+    interpret mode (small vocab keeps it fast; block shapes pad)."""
+    from distributedtensorflow_tpu.ops.xent import chunked_softmax_xent
+
+    key = jax.random.PRNGKey(5)
+    n, d, v = 64, 1024, 640
+    hidden = jax.random.normal(jax.random.fold_in(key, 0), (n, d)) * 0.05
+    wte = jax.random.normal(jax.random.fold_in(key, 1), (v, d)) * 0.05
+    targets = jax.random.randint(jax.random.fold_in(key, 2), (n,), 0, v)
+
+    def lf(h, w):
+        return fused_softmax_xent(h, w, targets, interpret=True)
+
+    def lc(h, w):
+        return chunked_softmax_xent(h[None], w, targets[None])
+
+    vf, gf = jax.value_and_grad(lf, argnums=(0, 1))(hidden, wte)
+    vc, gc = jax.value_and_grad(lc, argnums=(0, 1))(hidden, wte)
+    np.testing.assert_allclose(vf, vc, rtol=1e-5, atol=1e-6)
+    for a, b in zip(gf, gc):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)

@@ -136,6 +136,9 @@ while true; do
       # remat-free is the fastest measured config (21.2k tok/s).
       run lm_s32k     900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=32768 BENCH_LM_REMAT=0 python bench_lm.py \
         || { probe || break; }
+      # GPT-2-medium: the higher-MFU preset (hidden 1024; adaptive tiles).
+      run lm_medium   900 env BENCH_LM_WORKLOAD=gpt_medium_lm BENCH_LM_BATCH=8 python bench_lm.py \
+        || { probe || break; }
       run attn_4k     900 python bench_attn.py       || { probe || break; }
       run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
         || { probe || break; }
@@ -160,8 +163,8 @@ while true; do
 
   missing=0
   for s in lm_xla_cb16 conv_tpu resnet bert lm_auto lm_auto_in20 \
-           lm_s4096 lm_s8192 lm_s16k lm_s32k attn_4k attn_16k32k \
-           profile_lm; do
+           lm_medium lm_s4096 lm_s8192 lm_s16k lm_s32k attn_4k \
+           attn_16k32k profile_lm; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
