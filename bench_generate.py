@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Serving-side benchmark: KV-cache autoregressive decode tokens/sec.
+
+The training benches (bench.py / bench_lm.py / bench_bert.py) cover the
+SPMD training path; this measures the OTHER half of the reference's
+surface — serving (SURVEY.md §2.3 model-zoo row; ``models.generate`` is
+the KV-cache decode loop, compiled as ONE jitted scan).  Metric:
+generated tokens/sec/chip at a given batch, prompt and continuation
+length, greedy decoding (temperature 0 — the deterministic path every
+config exercises).
+
+Knobs (env): ``BENCH_GEN_BATCH`` (default 16), ``BENCH_GEN_PROMPT``
+(default 128), ``BENCH_GEN_NEW`` (default 128), ``BENCH_GEN_TEST`` CPU
+smoke.  One JSON line, same contract as the other benches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from bench_probe import probe_devices_with_retries
+
+if not probe_devices_with_retries("bench_generate"):
+    raise SystemExit(2)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+
+def main() -> None:
+    from distributedtensorflow_tpu.models import GPTLM, gpt_small, gpt_tiny
+    from distributedtensorflow_tpu.models.generate import generate
+
+    test_size = os.environ.get("BENCH_GEN_TEST") == "1"
+    b = int(os.environ.get("BENCH_GEN_BATCH", "2" if test_size else "16"))
+    prompt_len = int(
+        os.environ.get("BENCH_GEN_PROMPT", "16" if test_size else "128")
+    )
+    new = int(os.environ.get("BENCH_GEN_NEW", "8" if test_size else "128"))
+    cfg = gpt_tiny() if test_size else gpt_small()
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    prompt = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(b, prompt_len)
+    ).astype(np.int32)
+    params = model.init(rng, prompt[:, :1], deterministic=True)["params"]
+
+    run = jax.jit(
+        lambda p, ids: generate(p, ids, cfg=cfg, max_new_tokens=new)
+    )
+    out = run(params, prompt)          # compile + warm
+    float(np.asarray(out)[0, -1])      # fetch = sync (axon: no block_until)
+    iters = 3 if test_size else 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(params, prompt)
+    float(np.asarray(out)[0, -1])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = iters * b * new / dt
+    result = {
+        "metric": "gpt_small_greedy_decode_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,  # no public anchor for this serving config
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "batch": b,
+        "prompt_len": prompt_len,
+        "max_new_tokens": new,
+        "ms_per_decode_step": round(1e3 * dt / (iters * new), 3),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    from bench_probe import is_tpu_platform, persist_result
+
+    if is_tpu_platform(result["platform"]) and not test_size:
+        persist_result("generate", result)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

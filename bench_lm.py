@@ -6,10 +6,13 @@ images/sec/chip) — this measures the long-context/LM path: a GPT-small
 train step (bf16, fused QKV) on synthetic data.  Prints one JSON line in
 the same shape as bench.py.
 
-Knobs (env): ``BENCH_LM_BATCH`` per-chip batch (default 8),
-``BENCH_LM_SEQ`` sequence length (default 1024), ``BENCH_LM_REMAT`` 1/0
-(default 0 — the A100 anchor number is remat-off; remat trades ~1/3 extra
-FLOPs for activation memory and only helps once the batch doesn't fit).
+Knobs (env): ``BENCH_LM_WORKLOAD`` preset (``gpt_lm`` default /
+``gpt_medium_lm`` / ``lm_long_context`` — presets keep their OWN
+seq/remat defaults unless the envs below explicitly override),
+``BENCH_LM_BATCH`` per-chip batch (default 8), ``BENCH_LM_SEQ`` sequence
+length (gpt_lm default 1024), ``BENCH_LM_REMAT`` 0/1/attn (gpt_lm
+default 0 — the A100 anchor number is remat-off), ``BENCH_LM_ATTN`` /
+``BENCH_LM_XENT`` kernel selectors, ``BENCH_LM_INNER`` steps/dispatch.
 """
 
 from __future__ import annotations

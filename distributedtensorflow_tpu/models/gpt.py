@@ -99,11 +99,13 @@ def cached_attention_with_vars(module: nn.Module, q, k, v,
     from ..ops.attention import cached_decode_attention
 
     b, _, h, d = q.shape
+    # (B, H, D, S): decode streams the cache with S on the lane dim —
+    # see the layout note on ops.attention.cached_decode_attention.
     cached_k = module.variable(
-        "cache", "cached_key", lambda: jnp.zeros((b, max_seq, h, d), k.dtype)
+        "cache", "cached_key", lambda: jnp.zeros((b, h, d, max_seq), k.dtype)
     )
     cached_v = module.variable(
-        "cache", "cached_value", lambda: jnp.zeros((b, max_seq, h, d), v.dtype)
+        "cache", "cached_value", lambda: jnp.zeros((b, h, d, max_seq), v.dtype)
     )
     cache_ix = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
