@@ -99,13 +99,14 @@ def cached_attention_with_vars(module: nn.Module, q, k, v,
     from ..ops.attention import cached_decode_attention
 
     b, _, h, d = q.shape
-    # (B, H, D, S): decode streams the cache with S on the lane dim —
-    # see the layout note on ops.attention.cached_decode_attention.
+    # (B, H, S, D): per-step writes are contiguous (D,) rows and the
+    # Pallas decode kernel streams (H, S, D) tiles — see the decode-perf
+    # history on ops.attention.cached_decode_attention.
     cached_k = module.variable(
-        "cache", "cached_key", lambda: jnp.zeros((b, h, d, max_seq), k.dtype)
+        "cache", "cached_key", lambda: jnp.zeros((b, h, max_seq, d), k.dtype)
     )
     cached_v = module.variable(
-        "cache", "cached_value", lambda: jnp.zeros((b, h, d, max_seq), v.dtype)
+        "cache", "cached_value", lambda: jnp.zeros((b, h, max_seq, d), v.dtype)
     )
     cache_ix = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)

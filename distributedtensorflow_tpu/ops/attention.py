@@ -9,6 +9,8 @@ score/value contraction, softmax in float32.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -52,8 +54,8 @@ def cached_decode_attention(
     q: jax.Array,         # (B, s_new, H, D) new queries
     k_new: jax.Array,     # (B, s_new, H, D) new keys
     v_new: jax.Array,     # (B, s_new, H, D) new values
-    cached_k: jax.Array,  # (B, H, D, max_seq) cache — S on LANES
-    cached_v: jax.Array,  # (B, H, D, max_seq)
+    cached_k: jax.Array,  # (B, H, max_seq, D) cache
+    cached_v: jax.Array,  # (B, H, max_seq, D)
     cache_index: jax.Array,  # () int32 — next write slot
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One KV-cache decode step, shared by every serving path.
@@ -65,45 +67,161 @@ def cached_decode_attention(
     ``<= ix+i``, which is also correct for multi-token chunked prefill —
     and returns ``(out, cached_k, cached_v, cache_index)`` updated.
 
-    Layout + dtype discipline (2026-08-01 decode profiles): the cache is
-    stored **(B, H, D, S)** — the long S axis on TPU LANES (a multiple
-    of 128, zero pad waste) and D on sublanes — and the einsums keep
-    native operand dtype with fp32 ACCUMULATION
-    (``preferred_element_type``; an earlier ``.astype(f32)`` form
-    materialized full fp32 cache copies every step).  Honest measured
-    outcome: three formulations (fp32-cast + (B,S,H,D), S-contiguous
-    (B,H,S,D), and this lane-major one) all timed ~9.6 ms/step at
-    GPT-2-small bs16 — the multiply-reduce gemv lowering itself is the
-    bound, invariant to logical layout, so the next decode-perf lever is
-    a dedicated Pallas kernel, not more layout work.  This layout is
-    kept as the principled default (no pad waste, contiguous stream).
-    Softmax runs fp32 (matching :func:`xla_attention`).  New K/V arrive
-    BSHD from the projections; the per-step transpose touches only
-    (B, s_new, H, D).
+    Decode perf history (2026-08-01, GPT-2-small bs16 max_seq 1024, all
+    measured in BENCH_RESULTS/generate_20260801_*.json): XLA's gemv
+    lowering costs ~9.6 ms/step INVARIANT to cache layout and operand
+    dtype (three formulations tied); a per-(b, h) Pallas kernel cut it
+    to 7.1 ms but paid ~2.2 ms of strided cache WRITES in (B, H, D, S)
+    plus DMA latency on 192 tiny tiles; the shipped form — cache
+    (B, H, S, D) so the per-step write is a contiguous row, single-token
+    steps dispatched to the head-blocked Pallas kernel — measures
+    **7.3 ms/step (1.34x the XLA lowering)**.  The remaining gap to the
+    ~1 ms memory floor is kernel-internal (half-empty lanes at D=64 and
+    per-head softmax passes); further cuts need Mosaic-level work, not
+    layout changes.  Softmax runs fp32 (matching :func:`xla_attention`);
+    the multi-token (prefill) path keeps the XLA einsums with native
+    operand dtype + fp32 accumulation.
     """
     b, s_new, h, d = q.shape
-    max_seq = cached_k.shape[3]
+    max_seq = cached_k.shape[2]
     ix = cache_index
     cached_k = jax.lax.dynamic_update_slice(
-        cached_k, k_new.transpose(0, 2, 3, 1), (0, 0, 0, ix)
+        cached_k, k_new.transpose(0, 2, 1, 3), (0, 0, ix, 0)
     )
     cached_v = jax.lax.dynamic_update_slice(
-        cached_v, v_new.transpose(0, 2, 3, 1), (0, 0, 0, ix)
+        cached_v, v_new.transpose(0, 2, 1, 3), (0, 0, ix, 0)
     )
     q_pos = ix + jnp.arange(s_new)
     k_idx = jnp.arange(max_seq)
     valid = k_idx[None, :] <= q_pos[:, None]  # (s_new, max_seq)
+    # Kernel blocks are whole-axis in (S, D) (always tile-legal); the
+    # head-block picker bounds VMEM, so the only fallback case is a
+    # single head's (S, D) temporaries exceeding the budget.  Platform
+    # routing: compiled kernel on TPU; interpret-mode kernel on CPU so
+    # tests exercise the same code path; any OTHER backend (e.g. GPU)
+    # keeps the compiled XLA einsum path below — interpret emulation
+    # there would serve real traffic at Python speed.
+    platform = jax.devices()[0].platform
+    if (s_new == 1 and platform in ("tpu", "axon", "cpu")
+            and max_seq * d * _DECODE_TEMP_BYTES_PER_ELEM
+            <= _DECODE_VMEM_BUDGET):
+        out = _pallas_decode_attention(
+            q, cached_k, cached_v, valid.astype(jnp.int32),
+            interpret=platform == "cpu",
+        )
+        return out, cached_k, cached_v, ix + s_new
     scores = jnp.einsum(
-        "bqhd,bhdk->bhqk", q, cached_k,
+        "bqhd,bhkd->bhqk", q, cached_k,
         preferred_element_type=jnp.float32,
     ) / (d ** 0.5)
     scores = jnp.where(valid[None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhqk,bhdk->bqhd", weights.astype(q.dtype), cached_v,
+        "bhqk,bhkd->bqhd", weights.astype(q.dtype), cached_v,
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)
     return out, cached_k, cached_v, ix + s_new
+
+
+def _decode_attn_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, scale):
+    """A block of heads of one batch row's single-token decode attention.
+
+    XLA lowers the decode gemv as separate multiply-reduce fusions that
+    measured ~9.6 ms/step at GPT-2-small bs16 regardless of cache layout
+    (see :func:`cached_decode_attention`).  This kernel fuses
+    scores -> masked softmax -> weighted-V for ``hb`` heads per grid
+    step over (hb, S, D) K/V tiles: the only HBM traffic is one read of
+    each.  ``hb`` balances DMA latency (few big tiles) against the
+    ~24 bytes/element of fp32 temporaries that must fit the 16 MB VMEM
+    stack (hb = all 12 GPT-small heads spilled and ran at XLA speed).
+    """
+    # q/out ride with an 8-deep broadcast sublane dim — (1, hb, 8, d)
+    # blocks keep the head block on an UNTILED leading dim, so any hb is
+    # tile-legal (a (hb, d) trailing block is only legal for hb % 8 == 0
+    # or hb == H, and Mosaic cannot reshape lanes to sublanes in-kernel;
+    # both found on-chip at hb=4).  Same trick as fused_xent's _SUB
+    # scratch.  The head loop is a STATIC unroll: per-head temporaries
+    # are (S, D) fp32 (256 KB at GPT-small) and stay VMEM-resident,
+    # where a whole-block (hb, S, D) fp32 formulation spilled.
+    hb = k_ref.shape[1]
+    # Reshape the i32 mask BEFORE the bool compare: Mosaic's lane->sublane
+    # reshape only supports 32-bit element types (found on-chip: the i1
+    # form fails with "minor dim ... only supported for 32-bit types").
+    valid_col = valid_ref[...].reshape(-1, 1) != 0   # (S, 1)
+    for hi in range(hb):
+        q_h = q_ref[0, hi, :1, :].astype(jnp.float32)   # (1, D)
+        k_h = k_ref[0, hi, :, :].astype(jnp.float32)    # (S, D)
+        s = jnp.sum(k_h * q_h, axis=1, keepdims=True) * scale  # (S, 1)
+        s = jnp.where(valid_col, s, NEG_INF)
+        m = jnp.max(s, axis=0, keepdims=True)
+        p = jnp.exp(s - m)
+        w = p / jnp.sum(p, axis=0, keepdims=True)       # (S, 1) fp32
+        v_h = v_ref[0, hi, :, :].astype(jnp.float32)    # (S, D)
+        o = jnp.sum(v_h * w, axis=0, keepdims=True)     # (1, D)
+        o_ref[0, hi] = jnp.broadcast_to(
+            o, o_ref.shape[2:]
+        ).astype(o_ref.dtype)
+
+
+#: fp32 temporaries per cache element in the decode kernel (k cast + the
+#: multiply intermediate + v cast, roughly), used to pick the head block.
+_DECODE_TEMP_BYTES_PER_ELEM = 24
+_DECODE_VMEM_BUDGET = 12 * 2**20
+
+
+def _pick_decode_head_block(h: int, s: int, d: int) -> int:
+    import os
+
+    o = os.environ.get("DTFT_DECODE_HEAD_BLOCK")  # on-chip sweep override
+    if o:
+        n = int(o)
+        if n > 0 and h % n == 0:
+            return n
+        import sys
+
+        print(f"decode_attention: DTFT_DECODE_HEAD_BLOCK={o} invalid for "
+              f"{h} heads; using the auto-picked block", file=sys.stderr)
+    for hb in (8, 6, 4, 3, 2, 1):
+        if h % hb == 0 and hb * s * d * _DECODE_TEMP_BYTES_PER_ELEM \
+                <= _DECODE_VMEM_BUDGET:
+            return hb
+    return 1
+
+
+def _pallas_decode_attention(q, cached_k, cached_v, valid, *, interpret):
+    """Single-token decode attention over the (B, H, S, D) cache.
+
+    ``q`` (B, 1, H, D); ``valid`` (1, S) int32 (1 = attend).  Returns
+    (B, 1, H, D).  Grid (B, H/hb): each step streams hb heads' K/V.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, _, h, d = q.shape
+    s = cached_k.shape[2]
+    hb = _pick_decode_head_block(h, s, d)
+    mem = pl.ANY if interpret else pltpu.VMEM
+    q8 = jnp.broadcast_to(
+        q.transpose(0, 2, 1, 3), (b, h, 8, d)
+    )  # (B, H, 8, D): 8-deep sublane broadcast (see kernel note)
+    out = pl.pallas_call(
+        functools.partial(_decode_attn_kernel, scale=1.0 / (d ** 0.5)),
+        grid=(b, h // hb),
+        in_specs=[
+            pl.BlockSpec((1, hb, 8, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=mem),
+            pl.BlockSpec((1, hb, s, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=mem),
+            pl.BlockSpec((1, hb, s, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=mem),
+            pl.BlockSpec((1, s), lambda i, j: (0, 0), memory_space=mem),
+        ],
+        out_specs=pl.BlockSpec((1, hb, 8, d), lambda i, j: (i, j, 0, 0),
+                               memory_space=mem),
+        out_shape=jax.ShapeDtypeStruct((b, h, 8, d), q.dtype),
+        interpret=interpret,
+    )(q8, cached_k, cached_v, valid)
+    return out[:, :, 0, :][:, None, :, :]  # (B, 1, H, D)
 
 
 def xla_attention(q, k, v, *, mask=None, causal=False):
