@@ -189,6 +189,39 @@ def _masked_scores(q, k, qi, kj, *, scale, block_q, block_k, causal,
     return s
 
 
+def _straddles_diagonal(qi, kj, block_q, block_k):
+    """Traced scalar: does this running (q-block, k-block) pair cross the
+    causal diagonal?  A running pair that does NOT (its last k position
+    <= its first q position) is fully visible, so the per-element iota/
+    compare/select causal passes are pure VPU waste — at 8 blocks per
+    axis only 8 of the 36 running pairs straddle.  Callers split the
+    step body on this scalar with ``pl.when`` so the off-diagonal
+    majority skips the masking entirely."""
+    return kj * block_k + block_k - 1 > qi * block_q
+
+
+def _causal_step_split(qi, kj, run, *, block_q, block_k, causal, step):
+    """Run ``step(apply_causal)`` under the diagonal split.
+
+    ``step`` is the kernel body parameterized on whether the causal mask
+    passes are emitted; identical numerics either way (skipping is only
+    legal for fully-visible pairs).  Non-causal kernels keep the single
+    unmasked body (``run`` is the Python literal True there — every
+    block pair runs)."""
+    if not causal:
+        step(False)
+        return
+    diag = _straddles_diagonal(qi, kj, block_q, block_k)
+
+    @pl.when(run & diag)
+    def _():
+        step(True)
+
+    @pl.when(run & jnp.logical_not(diag))
+    def _():
+        step(False)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, block_q, block_k, causal,
                 have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None):
@@ -212,14 +245,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # nothing — skip its matmuls entirely (halves causal FLOPs).
     run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_causal):
         q = q_ref[0, 0, :, :]  # (block_q, D)
         k = k_ref[0, 0, :, :]  # (block_k, D)
         v = v_ref[0, 0, :, :]  # (block_k, D)
         s = _masked_scores(
             q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
         )
         m_prev = m_scr[:, :1]  # (block_q, 1)
@@ -235,6 +267,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[:, :] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:, :] = jnp.broadcast_to(l_new, l_scr.shape)
 
+    _causal_step_split(qi, kj, run, block_q=block_q, block_k=block_k,
+                       causal=causal, step=_step)
+
     @pl.when(kj == n_k - 1)
     def _finalize():
         # l is always > 0: even a fully-masked row has p = exp(NEG_INF -
@@ -245,6 +280,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0, 0, pl.ds(qi * block_q, block_q)] = (
             m_scr[:, 0] + jnp.log(l_scr[:, 0])
         )
+
+
+def _fwd_kernel_1k(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
+                   block_k, causal, have_mask, mask_ref=None,
+                   qseg_ref=None, kseg_ref=None):
+    """Single-k-block forward: the softmax in one pass, no online state.
+
+    When the whole K/V sequence fits one k block (the seq<=1024 headline
+    regime under the 1024x1024 retune, where the kernel is VPU-bound —
+    docs/LM_PERF.md), the online-softmax recurrence degenerates to a
+    plain row softmax: the m/l/acc scratch buffers, their init pass, the
+    alpha rescale of the accumulator, and the (block_q, 128) broadcast
+    writes are all dead work this kernel simply does not emit.  Same
+    reduction order and masked-row semantics as :func:`_fwd_kernel` with
+    n_k == 1 (a fully-masked row averages V, l = exp(0)*block_k > 0), so
+    outputs are bit-identical.
+    """
+    qi = pl.program_id(2)
+    # With K spanning the sequence, every causal q block straddles the
+    # diagonal — no point splitting on it (see _causal_step_split).
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    s = _masked_scores(
+        q, k, qi, 0, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+        qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+    )
+    m = jnp.max(s, axis=-1, keepdims=True)       # (block_q, 1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0, 0, :, :] = (pv / l).astype(o_ref.dtype)
+    lse_ref[0, 0, 0, pl.ds(qi * block_q, block_q)] = (
+        m[:, 0] + jnp.log(l[:, 0])
+    )
 
 
 def _extra_specs_and_args(mask, segment_ids, batch, seq, block_q, block_k,
@@ -321,8 +395,9 @@ def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
         mask, segment_ids, batch, seq, block_q, block_k, mem,
         kv_segment_ids=kv_segment_ids,
     )
+    one_k = seq // block_k == 1
     kernel = _wrap_kernel(
-        _fwd_kernel, 3, extra_names,
+        _fwd_kernel_1k if one_k else _fwd_kernel, 3, extra_names,
         scale=scale, block_q=block_q, block_k=block_k, causal=causal,
     )
 
@@ -341,7 +416,7 @@ def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
             jax.ShapeDtypeStruct(qt.shape, q.dtype),
             jax.ShapeDtypeStruct((batch, heads, 1, seq), jnp.float32),
         ],
-        scratch_shapes=[
+        scratch_shapes=[] if one_k else [
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
             pltpu.VMEM((block_q, 128), jnp.float32),  # running sum l
             pltpu.VMEM((block_q, depth), jnp.float32),  # output accumulator
@@ -409,15 +484,14 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
     run = (not causal) or (j * block_k <= i * block_q + block_q - 1)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_causal):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         gq = g_ref[0, 0, :, :]
         s = _masked_scores(
             q, k, i, j, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
         )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
@@ -431,16 +505,19 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         delta = delta_ref[0, 0, 0, :]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_scr[:, :] = dk_scr[:, :] + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_k, D)
         row = pl.ds(i * block_q, block_q)
         dq_all_scr[row] = dq_all_scr[row] + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, D)
+
+    _causal_step_split(i, j, run, block_q=block_q, block_k=block_k,
+                       causal=causal, step=_step)
 
     # Unconditional writes: see the docstring on flush semantics.
     dq_ref[0, 0, :, :] = dq_all_scr[pl.ds(i * block_q, block_q)].astype(
@@ -474,15 +551,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
 
     run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_causal):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         gq = g_ref[0, 0, :, :]
         s = _masked_scores(
             q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
         )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
@@ -497,6 +573,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    _causal_step_split(qi, kj, run, block_q=block_q, block_k=block_k,
+                       causal=causal, step=_step)
 
     @pl.when(kj == n_k - 1)
     def _finalize():
@@ -525,15 +604,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     # attends to this k-block.
     run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_causal):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         gq = g_ref[0, 0, :, :]
         s = _masked_scores(
             q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
         )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
@@ -552,6 +630,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_k, D)
+
+    _causal_step_split(qi, kj, run, block_q=block_q, block_k=block_k,
+                       causal=causal, step=_step)
 
     @pl.when(qi == n_q - 1)
     def _finalize():

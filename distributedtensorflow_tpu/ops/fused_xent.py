@@ -194,8 +194,15 @@ def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, c_ref, dx_ref, acc_sc,
     match = row == t_ref[...]
     dlog = c_ref[...] * (p - match.astype(jnp.float32))
     # dx_i += sum_j dlogits_ji * wte_j : contract the vocab sublanes.
+    # dlog drops to the operand compute dtype (bf16 in training) so the
+    # matmul runs native MXU passes instead of the ~4x-slower fp32
+    # emulation — profiled at 46% MXU with the old fp32 operands
+    # (docs/LM_PERF.md round-4 anatomy); accumulation stays fp32.  This
+    # matches standard mixed-precision (dlogits are bf16 wherever logits
+    # are), and bf16's fp32-sized exponent keeps the tiny c*(p-match)
+    # magnitudes exact in scale.  fp32 operands are left untouched.
     acc_sc[...] += jax.lax.dot_general(
-        dlog, w_ref[...].astype(jnp.float32), (((0,), (0,)), ((), ())),
+        dlog.astype(w_ref.dtype), w_ref[...], (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -218,9 +225,10 @@ def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, c_ref, dw_ref,
     dlog = c_ref[...] * (p - match.astype(jnp.float32))
     # dwte_j += sum_i dlogits_ji * x_i : contract the token lanes.  The
     # output block's index depends only on j (outer), so the accumulation
-    # target stays resident across the whole inner sweep.
+    # target stays resident across the whole inner sweep.  dlog in the
+    # compute dtype for the same native-MXU reason as the dx kernel.
     part = jax.lax.dot_general(
-        dlog, x_ref[...].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        dlog.astype(x_ref.dtype), x_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
