@@ -119,8 +119,28 @@ def cached_attention_with_vars(module: nn.Module, q, k, v,
     return out
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding, (B, S, H, D) with D even; fp32 trig, cast back.
+def rope_tables(
+    positions: jax.Array, d: int, theta: float, dtype
+) -> tuple[jax.Array, jax.Array]:
+    """Sign-folded (B, S, 1, D) cos/sin tables for :func:`rope`.
+
+    Split out so the trunk can compute the trig ONCE per step and share
+    the tables across every layer's q and k rotation (2 x num_layers
+    calls otherwise; under block remat each call is also recomputed in
+    the backward, whereas hoisted tables are saved residuals).  Trig in
+    fp32, then cast to the compute ``dtype`` the combine runs at."""
+    d_half = d // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # (B, S, Dh)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    cos_f = jnp.concatenate([cos, cos], axis=-1)[:, :, None, :]
+    sin_f = jnp.concatenate([-sin, sin], axis=-1)[:, :, None, :]
+    return cos_f.astype(dtype), sin_f.astype(dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         tables: tuple[jax.Array, jax.Array] | None = None) -> jax.Array:
+    """Rotary embedding, (B, S, H, D) with D even.
 
     Lane-friendly formulation (2026-08-01 retune): the textbook
     ``split -> 4 muls on (…, D/2) -> concat`` form cost ~31 ms/step in
@@ -130,14 +150,19 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     (16,1024,12,32)).  Folding the signs into a full-width sin pattern
     turns it into ONE half-swap relayout plus two muls and an add at
     full D width; per-element arithmetic is bit-identical
-    (x1*cos + x2*(-sin) == x1*cos - x2*sin in IEEE fp)."""
+    (x1*cos + x2*(-sin) == x1*cos - x2*sin in IEEE fp).
+
+    The combine runs in ``x.dtype`` (round-4 retune): upcasting the
+    already-bf16-rounded x to fp32 doubled the elementwise byte traffic
+    for one extra rounding's worth of precision that the final
+    cast-back discarded anyway.  fp32 inputs keep fully-fp32 math.
+    ``tables`` are the precomputed :func:`rope_tables` (cast here if
+    their dtype differs from x)."""
     d = x.shape[-1]
     d_half = d // 2
-    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
-    angles = positions[:, :, None].astype(jnp.float32) * freqs  # (B, S, Dh)
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
-    cos_f = jnp.concatenate([cos, cos], axis=-1)[:, :, None, :]
-    sin_f = jnp.concatenate([-sin, sin], axis=-1)[:, :, None, :]
+    if tables is None:
+        tables = rope_tables(positions, d, theta, x.dtype)
+    cos_f, sin_f = (t.astype(x.dtype) for t in tables)
     # Half-swap via a constant permutation matmul: the MXU moves the
     # halves (exact — R is 0/1), the VPU never runs a sub-lane relayout.
     r = jnp.block([
@@ -146,9 +171,8 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
         [jnp.eye(d_half, dtype=x.dtype),
          jnp.zeros((d_half, d_half), x.dtype)],
     ])  # x @ r == concat([x2, x1])
-    xf = x.astype(jnp.float32)
-    x_rot = jnp.einsum("bshd,de->bshe", x, r).astype(jnp.float32)
-    return (xf * cos_f + x_rot * sin_f).astype(x.dtype)
+    x_rot = jnp.einsum("bshd,de->bshe", x, r)
+    return x * cos_f + x_rot * sin_f
 
 
 class CausalSelfAttention(nn.Module):
@@ -157,7 +181,7 @@ class CausalSelfAttention(nn.Module):
     decode: bool = False  # KV-cache incremental decoding (serving path)
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool):
+    def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
         # Fused QKV projection: one large MXU matmul (column-parallel under
@@ -168,8 +192,8 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (*x.shape[:2], cfg.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        q = rope(q, positions, cfg.rope_theta, rope_tabs)
+        k = rope(k, positions, cfg.rope_theta, rope_tabs)
         if self.decode:
             if self.attn_fn is not None:
                 raise ValueError(
@@ -202,17 +226,18 @@ class GPTBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool):
+    def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
         attn_cls = CausalSelfAttention
         if cfg.remat_attn and not self.decode and not self.is_initializing():
             # static_argnums counts __call__'s args including self:
-            # deterministic is index 3 (same convention as the block remat).
+            # deterministic is index 3 (same convention as the block remat;
+            # rope_tabs at 4 is a traced array input, NOT static).
             attn_cls = nn.remat(CausalSelfAttention, static_argnums=(3,))
         x = x + attn_cls(
             cfg, self.attn_fn, self.decode, name="attn"
-        )(h, positions, deterministic)
+        )(h, positions, deterministic, rope_tabs)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
         # Column- then row-parallel MLP (Megatron split over `model`).
         fc_in = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
@@ -273,6 +298,13 @@ class GPTLM(nn.Module):
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1]), input_ids.shape
             )
+        # One trig computation per step, shared by every layer's q and k
+        # rotation (and saved as a residual under remat instead of being
+        # recomputed per block in the backward).
+        rope_tabs = rope_tables(
+            positions, cfg.hidden_size // cfg.num_heads, cfg.rope_theta,
+            cfg.dtype,
+        )
         block = GPTBlock
         if cfg.remat and not self.decode:
             # Remat each block: activations recomputed in backward — the
@@ -283,7 +315,7 @@ class GPTLM(nn.Module):
             block = nn.remat(GPTBlock, static_argnums=(3,))
         for i in range(cfg.num_layers):
             x = block(cfg, self.attn_fn, self.decode, name=f"h{i}")(
-                x, positions, deterministic
+                x, positions, deterministic, rope_tabs
             )
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:

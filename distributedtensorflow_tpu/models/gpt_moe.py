@@ -25,7 +25,8 @@ from jax.sharding import Mesh
 
 from ..parallel.moe import local_moe
 from ..parallel.sharding import LayoutMap
-from .gpt import CausalSelfAttention, GPTBlock, GPTConfig, gpt_layout
+from .gpt import (CausalSelfAttention, GPTBlock, GPTConfig, gpt_layout,
+                  rope_tables)
 
 PyTree = Any
 #: (tokens (T, d), router_kernel (d, E), expert_params, token_mask (T,)
@@ -120,7 +121,7 @@ class MoEGPTBlock(nn.Module):
     moe_fn: MoEFn | None = None
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool):
+    def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
         attn_cls = CausalSelfAttention
@@ -128,7 +129,7 @@ class MoEGPTBlock(nn.Module):
             # same convention as gpt.GPTBlock: attention-only checkpoint
             attn_cls = nn.remat(CausalSelfAttention, static_argnums=(3,))
         x = x + attn_cls(cfg, None, False, name="attn")(
-            h, positions, deterministic
+            h, positions, deterministic, rope_tabs
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
         m, aux = MoEMLP(cfg, self.moe_fn, name="moe_mlp")(h)
@@ -166,6 +167,10 @@ class GPTMoELM(nn.Module):
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1]), input_ids.shape
         )
+        rope_tabs = rope_tables(
+            positions, cfg.hidden_size // cfg.num_heads, cfg.rope_theta,
+            cfg.dtype,
+        )
         aux_total = jnp.zeros((), jnp.float32)
         dense_block = GPTBlock
         moe_block = MoEGPTBlock
@@ -176,12 +181,12 @@ class GPTMoELM(nn.Module):
             # layer k-1, 2k-1, ... are MoE (last of each group of k)
             if (i + 1) % cfg.moe_every_k == 0:
                 x, aux = moe_block(cfg, self.moe_fn, name=f"h{i}")(
-                    x, positions, deterministic
+                    x, positions, deterministic, rope_tabs
                 )
                 aux_total = aux_total + aux
             else:
                 x = dense_block(cfg, None, False, name=f"h{i}")(
-                    x, positions, deterministic
+                    x, positions, deterministic, rope_tabs
                 )
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:

@@ -372,16 +372,30 @@ def _wrap_kernel(inner, n_fixed_in, extra_names, **kw):
 
 def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
                    causal, interpret):
-    batch, seq, heads, depth = q.shape
+    # Mosaic needs the trailing two block dims tile-aligned or full-size:
+    # run the kernel in BHSD so (seq, depth) are the trailing dims.
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    o, lse, _ = _flash_forward_bhsd(qt, kt, vt, mask, segment_ids,
+                                    kv_segment_ids, causal=causal,
+                                    interpret=interpret)
+    return o, lse
+
+
+def _flash_forward_bhsd(qt, kt, vt, mask, segment_ids, kv_segment_ids=None,
+                        *, causal, interpret):
+    """Forward on already-BHSD operands; returns (o BSHD, lse, o BHSD).
+
+    The BHSD output is handed back so the custom VJP can save the
+    transposed operands as residuals — the backward kernels consume
+    BHSD, and re-deriving it there from BSHD residuals would re-emit
+    the relayouts the forward already paid for."""
+    batch, heads, seq, depth = qt.shape
     block_q = _pick_block_q(seq)
     block_k = _pick_block_k(seq)
     scale = 1.0 / (depth ** 0.5)
     grid = (batch, heads, seq // block_q, seq // block_k)
     mem = pl.ANY if interpret else pltpu.VMEM
-
-    # Mosaic needs the trailing two block dims tile-aligned or full-size:
-    # run the kernel in BHSD so (seq, depth) are the trailing dims.
-    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
 
     qspec = pl.BlockSpec(
         (1, 1, block_q, depth), lambda b, h, i, j: (b, h, i, 0),
@@ -413,7 +427,7 @@ def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
                          memory_space=mem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct(qt.shape, qt.dtype),
             jax.ShapeDtypeStruct((batch, heads, 1, seq), jnp.float32),
         ],
         scratch_shapes=[] if one_k else [
@@ -423,7 +437,7 @@ def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
         ],
         interpret=interpret,
     )(qt, kt, vt, *extra_args)
-    return o.transpose(0, 2, 1, 3), lse[:, :, 0, :]
+    return o.transpose(0, 2, 1, 3), lse[:, :, 0, :], o
 
 
 # --- Backward: Pallas kernels (fused single sweep, or dq + dkv split) -------
@@ -641,15 +655,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False):
-    q, k, v, mask, segment_ids, o, lse = res
+    """Backward from the custom-VJP residuals (BHSD operands + BHSD o)."""
+    qt, kt, vt, mask, segment_ids, ot, lse = res
+    gt = g.transpose(0, 2, 1, 3)
     # delta = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it.
     delta = jnp.einsum(
-        "bqhd,bqhd->bhq", g.astype(jnp.float32), o.astype(jnp.float32)
+        "bhqd,bhqd->bhq", gt.astype(jnp.float32), ot.astype(jnp.float32)
     )
-    return _flash_backward_pallas_core(
-        q, k, v, mask, g, lse, delta, segment_ids=segment_ids,
+    dqt, dkt, dvt = _flash_backward_pallas_bhsd(
+        qt, kt, vt, gt, mask, lse, delta, segment_ids=segment_ids,
         causal=causal, interpret=interpret, force_split=force_split
     )
+    bsdh = lambda x: x.transpose(0, 2, 1, 3)
+    return bsdh(dqt), bsdh(dkt), bsdh(dvt)
 
 
 def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
@@ -657,15 +675,30 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
                                 causal, interpret, force_split=False):
     """dq/dk/dv kernels from externally-supplied LSE and delta rows.
 
-    Split out so ring attention (``parallel/ring_attention.py``) can drive
-    the same kernels per K/V chunk with the *global* (cross-chunk) LSE.
-    ``lse``/``delta`` are (B, H, S) fp32.
+    BSHD entry kept for ring attention (``parallel/ring_attention.py``),
+    which drives the same kernels per K/V chunk with the *global*
+    (cross-chunk) LSE.  ``lse``/``delta`` are (B, H, S) fp32.
+    """
+    qt, kt, vt, gt = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
+    dqt, dkt, dvt = _flash_backward_pallas_bhsd(
+        qt, kt, vt, gt, mask, lse, delta, segment_ids=segment_ids,
+        kv_segment_ids=kv_segment_ids, causal=causal, interpret=interpret,
+        force_split=force_split,
+    )
+    bsdh = lambda x: x.transpose(0, 2, 1, 3)
+    return bsdh(dqt), bsdh(dkt), bsdh(dvt)
+
+
+def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
+                                segment_ids=None, kv_segment_ids=None,
+                                causal, interpret, force_split=False):
+    """The dq/dk/dv kernels on BHSD operands; grads returned BHSD.
 
     Dispatch: the fused single-sweep kernel (one p-recompute) when the
     (S, D) fp32 dq scratch fits ``FUSED_BWD_DQ_SCRATCH_BYTES``, else —
     or under ``force_split`` — the original dq + dkv pair.
     """
-    batch, seq, heads, depth = q.shape
+    batch, heads, seq, depth = qt.shape
     block_q = _pick_block_q(seq)
     block_k = _pick_block_k(seq)
     scale = 1.0 / (depth ** 0.5)
@@ -674,8 +707,6 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
     # (B, H, 1, S) keeps kernel blocks' trailing dims tile-legal like lse.
     delta = delta[:, :, None, :]
     lse4 = lse[:, :, None, :]  # (B, H, 1, S)
-
-    qt, kt, vt, gt = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
 
     if not force_split and seq * depth * 4 <= FUSED_BWD_DQ_SCRATCH_BYTES:
         fused_specs = [
@@ -722,9 +753,9 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
                              memory_space=mem),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct(qt.shape, q.dtype),
-                jax.ShapeDtypeStruct(kt.shape, k.dtype),
-                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+                jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+                jax.ShapeDtypeStruct(kt.shape, kt.dtype),
+                jax.ShapeDtypeStruct(vt.shape, vt.dtype),
             ],
             scratch_shapes=[
                 pltpu.VMEM((seq, depth), jnp.float32),     # dq, whole (b,h)
@@ -733,11 +764,7 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
             ],
             interpret=interpret,
         )(qt, kt, vt, gt, lse4, delta, *extra_args)
-        return (
-            dqt.transpose(0, 2, 1, 3),
-            dkt.transpose(0, 2, 1, 3),
-            dvt.transpose(0, 2, 1, 3),
-        )
+        return dqt, dkt, dvt
 
     # --- dq kernel: grid (B, H, n_q, n_k), k innermost ---
     dq_in_specs = [
@@ -772,7 +799,7 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
         out_specs=pl.BlockSpec((1, 1, block_q, depth),
                                lambda b, h, i, j: (b, h, i, 0),
                                memory_space=mem),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, qt.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, depth), jnp.float32)],
         interpret=interpret,
     )(*dq_args)
@@ -814,8 +841,8 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
                          lambda b, h, j, i: (b, h, j, 0), memory_space=mem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(kt.shape, k.dtype),
-            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+            jax.ShapeDtypeStruct(kt.shape, kt.dtype),
+            jax.ShapeDtypeStruct(vt.shape, vt.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, depth), jnp.float32),
@@ -824,8 +851,7 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
         interpret=interpret,
     )(*dkv_args)
 
-    bsdh = lambda x: x.transpose(0, 2, 1, 3)
-    return bsdh(dqt), bsdh(dkt), bsdh(dvt)
+    return dqt, dkt, dvt
 
 
 # --- Backward (blockwise XLA recompute from LSE — golden fallback) ----------
@@ -913,9 +939,14 @@ def _flash(q, k, v, mask, segment_ids, causal, interpret, backward_impl):
 
 
 def _flash_fwd(q, k, v, mask, segment_ids, causal, interpret, backward_impl):
-    o, lse = _flash_forward(q, k, v, mask, segment_ids, causal=causal,
-                            interpret=interpret)
-    return o, (q, k, v, mask, segment_ids, o, lse)
+    # Residuals are saved in the BHSD layout the kernels consume: the
+    # forward already paid for these relayouts, and saving the BSHD
+    # originals instead would make the backward re-emit all four
+    # (profiled at ~6 ms/step of pure transposes, docs/LM_PERF.md).
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o, lse, ot = _flash_forward_bhsd(qt, kt, vt, mask, segment_ids,
+                                     causal=causal, interpret=interpret)
+    return o, (qt, kt, vt, mask, segment_ids, ot, lse)
 
 
 def _flash_bwd(causal, interpret, backward_impl, res, g):
@@ -926,7 +957,11 @@ def _flash_bwd(causal, interpret, backward_impl, res, g):
             force_split=(impl == "pallas_split"),
         )
     else:
-        dq, dk, dv = _flash_backward_xla(res, g, causal=causal)
+        qt, kt, vt, mask, segment_ids, ot, lse = res
+        q, k, v, o = (t.transpose(0, 2, 1, 3) for t in (qt, kt, vt, ot))
+        dq, dk, dv = _flash_backward_xla(
+            (q, k, v, mask, segment_ids, o, lse), g, causal=causal
+        )
     return dq, dk, dv, None, None
 
 
