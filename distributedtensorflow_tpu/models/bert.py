@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.sharding import LayoutMap
+from .layers import FusedLayerNorm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,7 +78,7 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, mask, deterministic: bool, segment_ids=None):
         cfg = self.cfg
-        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        ln = lambda name: FusedLayerNorm(out_dtype=jnp.float32, name=name)
         attn_out = SelfAttention(cfg, name="attention")(
             x, mask, deterministic, segment_ids
         )
@@ -122,7 +123,7 @@ class BertEncoder(nn.Module):
         if token_type_ids is not None:
             x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
                              dtype=cfg.dtype, name="type_embed")(token_type_ids)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x)
+        x = FusedLayerNorm(out_dtype=jnp.float32, name="ln_embed")(x)
         if not deterministic:
             x = nn.Dropout(cfg.dropout_rate)(x, deterministic=False)
         mask = None
@@ -150,7 +151,7 @@ def mlm_head(cfg: BertConfig, x, masked_positions=None):
         x = jnp.take_along_axis(x, masked_positions[..., None], axis=1)
     x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
     x = nn.gelu(x)
-    x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
+    x = FusedLayerNorm(out_dtype=jnp.float32, name="mlm_ln")(x)
     return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_out")(x)
 
 

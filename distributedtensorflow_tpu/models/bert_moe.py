@@ -29,6 +29,7 @@ from jax.sharding import Mesh
 
 from ..parallel.moe import bind_expert_parallel_model, with_moe_layout
 from ..parallel.sharding import LayoutMap
+from .layers import FusedLayerNorm
 from .bert import (
     BertConfig,
     BertEncoder,
@@ -79,7 +80,7 @@ class MoETransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, mask, deterministic: bool, segment_ids=None):
         cfg = self.cfg
-        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        ln = lambda name: FusedLayerNorm(out_dtype=jnp.float32, name=name)
         attn_out = SelfAttention(cfg, name="attention")(
             x, mask, deterministic, segment_ids
         )

@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.sharding import LayoutMap
+from .layers import FusedLayerNorm
 
 AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
@@ -228,7 +229,7 @@ class GPTBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
         cfg = self.cfg
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        h = FusedLayerNorm(name="ln1")(x)
         attn_cls = CausalSelfAttention
         if cfg.remat_attn and not self.decode and not self.is_initializing():
             # static_argnums counts __call__'s args including self:
@@ -238,7 +239,7 @@ class GPTBlock(nn.Module):
         x = x + attn_cls(
             cfg, self.attn_fn, self.decode, name="attn"
         )(h, positions, deterministic, rope_tabs)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        h = FusedLayerNorm(name="ln2")(x)
         # Column- then row-parallel MLP (Megatron split over `model`).
         fc_in = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                          use_bias=False, name="fc_in")
@@ -317,7 +318,7 @@ class GPTLM(nn.Module):
             x = block(cfg, self.attn_fn, self.decode, name=f"h{i}")(
                 x, positions, deterministic, rope_tabs
             )
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = FusedLayerNorm(out_dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:
             # Loss-side chunked head (ops/xent.py): the caller applies the
             # tied embedding per token chunk so full-vocab logits never

@@ -27,6 +27,7 @@ from ..parallel.moe import local_moe
 from ..parallel.sharding import LayoutMap
 from .gpt import (CausalSelfAttention, GPTBlock, GPTConfig, gpt_layout,
                   rope_tables)
+from .layers import FusedLayerNorm
 
 PyTree = Any
 #: (tokens (T, d), router_kernel (d, E), expert_params, token_mask (T,)
@@ -123,7 +124,7 @@ class MoEGPTBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
         cfg = self.cfg
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        h = FusedLayerNorm(name="ln1")(x)
         attn_cls = CausalSelfAttention
         if cfg.remat_attn and not self.is_initializing():
             # same convention as gpt.GPTBlock: attention-only checkpoint
@@ -131,7 +132,7 @@ class MoEGPTBlock(nn.Module):
         x = x + attn_cls(cfg, None, False, name="attn")(
             h, positions, deterministic, rope_tabs
         )
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        h = FusedLayerNorm(name="ln2")(x)
         m, aux = MoEMLP(cfg, self.moe_fn, name="moe_mlp")(h)
         return x + m, aux
 
@@ -188,7 +189,7 @@ class GPTMoELM(nn.Module):
                 x = dense_block(cfg, None, False, name=f"h{i}")(
                     x, positions, deterministic, rope_tabs
                 )
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = FusedLayerNorm(out_dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:
             return x, aux_total  # loss applies the chunked head (ops/xent)
         from ..ops.xent import tied_head_logits

@@ -41,7 +41,8 @@ from ..parallel.pipeline import (
     gpipe_bubble_fraction,
     pipeline_apply,
 )
-from .gpt import GPTBlock, GPTConfig
+from .gpt import GPTBlock, GPTConfig, rope_tables
+from .layers import FusedLayerNorm
 
 PyTree = Any
 
@@ -158,7 +159,7 @@ class PipelinedGPT:
             )
         else:
             self._apply_block = self._block
-        self._ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self._ln_f = FusedLayerNorm(out_dtype=jnp.float32, name="ln_f")
         self._region = None  # jitted pipeline region, built on first apply
 
     # --- init ---------------------------------------------------------------
@@ -259,13 +260,20 @@ class PipelinedGPT:
             positions = jnp.broadcast_to(
                 jnp.arange(x.shape[1]), x.shape[:2]
             )
+        # Trig once per stage, shared across the layer scan (and saved as
+        # a residual under remat) — same hoist as GPTLM's trunk.
+        cfg = self.cfg
+        rope_tabs = rope_tables(
+            positions, cfg.hidden_size // cfg.num_heads, cfg.rope_theta,
+            cfg.dtype,
+        )
 
         def one(x, layer_params):
             # fp32 across the schedule, cfg.dtype inside the block (the
             # block's pre-LN casts do the rest)
             y = self._apply_block.apply(
                 {"params": layer_params}, x.astype(self.cfg.dtype),
-                positions, True,
+                positions, True, rope_tabs,
             )
             return y.astype(jnp.float32), None
 

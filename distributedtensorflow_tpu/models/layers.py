@@ -1,0 +1,34 @@
+"""Shared flax layers for the model zoo."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.layernorm import layer_norm
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in for ``nn.LayerNorm(dtype=float32)`` + output cast.
+
+    Same parameter tree ("scale", "bias", both fp32, shape (D,)) so
+    checkpoints written against the flax module restore unchanged; the
+    computation routes through ``ops.layernorm.layer_norm`` (one-pass
+    Pallas kernel on TPU, XLA reference elsewhere — identical fp32-stats
+    semantics on both paths).
+
+    ``out_dtype=None`` returns the input dtype (the pre-LN trunk case,
+    replacing ``nn.LayerNorm(dtype=f32)(x).astype(cfg.dtype)``); pass
+    ``jnp.float32`` for a final LN feeding an fp32 head.
+    """
+
+    epsilon: float = 1e-6  # flax nn.LayerNorm default (drop-in)
+    out_dtype: jnp.dtype | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (d,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (d,), jnp.float32)
+        return layer_norm(x, scale, bias, eps=self.epsilon,
+                          out_dtype=self.out_dtype or x.dtype)

@@ -20,6 +20,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.sharding import LayoutMap
+from .layers import FusedLayerNorm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,7 +55,7 @@ class ViTBlock(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        h = FusedLayerNorm(name="ln1")(x)
         # Fused QKV as one (D, 3H) matmul, like the GPT blocks: the flat 3H
         # output dim shards over `model` for any tp dividing 3*hidden (the
         # per-head layout would require tp | num_heads — ViT-S has 6).
@@ -70,7 +71,7 @@ class ViTBlock(nn.Module):
             cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="proj"
         )(attn)
         x = x + attn
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        h = FusedLayerNorm(name="ln2")(x)
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                      use_bias=False, name="fc_in")(h)
         h = nn.gelu(h)
@@ -111,7 +112,7 @@ class ViT(nn.Module):
         x = x + pos.astype(cfg.dtype)
         for i in range(cfg.num_layers):
             x = ViTBlock(cfg, name=f"block_{i}")(x, deterministic=not train)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = FusedLayerNorm(out_dtype=jnp.float32, name="ln_f")(x)
         x = jnp.mean(x, axis=1)  # global average pool (no cls token)
         return nn.Dense(
             cfg.num_classes, dtype=jnp.float32, name="head"

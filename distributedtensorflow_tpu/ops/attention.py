@@ -103,7 +103,7 @@ def cached_decode_attention(
     # there would serve real traffic at Python speed.
     platform = jax.devices()[0].platform
     if (s_new == 1 and platform in ("tpu", "axon", "cpu")
-            and max_seq * d * _DECODE_TEMP_BYTES_PER_ELEM
+            and max_seq * d * _decode_bytes_per_elem(cached_k.dtype.itemsize)
             <= _DECODE_VMEM_BUDGET):
         out = _pallas_decode_attention(
             q, cached_k, cached_v, valid.astype(jnp.int32),
@@ -131,45 +131,60 @@ def _decode_attn_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, scale):
     (see :func:`cached_decode_attention`).  This kernel fuses
     scores -> masked softmax -> weighted-V for ``hb`` heads per grid
     step over (hb, S, D) K/V tiles: the only HBM traffic is one read of
-    each.  ``hb`` balances DMA latency (few big tiles) against the
-    ~24 bytes/element of fp32 temporaries that must fit the 16 MB VMEM
-    stack (hb = all 12 GPT-small heads spilled and ran at XLA speed).
+    each.
+
+    Lane-major formulation (round-4 rework of the first measured kernel):
+    the original computed per-head scores as an (S, 1) COLUMN — every
+    softmax/mask pass used 1 of 128 lanes, and the score and weighted-V
+    contractions ran as VPU multiply+lane-reduce over fp32-cast (S, D)
+    tiles, which is exactly the "half-empty lanes and per-head softmax
+    passes" gap its 7.3 ms measurement recorded.  Here both contractions
+    are MXU dot_generals on the native-dtype tiles (fp32 accumulation)
+    and every elementwise temporary is a lane-major (8, S) row tile —
+    the 8 sublanes carry the q broadcast the block layout ships anyway,
+    so each pass is 8 full vregs instead of 128 nearly-empty ones, and
+    the (S, D) fp32 cast passes disappear entirely.
     """
     # q/out ride with an 8-deep broadcast sublane dim — (1, hb, 8, d)
     # blocks keep the head block on an UNTILED leading dim, so any hb is
     # tile-legal (a (hb, d) trailing block is only legal for hb % 8 == 0
     # or hb == H, and Mosaic cannot reshape lanes to sublanes in-kernel;
     # both found on-chip at hb=4).  Same trick as fused_xent's _SUB
-    # scratch.  The head loop is a STATIC unroll: per-head temporaries
-    # are (S, D) fp32 (256 KB at GPT-small) and stay VMEM-resident,
-    # where a whole-block (hb, S, D) fp32 formulation spilled.
+    # scratch.  The head loop is a STATIC unroll.
     hb = k_ref.shape[1]
-    # Reshape the i32 mask BEFORE the bool compare: Mosaic's lane->sublane
-    # reshape only supports 32-bit element types (found on-chip: the i1
-    # form fails with "minor dim ... only supported for 32-bit types").
-    valid_col = valid_ref[...].reshape(-1, 1) != 0   # (S, 1)
+    valid_row = valid_ref[...] != 0                     # (1, S)
     for hi in range(hb):
-        q_h = q_ref[0, hi, :1, :].astype(jnp.float32)   # (1, D)
-        k_h = k_ref[0, hi, :, :].astype(jnp.float32)    # (S, D)
-        s = jnp.sum(k_h * q_h, axis=1, keepdims=True) * scale  # (S, 1)
-        s = jnp.where(valid_col, s, NEG_INF)
-        m = jnp.max(s, axis=0, keepdims=True)
+        q_h = q_ref[0, hi, :, :]                        # (8, D), rows equal
+        k_h = k_ref[0, hi, :, :]                        # (S, D)
+        s = jax.lax.dot_general(
+            q_h, k_h, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # (8, S)
+        s = jnp.where(valid_row, s, NEG_INF)
+        m = jnp.max(s, axis=1, keepdims=True)
         p = jnp.exp(s - m)
-        w = p / jnp.sum(p, axis=0, keepdims=True)       # (S, 1) fp32
-        v_h = v_ref[0, hi, :, :].astype(jnp.float32)    # (S, D)
-        o = jnp.sum(v_h * w, axis=0, keepdims=True)     # (1, D)
-        o_ref[0, hi] = jnp.broadcast_to(
-            o, o_ref.shape[2:]
-        ).astype(o_ref.dtype)
+        w = (p / jnp.sum(p, axis=1, keepdims=True)).astype(v_ref.dtype)
+        o_ref[0, hi] = jax.lax.dot_general(
+            w, v_ref[0, hi, :, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)                           # (8, D), rows equal
 
 
-#: fp32 temporaries per cache element in the decode kernel (k cast + the
-#: multiply intermediate + v cast, roughly), used to pick the head block.
-_DECODE_TEMP_BYTES_PER_ELEM = 24
+def _decode_bytes_per_elem(kv_itemsize: int) -> int:
+    """VMEM bytes per cache element in the decode kernel: the
+    double-buffered K and V blocks (2 operands x 2 buffers x itemsize)
+    plus slack for the small lane-major temporaries.  Scales with the
+    cache dtype — a flat bf16 constant under-counted fp32 caches ~2x
+    and could pick a block over the 16 MB VMEM limit.  The lane-major
+    kernel holds no fp32 (S, D) casts (the old formulation's flat
+    24 B/elem), so more heads fit one grid step."""
+    return 4 * kv_itemsize + 2
+
+
 _DECODE_VMEM_BUDGET = 12 * 2**20
 
 
-def _pick_decode_head_block(h: int, s: int, d: int) -> int:
+def _pick_decode_head_block(h: int, s: int, d: int, kv_itemsize: int) -> int:
     import os
 
     o = os.environ.get("DTFT_DECODE_HEAD_BLOCK")  # on-chip sweep override
@@ -181,8 +196,8 @@ def _pick_decode_head_block(h: int, s: int, d: int) -> int:
 
         print(f"decode_attention: DTFT_DECODE_HEAD_BLOCK={o} invalid for "
               f"{h} heads; using the auto-picked block", file=sys.stderr)
-    for hb in (8, 6, 4, 3, 2, 1):
-        if h % hb == 0 and hb * s * d * _DECODE_TEMP_BYTES_PER_ELEM \
+    for hb in (16, 12, 8, 6, 4, 3, 2, 1):
+        if h % hb == 0 and hb * s * d * _decode_bytes_per_elem(kv_itemsize) \
                 <= _DECODE_VMEM_BUDGET:
             return hb
     return 1
@@ -199,7 +214,7 @@ def _pallas_decode_attention(q, cached_k, cached_v, valid, *, interpret):
 
     b, _, h, d = q.shape
     s = cached_k.shape[2]
-    hb = _pick_decode_head_block(h, s, d)
+    hb = _pick_decode_head_block(h, s, d, cached_k.dtype.itemsize)
     mem = pl.ANY if interpret else pltpu.VMEM
     q8 = jnp.broadcast_to(
         q.transpose(0, 2, 1, 3), (b, h, 8, d)

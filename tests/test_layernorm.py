@@ -1,0 +1,121 @@
+"""Fused Pallas LayerNorm vs the flax/XLA reference.
+
+The kernel must be a drop-in for ``nn.LayerNorm(dtype=float32)`` + cast:
+same values, same gradients (x, scale, bias), for multi-block grids,
+ragged row counts, bf16 and fp32 IO, and custom epsilon.  Runs in Pallas
+interpret mode on the CPU mesh.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.models.layers import FusedLayerNorm
+from distributedtensorflow_tpu.ops.layernorm import layer_norm
+
+
+def _setup(n=48, d=64, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, d)) * 2 + 0.5).astype(dtype)
+    g = jnp.asarray(rng.standard_normal(d) * 0.3 + 1.0, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(d) * 0.1, jnp.float32)
+    return x, g, b
+
+
+def _ref(x, g, b, eps=1e-5, out_dtype=None):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps) * g + b
+    return y.astype(out_dtype or x.dtype)
+
+
+@pytest.mark.parametrize("n,block", [(48, 16), (30, 16), (16, 16)])
+def test_fused_value_matches_reference(n, block, monkeypatch):
+    monkeypatch.setenv("DTFT_LN_BLOCK_TOKENS", str(block))
+    x, g, b = _setup(n=n)
+    got = layer_norm(x, g, b, impl="pallas", interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_ref(x, g, b)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_grads_match_reference(monkeypatch):
+    monkeypatch.setenv("DTFT_LN_BLOCK_TOKENS", "16")
+    x, g, b = _setup(n=40)
+
+    def loss_f(fn):
+        def f(x, g, b):
+            y = fn(x, g, b)
+            w = jnp.arange(y.size, dtype=jnp.float32).reshape(y.shape)
+            return jnp.sum(y.astype(jnp.float32) * w * 1e-3)
+        return f
+
+    fused = loss_f(lambda x, g, b: layer_norm(
+        x, g, b, impl="pallas", interpret=True))
+    ref = loss_f(_ref)
+    got = jax.grad(fused, argnums=(0, 1, 2))(x, g, b)
+    want = jax.grad(ref, argnums=(0, 1, 2))(x, g, b)
+    for gg, ww in zip(got, want):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(ww),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_bf16_io_fp32_stats(monkeypatch):
+    monkeypatch.setenv("DTFT_LN_BLOCK_TOKENS", "16")
+    x, g, b = _setup(n=32, dtype=jnp.bfloat16)
+    got = layer_norm(x, g, b, impl="pallas", interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(_ref(x, g, b), np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_out_dtype_override(monkeypatch):
+    monkeypatch.setenv("DTFT_LN_BLOCK_TOKENS", "16")
+    x, g, b = _setup(n=16, dtype=jnp.bfloat16)
+    got = layer_norm(x, g, b, out_dtype=jnp.float32, impl="pallas",
+                     interpret=True)
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.asarray(_ref(x, g, b, out_dtype=jnp.float32)),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_custom_eps(monkeypatch):
+    monkeypatch.setenv("DTFT_LN_BLOCK_TOKENS", "16")
+    x, g, b = _setup(n=16)
+    got = layer_norm(x, g, b, eps=1e-3, impl="pallas", interpret=True)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_ref(x, g, b, eps=1e-3)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_module_param_tree_matches_flax():
+    """FusedLayerNorm restores checkpoints written by nn.LayerNorm."""
+    x = jnp.ones((2, 8, 32))
+    ours = FusedLayerNorm().init(jax.random.PRNGKey(0), x)["params"]
+    flaxs = nn.LayerNorm(dtype=jnp.float32).init(
+        jax.random.PRNGKey(0), x)["params"]
+    assert jax.tree.structure(ours) == jax.tree.structure(flaxs)
+    assert all(
+        a.shape == b.shape and a.dtype == b.dtype
+        for a, b in zip(jax.tree.leaves(ours), jax.tree.leaves(flaxs))
+    )
+
+
+def test_module_matches_flax_layernorm():
+    """Module output == flax nn.LayerNorm(dtype=f32) -> cast, same params."""
+    x, g, b = _setup(n=24, d=32)
+    x3 = x.reshape(2, 12, 32)
+    params = {"scale": g, "bias": b}
+    got = FusedLayerNorm().apply({"params": params}, x3)
+    want = nn.LayerNorm(dtype=jnp.float32, epsilon=1e-5).apply(
+        {"params": params}, x3).astype(x3.dtype)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
