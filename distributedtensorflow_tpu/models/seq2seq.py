@@ -48,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.attention import dot_product_attention
 from ..ops.xent import chunked_argmax, chunked_softmax_xent, tied_head_logits
 from ..parallel.sharding import LayoutMap
-from .gpt import cached_attention_with_vars, rope
+from .gpt import cached_attention_with_vars, rope, rope_tables
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,7 +108,7 @@ class _Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, kv, *, q_positions, kv_positions, mask,
-                 deterministic: bool):
+                 deterministic: bool, q_tabs=None, kv_tabs=None):
         cfg = self.cfg
         if kv is None:  # self-attention
             kv = x
@@ -117,7 +117,7 @@ class _Attention(nn.Module):
             (cfg.num_heads, head_dim), dtype=cfg.dtype, use_bias=False,
             name=name,
         )
-        q = rope(dense("query")(x), q_positions, cfg.rope_theta)
+        q = rope(dense("query")(x), q_positions, cfg.rope_theta, q_tabs)
         cross_decode = self.decode and not self.causal
         if cross_decode and self.has_variable("cache", "cross_key"):
             # Step apply: the projected encoder K/V were stored by the
@@ -127,7 +127,7 @@ class _Attention(nn.Module):
             k = self.get_variable("cache", "cross_key")
             v = self.get_variable("cache", "cross_value")
         else:
-            k = rope(dense("key")(kv), kv_positions, cfg.rope_theta)
+            k = rope(dense("key")(kv), kv_positions, cfg.rope_theta, kv_tabs)
             v = dense("value")(kv)
             if cross_decode and not self.is_initializing():
                 # Bank the real projections for the step applies.  NOT
@@ -180,13 +180,14 @@ class EncoderBlock(nn.Module):
     cfg: Seq2SeqConfig
 
     @nn.compact
-    def __call__(self, x, *, positions, mask, deterministic):
+    def __call__(self, x, *, positions, mask, deterministic,
+                 rope_tabs=None):
         cfg = self.cfg
         norm = lambda name: nn.RMSNorm(dtype=jnp.float32, name=name)
         x = x + _Attention(cfg, name="attention")(
             norm("ln_attn")(x).astype(cfg.dtype), None,
             q_positions=positions, kv_positions=positions, mask=mask,
-            deterministic=deterministic,
+            deterministic=deterministic, q_tabs=rope_tabs, kv_tabs=rope_tabs,
         )
         x = x + _MLP(cfg, name="mlp")(
             norm("ln_mlp")(x).astype(cfg.dtype), deterministic
@@ -200,19 +201,21 @@ class DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, enc_out, *, positions, enc_positions, cross_mask,
-                 deterministic):
+                 deterministic, rope_tabs=None, enc_rope_tabs=None):
         cfg = self.cfg
         norm = lambda name: nn.RMSNorm(dtype=jnp.float32, name=name)
         x = x + _Attention(cfg, causal=True, decode=self.decode,
                            name="attention")(
             norm("ln_attn")(x).astype(cfg.dtype), None,
             q_positions=positions, kv_positions=positions, mask=None,
-            deterministic=deterministic,
+            deterministic=deterministic, q_tabs=rope_tabs,
+            kv_tabs=rope_tabs,
         )
         x = x + _Attention(cfg, decode=self.decode, name="cross_attention")(
             norm("ln_cross")(x).astype(cfg.dtype), enc_out,
             q_positions=positions, kv_positions=enc_positions,
             mask=cross_mask, deterministic=deterministic,
+            q_tabs=rope_tabs, kv_tabs=enc_rope_tabs,
         )
         x = x + _MLP(cfg, name="mlp")(
             norm("ln_mlp")(x).astype(cfg.dtype), deterministic
@@ -269,9 +272,14 @@ class Seq2SeqLM(nn.Module):
         # (padded QUERY rows produce garbage that the loss never reads).
         mask = pad[:, None, None, :]
         x = self.shared_embed(encoder_ids).astype(jnp.float32)
+        # Trig once per stream, shared by every block (same hoist as GPT).
+        tabs = rope_tables(
+            positions, cfg.hidden_size // cfg.num_heads, cfg.rope_theta,
+            cfg.dtype,
+        )
         for block in self.enc_blocks:
             x = block(x, positions=positions, mask=mask,
-                      deterministic=deterministic)
+                      deterministic=deterministic, rope_tabs=tabs)
         return self.enc_norm(x), pad, positions
 
     def decode(self, decoder_ids, enc_out, enc_pad, enc_positions,
@@ -283,10 +291,20 @@ class Seq2SeqLM(nn.Module):
             )
         cross_mask = enc_pad[:, None, None, :]
         x = self.shared_embed(decoder_ids).astype(jnp.float32)
+        cfg = self.cfg
+        tabs = rope_tables(
+            positions, cfg.hidden_size // cfg.num_heads, cfg.rope_theta,
+            cfg.dtype,
+        )
+        enc_tabs = rope_tables(
+            enc_positions, cfg.hidden_size // cfg.num_heads, cfg.rope_theta,
+            cfg.dtype,
+        )
         for block in self.dec_blocks:
             x = block(x, enc_out.astype(self.cfg.dtype),
                       positions=positions, enc_positions=enc_positions,
-                      cross_mask=cross_mask, deterministic=deterministic)
+                      cross_mask=cross_mask, deterministic=deterministic,
+                      rope_tabs=tabs, enc_rope_tabs=enc_tabs)
         return self.dec_norm(x)
 
     def __call__(self, encoder_ids, decoder_ids, deterministic: bool = True):

@@ -87,8 +87,12 @@ while true; do
   log "tunnel UP, running queue ($(cache_stat))"
 
   while true; do   # single-pass queue; break on tunnel death
-    # Round-5 queue (2026-08-01 refresh, after the round-4 evidence all
-    # landed): default paths are now Pallas (attn auto = flash >= 1024,
+    # Round-5 queue (2026-08-01 second refresh: stamps reset so every row
+    # re-measures the NEW default stack — bf16 fused-head bwd matmuls,
+    # single-pass flash fwd at n_k==1, diag-split causal, BHSD residuals,
+    # hoisted bf16 rope, fused Pallas LayerNorm, lane-major decode
+    # kernel; conv_tpu stays stamped, its artifact landed in round 4).
+    # Default paths are now Pallas (attn auto = flash >= 1024,
     # xent auto = fused on TPU), so only the explicitly-XLA fallback rows
     # are canary-free.  Compile cache is warm from round 4; stamps are
     # per-round (BENCH_RESULTS/.landed is gitignored).
@@ -125,6 +129,9 @@ while true; do
         || { probe || break; }
       run lm_auto_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
         || { probe || break; }
+      # Serving decode: the round-4 lane-major MXU kernel (bench_generate
+      # dispatches the Pallas decode path on TPU).
+      run generate      900 python bench_generate.py || { probe || break; }
       # Long-context ladder, defaults end-to-end.
       run lm_s4096    900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn python bench_lm.py \
         || { probe || break; }
