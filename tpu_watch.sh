@@ -147,6 +147,10 @@ while true; do
       run lm_medium   900 env BENCH_LM_WORKLOAD=gpt_medium_lm BENCH_LM_BATCH=8 python bench_lm.py \
         || { probe || break; }
       run attn_4k     900 python bench_attn.py       || { probe || break; }
+      # Threshold probe: does the single-pass fwd kernel now beat dense
+      # at 512 (the BERT regime)?  Decides MIN_SEQ_FOR_PALLAS.
+      run attn_512    600 env BENCH_ATTN_SEQS=512 python bench_attn.py \
+        || { probe || break; }
       run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
         || { probe || break; }
       # Fresh profile of the current default step (the instrument).
