@@ -10,7 +10,8 @@ length, greedy decoding (temperature 0 — the deterministic path every
 config exercises).
 
 Knobs (env): ``BENCH_GEN_BATCH`` (default 16), ``BENCH_GEN_PROMPT``
-(default 128), ``BENCH_GEN_NEW`` (default 128), ``BENCH_GEN_TEST`` CPU
+(default 128), ``BENCH_GEN_NEW`` (default 128), ``BENCH_GEN_KV_HEADS``
+(GQA kv-head count; must divide 12), ``BENCH_GEN_TEST`` CPU
 smoke.  One JSON line, same contract as the other benches.
 """
 
@@ -43,6 +44,11 @@ def main() -> None:
     )
     new = int(os.environ.get("BENCH_GEN_NEW", "8" if test_size else "128"))
     cfg = gpt_tiny() if test_size else gpt_small()
+    kv_heads = os.environ.get("BENCH_GEN_KV_HEADS")
+    if kv_heads:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, num_kv_heads=int(kv_heads))
     model = GPTLM(cfg)
     rng = jax.random.PRNGKey(0)
     prompt = np.random.default_rng(0).integers(
@@ -68,6 +74,7 @@ def main() -> None:
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": None,  # no public anchor for this serving config
+        "kv_heads": cfg.kv_heads,
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
         "batch": b,

@@ -60,6 +60,14 @@ class GPTConfig:
     #: stores no (S, S) tensors, so remat-free training fits much larger
     #: batches), or "xla".
     attn_impl: str = "auto"
+    #: Grouped-query attention: number of K/V heads; each group of
+    #: ``num_heads // num_kv_heads`` query heads shares one K/V head.
+    #: None = num_heads (MHA — every existing preset, param-tree
+    #: unchanged).  Shrinks the decode KV cache and its per-step HBM
+    #: stream by the group factor — the binding constraint of the serving
+    #: decode step (ops.attention decode-perf history).  New capability
+    #: beyond the reference stack (tf-classic predates GQA entirely).
+    num_kv_heads: int | None = None
     #: LM-head loss kernel: "auto" (Pallas fused head on TPU — the fastest
     #: measured path, 111.3k vs 108.4k tok/s against chunked_bf16 at the
     #: 2026-08-01 headline A/B — and "chunked" elsewhere, keeping CPU
@@ -68,6 +76,17 @@ class GPTConfig:
     #: "fused" (Pallas ops/fused_xent.py unconditionally — logits never
     #: leave VMEM; ~4.1x less head HBM traffic at equal FLOPs).
     xent_impl: str = "auto"
+
+    def __post_init__(self):
+        kv = self.num_kv_heads
+        if kv is not None and (kv <= 0 or self.num_heads % kv):
+            raise ValueError(
+                f"num_kv_heads={kv} must divide num_heads={self.num_heads}"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
 
 def gpt_small() -> GPTConfig:
@@ -99,15 +118,18 @@ def cached_attention_with_vars(module: nn.Module, q, k, v,
     by every serving path (GPT and seq2seq decoder self-attention)."""
     from ..ops.attention import cached_decode_attention
 
-    b, _, h, d = q.shape
-    # (B, H, S, D): per-step writes are contiguous (D,) rows and the
-    # Pallas decode kernel streams (H, S, D) tiles — see the decode-perf
+    b, _, _, d = q.shape
+    h_kv = k.shape[2]  # kv heads: < q heads under GQA (smaller cache)
+    # (B, Hkv, S, D): per-step writes are contiguous (D,) rows and the
+    # Pallas decode kernel streams (Hkv, S, D) tiles — see the decode-perf
     # history on ops.attention.cached_decode_attention.
     cached_k = module.variable(
-        "cache", "cached_key", lambda: jnp.zeros((b, h, max_seq, d), k.dtype)
+        "cache", "cached_key",
+        lambda: jnp.zeros((b, h_kv, max_seq, d), k.dtype)
     )
     cached_v = module.variable(
-        "cache", "cached_value", lambda: jnp.zeros((b, h, max_seq, d), v.dtype)
+        "cache", "cached_value",
+        lambda: jnp.zeros((b, h_kv, max_seq, d), v.dtype)
     )
     cache_ix = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -185,14 +207,23 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
+        n_kv = cfg.kv_heads
         # Fused QKV projection: one large MXU matmul (column-parallel under
-        # the model axis — gpt_layout shards the fused output dim).
+        # the model axis — gpt_layout shards the fused output dim).  Under
+        # GQA (kv_heads < num_heads) the K/V column groups shrink; at the
+        # MHA default the fused dim is exactly 3E and the split matches
+        # the historical jnp.split(qkv, 3) — same param tree, same values.
+        kv_width = n_kv * head_dim
         qkv = nn.Dense(
-            3 * cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="qkv"
+            cfg.hidden_size + 2 * kv_width, dtype=cfg.dtype, use_bias=False,
+            name="qkv",
         )(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (*x.shape[:2], cfg.num_heads, head_dim)
-        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = qkv[..., :cfg.hidden_size]
+        k = qkv[..., cfg.hidden_size:cfg.hidden_size + kv_width]
+        v = qkv[..., cfg.hidden_size + kv_width:]
+        q = q.reshape(*x.shape[:2], cfg.num_heads, head_dim)
+        k = k.reshape(*x.shape[:2], n_kv, head_dim)
+        v = v.reshape(*x.shape[:2], n_kv, head_dim)
         q = rope(q, positions, cfg.rope_theta, rope_tabs)
         k = rope(k, positions, cfg.rope_theta, rope_tabs)
         if self.decode:
@@ -205,6 +236,13 @@ class CausalSelfAttention(nn.Module):
                 )
             out = self._cached_attention(q, k, v)
         elif self.attn_fn is not None:
+            if n_kv != cfg.num_heads:
+                raise ValueError(
+                    "GQA (kv_heads < num_heads) is not supported with a "
+                    "custom attn_fn (ring/Ulysses sequence parallelism "
+                    "resharding assumes equal q/kv head counts) — use the "
+                    "dense/flash path or set kv_heads=num_heads"
+                )
             out = self.attn_fn(q, k, v)
         else:
             out = dot_product_attention(

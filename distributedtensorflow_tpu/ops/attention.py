@@ -52,10 +52,10 @@ def dot_product_attention(
 
 def cached_decode_attention(
     q: jax.Array,         # (B, s_new, H, D) new queries
-    k_new: jax.Array,     # (B, s_new, H, D) new keys
-    v_new: jax.Array,     # (B, s_new, H, D) new values
-    cached_k: jax.Array,  # (B, H, max_seq, D) cache
-    cached_v: jax.Array,  # (B, H, max_seq, D)
+    k_new: jax.Array,     # (B, s_new, Hkv, D) new keys (Hkv <= H: GQA)
+    v_new: jax.Array,     # (B, s_new, Hkv, D) new values
+    cached_k: jax.Array,  # (B, Hkv, max_seq, D) cache
+    cached_v: jax.Array,  # (B, Hkv, max_seq, D)
     cache_index: jax.Array,  # () int32 — next write slot
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One KV-cache decode step, shared by every serving path.
@@ -110,16 +110,32 @@ def cached_decode_attention(
             interpret=platform == "cpu",
         )
         return out, cached_k, cached_v, ix + s_new
-    scores = jnp.einsum(
-        "bqhd,bhkd->bhqk", q, cached_k,
-        preferred_element_type=jnp.float32,
-    ) / (d ** 0.5)
+    h_kv = cached_k.shape[1]
+    if h != h_kv:  # GQA: grouped einsums, cache never broadcast to H
+        g = h // h_kv
+        qg = q.reshape(b, s_new, h_kv, g, d)
+        scores = jnp.einsum(
+            "bqhgd,bhkd->bhgqk", qg, cached_k,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, h, s_new, max_seq) / (d ** 0.5)
+    else:
+        scores = jnp.einsum(
+            "bqhd,bhkd->bhqk", q, cached_k,
+            preferred_element_type=jnp.float32,
+        ) / (d ** 0.5)
     scores = jnp.where(valid[None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum(
-        "bhqk,bhkd->bqhd", weights.astype(q.dtype), cached_v,
-        preferred_element_type=jnp.float32,
-    ).astype(q.dtype)
+    if h != h_kv:
+        wg = weights.astype(q.dtype).reshape(b, h_kv, g, s_new, max_seq)
+        out = jnp.einsum(
+            "bhgqk,bhkd->bqhgd", wg, cached_v,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, s_new, h, d).astype(q.dtype)
+    else:
+        out = jnp.einsum(
+            "bhqk,bhkd->bqhd", weights.astype(q.dtype), cached_v,
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
     return out, cached_k, cached_v, ix + s_new
 
 
@@ -151,11 +167,16 @@ def _decode_attn_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, scale):
     # or hb == H, and Mosaic cannot reshape lanes to sublanes in-kernel;
     # both found on-chip at hb=4).  Same trick as fused_xent's _SUB
     # scratch.  The head loop is a STATIC unroll.
-    hb = k_ref.shape[1]
+    hb = q_ref.shape[1]
+    # GQA: the kv block carries hb // group heads; q head hi reads kv
+    # head hi // group — the group shares one streamed (S, D) tile, so
+    # the cache read (the decode step's binding HBM cost) shrinks by
+    # the group factor.
+    group = hb // k_ref.shape[1]
     valid_row = valid_ref[...] != 0                     # (1, S)
     for hi in range(hb):
         q_h = q_ref[0, hi, :, :]                        # (8, D), rows equal
-        k_h = k_ref[0, hi, :, :]                        # (S, D)
+        k_h = k_ref[0, hi // group, :, :]               # (S, D)
         s = jax.lax.dot_general(
             q_h, k_h, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -165,7 +186,7 @@ def _decode_attn_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, scale):
         p = jnp.exp(s - m)
         w = (p / jnp.sum(p, axis=1, keepdims=True)).astype(v_ref.dtype)
         o_ref[0, hi] = jax.lax.dot_general(
-            w, v_ref[0, hi, :, :], (((1,), (0,)), ((), ())),
+            w, v_ref[0, hi // group, :, :], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).astype(o_ref.dtype)                           # (8, D), rows equal
 
@@ -184,37 +205,46 @@ def _decode_bytes_per_elem(kv_itemsize: int) -> int:
 _DECODE_VMEM_BUDGET = 12 * 2**20
 
 
-def _pick_decode_head_block(h: int, s: int, d: int, kv_itemsize: int) -> int:
+def _pick_decode_head_block(h: int, s: int, d: int, kv_itemsize: int,
+                            group: int = 1) -> int:
+    """q-heads per grid step: a multiple of ``group`` (so every step's
+    kv block holds whole GQA groups) whose kv-side tile fits the VMEM
+    budget.  At group=1 this is the original picker."""
     import os
 
     o = os.environ.get("DTFT_DECODE_HEAD_BLOCK")  # on-chip sweep override
     if o:
         n = int(o)
-        if n > 0 and h % n == 0:
+        if n > 0 and h % n == 0 and n % group == 0:
             return n
         import sys
 
         print(f"decode_attention: DTFT_DECODE_HEAD_BLOCK={o} invalid for "
-              f"{h} heads; using the auto-picked block", file=sys.stderr)
-    for hb in (16, 12, 8, 6, 4, 3, 2, 1):
-        if h % hb == 0 and hb * s * d * _decode_bytes_per_elem(kv_itemsize) \
+              f"{h} heads / group {group}; using the auto-picked block",
+              file=sys.stderr)
+    for hb_kv in (16, 12, 8, 6, 4, 3, 2, 1):
+        hb = hb_kv * group
+        if h % hb == 0 and hb_kv * s * d * _decode_bytes_per_elem(kv_itemsize) \
                 <= _DECODE_VMEM_BUDGET:
             return hb
-    return 1
+    return group
 
 
 def _pallas_decode_attention(q, cached_k, cached_v, valid, *, interpret):
-    """Single-token decode attention over the (B, H, S, D) cache.
+    """Single-token decode attention over the (B, Hkv, S, D) cache.
 
     ``q`` (B, 1, H, D); ``valid`` (1, S) int32 (1 = attend).  Returns
-    (B, 1, H, D).  Grid (B, H/hb): each step streams hb heads' K/V.
+    (B, 1, H, D).  Grid (B, H/hb): each step streams hb/group kv heads'
+    K/V (GQA shares each kv tile across its query-head group).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, _, h, d = q.shape
-    s = cached_k.shape[2]
-    hb = _pick_decode_head_block(h, s, d, cached_k.dtype.itemsize)
+    h_kv, s = cached_k.shape[1], cached_k.shape[2]
+    group = h // h_kv
+    hb = _pick_decode_head_block(h, s, d, cached_k.dtype.itemsize, group)
+    hb_kv = hb // group
     mem = pl.ANY if interpret else pltpu.VMEM
     q8 = jnp.broadcast_to(
         q.transpose(0, 2, 1, 3), (b, h, 8, d)
@@ -225,9 +255,9 @@ def _pallas_decode_attention(q, cached_k, cached_v, valid, *, interpret):
         in_specs=[
             pl.BlockSpec((1, hb, 8, d), lambda i, j: (i, j, 0, 0),
                          memory_space=mem),
-            pl.BlockSpec((1, hb, s, d), lambda i, j: (i, j, 0, 0),
+            pl.BlockSpec((1, hb_kv, s, d), lambda i, j: (i, j, 0, 0),
                          memory_space=mem),
-            pl.BlockSpec((1, hb, s, d), lambda i, j: (i, j, 0, 0),
+            pl.BlockSpec((1, hb_kv, s, d), lambda i, j: (i, j, 0, 0),
                          memory_space=mem),
             pl.BlockSpec((1, s), lambda i, j: (0, 0), memory_space=mem),
         ],
@@ -240,18 +270,32 @@ def _pallas_decode_attention(q, cached_k, cached_v, valid, *, interpret):
 
 
 def xla_attention(q, k, v, *, mask=None, causal=False):
+    """BSHD attention; supports GQA (k/v with fewer heads than q, heads
+    grouped ``g = Hq // Hkv``) via grouped einsums — the (Hkv, g) <->
+    (Hq,) reshapes are over adjacent dims, so they are free relayouts,
+    and K/V are never materialized at Hq width."""
     orig_dtype = q.dtype
-    depth = q.shape[-1]
+    b, sq, hq, depth = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
     scale = 1.0 / jnp.sqrt(depth).astype(jnp.float32)
     # (B, H, Sq, Sk) scores; contraction in input dtype (bf16 MXU), softmax fp32
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if hq != hkv:
+        g = hq // hkv
+        qg = q.reshape(b, sq, hkv, g, depth)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).reshape(
+            b, hq, sq, sk) * scale
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     scores = scores.astype(jnp.float32)
     if causal:
-        sq, sk = scores.shape[-2], scores.shape[-1]
         causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         scores = jnp.where(causal_mask, scores, NEG_INF)
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(orig_dtype), v)
+    if hq != hkv:
+        wg = weights.astype(orig_dtype).reshape(b, hkv, g, sq, sk)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v).reshape(b, sq, hq, depth)
+    else:
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(orig_dtype), v)
     return out

@@ -95,9 +95,18 @@ def _on_tpu() -> bool:
 MIN_SEQ_FOR_PALLAS = 1024
 
 
+def _gqa_ok(qshape, kshape) -> bool:
+    """Same (B, S, D) and q heads an integer multiple of kv heads."""
+    return (
+        qshape[0] == kshape[0] and qshape[1] == kshape[1]
+        and qshape[3] == kshape[3] and kshape[2] > 0
+        and qshape[2] % kshape[2] == 0
+    )
+
+
 def supported(q, k, v, *, mask=None, segment_ids=None) -> bool:
     """True when auto-dispatch should take the Pallas kernel for this call."""
-    if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
+    if q.ndim != 4 or k.shape != v.shape or not _gqa_ok(q.shape, k.shape):
         return False
     if not _on_tpu():
         return False
@@ -389,8 +398,14 @@ def _flash_forward_bhsd(qt, kt, vt, mask, segment_ids, kv_segment_ids=None,
     The BHSD output is handed back so the custom VJP can save the
     transposed operands as residuals — the backward kernels consume
     BHSD, and re-deriving it there from BSHD residuals would re-emit
-    the relayouts the forward already paid for."""
+    the relayouts the forward already paid for.
+
+    GQA (kt/vt with fewer heads): the kv index map sends q-head grid
+    step ``h`` to kv head ``h // group`` — every q head in a group reads
+    the SAME kv tile, so the sharing is zero-copy (no (B, Hq, S, D)
+    broadcast ever exists in HBM)."""
     batch, heads, seq, depth = qt.shape
+    group = heads // kt.shape[1]
     block_q = _pick_block_q(seq)
     block_k = _pick_block_k(seq)
     scale = 1.0 / (depth ** 0.5)
@@ -402,7 +417,7 @@ def _flash_forward_bhsd(qt, kt, vt, mask, segment_ids, kv_segment_ids=None,
         memory_space=mem,
     )
     kvspec = pl.BlockSpec(
-        (1, 1, block_k, depth), lambda b, h, i, j: (b, h, j, 0),
+        (1, 1, block_k, depth), lambda b, h, i, j: (b, h // group, j, 0),
         memory_space=mem,
     )
     extra_specs, extra_args, extra_names = _extra_specs_and_args(
@@ -655,8 +670,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False):
-    """Backward from the custom-VJP residuals (BHSD operands + BHSD o)."""
+    """Backward from the custom-VJP residuals (BHSD operands + BHSD o).
+
+    GQA residuals hold K/V compact (Hkv heads).  The forward shares
+    tiles zero-copy via its index map; the backward instead broadcasts
+    K/V to Hq for the unchanged kernels and group-sums dk/dv afterwards
+    — a deliberate simplicity trade: training-side GQA gains are in the
+    QKV projection, not here, while the decode path (where the cache
+    stream IS the bound) gets native grouping in ops.attention."""
     qt, kt, vt, mask, segment_ids, ot, lse = res
+    heads, kv_heads = qt.shape[1], kt.shape[1]
+    if kv_heads != heads:
+        group = heads // kv_heads
+        kt, vt = (
+            jnp.repeat(x, group, axis=1) for x in (kt, vt)
+        )
     gt = g.transpose(0, 2, 1, 3)
     # delta = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it.
     delta = jnp.einsum(
@@ -666,6 +694,10 @@ def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False):
         qt, kt, vt, gt, mask, lse, delta, segment_ids=segment_ids,
         causal=causal, interpret=interpret, force_split=force_split
     )
+    if kv_heads != heads:
+        b, _, s, d = dkt.shape
+        dkt = dkt.reshape(b, kv_heads, group, s, d).sum(axis=2)
+        dvt = dvt.reshape(b, kv_heads, group, s, d).sum(axis=2)
     bsdh = lambda x: x.transpose(0, 2, 1, 3)
     return bsdh(dqt), bsdh(dkt), bsdh(dvt)
 
@@ -959,9 +991,17 @@ def _flash_bwd(causal, interpret, backward_impl, res, g):
     else:
         qt, kt, vt, mask, segment_ids, ot, lse = res
         q, k, v, o = (t.transpose(0, 2, 1, 3) for t in (qt, kt, vt, ot))
+        heads, kv_heads = q.shape[2], k.shape[2]
+        if kv_heads != heads:  # GQA: broadcast for the equal-head fallback
+            group = heads // kv_heads
+            k, v = (jnp.repeat(x, group, axis=2) for x in (k, v))
         dq, dk, dv = _flash_backward_xla(
             (q, k, v, mask, segment_ids, o, lse), g, causal=causal
         )
+        if kv_heads != heads:
+            b, s, _, d = dk.shape
+            dk = dk.reshape(b, s, kv_heads, group, d).sum(axis=3)
+            dv = dv.reshape(b, s, kv_heads, group, d).sum(axis=3)
     return dq, dk, dv, None, None
 
 
@@ -985,9 +1025,10 @@ def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
     wanting silent fallback should go through
     ``ops.attention.dot_product_attention`` with ``implementation="auto"``).
     """
-    if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
+    if q.ndim != 4 or k.shape != v.shape or not _gqa_ok(q.shape, k.shape):
         raise ValueError(
-            f"flash_attention needs matching BSHD q/k/v, got {q.shape} "
+            f"flash_attention needs BSHD q/k/v with matching (B, S, D) and "
+            f"q heads a multiple of kv heads (GQA), got {q.shape} "
             f"{k.shape} {v.shape}"
         )
     if _pick_block_q(q.shape[1]) is None:

@@ -186,7 +186,8 @@ def get_workload(name: str, *, test_size: bool = False,
                  seq_len: int | None = None,
                  remat: bool | str | None = None,
                  attn_impl: str | None = None,
-                 xent_impl: str | None = None) -> Workload:
+                 xent_impl: str | None = None,
+                 kv_heads: int | None = None) -> Workload:
     """Build a preset by name.  ``test_size`` shrinks models for CI.
 
     ``sp_scheme`` picks the sequence-parallel attention used by ``gpt_lm``
@@ -197,7 +198,8 @@ def get_workload(name: str, *, test_size: bool = False,
     ("bfloat16" or None) sets the dtype of the pipeline's inter-stage
     ppermute payload — bf16 halves the wire (ICI) traffic, bit-exactly
     for bf16 models; carries/buffers stay fp32 (see
-    PipelinedGPT.handoff_dtype).  ``seq_len`` / ``remat``
+    PipelinedGPT.handoff_dtype).  ``kv_heads`` enables GQA on the
+    gpt family (num_kv_heads; see models.gpt.GPTConfig).  ``seq_len`` / ``remat``
     override the LM presets' sequence length and rematerialization (remat
     trades ~1/3 extra FLOPs for activation memory; benches turn it off when
     the batch fits).
@@ -370,7 +372,8 @@ def get_workload(name: str, *, test_size: bool = False,
             attn_impl = attn_impl or "pallas"
         seq = seq_len or (64 if test_size else 2048)
         if (remat is not None or attn_impl is not None
-                or xent_impl is not None or seq > cfg.max_seq):
+                or xent_impl is not None or kv_heads is not None
+                or seq > cfg.max_seq):
             # remat: True/False = whole blocks; "attn" = attention-only.
             cfg = dataclasses.replace(
                 cfg,
@@ -378,6 +381,8 @@ def get_workload(name: str, *, test_size: bool = False,
                 remat_attn=remat == "attn",
                 attn_impl=attn_impl or cfg.attn_impl,
                 xent_impl=xent_impl or cfg.xent_impl,
+                num_kv_heads=(kv_heads if kv_heads is not None
+                              else cfg.num_kv_heads),
                 max_seq=max(cfg.max_seq, seq),
             )
         gbs = global_batch_size or (8 if test_size else 64)
@@ -517,7 +522,8 @@ def get_workload(name: str, *, test_size: bool = False,
         cfg = gpt_moe_tiny() if test_size else gpt_moe_small()
         seq = seq_len or (64 if test_size else 2048)
         if (remat is not None or attn_impl is not None
-                or xent_impl is not None or seq > cfg.max_seq):
+                or xent_impl is not None or kv_heads is not None
+                or seq > cfg.max_seq):
             # remat: True/False = whole blocks; "attn" = attention-only.
             cfg = dataclasses.replace(
                 cfg,
@@ -525,6 +531,8 @@ def get_workload(name: str, *, test_size: bool = False,
                 remat_attn=remat == "attn",
                 attn_impl=attn_impl or cfg.attn_impl,
                 xent_impl=xent_impl or cfg.xent_impl,
+                num_kv_heads=(kv_heads if kv_heads is not None
+                              else cfg.num_kv_heads),
                 max_seq=max(cfg.max_seq, seq),
             )
         gbs = global_batch_size or (8 if test_size else 64)

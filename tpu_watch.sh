@@ -132,6 +132,11 @@ while true; do
       # Serving decode: the round-4 lane-major MXU kernel (bench_generate
       # dispatches the Pallas decode path on TPU).
       run generate      900 python bench_generate.py || { probe || break; }
+      # GQA decode A/B: kv_heads=2 shrinks the per-step cache stream 6x
+      # (12 q heads share 2 kv heads) — the decode step's binding HBM
+      # cost; random weights, pure speed row.
+      run generate_gqa  900 env BENCH_GEN_KV_HEADS=2 python bench_generate.py \
+        || { probe || break; }
       # Long-context ladder, defaults end-to-end.
       run lm_s4096    900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn python bench_lm.py \
         || { probe || break; }

@@ -207,6 +207,7 @@ def run_evaluator(args) -> None:
         pp_handoff=_PP_HANDOFF[args.pp_handoff_dtype],
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
+        kv_heads=args.kv_heads,
         remat=REMAT_FLAG[args.remat],
     )
     if wl.eval_fn is None:
@@ -607,6 +608,10 @@ def main() -> None:
                    default=None,
                    help="LM presets: attention kernel (auto = Pallas flash"
                         " on TPU past the evidenced seq threshold)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA: number of K/V heads for the gpt family "
+                        "(must divide the model's head count; shrinks the "
+                        "serving KV cache num_heads/kv_heads-fold)")
     p.add_argument("--xent-impl",
                    choices=("auto", "chunked", "chunked_bf16", "fused"),
                    default=None,
@@ -698,6 +703,7 @@ def main() -> None:
         remat=REMAT_FLAG[args.remat],
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
+        kv_heads=args.kv_heads,
     )
     wl = apply_optimizer_flags(wl, args)
     spec = parse_mesh(args.mesh) or wl.mesh_spec
