@@ -153,3 +153,39 @@ def test_gqa_model_trains_and_cache_is_compact():
         nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None]
         cur = jnp.concatenate([cur, nxt], axis=1)
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
+
+
+def test_gqa_trains_under_dp_tp(devices):
+    """GQA composes with tensor parallelism on the virtual mesh.
+
+    What this checks: the full GQA train step (fused qkv with unequal
+    q/kv column groups, grouped attention einsums, grouped-KV grads)
+    compiles and trains under the Megatron layout on a data x model
+    mesh.  The fused-qkv column split is NOT group-aligned — GSPMD
+    inserts the reshards/collectives the grouped einsums need — so this
+    is a GSPMD-correctness gate, not a zero-communication-layout claim."""
+    from distributedtensorflow_tpu.data import InputContext, device_put_batch
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.train import (
+        create_sharded_state,
+        make_train_step,
+    )
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    wl = get_workload("gpt_lm", test_size=True, global_batch_size=8,
+                      kv_heads=2)
+    mesh = build_mesh(MeshSpec(data=2, model=4), devices)
+    wl = wl.for_mesh(mesh)
+    rng = jax.random.PRNGKey(0)
+    state, specs = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
+    )
+    step = make_train_step(wl.loss_fn, mesh, specs)
+    ctx = InputContext(1, 0, wl.global_batch_size)
+    it = wl.input_fn(ctx, 0)
+    losses = []
+    for _ in range(10):
+        batch = device_put_batch(next(it), mesh)
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
