@@ -63,10 +63,27 @@ class Seq2SeqConfig:
     dropout_rate: float = 0.0
     rope_theta: float = 10000.0
     dtype: jnp.dtype = jnp.bfloat16
+    #: Grouped-query attention: K/V heads per attention (self AND cross);
+    #: None = num_heads (MHA).  Same capability/convention as
+    #: models.gpt.GPTConfig.num_kv_heads — shrinks the decoder's
+    #: self-attention KV cache and the banked cross K/V by the group
+    #: factor when serving.
+    num_kv_heads: int | None = None
     #: id that starts every decoder input (teacher forcing shift-in).
     bos_id: int = 0
     #: padding id — excluded from the loss and from encoder attention.
     pad_id: int = 1
+
+    def __post_init__(self):
+        kv = self.num_kv_heads
+        if kv is not None and (kv <= 0 or self.num_heads % kv):
+            raise ValueError(
+                f"num_kv_heads={kv} must divide num_heads={self.num_heads}"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
 
 def seq2seq_small() -> Seq2SeqConfig:
@@ -113,10 +130,11 @@ class _Attention(nn.Module):
         if kv is None:  # self-attention
             kv = x
         head_dim = cfg.hidden_size // cfg.num_heads
-        dense = lambda name: nn.DenseGeneral(
-            (cfg.num_heads, head_dim), dtype=cfg.dtype, use_bias=False,
+        dense = lambda name, heads=cfg.num_heads: nn.DenseGeneral(
+            (heads, head_dim), dtype=cfg.dtype, use_bias=False,
             name=name,
         )
+        kv_dense = lambda name: dense(name, cfg.kv_heads)
         q = rope(dense("query")(x), q_positions, cfg.rope_theta, q_tabs)
         cross_decode = self.decode and not self.causal
         if cross_decode and self.has_variable("cache", "cross_key"):
@@ -127,8 +145,9 @@ class _Attention(nn.Module):
             k = self.get_variable("cache", "cross_key")
             v = self.get_variable("cache", "cross_value")
         else:
-            k = rope(dense("key")(kv), kv_positions, cfg.rope_theta, kv_tabs)
-            v = dense("value")(kv)
+            k = rope(kv_dense("key")(kv), kv_positions, cfg.rope_theta,
+                     kv_tabs)
+            v = kv_dense("value")(kv)
             if cross_decode and not self.is_initializing():
                 # Bank the real projections for the step applies.  NOT
                 # during .init(): the canonical flax cache-allocation
@@ -465,15 +484,26 @@ def seq2seq_generate(
     )
 
 
-def seq2seq_layout() -> LayoutMap:
+def seq2seq_layout(cfg: Seq2SeqConfig | None = None) -> LayoutMap:
     """Megatron TP rules over ``model`` — same column/row split as
     :func:`..models.bert.bert_layout`, applied to self-, cross-, and MLP
     kernels in both stacks; the shared table row-shards (vocab) so the
-    chunked head partitions cleanly (ops/xent.py TP note)."""
-    return LayoutMap([
-        (r"(query|key|value)/kernel", P(None, "model", None)),
+    chunked head partitions cleanly (ops/xent.py TP note).
+
+    GQA (``cfg.num_kv_heads < num_heads``): the key/value kernels'
+    heads axis may be smaller than the TP degree, so head-sharding them
+    would fail at parameter placement — they stay replicated instead
+    (the Megatron-GQA convention when tp > kv_heads; they are the
+    smallest kernels in the block, E x Hkv x D)."""
+    rules = [
         (r"(attention|cross_attention)/out/kernel", P("model", None, None)),
         (r"mlp_in/kernel", P(None, "model")),
         (r"mlp_out/kernel", P("model", None)),
         (r"shared/embedding", P("model", None)),
-    ])
+    ]
+    if cfg is not None and cfg.kv_heads != cfg.num_heads:
+        rules.insert(0, (r"query/kernel", P(None, "model", None)))
+        # key/value: no rule -> replicated
+    else:
+        rules.insert(0, (r"(query|key|value)/kernel", P(None, "model", None)))
+    return LayoutMap(rules)

@@ -582,6 +582,8 @@ def get_workload(name: str, *, test_size: bool = False,
         seq = seq_len or (32 if test_size else 256)
         if seq > cfg.max_seq:  # grow the declared envelope with overrides
             cfg = dataclasses.replace(cfg, max_seq=seq)
+        if kv_heads is not None:
+            cfg = dataclasses.replace(cfg, num_kv_heads=kv_heads)
         model = Seq2SeqLM(cfg)
         gbs = global_batch_size or (8 if test_size else 64)
 
@@ -605,7 +607,7 @@ def get_workload(name: str, *, test_size: bool = False,
             init_fn=s2s_init,
             global_batch_size=gbs,
             mesh_spec=MeshSpec(data=-1),
-            layout=seq2seq_layout(),
+            layout=seq2seq_layout(cfg),
         )
     raise ValueError(
         f"unknown workload {name!r}; known: mnist_lenet cifar_resnet20 "
