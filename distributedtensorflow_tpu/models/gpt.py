@@ -60,6 +60,12 @@ class GPTConfig:
     #: stores no (S, S) tensors, so remat-free training fits much larger
     #: batches), or "xla".
     attn_impl: str = "auto"
+    #: Sliding-window attention (Mistral-style): token i attends keys in
+    #: ``(i - attn_window, i]``.  None = full causal.  The flash kernels
+    #: skip out-of-band blocks (O(S*window) cost); the decode path masks
+    #: the cache the same way, so training and serving agree.  New
+    #: capability beyond the reference stack.
+    attn_window: int | None = None
     #: Grouped-query attention: number of K/V heads; each group of
     #: ``num_heads // num_kv_heads`` query heads shares one K/V head.
     #: None = num_heads (MHA — every existing preset, param-tree
@@ -82,6 +88,10 @@ class GPTConfig:
         if kv is not None and (kv <= 0 or self.num_heads % kv):
             raise ValueError(
                 f"num_kv_heads={kv} must divide num_heads={self.num_heads}"
+            )
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(
+                f"attn_window={self.attn_window} must be >= 1 (None = full)"
             )
 
     @property
@@ -111,7 +121,8 @@ def gpt_tiny() -> GPTConfig:
 
 
 def cached_attention_with_vars(module: nn.Module, q, k, v,
-                               max_seq: int) -> jax.Array:
+                               max_seq: int,
+                               window: int | None = None) -> jax.Array:
     """Flax "cache"-collection plumbing around
     :func:`..ops.attention.cached_decode_attention` — the ONE place the
     cache layout (cached_key/cached_value/cache_index) is defined, shared
@@ -136,7 +147,8 @@ def cached_attention_with_vars(module: nn.Module, q, k, v,
     )
     out, cached_k.value, cached_v.value, cache_ix.value = (
         cached_decode_attention(
-            q, k, v, cached_k.value, cached_v.value, cache_ix.value
+            q, k, v, cached_k.value, cached_v.value, cache_ix.value,
+            window=window,
         )
     )
     return out
@@ -243,10 +255,17 @@ class CausalSelfAttention(nn.Module):
                     "resharding assumes equal q/kv head counts) — use the "
                     "dense/flash path or set kv_heads=num_heads"
                 )
+            if cfg.attn_window is not None:
+                raise ValueError(
+                    "attn_window is not supported with a custom attn_fn "
+                    "(sequence-parallel attention masks per K/V chunk) — "
+                    "use the dense/flash path"
+                )
             out = self.attn_fn(q, k, v)
         else:
             out = dot_product_attention(
-                q, k, v, causal=True, implementation=cfg.attn_impl
+                q, k, v, causal=True, window=cfg.attn_window,
+                implementation=cfg.attn_impl,
             )
         out = out.reshape(*x.shape[:2], cfg.hidden_size)
         # Row-parallel output projection (its input dim is head-sharded).
@@ -256,7 +275,8 @@ class CausalSelfAttention(nn.Module):
 
     def _cached_attention(self, q, k, v):
         """One decode step against the KV cache (shared helper)."""
-        return cached_attention_with_vars(self, q, k, v, self.cfg.max_seq)
+        return cached_attention_with_vars(self, q, k, v, self.cfg.max_seq,
+                                          window=self.cfg.attn_window)
 
 
 class GPTBlock(nn.Module):

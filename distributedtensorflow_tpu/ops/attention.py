@@ -25,6 +25,7 @@ def dot_product_attention(
     mask: jax.Array | None = None,  # broadcastable to (B, H, Sq, Sk); True=keep
     segment_ids: jax.Array | None = None,  # int (B, S): packed sequences
     causal: bool = False,
+    window: int | None = None,  # sliding window (requires causal)
     implementation: str = "auto",  # "auto" | "xla" | "pallas"
 ) -> jax.Array:
     """Multi-head scaled dot-product attention, BSHD layout.
@@ -33,6 +34,9 @@ def dot_product_attention(
     shapes allow, else the XLA path.  ``segment_ids`` restricts attention to
     within packed segments (BERT-style example packing); on the XLA path it
     lowers to a block-diagonal mask, on the Pallas path it stays O(S) memory.
+    ``window`` enables causal sliding-window attention (token i sees keys
+    in ``(i - window, i]``); the Pallas path skips out-of-band blocks so
+    cost is O(S * window).
     """
     if implementation in ("auto", "pallas"):
         from . import flash_attention  # noqa: PLC0415 (lazy: pallas optional)
@@ -42,12 +46,13 @@ def dot_product_attention(
             or implementation == "pallas"
         ):
             return flash_attention.flash_attention(
-                q, k, v, mask=mask, segment_ids=segment_ids, causal=causal
+                q, k, v, mask=mask, segment_ids=segment_ids, causal=causal,
+                window=window,
             )
     if segment_ids is not None:
         seg = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None, :, :]
         mask = seg if mask is None else jnp.logical_and(mask, seg)
-    return xla_attention(q, k, v, mask=mask, causal=causal)
+    return xla_attention(q, k, v, mask=mask, causal=causal, window=window)
 
 
 def cached_decode_attention(
@@ -57,6 +62,7 @@ def cached_decode_attention(
     cached_k: jax.Array,  # (B, Hkv, max_seq, D) cache
     cached_v: jax.Array,  # (B, Hkv, max_seq, D)
     cache_index: jax.Array,  # () int32 — next write slot
+    window: int | None = None,  # sliding window (matches training masking)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One KV-cache decode step, shared by every serving path.
 
@@ -94,6 +100,9 @@ def cached_decode_attention(
     q_pos = ix + jnp.arange(s_new)
     k_idx = jnp.arange(max_seq)
     valid = k_idx[None, :] <= q_pos[:, None]  # (s_new, max_seq)
+    if window is not None:
+        # sliding window: only the last `window` positions stay visible
+        valid &= k_idx[None, :] > q_pos[:, None] - window
     # Kernel blocks are whole-axis in (S, D) (always tile-legal); the
     # head-block picker bounds VMEM, so the only fallback case is a
     # single head's (S, D) temporaries exceeding the budget.  Platform
@@ -269,7 +278,7 @@ def _pallas_decode_attention(q, cached_k, cached_v, valid, *, interpret):
     return out[:, :, 0, :][:, None, :, :]  # (B, 1, H, D)
 
 
-def xla_attention(q, k, v, *, mask=None, causal=False):
+def xla_attention(q, k, v, *, mask=None, causal=False, window=None):
     """BSHD attention; supports GQA (k/v with fewer heads than q, heads
     grouped ``g = Hq // Hkv``) via grouped einsums — the (Hkv, g) <->
     (Hq,) reshapes are over adjacent dims, so they are free relayouts,
@@ -287,8 +296,14 @@ def xla_attention(q, k, v, *, mask=None, causal=False):
     else:
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     scores = scores.astype(jnp.float32)
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal")
     if causal:
         causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            # band lower edge in absolute positions (q offset for Sq < Sk)
+            qp = jnp.arange(sq)[:, None] + (sk - sq)
+            causal_mask &= jnp.arange(sk)[None, :] > qp - window
         scores = jnp.where(causal_mask, scores, NEG_INF)
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)

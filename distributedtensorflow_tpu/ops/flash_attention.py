@@ -172,24 +172,32 @@ def _segment_mask(s, qseg_ref, kseg_ref):
 
 
 def _masked_scores(q, k, qi, kj, *, scale, block_q, block_k, causal,
-                   have_mask, mask_ref, qseg_ref, kseg_ref):
+                   have_mask, mask_ref, qseg_ref, kseg_ref, window=None):
     """The (block_q, block_k) fp32 score tile with every mask applied.
 
     THE shared recompute of all four kernels (fwd, dq, dkv, fused bwd):
-    qk^T contraction, causal iota mask, padding mask, packed-segment
-    mask.  One definition so a masking-semantics change cannot
-    desynchronize the forward from one of the backward variants."""
+    qk^T contraction, causal iota mask, sliding-window lower edge,
+    padding mask, packed-segment mask.  One definition so a
+    masking-semantics change cannot desynchronize the forward from one
+    of the backward variants.  ``window`` (static) keeps only keys in
+    ``(q_pos - window, q_pos]``."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    if causal:
+    if causal or window is not None:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            keep = q_pos >= k_pos
+            if window is not None:
+                keep &= k_pos > q_pos - window
+        else:
+            keep = k_pos > q_pos - window
+        s = jnp.where(keep, s, NEG_INF)
     if have_mask:
         keep = mask_ref[0, 0, :]  # (block_k,)
         s = jnp.where(keep[None, :], s, NEG_INF)
@@ -209,31 +217,72 @@ def _straddles_diagonal(qi, kj, block_q, block_k):
     return kj * block_k + block_k - 1 > qi * block_q
 
 
-def _causal_step_split(qi, kj, run, *, block_q, block_k, causal, step):
-    """Run ``step(apply_causal)`` under the diagonal split.
+def _straddles_window(qi, kj, block_q, block_k, window):
+    """Traced scalar: does the pair cross the sliding-window LOWER edge
+    (some k in the block is <= some q's q_pos - window)?  Fully-inside
+    pairs (min k > max q - window) need no lower-edge mask."""
+    return kj * block_k <= qi * block_q + block_q - 1 - window
 
-    ``step`` is the kernel body parameterized on whether the causal mask
-    passes are emitted; identical numerics either way (skipping is only
-    legal for fully-visible pairs).  Non-causal kernels keep the single
-    unmasked body (``run`` is the Python literal True there — every
-    block pair runs)."""
-    if not causal:
-        step(False)
+
+def _band_run(qi, kj, block_q, block_k, causal, window):
+    """Python-or-traced: does this block pair contribute at all?
+
+    Upper cut (causal): first k <= last q position.  Lower cut (window):
+    last k position >= first q position - (window - 1) — a pair entirely
+    below the band is all-masked, so its matmuls are skipped outright
+    (this is what turns O(S^2) into O(S*window) at long sequence)."""
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + block_q - 1
+    if window is not None:
+        in_band = kj * block_k + block_k - 1 >= qi * block_q - (window - 1)
+        run = in_band if run is True else (run & in_band)
+    return run
+
+
+def _causal_step_split(qi, kj, run, *, block_q, block_k, causal, step,
+                       window=None):
+    """Run ``step(apply_causal, apply_window)`` under the band split.
+
+    ``step`` is the kernel body parameterized on which mask passes are
+    emitted; identical numerics either way (skipping is only legal for
+    pairs fully inside the respective edge).  Pairs needing neither
+    edge (the band interior) run completely unmasked; with no window
+    and no causal flag there is a single unmasked body (``run`` is the
+    Python literal True there — every block pair runs)."""
+    if not causal and window is None:
+        step(False, False)
         return
-    diag = _straddles_diagonal(qi, kj, block_q, block_k)
+    need_diag = (
+        _straddles_diagonal(qi, kj, block_q, block_k) if causal
+        else jnp.bool_(False)
+    )
+    need_win = (
+        _straddles_window(qi, kj, block_q, block_k, window)
+        if window is not None else jnp.bool_(False)
+    )
 
-    @pl.when(run & diag)
+    @pl.when(run & need_diag & need_win)
     def _():
-        step(True)
+        step(True, True)
 
-    @pl.when(run & jnp.logical_not(diag))
+    @pl.when(run & need_diag & jnp.logical_not(need_win))
     def _():
-        step(False)
+        step(True, False)
+
+    @pl.when(run & jnp.logical_not(need_diag) & need_win)
+    def _():
+        step(False, True)
+
+    @pl.when(run & jnp.logical_not(need_diag) & jnp.logical_not(need_win))
+    def _():
+        step(False, False)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, block_q, block_k, causal,
-                have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None):
+                have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None,
+                window=None):
     """One (q-block, k-block) grid step of online-softmax accumulation.
 
     Grid is (B, H, n_q, n_k) with k innermost; the m/l/acc state for the
@@ -250,11 +299,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:, :] = jnp.zeros_like(l_scr)
         acc_scr[:, :] = jnp.zeros_like(acc_scr)
 
-    # Under causal masking, a k-block strictly above the diagonal contributes
-    # nothing — skip its matmuls entirely (halves causal FLOPs).
-    run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    # A k-block strictly above the causal diagonal or entirely below the
+    # sliding-window band contributes nothing — skip its matmuls entirely
+    # (halves causal FLOPs; makes windowed cost O(S*window)).
+    run = _band_run(qi, kj, block_q, block_k, causal, window)
 
-    def _step(apply_causal):
+    def _step(apply_causal, apply_window):
         q = q_ref[0, 0, :, :]  # (block_q, D)
         k = k_ref[0, 0, :, :]  # (block_k, D)
         v = v_ref[0, 0, :, :]  # (block_k, D)
@@ -262,6 +312,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
             causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+            window=window if apply_window else None,
         )
         m_prev = m_scr[:, :1]  # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -277,7 +328,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:, :] = jnp.broadcast_to(l_new, l_scr.shape)
 
     _causal_step_split(qi, kj, run, block_q=block_q, block_k=block_k,
-                       causal=causal, step=_step)
+                       causal=causal, step=_step, window=window)
 
     @pl.when(kj == n_k - 1)
     def _finalize():
@@ -293,7 +344,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 def _fwd_kernel_1k(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
                    block_k, causal, have_mask, mask_ref=None,
-                   qseg_ref=None, kseg_ref=None):
+                   qseg_ref=None, kseg_ref=None, window=None):
     """Single-k-block forward: the softmax in one pass, no online state.
 
     When the whole K/V sequence fits one k block (the seq<=1024 headline
@@ -315,7 +366,7 @@ def _fwd_kernel_1k(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
     s = _masked_scores(
         q, k, qi, 0, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, have_mask=have_mask, mask_ref=mask_ref,
-        qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        qseg_ref=qseg_ref, kseg_ref=kseg_ref, window=window,
     )
     m = jnp.max(s, axis=-1, keepdims=True)       # (block_q, 1)
     p = jnp.exp(s - m)
@@ -380,19 +431,19 @@ def _wrap_kernel(inner, n_fixed_in, extra_names, **kw):
 
 
 def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
-                   causal, interpret):
+                   causal, interpret, window=None):
     # Mosaic needs the trailing two block dims tile-aligned or full-size:
     # run the kernel in BHSD so (seq, depth) are the trailing dims.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
 
     o, lse, _ = _flash_forward_bhsd(qt, kt, vt, mask, segment_ids,
                                     kv_segment_ids, causal=causal,
-                                    interpret=interpret)
+                                    interpret=interpret, window=window)
     return o, lse
 
 
 def _flash_forward_bhsd(qt, kt, vt, mask, segment_ids, kv_segment_ids=None,
-                        *, causal, interpret):
+                        *, causal, interpret, window=None):
     """Forward on already-BHSD operands; returns (o BSHD, lse, o BHSD).
 
     The BHSD output is handed back so the custom VJP can save the
@@ -428,6 +479,7 @@ def _flash_forward_bhsd(qt, kt, vt, mask, segment_ids, kv_segment_ids=None,
     kernel = _wrap_kernel(
         _fwd_kernel_1k if one_k else _fwd_kernel, 3, extra_names,
         scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+        window=window,
     )
 
     o, lse = pl.pallas_call(
@@ -480,7 +532,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                       dq_ref, dk_ref, dv_ref, dq_all_scr, dk_scr, dv_scr,
                       *, scale, block_q, block_k, causal,
                       have_mask, mask_ref=None, qseg_ref=None,
-                      kseg_ref=None):
+                      kseg_ref=None, window=None):
     """dq, dk and dv in ONE sweep — the p-tile is recomputed once.
 
     The split pair pays 7 matmuls + 2 exp-of-score-tile passes per
@@ -511,9 +563,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dk_scr[:, :] = jnp.zeros_like(dk_scr)
         dv_scr[:, :] = jnp.zeros_like(dv_scr)
 
-    run = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+    run = _band_run(i, j, block_q, block_k, causal, window)
 
-    def _step(apply_causal):
+    def _step(apply_causal, apply_window):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -522,6 +574,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             q, k, i, j, scale=scale, block_q=block_q, block_k=block_k,
             causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+            window=window if apply_window else None,
         )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])
@@ -546,7 +599,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         )  # (block_q, D)
 
     _causal_step_split(i, j, run, block_q=block_q, block_k=block_k,
-                       causal=causal, step=_step)
+                       causal=causal, step=_step, window=window)
 
     # Unconditional writes: see the docstring on flush semantics.
     dq_ref[0, 0, :, :] = dq_all_scr[pl.ds(i * block_q, block_q)].astype(
@@ -562,7 +615,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, scale, block_q, block_k, causal,
-                   have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None):
+                   have_mask, mask_ref=None, qseg_ref=None, kseg_ref=None,
+                   window=None):
     """dq for one q-block, accumulated over the k sweep (k innermost).
 
     Recomputes the p-tile from the saved LSE:
@@ -578,9 +632,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:, :] = jnp.zeros_like(dq_scr)
 
-    run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    run = _band_run(qi, kj, block_q, block_k, causal, window)
 
-    def _step(apply_causal):
+    def _step(apply_causal, apply_window):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -589,6 +643,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
             q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
             causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+            window=window if apply_window else None,
         )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])
@@ -604,7 +659,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         )
 
     _causal_step_split(qi, kj, run, block_q=block_q, block_k=block_k,
-                       causal=causal, step=_step)
+                       causal=causal, step=_step, window=window)
 
     @pl.when(kj == n_k - 1)
     def _finalize():
@@ -614,7 +669,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q,
                     block_k, causal, have_mask, mask_ref=None,
-                    qseg_ref=None, kseg_ref=None):
+                    qseg_ref=None, kseg_ref=None, window=None):
     """dk/dv for one k-block, accumulated over the q sweep (q innermost).
 
       dv = sum_q p^T @ g
@@ -630,10 +685,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_scr[:, :] = jnp.zeros_like(dv_scr)
 
     # A q-block strictly above the causal diagonal (all q < all k) never
-    # attends to this k-block.
-    run = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    # attends to this k-block; one entirely below the window band neither.
+    run = _band_run(qi, kj, block_q, block_k, causal, window)
 
-    def _step(apply_causal):
+    def _step(apply_causal, apply_window):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -642,6 +697,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             q, k, qi, kj, scale=scale, block_q=block_q, block_k=block_k,
             causal=apply_causal, have_mask=have_mask, mask_ref=mask_ref,
             qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+            window=window if apply_window else None,
         )
         lse = lse_ref[0, 0, 0, :]  # (block_q,)
         p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
@@ -661,7 +717,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         )  # (block_k, D)
 
     _causal_step_split(qi, kj, run, block_q=block_q, block_k=block_k,
-                       causal=causal, step=_step)
+                       causal=causal, step=_step, window=window)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -669,7 +725,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
-def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False):
+def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False,
+                           window=None):
     """Backward from the custom-VJP residuals (BHSD operands + BHSD o).
 
     GQA residuals hold K/V compact (Hkv heads).  The forward shares
@@ -692,7 +749,8 @@ def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False):
     )
     dqt, dkt, dvt = _flash_backward_pallas_bhsd(
         qt, kt, vt, gt, mask, lse, delta, segment_ids=segment_ids,
-        causal=causal, interpret=interpret, force_split=force_split
+        causal=causal, interpret=interpret, force_split=force_split,
+        window=window,
     )
     if kv_heads != heads:
         b, _, s, d = dkt.shape
@@ -704,7 +762,8 @@ def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False):
 
 def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
                                 segment_ids=None, kv_segment_ids=None,
-                                causal, interpret, force_split=False):
+                                causal, interpret, force_split=False,
+                                window=None):
     """dq/dk/dv kernels from externally-supplied LSE and delta rows.
 
     BSHD entry kept for ring attention (``parallel/ring_attention.py``),
@@ -715,7 +774,7 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
     dqt, dkt, dvt = _flash_backward_pallas_bhsd(
         qt, kt, vt, gt, mask, lse, delta, segment_ids=segment_ids,
         kv_segment_ids=kv_segment_ids, causal=causal, interpret=interpret,
-        force_split=force_split,
+        force_split=force_split, window=window,
     )
     bsdh = lambda x: x.transpose(0, 2, 1, 3)
     return bsdh(dqt), bsdh(dkt), bsdh(dvt)
@@ -723,7 +782,8 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
 
 def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
                                 segment_ids=None, kv_segment_ids=None,
-                                causal, interpret, force_split=False):
+                                causal, interpret, force_split=False,
+                                window=None):
     """The dq/dk/dv kernels on BHSD operands; grads returned BHSD.
 
     Dispatch: the fused single-sweep kernel (one p-recompute) when the
@@ -768,6 +828,7 @@ def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
         kernel = _wrap_kernel(
             _bwd_fused_kernel, 6, extra_names,
             scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+            window=window,
         )
         dqt, dkt, dvt = pl.pallas_call(
             kernel,
@@ -822,6 +883,7 @@ def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
     dq_kernel = _wrap_kernel(
         _bwd_dq_kernel, 6, extra_names,
         scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+        window=window,
     )
 
     dqt = pl.pallas_call(
@@ -860,6 +922,7 @@ def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
     dkv_kernel = _wrap_kernel(
         _bwd_dkv_kernel, 6, extra_names2,
         scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+        window=window,
     )
 
     dkt, dvt = pl.pallas_call(
@@ -889,7 +952,7 @@ def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
 # --- Backward (blockwise XLA recompute from LSE — golden fallback) ----------
 
 
-def _flash_backward_xla(res, g, *, causal):
+def _flash_backward_xla(res, g, *, causal, window=None):
     q, k, v, mask, segment_ids, o, lse = res
     batch, seq, heads, depth = q.shape
     # Fixed 128-row blocks, deliberately NOT _pick_block_q: this path's
@@ -931,10 +994,15 @@ def _flash_backward_xla(res, g, *, causal):
         dk_acc, dv_acc = carry
         qb, gb, lseb, deltab, segb, blk = xs
         s = jnp.einsum("bqhd,bkhd->bhqk", qb, kf) * scale
-        if causal:
+        if causal or window is not None:
             q_pos = blk * block_q + jnp.arange(block_q)
-            s = jnp.where(q_pos[None, None, :, None] >= k_pos[None, None, None, :],
-                          s, NEG_INF)
+            keep = (
+                q_pos[:, None] >= k_pos[None, :] if causal
+                else jnp.ones((block_q, seq), bool)
+            )
+            if window is not None:
+                keep &= k_pos[None, :] > q_pos[:, None] - window
+            s = jnp.where(keep[None, None, :, :], s, NEG_INF)
         if mask is not None:
             s = jnp.where(mask[:, None, None, :], s, NEG_INF)
         if segment_ids is not None:
@@ -963,30 +1031,33 @@ def _flash_backward_xla(res, g, *, causal):
 # --- Public entry with custom VJP -------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash(q, k, v, mask, segment_ids, causal, interpret, backward_impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, mask, segment_ids, causal, interpret, backward_impl,
+           window):
     o, _ = _flash_forward(q, k, v, mask, segment_ids, causal=causal,
-                          interpret=interpret)
+                          interpret=interpret, window=window)
     return o
 
 
-def _flash_fwd(q, k, v, mask, segment_ids, causal, interpret, backward_impl):
+def _flash_fwd(q, k, v, mask, segment_ids, causal, interpret, backward_impl,
+               window):
     # Residuals are saved in the BHSD layout the kernels consume: the
     # forward already paid for these relayouts, and saving the BSHD
     # originals instead would make the backward re-emit all four
     # (profiled at ~6 ms/step of pure transposes, docs/LM_PERF.md).
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     o, lse, ot = _flash_forward_bhsd(qt, kt, vt, mask, segment_ids,
-                                     causal=causal, interpret=interpret)
+                                     causal=causal, interpret=interpret,
+                                     window=window)
     return o, (qt, kt, vt, mask, segment_ids, ot, lse)
 
 
-def _flash_bwd(causal, interpret, backward_impl, res, g):
+def _flash_bwd(causal, interpret, backward_impl, window, res, g):
     impl = backward_impl or BACKWARD_IMPL
     if impl in ("pallas", "pallas_split"):
         dq, dk, dv = _flash_backward_pallas(
             res, g, causal=causal, interpret=interpret,
-            force_split=(impl == "pallas_split"),
+            force_split=(impl == "pallas_split"), window=window,
         )
     else:
         qt, kt, vt, mask, segment_ids, ot, lse = res
@@ -996,7 +1067,8 @@ def _flash_bwd(causal, interpret, backward_impl, res, g):
             group = heads // kv_heads
             k, v = (jnp.repeat(x, group, axis=2) for x in (k, v))
         dq, dk, dv = _flash_backward_xla(
-            (q, k, v, mask, segment_ids, o, lse), g, causal=causal
+            (q, k, v, mask, segment_ids, o, lse), g, causal=causal,
+            window=window,
         )
         if kv_heads != heads:
             b, s, _, d = dk.shape
@@ -1009,7 +1081,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
-                    interpret=None, backward_impl=None):
+                    interpret=None, backward_impl=None, window=None):
     """Flash attention, BSHD layout; differentiable.
 
     ``mask`` is a padding mask (B, S) or (B, 1, 1, S), True = attend.
@@ -1021,6 +1093,11 @@ def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
     default, "pallas" = fused single-sweep kernel (split pair when the dq
     scratch exceeds VMEM budget), "pallas_split" = force the dq + dkv
     pair, "xla" = blockwise-recompute golden path.
+    ``window`` (int, requires ``causal=True``) enables sliding-window
+    attention: token i attends keys in ``(i - window, i]``.  Block pairs
+    entirely below the band are skipped outright, so cost scales
+    O(S * window) instead of O(S^2); ``window >= seq`` degrades to plain
+    causal.
     Raises ValueError for shapes/masks the kernel cannot handle (callers
     wanting silent fallback should go through
     ``ops.attention.dot_product_attention`` with ``implementation="auto"``).
@@ -1046,7 +1123,18 @@ def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
             f"segment_ids shape/dtype unsupported: need int (B, S), got "
             f"{segment_ids.shape} {segment_ids.dtype}"
         )
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True — "
+                "a lower-edge-only band has unbounded lookahead"
+            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window >= q.shape[1]:
+            window = None  # full causal attention; skip the dead masking
     if interpret is None:
         interpret = not _on_tpu()
     pad = _as_padding_mask(mask, q.shape)
-    return _flash(q, k, v, pad, segment_ids, causal, interpret, backward_impl)
+    return _flash(q, k, v, pad, segment_ids, causal, interpret,
+                  backward_impl, window)

@@ -178,6 +178,32 @@ def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
         yield {"categorical": cat, "dense": dense, "label": label}
 
 
+def _apply_gpt_overrides(cfg, *, seq, remat, attn_impl, xent_impl,
+                         kv_heads, attn_window):
+    """CLI/bench knob overrides shared by the gpt and gpt_moe families.
+
+    ONE definition so a new knob cannot be wired into one preset family
+    and silently ignored by the other (the historical failure mode of
+    the previously duplicated blocks).  remat: True/False = whole
+    blocks; "attn" = attention-only."""
+    if (remat is None and attn_impl is None and xent_impl is None
+            and kv_heads is None and attn_window is None
+            and seq <= cfg.max_seq):
+        return cfg
+    return dataclasses.replace(
+        cfg,
+        remat=cfg.remat if remat is None else remat is True,
+        remat_attn=remat == "attn",
+        attn_impl=attn_impl or cfg.attn_impl,
+        xent_impl=xent_impl or cfg.xent_impl,
+        num_kv_heads=(kv_heads if kv_heads is not None
+                      else cfg.num_kv_heads),
+        attn_window=(attn_window if attn_window is not None
+                     else cfg.attn_window),
+        max_seq=max(cfg.max_seq, seq),
+    )
+
+
 def get_workload(name: str, *, test_size: bool = False,
                  global_batch_size: int | None = None,
                  sp_scheme: str = "ring",
@@ -187,7 +213,8 @@ def get_workload(name: str, *, test_size: bool = False,
                  remat: bool | str | None = None,
                  attn_impl: str | None = None,
                  xent_impl: str | None = None,
-                 kv_heads: int | None = None) -> Workload:
+                 kv_heads: int | None = None,
+                 attn_window: int | None = None) -> Workload:
     """Build a preset by name.  ``test_size`` shrinks models for CI.
 
     ``sp_scheme`` picks the sequence-parallel attention used by ``gpt_lm``
@@ -371,20 +398,10 @@ def get_workload(name: str, *, test_size: bool = False,
             remat = "attn" if remat is None else remat
             attn_impl = attn_impl or "pallas"
         seq = seq_len or (64 if test_size else 2048)
-        if (remat is not None or attn_impl is not None
-                or xent_impl is not None or kv_heads is not None
-                or seq > cfg.max_seq):
-            # remat: True/False = whole blocks; "attn" = attention-only.
-            cfg = dataclasses.replace(
-                cfg,
-                remat=cfg.remat if remat is None else remat is True,
-                remat_attn=remat == "attn",
-                attn_impl=attn_impl or cfg.attn_impl,
-                xent_impl=xent_impl or cfg.xent_impl,
-                num_kv_heads=(kv_heads if kv_heads is not None
-                              else cfg.num_kv_heads),
-                max_seq=max(cfg.max_seq, seq),
-            )
+        cfg = _apply_gpt_overrides(
+            cfg, seq=seq, remat=remat, attn_impl=attn_impl,
+            xent_impl=xent_impl, kv_heads=kv_heads, attn_window=attn_window,
+        )
         gbs = global_batch_size or (8 if test_size else 64)
 
         def build(attn_fn=None):
@@ -521,20 +538,10 @@ def get_workload(name: str, *, test_size: bool = False,
 
         cfg = gpt_moe_tiny() if test_size else gpt_moe_small()
         seq = seq_len or (64 if test_size else 2048)
-        if (remat is not None or attn_impl is not None
-                or xent_impl is not None or kv_heads is not None
-                or seq > cfg.max_seq):
-            # remat: True/False = whole blocks; "attn" = attention-only.
-            cfg = dataclasses.replace(
-                cfg,
-                remat=cfg.remat if remat is None else remat is True,
-                remat_attn=remat == "attn",
-                attn_impl=attn_impl or cfg.attn_impl,
-                xent_impl=xent_impl or cfg.xent_impl,
-                num_kv_heads=(kv_heads if kv_heads is not None
-                              else cfg.num_kv_heads),
-                max_seq=max(cfg.max_seq, seq),
-            )
+        cfg = _apply_gpt_overrides(
+            cfg, seq=seq, remat=remat, attn_impl=attn_impl,
+            xent_impl=xent_impl, kv_heads=kv_heads, attn_window=attn_window,
+        )
         gbs = global_batch_size or (8 if test_size else 64)
         model = GPTMoELM(cfg)  # local (replicated) experts until for_mesh
 

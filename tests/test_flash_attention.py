@@ -128,7 +128,8 @@ def test_forced_pallas_raises_clear_errors():
     bad_mask = jnp.ones((2, 4, 128, 128), bool)  # full attention mask
     with pytest.raises(ValueError, match="mask shape"):
         flash_attention(q2, k2, v2, mask=bad_mask, interpret=True)
-    with pytest.raises(ValueError, match="matching BSHD"):
+    # mismatched seq between q and k/v: not even a valid GQA shape
+    with pytest.raises(ValueError, match="BSHD"):
         flash_attention(q2, k2[:, :64], v2, interpret=True)
 
 
@@ -297,3 +298,83 @@ def test_fused_backward_dispatch_budget(monkeypatch):
     grad_split = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(grad_fused, grad_split):
         np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+# --- Sliding-window attention ------------------------------------------------
+
+
+def _dense_swa_reference(q, k, v, window):
+    """Dense causal sliding-window attention (fp32 softmax)."""
+    b, s, h, d = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / (d ** 0.5)
+    qp = jnp.arange(s)[:, None]
+    kp = jnp.arange(s)[None, :]
+    keep = (qp >= kp) & (kp > qp - window)
+    scores = jnp.where(keep[None, None], scores, -1e9)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype), v)
+
+
+@pytest.mark.parametrize("window", [1, 7, 16, 33, 64, 100])
+def test_sliding_window_matches_dense(window, monkeypatch):
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_Q", "16")
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_K", "16")
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 64, 3, 16)) * 0.5, jnp.float32)
+        for _ in range(3)
+    )
+    got = flash_attention(q, k, v, causal=True, window=window,
+                          interpret=True)
+    want = _dense_swa_reference(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("impl", ["pallas", "pallas_split", "xla"])
+def test_sliding_window_grads_match_dense(impl, monkeypatch):
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_Q", "16")
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_K", "16")
+    rng = np.random.default_rng(1)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 48, 2, 8)) * 0.5, jnp.float32)
+        for _ in range(3)
+    )
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, window=13,
+                            interpret=True, backward_impl=impl)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            _dense_swa_reference(q, k, v, 13).astype(jnp.float32) ** 2
+        )
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_window_geq_seq_equals_plain_causal(monkeypatch):
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_Q", "16")
+    monkeypatch.setenv("DTFT_FLASH_BLOCK_K", "16")
+    rng = np.random.default_rng(2)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 32, 2, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    a = flash_attention(q, k, v, causal=True, window=32, interpret=True)
+    b = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_window_requires_causal():
+    q = jnp.zeros((1, 16, 2, 8))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, window=8, interpret=True)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, q, q, causal=True, window=0, interpret=True)

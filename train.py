@@ -208,6 +208,7 @@ def run_evaluator(args) -> None:
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
         kv_heads=args.kv_heads,
+        attn_window=args.attn_window,
         remat=REMAT_FLAG[args.remat],
     )
     if wl.eval_fn is None:
@@ -608,10 +609,16 @@ def main() -> None:
                    default=None,
                    help="LM presets: attention kernel (auto = Pallas flash"
                         " on TPU past the evidenced seq threshold)")
+    p.add_argument("--attn-window", type=int, default=None,
+                   help="sliding-window attention for the gpt family "
+                        "(token i sees the last N keys; None = full causal; "
+                        "flash kernels skip out-of-band blocks, decode masks "
+                        "the KV cache identically)")
     p.add_argument("--kv-heads", type=int, default=None,
-                   help="GQA: number of K/V heads for the gpt family "
-                        "(must divide the model's head count; shrinks the "
-                        "serving KV cache num_heads/kv_heads-fold)")
+                   help="GQA: number of K/V heads (gpt family and "
+                        "t5_seq2seq; must divide the model's head count; "
+                        "shrinks the serving KV cache "
+                        "num_heads/kv_heads-fold)")
     p.add_argument("--xent-impl",
                    choices=("auto", "chunked", "chunked_bf16", "fused"),
                    default=None,
@@ -704,6 +711,7 @@ def main() -> None:
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
         kv_heads=args.kv_heads,
+        attn_window=args.attn_window,
     )
     wl = apply_optimizer_flags(wl, args)
     spec = parse_mesh(args.mesh) or wl.mesh_spec
