@@ -12,7 +12,7 @@ seq/remat defaults unless the envs below explicitly override),
 ``BENCH_LM_BATCH`` per-chip batch (default 8), ``BENCH_LM_SEQ`` sequence
 length (gpt_lm default 1024), ``BENCH_LM_REMAT`` 0/1/attn (gpt_lm
 default 0 — the A100 anchor number is remat-off), ``BENCH_LM_ATTN`` /
-``BENCH_LM_XENT`` kernel selectors, ``BENCH_LM_INNER`` steps/dispatch.
+``BENCH_LM_XENT`` kernel selectors, ``BENCH_LM_WINDOW`` sliding-window size, ``BENCH_LM_INNER`` steps/dispatch.
 """
 
 from __future__ import annotations
@@ -79,10 +79,13 @@ def main() -> None:
         raise SystemExit(f"BENCH_LM_REMAT={remat_env!r}: expected 0, 1, or attn")
     attn_impl = os.environ.get("BENCH_LM_ATTN") or None
     xent_impl = os.environ.get("BENCH_LM_XENT") or None
+    window_env = os.environ.get("BENCH_LM_WINDOW")
+    attn_window = int(window_env) if window_env else None
     wl = get_workload(
         workload, test_size=test_size,
         global_batch_size=per_chip_batch * n_chips,
         seq_len=seq, remat=remat, attn_impl=attn_impl, xent_impl=xent_impl,
+        attn_window=attn_window,
     )
     wl = wl.for_mesh(mesh)
     if seq is None:  # resolved by the preset; recover it for data + MFU
@@ -146,6 +149,7 @@ def main() -> None:
             "global_batch": wl.global_batch_size,
             "remat": remat,
             "attn_impl": attn_label,
+            "attn_window": _cfg.attn_window,
             "xent_impl": xent_label,
             "steps_per_call": inner,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -196,6 +200,7 @@ def main() -> None:
         "global_batch": wl.global_batch_size,
         "remat": remat,
         "attn_impl": attn_label,
+        "attn_window": _cfg.attn_window,
         "xent_impl": xent_label,
         "step_time_ms": round(1000 * dt / n_opt_steps, 2),
         "steps_per_call": inner,

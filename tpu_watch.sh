@@ -148,6 +148,11 @@ while true; do
       # remat-free is the fastest measured config (21.2k tok/s).
       run lm_s32k     900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=32768 BENCH_LM_REMAT=0 python bench_lm.py \
         || { probe || break; }
+      # Sliding window at 32k (window 4096): the O(S*window) banded
+      # kernels vs the full-causal row above — the round-4 capability's
+      # headline evidence.
+      run lm_s32k_w4k 900 env BENCH_LM_BATCH=1 BENCH_LM_SEQ=32768 BENCH_LM_REMAT=0 BENCH_LM_WINDOW=4096 python bench_lm.py \
+        || { probe || break; }
       # GPT-2-medium: the higher-MFU preset (hidden 1024; adaptive tiles).
       run lm_medium   900 env BENCH_LM_WORKLOAD=gpt_medium_lm BENCH_LM_BATCH=8 python bench_lm.py \
         || { probe || break; }
