@@ -450,15 +450,22 @@ def run_ps_cluster_task(args, cluster, task_type, task_index) -> None:
         else task_index + len(chiefs)
     )
     # Bounded wait for the PS tier to come up (tasks start unordered).
+    # 180s, not 60: at 60 the 4-process e2e test flaked once under a
+    # fully loaded 1-core box (suite + watcher competing, 2026-08-01) —
+    # each PS process needs its own jax/numpy import before it binds,
+    # and those imports serialize under oversubscription.
+    # DTFT_PS_WAIT_S overrides (e.g. to shorten a deliberate
+    # unreachable-PS scenario).
     client = AsyncPSClient(ps_addrs, plan, worker_id=worker_id)
-    deadline = time.time() + 60
+    wait_s = float(os.environ.get("DTFT_PS_WAIT_S", "180"))
+    deadline = time.time() + wait_s
     while True:
         try:
             client.stats()
             break
         except PSUnavailableError:
             if time.time() > deadline:
-                raise SystemExit("PS tasks unreachable after 60s")
+                raise SystemExit(f"PS tasks unreachable after {wait_s:.0f}s")
             time.sleep(0.5)
     logging.info(
         "%s task %d = async worker %d/%d against ps=%s",
