@@ -73,6 +73,14 @@ MATRIX = [
                      "BENCH_LM_INNER": "4"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1",
                      "BENCH_LM_WORKLOAD": "gpt_medium_lm"}),
+    ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_WINDOW": "16"}),
+    # the long-context ladder's knob shape (seq/batch overrides, remat=0)
+    ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_SEQ": "64",
+                     "BENCH_LM_BATCH": "1", "BENCH_LM_REMAT": "0"}),
+    ("bench_generate.py", {"BENCH_GEN_TEST": "1"}),
+    ("bench_generate.py", {"BENCH_GEN_TEST": "1",
+                           "BENCH_GEN_KV_HEADS": "2"}),
+    ("bench_attn.py", {"BENCH_ATTN_SEQS": "256", "BENCH_ATTN_STEPS": "2"}),
     ("bench.py", {"BENCH_TEST": "1"}),
     ("bench.py", {"BENCH_TEST": "1", "BENCH_INNER": "2"}),
     ("bench_bert.py", {"BENCH_BERT_TEST": "1"}),
