@@ -77,6 +77,10 @@ MATRIX = [
     # the long-context ladder's knob shape (seq/batch overrides, remat=0)
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_SEQ": "64",
                      "BENCH_LM_BATCH": "1", "BENCH_LM_REMAT": "0"}),
+    # the windowed 32k row's exact knob combination (lm_s32k_w4k)
+    ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_SEQ": "64",
+                     "BENCH_LM_BATCH": "1", "BENCH_LM_REMAT": "0",
+                     "BENCH_LM_WINDOW": "16"}),
     ("bench_generate.py", {"BENCH_GEN_TEST": "1"}),
     ("bench_generate.py", {"BENCH_GEN_TEST": "1",
                            "BENCH_GEN_KV_HEADS": "2"}),

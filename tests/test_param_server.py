@@ -195,8 +195,14 @@ def test_tf_config_ps_cluster_end_to_end():
         "chief": [f"127.0.0.1:{ports[2]}"],
         "worker": [f"127.0.0.1:{ports[3]}"],
     }
+    # idle-timeout 360, not 120: the ps tier's idle clock ticks from
+    # startup, and under a fully loaded box (suite + watcher) the four
+    # children's jax imports serialize — at 120 the ps tasks gave up
+    # before the workers finished importing (observed 2026-08-01, twice:
+    # workers then report "PS tasks unreachable").  The 420s communicate
+    # timeout below still bounds orphaned processes.
     flags = ["--workload", "widedeep", "--test-size", "--steps", "4",
-             "--batch-size", "32", "--idle-timeout", "120"]
+             "--batch-size", "32", "--idle-timeout", "360"]
     procs = []
     outs = []
     try:
