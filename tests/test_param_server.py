@@ -210,6 +210,11 @@ def test_tf_config_ps_cluster_end_to_end():
                                  ("worker", 0)):
             env = dict(os.environ)
             env.pop("XLA_FLAGS", None)  # no virtual devices in the children
+            # Workers' PS-reachability wait: the default 180s expired
+            # once under full-suite load (2026-08-01 run 4) — all four
+            # children's jax imports AND widedeep model builds serialize
+            # on this 1-core box before the ps tier binds.
+            env["DTFT_PS_WAIT_S"] = "360"
             env["TF_CONFIG"] = json.dumps(
                 {"cluster": cluster,
                  "task": {"type": task_type, "index": index}}
@@ -219,7 +224,8 @@ def test_tf_config_ps_cluster_end_to_end():
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             ))
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            # 600s: must exceed the 360s worker wait + import/build time.
+            out, _ = p.communicate(timeout=600)
             outs.append(out)
             assert p.returncode == 0, out[-1500:]
     finally:  # a hung/failed task must not orphan its peers
