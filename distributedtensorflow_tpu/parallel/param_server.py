@@ -387,16 +387,28 @@ class PSServer:
         total_updates: int | None = None,
         *,
         idle_timeout_s: float | None = None,
+        startup_grace_s: float | None = None,
         poll_s: float = 0.2,
     ) -> int:
         """Block this thread until the shard has absorbed ``total_updates``
-        pushes, ``stop`` arrives, or no push for ``idle_timeout_s`` —
-        measured from serve start when none has landed yet, so a ps task
-        whose workers all died before the first push still exits.  The
+        pushes, ``stop`` arrives, or no push for ``idle_timeout_s``.  The
         standalone-PS-task loop for the cluster launcher path (reference: a
         ps task blocks in ``server.join()``, SURVEY.md §1 L7
-        run_distributed.sh / §5.6 TF_CONFIG).  Returns the final version."""
+        run_distributed.sh / §5.6 TF_CONFIG).  Returns the final version.
+
+        Before the FIRST push the clock uses ``startup_grace_s`` instead
+        (None = idle_timeout_s): cluster tasks start unordered and the
+        workers' interpreter/model startup can far exceed a reasonable
+        steady-state idle bound — with one clock for both, the ps tier
+        gives up exactly when slow workers are about to connect and the
+        cluster deadlocks into "PS tasks unreachable" (observed three
+        times under a loaded 1-core box, 2026-08-01, at every deadline
+        tried: the race scales with the numbers).  A dead cluster still
+        exits: the grace is finite, just sized for startup rather than
+        steady-state idleness."""
         done_since: float | None = None
+        with self._lock:
+            first_version = self._version
         while True:
             with self._lock:
                 version = self._version
@@ -409,13 +421,16 @@ class PSServer:
             # drain is CAPPED: a peer that wedged mid-request (half-open
             # TCP, stalled host) must not pin the ps task forever — after
             # _DRAIN_CAP_S we return anyway and let stop() reset it.
+            no_push_yet = version == first_version
+            bound = (
+                startup_grace_s
+                if (no_push_yet and startup_grace_s is not None)
+                else idle_timeout_s
+            )
             done = (
                 (total_updates is not None and version >= total_updates)
                 or self._stopping.is_set()
-                or (
-                    idle_timeout_s is not None
-                    and time.monotonic() - last > idle_timeout_s
-                )
+                or (bound is not None and time.monotonic() - last > bound)
             )
             if done:
                 if done_since is None:

@@ -373,3 +373,35 @@ def test_wedged_peer_cannot_pin_serve_until():
         wedge.close()
     finally:
         server.stop()
+
+
+def test_serve_until_startup_grace_outlives_idle_timeout():
+    """Before the first push the ps task waits ``startup_grace_s``, not
+    ``idle_timeout_s`` — the fix for the startup race where a ps tier
+    idles out exactly while slow workers are still booting.  After the
+    first push the strict idle clock applies."""
+    import threading
+
+    server = PSServer({}, lambda: optax.sgd(0.1), port=0)
+    out = {}
+
+    def run():
+        t0 = time.monotonic()
+        out["version"] = server.serve_until(
+            None, idle_timeout_s=0.4, startup_grace_s=3.0, poll_s=0.05
+        )
+        out["elapsed"] = time.monotonic() - t0
+
+    th = threading.Thread(target=run, daemon=True)
+    try:
+        th.start()
+        # At 1s (far past idle_timeout_s) the server must still be
+        # alive: no push has landed, so the grace clock governs.
+        time.sleep(1.0)
+        assert th.is_alive(), "ps task idled out during the startup grace"
+        th.join(timeout=10)
+        assert not th.is_alive()
+        # It exited via the grace bound (>= 3s), not the idle bound.
+        assert out["elapsed"] >= 2.9, out
+    finally:
+        server.stop()

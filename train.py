@@ -370,6 +370,13 @@ def run_async_ps(args) -> None:
                          args.target_metric, args.target_value, got)
 
 
+def _ps_wait_s() -> float:
+    """Worker-side PS-reachability wait (seconds).  ONE definition: the
+    ps tier's startup grace is derived from this same number so the two
+    clocks cannot silently diverge (the startup-race deadlock class)."""
+    return float(os.environ.get("DTFT_PS_WAIT_S", "180"))
+
+
 def run_ps_cluster_task(args, cluster, task_type, task_index) -> None:
     """One task of a TF_CONFIG parameter-server cluster.
 
@@ -436,8 +443,16 @@ def run_ps_cluster_task(args, cluster, task_type, task_index) -> None:
             task_index, num_ps, len(shards[task_index]),
             ps_addrs[task_index], total,
         )
+        # Startup grace: cover the workers' own bounded reachability
+        # wait (DTFT_PS_WAIT_S) plus build slack, so the ps tier never
+        # idles out while a slow worker is still starting (both clocks
+        # race otherwise — see PSServer.serve_until).
+        grace = max(
+            float(args.idle_timeout or 0),
+            _ps_wait_s() + 120,
+        )
         version = server.serve_until(
-            total, idle_timeout_s=args.idle_timeout
+            total, idle_timeout_s=args.idle_timeout, startup_grace_s=grace
         )
         logging.info("ps task %d done at version %d", task_index, version)
         server.stop()
@@ -457,7 +472,7 @@ def run_ps_cluster_task(args, cluster, task_type, task_index) -> None:
     # DTFT_PS_WAIT_S overrides (e.g. to shorten a deliberate
     # unreachable-PS scenario).
     client = AsyncPSClient(ps_addrs, plan, worker_id=worker_id)
-    wait_s = float(os.environ.get("DTFT_PS_WAIT_S", "180"))
+    wait_s = _ps_wait_s()
     deadline = time.time() + wait_s
     while True:
         try:
