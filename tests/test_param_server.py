@@ -223,11 +223,29 @@ def test_tf_config_ps_cluster_end_to_end():
                 [sys.executable, "train.py", *flags], cwd=repo, env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             ))
+        roles = ["ps0", "ps1", "chief", "worker"]
         for p in procs:
             # 600s: must exceed the 360s worker wait + import/build time.
             out, _ = p.communicate(timeout=600)
             outs.append(out)
-            assert p.returncode == 0, out[-1500:]
+        # Collect EVERY task's tail before asserting: the first-failure
+        # assert used to show only one child's output, and the ~1.8 KB
+        # XLA cpu-AOT banner swallowed even that — three suite-context
+        # failures went undiagnosable (2026-08-01).  The digest strips
+        # banner lines and labels each task.
+        def tail(out):
+            lines = [
+                ln for ln in out.splitlines()
+                if "cpu_aot_loader" not in ln and "machine features" not in ln
+            ]
+            return "\n".join(lines[-6:])
+
+        digest = "\n".join(
+            f"--- {r} rc={p.returncode} ---\n{tail(o)}"
+            for r, p, o in zip(roles, procs, outs)
+        )
+        for r, p in zip(roles, procs):
+            assert p.returncode == 0, f"{r} failed\n{digest}"
     finally:  # a hung/failed task must not orphan its peers
         for p in procs:
             if p.poll() is None:
