@@ -241,6 +241,9 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
 
 
 def main() -> None:
+    from bench_probe import enable_compile_cache
+
+    enable_compile_cache()
     from bench_probe import (
         is_tpu_platform,
         persist_result,

@@ -23,10 +23,13 @@ import sys
 import time
 
 from bench_probe import (
+    enable_compile_cache,
     is_tpu_platform,
     persist_result,
     probe_devices_with_retries,
 )
+
+enable_compile_cache()
 
 
 def bench_one(fn, args, n_steps: int, repeats: int = 3) -> float:

@@ -18,6 +18,9 @@ import os
 import time
 
 from bench_probe import probe_devices_with_retries
+from bench_probe import enable_compile_cache
+
+enable_compile_cache()
 
 if not probe_devices_with_retries("bench_bert"):
     raise SystemExit(2)

@@ -98,6 +98,9 @@ def median_rate(measure_once, total: int) -> int:
 
 
 def main() -> None:
+    from bench_probe import enable_compile_cache
+
+    enable_compile_cache()
     from bench_probe import persist_result
 
     from distributedtensorflow_tpu.native.recordio import RecordReader

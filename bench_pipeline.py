@@ -46,6 +46,9 @@ import optax  # noqa: E402
 
 
 def main() -> None:
+    from bench_probe import enable_compile_cache
+
+    enable_compile_cache()
     from distributedtensorflow_tpu.models.gpt import (
         GPTConfig,
         GPTLM,

@@ -26,13 +26,30 @@ RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # --- persistent compilation cache (VERDICT r3 #1) ---------------------------
 # Round 3 lost its one tunnel window to compiles; with the persistent cache
 # every compile survives across processes AND windows, so a re-opened window
-# starts from warm XLA binaries.  bench_probe is imported BEFORE jax by every
-# bench script, so setdefault here wires the whole bench/watcher fleet (env
-# beats config-update: it reaches the probe subprocesses too).  min-compile-
-# time/entry-size 0 = cache everything, incl. the probe's tiny canary (whose
-# cross-process cache hit is the liveness proof for the wiring itself).
+# starts from warm XLA binaries.  Every bench script calls
+# enable_compile_cache() explicitly in its prologue (the env vars also reach
+# the probe subprocesses); tpu_watch.sh exports the same values itself.
+# min-compile-time/entry-size 0 = cache everything, incl. the probe's tiny
+# canary (whose cross-process cache hit is the liveness proof for the
+# wiring itself).
 _CACHE_DIR = os.path.join(RESULTS_DIR, ".jax_cache")
-if os.environ.get("BENCH_NO_COMPILE_CACHE") != "1":
+
+
+def enable_compile_cache() -> None:
+    """Persistent-XLA-cache env + live-config defaults for BENCH runs.
+
+    Called EXPLICITLY by the bench scripts (and exported equivalently by
+    tpu_watch.sh) — NOT at import.  This used to run as an import side
+    effect, and anything that imported bench_probe inherited the
+    mutation: the pytest process imported it (tests/test_bench_smoke),
+    its env leaked to every later test's subprocesses, and the
+    PS-cluster e2e's four children then serialized on the shared cache's
+    file locks (min_compile_time 0 = every tiny executable locks the
+    dir) — the suite-only "PS tasks unreachable" deadlock of
+    2026-08-01, undiagnosable for four runs.  Import side effects that
+    mutate os.environ travel to child processes; don't."""
+    if os.environ.get("BENCH_NO_COMPILE_CACHE") == "1":
+        return
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")

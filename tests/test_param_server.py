@@ -210,6 +210,17 @@ def test_tf_config_ps_cluster_end_to_end():
                                  ("worker", 0)):
             env = dict(os.environ)
             env.pop("XLA_FLAGS", None)  # no virtual devices in the children
+            # Children must not inherit a persistent-compile-cache setup
+            # (suite-context leak class: four children serializing on the
+            # shared cache's file locks deadlocked this test for four
+            # full-suite runs, 2026-08-01) nor the axon TPU platform (the
+            # ps cluster is host-side by design and the tunnel may be
+            # down).
+            for k in list(env):
+                if k.startswith(("JAX_COMPILATION_CACHE",
+                                 "JAX_PERSISTENT_CACHE")):
+                    env.pop(k)
+            env["JAX_PLATFORMS"] = "cpu"
             # Workers' PS-reachability wait: the default 180s expired
             # once under full-suite load (2026-08-01 run 4) — all four
             # children's jax imports AND widedeep model builds serialize

@@ -45,6 +45,9 @@ def timed(fn, args, iters=12):
 
 
 def main():
+    from bench_probe import enable_compile_cache
+
+    enable_compile_cache()
     b = int(os.environ.get("SWEEP_BATCH", 16))
     h = int(os.environ.get("SWEEP_HEADS", 12))
     s = int(os.environ.get("SWEEP_SEQ", 1024))
