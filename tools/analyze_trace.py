@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Mine a jax.profiler trace into a per-op-family time table.
+
+The chip-free half of the profile-driven perf loop (docs/LM_PERF.md):
+`train.py --profile-dir` drops `plugins/profile/<ts>/*.trace.json.gz`;
+this tool aggregates the device lane's complete events by fusion family
+(trailing `.N` suffixes stripped) so a step's time budget reads as a
+dozen lines instead of a 5500-event trace.  The round-4 step-anatomy
+tables (head bwd 27.9 ms, attn 29 ms, LN-shaped fusions 16.6 ms, copies
+11.8 ms) came from exactly this aggregation.
+
+Usage:
+    python tools/analyze_trace.py BENCH_RESULTS/profile_lm_tpu [--steps N]
+
+`--steps` divides totals into per-step numbers (default: infer from the
+`jit_step` event count on the device lane; pass explicitly when the
+profile window covers partial steps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(
+        os.path.join(path, "plugins", "profile", "*", "*.trace.json.gz")
+    ))
+    if not hits:
+        raise SystemExit(f"no *.trace.json.gz under {path}")
+    return hits[-1]  # newest capture
+
+
+def device_pid(trace: dict) -> int:
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = e["args"].get("name", "")
+            if "TPU" in name or "tpu" in name.lower():
+                return e["pid"]
+    raise SystemExit("no TPU device lane in trace (CPU-only profile?)")
+
+
+def analyze(trace_path: str, n_steps: int | None) -> None:
+    trace = json.load(gzip.open(trace_path))
+    pid = device_pid(trace)
+    events = [
+        e for e in trace["traceEvents"]
+        if e.get("ph") == "X" and e.get("pid") == pid
+    ]
+    if n_steps is None:
+        # Count the dominant jit_* computation only: one profile window
+        # can also hold jit_eval_step / init executions, and counting
+        # those would silently scale every per-step number.
+        jit_names = collections.Counter(
+            e["name"].split("(")[0] for e in events
+            if e["name"].startswith("jit_")
+        )
+        n_steps = max(jit_names.most_common(1)[0][1] if jit_names else 1, 1)
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for e in events:
+        name = e["name"]
+        if name.startswith("jit_") or re.fullmatch(r"\d+", name):
+            continue  # umbrellas / numeric lane markers, not leaf ops
+        fam = re.sub(r"\.\d+$", "", name)
+        agg[fam] += e.get("dur", 0)
+        cnt[fam] += 1
+    total = sum(agg.values())
+    print(f"trace: {trace_path}")
+    print(f"device leaf time: {total / 1000:.1f} ms over {n_steps} step(s) "
+          f"-> {total / n_steps / 1000:.2f} ms/step")
+    print(f"{'ms/step':>9}  {'ops/step':>8}  family")
+    for name, us in agg.most_common(30):
+        print(f"{us / n_steps / 1000:9.3f}  {cnt[name] // n_steps:8d}  "
+              f"{name[:90]}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="profile dir (or a .trace.json.gz file)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="profiled step count (default: count of the "
+                         "dominant jit_* computation's executions)")
+    args = ap.parse_args()
+    if args.steps is not None and args.steps < 1:
+        ap.error("--steps must be >= 1")
+    analyze(find_trace(args.path), args.steps)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
