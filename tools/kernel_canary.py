@@ -1,0 +1,102 @@
+"""Round-4 kernel-family compile canary (driven by tpu_watch.sh).
+
+Compiles each Pallas kernel family tiny on the CURRENT backend and
+prints a one-line pass/fail dict — on-chip Mosaic diagnosis without
+burning a tunnel window bisecting which kernel a failing bench row
+died in.  Runs standalone too: python tools/kernel_canary.py
+(add JAX_PLATFORMS=cpu off-chip; interpret-mode kernels then run).
+"""
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The axon sitecustomize force-selects the TPU platform over the
+# JAX_PLATFORMS env var; honor an explicit env request via the config
+# (must precede first backend use) so off-chip smokes don't touch the
+# tunnel.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+# Real tiling on the chip; tiny shapes off-chip (interpret mode runs at
+# Python speed — the full 1024-block kernels take >15 min on CPU, which
+# is useless for a smoke; the harness itself is what a CPU run checks).
+ON_TPU = jax.default_backend() in ("tpu", "axon")
+SEQ = 1024 if ON_TPU else 64
+SEQ_W = 2048 if ON_TPU else 128
+WIN = 512 if ON_TPU else 32
+TOK = 128 if ON_TPU else 32
+VOCAB = 1024 if ON_TPU else 256
+CACHE = 512 if ON_TPU else 64
+
+results = {}
+
+def try_one(name, fn):
+    try:
+        fn()
+        results[name] = "ok"
+    except Exception as e:  # noqa: BLE001 - diagnostic surface
+        results[name] = (type(e).__name__ + ": " + str(e))[:300]
+        traceback.print_exc()
+
+def ln():
+    from distributedtensorflow_tpu.ops.layernorm import layer_norm
+    x = jnp.ones((64, 256), jnp.bfloat16)
+    g = jnp.ones((256,), jnp.float32)
+    b = jnp.zeros((256,), jnp.float32)
+    out = jax.jit(lambda x: layer_norm(x, g, b, impl="pallas"))(x)
+    np.asarray(out[0, :1])  # fetch = sync on axon
+
+def flash_1k():
+    from distributedtensorflow_tpu.ops.flash_attention import flash_attention
+    q = jnp.ones((1, SEQ, 2, 64), jnp.bfloat16)
+    out = jax.jit(lambda q: flash_attention(q, q, q, causal=True))(q)
+    np.asarray(out[0, 0, 0, :1])
+
+def flash_window():
+    from distributedtensorflow_tpu.ops.flash_attention import flash_attention
+    q = jnp.ones((1, SEQ_W, 2, 64), jnp.bfloat16)
+    out = jax.jit(
+        lambda q: flash_attention(q, q, q, causal=True, window=WIN)
+    )(q)
+    np.asarray(out[0, 0, 0, :1])
+
+def flash_bwd():
+    from distributedtensorflow_tpu.ops.flash_attention import flash_attention
+    q = jnp.ones((1, SEQ, 2, 64), jnp.bfloat16)
+    g = jax.jit(jax.grad(
+        lambda q: flash_attention(q, q, q, causal=True).astype(
+            jnp.float32).sum()
+    ))(q)
+    np.asarray(g[0, 0, 0, :1])
+
+def fused_head():
+    from distributedtensorflow_tpu.ops.fused_xent import fused_softmax_xent
+    h = jnp.ones((2, TOK, 768), jnp.bfloat16)
+    w = jnp.ones((VOCAB, 768), jnp.bfloat16)
+    t = jnp.zeros((2, TOK), jnp.int32)
+    g = jax.jit(jax.grad(
+        lambda h: fused_softmax_xent(h, w, t).astype(jnp.float32)
+    ))(h)
+    np.asarray(g[0, 0, :1])
+
+def decode():
+    from distributedtensorflow_tpu.ops.attention import cached_decode_attention
+    q = jnp.ones((2, 1, 4, 64), jnp.bfloat16)
+    kn = jnp.ones((2, 1, 2, 64), jnp.bfloat16)  # GQA: 2 kv heads
+    ck = jnp.zeros((2, 2, CACHE, 64), jnp.bfloat16)
+    ix = jnp.zeros((), jnp.int32)
+    out = jax.jit(cached_decode_attention)(q, kn, kn, ck, ck, ix)[0]
+    np.asarray(out[0, 0, 0, :1])
+
+for name, fn in [("fused_layernorm", ln), ("flash_fwd_1k", flash_1k),
+                 ("flash_window", flash_window), ("flash_fused_bwd", flash_bwd),
+                 ("fused_head", fused_head), ("decode_kernel", decode)]:
+    try_one(name, fn)
+print("kernel_canary:", results)

@@ -67,6 +67,15 @@ run() {
   return 1
 }
 
+# Kernel-family diagnostic canary (NOT a gate): compiles each of the
+# round-4 kernels tiny on the real chip and logs per-kernel pass/fail.
+# Interpret mode validates neither Mosaic tiling nor VMEM (the fused-
+# head lesson, 2026-08-01): if a default-stack row dies, this log line
+# says WHICH kernel rejected without burning a window on bisection.
+kernel_canary() {
+  timeout 420 python /root/repo/tools/kernel_canary.py >> "$LOG" 2>&1
+}
+
 # Pallas canary: a tiny pallas_call must compile+run in 90s, else every
 # Pallas row this window would hang to its timeout — skip them all.
 pallas_ok() {
@@ -123,6 +132,11 @@ while true; do
       :  # all Pallas rows landed — don't spend window time on the canary
     elif pallas_ok; then
       log "pallas canary ok"
+      if [ ! -f "$STAMPS/kernel_canary" ]; then
+        if kernel_canary; then touch "$STAMPS/kernel_canary"; fi
+        log "kernel canary recorded (kernel_canary: line above)"
+        probe || break
+      fi
       # The round-4 headline stack IS the default: flash 1024-blocks +
       # fused CE head (112.9k tokens/s with in20 on 2026-08-01).
       run lm_auto       600 env BENCH_LM_BATCH=16 python bench_lm.py \
