@@ -38,20 +38,32 @@ CACHE = 512 if ON_TPU else 64
 results = {}
 
 def try_one(name, fn):
+    # Announce BEFORE and flush AFTER each kernel: a hang (the historic
+    # Pallas failure mode) kills the process via the watcher's timeout,
+    # and an end-only summary would leave zero diagnostics — the log
+    # must show which kernels passed and which one it was stuck in.
+    print(f"kernel_canary: {name} ...", flush=True)
     try:
         fn()
         results[name] = "ok"
     except Exception as e:  # noqa: BLE001 - diagnostic surface
         results[name] = (type(e).__name__ + ": " + str(e))[:300]
         traceback.print_exc()
+    print(f"kernel_canary: {name} -> {results[name]}", flush=True)
 
 def ln():
+    # fwd AND bwd at production width: the backward's grid-accumulated
+    # (1, D) dg/db outputs are the riskiest LN pattern on real Mosaic.
     from distributedtensorflow_tpu.ops.layernorm import layer_norm
-    x = jnp.ones((64, 256), jnp.bfloat16)
-    g = jnp.ones((256,), jnp.float32)
-    b = jnp.zeros((256,), jnp.float32)
-    out = jax.jit(lambda x: layer_norm(x, g, b, impl="pallas"))(x)
-    np.asarray(out[0, :1])  # fetch = sync on axon
+    d = 768 if ON_TPU else 128
+    x = jnp.ones((1024 if ON_TPU else 32, d), jnp.bfloat16)
+    g = jnp.ones((d,), jnp.float32)
+    b = jnp.zeros((d,), jnp.float32)
+    grad = jax.jit(jax.grad(
+        lambda x: layer_norm(x, g, b, impl="pallas").astype(
+            jnp.float32).sum()
+    ))(x)
+    np.asarray(grad[0, :1])  # fetch = sync on axon
 
 def flash_1k():
     from distributedtensorflow_tpu.ops.flash_attention import flash_attention

@@ -73,7 +73,7 @@ run() {
 # head lesson, 2026-08-01): if a default-stack row dies, this log line
 # says WHICH kernel rejected without burning a window on bisection.
 kernel_canary() {
-  timeout 420 python /root/repo/tools/kernel_canary.py >> "$LOG" 2>&1
+  timeout 420 python tools/kernel_canary.py >> "$LOG" 2>&1
 }
 
 # Pallas canary: a tiny pallas_call must compile+run in 90s, else every
@@ -133,8 +133,15 @@ while true; do
     elif pallas_ok; then
       log "pallas canary ok"
       if [ ! -f "$STAMPS/kernel_canary" ]; then
-        if kernel_canary; then touch "$STAMPS/kernel_canary"; fi
-        log "kernel canary recorded (kernel_canary: line above)"
+        # Stamp the ATTEMPT regardless of outcome — this is diagnosis,
+        # not a gate, and a hanging kernel must not re-spend 420s ahead
+        # of the priority rows in every subsequent window.
+        if kernel_canary; then
+          log "kernel canary done (per-kernel lines above)"
+        else
+          log "kernel canary FAILED/timed out (see partial lines above)"
+        fi
+        touch "$STAMPS/kernel_canary"
         probe || break
       fi
       # The round-4 headline stack IS the default: flash 1024-blocks +
