@@ -50,13 +50,77 @@ PEAK_FLOPS_BY_KIND = {
 #: Used when XLA's compiled cost analysis is unavailable on the backend.
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 12.3e9
 
+#: Peak HBM bandwidth (bytes/s) by device_kind substring (public specs).
+#: The resnet step is HBM-roofline-bound (docs/RESNET_PERF.md §1: 812 GB/s
+#: achieved = 99% of peak), so the roofline axis it lives on is bandwidth
+#: utilization, not MFU — emitted as ``hbm_bw_util`` alongside both MFUs.
+PEAK_HBM_BY_KIND = {
+    "v5 lite": 819e9,  # v5e
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+}
 
-def _peak_flops(device_kind: str) -> float:
+
+def _peak_lookup(table: dict, device_kind: str, default: float) -> float:
     kind = device_kind.lower()
-    for sub, peak in PEAK_FLOPS_BY_KIND.items():
+    for sub, peak in table.items():
         if sub in kind:
             return peak
-    return 197e12  # this sandbox's chip is a TPU v5 lite
+    return default  # this sandbox's chip is a TPU v5 lite
+
+
+def _peak_flops(device_kind: str) -> float:
+    return _peak_lookup(PEAK_FLOPS_BY_KIND, device_kind, 197e12)
+
+
+def _peak_hbm(device_kind: str) -> float:
+    return _peak_lookup(PEAK_HBM_BY_KIND, device_kind, 819e9)
+
+
+def apply_experiment_flags() -> dict:
+    """Apply the A/B compiler-flag env knobs (docs/RESNET_PERF.md §3 L1).
+
+    Must run BEFORE the first jax import in this process.  Appends
+    ``BENCH_LIBTPU_FLAGS`` to ``LIBTPU_INIT_ARGS`` and ``BENCH_XLA_FLAGS``
+    to ``XLA_FLAGS`` (runtime env of THIS bench process only — never an
+    import side effect; see the round-4 PS-deadlock post-mortem).
+    Returns the experiment-identifying fields for the result JSON.
+    """
+    fields = {}
+    libtpu = os.environ.get("BENCH_LIBTPU_FLAGS", "")
+    if libtpu:
+        if libtpu not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+            # Fallback only: the axon sitecustomize imports jax before any
+            # user module, so flags set here may land after plugin load.
+            # tpu_watch.sh therefore passes LIBTPU_INIT_ARGS itself on the
+            # command line (exists before the interpreter starts); this
+            # branch covers direct `BENCH_LIBTPU_FLAGS=... python bench.py`
+            # invocations, where lazy backend init usually still reads it.
+            os.environ["LIBTPU_INIT_ARGS"] = (
+                os.environ.get("LIBTPU_INIT_ARGS", "") + " " + libtpu
+            ).strip()
+        fields["libtpu_flags"] = libtpu
+    xla = os.environ.get("BENCH_XLA_FLAGS", "")
+    if xla:
+        if xla not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + xla
+            ).strip()
+        fields["xla_flags"] = xla
+    if os.environ.get("BENCH_S2D") == "1":
+        fields["space_to_depth"] = True
+    return fields
+
+
+def _is_experiment() -> bool:
+    """A/B rows must not compete with the headline cache (main())."""
+    return bool(
+        os.environ.get("BENCH_LIBTPU_FLAGS")
+        or os.environ.get("BENCH_XLA_FLAGS")
+        or os.environ.get("BENCH_S2D") == "1"
+    )
 
 
 #: Results within this window of the newest one count as the same sweep.
@@ -133,6 +197,8 @@ def _tunnel_outage_evidence(path: str | None = None) -> dict | None:
 
 def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
               image_size: int = 224) -> dict:
+    experiment_fields = apply_experiment_flags()  # before first jax import
+
     import jax
     import jax.numpy as jnp
 
@@ -159,7 +225,10 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
     platform = jax.devices()[0].platform
     device_kind = jax.devices()[0].device_kind
 
-    model = ResNet50(dtype=jnp.bfloat16)
+    model = ResNet50(
+        dtype=jnp.bfloat16,
+        space_to_depth=bool(experiment_fields.get("space_to_depth")),
+    )
     init_fn = lambda r: model.init(r, jnp.zeros((2, image_size, image_size, 3)))
     rng = jax.random.PRNGKey(0)
     state, specs = create_sharded_state(
@@ -203,8 +272,9 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         n_steps = -(-n_steps // inner)
         warmup = max(1, warmup // inner)
     compiled = step.lower(state, batch, rng).compile()
-    from bench_probe import mfu_fields, timed_steps
+    from bench_probe import compiled_cost, mfu_fields, timed_steps
 
+    cost = compiled_cost(compiled)
     state, dt = timed_steps(compiled, state, batch, rng,
                             n_steps=n_steps, warmup=warmup)
     images_per_sec = n_steps * inner * global_batch / dt
@@ -220,7 +290,15 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         * (image_size / 224.0) ** 2 / n_chips,
         "analytic_12.3GF_per_image",
         xla_flops_scale=inner,
+        cost=cost,
     )
+
+    # HBM roofline axis (docs/RESNET_PERF.md): achieved bandwidth from
+    # XLA's cost analysis over measured step time, as a fraction of peak.
+    hbm_bw_util = None
+    ba = float(cost.get("bytes accessed", 0)) if cost else 0.0
+    if ba > 0:
+        hbm_bw_util = (ba * inner * n_steps / dt) / _peak_hbm(device_kind)
 
     return {
         "metric": "resnet50_synthetic_imagenet_images_per_sec_per_chip",
@@ -228,6 +306,8 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 4),
         **mfu,
+        "hbm_bw_util": round(hbm_bw_util, 4) if hbm_bw_util else None,
+        **experiment_fields,
         "platform": platform,
         "device_kind": device_kind,
         "n_chips": n_chips,
@@ -255,6 +335,7 @@ def main() -> None:
         # and glacial at 224px), honestly labeled via platform/image_size
         result = run_bench(per_chip_batch=2, n_steps=2, warmup=1,
                            image_size=64)
+        result.update(fresh=True, age_s=0)
         print(json.dumps(result))
         return
 
@@ -264,8 +345,14 @@ def main() -> None:
             n_steps=int(os.environ.get("BENCH_STEPS", "30")),
             warmup=3,
         )
+        result.update(fresh=True, age_s=0)
         if is_tpu_platform(result["platform"]):
-            persist_result("resnet50", result)
+            # A/B experiment rows (flags / s2d) persist under a prefix the
+            # headline cache glob (resnet50_*) does not match, so an
+            # experiment can never masquerade as the driver metric.
+            persist_result(
+                "resnet50ab" if _is_experiment() else "resnet50", result
+            )
         print(json.dumps(result))
         return
 
@@ -276,6 +363,18 @@ def main() -> None:
             f"{cached['cached_from']}",
             file=sys.stderr,
         )
+        # Machine-distinguishable staleness at top level (VERDICT r4 #6):
+        # the driver gates on "fresh"/"age_s" without parsing the
+        # tunnel_outage block or cached_from.
+        cached["fresh"] = False
+        try:
+            import datetime
+
+            age = time.time() - datetime.datetime.fromisoformat(
+                cached["timestamp"]).timestamp()
+            cached["age_s"] = round(max(0.0, age))
+        except (KeyError, ValueError, TypeError):
+            cached["age_s"] = None
         cached["tunnel_outage"] = _tunnel_outage_evidence()
         print(json.dumps(cached))
         return
@@ -289,6 +388,7 @@ def main() -> None:
     result = run_bench(per_chip_batch=2, n_steps=2, warmup=1, image_size=64)
     result["platform"] = "cpu_fallback"
     result["vs_baseline"] = 0.0
+    result.update(fresh=True, age_s=0)
     print(json.dumps(result))
 
 

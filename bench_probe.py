@@ -240,9 +240,21 @@ def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
     return state, time.perf_counter() - t0
 
 
+def compiled_cost(compiled) -> dict | None:
+    """One best-effort ``cost_analysis()`` call, shared by every consumer
+    (mfu_fields, bench.py's hbm_bw_util) so the flaky-tunnel RPC is paid
+    once per executable and cannot return inconsistent outcomes."""
+    try:
+        return compiled.cost_analysis()
+    except Exception as e:
+        print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
+        return None
+
+
 def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,
                analytic_flops_per_step: float,
-               analytic_source: str, xla_flops_scale: float = 1.0) -> dict:
+               analytic_source: str, xla_flops_scale: float = 1.0,
+               cost: dict | None = None) -> dict:
     """Both MFU accountings for a bench result, as emit-ready fields.
 
     ``mfu_analytic`` divides ANALYTIC per-chip model FLOPs (6·N·D-style,
@@ -265,12 +277,10 @@ def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,
 
     peak = _peak_flops(device_kind)
     xla_mfu = None
-    try:
-        cost = compiled.cost_analysis()
-        if cost and cost.get("flops"):
-            xla_mfu = (float(cost["flops"]) * xla_flops_scale * n_steps / dt) / peak
-    except Exception as e:  # cost analysis is best-effort on the tunnel
-        print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
+    if cost is None:
+        cost = compiled_cost(compiled)
+    if cost and cost.get("flops"):
+        xla_mfu = (float(cost["flops"]) * xla_flops_scale * n_steps / dt) / peak
     analytic_mfu = (analytic_flops_per_step * n_steps / dt) / peak
     return {
         "mfu": round(analytic_mfu, 4),

@@ -193,7 +193,7 @@ def _apply_gpt_overrides(cfg, *, seq, remat, attn_impl, xent_impl,
     return dataclasses.replace(
         cfg,
         remat=cfg.remat if remat is None else remat is True,
-        remat_attn=remat == "attn",
+        remat_attn=cfg.remat_attn if remat is None else remat == "attn",
         attn_impl=attn_impl or cfg.attn_impl,
         xent_impl=xent_impl or cfg.xent_impl,
         num_kv_heads=(kv_heads if kv_heads is not None

@@ -153,6 +153,30 @@ def test_rope_relative_shift_invariance():
     np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), atol=1e-4)
 
 
+def test_rope_bf16_long_seq_tolerance():
+    """Pin the bf16 rope combine's precision at long context (ADVICE r4).
+
+    rope computes cos/sin tables and the rotate-combine in the compute
+    dtype (bf16 on the training path) — a measured round-4 bandwidth win.
+    The angles themselves are fp32 (rope_tables), which is what keeps
+    large positions sane: bf16 positions at 32k would round by ~128 and
+    the tables would be garbage.  This test bounds the bf16 path against
+    the fp32 reference at positions up to 32k with a pinned tolerance so
+    a regression that moves the trig or the position arithmetic to bf16
+    fails loudly instead of silently corrupting long-context runs."""
+    rng = jax.random.PRNGKey(7)
+    x = jax.random.normal(rng, (1, 8, 2, 64), jnp.float32)
+    # positions sampled across the full 32k range, not just the start
+    pos = jnp.asarray([[0, 1, 1023, 4096, 8191, 16384, 30000, 32767]])
+    ref = rope(x, pos, 1e4)  # fp32 end to end
+    got = rope(x.astype(jnp.bfloat16), pos, 1e4).astype(jnp.float32)
+    # bf16 rounding on x, the tables, and the combine: |x| ~ N(0,1) so
+    # absolute error ~ few * 2^-8.  4e-2 abs is the pinned budget; the
+    # bf16-angles failure mode this guards against produces O(1) errors.
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=0, atol=4e-2)
+
+
 def test_workload_trains_loss_falls(devices):
     wl = get_workload("gpt_lm", test_size=True, global_batch_size=8)
     from distributedtensorflow_tpu.data import InputContext, device_put_batch

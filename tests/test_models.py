@@ -52,6 +52,42 @@ def test_resnet50_param_count():
     assert 25_000_000 < total < 26_000_000
 
 
+def test_space_to_depth_stem_equivalence():
+    """The 4x4/s1 stem on space-to-depth input computes the SAME function
+    as the 7x7/s2 stem (docs/RESNET_PERF.md §3 L2): map W7[di,dj,c,o] onto
+    W4[p+2,q+2,(a*2+b)*3+c,o] via di=2p+a+3 and the outputs must match."""
+    from distributedtensorflow_tpu.models.resnet import ImageNetResNet
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, 64, 64, 3), jnp.float32)
+    ref = ImageNetResNet(stage_sizes=(1, 1), dtype=jnp.float32)
+    s2d = ImageNetResNet(stage_sizes=(1, 1), dtype=jnp.float32,
+                         space_to_depth=True)
+    vs_ref = ref.init(rng, x)
+    # Rebuild the s2d variables from the reference ones: identical except
+    # the stem kernel, which is re-laid-out per the (p,a) tap mapping.
+    w7 = vs_ref["params"]["Conv_0"]["kernel"]  # (7,7,3,64)
+    w4 = np.zeros((4, 4, 12, 64), np.float32)
+    for p in range(-2, 2):
+        for a in range(2):
+            di = 2 * p + a + 3
+            if not 0 <= di < 7:
+                continue
+            for q in range(-2, 2):
+                for b in range(2):
+                    dj = 2 * q + b + 3
+                    if not 0 <= dj < 7:
+                        continue
+                    w4[p + 2, q + 2, (a * 2 + b) * 3:(a * 2 + b) * 3 + 3] = \
+                        np.asarray(w7[di, dj])
+    vs_s2d = jax.tree.map(lambda v: v, vs_ref)  # shallow copy of the tree
+    vs_s2d["params"]["Conv_0"]["kernel"] = jnp.asarray(w4)
+    out_ref = ref.apply(vs_ref, x, train=False, mutable=False)
+    out_s2d = s2d.apply(vs_s2d, x, train=False, mutable=False)
+    np.testing.assert_allclose(np.asarray(out_ref), np.asarray(out_s2d),
+                               atol=1e-4, rtol=1e-4)
+
+
 def test_bert_tiny_mlm_loss_and_grads():
     cfg = bert_tiny()
     model = BertForMLM(cfg)

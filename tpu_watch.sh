@@ -122,6 +122,10 @@ while true; do
     # -- p2: headline refresh (non-LM benches are Pallas-free) -----------
     run resnet        900 python bench.py            || { probe || break; }
     run bert          900 python bench_bert.py       || { probe || break; }
+    # ResNet perf-loop A/Bs (docs/RESNET_PERF.md §3; persisted under
+    # resnet50ab_* so they never compete with the headline cache).
+    run resnet_s2d    900 env BENCH_S2D=1 python bench.py \
+      || { probe || break; }
     # -- p3: Pallas rows (the default stack), canary-gated ---------------
     pallas_missing=0
     for s in lm_auto lm_auto_in20 lm_s4096 lm_s8192 lm_s16k lm_s32k \
@@ -200,13 +204,32 @@ while true; do
     else
       log "pallas canary FAILED — skipping Pallas rows this window"
     fi
+    # Speculative compiler-flag A/Bs (docs/RESNET_PERF.md §3 L1), LAST:
+    # they may only spend surplus window time after every evidence row.  A
+    # nonexistent flag fails fast inside the timeout; Pallas-free.
+    # LIBTPU_INIT_ARGS is set HERE (before the interpreter starts): the
+    # axon sitecustomize imports jax ahead of user code, so bench.py
+    # setting it at runtime could miss plugin load.  BENCH_LIBTPU_FLAGS
+    # carries the same value for result labeling.
+    # Per-experiment compile-cache dirs: libtpu init flags are NOT part of
+    # the persistent-cache key (it hashes HLO + compile options), so
+    # sharing the headline cache would serve the un-flagged executable to
+    # the A/B (and vice versa), silently invalidating it.
+    run resnet_fl1  600 env "LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=65536" \
+      "BENCH_LIBTPU_FLAGS=--xla_tpu_scoped_vmem_limit_kib=65536" \
+      "JAX_COMPILATION_CACHE_DIR=$PWD/BENCH_RESULTS/.jax_cache_fl1" python bench.py \
+      || { probe || break; }
+    run resnet_fl2  600 env "LIBTPU_INIT_ARGS=--xla_tpu_rwb_fusion=false" \
+      "BENCH_LIBTPU_FLAGS=--xla_tpu_rwb_fusion=false" \
+      "JAX_COMPILATION_CACHE_DIR=$PWD/BENCH_RESULTS/.jax_cache_fl2" python bench.py \
+      || { probe || break; }
     break
   done
 
   missing=0
-  for s in lm_xla_cb16 conv_tpu resnet bert lm_auto lm_auto_in20 \
-           lm_medium lm_s4096 lm_s8192 lm_s16k lm_s32k attn_4k \
-           attn_16k32k profile_lm; do
+  for s in lm_xla_cb16 conv_tpu resnet resnet_s2d bert lm_auto \
+           lm_auto_in20 lm_medium lm_s4096 lm_s8192 lm_s16k lm_s32k \
+           attn_4k attn_16k32k profile_lm; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
