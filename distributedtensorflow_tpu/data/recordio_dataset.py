@@ -198,6 +198,7 @@ def repeated_record_dataset(
     *,
     batch_size: int | None = None,
     policy: str = "AUTO",
+    decode_fn: Callable[[bytes], Example] = decode_example,
     shuffle_buffer: int = 0,
     seed: int = 0,
     on_epoch=None,
@@ -213,7 +214,8 @@ def repeated_record_dataset(
         yielded = False
         for batch in record_dataset(
             files, ctx, batch_size=batch_size, policy=policy,
-            shuffle_buffer=shuffle_buffer, seed=seed + epoch,
+            decode_fn=decode_fn, shuffle_buffer=shuffle_buffer,
+            seed=seed + epoch,
         ):
             yielded = True
             yield batch

@@ -10,11 +10,20 @@ score/value contraction, softmax in float32.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e9  # large-negative in bf16-safe range (bf16 max ~3.4e38; 1e9 fine)
+
+#: Decode-step kernel selection: "auto" (Pallas single-token kernel where
+#: platform/VMEM allow) or "xla" (force the einsum lowering).  Seeded from
+#: the env; deliberately a MUTABLE module global, re-read at every trace:
+#: bench_generate._xla_relative swaps it between back-to-back compiles for
+#: the XLA-relative A/B (the decode claim hierarchy's primary axis), and
+#: tests monkeypatch it.  Do not cache or freeze it at import time.
+DECODE_IMPL = os.environ.get("DTF_DECODE_IMPL", "auto")
 
 
 def dot_product_attention(
@@ -111,7 +120,8 @@ def cached_decode_attention(
     # keeps the compiled XLA einsum path below — interpret emulation
     # there would serve real traffic at Python speed.
     platform = jax.devices()[0].platform
-    if (s_new == 1 and platform in ("tpu", "axon", "cpu")
+    if (DECODE_IMPL != "xla" and s_new == 1
+            and platform in ("tpu", "axon", "cpu")
             and max_seq * d * _decode_bytes_per_elem(cached_k.dtype.itemsize)
             <= _DECODE_VMEM_BUDGET):
         out = _pallas_decode_attention(

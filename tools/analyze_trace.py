@@ -49,6 +49,68 @@ def device_pid(trace: dict) -> int:
     raise SystemExit("no TPU device lane in trace (CPU-only profile?)")
 
 
+def _infer_steps(events: list) -> int:
+    """Step count = executions of the dominant jit_* computation (one
+    profile window can also hold jit_eval_step / init executions)."""
+    jit_names = collections.Counter(
+        e["name"].split("(")[0] for e in events
+        if e["name"].startswith("jit_")
+    )
+    return max(jit_names.most_common(1)[0][1] if jit_names else 1, 1)
+
+
+def analyze_bytes(trace_path: str, n_steps: int | None,
+                  peak_gbps: float) -> None:
+    """Roofline accounting: per-HLO-category time, bytes_accessed, and
+    achieved bandwidth (the docs/RESNET_PERF.md §1 methodology).
+
+    ``bytes_accessed`` comes from XLA's cost analysis embedded in the
+    trace args; for fusions it equals the sum of unique operand + output
+    sizes (each operand counted once), so category GB/s near the HBM peak
+    means the program is bandwidth-saturated and only graph-level traffic
+    cuts can speed it up."""
+    trace = json.load(gzip.open(trace_path))
+    pid = device_pid(trace)
+    all_events = [
+        e for e in trace["traceEvents"]
+        if e.get("ph") == "X" and e.get("pid") == pid
+    ]
+    if n_steps is None:
+        n_steps = _infer_steps(all_events)
+    events = [e for e in all_events if "long_name" in e.get("args", {})]
+    agg = collections.defaultdict(lambda: [0.0, 0.0, 0])  # us, GB, n
+    for e in events:
+        a = e["args"]
+        cat = a.get("hlo_category", "?")
+        fam = re.sub(r"\.\d+$", "", e["name"])
+        key = (cat, fam)
+        agg[key][0] += e.get("dur", 0)
+        agg[key][1] += float(a.get("bytes_accessed", 0)) / 1e9
+        agg[key][2] += 1
+    print(f"trace: {trace_path}")
+    print(f"{'ms/step':>8} {'GB/step':>8} {'GB/s':>7} {'n/step':>6}  "
+          "category / family")
+    tot_us = tot_gb = 0.0
+    for (cat, fam), (us, gb, n) in sorted(agg.items(),
+                                          key=lambda kv: -kv[1][0]):
+        # async-* categories (DMA slices etc.) overlap compute: their
+        # wall time is already inside other ops' windows and their bytes
+        # would double-book the streaming roofline — shown but untotaled.
+        if not cat.startswith("async"):
+            tot_us += us
+            tot_gb += gb
+        if us / n_steps / 1000 < 0.05:
+            continue
+        bw = gb / (us / 1e6) if us else 0.0
+        over = " (overlapped; untotaled)" if cat.startswith("async") else ""
+        print(f"{us / n_steps / 1000:8.3f} {gb / n_steps:8.3f} {bw:7.0f} "
+              f"{n // n_steps:6d}  {cat} / {fam[:60]}{over}")
+    avg_bw = tot_gb / (tot_us / 1e6) if tot_us else 0.0
+    print(f"TOTAL (sync): {tot_us / n_steps / 1000:.1f} ms/step, "
+          f"{tot_gb / n_steps:.1f} GB/step -> avg {avg_bw:.0f} GB/s "
+          f"({100 * avg_bw / peak_gbps:.0f}% of {peak_gbps:.0f} GB/s peak)")
+
+
 def analyze(trace_path: str, n_steps: int | None) -> None:
     trace = json.load(gzip.open(trace_path))
     pid = device_pid(trace)
@@ -57,14 +119,7 @@ def analyze(trace_path: str, n_steps: int | None) -> None:
         if e.get("ph") == "X" and e.get("pid") == pid
     ]
     if n_steps is None:
-        # Count the dominant jit_* computation only: one profile window
-        # can also hold jit_eval_step / init executions, and counting
-        # those would silently scale every per-step number.
-        jit_names = collections.Counter(
-            e["name"].split("(")[0] for e in events
-            if e["name"].startswith("jit_")
-        )
-        n_steps = max(jit_names.most_common(1)[0][1] if jit_names else 1, 1)
+        n_steps = _infer_steps(events)
     agg = collections.Counter()
     cnt = collections.Counter()
     for e in events:
@@ -90,10 +145,18 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=None,
                     help="profiled step count (default: count of the "
                          "dominant jit_* computation's executions)")
+    ap.add_argument("--bytes", action="store_true",
+                    help="roofline mode: per-HLO-category bytes_accessed "
+                         "+ achieved GB/s (docs/RESNET_PERF.md §1)")
+    ap.add_argument("--peak-gbps", type=float, default=819.0,
+                    help="HBM peak for the %%-of-peak line (default v5e)")
     args = ap.parse_args()
     if args.steps is not None and args.steps < 1:
         ap.error("--steps must be >= 1")
-    analyze(find_trace(args.path), args.steps)
+    if args.bytes:
+        analyze_bytes(find_trace(args.path), args.steps, args.peak_gbps)
+    else:
+        analyze(find_trace(args.path), args.steps)
 
 
 if __name__ == "__main__":

@@ -126,6 +126,11 @@ while true; do
     # resnet50ab_* so they never compete with the headline cache).
     run resnet_s2d    900 env BENCH_S2D=1 python bench.py \
       || { probe || break; }
+    # Input-pipeline-in-the-loop headline (VERDICT r4 #3): records ->
+    # native reader -> Prefetcher -> chip; first run also writes the
+    # record shards (~300 MB, reused after).
+    run resnet_records 1200 env BENCH_INPUT=records python bench.py \
+      || { probe || break; }
     # -- p3: Pallas rows (the default stack), canary-gated ---------------
     pallas_missing=0
     for s in lm_auto lm_auto_in20 lm_s4096 lm_s8192 lm_s16k lm_s32k \
@@ -154,12 +159,14 @@ while true; do
         || { probe || break; }
       run lm_auto_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
         || { probe || break; }
-      # Serving decode: the round-4 lane-major MXU kernel (bench_generate
-      # dispatches the Pallas decode path on TPU).
-      run generate      900 python bench_generate.py || { probe || break; }
+      # Serving decode, round-5 evidence discipline (VERDICT r4 #4):
+      # median-of-3 per point, batch(1/4/16/64) x cache(1k/4k) scaling
+      # curve, XLA-relative A/B at the headline point (primary claim).
+      run generate     1500 env BENCH_GEN_CURVE=1 python bench_generate.py \
+        || { probe || break; }
       # GQA decode A/B: kv_heads=2 shrinks the per-step cache stream 6x
       # (12 q heads share 2 kv heads) — the decode step's binding HBM
-      # cost; random weights, pure speed row.
+      # cost; random weights, pure speed row.  Median-of-3 + XLA A/B.
       run generate_gqa  900 env BENCH_GEN_KV_HEADS=2 python bench_generate.py \
         || { probe || break; }
       # Long-context ladder, defaults end-to-end.
@@ -215,11 +222,15 @@ while true; do
     # the persistent-cache key (it hashes HLO + compile options), so
     # sharing the headline cache would serve the un-flagged executable to
     # the A/B (and vice versa), silently invalidating it.
-    run resnet_fl1  600 env "LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=65536" \
+    # Append to (not replace) any inherited LIBTPU_INIT_ARGS so the A/B
+    # differs from baseline in exactly the one flag under test.
+    run resnet_fl1  600 env \
+      "LIBTPU_INIT_ARGS=${LIBTPU_INIT_ARGS:+$LIBTPU_INIT_ARGS }--xla_tpu_scoped_vmem_limit_kib=65536" \
       "BENCH_LIBTPU_FLAGS=--xla_tpu_scoped_vmem_limit_kib=65536" \
       "JAX_COMPILATION_CACHE_DIR=$PWD/BENCH_RESULTS/.jax_cache_fl1" python bench.py \
       || { probe || break; }
-    run resnet_fl2  600 env "LIBTPU_INIT_ARGS=--xla_tpu_rwb_fusion=false" \
+    run resnet_fl2  600 env \
+      "LIBTPU_INIT_ARGS=${LIBTPU_INIT_ARGS:+$LIBTPU_INIT_ARGS }--xla_tpu_rwb_fusion=false" \
       "BENCH_LIBTPU_FLAGS=--xla_tpu_rwb_fusion=false" \
       "JAX_COMPILATION_CACHE_DIR=$PWD/BENCH_RESULTS/.jax_cache_fl2" python bench.py \
       || { probe || break; }
@@ -227,9 +238,9 @@ while true; do
   done
 
   missing=0
-  for s in lm_xla_cb16 conv_tpu resnet resnet_s2d bert lm_auto \
-           lm_auto_in20 lm_medium lm_s4096 lm_s8192 lm_s16k lm_s32k \
-           attn_4k attn_16k32k profile_lm; do
+  for s in lm_xla_cb16 conv_tpu resnet resnet_s2d resnet_records bert \
+           lm_auto lm_auto_in20 lm_medium lm_s4096 lm_s8192 lm_s16k \
+           lm_s32k attn_4k attn_16k32k profile_lm generate generate_gqa; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
