@@ -130,10 +130,16 @@ def main() -> None:
         "steps_per_call": inner,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
+    # Flash-threshold experiment rows (DTF_MIN_SEQ_FOR_PALLAS, the
+    # attn_512/BERT A/B) label themselves and persist under bertab_* so
+    # they never compete with the headline bert_* cache.
+    flash_thresh = os.environ.get("DTF_MIN_SEQ_FOR_PALLAS")
+    if flash_thresh:
+        result["min_seq_for_pallas"] = int(flash_thresh)
     from bench_probe import is_tpu_platform, persist_result
 
     if is_tpu_platform(result["platform"]) and not test_size:
-        persist_result("bert", result)
+        persist_result("bertab" if flash_thresh else "bert", result)
     print(json.dumps(result))
 
 

@@ -50,26 +50,27 @@ if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
 
-def _decode_tokens_per_sec(cfg, params, prompt, new: int, iters: int) -> float:
-    """One timed trial: `iters` full decode calls, one final fetch."""
+def _median_point(cfg, params, prompt, new: int, iters: int,
+                  trials: int = 3) -> dict:
+    """Median-of-N steady-state trials + relative spread for one point.
+
+    Compiles ONCE and warms before the first trial (tunnel window time is
+    the scarce resource — re-jitting per trial would triple the compile
+    bill); the N trials then measure steady-state run-to-run variance,
+    which is what the +23% round-4 spread was."""
     from distributedtensorflow_tpu.models.generate import generate
 
     run = jax.jit(lambda p, ids: generate(p, ids, cfg=cfg, max_new_tokens=new))
     out = run(params, prompt)          # compile + warm
     float(np.asarray(out)[0, -1])      # fetch = sync (axon: no block_until)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run(params, prompt)
-    float(np.asarray(out)[0, -1])
-    dt = time.perf_counter() - t0
-    return iters * prompt.shape[0] * new / dt
-
-
-def _median_point(cfg, params, prompt, new: int, iters: int,
-                  trials: int = 3) -> dict:
-    """Median-of-N trials + relative spread for one operating point."""
-    vals = [_decode_tokens_per_sec(cfg, params, prompt, new, iters)
-            for _ in range(trials)]
+    vals = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run(params, prompt)
+        float(np.asarray(out)[0, -1])
+        vals.append(iters * prompt.shape[0] * new
+                    / (time.perf_counter() - t0))
     med = statistics.median(vals)
     return {
         "tokens_per_sec": round(med, 1),
@@ -78,24 +79,34 @@ def _median_point(cfg, params, prompt, new: int, iters: int,
     }
 
 
-def _setup(cfg, b: int, prompt_len: int):
+def _init_params(cfg):
+    """Params are batch-independent — init once per cfg, share across the
+    batch sweep."""
     from distributedtensorflow_tpu.models import GPTLM
 
-    model = GPTLM(cfg)
-    prompt = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, size=(b, prompt_len)
-    ).astype(np.int32)
-    params = model.init(
-        jax.random.PRNGKey(0), prompt[:, :1], deterministic=True
+    ids = np.zeros((1, 1), np.int32)
+    return GPTLM(cfg).init(
+        jax.random.PRNGKey(0), ids, deterministic=True
     )["params"]
-    return params, jax.numpy.asarray(prompt)
 
 
-def _xla_relative(cfg, params, prompt, new: int, iters: int) -> dict:
-    """Default-stack vs forced-XLA decode, back to back (primary claim)."""
+def _make_prompt(cfg, b: int, prompt_len: int):
+    return jax.numpy.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(b, prompt_len)
+    ).astype(np.int32))
+
+
+def _xla_relative(cfg, params, prompt, new: int, iters: int,
+                  default_pt: dict | None = None) -> dict:
+    """Default-stack vs forced-XLA decode, back to back (primary claim).
+
+    ``default_pt`` reuses an already-measured default-path point (curve
+    mode measures the headline grid point anyway) so only the XLA side
+    pays a fresh compile."""
     from distributedtensorflow_tpu.ops import attention
 
-    default_pt = _median_point(cfg, params, prompt, new, iters)
+    if default_pt is None:
+        default_pt = _median_point(cfg, params, prompt, new, iters)
     prev = attention.DECODE_IMPL
     attention.DECODE_IMPL = "xla"
     try:
@@ -131,22 +142,30 @@ def main() -> None:
         iters = 2 if test_size else 4
         batches = (1, 2) if test_size else (1, 4, 16, 64)
         caches = (64,) if test_size else (1024, 4096)
+        hb, hc = (batches[-1], caches[0]) if test_size else (16, 1024)
         points = []
+        head_pt = None
         for cache in caches:
             # max_seq == cache EXACTLY: decode cost scales with the
             # allocated cache buffer (both kernels stream all max_seq
             # entries), so a larger buffer would mislabel the point.
             ccfg = dataclasses.replace(cfg, max_seq=cache)
+            params = _init_params(ccfg)  # batch-independent; once per cfg
             for b in batches:
-                params, prompt = _setup(ccfg, b, cache - new)
+                prompt = _make_prompt(ccfg, b, cache - new)
                 pt = _median_point(ccfg, params, prompt, new, iters)
                 points.append({"batch": b, "cache_len": cache, **pt})
-        # headline point (bs16 cache1024 in the real grid) + its XLA A/B
-        hb, hc = (batches[-1], caches[0]) if test_size else (16, 1024)
+                if (b, cache) == (hb, hc):
+                    head_pt = pt
+        # headline point's XLA A/B: reuse the grid measurement for the
+        # default side; only the forced-XLA side compiles fresh.
         ccfg = dataclasses.replace(cfg, max_seq=hc)
-        params, prompt = _setup(ccfg, hb, hc - new)
-        head = (_xla_relative if want_ab else _median_point)(
-            ccfg, params, prompt, new, iters)
+        params = _init_params(ccfg)
+        prompt = _make_prompt(ccfg, hb, hc - new)
+        head = (_xla_relative(ccfg, params, prompt, new, iters,
+                              default_pt=head_pt)
+                if want_ab else
+                (head_pt or _median_point(ccfg, params, prompt, new, iters)))
         result = {
             "metric": "gpt_small_greedy_decode_curve_tokens_per_sec_per_chip",
             "value": head["tokens_per_sec"],
@@ -164,7 +183,8 @@ def main() -> None:
         )
         new = int(os.environ.get("BENCH_GEN_NEW", "8" if test_size else "128"))
         iters = 3 if test_size else 8
-        params, prompt = _setup(cfg, b, prompt_len)
+        params = _init_params(cfg)
+        prompt = _make_prompt(cfg, b, prompt_len)
         point = (_xla_relative if want_ab else _median_point)(
             cfg, params, prompt, new, iters)
         result = {

@@ -24,6 +24,7 @@ Layout: BSHD (batch, seq, heads, head_dim) to match ``ops.attention``.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -91,8 +92,13 @@ def _on_tpu() -> bool:
 #: this threshold used to sit at 4096.  At 4k the win is 3.3x, at 8k the
 #: dense path OOMs (attn_20260801_014350.json).  Below 1024 the dense
 #: path keeps the job: score tensors are small enough that XLA's fusion
-#: is competitive and the kernel's fixed overhead dominates.
-MIN_SEQ_FOR_PALLAS = 1024
+#: is competitive and the kernel's fixed overhead dominates — pending the
+#: seq-512 probe (VERDICT r4 #5): the env seed lets the watcher A/B BERT
+#: with the threshold at 512 (`DTF_MIN_SEQ_FOR_PALLAS=512 bench_bert.py`)
+#: in the same window as the attn_512 kernel probe, so the decision and
+#: its end-to-end consequence land together.  Mutable module global,
+#: re-read at each trace (tests monkeypatch it).
+MIN_SEQ_FOR_PALLAS = int(os.environ.get("DTF_MIN_SEQ_FOR_PALLAS", "1024"))
 
 
 def _gqa_ok(qshape, kshape) -> bool:

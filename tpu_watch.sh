@@ -167,7 +167,7 @@ while true; do
       # GQA decode A/B: kv_heads=2 shrinks the per-step cache stream 6x
       # (12 q heads share 2 kv heads) — the decode step's binding HBM
       # cost; random weights, pure speed row.  Median-of-3 + XLA A/B.
-      run generate_gqa  900 env BENCH_GEN_KV_HEADS=2 python bench_generate.py \
+      run generate_gqa 1500 env BENCH_GEN_KV_HEADS=2 python bench_generate.py \
         || { probe || break; }
       # Long-context ladder, defaults end-to-end.
       run lm_s4096    900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn python bench_lm.py \
@@ -192,6 +192,12 @@ while true; do
       # Threshold probe: does the single-pass fwd kernel now beat dense
       # at 512 (the BERT regime)?  Decides MIN_SEQ_FOR_PALLAS.
       run attn_512    600 env BENCH_ATTN_SEQS=512 python bench_attn.py \
+        || { probe || break; }
+      # The end-to-end consequence of attn_512 (VERDICT r4 #5): BERT with
+      # the flash threshold lowered to its seq.  Persisted under bertab_*
+      # (bench_bert experiment prefix) — compare against the bert row to
+      # decide MIN_SEQ_FOR_PALLAS.
+      run bert_flash512 900 env DTF_MIN_SEQ_FOR_PALLAS=512 python bench_bert.py \
         || { probe || break; }
       run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
         || { probe || break; }
@@ -240,7 +246,8 @@ while true; do
   missing=0
   for s in lm_xla_cb16 conv_tpu resnet resnet_s2d resnet_records bert \
            lm_auto lm_auto_in20 lm_medium lm_s4096 lm_s8192 lm_s16k \
-           lm_s32k attn_4k attn_16k32k profile_lm generate generate_gqa; do
+           lm_s32k attn_4k attn_512 bert_flash512 attn_16k32k profile_lm \
+           generate generate_gqa; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
