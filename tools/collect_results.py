@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Summarize BENCH_RESULTS/*.json into one table per bench family.
+
+Post-window helper: when the watcher lands a queue, the docs' measured
+columns (docs/LM_PERF.md, docs/RESNET_PERF.md §5, PARITY.md) get filled
+from these artifacts — this prints the newest rows per family with the
+fields those tables need, so a short tunnel window's evidence is
+transcribed in seconds instead of by spelunking JSON by hand.
+
+Usage:
+    python tools/collect_results.py [--since 20260801_22] [--family lm ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import glob
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "BENCH_RESULTS")
+
+#: Fields worth showing per family (first list hit wins per artifact).
+FIELDS = [
+    "value", "unit", "vs_baseline", "mfu_analytic", "mfu_xla_cost",
+    "hbm_bw_util", "xla_relative", "spread", "seq", "batch", "global_batch",
+    "cache_len", "kv_heads", "min_seq_for_pallas", "space_to_depth",
+    "libtpu_flags", "input", "step_time_ms", "ms_per_decode_step",
+    "steps_per_call", "platform",
+]
+
+
+def rows(family_filter, since):
+    out = {}
+    for path in sorted(glob.glob(os.path.join(RESULTS, "*.json"))):
+        name = os.path.basename(path)
+        m = re.match(r"([a-z0-9_]+?)_(\d{8}_\d{6})\.json$", name)
+        if not m:
+            continue
+        family, ts = m.group(1), m.group(2)
+        if family_filter and family not in family_filter:
+            continue
+        if since and ts < since:
+            continue
+        try:
+            with open(path) as f:
+                out.setdefault(family, []).append((ts, name, json.load(f)))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"  !! {name}: unreadable ({e})")
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--since", default=None,
+                    help="only artifacts at/after this stamp "
+                         "(YYYYMMDD_HHMMSS prefix match, e.g. 20260801_2)")
+    ap.add_argument("--family", nargs="*", default=None,
+                    help="restrict to these family prefixes")
+    ap.add_argument("--last", type=int, default=3,
+                    help="newest N artifacts per family (default 3)")
+    args = ap.parse_args()
+
+    found = rows(args.family, args.since)
+    if not found:
+        print("no matching artifacts")
+        return
+    for family in sorted(found):
+        print(f"\n== {family} ==")
+        for ts, name, r in found[family][-args.last:]:
+            bits = [f"{k}={r[k]}" for k in FIELDS
+                    if r.get(k) is not None and r.get(k) is not False]
+            print(f"  {name}")
+            print(f"    {'  '.join(bits)}")
+            if "curve" in r:
+                for p in r["curve"]:
+                    print(f"      bs{p['batch']:>3} cache{p['cache_len']:>5}: "
+                          f"{p['tokens_per_sec']:>9} tok/s "
+                          f"(spread {p['spread']})")
+
+
+if __name__ == "__main__":
+    main()

@@ -246,8 +246,8 @@ while true; do
   missing=0
   for s in lm_xla_cb16 conv_tpu resnet resnet_s2d resnet_records bert \
            lm_auto lm_auto_in20 lm_medium lm_s4096 lm_s8192 lm_s16k \
-           lm_s32k attn_4k attn_512 bert_flash512 attn_16k32k profile_lm \
-           generate generate_gqa; do
+           lm_s32k lm_s32k_w4k attn_4k attn_512 bert_flash512 \
+           attn_16k32k profile_lm generate generate_gqa; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
