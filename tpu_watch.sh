@@ -122,19 +122,21 @@ while true; do
     # -- p2: headline refresh (non-LM benches are Pallas-free) -----------
     run resnet        900 python bench.py            || { probe || break; }
     run bert          900 python bench_bert.py       || { probe || break; }
-    # ResNet perf-loop A/Bs (docs/RESNET_PERF.md §3; persisted under
-    # resnet50ab_* so they never compete with the headline cache).
+    # ResNet perf-loop A/B (docs/RESNET_PERF.md §3; persisted under
+    # resnet50ab_* so it never competes with the headline cache).
+    # resnet_records / generate rows run AFTER the LM ladder: queue order
+    # is verdict priority (r4 #1 resnet story, #2 LM measured column,
+    # then #3 records / #4 decode), and stamps resume across windows.
     run resnet_s2d    900 env BENCH_S2D=1 python bench.py \
       || { probe || break; }
-    # Input-pipeline-in-the-loop headline (VERDICT r4 #3): records ->
-    # native reader -> Prefetcher -> chip; first run also writes the
-    # record shards (~300 MB, reused after).
-    run resnet_records 1200 env BENCH_INPUT=records python bench.py \
-      || { probe || break; }
     # -- p3: Pallas rows (the default stack), canary-gated ---------------
+    # This list must cover EVERY row inside the canary-gated block below,
+    # else a landed subset makes the block unreachable and the remaining
+    # rows starve while the outer missing-list counts them forever.
     pallas_missing=0
     for s in lm_auto lm_auto_in20 lm_s4096 lm_s8192 lm_s16k lm_s32k \
-             attn_4k attn_16k32k profile_lm; do
+             lm_s32k_w4k lm_medium attn_4k attn_512 bert_flash512 \
+             generate generate_gqa attn_16k32k profile_lm; do
       [ -f "$STAMPS/$s" ] || pallas_missing=1
     done
     if (( pallas_missing == 0 )); then
@@ -158,16 +160,6 @@ while true; do
       run lm_auto       600 env BENCH_LM_BATCH=16 python bench_lm.py \
         || { probe || break; }
       run lm_auto_in20  600 env BENCH_LM_BATCH=16 BENCH_LM_INNER=20 python bench_lm.py \
-        || { probe || break; }
-      # Serving decode, round-5 evidence discipline (VERDICT r4 #4):
-      # median-of-3 per point, batch(1/4/16/64) x cache(1k/4k) scaling
-      # curve, XLA-relative A/B at the headline point (primary claim).
-      run generate     1500 env BENCH_GEN_CURVE=1 python bench_generate.py \
-        || { probe || break; }
-      # GQA decode A/B: kv_heads=2 shrinks the per-step cache stream 6x
-      # (12 q heads share 2 kv heads) — the decode step's binding HBM
-      # cost; random weights, pure speed row.  Median-of-3 + XLA A/B.
-      run generate_gqa 1500 env BENCH_GEN_KV_HEADS=2 python bench_generate.py \
         || { probe || break; }
       # Long-context ladder, defaults end-to-end.
       run lm_s4096    900 env BENCH_LM_BATCH=4 BENCH_LM_SEQ=4096 BENCH_LM_REMAT=attn python bench_lm.py \
@@ -199,6 +191,16 @@ while true; do
       # decide MIN_SEQ_FOR_PALLAS.
       run bert_flash512 900 env DTF_MIN_SEQ_FOR_PALLAS=512 python bench_bert.py \
         || { probe || break; }
+      # Serving decode, round-5 evidence discipline (VERDICT r4 #4):
+      # median-of-3 per point, batch(1/4/16/64) x cache(1k/4k) scaling
+      # curve, XLA-relative A/B at the headline point (primary claim).
+      run generate     1500 env BENCH_GEN_CURVE=1 python bench_generate.py \
+        || { probe || break; }
+      # GQA decode A/B: kv_heads=2 shrinks the per-step cache stream 6x
+      # (12 q heads share 2 kv heads) — the decode step's binding HBM
+      # cost; random weights, pure speed row.  Median-of-3 + XLA A/B.
+      run generate_gqa 1500 env BENCH_GEN_KV_HEADS=2 python bench_generate.py \
+        || { probe || break; }
       run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
         || { probe || break; }
       # Fresh profile of the current default step (the instrument).
@@ -217,6 +219,12 @@ while true; do
     else
       log "pallas canary FAILED — skipping Pallas rows this window"
     fi
+    # Input-pipeline-in-the-loop headline (VERDICT r4 #3): records ->
+    # native reader -> Prefetcher -> chip; first run also writes the
+    # record shards (~300 MB, reused after).  Pallas-FREE, so it sits
+    # OUTSIDE the canary gate — after the LM block only for priority.
+    run resnet_records 1200 env BENCH_INPUT=records python bench.py \
+      || { probe || break; }
     # Speculative compiler-flag A/Bs (docs/RESNET_PERF.md §3 L1), LAST:
     # they may only spend surplus window time after every evidence row.  A
     # nonexistent flag fails fast inside the timeout; Pallas-free.
