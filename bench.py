@@ -454,11 +454,23 @@ def run_bench_records(per_chip_batch: int, n_steps: int, warmup: int,
 
     images_per_sec = n_steps * global_batch / dt
     per_chip = images_per_sec / n_chips
+    # Same MFU triple as the synthetic row (the gap between the two rows
+    # IS the input-pipeline cost).  No AOT executable here, so cost={}
+    # skips XLA cost analysis and mfu_xla_cost emits as None.
+    from bench_probe import mfu_fields
+
+    mfu = mfu_fields(
+        None, dt, n_steps, device_kind,
+        RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
+        * (image_size / 224.0) ** 2 / n_chips,
+        "analytic_12.3GF_per_image", cost={},
+    )
     return {
         "metric": "resnet50_records_imagenet_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 4),
+        **mfu,
         "input": "records",
         "record_format": "raw_u8_label32",
         "n_record_images": n_images,

@@ -25,6 +25,14 @@ LOG=BENCH_RESULTS/tpu_watch.log
 STAMPS=BENCH_RESULTS/.landed
 mkdir -p BENCH_RESULTS "$STAMPS"
 
+# ONE list for the canary-gated Pallas block (gate check + bottom
+# missing-list): a row added to the block but not here would be silently
+# starved once the listed rows land.  Defined top-level (set -u: the
+# bottom check runs even when a failed probe skips the queue body).
+PALLAS_STAMPS=(lm_auto lm_auto_in20 lm_s4096 lm_s8192 lm_s16k lm_s32k
+               lm_s32k_w4k lm_medium attn_4k attn_512 bert_flash512
+               generate generate_gqa attn_16k32k profile_lm)
+
 # Persistent XLA compilation cache (VERDICT r3 #1): round 3's only window
 # died in compiles.  Exported HERE (not just in bench_probe) so the direct
 # train.py items and the Pallas canary inherit it too; every compile any
@@ -124,19 +132,18 @@ while true; do
     run bert          900 python bench_bert.py       || { probe || break; }
     # ResNet perf-loop A/B (docs/RESNET_PERF.md §3; persisted under
     # resnet50ab_* so it never competes with the headline cache).
-    # resnet_records / generate rows run AFTER the LM ladder: queue order
-    # is verdict priority (r4 #1 resnet story, #2 LM measured column,
-    # then #3 records / #4 decode), and stamps resume across windows.
     run resnet_s2d    900 env BENCH_S2D=1 python bench.py \
       || { probe || break; }
+    # Input-pipeline-in-the-loop headline (VERDICT r4 #3): records ->
+    # native reader -> Prefetcher -> chip; first run also writes the
+    # record shards (~300 MB, reused after).  Pallas-free and cannot
+    # hang, so it stays in p2 AHEAD of the Pallas block — a window that
+    # dies mid-Pallas must not cost the records evidence.
+    run resnet_records 1200 env BENCH_INPUT=records python bench.py \
+      || { probe || break; }
     # -- p3: Pallas rows (the default stack), canary-gated ---------------
-    # This list must cover EVERY row inside the canary-gated block below,
-    # else a landed subset makes the block unreachable and the remaining
-    # rows starve while the outer missing-list counts them forever.
     pallas_missing=0
-    for s in lm_auto lm_auto_in20 lm_s4096 lm_s8192 lm_s16k lm_s32k \
-             lm_s32k_w4k lm_medium attn_4k attn_512 bert_flash512 \
-             generate generate_gqa attn_16k32k profile_lm; do
+    for s in "${PALLAS_STAMPS[@]}"; do
       [ -f "$STAMPS/$s" ] || pallas_missing=1
     done
     if (( pallas_missing == 0 )); then
@@ -219,12 +226,6 @@ while true; do
     else
       log "pallas canary FAILED — skipping Pallas rows this window"
     fi
-    # Input-pipeline-in-the-loop headline (VERDICT r4 #3): records ->
-    # native reader -> Prefetcher -> chip; first run also writes the
-    # record shards (~300 MB, reused after).  Pallas-FREE, so it sits
-    # OUTSIDE the canary gate — after the LM block only for priority.
-    run resnet_records 1200 env BENCH_INPUT=records python bench.py \
-      || { probe || break; }
     # Speculative compiler-flag A/Bs (docs/RESNET_PERF.md §3 L1), LAST:
     # they may only spend surplus window time after every evidence row.  A
     # nonexistent flag fails fast inside the timeout; Pallas-free.
@@ -253,9 +254,7 @@ while true; do
 
   missing=0
   for s in lm_xla_cb16 conv_tpu resnet resnet_s2d resnet_records bert \
-           lm_auto lm_auto_in20 lm_medium lm_s4096 lm_s8192 lm_s16k \
-           lm_s32k lm_s32k_w4k attn_4k attn_512 bert_flash512 \
-           attn_16k32k profile_lm generate generate_gqa; do
+           "${PALLAS_STAMPS[@]}"; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi
