@@ -243,12 +243,19 @@ def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
 def compiled_cost(compiled) -> dict | None:
     """One best-effort ``cost_analysis()`` call, shared by every consumer
     (mfu_fields, bench.py's hbm_bw_util) so the flaky-tunnel RPC is paid
-    once per executable and cannot return inconsistent outcomes."""
+    once per executable and cannot return inconsistent outcomes.
+
+    Older jax (this image's 0.4.37) returns a LIST of per-device dicts;
+    normalized here to the first device's dict so every consumer sees one
+    shape."""
     try:
-        return compiled.cost_analysis()
+        cost = compiled.cost_analysis()
     except Exception as e:
         print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
         return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    return cost
 
 
 def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,

@@ -21,9 +21,19 @@ from typing import Any
 
 import orbax.checkpoint as ocp
 
+from .. import obs
 from ..train.state import TrainState
 
 logger = logging.getLogger("distributedtensorflow_tpu")
+
+# Registry metrics (obs/): checkpoint IO health.  The save gauge records
+# the BLOCKING portion only — with async_save the Orbax commit continues in
+# the background and the train loop is already running again.
+_M_SAVES = obs.counter("checkpoint_saves_total", "checkpoint saves accepted")
+_M_RESTORES = obs.counter("checkpoint_restores_total", "checkpoint restores")
+_M_SAVE_S = obs.gauge(
+    "checkpoint_last_save_blocking_s", "blocking seconds of the last save call"
+)
 
 PyTree = Any
 
@@ -89,11 +99,17 @@ class CheckpointManager:
                 f"best_metric={self._best_metric!r} retention needs "
                 f"metrics[{self._best_metric!r}] passed to save()"
             )
-        saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(_as_tree(state)), force=force,
-            metrics={k: float(v) for k, v in metrics.items()} if metrics else None,
-        )
+        with obs.span("checkpoint_save") as sp:
+            saved = self._mgr.save(
+                step, args=ocp.args.StandardSave(_as_tree(state)), force=force,
+                metrics=(
+                    {k: float(v) for k, v in metrics.items()}
+                    if metrics else None
+                ),
+            )
         if saved:
+            _M_SAVES.inc()
+            _M_SAVE_S.set(sp.dur_s)
             logger.info("checkpoint saved at step %d", step)
         return saved
 
@@ -111,10 +127,12 @@ class CheckpointManager:
         step = self._mgr.latest_step()
         if step is None:
             return None
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.StandardRestore(_as_tree(target)),
-        )
+        with obs.span("checkpoint_restore"):
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.StandardRestore(_as_tree(target)),
+            )
+        _M_RESTORES.inc()
         logger.info("restored checkpoint step %d", step)
         return target.replace(
             step=restored["step"],
@@ -125,9 +143,11 @@ class CheckpointManager:
 
     def restore(self, step: int, target: TrainState) -> TrainState:
         """Restore a specific step into ``target``'s shardings."""
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(_as_tree(target))
-        )
+        with obs.span("checkpoint_restore"):
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(_as_tree(target))
+            )
+        _M_RESTORES.inc()
         logger.info("restored checkpoint step %d", step)
         return target.replace(
             step=restored["step"],
@@ -149,7 +169,8 @@ class CheckpointManager:
         self._mgr.reload()
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        with obs.span("checkpoint_wait"):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.close()

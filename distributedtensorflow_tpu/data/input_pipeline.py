@@ -24,12 +24,14 @@ import itertools
 import logging
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from .. import obs
 from ..parallel import sharding as shardlib
 
 logger = logging.getLogger("distributedtensorflow_tpu")
@@ -137,6 +139,18 @@ class Prefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
         self._err: BaseException | None = None
         self._stop = threading.Event()
+        # obs registry handles, resolved once (hot-path discipline).  The
+        # wait histogram is the input-bound-vs-compute-bound signal: near-0
+        # waits = input keeps up; waits ~ step time = input-bound.
+        self._m_batches = obs.counter(
+            "data_batches_total", "batches handed to the consumer"
+        )
+        self._m_wait = obs.histogram(
+            "data_wait_seconds", "consumer blocking time per batch fetch"
+        )
+        self._m_put = obs.histogram(
+            "data_device_put_seconds", "host->device placement time per batch"
+        )
         self._thread = threading.Thread(
             target=self._run, args=(iter(it),), daemon=True
         )
@@ -158,11 +172,13 @@ class Prefetcher:
             for batch in self._batches(it):
                 if self._stop.is_set():
                     return
+                t0 = time.perf_counter()
                 out = (
                     device_put_bundle(batch, self._mesh)
                     if self._bundle > 1
                     else device_put_batch(batch, self._mesh)
                 )
+                self._m_put.observe(time.perf_counter() - t0)
                 # bounded put that re-checks stop, so close() can't deadlock
                 # against a full queue
                 while not self._stop.is_set():
@@ -211,11 +227,14 @@ class Prefetcher:
         return self
 
     def __next__(self):
+        t0 = time.perf_counter()
         item = self._q.get()
+        self._m_wait.observe(time.perf_counter() - t0)
         if item is self._DONE:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        self._m_batches.inc()
         return item
 
 

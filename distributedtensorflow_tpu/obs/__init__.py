@@ -1,0 +1,39 @@
+"""Unified telemetry: metrics registry, span tracing, cross-host
+aggregation, anomaly detection.
+
+The reference harness's observability floor is ``tf.summary`` scalars plus
+chief-only logging; this subsystem answers the questions that floor cannot:
+*where did the step time go* (span tracing → per-step breakdown), *which
+host is slow* (cross-host gauge aggregation), *is the run healthy*
+(streaming anomaly detection), and *what is every layer doing* (the
+process-local registry any module writes to without plumbing a writer).
+
+Surfaces:
+
+- ``counter/gauge/histogram`` — process-local registry metrics, exported
+  into ``metrics.jsonl`` rows and a Prometheus text snapshot
+  (``metrics.prom``);
+- ``span("name")`` — wall-time tree tracing into ``trace.jsonl`` plus the
+  per-step breakdown fields (``t_data``/``t_step``/``f_data``/...);
+- ``host_aggregate`` — per-host gauge allgather → min/median/max/straggler;
+- ``AnomalyDetector`` — NaN/Inf loss, loss z-spike, step-time regression,
+  raising through the Watchdog-style callback convention;
+- ``tools/run_report.py`` — renders a logdir's two streams into one
+  human-readable run report.
+"""
+
+from .aggregate import host_aggregate, straggler_summary  # noqa: F401
+from .anomaly import Anomaly, AnomalyDetector  # noqa: F401
+from .mfu import mfu_record_fields, peak_flops  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+    set_default_registry,
+)
+from .tracing import Span, TraceRecorder, active_recorder, span  # noqa: F401

@@ -1,0 +1,70 @@
+"""Cross-host aggregation of per-host gauge snapshots.
+
+SPMD training is only as fast as its slowest host: a straggler's data stall
+or GC pause stalls every collective.  Both TPU-pod scaling reports this
+repo follows (MLPerf v3 pods, arxiv 1909.09756; pjit TPUv4, arxiv
+2204.06514) attribute scaling wins to making per-host step-time spread
+visible.  This module is that surface: every host publishes a small dict of
+scalars (step time, data wait), an ``multihost_utils.process_allgather``
+collects them, and the chief logs min/median/max plus which host is the
+straggler.
+
+The gather runs at **log boundaries only** (it is a device collective —
+never put it on the per-step path).  Keys must be identical on every host
+(they derive from the same TrainerConfig, so they are).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+__all__ = ["host_aggregate", "straggler_summary"]
+
+
+def host_aggregate(values: dict[str, float]) -> dict[str, float]:
+    """Allgather ``values`` from every host; return spread fields.
+
+    For each input key ``k`` the result carries ``k_host_min`` /
+    ``k_host_median`` / ``k_host_max`` and ``k_straggler`` (the process
+    index holding the max — for wait-style metrics the slowest host).
+    Single-process: computed locally, no collective.
+    """
+    import jax  # noqa: PLC0415 — keep module importable pre-backend-init
+
+    keys = sorted(values)
+    if not keys:
+        return {}
+    local = np.asarray([float(values[k]) for k in keys], np.float64)
+    if jax.process_count() == 1:
+        rows = local[None, :]
+    else:
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        rows = np.asarray(multihost_utils.process_allgather(local))
+        rows = rows.reshape(jax.process_count(), len(keys))
+    out: dict[str, float] = {}
+    for j, k in enumerate(keys):
+        col = rows[:, j]
+        out[f"{k}_host_min"] = float(col.min())
+        out[f"{k}_host_median"] = float(np.median(col))
+        out[f"{k}_host_max"] = float(col.max())
+        out[f"{k}_straggler"] = float(int(col.argmax()))
+    return out
+
+
+def straggler_summary(agg: dict[str, float], key: str) -> str:
+    """One log line for a gathered key: ``step_time min/med/max straggler``."""
+    try:
+        return (
+            f"{key} host min/median/max = "
+            f"{agg[f'{key}_host_min']:.4g}/"
+            f"{agg[f'{key}_host_median']:.4g}/"
+            f"{agg[f'{key}_host_max']:.4g}s "
+            f"(straggler host {int(agg[f'{key}_straggler'])})"
+        )
+    except KeyError:
+        return f"{key}: no aggregation fields"

@@ -1,0 +1,104 @@
+"""MFU fields for the live metric stream.
+
+Reuses ``bench_probe.mfu_fields`` (the repo's one MFU accounting — analytic
+model FLOPs over device peak) when the repo root is importable, so the
+Trainer's per-step ``mfu`` and the bench suite's ``mfu`` can never diverge;
+falls back to the same arithmetic with the local peak table otherwise.
+The repo-root imports are resolved ONCE and cached (a failed import is not
+cached by sys.modules, and this runs at every log boundary).
+Only numeric fields are returned (the ``metrics.jsonl`` writer is
+numbers-only; ``mfu_analytic_source`` stays in the bench JSON world).
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+__all__ = ["mfu_record_fields", "peak_flops"]
+
+#: bench.py's PEAK_FLOPS_BY_KIND, duplicated as the in-package fallback for
+#: deployments where the repo root (bench.py) is not on sys.path.
+_PEAK_FLOPS_BY_KIND = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+}
+_DEFAULT_PEAK = 197e12
+
+_UNRESOLVED = object()
+_bench_peak_flops = _UNRESOLVED  # bench._peak_flops | None
+_bench_mfu_fields = _UNRESOLVED  # bench_probe.mfu_fields | None
+
+
+def _resolve_bench() -> None:
+    global _bench_peak_flops, _bench_mfu_fields
+    if _bench_peak_flops is _UNRESOLVED:
+        try:
+            from bench import _peak_flops  # noqa: PLC0415 — repo-root module
+
+            _bench_peak_flops = _peak_flops
+        except Exception:
+            _bench_peak_flops = None
+    if _bench_mfu_fields is _UNRESOLVED:
+        try:
+            from bench_probe import mfu_fields  # noqa: PLC0415
+
+            _bench_mfu_fields = mfu_fields
+        except Exception:
+            _bench_mfu_fields = None
+
+
+def peak_flops(device_kind: str) -> float:
+    """Peak dense bf16 FLOP/s for a device kind (bench.py table)."""
+    _resolve_bench()
+    if _bench_peak_flops is not None:
+        return _bench_peak_flops(device_kind)
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS_BY_KIND.items():
+        if sub in kind:
+            return peak
+    return _DEFAULT_PEAK
+
+
+def mfu_record_fields(
+    flops_per_step: float,
+    dt_per_step: float,
+    device_kind: str | None = None,
+) -> dict[str, float]:
+    """Numeric MFU fields for one metric record.
+
+    ``flops_per_step`` is per-chip model FLOPs per optimizer step (analytic
+    6·N·D-style, or the XLA cost-analysis estimate from
+    ``train.engine.estimate_step_flops``); ``dt_per_step`` the measured
+    wall seconds per step.  Returns ``{}`` when either is unknown.
+    """
+    if not flops_per_step or not dt_per_step or dt_per_step <= 0:
+        return {}
+    if device_kind is None:
+        try:
+            import jax  # noqa: PLC0415
+
+            device_kind = jax.local_devices()[0].device_kind
+        except Exception:
+            device_kind = ""
+    _resolve_bench()
+    if _bench_mfu_fields is not None:
+        try:
+            # cost={} skips the executable cost-analysis RPC path: the live
+            # stream only carries the analytic accounting.
+            fields = _bench_mfu_fields(
+                None, dt_per_step, 1, device_kind, flops_per_step,
+                "trainer_flops_per_step", cost={},
+            )
+            return {
+                k: float(v) for k, v in fields.items()
+                if isinstance(v, (int, float)) and v is not None
+            }
+        except Exception:
+            logger.exception("bench_probe.mfu_fields failed; using fallback")
+    mfu = flops_per_step / dt_per_step / peak_flops(device_kind)
+    return {"mfu": round(mfu, 4), "mfu_analytic": round(mfu, 4)}

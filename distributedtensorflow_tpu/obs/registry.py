@@ -1,0 +1,298 @@
+"""Process-local metrics registry: counters, gauges, histograms with labels.
+
+The reference stack's only metric surface is ``tf.summary`` scalars written
+by whoever holds the writer object.  This registry inverts that: any module
+increments a named metric without plumbing a writer — the exporters pull.
+Two export surfaces:
+
+- :meth:`Registry.scalars` — a flat ``{name: float}`` dict merged into the
+  per-step ``metrics.jsonl`` record by the Trainer (histograms export
+  ``_count`` / ``_sum`` / ``_avg``);
+- :meth:`Registry.to_prometheus` / :meth:`Registry.write_prometheus` — a
+  Prometheus text-format snapshot file (``metrics.prom``) for scrape-style
+  consumption, written atomically (tmp + rename).
+
+Thread-safe: metric objects hold one lock each; the hot path (unlabeled
+``inc``/``set``/``observe``) is a dict update under that lock.  Metric
+handles are cached — call :func:`counter` once and keep the object when
+incrementing from a hot loop.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import os
+import re
+import threading
+import time
+from typing import Iterable, Mapping
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "default_registry",
+    "set_default_registry",
+]
+
+#: Wall-time-seconds oriented default buckets (spans from ms to minutes).
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def _label_key(labels: Mapping[str, str]) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_suffix(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+def _flat_suffix(key: tuple) -> str:
+    """Label suffix safe for jsonl field names / TB tags (no braces)."""
+    if not key:
+        return ""
+    return "." + ".".join(f"{k}_{_NAME_RE.sub('_', v)}" for k, v in key)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def _items(self) -> list[tuple[tuple, float]]:
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (events, batches, anomalies)."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: inc({n}) is negative")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    """Point-in-time value (queue depth, HBM bytes, last step time)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def add(self, n: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (latencies, wait times)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets))
+        # per label key: [bucket_counts..., +inf count], sum, count
+        self._hist: dict[tuple, tuple[list[int], float, int]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        value = float(value)
+        key = _label_key(labels)
+        with self._lock:
+            counts, total, n = self._hist.get(
+                key, ([0] * (len(self.buckets) + 1), 0.0, 0)
+            )
+            counts[bisect.bisect_left(self.buckets, value)] += 1
+            self._hist[key] = (counts, total + value, n + 1)
+
+    def stats(self, **labels) -> dict[str, float]:
+        with self._lock:
+            counts, total, n = self._hist.get(
+                _label_key(labels), ([0] * (len(self.buckets) + 1), 0.0, 0)
+            )
+        return {
+            "count": float(n),
+            "sum": total,
+            "avg": total / n if n else 0.0,
+        }
+
+    def _hist_items(self):
+        with self._lock:
+            return [
+                (key, list(counts), total, n)
+                for key, (counts, total, n) in self._hist.items()
+            ]
+
+
+class Registry:
+    """Name → metric map; the exporters read it, any module writes it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def scalars(self) -> dict[str, float]:
+        """Flat numeric snapshot for the ``metrics.jsonl`` exporter.
+
+        Counters/gauges export under their name (labels flattened into a
+        ``.label_value`` suffix — brace-free so the fields survive jsonl
+        tooling and TensorBoard tags); histograms export ``_count`` /
+        ``_sum`` / ``_avg`` (bucket vectors stay Prometheus-only so jsonl
+        rows don't balloon).
+        """
+        out: dict[str, float] = {}
+        for m in self.metrics():
+            if isinstance(m, Histogram):
+                for key, counts, total, n in m._hist_items():
+                    suffix = _flat_suffix(key)
+                    out[f"{m.name}_count{suffix}"] = float(n)
+                    out[f"{m.name}_sum{suffix}"] = total
+                    out[f"{m.name}_avg{suffix}"] = total / n if n else 0.0
+            else:
+                for key, v in m._items():
+                    out[f"{m.name}{_flat_suffix(key)}"] = v
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (counters get ``_total``-as-is
+        names; histograms emit cumulative ``_bucket{le=...}`` series)."""
+        lines: list[str] = []
+        for m in self.metrics():
+            name = _prom_name(m.name)
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                for key, counts, total, n in m._hist_items():
+                    labels = dict(key)
+                    cum = 0
+                    for bound, c in zip(m.buckets, counts):
+                        cum += c
+                        lk = _label_key({**labels, "le": repr(bound)})
+                        lines.append(f"{name}_bucket{_label_suffix(lk)} {cum}")
+                    lk = _label_key({**labels, "le": "+Inf"})
+                    lines.append(f"{name}_bucket{_label_suffix(lk)} {n}")
+                    s = _label_suffix(key)
+                    lines.append(f"{name}_sum{s} {_fmt_float(total)}")
+                    lines.append(f"{name}_count{s} {n}")
+            else:
+                for key, v in m._items():
+                    lines.append(f"{name}{_label_suffix(key)} {_fmt_float(v)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_prometheus(self, path: str) -> None:
+        """Atomic snapshot write (tmp + rename) so a scraper never reads a
+        half-written file."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(f"# snapshot_unix_time {time.time():.3f}\n")
+            f.write(self.to_prometheus())
+        os.replace(tmp, path)
+
+
+def _fmt_float(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v)
+
+
+_default = Registry()
+_default_lock = threading.Lock()
+
+
+def default_registry() -> Registry:
+    return _default
+
+
+def set_default_registry(reg: Registry) -> Registry:
+    """Swap the process-default registry (tests); returns the previous one.
+
+    Scope caveat: instrumented modules resolve their metric handles ONCE —
+    some at import time (coordinator, checkpoint manager), some at
+    construction (Prefetcher, engine steps, Trainer).  Handles already
+    bound keep writing to the registry they were created in; swap before
+    importing/constructing what you want isolated, or pass an explicit
+    ``Registry`` of your own for fully hermetic accounting.
+    """
+    global _default
+    with _default_lock:
+        prev, _default = _default, reg
+    return prev
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _default.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _default.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+    return _default.histogram(name, help, buckets=buckets)

@@ -1,0 +1,240 @@
+"""Lightweight span tracing: wall-time trees per training step.
+
+``with span("data_wait"): ...`` times a region.  Spans nest per thread
+(children attach to the enclosing span); a completed *root* span is
+delivered to the installed :class:`TraceRecorder`, which groups roots into
+per-step rows, writes them to ``trace.jsonl``, and accumulates per-name
+window totals the Trainer turns into the step-time breakdown
+(data-wait / compute-dispatch / host-blocking / checkpoint / eval).
+
+Design constraints:
+
+- ``span`` must be exception-transparent — the Trainer's fit loop relies on
+  ``StopIteration`` from ``next(it)`` escaping unchanged, so ``span`` is a
+  plain class context manager, NOT a ``@contextmanager`` generator (PEP 479
+  would turn an in-body StopIteration into RuntimeError).
+- near-zero cost when no recorder is installed: two ``perf_counter`` calls
+  and a list push/pop;
+- spans may complete on any thread (the Prefetcher's ``device_put`` worker);
+  roots from any thread land in the currently open step row.
+
+``trace.jsonl`` row schema (one JSON object per line)::
+
+    {"step": int, "k": int, "t_wall": float,
+     "spans": [{"name": str, "dur_s": float, "children": [...]}, ...]}
+    {"kind": "anomaly", "step": int, "anomaly": str, "message": str,
+     "value": float}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+__all__ = ["Span", "span", "TraceRecorder", "active_recorder"]
+
+_tls = threading.local()
+
+
+class Span:
+    __slots__ = ("name", "t0", "dur_s", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = 0.0
+        self.dur_s = 0.0
+        self.children: list[Span] = []
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"name": self.name, "dur_s": round(self.dur_s, 6)}
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class span:
+    """``with span("train_step"): ...`` — time a region into the trace."""
+
+    __slots__ = ("_span",)
+
+    def __init__(self, name: str):
+        self._span = Span(name)
+
+    def __enter__(self) -> Span:
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self._span.t0 = time.perf_counter()
+        stack.append(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        s = self._span
+        s.dur_s = time.perf_counter() - s.t0
+        stack = _tls.stack
+        stack.pop()
+        if stack:
+            stack[-1].children.append(s)
+        else:
+            rec = _recorder
+            if rec is not None:
+                rec._add_root(s)
+        return False
+
+
+_recorder: "TraceRecorder | None" = None
+_recorder_lock = threading.Lock()
+
+
+def active_recorder() -> "TraceRecorder | None":
+    return _recorder
+
+
+class TraceRecorder:
+    """Collects root spans into per-step rows and window totals.
+
+    ``path=None`` keeps the recorder accounting-only (window totals for the
+    breakdown, no file) — the Trainer installs one per fit either way.
+    Only the chief process writes the file (the ``MetricWriter``
+    convention); non-chief recorders still accumulate window totals so
+    cross-host aggregation has per-host numbers to gather.
+    """
+
+    def __init__(self, path: str | None = None, *, chief_only: bool = True):
+        self._f = None
+        if path is not None:
+            chief = True
+            if chief_only:
+                try:
+                    import jax  # noqa: PLC0415
+
+                    chief = jax.process_index() == 0
+                except Exception:
+                    chief = True
+            if chief:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                self._f = open(path, "a")
+        self._lock = threading.Lock()
+        self._step: int | None = None
+        self._k = 1
+        self._step_t0 = 0.0
+        self._roots: list[Span] = []
+        self._window: dict[str, float] = {}
+        self._window_counts: dict[str, int] = {}
+
+    # -- install / uninstall -------------------------------------------------
+
+    def install(self) -> "TraceRecorder":
+        global _recorder
+        with _recorder_lock:
+            _recorder = self
+        return self
+
+    def uninstall(self) -> None:
+        global _recorder
+        with _recorder_lock:
+            if _recorder is self:
+                _recorder = None
+
+    def __enter__(self) -> "TraceRecorder":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+        self.close()
+
+    # -- span intake ---------------------------------------------------------
+
+    def _add_root(self, s: Span) -> None:
+        with self._lock:
+            self._roots.append(s)
+            self._window[s.name] = self._window.get(s.name, 0.0) + s.dur_s
+            self._window_counts[s.name] = self._window_counts.get(s.name, 0) + 1
+
+    # -- step grouping -------------------------------------------------------
+
+    def begin_step(self, step: int, k: int = 1) -> None:
+        """Open a step row; roots completing until ``end_step`` belong to it.
+
+        An already-open row is flushed first, so a loop that only calls
+        ``begin_step`` still emits every row.
+        """
+        with self._lock:
+            if self._step is not None:
+                self._flush_row_locked()
+            self._step = step
+            self._k = k
+            self._step_t0 = time.perf_counter()
+            self._roots = []
+
+    def adjust_step(self, step: int, k: int = 1) -> None:
+        """Relabel the open row — for callers whose step count is only
+        final after the data fetch (a short prebundled trailing bundle
+        shrinks the dispatch below the projected k)."""
+        with self._lock:
+            if self._step is not None:
+                self._step = step
+                self._k = k
+
+    def end_step(self) -> None:
+        with self._lock:
+            self._flush_row_locked()
+
+    def _flush_row_locked(self) -> None:
+        if self._step is None:
+            # roots outside any step (e.g. the final checkpoint after the
+            # loop): emit them unanchored so the wall time is not lost.
+            if self._roots and self._f is not None:
+                self._write(
+                    {"step": None,
+                     "spans": [s.to_dict() for s in self._roots]}
+                )
+            self._roots = []
+            return
+        row = {
+            "step": self._step,
+            "k": self._k,
+            "t_wall": round(time.perf_counter() - self._step_t0, 6),
+            "spans": [s.to_dict() for s in self._roots],
+        }
+        self._step = None
+        self._roots = []
+        if self._f is not None:
+            self._write(row)
+
+    def write_event(self, event: dict[str, Any]) -> None:
+        """Append an out-of-band row (anomalies, run markers)."""
+        with self._lock:
+            if self._f is not None:
+                self._write(event)
+
+    def _write(self, row: dict[str, Any]) -> None:
+        from ..utils.metrics import json_sanitize  # noqa: PLC0415
+
+        # allow_nan=False + sentinel strings: an anomaly event's value is
+        # often NaN, and a bare NaN token is invalid strict JSON.
+        self._f.write(json.dumps(json_sanitize(row), allow_nan=False) + "\n")
+        self._f.flush()
+
+    # -- breakdown window ----------------------------------------------------
+
+    def drain_window(self) -> dict[str, float]:
+        """Return and reset per-span-name total seconds since last drain.
+
+        The Trainer divides these by the window's optimizer-step count to
+        get the per-step breakdown fields.
+        """
+        with self._lock:
+            totals, self._window = self._window, {}
+            self._window_counts = {}
+            return totals
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_row_locked()
+            if self._f is not None:
+                self._f.close()
+                self._f = None

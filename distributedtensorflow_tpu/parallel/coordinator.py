@@ -36,7 +36,29 @@ import threading
 import time
 from typing import Any, Callable, Generic, Iterable, Iterator, TypeVar
 
+from .. import obs
+
 logger = logging.getLogger("distributedtensorflow_tpu")
+
+# Registry metrics (obs/): dispatch health of every Coordinator in the
+# process, one shared family with no per-instance labels — the queue-depth
+# gauge is the "is host-side work backing up" signal.
+_M_SCHEDULED = obs.counter(
+    "coordinator_closures_scheduled_total", "closures accepted by schedule()"
+)
+_M_FINISHED = obs.counter(
+    "coordinator_closures_finished_total", "closures completed successfully"
+)
+_M_RETRIED = obs.counter(
+    "coordinator_closures_retried_total",
+    "closure re-queues after a retryable worker failure",
+)
+_M_FAILED = obs.counter(
+    "coordinator_closures_failed_total", "closures parked as application errors"
+)
+_M_QUEUE_DEPTH = obs.gauge(
+    "coordinator_queue_depth", "closures waiting for a worker"
+)
 
 T = TypeVar("T")
 
@@ -128,6 +150,7 @@ class _ClosureQueue:
             if self._closed:
                 raise RuntimeError("coordinator is shut down")
             self._queue.append(closure)
+            _M_QUEUE_DEPTH.set(len(self._queue))
             self._not_empty.notify()
 
     def get(self, timeout: float = 0.1) -> Closure | None:
@@ -138,6 +161,7 @@ class _ClosureQueue:
                 return None
             closure = self._queue.popleft()
             self._inflight += 1
+            _M_QUEUE_DEPTH.set(len(self._queue))
             self._not_full.notify()
             return closure
 
@@ -147,6 +171,7 @@ class _ClosureQueue:
             self._inflight -= 1
             if self._error is None and not self._closed:
                 self._queue.appendleft(closure)
+                _M_QUEUE_DEPTH.set(len(self._queue))
                 self._not_empty.notify()
             else:
                 closure.output._set_error(ClosureAborted("coordinator errored"))
@@ -167,6 +192,7 @@ class _ClosureQueue:
             for closure in self._queue:
                 closure.output._set_error(ClosureAborted("cancelled"))
             self._queue.clear()
+            _M_QUEUE_DEPTH.set(0)
             self._not_full.notify_all()
             self._drained.notify_all()
 
@@ -201,6 +227,7 @@ class _ClosureQueue:
             for closure in self._queue:
                 closure.output._set_error(ClosureAborted("coordinator shut down"))
             self._queue.clear()
+            _M_QUEUE_DEPTH.set(0)
             self._not_empty.notify_all()
             self._not_full.notify_all()
             self._drained.notify_all()
@@ -370,6 +397,7 @@ class _Worker(threading.Thread):
             except self._coord._retryable as e:
                 self.failures += 1
                 closure.attempts += 1
+                _M_RETRIED.inc()
                 if closure.attempts >= self._coord._max_retries:
                     err = RuntimeError(
                         f"closure failed {closure.attempts} retryable attempts"
@@ -377,6 +405,7 @@ class _Worker(threading.Thread):
                     err.__cause__ = e
                     closure.output._set_error(err)
                     queue.mark_failed(err)
+                    _M_FAILED.inc()  # retry exhaustion is a permanent failure
                     continue
                 logger.warning(
                     "worker %d unavailable (%s); re-queueing closure "
@@ -386,9 +415,11 @@ class _Worker(threading.Thread):
             except BaseException as e:  # noqa: BLE001 — parked, re-raised at join
                 closure.output._set_error(e)
                 queue.mark_failed(e)
+                _M_FAILED.inc()
             else:
                 closure.output._set_value(result)
                 queue.mark_finished()
+                _M_FINISHED.inc()
 
 
 class Coordinator:
@@ -465,6 +496,7 @@ class Coordinator:
         """
         closure = Closure(fn, args, kwargs or {})
         self._queue.put(closure)
+        _M_SCHEDULED.inc()
         return closure.output
 
     def join(self, timeout: float | None = None) -> None:

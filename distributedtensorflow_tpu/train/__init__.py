@@ -3,6 +3,7 @@
 from .state import TrainState, create_sharded_state, split_variables  # noqa: F401
 from .engine import (  # noqa: F401
     accumulate_gradients,
+    estimate_step_flops,
     make_eval_step,
     make_multi_train_step,
     make_train_step,

@@ -27,6 +27,8 @@ without any pass ``{}`` through unchanged.
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Any, Callable
 
 import jax
@@ -34,8 +36,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..parallel import sharding as shardlib
 from .state import TrainState
+
+logger = logging.getLogger("distributedtensorflow_tpu")
 
 PyTree = Any
 
@@ -112,6 +117,77 @@ def accumulate_gradients(
     return grads, metrics, new_mstate
 
 
+class _InstrumentedStep:
+    """Thin telemetry shim over a jitted step executable.
+
+    Counts dispatches into the obs registry and records the first dispatch
+    (which pays tracing + XLA compile) as a gauge — without touching the
+    per-dispatch hot path beyond one counter increment.  ``lower`` is
+    forwarded so the AOT path (`bench.py`'s ``step.lower(...).compile()``)
+    keeps working on the wrapped object.
+    """
+
+    __slots__ = ("_jitted", "_label", "_first", "_dispatches", "_first_gauge")
+
+    def __init__(self, jitted, label: str):
+        self._jitted = jitted
+        self._label = label
+        self._first = True
+        self._dispatches = obs.counter(
+            "engine_dispatches_total",
+            "train/eval step dispatches by executable kind",
+        )
+        self._first_gauge = obs.gauge(
+            "engine_first_dispatch_s",
+            "wall seconds of the first dispatch (trace + XLA compile + run)",
+        )
+
+    def __call__(self, *args):
+        if self._first:
+            self._first = False
+            with obs.span(f"compile_{self._label}"):
+                t0 = time.perf_counter()
+                out = self._jitted(*args)
+                self._first_gauge.set(
+                    time.perf_counter() - t0, kind=self._label
+                )
+            self._dispatches.inc(kind=self._label)
+            return out
+        self._dispatches.inc(kind=self._label)
+        return self._jitted(*args)
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    @property
+    def jitted(self):
+        return self._jitted
+
+
+def estimate_step_flops(step, state, batch_abstract, rng) -> float | None:
+    """Best-effort per-step FLOPs from XLA's compiled cost analysis.
+
+    AOT-lowers ``step`` against abstract batch shapes and reads
+    ``cost_analysis()["flops"]`` — the partitioned (per-device) module's
+    count, exactly the per-chip MFU numerator.  Known coarseness: a
+    ``lax.scan`` body (grad accumulation, multi-step bundling) is counted
+    once regardless of trip count (see ``bench_probe.mfu_fields``'s
+    ``xla_flops_scale`` note).  Returns None when the backend can't answer;
+    callers treat that as "no MFU fields".  Costs one extra compile — the
+    persistent compilation cache absorbs it on reruns.
+    """
+    try:
+        compiled = step.lower(state, batch_abstract, rng).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax: one dict per device
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        return flops or None
+    except Exception as e:
+        logger.info("estimate_step_flops: cost analysis unavailable (%s)", e)
+        return None
+
+
 def make_train_step(
     loss_fn: LossFn,
     mesh: Mesh,
@@ -132,11 +208,14 @@ def make_train_step(
     repl = NamedSharding(mesh, P())
     step = _step_body(loss_fn, accum_steps)
 
-    return jax.jit(
-        step,
-        in_shardings=(state_shardings, batch_sharding, repl),
-        out_shardings=(state_shardings, repl),
-        donate_argnums=(0,) if donate else (),
+    return _InstrumentedStep(
+        jax.jit(
+            step,
+            in_shardings=(state_shardings, batch_sharding, repl),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0,) if donate else (),
+        ),
+        "train_step",
     )
 
 
@@ -208,11 +287,14 @@ def make_multi_train_step(
 
         return lax.scan(body, state, batches)
 
-    return jax.jit(
-        multi_step,
-        in_shardings=(state_shardings, batch_sharding, repl),
-        out_shardings=(state_shardings, repl),
-        donate_argnums=(0,) if donate else (),
+    return _InstrumentedStep(
+        jax.jit(
+            multi_step,
+            in_shardings=(state_shardings, batch_sharding, repl),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0,) if donate else (),
+        ),
+        "multi_train_step",
     )
 
 
@@ -227,9 +309,12 @@ def make_eval_step(
     mstate_shardings = shardlib.named_shardings(mesh, state_specs.model_state)
     repl = NamedSharding(mesh, P())
 
-    jitted = jax.jit(
-        metric_fn,
-        in_shardings=(param_shardings, mstate_shardings, batch_sharding),
-        out_shardings=repl,
+    jitted = _InstrumentedStep(
+        jax.jit(
+            metric_fn,
+            in_shardings=(param_shardings, mstate_shardings, batch_sharding),
+            out_shardings=repl,
+        ),
+        "eval_step",
     )
     return lambda state, batch: jitted(state.params, state.model_state, batch)

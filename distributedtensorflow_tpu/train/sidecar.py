@@ -22,6 +22,7 @@ import logging
 import time
 from typing import Any, Callable, Iterable
 
+from .. import obs
 from ..utils.metrics import MetricWriter
 from .state import TrainState
 from .trainer import weighted_evaluate
@@ -67,10 +68,14 @@ class SidecarEvaluator:
         self.history: dict[int, dict] = {}  # step -> metrics
 
     def _evaluate_state(self, step: int, state) -> dict:
-        metrics = weighted_evaluate(
-            self.eval_step, state, self.eval_iter_fn(),
-            max_steps=self.eval_steps,
-        )
+        with obs.span("sidecar_eval"):
+            metrics = weighted_evaluate(
+                self.eval_step, state, self.eval_iter_fn(),
+                max_steps=self.eval_steps,
+            )
+        obs.counter(
+            "sidecar_evaluations_total", "checkpoints evaluated"
+        ).inc()
         self.history[step] = metrics
         self.writer.write(step, {f"eval/{k}": v for k, v in metrics.items()})
         logger.info(

@@ -10,12 +10,15 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
+import time
 from typing import Any, Callable, Iterable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..utils.metrics import MetricWriter, ThroughputMeter
 from .state import TrainState
 
@@ -67,6 +70,20 @@ class TrainerConfig:
     target_metric: str | None = None
     target_value: float | None = None
     target_mode: str = "max"
+    # Telemetry (obs/): span tracing writes <logdir>/trace.jsonl and feeds
+    # the per-step breakdown fields (t_data/t_step/f_data/...) into every
+    # train record; the registry snapshot rides the same record and a
+    # Prometheus text snapshot lands at <logdir>/metrics.prom.
+    trace: bool = True
+    # Per-chip model FLOPs per optimizer step — enables the mfu fields in
+    # the metric stream (analytic 6·N·D-style, or train.py's
+    # --estimate-flops XLA-cost estimate).  0 = no MFU accounting.
+    flops_per_step: float = 0.0
+    # Streaming anomaly detection (obs.AnomalyDetector) at log boundaries:
+    # NaN/Inf loss, loss z-spike, step-time regression vs trailing median.
+    # Anomalies log, count into the registry, land in trace.jsonl, and fan
+    # out to Callback.on_anomaly.  False disables.
+    anomaly_detection: bool = True
 
     def __post_init__(self):
         # Fail a dead-on-arrival gate at setup, not after the first eval.
@@ -106,6 +123,12 @@ class Callback:
 
     def on_checkpoint(self, trainer: "Trainer", step: int, state) -> None: ...
 
+    def on_anomaly(self, trainer: "Trainer", anomaly) -> None:
+        """Fires per detected :class:`~..obs.Anomaly` (NaN loss, loss
+        spike, step-time regression).  Runs under the Watchdog callback
+        guard: exceptions are logged, never fatal to the fit."""
+        ...
+
     def on_fit_end(self, trainer: "Trainer", state) -> None: ...
 
 
@@ -130,6 +153,20 @@ class Trainer:
         self.stop_training = False
         self.writer = MetricWriter(config.logdir)
         self.meter = ThroughputMeter(config.global_batch_size)
+        #: Span recorder for the current fit (obs.TraceRecorder); feeds the
+        #: step-time breakdown and writes <logdir>/trace.jsonl.
+        self.tracer: obs.TraceRecorder | None = None
+        #: Streaming anomaly detector, fed at log boundaries.
+        self.anomaly_detector = (
+            obs.AnomalyDetector(on_anomaly=self._record_anomaly)
+            if config.anomaly_detection else None
+        )
+        self._anomaly_counter = obs.counter(
+            "anomalies_total", "anomalies detected by kind"
+        )
+        # Breakdown window clocks (reset at every log boundary).
+        self._window_t0 = time.perf_counter()
+        self._window_step0 = 0
         # Latest eval metrics, threaded into checkpointer.save() so a
         # best_metric (keep-best) manager works under the Trainer.
         self._last_eval_metrics: dict | None = None
@@ -149,32 +186,83 @@ class Trainer:
         # Model.fit contract: stop_training resets on entry).
         self.stop_training = False
         self.meter.start()
+        self._window_t0 = time.perf_counter()
+        self._window_step0 = int(state.step)
         watchdog = None
         if cfg.watchdog_timeout > 0:
             from ..utils.watchdog import Watchdog
 
             watchdog = Watchdog(cfg.watchdog_timeout)
-        try:
-            for cb in self.callbacks:
-                cb.on_fit_begin(self, state)
-            state = self._fit_loop(state, it, rng, eval_iter_fn, watchdog)
-        finally:
-            if watchdog is not None:
-                watchdog.stop()
-            close = getattr(train_iter, "close", None)
-            if close is not None:
-                close()
-        if self.checkpointer is not None and not self._preempted:
-            # Label with the step actually reached (an accuracy-gate early
-            # stop must not save under the total_steps slot).  A preemption
-            # exit already force-saved inside the loop.
-            self.checkpointer.save(
-                int(state.step), state, force=True, metrics=self._ckpt_metrics()
+        if cfg.trace:
+            trace_path = (
+                os.path.join(cfg.logdir, "trace.jsonl") if cfg.logdir else None
             )
-            self.checkpointer.wait()
+            self.tracer = obs.TraceRecorder(trace_path).install()
+        try:
+            try:
+                for cb in self.callbacks:
+                    cb.on_fit_begin(self, state)
+                state = self._fit_loop(state, it, rng, eval_iter_fn, watchdog)
+            finally:
+                if self.tracer is not None:
+                    # Early returns (target gate, preemption, stop_training)
+                    # leave the last step row open; flush it HERE so the
+                    # post-loop force-checkpoint's spans land unanchored
+                    # instead of inflating that step's t_wall.
+                    self.tracer.end_step()
+                if watchdog is not None:
+                    watchdog.stop()
+                close = getattr(train_iter, "close", None)
+                if close is not None:
+                    close()
+            if self.checkpointer is not None and not self._preempted:
+                # Label with the step actually reached (an accuracy-gate
+                # early stop must not save under the total_steps slot).  A
+                # preemption exit already force-saved inside the loop.
+                self.checkpointer.save(
+                    int(state.step), state, force=True,
+                    metrics=self._ckpt_metrics(),
+                )
+                self.checkpointer.wait()
+            for cb in self.callbacks:
+                cb.on_fit_end(self, state)
+            return state
+        finally:
+            if self.tracer is not None:
+                self.tracer.uninstall()
+                self.tracer.close()
+                self.tracer = None
+
+    def close(self) -> None:
+        """Release owned resources — flushes and closes the metric writer.
+
+        Idempotent; ``with Trainer(...) as t: t.fit(...)`` guarantees the
+        ``metrics.jsonl`` handle is released on any exit path (it used to
+        leak on every non-happy path)."""
+        self.writer.close()
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _record_anomaly(self, anomaly) -> None:
+        """Default anomaly sink: log, count, trace, fan out to callbacks —
+        the Watchdog on_timeout convention (never fatal to the fit)."""
+        logger.error("anomaly: %s", anomaly.message)
+        self._anomaly_counter.inc(kind=anomaly.kind)
+        if self.tracer is not None:
+            self.tracer.write_event({
+                "kind": "anomaly", "step": anomaly.step,
+                "anomaly": anomaly.kind, "message": anomaly.message,
+                "value": anomaly.value,
+            })
         for cb in self.callbacks:
-            cb.on_fit_end(self, state)
-        return state
+            try:
+                cb.on_anomaly(self, anomaly)
+            except Exception:
+                logger.exception("on_anomaly callback failed")
 
     def _ckpt_metrics(self, manager=None) -> dict | None:
         """Metrics to attach to a save through ``manager`` (default: the
@@ -239,50 +327,61 @@ class Trainer:
                         and step_i <= profile_at < step_i + k_eff):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
-                if k == 1:
-                    batch = next(it)
-                elif cfg.input_prebundled:
-                    batch = next(it)  # already (k', B, ...) global arrays
-                    k_have = jax.tree.leaves(batch)[0].shape[0]
-                    if k_have == 0:
-                        raise StopIteration
-                    if k_have < k_eff:
-                        # Short trailing bundle: TRAIN it (shrinking this
-                        # dispatch; one extra compile) rather than raising
-                        # StopIteration and silently discarding up to k-1
-                        # trainable batches.  The stream then surfaces its
-                        # genuine end on the next next(it).
-                        k_eff = k_have
-                    elif k_have > k_eff:
-                        # Tail: slice the REPLICATED leading step dim.
-                        # Under jit (one extra tail compile) because an
-                        # eager slice of a non-fully-addressable global
-                        # array is illegal in multi-controller JAX.
-                        batch = jax.jit(
-                            lambda b: jax.tree.map(
-                                lambda x: x[:k_eff], b
-                            )
-                        )(batch)
-                else:
-                    # Explicit loop, not a genexp: an exhausted iterator
-                    # must surface as StopIteration (the k=1 behavior),
-                    # not PEP-479's RuntimeError.  np.stack for host
-                    # batches (keeps them uncommitted so the jit can shard
-                    # them); jnp.stack only for already-device single-
-                    # process arrays.
-                    bundle = []
-                    for _ in range(k_eff):
-                        bundle.append(next(it))
-                    batch = jax.tree.map(
-                        lambda *xs: (
-                            np.stack(xs)
-                            if isinstance(xs[0], np.ndarray)
-                            else jnp.stack(xs)
-                        ),
-                        *bundle,
-                    )
+                if self.tracer is not None:
+                    self.tracer.begin_step(step_i + k_eff, k_eff)
+                # data_wait is a plain-class span (obs.span): it must be
+                # exception-transparent — StopIteration from next(it) ends
+                # the fit and has to escape unchanged.
+                with obs.span("data_wait"):
+                    if k == 1:
+                        batch = next(it)
+                    elif cfg.input_prebundled:
+                        batch = next(it)  # already (k', B, ...) global arrays
+                        k_have = jax.tree.leaves(batch)[0].shape[0]
+                        if k_have == 0:
+                            raise StopIteration
+                        if k_have < k_eff:
+                            # Short trailing bundle: TRAIN it (shrinking this
+                            # dispatch; one extra compile) rather than raising
+                            # StopIteration and silently discarding up to k-1
+                            # trainable batches.  The stream then surfaces its
+                            # genuine end on the next next(it).
+                            k_eff = k_have
+                        elif k_have > k_eff:
+                            # Tail: slice the REPLICATED leading step dim.
+                            # Under jit (one extra tail compile) because an
+                            # eager slice of a non-fully-addressable global
+                            # array is illegal in multi-controller JAX.
+                            batch = jax.jit(
+                                lambda b: jax.tree.map(
+                                    lambda x: x[:k_eff], b
+                                )
+                            )(batch)
+                    else:
+                        # Explicit loop, not a genexp: an exhausted iterator
+                        # must surface as StopIteration (the k=1 behavior),
+                        # not PEP-479's RuntimeError.  np.stack for host
+                        # batches (keeps them uncommitted so the jit can shard
+                        # them); jnp.stack only for already-device single-
+                        # process arrays.
+                        bundle = []
+                        for _ in range(k_eff):
+                            bundle.append(next(it))
+                        batch = jax.tree.map(
+                            lambda *xs: (
+                                np.stack(xs)
+                                if isinstance(xs[0], np.ndarray)
+                                else jnp.stack(xs)
+                            ),
+                            *bundle,
+                        )
                 step_next = step_i + k_eff
-                state, metrics = self.train_step(state, batch, rng)
+                if self.tracer is not None:
+                    # k_eff may have shrunk during the fetch (short
+                    # prebundled tail); relabel the row with final values.
+                    self.tracer.adjust_step(step_next, k_eff)
+                with obs.span("train_step"):
+                    state, metrics = self.train_step(state, batch, rng)
                 if k > 1:  # stacked (k_eff, ...) metrics; report the last
                     metrics = jax.tree.map(lambda v: v[-1], metrics)
                 self.meter.update(k_eff)
@@ -303,10 +402,32 @@ class Trainer:
                 step_i = step_next - 1  # hooks below address the last step
                 if crosses(step_next - k_eff, step_next, cfg.log_every):
                     # jax.Array fetches sync here, off the critical cadence
-                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    with obs.span("host_block"):
+                        last_metrics = {
+                            k: float(v) for k, v in metrics.items()
+                        }
                     last_metrics.update(self.meter.rates())
                     last_metrics.update(device_memory_stats())
+                    breakdown = self._window_breakdown(step_next)
+                    last_metrics.update(breakdown)
+                    if jax.process_count() > 1:
+                        # Every host reaches this branch, so the allgather
+                        # is globally consistent; chief-only would hang it.
+                        agg = obs.host_aggregate({
+                            "t_step": breakdown.get("t_step", 0.0),
+                            "t_data": breakdown.get("t_data", 0.0),
+                        })
+                        last_metrics.update(agg)
+                        logger.info(obs.straggler_summary(agg, "t_step"))
+                    last_metrics.update(obs.default_registry().scalars())
+                    if self.anomaly_detector is not None:
+                        self.anomaly_detector.observe(
+                            step_i + 1,
+                            loss=last_metrics.get("loss"),
+                            step_time=breakdown.get("t_step"),
+                        )
                     self.writer.write(step_i + 1, last_metrics)
+                    self._export_prometheus()
                     logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
                     self.meter.start()
                 if (
@@ -314,7 +435,8 @@ class Trainer:
                     and eval_iter_fn is not None
                     and crosses(step_next - k_eff, step_next, cfg.eval_every)
                 ):
-                    eval_metrics = self.evaluate(state, eval_iter_fn())
+                    with obs.span("eval"):
+                        eval_metrics = self.evaluate(state, eval_iter_fn())
                     self._last_eval_metrics = eval_metrics
                     self.writer.write(
                         step_i + 1,
@@ -362,6 +484,8 @@ class Trainer:
                         "callback requested stop at step %d", step_i + 1
                     )
                     return state
+                if self.tracer is not None:
+                    self.tracer.end_step()
                 step_i = step_next
         finally:
             if profiling:  # exception mid-window, or window past total_steps
@@ -373,6 +497,61 @@ class Trainer:
                 cfg.total_steps, profile_at,
             )
         return state
+
+    def _window_breakdown(self, step_next: int) -> dict[str, float]:
+        """Per-optimizer-step time breakdown since the last log boundary.
+
+        ``t_step`` is wall seconds per step; ``t_data`` / ``t_dispatch`` /
+        ``t_host`` are the span totals (data-wait, compute dispatch, host
+        metric-fetch blocking) divided by the window's step count, with
+        ``f_*`` their fractions of ``t_step``.  ``t_eval`` / ``t_ckpt``
+        appear when the window contained eval/checkpoint work (those hooks
+        run after the log write, so their spans land in the FOLLOWING
+        window — one-boundary shift, steady-state exact).  MFU fields ride
+        along when ``TrainerConfig.flops_per_step`` is set
+        (``bench_probe.mfu_fields`` accounting).
+        """
+        now = time.perf_counter()
+        n = max(step_next - self._window_step0, 1)
+        wall = max(now - self._window_t0, 1e-12)
+        self._window_t0 = now
+        self._window_step0 = step_next
+        t_step = wall / n
+        if self.tracer is None:
+            # trace=False still reports wall-clock-per-step (and MFU, which
+            # derives from it) — neither needs spans, and the step-time-
+            # regression detector feeds on t_step.
+            return {
+                "t_step": t_step,
+                **obs.mfu_record_fields(self.config.flops_per_step, t_step),
+            }
+        totals = self.tracer.drain_window()
+        out = {
+            "t_step": t_step,
+            "t_data": totals.get("data_wait", 0.0) / n,
+            "t_dispatch": totals.get("train_step", 0.0) / n,
+            "t_host": totals.get("host_block", 0.0) / n,
+        }
+        if totals.get("eval"):
+            out["t_eval"] = totals["eval"] / n
+        if totals.get("checkpoint_save"):
+            out["t_ckpt"] = totals["checkpoint_save"] / n
+        for part in ("data", "dispatch", "host"):
+            out[f"f_{part}"] = out[f"t_{part}"] / t_step
+        out.update(
+            obs.mfu_record_fields(self.config.flops_per_step, t_step)
+        )
+        return out
+
+    def _export_prometheus(self) -> None:
+        if self.config.logdir is None or jax.process_index() != 0:
+            return
+        try:
+            obs.default_registry().write_prometheus(
+                os.path.join(self.config.logdir, "metrics.prom")
+            )
+        except OSError:  # a full/readonly disk must not kill the fit
+            logger.exception("prometheus snapshot write failed")
 
     def _target_reached(self, eval_metrics: dict, step: int) -> bool:
         cfg = self.config

@@ -1,0 +1,60 @@
+"""Compatibility shims for the jax this image ships (0.4.37).
+
+The codebase targets the modern spellings (``jax.shard_map`` with
+``check_vma``/``axis_names``, ``jax.sharding.set_mesh``); this image's jax
+predates them.  Importing this module (the package ``__init__`` does)
+installs equivalents onto the jax namespace so both the library and the
+test suite run unchanged on either version:
+
+- ``jax.shard_map(f, mesh=, in_specs=, out_specs=, check_vma=, axis_names=)``
+  → ``jax.experimental.shard_map.shard_map`` with ``check_rep=check_vma``
+  and the partial-manual set translated (new API names the MANUAL axes via
+  ``axis_names``; the old API names the AUTO remainder via ``auto``);
+- ``jax.sharding.set_mesh(mesh)`` → the legacy ambient-mesh context
+  (``Mesh`` is itself a context manager).
+
+No-op on a jax that already has the modern API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental import shard_map as _sm
+
+    def _shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=True,
+                   axis_names=None, **kwargs):
+        auto = kwargs.pop("auto", None)
+        if kwargs:
+            raise TypeError(f"shard_map compat: unknown kwargs {sorted(kwargs)}")
+        if axis_names is not None and auto is None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        extra = {"auto": auto} if auto else {}
+        return _sm.shard_map(
+            f, mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=bool(check_vma), **extra,
+        )
+
+    jax.shard_map = _shard_map
+
+if not hasattr(jax.tree, "map_with_path"):
+    jax.tree.map_with_path = jax.tree_util.tree_map_with_path
+
+if not hasattr(jax.tree, "leaves_with_path"):
+    jax.tree.leaves_with_path = jax.tree_util.tree_leaves_with_path
+
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        """Size of a mapped axis — the classic ``psum(1, axis)`` idiom
+        (constant-folds to a Python int at trace time)."""
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+if not hasattr(jax.sharding, "set_mesh"):
+    def _set_mesh(mesh):
+        """Ambient-mesh context: the legacy ``with mesh:`` global mesh."""
+        return mesh
+
+    jax.sharding.set_mesh = _set_mesh

@@ -4,12 +4,20 @@ Reference: ``tf.summary`` event files + Keras callbacks + chief-only
 convention (SURVEY.md §5.5).  A ``metrics.jsonl`` record is always written
 (the human/tool-greppable artifact); TensorBoard-compatible event output is
 layered on top through ``tf.summary`` when TF is importable.
+
+Lifecycle contract: ``MetricWriter`` is a context manager, ``close()`` is
+idempotent and flushes, and every owner (``Trainer``, ``SidecarEvaluator``,
+``train.py``'s async-PS role) closes its writer on shutdown — the one
+append/flush/close discipline for everything that touches
+``metrics.jsonl``.  Writes after ``close()`` are dropped (a late async
+callback must not crash teardown).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import time
 from typing import Any, Mapping
@@ -19,6 +27,23 @@ import jax
 logger = logging.getLogger("distributedtensorflow_tpu")
 
 
+def json_sanitize(value: Any) -> Any:
+    """Map non-finite floats to sentinel strings ("NaN"/"Infinity"/
+    "-Infinity"), recursively.  ``json.dumps`` would otherwise emit bare
+    ``NaN`` tokens — invalid strict JSON — exactly on the rows that matter
+    most (a NaN loss).  Consumers (``tools/run_report.py``,
+    ``tools/check_metrics_schema.py``) decode the sentinels back."""
+    if isinstance(value, float) and not math.isfinite(value):
+        if math.isnan(value):
+            return "NaN"
+        return "Infinity" if value > 0 else "-Infinity"
+    if isinstance(value, dict):
+        return {k: json_sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [json_sanitize(v) for v in value]
+    return value
+
+
 class MetricWriter:
     """Writes scalars; only the chief process actually emits (SURVEY.md §5.5)."""
 
@@ -26,6 +51,7 @@ class MetricWriter:
         self._chief = jax.process_index() == 0
         self._tb = None
         self._jsonl = None
+        self._closed = False
         if not self._chief or logdir is None:
             return
         os.makedirs(logdir, exist_ok=True)
@@ -41,9 +67,11 @@ class MetricWriter:
         self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
 
     def write(self, step: int, scalars: Mapping[str, Any]) -> None:
-        if not self._chief:
+        if not self._chief or self._closed:
             return
-        scalars = {k: float(v) for k, v in scalars.items()}
+        scalars = {
+            k: float(v) for k, v in scalars.items() if v is not None
+        }
         if self._tb is not None:
             import tensorflow as tf  # noqa: PLC0415
 
@@ -52,12 +80,59 @@ class MetricWriter:
                     tf.summary.scalar(k, v)
             self._tb.flush()
         if self._jsonl is not None:
-            self._jsonl.write(json.dumps({"step": step, **scalars}) + "\n")
+            self._jsonl.write(
+                json.dumps(json_sanitize({"step": step, **scalars}),
+                           allow_nan=False) + "\n"
+            )
             self._jsonl.flush()
 
+    def write_record(self, record: Mapping[str, Any]) -> None:
+        """Append one free-form JSON record (chief-only, flushed).
+
+        For streams whose rows are not step-keyed scalar dicts (the
+        async-PS progress records carry nested histograms); shares this
+        writer's handle/flush/close discipline instead of a raw
+        ``open(...)`` next to it.
+        """
+        if not self._chief or self._closed or self._jsonl is None:
+            return
+        self._jsonl.write(
+            json.dumps(json_sanitize(dict(record)), allow_nan=False) + "\n"
+        )
+        self._jsonl.flush()
+
+    def flush(self) -> None:
+        if self._jsonl is not None and not self._closed:
+            self._jsonl.flush()
+        if self._tb is not None and not self._closed:
+            self._tb.flush()
+
     def close(self) -> None:
+        """Flush and release both sinks; safe to call more than once."""
+        if self._closed:
+            return
+        self._closed = True
         if self._jsonl is not None:
-            self._jsonl.close()
+            try:
+                self._jsonl.flush()
+            finally:
+                self._jsonl.close()
+                self._jsonl = None
+        if self._tb is not None:
+            try:
+                self._tb.flush()
+                close = getattr(self._tb, "close", None)
+                if close is not None:
+                    close()
+            except Exception:  # a broken TB writer must not mask teardown
+                logger.exception("tensorboard writer close failed")
+            self._tb = None
+
+    def __enter__(self) -> "MetricWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class ThroughputMeter:

@@ -59,8 +59,11 @@ def test_capacity_drops_tokens():
 
 def test_top2_route_invariants():
     logits = jax.random.normal(jax.random.PRNGKey(1), (16, E))
-    dispatch, combine, aux = top2_route(logits, capacity=6)
-    assert dispatch.shape == (16, E, 6)
+    # capacity = num tokens: ample under ANY logits draw (the PRNG stream
+    # differs across jax versions, so a merely-probably-ample capacity
+    # made the every-token-fully-routed invariant below seed-dependent)
+    dispatch, combine, aux = top2_route(logits, capacity=16)
+    assert dispatch.shape == (16, E, 16)
     # each token occupies at most two slots (its two experts)
     per_token = dispatch.sum(axis=(1, 2))
     assert (per_token <= 2).all()

@@ -45,7 +45,11 @@ def test_named_scope_in_hlo():
         with named_scope("my_marker_scope"):
             return x * 2 + 1
 
-    hlo = jax.jit(f).lower(jnp.ones((4,))).as_text(debug_info=True)
+    lowered = jax.jit(f).lower(jnp.ones((4,)))
+    try:  # this image's jax (0.4.37) has no as_text(debug_info=...)
+        hlo = lowered.as_text(debug_info=True)
+    except TypeError:
+        hlo = lowered.compile().as_text()  # op metadata survives compile
     assert "my_marker_scope" in hlo
 
 

@@ -1,0 +1,137 @@
+"""tools/run_report.py + tools/check_metrics_schema.py against a synthetic
+logdir — the tier-1 exercise of the reporting path (no training needed)."""
+
+import json
+
+import pytest
+
+from tools import check_metrics_schema, run_report
+
+
+def _write_jsonl(path, rows):
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+
+@pytest.fixture
+def logdir(tmp_path):
+    rows = []
+    for i, step in enumerate(range(10, 101, 10)):
+        rows.append({
+            "step": step, "loss": 2.0 - 0.01 * i, "accuracy": 0.1 + 0.05 * i,
+            "steps_per_sec": 10.0,
+            "t_step": 0.1 if step < 100 else 0.4,  # final window regresses
+            "t_data": 0.01, "t_dispatch": 0.08, "t_host": 0.001,
+            "f_data": 0.1, "f_dispatch": 0.8, "f_host": 0.01,
+            "t_step_host_min": 0.09, "t_step_host_median": 0.1,
+            "t_step_host_max": 0.12, "t_step_straggler": 3,
+        })
+        if step % 50 == 0:
+            rows.append({"step": step, "eval_loss": 1.5, "eval_accuracy": 0.5})
+    _write_jsonl(tmp_path / "metrics.jsonl", rows)
+    trace = [
+        {"step": s, "k": 1, "t_wall": 0.1,
+         "spans": [{"name": "data_wait", "dur_s": 0.01},
+                   {"name": "train_step", "dur_s": 0.08}]}
+        for s in range(1, 6)
+    ]
+    trace.append({"kind": "anomaly", "step": 100,
+                  "anomaly": "step_time_regression",
+                  "message": "step time 0.4s is 4.0x the trailing median",
+                  "value": 0.4})
+    _write_jsonl(tmp_path / "trace.jsonl", trace)
+    return tmp_path
+
+
+def test_build_report_sections(logdir):
+    report = run_report.build_report(str(logdir))
+    assert report["rows"] == {"train": 10, "eval": 2, "trace": 6}
+    assert report["steps"] == {"first": 10, "last": 100}
+    st = report["step_time"]
+    assert st["source"] == "t_step breakdown fields"
+    assert st["p50"] == pytest.approx(0.1)
+    assert st["max"] == pytest.approx(0.4)
+    parts = {b["part"]: b for b in report["breakdown"]}
+    assert parts["data_wait"]["s_per_step"] == pytest.approx(0.01)
+    assert 0 < parts["dispatch"]["fraction"] < 1
+    # recorded anomaly survives; step-time regression at step 100
+    kinds = {a["anomaly"] for a in report["anomalies"]}
+    assert "step_time_regression" in kinds
+    assert report["stragglers"]["t_step"]["straggler"] == 3
+    assert report["final_eval"]["eval_accuracy"] == 0.5
+
+
+def test_render_contains_tables(logdir, capsys):
+    assert run_report.main([str(logdir)]) == 0
+    out = capsys.readouterr().out
+    assert "RUN REPORT" in out
+    assert "p50 0.1s" in out
+    assert "data_wait" in out and "dispatch" in out
+    assert "step_time_regression" in out
+    assert "straggler host 3" in out
+
+
+def test_report_json_mode(logdir, capsys):
+    assert run_report.main([str(logdir), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["rows"]["train"] == 10
+
+
+def test_report_offline_rescan_finds_nan(tmp_path):
+    rows = [{"step": s, "loss": 1.0} for s in range(1, 5)]
+    # the writer records NaN as the strict-JSON sentinel string
+    rows.append({"step": 5, "loss": "NaN"})
+    _write_jsonl(tmp_path / "metrics.jsonl", rows)  # no trace.jsonl at all
+    report = run_report.build_report(str(tmp_path))
+    assert any(
+        a["anomaly"] == "non_finite_loss" and a.get("source") == "offline_rescan"
+        for a in report["anomalies"]
+    )
+
+
+def test_report_missing_logdir():
+    with pytest.raises(SystemExit):
+        run_report.build_report("/nonexistent/logdir")
+
+
+# --- schema checker ---------------------------------------------------------
+
+
+def test_schema_accepts_valid_rows(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    _write_jsonl(p, [
+        {"step": 0, "loss": 1.0},
+        {"step": 100, "eval_accuracy": 0.99, "hbm_in_use_gib": 1.25},
+    ])
+    errors, warnings = check_metrics_schema.check_file(str(p))
+    assert errors == [] and warnings == []
+    assert check_metrics_schema.main([str(p)]) == 0
+
+
+def test_schema_rejects_bad_rows(tmp_path, capsys):
+    p = tmp_path / "metrics.jsonl"
+    p.write_text(
+        json.dumps({"loss": 1.0}) + "\n"  # missing step
+        + json.dumps({"step": -1, "loss": 1.0}) + "\n"  # negative step
+        + json.dumps({"step": 2, "note": "a string"}) + "\n"  # non-numeric
+        + "{broken json\n"
+    )
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 4
+    assert check_metrics_schema.main([str(p)]) == 1
+
+
+def test_schema_warns_on_non_finite(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    # both spellings: the sentinel string the current writer emits, and a
+    # bare NaN token from a pre-sentinel log (python json still parses it)
+    _write_jsonl(p, [{"step": 1, "loss": "NaN"}])
+    with open(p, "a") as f:
+        f.write('{"step": 2, "loss": NaN}\n')
+    errors, warnings = check_metrics_schema.check_file(str(p))
+    assert errors == []
+    assert len(warnings) == 2  # NaN loss is recordable, flagged not fatal
+
+
+def test_schema_default_glob_covers_artifacts():
+    # the repo's own convergence artifacts must satisfy the documented schema
+    assert check_metrics_schema.main([]) == 0

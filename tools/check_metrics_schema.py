@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Validate ``metrics.jsonl`` files against the documented row schema.
+
+Usage::
+
+    python tools/check_metrics_schema.py                # all ARTIFACTS runs
+    python tools/check_metrics_schema.py path/a.jsonl [path/b.jsonl ...]
+
+The schema (docs/API.md "Telemetry"): every row of a *training-run*
+``metrics.jsonl`` is one JSON object with
+
+- ``step``: a non-negative integer (integral floats accepted — JSON has one
+  number type);
+- every other entry: a finite number, or one of the non-finite sentinel
+  strings ``"NaN"`` / ``"Infinity"`` / ``"-Infinity"`` the writer emits to
+  keep lines strict JSON (reported as a warning, not an error — a NaN loss
+  is exactly what the stream must be able to record), with a non-empty key
+  free of control characters.
+
+Rows written by the async-PS role (keyed by ``time``/``global_version``
+instead of ``step``, nested ``staleness_hist``) are a different stream and
+out of scope here; this tool targets the convergence/training artifacts.
+
+Exit status: 0 = every file valid, 1 = any violation (CI gate).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_GLOB = os.path.join(REPO, "ARTIFACTS", "convergence_*", "metrics.jsonl")
+
+
+def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
+    """Returns (errors, warnings) for one parsed row."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    if not isinstance(row, dict):
+        return [f"line {lineno}: row is {type(row).__name__}, not an object"], []
+    step = row.get("step")
+    if step is None:
+        errors.append(f"line {lineno}: missing 'step'")
+    elif not isinstance(step, (int, float)) or isinstance(step, bool) \
+            or float(step) != int(step) or step < 0:
+        errors.append(f"line {lineno}: 'step' {step!r} is not a "
+                      "non-negative integer")
+    for k, v in row.items():
+        if k == "step":
+            continue
+        if not isinstance(k, str) or not k or any(ord(c) < 32 for c in k):
+            errors.append(f"line {lineno}: bad field name {k!r}")
+            continue
+        if v in ("NaN", "Infinity", "-Infinity"):
+            warnings.append(f"line {lineno}: field {k!r} is non-finite ({v})")
+        elif isinstance(v, bool) or not isinstance(v, (int, float)):
+            errors.append(
+                f"line {lineno}: field {k!r} is {type(v).__name__}, "
+                "not a number"
+            )
+        elif not math.isfinite(v):
+            # pre-sentinel writers emitted bare NaN tokens; python json
+            # still parses them, so keep flagging rather than erroring
+            warnings.append(f"line {lineno}: field {k!r} is non-finite ({v})")
+    return errors, warnings
+
+
+def check_file(path: str) -> tuple[list[str], list[str]]:
+    errors: list[str] = []
+    warnings: list[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: invalid JSON ({e})")
+                continue
+            e, w = check_row(row, i)
+            errors.extend(e)
+            warnings.extend(w)
+    return errors, warnings
+
+
+def main(argv: list[str] | None = None) -> int:
+    paths = list(argv) if argv else sorted(glob.glob(DEFAULT_GLOB))
+    if not paths:
+        print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)
+        return 1
+    failed = False
+    for path in paths:
+        errors, warnings = check_file(path)
+        for w in warnings:
+            print(f"WARN  {path}: {w}")
+        if errors:
+            failed = True
+            for e in errors:
+                print(f"ERROR {path}: {e}")
+        else:
+            print(f"OK    {path}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Render a logdir's telemetry streams into one human-readable run report.
+
+Usage::
+
+    python tools/run_report.py <logdir> [--json]
+
+Reads ``<logdir>/metrics.jsonl`` (required) and ``<logdir>/trace.jsonl``
+(optional) — the two streams the obs subsystem writes — and prints:
+
+- run summary (rows, step range, final/best metrics);
+- step-time percentiles (p50/p90/p99/max), from the per-record ``t_step``
+  breakdown fields when present, else from per-step trace rows, else from
+  ``steps_per_sec``;
+- the step-time breakdown table (mean data-wait / dispatch / host-block /
+  eval / checkpoint fractions);
+- anomalies: events recorded in ``trace.jsonl`` by the live detector, plus
+  an offline re-scan of the metric rows (so pre-obs logs still get a
+  verdict);
+- straggler summary when the run was multi-host (``*_host_min/median/max``
+  fields).
+
+``--json`` emits the same content as one machine-readable JSON object.
+Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import statistics
+import sys
+
+
+_NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
+              "-Infinity": float("-inf")}
+
+
+def _load_jsonl(path: str) -> list[dict]:
+    rows = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"{path}:{i + 1}: skipping bad row ({e})",
+                      file=sys.stderr)
+                continue
+            if isinstance(row, dict):
+                # decode the writer's strict-JSON non-finite sentinels
+                rows.append({
+                    k: _NONFINITE.get(v, v) if isinstance(v, str) else v
+                    for k, v in row.items()
+                })
+    return rows
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile (no interpolation; stdlib-only)."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def split_rows(rows: list[dict]) -> tuple[list[dict], list[dict]]:
+    """(train records, eval records) — eval rows carry only eval-prefixed
+    scalars: ``eval_*`` from the Trainer, ``eval/*`` from the sidecar
+    evaluator."""
+    train, evals = [], []
+    for r in rows:
+        keys = set(r) - {"step"}
+        if keys and all(k.startswith(("eval_", "eval/")) for k in keys):
+            evals.append(r)
+        else:
+            train.append(r)
+    return train, evals
+
+
+def step_times(train: list[dict], trace: list[dict]) -> tuple[list[float], str]:
+    """Per-step wall seconds and which source supplied them."""
+    vals = [r["t_step"] for r in train
+            if isinstance(r.get("t_step"), (int, float))]
+    if vals:
+        return vals, "t_step breakdown fields"
+    vals = [r["t_wall"] / max(int(r.get("k", 1)), 1) for r in trace
+            if isinstance(r.get("t_wall"), (int, float))]
+    if vals:
+        return vals, "trace.jsonl step rows"
+    vals = [1.0 / r["steps_per_sec"] for r in train
+            if r.get("steps_per_sec")]
+    return vals, "1/steps_per_sec"
+
+
+def breakdown_table(train: list[dict]) -> list[tuple[str, float, float]]:
+    """[(part, mean_seconds_per_step, mean_fraction)] from breakdown fields."""
+    parts = [
+        ("data_wait", "t_data"),
+        ("dispatch", "t_dispatch"),
+        ("host_block", "t_host"),
+        ("eval", "t_eval"),
+        ("checkpoint", "t_ckpt"),
+    ]
+    rows_with = [r for r in train if isinstance(r.get("t_step"), (int, float))]
+    if not rows_with:
+        return []
+    mean_t_step = statistics.fmean(r["t_step"] for r in rows_with)
+    out = []
+    for label, key in parts:
+        vals = [r[key] for r in rows_with
+                if isinstance(r.get(key), (int, float))]
+        if not vals:
+            continue
+        # absent key in a row = 0 contribution in that window
+        mean_s = sum(vals) / len(rows_with)
+        out.append((label, mean_s, mean_s / mean_t_step if mean_t_step else 0.0))
+    return out
+
+
+def collect_anomalies(trace: list[dict], train: list[dict]) -> list[dict]:
+    recorded = [r for r in trace if r.get("kind") == "anomaly"]
+    # Offline re-scan with the same detector the Trainer runs live, so a
+    # logdir written before obs (or with detection off) still gets checked.
+    # Exception, not ImportError: the package import chain pulls in jax,
+    # and on an analysis box with a different jax this must degrade to
+    # recorded-only, never crash the report (the tool's portability
+    # contract).
+    try:
+        from distributedtensorflow_tpu.obs import AnomalyDetector
+    except Exception as e:
+        print(f"offline anomaly re-scan unavailable ({e})", file=sys.stderr)
+        return recorded
+    det = AnomalyDetector(on_anomaly=lambda a: None)
+    seen = {(r.get("anomaly"), r.get("step")) for r in recorded}
+    for r in train:
+        for a in det.observe_record(r):
+            if (a.kind, a.step) not in seen:
+                recorded.append({
+                    "kind": "anomaly", "step": a.step, "anomaly": a.kind,
+                    "message": a.message, "value": a.value,
+                    "source": "offline_rescan",
+                })
+    return recorded
+
+
+def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
+    """Last-row host-spread fields, grouped by base key."""
+    out: dict[str, dict[str, float]] = {}
+    for r in train:
+        for k, v in r.items():
+            for suffix in ("_host_min", "_host_median", "_host_max",
+                           "_straggler"):
+                if k.endswith(suffix):
+                    base = k[: -len(suffix)]
+                    out.setdefault(base, {})[suffix.lstrip("_")] = v
+    return out
+
+
+def build_report(logdir: str) -> dict:
+    metrics_path = os.path.join(logdir, "metrics.jsonl")
+    if not os.path.exists(metrics_path):
+        raise SystemExit(f"{metrics_path}: not found (is this a logdir?)")
+    rows = _load_jsonl(metrics_path)
+    trace_path = os.path.join(logdir, "trace.jsonl")
+    trace = _load_jsonl(trace_path) if os.path.exists(trace_path) else []
+    train, evals = split_rows(rows)
+
+    times, source = step_times(train, trace)
+    times_sorted = sorted(times)
+    percentiles = {
+        "p50": _percentile(times_sorted, 0.50),
+        "p90": _percentile(times_sorted, 0.90),
+        "p99": _percentile(times_sorted, 0.99),
+        "max": times_sorted[-1] if times_sorted else float("nan"),
+    } if times_sorted else {}
+
+    steps = [int(r["step"]) for r in rows if "step" in r]
+    final_train = train[-1] if train else {}
+    final_eval = evals[-1] if evals else {}
+    report = {
+        "logdir": logdir,
+        "rows": {"train": len(train), "eval": len(evals),
+                 "trace": len(trace)},
+        "steps": {"first": min(steps), "last": max(steps)} if steps else {},
+        "step_time": {"source": source, "unit": "s/step", **percentiles},
+        "breakdown": [
+            {"part": p, "s_per_step": s, "fraction": f}
+            for p, s, f in breakdown_table(train)
+        ],
+        "anomalies": collect_anomalies(trace, train),
+        "stragglers": straggler_fields(train),
+        "final_metrics": {
+            k: v for k, v in final_train.items()
+            if k in ("step", "loss", "accuracy", "steps_per_sec",
+                     "examples_per_sec_per_chip", "mfu", "mfu_analytic",
+                     "mfu_xla_cost")
+        },
+        "final_eval": final_eval,
+    }
+    return report
+
+
+def render(report: dict) -> str:
+    lines = [
+        f"RUN REPORT — {report['logdir']}",
+        "=" * 72,
+        (
+            f"rows: {report['rows']['train']} train, "
+            f"{report['rows']['eval']} eval, {report['rows']['trace']} trace"
+        ),
+    ]
+    if report["steps"]:
+        lines.append(
+            f"steps: {report['steps']['first']} .. {report['steps']['last']}"
+        )
+    st = report["step_time"]
+    if "p50" in st:
+        lines += [
+            "",
+            f"step time ({st['source']}):",
+            (
+                f"  p50 {st['p50']:.4g}s   p90 {st['p90']:.4g}s   "
+                f"p99 {st['p99']:.4g}s   max {st['max']:.4g}s"
+            ),
+        ]
+    if report["breakdown"]:
+        lines += ["", "step-time breakdown (mean per optimizer step):"]
+        for b in report["breakdown"]:
+            lines.append(
+                f"  {b['part']:<12} {b['s_per_step'] * 1e3:9.3f} ms  "
+                f"{b['fraction'] * 100:6.2f}%"
+            )
+    lines += ["", f"anomalies: {len(report['anomalies'])}"]
+    for a in report["anomalies"][:20]:
+        src = " [offline]" if a.get("source") == "offline_rescan" else ""
+        lines.append(f"  step {a.get('step')}: {a.get('anomaly')} — "
+                     f"{a.get('message', '')}{src}")
+    if len(report["anomalies"]) > 20:
+        lines.append(f"  ... {len(report['anomalies']) - 20} more")
+    if report["stragglers"]:
+        lines += ["", "straggler summary (last record):"]
+        for base, d in report["stragglers"].items():
+            lines.append(
+                f"  {base}: min/median/max = "
+                f"{d.get('host_min', float('nan')):.4g}/"
+                f"{d.get('host_median', float('nan')):.4g}/"
+                f"{d.get('host_max', float('nan')):.4g}s  "
+                f"straggler host {int(d.get('straggler', -1))}"
+            )
+    if report["final_metrics"]:
+        lines += ["", "final train record: " + " ".join(
+            f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in report["final_metrics"].items()
+        )]
+    if report["final_eval"]:
+        lines.append("final eval record:  " + " ".join(
+            f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in report["final_eval"].items()
+        ))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logdir", help="directory holding metrics.jsonl "
+                                  "(+ optional trace.jsonl)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one JSON object")
+    args = p.parse_args(argv)
+    report = build_report(args.logdir)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render(report), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    raise SystemExit(main())

@@ -20,6 +20,7 @@ import os
 import time
 
 import jax
+import numpy as np
 
 
 #: --remat CLI choice -> get_workload(remat=...) value; single mapping
@@ -272,7 +273,6 @@ def run_async_ps(args) -> None:
     ``ParameterServerStrategyV2`` path (SURVEY.md §3.3) — host-side by
     design; the TPU stays with the sync engine (see parallel/param_server.py
     module docstring)."""
-    import json as jsonlib
     import time as time_mod
 
     from distributedtensorflow_tpu.parallel.param_server import AsyncPSTrainer
@@ -307,12 +307,14 @@ def run_async_ps(args) -> None:
         "async-ps: workload=%s ps=%d workers=%d steps=%d batch=%d/worker",
         args.workload, args.num_ps, args.num_workers, args.steps, batch,
     )
-    writer = None
-    if args.logdir:
-        os.makedirs(args.logdir, exist_ok=True)
-        writer = open(os.path.join(args.logdir, "metrics.jsonl"), "a")
+    from distributedtensorflow_tpu.utils.metrics import MetricWriter
+
+    # Routed through MetricWriter (not a raw open()) so every metrics.jsonl
+    # producer shares one append/flush/close discipline; records here are
+    # free-form (nested staleness histogram), hence write_record.
+    writer = MetricWriter(args.logdir, use_tensorboard=False)
     total = args.num_workers * args.num_ps * args.steps
-    with trainer:
+    with writer, trainer:
         trainer.start()
         last = -1
         while True:
@@ -322,12 +324,10 @@ def run_async_ps(args) -> None:
             except TimeoutError:
                 pass
             v = trainer.global_version()
-            if v != last and writer:
-                writer.write(jsonlib.dumps(
-                    {"time": time_mod.time(), "global_version": v,
-                     "of": total}) + "\n")
-                writer.flush()
             if v != last:
+                writer.write_record(
+                    {"time": time_mod.time(), "global_version": v,
+                     "of": total})
                 logging.info("async-ps: %d/%d updates applied", v, total)
             last = v
         metrics = (
@@ -346,13 +346,11 @@ def run_async_ps(args) -> None:
             dict(sorted(hist.items(), key=lambda kv: int(kv[0]))),
             {k: round(v, 4) for k, v in metrics.items()},
         )
-        if writer:
-            writer.write(jsonlib.dumps({
-                "time": time_mod.time(), "final": True,
-                "loss_first": first, "loss_last": last_loss,
-                "staleness_hist": hist, **metrics,
-            }) + "\n")
-            writer.close()
+        writer.write_record({
+            "time": time_mod.time(), "final": True,
+            "loss_first": first, "loss_last": last_loss,
+            "staleness_hist": hist, **metrics,
+        })
         if args.target_metric:
             got = metrics.get(args.target_metric)
             if got is None:
@@ -540,6 +538,22 @@ def main() -> None:
                    help="number of steps to trace")
     p.add_argument("--watchdog-timeout", type=float, default=0.0,
                    help="dump all stacks if no step completes for N seconds")
+    p.add_argument("--flops-per-step", type=float, default=0.0,
+                   help="per-chip model FLOPs per optimizer step (analytic "
+                        "6·N·D-style); enables the mfu fields in "
+                        "metrics.jsonl")
+    p.add_argument("--estimate-flops", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="estimate --flops-per-step from XLA's compiled cost "
+                        "analysis (one extra AOT compile, absorbed by the "
+                        "persistent cache). auto = on for the CPU backend "
+                        "only (an extra TPU compile is not free)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable span tracing (trace.jsonl + the per-step "
+                        "t_data/t_step breakdown fields)")
+    p.add_argument("--no-anomaly-detection", action="store_true",
+                   help="disable the streaming anomaly detector (NaN loss, "
+                        "loss spikes, step-time regression)")
     p.add_argument("--deterministic", action="store_true",
                    help="pin PRNG partitioning + matmul precision for "
                         "cross-topology reproducibility")
@@ -774,6 +788,30 @@ def main() -> None:
     eval_step = (
         make_eval_step(wl.eval_fn, mesh, specs) if wl.eval_fn else None
     )
+    flops_per_step = args.flops_per_step
+    if not flops_per_step and (
+        args.estimate_flops == "on"
+        or (args.estimate_flops == "auto"
+            and jax.default_backend() == "cpu")
+    ):
+        from distributedtensorflow_tpu.train import estimate_step_flops
+
+        lead = (args.steps_per_call,) if args.steps_per_call > 1 else ()
+        batch_sds = {
+            k: jax.ShapeDtypeStruct(
+                lead + (wl.global_batch_size,) + np.shape(v)[1:],
+                np.asarray(v).dtype,
+            )
+            for k, v in wl.init_batch.items()
+        }
+        flops_per_step = estimate_step_flops(
+            train_step, state, batch_sds, jax.random.PRNGKey(args.seed)
+        ) or 0.0
+        if flops_per_step:
+            logging.info(
+                "mfu: XLA cost analysis estimates %.3g FLOPs/step",
+                flops_per_step,
+            )
     if args.target_metric:  # the gate must be able to fire (fail at setup)
         if args.target_value is None:
             raise SystemExit("--target-metric requires --target-value")
@@ -854,6 +892,9 @@ def main() -> None:
             target_metric=args.target_metric,
             target_value=args.target_value,
             target_mode=args.target_mode,
+            trace=not args.no_trace,
+            flops_per_step=flops_per_step,
+            anomaly_detection=not args.no_anomaly_detection,
         ),
         eval_step=eval_step,
         checkpointer=checkpointer,
@@ -885,7 +926,8 @@ def main() -> None:
             eval_iter_fn = lambda: Prefetcher(
                 wl.input_fn(ctx, args.seed + 999), mesh
             )
-    state = trainer.fit(state, train_iter, rng, eval_iter_fn=eval_iter_fn)
+    with trainer:  # closes the metric writer on every exit path
+        state = trainer.fit(state, train_iter, rng, eval_iter_fn=eval_iter_fn)
     logging.info("done at step %d", int(state.step))
 
 
