@@ -99,6 +99,7 @@ class CheckpointManager:
                 f"best_metric={self._best_metric!r} retention needs "
                 f"metrics[{self._best_metric!r}] passed to save()"
             )
+        obs.record_event("checkpoint_begin", step=step)
         with obs.span("checkpoint_save") as sp:
             saved = self._mgr.save(
                 step, args=ocp.args.StandardSave(_as_tree(state)), force=force,
@@ -107,6 +108,10 @@ class CheckpointManager:
                     if metrics else None
                 ),
             )
+        obs.record_event(
+            "checkpoint_end", step=step, saved=bool(saved),
+            blocking_s=round(sp.dur_s, 4),
+        )
         if saved:
             _M_SAVES.inc()
             _M_SAVE_S.set(sp.dur_s)

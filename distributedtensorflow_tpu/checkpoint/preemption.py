@@ -24,10 +24,18 @@ from typing import Callable
 import jax
 import numpy as np
 
+from .. import obs
 from ..train.state import TrainState
 from .manager import CheckpointManager
 
 logger = logging.getLogger("distributedtensorflow_tpu")
+
+# Registry metric (obs/): preemption notices observed by this process —
+# fleet dashboards watch the rate; the flight recorder gets the per-event
+# forensic record (signal number, step of the consistent save).
+_M_PREEMPTIONS = obs.counter(
+    "preemptions_total", "preemption notices observed (signal or trigger)"
+)
 
 
 class PreemptionHandler:
@@ -56,6 +64,10 @@ class PreemptionHandler:
         self._on_exit = on_exit
         self._poll_every = max(1, poll_every)
         self._flag = threading.Event()
+        #: Signal-context stash: (source, signum) awaiting a lock-safe
+        #: flush; ``_recorded`` dedupes repeated notices.
+        self._pending: tuple[str, int] | None = None
+        self._recorded = False
         self._installed = []
         for sig in signals:
             try:
@@ -66,7 +78,32 @@ class PreemptionHandler:
 
     def _on_signal(self, signum, frame):
         logger.warning("preemption signal %s received", signum)
+        # Signal handlers interrupt the MAIN thread, which may be holding
+        # the flight ring's or a counter's non-reentrant lock at that very
+        # instant (flight.record("step") runs every dispatch) — taking
+        # either here could self-deadlock exactly when the consistent save
+        # matters most.  Stash the notice; should_save()/save_and_exit()
+        # flush it from normal loop context.
+        if not self._flag.is_set():
+            self._pending = ("signal", int(signum))
         self._flag.set()
+
+    def _record_preemption(self, *, source: str, signum: int | None = None):
+        """Structured ``preemption`` event into the flight recorder + the
+        ``preemptions_total`` counter (once per preemption)."""
+        if self._recorded:
+            return  # repeated notices for one preemption count once
+        self._recorded = True
+        _M_PREEMPTIONS.inc()
+        event = {"source": source}
+        if signum is not None:
+            event["signal"] = signum
+        obs.record_event("preemption", **event)
+
+    def _flush_pending(self) -> None:
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._record_preemption(source=pending[0], signum=pending[1])
 
     @property
     def preempted(self) -> bool:
@@ -80,7 +117,9 @@ class PreemptionHandler:
         return self._manager
 
     def trigger(self) -> None:
-        """Programmatic preemption (tests / external watchers)."""
+        """Programmatic preemption (tests / external watchers) — normal
+        thread context, so the event records immediately."""
+        self._record_preemption(source="trigger")
         self._flag.set()
 
     def should_save(self, step: int | None = None) -> bool:
@@ -98,6 +137,7 @@ class PreemptionHandler:
         seconds long.  A locally-set flag waits (at most ``poll_every``
         steps) for the next poll boundary.  ``step=None`` polls now.
         """
+        self._flush_pending()  # lock-safe context: record a stashed notice
         local = 1 if self._flag.is_set() else 0
         if jax.process_count() == 1:
             return bool(local)
@@ -115,9 +155,14 @@ class PreemptionHandler:
         launcher restarts the job, which resumes from this checkpoint).
         ``metrics`` feeds a keep-best manager's retention scoring (required
         by such managers on every save)."""
+        self._flush_pending()  # callers may skip should_save (tests)
         self._manager.save(step, state, force=True, metrics=metrics)
         self._manager.wait()
         logger.warning("preemption save complete at step %d", step)
+        obs.record_event("preemption_save", step=step)
+        flight = obs.default_recorder()
+        if flight is not None:  # the process is about to exit: persist now
+            flight.dump(reason="preemption")
         if self._on_exit is not None:
             self._on_exit()
 

@@ -18,12 +18,27 @@ Surfaces:
 - ``host_aggregate`` — per-host gauge allgather → min/median/max/straggler;
 - ``AnomalyDetector`` — NaN/Inf loss, loss z-spike, step-time regression,
   raising through the Watchdog-style callback convention;
-- ``tools/run_report.py`` — renders a logdir's two streams into one
+- ``FlightRecorder`` — bounded ring of structured events, dumped to
+  ``flight.jsonl`` on watchdog timeout / crash / anomaly / preemption so a
+  dying job always leaves a last-minutes forensic record;
+- ``StatusServer`` — per-host stdlib HTTP thread serving ``/healthz``,
+  ``/statusz``, ``/varz``, ``/threadz``, ``/memz``, ``/flightz`` — the
+  live half: point ``curl`` at a run while it is wedged;
+- ``memory`` — per-device HBM, host RSS, and ``jax.live_arrays()`` census
+  feeding the registry, the per-step record, and ``/memz``;
+- ``tools/run_report.py`` — renders a logdir's streams into one
   human-readable run report.
 """
 
+from . import flight_recorder, memory  # noqa: F401
 from .aggregate import host_aggregate, straggler_summary  # noqa: F401
 from .anomaly import Anomaly, AnomalyDetector  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    default_recorder,
+    install_recorder,
+    record_event,
+)
 from .mfu import mfu_record_fields, peak_flops  # noqa: F401
 from .registry import (  # noqa: F401
     Counter,
@@ -36,4 +51,5 @@ from .registry import (  # noqa: F401
     histogram,
     set_default_registry,
 )
+from .server import StatusServer  # noqa: F401
 from .tracing import Span, TraceRecorder, active_recorder, span  # noqa: F401

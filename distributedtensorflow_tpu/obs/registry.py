@@ -154,6 +154,35 @@ class Histogram(_Metric):
             "avg": total / n if n else 0.0,
         }
 
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated ``q``-quantile from the cumulative buckets — linear
+        interpolation inside the containing bucket (the PromQL
+        ``histogram_quantile`` estimate, computed registry-side so the
+        ``metrics.prom`` snapshot can carry summary lines without a query
+        engine).  Observations past the last finite bound clamp to it
+        (PromQL's +Inf-bucket behavior); no observations → NaN."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            counts, _total, n = self._hist.get(
+                _label_key(labels), ([0] * (len(self.buckets) + 1), 0.0, 0)
+            )
+            counts = list(counts)
+        if n == 0:
+            return float("nan")
+        target = q * n
+        cum = 0
+        for i, c in enumerate(counts):
+            prev = cum
+            cum += c
+            if cum >= target and c > 0:
+                if i >= len(self.buckets):  # +Inf bucket: clamp
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * (target - prev) / c
+        return self.buckets[-1]
+
     def _hist_items(self):
         with self._lock:
             return [
@@ -228,7 +257,8 @@ class Registry:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
             if isinstance(m, Histogram):
-                for key, counts, total, n in m._hist_items():
+                hist_items = m._hist_items()
+                for key, counts, total, n in hist_items:
                     labels = dict(key)
                     cum = 0
                     for bound, c in zip(m.buckets, counts):
@@ -240,6 +270,22 @@ class Registry:
                     s = _label_suffix(key)
                     lines.append(f"{name}_sum{s} {_fmt_float(total)}")
                     lines.append(f"{name}_count{s} {n}")
+                # Summary-style quantile estimates (p50/p95/p99) so a
+                # scrape-less reader of metrics.prom gets tail latency
+                # without running histogram_quantile.  A SIBLING gauge
+                # family, not extra samples under the histogram TYPE:
+                # quantile-labeled samples inside a histogram family are
+                # invalid exposition format and strict parsers
+                # (promtool, expfmt) reject the whole page.
+                lines.append(f"# TYPE {name}_quantile gauge")
+                for key, _counts, _total, _n in hist_items:
+                    labels = dict(key)
+                    for q in (0.5, 0.95, 0.99):
+                        lk = _label_key({**labels, "quantile": repr(q)})
+                        lines.append(
+                            f"{name}_quantile{_label_suffix(lk)} "
+                            f"{_fmt_float(m.quantile(q, **labels))}"
+                        )
             else:
                 for key, v in m._items():
                     lines.append(f"{name}{_label_suffix(key)} {_fmt_float(v)}")

@@ -1,0 +1,234 @@
+"""Live introspection HTTP server: point ``curl`` at a wedged run.
+
+Post-hoc streams answer "what happened"; this answers "what is happening"
+— a stdlib ``http.server`` background thread per host (the */statusz*
+family every production serving stack grows), read-only, no third-party
+deps, safe to leave on for a whole training job:
+
+- ``/healthz`` — liveness JSON (last step, watchdog ping age); HTTP 503
+  once the watchdog has fired, so a pod-level prober can flag the wedged
+  host without parsing anything;
+- ``/statusz`` — human-readable run summary (step, loss, breakdown
+  fractions, straggler info, checkpoint state);
+- ``/varz``   — the metrics registry's live Prometheus snapshot (the
+  file-based ``metrics.prom`` without waiting for a log boundary);
+- ``/threadz`` — all-thread stack dump (the watchdog's post-mortem, on
+  demand while the process is still alive — THE mid-hang artifact);
+- ``/memz``   — per-device HBM, host RSS, live-array census JSON;
+- ``/flightz`` — the flight recorder's current ring as a JSON array.
+
+Every handler is read-only and must not touch the device (no collectives,
+no blocking fetches) — it has to answer precisely when the main thread is
+wedged inside one.  ``port=0`` binds an ephemeral port (tests, multiple
+hosts per box); the bound port is ``server.port``.
+
+Exposure: the default bind is loopback — ``/threadz`` stack traces and
+``/flightz`` exception messages leak paths and config, and there is no
+authentication.  Pass ``host="0.0.0.0"`` explicitly (train.py's
+``--status-host``) only on a trusted cluster network where remote
+``curl`` of a wedged host is the point.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+__all__ = ["StatusServer"]
+
+_ENDPOINTS = {
+    "/healthz": "liveness: last step, watchdog ping age (503 after timeout)",
+    "/statusz": "human-readable run summary",
+    "/varz": "Prometheus metrics snapshot (live)",
+    "/threadz": "stack dump of every thread",
+    "/memz": "device HBM + host RSS + live-array census",
+    "/flightz": "flight-recorder ring (JSON array)",
+}
+
+
+def _render_status(value: Any, indent: str = "") -> list[str]:
+    """dict → aligned ``key: value`` lines (nested dicts indent)."""
+    lines: list[str] = []
+    if not isinstance(value, dict):
+        return [f"{indent}{value}"]
+    width = max((len(str(k)) for k in value), default=0)
+    for k, v in value.items():
+        if isinstance(v, dict):
+            lines.append(f"{indent}{k}:")
+            lines.extend(_render_status(v, indent + "  "))
+        elif isinstance(v, float):
+            lines.append(f"{indent}{str(k):<{width}}  {v:.6g}")
+        else:
+            lines.append(f"{indent}{str(k):<{width}}  {v}")
+    return lines
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set per-server via the factory in StatusServer.__init__.
+    server_ref: "StatusServer"
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # request logs stay out of stderr
+        logger.debug("statusz: " + fmt, *args)
+
+    def _reply(self, body: str, *, status: int = 200,
+               content_type: str = "text/plain; charset=utf-8") -> None:
+        data = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_json(self, payload: Any, *, status: int = 200) -> None:
+        from ..utils.metrics import json_sanitize  # noqa: PLC0415
+
+        self._reply(
+            json.dumps(json_sanitize(payload), indent=2, allow_nan=False)
+            + "\n",
+            status=status, content_type="application/json",
+        )
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        srv = self.server_ref
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/", "/helpz"):
+                self._reply(
+                    "distributedtensorflow_tpu introspection server\n\n"
+                    + "\n".join(f"  {p:<10} {d}"
+                                for p, d in _ENDPOINTS.items())
+                    + "\n"
+                )
+            elif path == "/healthz":
+                health = srv.health()
+                self._reply_json(
+                    health, status=200 if health.get("ok", True) else 503
+                )
+            elif path == "/statusz":
+                self._reply("\n".join(_render_status(srv.status())) + "\n")
+            elif path == "/varz":
+                self._reply(
+                    srv.registry.to_prometheus(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/threadz":
+                from ..utils.watchdog import dump_all_stacks  # noqa: PLC0415
+
+                buf = io.StringIO()
+                dump_all_stacks(file=buf)
+                self._reply(buf.getvalue())
+            elif path == "/memz":
+                from . import memory  # noqa: PLC0415
+
+                self._reply_json(memory.memz())
+            elif path == "/flightz":
+                flight = srv.flight
+                self._reply_json(flight.events() if flight is not None else [])
+            else:
+                self._reply(f"unknown endpoint {path}\n", status=404)
+        except Exception as e:  # a handler bug must not kill the server
+            logger.exception("statusz handler failed for %s", path)
+            try:
+                self._reply(f"internal error: {e!r}\n", status=500)
+            except OSError:
+                pass  # client went away mid-reply
+
+
+class StatusServer:
+    """Background-thread HTTP server exposing the introspection endpoints.
+
+    All sources are optional: ``registry`` defaults to the process
+    registry, ``flight`` to the process-default flight recorder at serve
+    time, ``status_fn``/``health_fn`` to minimal uptime payloads.  The
+    supplied callables run on handler threads — they must be thread-safe
+    and must never block on the device.
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        *,
+        host: str = "127.0.0.1",
+        registry=None,
+        flight=None,
+        status_fn: Callable[[], dict] | None = None,
+        health_fn: Callable[[], dict] | None = None,
+    ):
+        from . import registry as reglib  # noqa: PLC0415
+
+        self._registry = registry or reglib.default_registry()
+        self._flight = flight
+        self._status_fn = status_fn
+        self._health_fn = health_fn
+        self._t0 = time.time()
+        handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.port: int = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dtf-statusz", daemon=True
+        )
+        self._started = False
+
+    # -- sources (read by the handler) ---------------------------------------
+
+    @property
+    def registry(self):
+        return self._registry
+
+    @property
+    def flight(self):
+        if self._flight is not None:
+            return self._flight
+        from . import flight_recorder  # noqa: PLC0415
+
+        return flight_recorder.default_recorder()
+
+    def status(self) -> dict:
+        base = {"uptime_s": round(time.time() - self._t0, 1)}
+        if self._status_fn is not None:
+            base.update(self._status_fn())
+        return base
+
+    def health(self) -> dict:
+        base: dict = {"ok": True,
+                      "uptime_s": round(time.time() - self._t0, 1)}
+        if self._health_fn is not None:
+            base.update(self._health_fn())
+        return base
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "StatusServer":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+            logger.info("introspection server listening on port %d "
+                        "(/healthz /statusz /varz /threadz /memz /flightz)",
+                        self.port)
+        return self
+
+    def stop(self) -> None:
+        """Idempotent shutdown; joins the serve thread."""
+        if self._started:
+            self._started = False
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+    close = stop
+
+    def __enter__(self) -> "StatusServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -406,16 +406,31 @@ class _Worker(threading.Thread):
                     closure.output._set_error(err)
                     queue.mark_failed(err)
                     _M_FAILED.inc()  # retry exhaustion is a permanent failure
+                    obs.record_event(
+                        "coordinator_failure", worker=self.worker_id,
+                        attempts=closure.attempts, error="retries exhausted",
+                    )
                     continue
                 logger.warning(
                     "worker %d unavailable (%s); re-queueing closure "
                     "(attempt %d)", self.worker_id, e, closure.attempts,
+                )
+                # Flight marker: a retried closure is exactly the kind of
+                # "what was happening before the hang" breadcrumb the
+                # post-mortem wants (a dying worker pool precedes a stall).
+                obs.record_event(
+                    "coordinator_retry", worker=self.worker_id,
+                    attempt=closure.attempts, error=repr(e)[:200],
                 )
                 queue.put_back(closure)
             except BaseException as e:  # noqa: BLE001 — parked, re-raised at join
                 closure.output._set_error(e)
                 queue.mark_failed(e)
                 _M_FAILED.inc()
+                obs.record_event(
+                    "coordinator_failure", worker=self.worker_id,
+                    error=repr(e)[:200],
+                )
             else:
                 closure.output._set_value(result)
                 queue.mark_finished()

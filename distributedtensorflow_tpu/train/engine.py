@@ -145,12 +145,20 @@ class _InstrumentedStep:
     def __call__(self, *args):
         if self._first:
             self._first = False
+            # Flight markers: a hang *during* compile looks identical to a
+            # stalled collective from outside; a ring whose last event is
+            # compile_begin (no matching compile) is the disambiguating
+            # post-mortem signature — so the begin marker must land BEFORE
+            # the potentially-wedging call.
+            obs.record_event("compile_begin", label=self._label)
             with obs.span(f"compile_{self._label}"):
                 t0 = time.perf_counter()
                 out = self._jitted(*args)
-                self._first_gauge.set(
-                    time.perf_counter() - t0, kind=self._label
-                )
+                dur = time.perf_counter() - t0
+                self._first_gauge.set(dur, kind=self._label)
+            obs.record_event(
+                "compile", label=self._label, seconds=round(dur, 3)
+            )
             self._dispatches.inc(kind=self._label)
             return out
         self._dispatches.inc(kind=self._label)

@@ -84,6 +84,21 @@ class TrainerConfig:
     # Anomalies log, count into the registry, land in trace.jsonl, and fan
     # out to Callback.on_anomaly.  False disables.
     anomaly_detection: bool = True
+    # Live introspection server (obs.StatusServer): /healthz /statusz /varz
+    # /threadz /memz /flightz on this port (0 = ephemeral; the bound port is
+    # trainer.status_server.port).  None disables.  status_host defaults to
+    # loopback — set "0.0.0.0" only on a trusted cluster network (/threadz
+    # and /flightz leak paths and exception text; no auth).
+    status_port: int | None = None
+    status_host: str = "127.0.0.1"
+    # Crash/hang flight recorder (obs.FlightRecorder): bounded ring of
+    # structured events (step boundaries, checkpoint begin/end, anomalies,
+    # preemption, compile/coordinator markers), dumped to
+    # <logdir>/flight.jsonl on watchdog timeout, unhandled exception,
+    # anomaly, preemption, and clean fit exit.  Installed as the process
+    # default so deep layers' markers flow in.
+    flight_recorder: bool = False
+    flight_capacity: int = 2048
 
     def __post_init__(self):
         # Fail a dead-on-arrival gate at setup, not after the first eval.
@@ -171,6 +186,59 @@ class Trainer:
         # best_metric (keep-best) manager works under the Trainer.
         self._last_eval_metrics: dict | None = None
         self._preempted = False
+        #: The fit's hang watchdog while a fit is running (health surface).
+        self.watchdog = None
+        # Last log-boundary record + step — what /statusz and /healthz
+        # report (plain dict reads under the GIL; handlers never sync).
+        self._last_record: dict = {}
+        self._last_step = 0
+        self._fit_t0: float | None = None
+        # Checkpoint state tracked trainer-side so /statusz never does
+        # storage I/O (an all_steps() listing would block on exactly the
+        # stalled mount a wedged job is being probed about).
+        self._ckpt_count = 0
+        self._last_ckpt_step: int | None = None
+        #: Flight recorder (obs.FlightRecorder), installed as the process
+        #: default so markers from the engine/checkpoint/coordinator/
+        #: preemption layers land in the same ring.  Chief writes
+        #: <logdir>/flight.jsonl; other hosts flight.<proc>.jsonl (a hang
+        #: post-mortem needs EVERY host's record, not just the chief's).
+        self.flight: obs.FlightRecorder | None = None
+        if config.flight_recorder:
+            path = None
+            if config.logdir is not None:
+                idx = jax.process_index()
+                name = "flight.jsonl" if idx == 0 else f"flight.{idx}.jsonl"
+                path = os.path.join(config.logdir, name)
+            self.flight = obs.FlightRecorder(config.flight_capacity, path)
+            obs.install_recorder(self.flight)
+            self.flight.install_crash_hooks()
+        #: Live introspection server (obs.StatusServer); alive for the
+        #: trainer's whole lifetime so a wedged fit can still be probed.
+        self.status_server: obs.StatusServer | None = None
+        if config.status_port is not None:
+            # Multi-process-per-host launches would all bind the same
+            # configured port: offset a fixed port by process index (so
+            # every process stays probeable at a predictable address);
+            # port 0 is ephemeral and needs none.  A failed bind degrades
+            # to a warning — introspection must never kill the job it is
+            # meant to debug.
+            port = config.status_port
+            if port:
+                port += jax.process_index()
+            try:
+                self.status_server = obs.StatusServer(
+                    port,
+                    host=config.status_host,
+                    flight=self.flight,
+                    status_fn=self.status,
+                    health_fn=self.health,
+                ).start()
+            except OSError:
+                logger.exception(
+                    "introspection server failed to bind %s:%d; "
+                    "continuing without it", config.status_host, port,
+                )
 
     def fit(
         self,
@@ -188,16 +256,27 @@ class Trainer:
         self.meter.start()
         self._window_t0 = time.perf_counter()
         self._window_step0 = int(state.step)
+        self._last_step = int(state.step)
+        self._fit_t0 = time.time()
+        if self.flight is not None:
+            self.flight.record(
+                "fit_begin", step=int(state.step),
+                total_steps=cfg.total_steps,
+            )
         watchdog = None
         if cfg.watchdog_timeout > 0:
             from ..utils.watchdog import Watchdog
 
-            watchdog = Watchdog(cfg.watchdog_timeout)
+            watchdog = Watchdog(
+                cfg.watchdog_timeout, flight_recorder=self.flight
+            )
+        self.watchdog = watchdog
         if cfg.trace:
             trace_path = (
                 os.path.join(cfg.logdir, "trace.jsonl") if cfg.logdir else None
             )
             self.tracer = obs.TraceRecorder(trace_path).install()
+        fit_exc: BaseException | None = None
         try:
             try:
                 for cb in self.callbacks:
@@ -212,6 +291,7 @@ class Trainer:
                     self.tracer.end_step()
                 if watchdog is not None:
                     watchdog.stop()
+                    self.watchdog = None
                 close = getattr(train_iter, "close", None)
                 if close is not None:
                     close()
@@ -224,22 +304,57 @@ class Trainer:
                     metrics=self._ckpt_metrics(),
                 )
                 self.checkpointer.wait()
+                self._ckpt_count += 1
+                self._last_ckpt_step = int(state.step)
             for cb in self.callbacks:
                 cb.on_fit_end(self, state)
             return state
+        except BaseException as e:
+            # Captured explicitly, NOT via sys.exc_info() in the finally:
+            # there exc_info also reports an OUTER in-flight exception
+            # (fit() called inside an except block), which would stamp a
+            # bogus crash verdict on a clean fit.
+            fit_exc = e
+            raise
         finally:
             if self.tracer is not None:
                 self.tracer.uninstall()
                 self.tracer.close()
                 self.tracer = None
+            if self.flight is not None:
+                # Clean exits leave a record too; an exception unwinding
+                # through here is recorded before the dump (the top-level
+                # excepthook would only fire after close() uninstalls it).
+                # fit_end marks CLEAN exits only — run_report's clean-exit
+                # verdict keys on the last event being fit_end, so a
+                # crashed fit must end on its exception event instead.
+                if fit_exc is not None:
+                    self.flight.record(
+                        "exception", exc_type=type(fit_exc).__name__,
+                        message=str(fit_exc)[:500],
+                    )
+                    self.flight.dump(reason=type(fit_exc).__name__)
+                else:
+                    self.flight.record(
+                        "fit_end", step=int(state.step),
+                        preempted=self._preempted,
+                    )
+                    self.flight.dump()
 
     def close(self) -> None:
-        """Release owned resources — flushes and closes the metric writer.
+        """Release owned resources — the metric writer, the introspection
+        server, and the flight recorder's default-installation/crash hooks.
 
         Idempotent; ``with Trainer(...) as t: t.fit(...)`` guarantees the
         ``metrics.jsonl`` handle is released on any exit path (it used to
         leak on every non-happy path)."""
         self.writer.close()
+        if self.status_server is not None:
+            self.status_server.stop()
+        if self.flight is not None:
+            self.flight.uninstall_crash_hooks()
+            if obs.default_recorder() is self.flight:
+                obs.install_recorder(None)
 
     def __enter__(self) -> "Trainer":
         return self
@@ -248,8 +363,9 @@ class Trainer:
         self.close()
 
     def _record_anomaly(self, anomaly) -> None:
-        """Default anomaly sink: log, count, trace, fan out to callbacks —
-        the Watchdog on_timeout convention (never fatal to the fit)."""
+        """Default anomaly sink: log, count, trace, flight-record, fan out
+        to callbacks — the Watchdog on_timeout convention (never fatal to
+        the fit)."""
         logger.error("anomaly: %s", anomaly.message)
         self._anomaly_counter.inc(kind=anomaly.kind)
         if self.tracer is not None:
@@ -258,6 +374,8 @@ class Trainer:
                 "anomaly": anomaly.kind, "message": anomaly.message,
                 "value": anomaly.value,
             })
+        if self.flight is not None:  # records the event AND dumps the ring
+            self.flight.record_anomaly(anomaly)
         for cb in self.callbacks:
             try:
                 cb.on_anomaly(self, anomaly)
@@ -385,6 +503,11 @@ class Trainer:
                 if k > 1:  # stacked (k_eff, ...) metrics; report the last
                     metrics = jax.tree.map(lambda v: v[-1], metrics)
                 self.meter.update(k_eff)
+                self._last_step = step_next
+                if self.flight is not None:
+                    # Step-boundary breadcrumb: dispatch returned (async —
+                    # the device may still be computing), no metric fetch.
+                    self.flight.record("step", step=step_next, k=k_eff)
                 for cb in self.callbacks:
                     cb.on_step_end(self, step_next, state, metrics)
                 if watchdog is not None:
@@ -407,7 +530,13 @@ class Trainer:
                             k: float(v) for k, v in metrics.items()
                         }
                     last_metrics.update(self.meter.rates())
-                    last_metrics.update(device_memory_stats())
+                    # HBM + host RSS + live-array census ride every logged
+                    # record; the labeled per-device gauges refresh for
+                    # /varz and the metrics.prom snapshot.  One collect()
+                    # feeds both — the census is O(#live arrays).
+                    mem_snap = obs.memory.collect()
+                    last_metrics.update(obs.memory.record_fields(mem_snap))
+                    obs.memory.update_registry(snapshot=mem_snap)
                     breakdown = self._window_breakdown(step_next)
                     last_metrics.update(breakdown)
                     if jax.process_count() > 1:
@@ -429,6 +558,13 @@ class Trainer:
                     self.writer.write(step_i + 1, last_metrics)
                     self._export_prometheus()
                     logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
+                    self._last_record = last_metrics  # /statusz snapshot
+                    if self.flight is not None:
+                        self.flight.record(
+                            "log", step=step_i + 1,
+                            loss=last_metrics.get("loss"),
+                            t_step=breakdown.get("t_step"),
+                        )
                     self.meter.start()
                 if (
                     self.eval_step is not None
@@ -438,6 +574,8 @@ class Trainer:
                     with obs.span("eval"):
                         eval_metrics = self.evaluate(state, eval_iter_fn())
                     self._last_eval_metrics = eval_metrics
+                    if self.flight is not None:
+                        self.flight.record("eval", step=step_i + 1)
                     self.writer.write(
                         step_i + 1,
                         {f"eval_{k}": v for k, v in eval_metrics.items()},
@@ -459,6 +597,8 @@ class Trainer:
                     self.checkpointer.save(
                         step_i + 1, state, metrics=self._ckpt_metrics()
                     )
+                    self._ckpt_count += 1
+                    self._last_ckpt_step = step_i + 1
                     for cb in self.callbacks:
                         cb.on_checkpoint(self, step_i + 1, state)
                     if watchdog is not None:  # so is a synchronous save
@@ -543,6 +683,73 @@ class Trainer:
         )
         return out
 
+    def status(self) -> dict:
+        """/statusz payload: run position, last logged metrics, breakdown
+        fractions, straggler spread, checkpoint state.  Reads plain
+        attributes only — never syncs the device, so it answers mid-hang."""
+        rec = self._last_record
+        out: dict = {
+            "run": {
+                "step": self._last_step,
+                "total_steps": self.config.total_steps,
+                "fit_elapsed_s": (
+                    round(time.time() - self._fit_t0, 1)
+                    if self._fit_t0 else None
+                ),
+                "preempted": self._preempted,
+                "stop_requested": self.stop_training,
+            },
+        }
+        core = {
+            k: rec[k] for k in (
+                "loss", "accuracy", "steps_per_sec",
+                "examples_per_sec_per_chip", "mfu", "hbm_in_use_gib",
+                "hbm_peak_gib", "host_rss_gib", "live_arrays_gib",
+            ) if k in rec
+        }
+        if core:
+            out["last_log"] = core
+        breakdown = {
+            k: rec[k] for k in (
+                "t_step", "t_data", "t_dispatch", "t_host", "t_eval",
+                "t_ckpt", "f_data", "f_dispatch", "f_host",
+            ) if k in rec
+        }
+        if breakdown:
+            out["breakdown"] = breakdown
+        spread = {k: v for k, v in rec.items() if "_host_" in k
+                  or k.endswith("_straggler")}
+        if spread:
+            out["host_spread"] = spread
+        if self.anomaly_detector is not None:
+            out["anomalies"] = len(self.anomaly_detector.anomalies)
+        wd = self.watchdog  # snapshot: fit's finally nulls it concurrently
+        if wd is not None:
+            out["watchdog"] = {
+                "ping_age_s": round(wd.ping_age(), 1),
+                "timeout_s": wd.timeout,
+                "fired": wd.fired,
+            }
+        if self.checkpointer is not None:
+            out["checkpoint"] = {
+                "saves": self._ckpt_count,
+                "last_saved_step": self._last_ckpt_step,
+            }
+        if self._last_eval_metrics:
+            out["last_eval"] = dict(self._last_eval_metrics)
+        return out
+
+    def health(self) -> dict:
+        """/healthz payload; ``ok`` False (HTTP 503) once the watchdog has
+        fired — the signal a pod-level prober keys on."""
+        out: dict = {"ok": True, "last_step": self._last_step}
+        wd = self.watchdog  # snapshot: fit's finally nulls it concurrently
+        if wd is not None:
+            out["watchdog_ping_age_s"] = round(wd.ping_age(), 1)
+            out["watchdog_timeout_s"] = wd.timeout
+            out["ok"] = not wd.fired
+        return out
+
     def _export_prometheus(self) -> None:
         if self.config.logdir is None or jax.process_index() != 0:
             return
@@ -592,10 +799,11 @@ class Trainer:
 def device_memory_stats() -> dict[str, float]:
     """Device-0 HBM usage (GiB), for the periodic metric stream.
 
-    Reference analogue: the memory timeline of the TF profiler
-    (SURVEY.md §5.1); here it rides the scalar metrics so OOM creep is
-    visible in TensorBoard/JSONL without a trace.  Backends without
-    ``memory_stats`` (virtual CPU) contribute nothing.
+    Back-compat surface: the fit loop now records the fuller
+    ``obs.memory.record_fields()`` (HBM + host RSS + live-array census);
+    this keeps the original cheap HBM-only read — no O(#arrays) census —
+    for external callers.  Backends without ``memory_stats`` (virtual
+    CPU) contribute nothing.
     """
     try:
         stats = jax.local_devices()[0].memory_stats()

@@ -6,6 +6,14 @@ The reference ships a watchdog that dumps stacks on coordinator hangs
 failure mode — one wedged host stalls every collective in the job — and the
 most valuable artifact is "where was every thread when it stalled".
 
+Observability wiring (ISSUE 2): the watchdog exports
+``watchdog_ping_age_seconds`` (gauge, refreshed every poll — the
+``/healthz`` liveness signal) and ``watchdog_timeouts_total`` (counter)
+into the obs registry, and on timeout routes the post-mortem through the
+flight recorder: the stall event (with the stack dump) is appended to the
+ring and the ring is dumped to ``flight.jsonl`` — so a hung job leaves its
+last-minutes record even if nobody is watching stderr.
+
 Usage::
 
     wd = Watchdog(timeout=300, on_timeout=...)   # starts armed
@@ -47,7 +55,8 @@ class Watchdog:
     """Background timer that fires when :meth:`ping` stops arriving.
 
     On timeout it dumps every thread's stack (the post-mortem the reference's
-    watchdog produces) and calls ``on_timeout``.  By default the process
+    watchdog produces), records the stall into the flight recorder (and
+    dumps its ring), and calls ``on_timeout``.  By default the process
     keeps running — set ``fatal=True`` to abort with a core-style stack dump
     (``faulthandler``), which is what you want under a job scheduler that
     will restart the task.
@@ -60,16 +69,32 @@ class Watchdog:
         on_timeout: Callable[[], None] | None = None,
         fatal: bool = False,
         poll_interval: float | None = None,
+        flight_recorder=None,
     ):
         self.timeout = timeout
         self._on_timeout = on_timeout
         self._fatal = fatal
+        #: Explicit flight recorder; None falls back to the process default
+        #: at fire time (obs.flight_recorder.install_recorder).
+        self._flight = flight_recorder
         self._last = time.monotonic()
         self._fired = False
         self._stop = threading.Event()
         self._poll = poll_interval if poll_interval is not None else min(
             timeout / 4, 5.0
         )
+        # Lazy obs binding keeps utils importable without completing the
+        # obs package first (utils.__init__ runs during the root import).
+        from ..obs import registry as _reg  # noqa: PLC0415
+
+        self._ping_age_gauge = _reg.gauge(
+            "watchdog_ping_age_seconds",
+            "seconds since the last progress ping (refreshed every poll)",
+        )
+        self._timeouts_counter = _reg.counter(
+            "watchdog_timeouts_total", "watchdog stall firings"
+        )
+        self._ping_age_gauge.set(0.0)
         self._thread = threading.Thread(
             target=self._run, name="dtf-watchdog", daemon=True
         )
@@ -79,6 +104,11 @@ class Watchdog:
         """Record progress; resets the timeout clock."""
         self._last = time.monotonic()
         self._fired = False
+        self._ping_age_gauge.set(0.0)
+
+    def ping_age(self) -> float:
+        """Seconds since the last ping — the ``/healthz`` liveness field."""
+        return time.monotonic() - self._last
 
     @property
     def fired(self) -> bool:
@@ -86,19 +116,22 @@ class Watchdog:
 
     def _run(self) -> None:
         while not self._stop.wait(self._poll):
+            idle = time.monotonic() - self._last
+            self._ping_age_gauge.set(idle)
             if self._fired:
                 continue
-            idle = time.monotonic() - self._last
             if idle < self.timeout:
                 continue
             self._fired = True
+            self._timeouts_counter.inc()
             logger.error(
                 "watchdog: no progress for %.0fs (timeout %.0fs); "
                 "dumping all thread stacks",
                 idle,
                 self.timeout,
             )
-            dump_all_stacks()
+            stacks = dump_all_stacks()
+            self._record_flight(idle, stacks)
             if self._on_timeout is not None:
                 try:
                     self._on_timeout()
@@ -109,6 +142,22 @@ class Watchdog:
                 import os
 
                 os.abort()
+
+    def _record_flight(self, idle: float, stacks: str) -> None:
+        """Append the stall to the flight ring and persist it."""
+        from ..obs import flight_recorder  # noqa: PLC0415
+
+        flight = self._flight or flight_recorder.default_recorder()
+        if flight is None:
+            return
+        try:
+            flight.record(
+                "watchdog_timeout", idle_s=round(idle, 3),
+                timeout_s=self.timeout, stacks=stacks,
+            )
+            flight.dump(reason="watchdog_timeout")
+        except Exception:
+            logger.exception("watchdog flight-recorder dump failed")
 
     def stop(self) -> None:
         self._stop.set()

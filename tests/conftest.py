@@ -43,6 +43,7 @@ _PROCESS_TEST_FILES = {
     "test_examples.py",
     "test_sidecar.py",
     "test_combined_axes.py",
+    "test_train_introspection_smoke.py",
 }
 
 

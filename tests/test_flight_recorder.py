@@ -1,0 +1,215 @@
+"""Flight recorder: ring semantics, dump triggers (watchdog timeout,
+anomaly, crash hook, preemption signal), and the default-recorder plumbing
+deep layers emit through.
+
+The acceptance surface of ISSUE 2: an induced watchdog timeout and an
+induced anomaly must each leave a parseable ``flight.jsonl`` whose last
+events match the injected history.
+"""
+
+import json
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from distributedtensorflow_tpu import obs
+from distributedtensorflow_tpu.obs import flight_recorder
+from distributedtensorflow_tpu.utils.watchdog import Watchdog
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# --- ring semantics ----------------------------------------------------------
+
+
+def test_ring_is_bounded_and_ordered():
+    rec = obs.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("step", step=i)
+    events = rec.events()
+    assert len(events) == len(rec) == 4
+    assert [e["step"] for e in events] == [6, 7, 8, 9]  # oldest dropped
+    assert all(e["kind"] == "step" for e in events)
+    assert all("t" in e for e in events)
+
+
+def test_dump_writes_parseable_jsonl(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    rec = obs.FlightRecorder(capacity=8, path=path)
+    rec.record("fit_begin", step=0)
+    rec.record("anomaly", step=3, value=float("nan"))  # sentinel round-trip
+    assert rec.dump() == path
+    rows = _read_jsonl(path)
+    assert [r["kind"] for r in rows] == ["fit_begin", "anomaly"]
+    assert rows[1]["value"] == "NaN"  # strict-JSON sentinel, not a bare token
+    # repeated dumps overwrite atomically with the newest ring
+    rec.record("fit_end", step=5)
+    rec.dump()
+    assert _read_jsonl(path)[-1]["kind"] == "fit_end"
+
+
+def test_dump_without_path_is_noop():
+    rec = obs.FlightRecorder(capacity=8)
+    rec.record("step", step=1)
+    assert rec.dump() is None
+
+
+# --- default recorder / record_event -----------------------------------------
+
+
+def test_record_event_routes_to_installed_recorder():
+    rec = obs.FlightRecorder(capacity=8)
+    assert flight_recorder.default_recorder() is not rec
+    obs.record_event("orphan")  # no recorder of ours: must not raise
+    prev = obs.install_recorder(rec)
+    try:
+        obs.record_event("checkpoint_begin", step=7, extra="x")
+        events = rec.events()
+        assert events[-1]["kind"] == "checkpoint_begin"
+        assert events[-1]["step"] == 7 and events[-1]["extra"] == "x"
+    finally:
+        obs.install_recorder(prev)
+
+
+# --- dump triggers -----------------------------------------------------------
+
+
+def test_watchdog_timeout_dumps_flight_record(tmp_path):
+    """An induced stall must leave flight.jsonl whose last event is the
+    watchdog_timeout, preceded by the injected history."""
+    path = str(tmp_path / "flight.jsonl")
+    rec = obs.FlightRecorder(capacity=32, path=path)
+    for i in range(3):
+        rec.record("step", step=i)
+    before = obs.counter("watchdog_timeouts_total").value()
+    fired = threading.Event()
+    wd = Watchdog(timeout=0.2, on_timeout=fired.set, poll_interval=0.05,
+                  flight_recorder=rec)
+    try:
+        assert fired.wait(timeout=5.0), "watchdog never fired"
+        deadline = time.monotonic() + 5.0
+        while not (tmp_path / "flight.jsonl").exists():
+            assert time.monotonic() < deadline, "flight dump never landed"
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    rows = _read_jsonl(path)
+    assert [r["kind"] for r in rows[:3]] == ["step"] * 3
+    assert [r["step"] for r in rows[:3]] == [0, 1, 2]
+    last = rows[-1]
+    assert last["kind"] == "watchdog_timeout"
+    assert last["timeout_s"] == pytest.approx(0.2)
+    assert "dtf-watchdog" in last["stacks"]  # the all-thread dump rode along
+    assert obs.counter("watchdog_timeouts_total").value() >= before + 1
+
+
+def test_anomaly_dumps_flight_record(tmp_path):
+    """An induced NaN-loss anomaly routed through record_anomaly must leave
+    a parseable flight.jsonl ending in the anomaly event."""
+    path = str(tmp_path / "flight.jsonl")
+    rec = obs.FlightRecorder(capacity=32, path=path)
+    rec.record("fit_begin", step=0)
+    rec.record("step", step=1)
+    det = obs.AnomalyDetector(on_anomaly=rec.record_anomaly)
+    found = det.observe(2, loss=float("nan"))
+    assert [a.kind for a in found] == ["non_finite_loss"]
+    rows = _read_jsonl(path)
+    assert [r["kind"] for r in rows] == ["fit_begin", "step", "anomaly"]
+    assert rows[-1]["anomaly"] == "non_finite_loss"
+    assert rows[-1]["step"] == 2
+    assert rows[-1]["value"] == "NaN"
+
+
+def test_crash_hook_records_and_dumps(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    rec = obs.FlightRecorder(capacity=8, path=path)
+    rec.record("step", step=1)
+    seen = []
+    prev_hook = sys.excepthook
+    sys.excepthook = lambda *a: seen.append(a)
+    try:
+        rec.install_crash_hooks()
+        rec.install_crash_hooks()  # idempotent: must not stack
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        rec.uninstall_crash_hooks()
+        assert sys.excepthook is not prev_hook  # restored OUR sentinel
+        sys.excepthook = prev_hook
+    assert len(seen) == 1  # chained exactly once to the previous hook
+    rows = _read_jsonl(path)
+    assert [r["kind"] for r in rows] == ["step", "exception"]
+    assert rows[-1]["exc_type"] == "RuntimeError"
+    assert "boom" in rows[-1]["message"]
+
+
+# --- preemption --------------------------------------------------------------
+
+
+class _StubManager:
+    """CheckpointManager-shaped stub: records save/wait calls."""
+
+    def __init__(self):
+        self.saved = []
+
+    def save(self, step, state, *, force=False, metrics=None):
+        self.saved.append(step)
+        return True
+
+    def wait(self):
+        pass
+
+
+def test_preemption_signal_records_flight_event_and_counter(tmp_path):
+    """A raised in-process signal must set the flag, record a structured
+    preemption event, and bump preemptions_total."""
+    from distributedtensorflow_tpu.checkpoint.preemption import (
+        PreemptionHandler,
+    )
+
+    rec = obs.FlightRecorder(capacity=16,
+                             path=str(tmp_path / "flight.jsonl"))
+    prev = obs.install_recorder(rec)
+    before = obs.counter("preemptions_total").value()
+    handler = PreemptionHandler(_StubManager(), signals=(signal.SIGUSR1,))
+    try:
+        assert not handler.preempted
+        signal.raise_signal(signal.SIGUSR1)
+        assert handler.preempted
+        assert handler.should_save(step=12)
+        assert obs.counter("preemptions_total").value() == before + 1
+        events = rec.events()
+        assert events[-1]["kind"] == "preemption"
+        assert events[-1]["source"] == "signal"
+        assert events[-1]["signal"] == int(signal.SIGUSR1)
+        # repeated notices for the same preemption count once
+        signal.raise_signal(signal.SIGUSR1)
+        assert obs.counter("preemptions_total").value() == before + 1
+        handler.save_and_exit(12, state=None)
+        rows = _read_jsonl(str(tmp_path / "flight.jsonl"))
+        assert rows[-1]["kind"] == "preemption_save"
+        assert rows[-1]["step"] == 12
+    finally:
+        handler.uninstall()
+        obs.install_recorder(prev)
+
+
+def test_preemption_trigger_counts_once():
+    from distributedtensorflow_tpu.checkpoint.preemption import (
+        PreemptionHandler,
+    )
+
+    before = obs.counter("preemptions_total").value()
+    handler = PreemptionHandler(_StubManager(), signals=())
+    handler.trigger()
+    handler.trigger()
+    assert handler.preempted
+    assert obs.counter("preemptions_total").value() == before + 1

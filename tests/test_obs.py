@@ -88,6 +88,72 @@ def test_registry_prometheus_text(tmp_path):
     assert not list(tmp_path.glob("*.tmp.*"))  # atomic: no temp leftovers
 
 
+def test_histogram_quantile_interpolation():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 0.5, 1.0))
+    import math
+
+    assert math.isnan(h.quantile(0.5))  # no observations
+    for _ in range(50):
+        h.observe(0.05)  # first bucket (0, 0.1]
+    for _ in range(50):
+        h.observe(0.3)  # second bucket (0.1, 0.5]
+    # p50 sits at the first/second bucket boundary; within-bucket linear
+    # interpolation puts it at the top of bucket one
+    assert h.quantile(0.5) == pytest.approx(0.1)
+    assert 0.1 < h.quantile(0.95) <= 0.5
+    assert h.quantile(1.0) == pytest.approx(0.5)
+    # observations past the last finite bound clamp to it (PromQL +Inf rule)
+    h.observe(100.0)
+    assert h.quantile(0.999) == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_quantile_respects_labels():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05, kind="fast")
+    h.observe(0.9, kind="slow")
+    assert h.quantile(0.5, kind="fast") <= 0.1
+    assert h.quantile(0.5, kind="slow") > 0.1
+
+
+def test_prometheus_snapshot_carries_summary_quantiles():
+    reg = Registry()
+    h = reg.histogram("wait_seconds", buckets=(0.1, 1.0))
+    for _ in range(90):
+        h.observe(0.05)
+    for _ in range(10):
+        h.observe(0.9)
+    text = reg.to_prometheus()
+    # summary-style estimates ride alongside the buckets as a SIBLING
+    # gauge family (quantile samples inside the histogram family itself
+    # would be invalid exposition format)
+    assert "# TYPE wait_seconds_quantile gauge" in text
+    assert 'wait_seconds_quantile{quantile="0.5"}' in text
+    assert 'wait_seconds_quantile{quantile="0.95"}' in text
+    assert 'wait_seconds_quantile{quantile="0.99"}' in text
+    p50 = next(
+        float(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+        if line.startswith('wait_seconds_quantile{quantile="0.5"}')
+    )
+    assert p50 <= 0.1
+    p99 = next(
+        float(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+        if line.startswith('wait_seconds_quantile{quantile="0.99"}')
+    )
+    assert p99 > 0.1
+    # every histogram sample stays inside its own family: the _bucket /
+    # _sum / _count block is contiguous (strict-parser requirement)
+    lines = text.splitlines()
+    hist_idx = [i for i, line in enumerate(lines)
+                if line.startswith(("wait_seconds_bucket",
+                                    "wait_seconds_sum",
+                                    "wait_seconds_count"))]
+    assert hist_idx == list(range(hist_idx[0], hist_idx[-1] + 1))
+
+
 def test_registry_thread_safety():
     reg = Registry()
     c = reg.counter("n")

@@ -10,6 +10,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributedtensorflow_tpu.utils import (
     Watchdog,
@@ -102,6 +103,46 @@ def test_watchdog_rearms_after_ping(capfd):
 def test_dump_all_stacks_includes_this_frame(capfd):
     text = dump_all_stacks()
     assert "test_dump_all_stacks_includes_this_frame" in text
+
+
+def test_watchdog_context_manager_stops_thread():
+    """`with Watchdog(...)` must arm on entry and stop its poll thread on
+    exit — the previously-untested context-manager path."""
+    with Watchdog(timeout=30.0, poll_interval=0.05) as wd:
+        assert wd is not None
+        assert wd._thread.is_alive()
+        wd.ping()
+        assert not wd.fired
+    assert not wd._thread.is_alive()
+
+
+def test_watchdog_context_manager_stops_on_exception():
+    with pytest.raises(RuntimeError):
+        with Watchdog(timeout=30.0, poll_interval=0.05) as wd:
+            raise RuntimeError("body failed")
+    assert not wd._thread.is_alive()
+
+
+def test_watchdog_exports_registry_metrics():
+    from distributedtensorflow_tpu import obs
+
+    before = obs.counter("watchdog_timeouts_total").value()
+    fired = threading.Event()
+    wd = Watchdog(timeout=0.2, on_timeout=fired.set, poll_interval=0.05)
+    try:
+        assert fired.wait(timeout=5.0)
+        deadline = time.monotonic() + 5.0
+        while (obs.counter("watchdog_timeouts_total").value() < before + 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert obs.counter("watchdog_timeouts_total").value() >= before + 1
+        # the poll loop keeps the ping-age gauge fresh; the stall is visible
+        assert obs.gauge("watchdog_ping_age_seconds").value() >= 0.2
+        assert wd.ping_age() >= 0.2
+        wd.ping()
+        assert wd.ping_age() < 0.2
+    finally:
+        wd.stop()
 
 
 # --- determinism ------------------------------------------------------------

@@ -93,6 +93,49 @@ def test_report_missing_logdir():
         run_report.build_report("/nonexistent/logdir")
 
 
+# --- flight recorder section -------------------------------------------------
+
+
+_FLIGHT = [
+    {"t": 100.0, "kind": "fit_begin", "step": 0, "total_steps": 3},
+    {"t": 100.5, "kind": "compile", "label": "train_step", "seconds": 0.5},
+    {"t": 101.0, "kind": "step", "step": 1, "k": 1},
+    {"t": 101.2, "kind": "log", "step": 1, "loss": 2.1},
+    {"t": 101.9, "kind": "watchdog_timeout", "idle_s": 0.7,
+     "timeout_s": 0.5, "stacks": "--- thread MainThread ---"},
+]
+
+
+def test_report_flight_section(logdir, capsys):
+    _write_jsonl(logdir / "flight.jsonl", _FLIGHT)
+    report = run_report.build_report(str(logdir))
+    fl = report["flight"]
+    assert fl["events"] == 5
+    assert fl["clean_exit"] is False  # died mid-flight: no fit_end
+    assert fl["kinds"]["fit_begin"] == 1
+    assert fl["last"][-1]["kind"] == "watchdog_timeout"
+    assert run_report.main([str(logdir)]) == 0
+    out = capsys.readouterr().out
+    assert "flight recorder: 5 events" in out
+    assert "NOT a clean exit" in out
+    assert "watchdog_timeout" in out
+    assert "--- thread" not in out  # stacks stay out of the one-liner
+
+
+def test_report_flight_clean_exit(logdir, capsys):
+    _write_jsonl(logdir / "flight.jsonl",
+                 _FLIGHT[:4] + [{"t": 102.0, "kind": "fit_end", "step": 3}])
+    report = run_report.build_report(str(logdir))
+    assert report["flight"]["clean_exit"] is True
+    assert run_report.main([str(logdir)]) == 0
+    assert "clean exit" in capsys.readouterr().out
+
+
+def test_report_without_flight_has_empty_section(logdir):
+    report = run_report.build_report(str(logdir))
+    assert report["flight"] == {}
+
+
 # --- schema checker ---------------------------------------------------------
 
 
@@ -135,3 +178,40 @@ def test_schema_warns_on_non_finite(tmp_path):
 def test_schema_default_glob_covers_artifacts():
     # the repo's own convergence artifacts must satisfy the documented schema
     assert check_metrics_schema.main([]) == 0
+
+
+def test_flight_schema_accepts_valid_events(tmp_path):
+    p = tmp_path / "flight.jsonl"
+    _write_jsonl(p, [
+        {"t": 100.0, "kind": "fit_begin", "step": 0},
+        {"t": 100.5, "kind": "anomaly", "step": 2, "value": "NaN",
+         "message": "loss is nan"},
+        {"t": 100.5, "kind": "fit_end", "step": 3, "preempted": False},
+    ])
+    errors, warnings = check_metrics_schema.check_file(str(p))
+    assert errors == [] and warnings == []
+    assert check_metrics_schema.main([str(p)]) == 0
+
+
+def test_flight_schema_rejects_bad_events(tmp_path):
+    p = tmp_path / "flight.jsonl"
+    _write_jsonl(p, [
+        {"kind": "step", "step": 1},                 # missing t
+        {"t": 100.0, "step": 1},                     # missing kind
+        {"t": 99.0, "kind": "step", "step": -1},     # t decreases + bad step
+        {"t": 101.0, "kind": "log", "nested": {"a": 1}},  # non-scalar field
+    ])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 5
+    assert check_metrics_schema.main([str(p)]) == 1
+
+
+def test_flight_schema_selected_by_basename(tmp_path):
+    # the same rows validate as metrics, not flight, under another name
+    p = tmp_path / "metrics.jsonl"
+    _write_jsonl(p, [{"t": 100.0, "kind": "step"}])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert any("missing 'step'" in e for e in errors)
+    p2 = tmp_path / "flight.3.jsonl"  # non-chief hosts' dumps also match
+    _write_jsonl(p2, [{"t": 100.0, "kind": "step"}])
+    assert check_metrics_schema.check_file(str(p2)) == ([], [])

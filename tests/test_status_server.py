@@ -1,0 +1,317 @@
+"""Live introspection server: every endpoint served and correct, plus the
+Trainer-integrated path (status_port/flight_recorder TrainerConfig knobs)
+— the ISSUE 2 acceptance surface, all in-process on the virtual CPU mesh.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflow_tpu import obs
+from distributedtensorflow_tpu.obs import memory
+
+
+def _get(port, path, timeout=10):
+    """(status, body) — HTTP errors return their status instead of raising."""
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        )
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def server():
+    reg = obs.Registry()
+    reg.counter("requests_total", "test counter").inc(3)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    flight = obs.FlightRecorder(capacity=8)
+    flight.record("fit_begin", step=0)
+    flight.record("step", step=1)
+    state = {"healthy": True}
+    srv = obs.StatusServer(
+        0, host="127.0.0.1", registry=reg, flight=flight,
+        status_fn=lambda: {"step": 7, "loss": 1.25,
+                           "breakdown": {"f_data": 0.1}},
+        health_fn=lambda: {"ok": state["healthy"], "last_step": 7},
+    ).start()
+    srv._test_state = state
+    yield srv
+    srv.stop()
+
+
+def test_healthz_ok_and_unhealthy_503(server):
+    status, body = _get(server.port, "/healthz")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["ok"] is True
+    assert payload["last_step"] == 7
+    assert payload["uptime_s"] >= 0
+    server._test_state["healthy"] = False
+    status, body = _get(server.port, "/healthz")
+    assert status == 503
+    assert json.loads(body)["ok"] is False
+
+
+def test_statusz_renders_status_fn(server):
+    status, body = _get(server.port, "/statusz")
+    assert status == 200
+    assert "step" in body and "7" in body
+    assert "loss" in body and "1.25" in body
+    assert "f_data" in body  # nested dicts render indented
+
+
+def test_varz_serves_live_prometheus(server):
+    status, body = _get(server.port, "/varz")
+    assert status == 200
+    assert "# TYPE requests_total counter" in body
+    assert "requests_total 3.0" in body
+    assert 'lat_seconds_bucket{le="0.1"} 1' in body
+    assert 'lat_seconds_quantile{quantile="0.5"}' in body  # summary family
+    # live, not a snapshot file: a post-start increment is visible
+    server.registry.counter("requests_total").inc()
+    assert "requests_total 4.0" in _get(server.port, "/varz")[1]
+
+
+def test_threadz_dumps_all_threads(server):
+    status, body = _get(server.port, "/threadz")
+    assert status == 200
+    assert "--- thread" in body
+    assert "MainThread" in body
+
+
+def test_memz_reports_host_and_live_arrays(server):
+    x = jnp.ones((128, 128))  # a live array the census must see
+    status, body = _get(server.port, "/memz")
+    assert status == 200
+    payload = json.loads(body)
+    assert len(payload["devices"]) == len(jax.local_devices())
+    assert payload["host_rss_bytes"] > 0
+    assert payload["live_arrays"]["count"] >= 1
+    assert payload["live_arrays"]["bytes"] >= x.size * x.dtype.itemsize
+
+
+def test_flightz_serves_ring(server):
+    status, body = _get(server.port, "/flightz")
+    assert status == 200
+    events = json.loads(body)
+    assert [e["kind"] for e in events] == ["fit_begin", "step"]
+
+
+def test_index_and_unknown_endpoint(server):
+    status, body = _get(server.port, "/")
+    assert status == 200
+    for ep in ("/healthz", "/statusz", "/varz", "/threadz", "/memz",
+               "/flightz"):
+        assert ep in body
+    status, _ = _get(server.port, "/nope")
+    assert status == 404
+
+
+def test_server_stop_is_idempotent():
+    srv = obs.StatusServer(0, host="127.0.0.1").start()
+    srv.stop()
+    srv.stop()
+
+
+# --- memory module (the /memz sources) ---------------------------------------
+
+
+def test_memory_record_fields_on_cpu():
+    fields = memory.record_fields()
+    # virtual CPU devices report no memory_stats -> no hbm_* fields, but
+    # host RSS and the live-array census must always be present
+    assert fields["host_rss_gib"] > 0
+    assert fields["live_arrays"] >= 0
+    assert fields["live_arrays_gib"] >= 0
+
+
+def test_memory_update_registry_gauges():
+    reg = obs.Registry()
+    memory.update_registry(reg)
+    scalars = reg.scalars()
+    assert scalars["host_rss_bytes"] > 0
+    assert "live_arrays" in scalars and "live_arrays_bytes" in scalars
+
+
+def test_live_arrays_census_top_k():
+    big = jnp.zeros((256, 256), jnp.float32)
+    census = memory.live_arrays_census(top=3)
+    assert census["count"] >= 1
+    assert len(census["top"]) <= 3
+    assert census["top"] == sorted(
+        census["top"], key=lambda e: -e["bytes"]
+    )
+    assert census["top"][0]["bytes"] >= big.size * big.dtype.itemsize
+
+
+# --- Trainer integration (the acceptance path) -------------------------------
+
+
+def _lenet_setup(mesh):
+    from distributedtensorflow_tpu.models import LeNet5
+    from distributedtensorflow_tpu.train import (
+        create_sharded_state,
+        make_train_step,
+    )
+    from distributedtensorflow_tpu.train.losses import classification_loss
+
+    model = LeNet5()
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(0.05), mesh, jax.random.PRNGKey(0)
+    )
+    return state, make_train_step(classification_loss(model), mesh, specs)
+
+
+def _batches(n, batch_size=16, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        yield {
+            "image": rng.standard_normal(
+                (batch_size, 28, 28, 1)
+            ).astype(np.float32),
+            "label": rng.integers(0, 10, (batch_size,)).astype(np.int32),
+        }
+
+
+def test_trainer_status_server_and_flight_recorder(tmp_path, dp_mesh):
+    """TrainerConfig(status_port=0, flight_recorder=True): the server
+    answers /healthz //statusz /flightz about the finished fit, and the
+    logdir holds flight.jsonl + per-step RSS fields — the e2e acceptance
+    check, in-process."""
+    from distributedtensorflow_tpu.train.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    state, train_step = _lenet_setup(dp_mesh)
+    cfg = TrainerConfig(
+        total_steps=3, log_every=1, global_batch_size=16,
+        logdir=str(tmp_path), status_port=0, flight_recorder=True,
+        watchdog_timeout=300.0,
+    )
+    with Trainer(train_step, cfg) as trainer:
+        assert trainer.status_server is not None
+        port = trainer.status_server.port
+        assert port > 0  # ephemeral bind resolved
+        out = trainer.fit(state, _batches(3), jax.random.PRNGKey(1))
+        assert int(out.step) == 3
+
+        status, body = _get(port, "/healthz")
+        assert status == 200
+        assert json.loads(body)["last_step"] == 3
+
+        status, body = _get(port, "/statusz")
+        assert status == 200
+        assert "step" in body and "loss" in body
+
+        status, body = _get(port, "/flightz")
+        events = json.loads(body)
+        kinds = [e["kind"] for e in events]
+        assert kinds[0] == "fit_begin" and kinds[-1] == "fit_end"
+        assert "step" in kinds and "log" in kinds and "compile" in kinds
+
+    # the trainer's exit dumped the ring for post-mortem tooling
+    flight_rows = [
+        json.loads(line) for line in (tmp_path / "flight.jsonl").read_text(
+        ).splitlines() if line.strip()
+    ]
+    assert flight_rows[-1]["kind"] == "fit_end"
+    metric_rows = [
+        json.loads(line) for line in (tmp_path / "metrics.jsonl").read_text(
+        ).splitlines() if line.strip()
+    ]
+    assert all("host_rss_gib" in r for r in metric_rows)
+    assert all("live_arrays_gib" in r for r in metric_rows)
+    # close() released the process-default recorder and the server
+    assert obs.default_recorder() is not trainer.flight
+
+
+def test_trainer_crashed_fit_leaves_exception_tail(tmp_path, dp_mesh):
+    """A fit that dies on an exception must NOT end its flight record in
+    fit_end — run_report's clean-exit verdict keys on the last event."""
+    from distributedtensorflow_tpu.train.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    state, train_step = _lenet_setup(dp_mesh)
+    calls = {"n": 0}
+
+    def exploding_step(state, batch, rng):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("induced mid-fit crash")
+        return train_step(state, batch, rng)
+
+    cfg = TrainerConfig(
+        total_steps=4, log_every=1, global_batch_size=16,
+        logdir=str(tmp_path), flight_recorder=True,
+    )
+    with Trainer(exploding_step, cfg) as trainer:
+        with pytest.raises(RuntimeError, match="induced"):
+            trainer.fit(state, _batches(4), jax.random.PRNGKey(1))
+    rows = [
+        json.loads(line) for line in (tmp_path / "flight.jsonl").read_text(
+        ).splitlines() if line.strip()
+    ]
+    assert rows[-1]["kind"] == "exception"
+    assert rows[-1]["exc_type"] == "RuntimeError"
+    assert "fit_end" not in {r["kind"] for r in rows}
+
+    from tools import run_report
+
+    report = run_report.build_report(str(tmp_path))
+    assert report["flight"]["clean_exit"] is False
+
+
+def test_trainer_clean_fit_inside_except_block_is_clean(tmp_path, dp_mesh):
+    """sys.exc_info() in a finally also sees an OUTER in-flight exception;
+    a clean fit() called from an except block must still record fit_end
+    (the crash verdict comes from the fit's OWN exception only)."""
+    from distributedtensorflow_tpu.train.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    state, train_step = _lenet_setup(dp_mesh)
+    cfg = TrainerConfig(
+        total_steps=2, log_every=1, global_batch_size=16,
+        logdir=str(tmp_path), flight_recorder=True,
+    )
+    with Trainer(train_step, cfg) as trainer:
+        try:
+            raise ValueError("outer in-flight exception")
+        except ValueError:
+            trainer.fit(state, _batches(2), jax.random.PRNGKey(1))
+    rows = [
+        json.loads(line) for line in (tmp_path / "flight.jsonl").read_text(
+        ).splitlines() if line.strip()
+    ]
+    assert rows[-1]["kind"] == "fit_end"
+    assert "exception" not in {r["kind"] for r in rows}
+
+
+def test_trainer_defaults_leave_introspection_off(dp_mesh):
+    from distributedtensorflow_tpu.train.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    state, train_step = _lenet_setup(dp_mesh)
+    cfg = TrainerConfig(total_steps=1, log_every=0, global_batch_size=16)
+    with Trainer(train_step, cfg) as trainer:
+        assert trainer.status_server is None
+        assert trainer.flight is None
+        trainer.fit(state, _batches(1), jax.random.PRNGKey(1))

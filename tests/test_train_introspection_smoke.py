@@ -1,0 +1,81 @@
+"""train.py live-introspection flags, end to end in a subprocess.
+
+The ISSUE 2 acceptance command: ``python train.py --workload mnist_lenet
+--steps 3 --status-port 0 --flight-recorder`` must run green on CPU with
+the server bound to an ephemeral port, a ``flight.jsonl`` in the logdir,
+and per-step memory fields in the metric stream; ``--profiler-port`` must
+bring up the jax.profiler server on the same run (the flag path can only
+be exercised out-of-process — the profiler server binds for the process
+lifetime).
+
+Process-spawning, so slow-laned wholesale via conftest's
+_PROCESS_TEST_FILES (the full suite runs it; the <5-min sanity lane
+skips it).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_train_with_status_port_flight_recorder_and_profiler(tmp_path):
+    from distributedtensorflow_tpu.testing import pick_unused_port
+
+    logdir = tmp_path / "logs"
+    profiler_port = pick_unused_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [
+            sys.executable, "train.py",
+            "--workload", "mnist_lenet", "--steps", "3", "--test-size",
+            "--log-every", "1", "--device", "cpu",
+            "--status-port", "0",
+            "--flight-recorder",
+            "--profiler-port", str(profiler_port),
+            "--logdir", str(logdir),
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stderr[-4000:]
+    log = res.stderr + res.stdout
+
+    # the introspection server resolved its ephemeral bind and said so
+    m = re.search(r"introspection server listening on port (\d+)", log)
+    assert m, log[-4000:]
+    assert int(m.group(1)) > 0
+
+    # the profiler-server flag path executed on CPU
+    assert f"profiler server listening on port {profiler_port}" in log
+
+    # flight.jsonl landed, parses, and covers the whole run
+    flight = [
+        json.loads(line)
+        for line in (logdir / "flight.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    kinds = [e["kind"] for e in flight]
+    assert kinds[0] == "fit_begin" and kinds[-1] == "fit_end"
+    assert kinds.count("step") == 3
+
+    # per-step memory fields ride the metric stream
+    rows = [
+        json.loads(line)
+        for line in (logdir / "metrics.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    assert len(rows) == 3
+    assert all("host_rss_gib" in r and "live_arrays_gib" in r for r in rows)
+
+    # both artifacts satisfy their documented schemas (the CI gate)
+    check = subprocess.run(
+        [
+            sys.executable, "tools/check_metrics_schema.py",
+            str(logdir / "metrics.jsonl"), str(logdir / "flight.jsonl"),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr

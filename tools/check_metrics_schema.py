@@ -1,12 +1,17 @@
 #!/usr/bin/env python
-"""Validate ``metrics.jsonl`` files against the documented row schema.
+"""Validate ``metrics.jsonl`` / ``flight.jsonl`` files against the
+documented row schemas.
 
 Usage::
 
     python tools/check_metrics_schema.py                # all ARTIFACTS runs
     python tools/check_metrics_schema.py path/a.jsonl [path/b.jsonl ...]
 
-The schema (docs/API.md "Telemetry"): every row of a *training-run*
+Files whose basename starts with ``flight`` are validated against the
+flight-recorder event schema; everything else against the metric-row
+schema.
+
+The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
 
 - ``step``: a non-negative integer (integral floats accepted — JSON has one
@@ -16,6 +21,12 @@ The schema (docs/API.md "Telemetry"): every row of a *training-run*
   keep lines strict JSON (reported as a warning, not an error — a NaN loss
   is exactly what the stream must be able to record), with a non-empty key
   free of control characters.
+
+The flight schema (docs/API.md "Live introspection"): every event of a
+``flight.jsonl`` dump is one JSON object with ``t`` (finite unix seconds),
+``kind`` (non-empty string), optional ``step`` (non-negative integer), and
+free-form event fields (JSON scalars; non-finite numbers use the same
+sentinel strings); event timestamps must be non-decreasing (ring order).
 
 Rows written by the async-PS role (keyed by ``time``/``global_version``
 instead of ``step``, nested ``staleness_hist``) are a different stream and
@@ -34,6 +45,9 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_GLOB = os.path.join(REPO, "ARTIFACTS", "convergence_*", "metrics.jsonl")
+DEFAULT_FLIGHT_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "convergence_*", "flight*.jsonl"
+)
 
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
@@ -69,9 +83,56 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
-def check_file(path: str) -> tuple[list[str], list[str]]:
+def check_flight_row(row, lineno: int,
+                     prev_t: float | None) -> tuple[list[str], list[str], float | None]:
+    """Returns (errors, warnings, timestamp) for one flight event."""
     errors: list[str] = []
     warnings: list[str] = []
+    if not isinstance(row, dict):
+        return ([f"line {lineno}: event is {type(row).__name__}, "
+                 "not an object"], [], prev_t)
+    t = row.get("t")
+    if not isinstance(t, (int, float)) or isinstance(t, bool) \
+            or not math.isfinite(t):
+        errors.append(f"line {lineno}: 't' {t!r} is not a finite number")
+        t = None
+    elif prev_t is not None and t < prev_t:
+        errors.append(
+            f"line {lineno}: 't' {t} decreases (ring order violated)"
+        )
+    kind = row.get("kind")
+    if not isinstance(kind, str) or not kind:
+        errors.append(f"line {lineno}: 'kind' {kind!r} is not a "
+                      "non-empty string")
+    step = row.get("step")
+    if step is not None and (
+        not isinstance(step, (int, float)) or isinstance(step, bool)
+        or float(step) != int(step) or step < 0
+    ):
+        errors.append(f"line {lineno}: 'step' {step!r} is not a "
+                      "non-negative integer")
+    for k, v in row.items():
+        if not isinstance(k, str) or not k or any(ord(c) < 32 for c in k):
+            errors.append(f"line {lineno}: bad field name {k!r}")
+            continue
+        if k in ("t", "kind", "step"):
+            continue
+        if isinstance(v, float) and not math.isfinite(v):
+            warnings.append(f"line {lineno}: field {k!r} is a bare "
+                            f"non-finite ({v}); writer emits sentinels")
+        elif not isinstance(v, (int, float, str, bool)) and v is not None:
+            errors.append(
+                f"line {lineno}: field {k!r} is {type(v).__name__}, "
+                "not a JSON scalar"
+            )
+    return errors, warnings, (t if t is not None else prev_t)
+
+
+def check_file(path: str) -> tuple[list[str], list[str]]:
+    flight = os.path.basename(path).startswith("flight")
+    errors: list[str] = []
+    warnings: list[str] = []
+    prev_t: float | None = None
     with open(path) as f:
         for i, line in enumerate(f, start=1):
             line = line.strip()
@@ -82,14 +143,19 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
             except json.JSONDecodeError as e:
                 errors.append(f"line {i}: invalid JSON ({e})")
                 continue
-            e, w = check_row(row, i)
+            if flight:
+                e, w, prev_t = check_flight_row(row, i, prev_t)
+            else:
+                e, w = check_row(row, i)
             errors.extend(e)
             warnings.extend(w)
     return errors, warnings
 
 
 def main(argv: list[str] | None = None) -> int:
-    paths = list(argv) if argv else sorted(glob.glob(DEFAULT_GLOB))
+    paths = list(argv) if argv else sorted(
+        glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
+    )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)
         return 1

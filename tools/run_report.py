@@ -5,8 +5,9 @@ Usage::
 
     python tools/run_report.py <logdir> [--json]
 
-Reads ``<logdir>/metrics.jsonl`` (required) and ``<logdir>/trace.jsonl``
-(optional) — the two streams the obs subsystem writes — and prints:
+Reads ``<logdir>/metrics.jsonl`` (required) plus ``<logdir>/trace.jsonl``
+and ``<logdir>/flight.jsonl`` (optional) — the streams the obs subsystem
+writes — and prints:
 
 - run summary (rows, step range, final/best metrics);
 - step-time percentiles (p50/p90/p99/max), from the per-record ``t_step``
@@ -18,7 +19,10 @@ Reads ``<logdir>/metrics.jsonl`` (required) and ``<logdir>/trace.jsonl``
   an offline re-scan of the metric rows (so pre-obs logs still get a
   verdict);
 - straggler summary when the run was multi-host (``*_host_min/median/max``
-  fields).
+  fields);
+- flight recorder: the last events before exit from ``flight.jsonl`` —
+  the first thing to read on a crashed or hung run (a last event that is
+  not ``fit_end`` means the process died mid-flight).
 
 ``--json`` emits the same content as one machine-readable JSON object.
 Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
@@ -148,6 +152,24 @@ def collect_anomalies(trace: list[dict], train: list[dict]) -> list[dict]:
     return recorded
 
 
+def flight_summary(flight: list[dict], last_n: int = 10) -> dict:
+    """Flight-recorder digest: event count by kind, the last ``last_n``
+    events (what the process was doing before exit), and whether the dump
+    ends in a clean ``fit_end`` or mid-flight (crash/hang signature)."""
+    if not flight:
+        return {}
+    kinds: dict[str, int] = {}
+    for e in flight:
+        k = e.get("kind", "?")
+        kinds[k] = kinds.get(k, 0) + 1
+    return {
+        "events": len(flight),
+        "kinds": dict(sorted(kinds.items(), key=lambda kv: -kv[1])),
+        "clean_exit": flight[-1].get("kind") == "fit_end",
+        "last": flight[-last_n:],
+    }
+
+
 def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     """Last-row host-spread fields, grouped by base key."""
     out: dict[str, dict[str, float]] = {}
@@ -168,6 +190,8 @@ def build_report(logdir: str) -> dict:
     rows = _load_jsonl(metrics_path)
     trace_path = os.path.join(logdir, "trace.jsonl")
     trace = _load_jsonl(trace_path) if os.path.exists(trace_path) else []
+    flight_path = os.path.join(logdir, "flight.jsonl")
+    flight = _load_jsonl(flight_path) if os.path.exists(flight_path) else []
     train, evals = split_rows(rows)
 
     times, source = step_times(train, trace)
@@ -194,6 +218,7 @@ def build_report(logdir: str) -> dict:
         ],
         "anomalies": collect_anomalies(trace, train),
         "stragglers": straggler_fields(train),
+        "flight": flight_summary(flight),
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -242,6 +267,28 @@ def render(report: dict) -> str:
                      f"{a.get('message', '')}{src}")
     if len(report["anomalies"]) > 20:
         lines.append(f"  ... {len(report['anomalies']) - 20} more")
+    fl = report.get("flight")
+    if fl:
+        exit_note = ("clean exit" if fl["clean_exit"]
+                     else "NOT a clean exit — died mid-flight")
+        lines += [
+            "",
+            f"flight recorder: {fl['events']} events ({exit_note})",
+        ]
+        t_last = None
+        for e in fl["last"]:
+            if isinstance(e.get("t"), (int, float)):
+                t_last = e["t"]
+        for e in fl["last"]:
+            t = e.get("t")
+            rel = (f"{t - t_last:+9.2f}s"
+                   if isinstance(t, (int, float)) and t_last is not None
+                   else " " * 10)
+            extra = " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("t", "kind", "stacks", "message")
+            )
+            lines.append(f"  {rel}  {e.get('kind', '?'):<18} {extra}".rstrip())
     if report["stragglers"]:
         lines += ["", "straggler summary (last record):"]
         for base, d in report["stragglers"].items():

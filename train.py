@@ -538,6 +538,23 @@ def main() -> None:
                    help="number of steps to trace")
     p.add_argument("--watchdog-timeout", type=float, default=0.0,
                    help="dump all stacks if no step completes for N seconds")
+    p.add_argument("--status-port", type=int, default=None, metavar="PORT",
+                   help="start the live introspection HTTP server on this "
+                        "port (0 = ephemeral): /healthz /statusz /varz "
+                        "/threadz /memz /flightz — curl a wedged run")
+    p.add_argument("--status-host", default="127.0.0.1", metavar="ADDR",
+                   help="bind address for --status-port; the loopback "
+                        "default keeps /threadz stacks private — set "
+                        "0.0.0.0 only on a trusted cluster network")
+    p.add_argument("--profiler-port", type=int, default=None, metavar="PORT",
+                   help="start the jax.profiler server for on-demand remote "
+                        "trace capture (TensorBoard 'capture profile' / "
+                        "jax.profiler.trace_remote against this port)")
+    p.add_argument("--flight-recorder", action="store_true",
+                   help="record a bounded ring of structured events (step/"
+                        "checkpoint/anomaly/preemption/compile markers), "
+                        "dumped to <logdir>/flight.jsonl on watchdog "
+                        "timeout, crash, anomaly, preemption, and exit")
     p.add_argument("--flops-per-step", type=float, default=0.0,
                    help="per-chip model FLOPs per optimizer step (analytic "
                         "6·N·D-style); enables the mfu fields in "
@@ -737,6 +754,12 @@ def main() -> None:
     from distributedtensorflow_tpu.workloads import get_workload
 
     cluster = parallel.initialize()
+    if args.profiler_port is not None:
+        from distributedtensorflow_tpu.utils import profiler
+
+        # Held for the process lifetime; a TensorBoard "capture profile"
+        # request (or jax.profiler.trace_remote) pulls traces on demand.
+        _profiler_server = profiler.start_server(args.profiler_port)  # noqa: F841
     wl = get_workload(
         args.workload, test_size=args.test_size,
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
@@ -895,6 +918,9 @@ def main() -> None:
             trace=not args.no_trace,
             flops_per_step=flops_per_step,
             anomaly_detection=not args.no_anomaly_detection,
+            status_port=args.status_port,
+            status_host=args.status_host,
+            flight_recorder=args.flight_recorder,
         ),
         eval_step=eval_step,
         checkpointer=checkpointer,
