@@ -532,11 +532,6 @@ def main() -> None:
     # clearly-labeled CPU fallback below.
     cached = None if records else _best_recent_persisted_tpu()
     if cached is not None:
-        print(
-            "bench: tunnel down; emitting persisted TPU result "
-            f"{cached['cached_from']}",
-            file=sys.stderr,
-        )
         # Machine-distinguishable staleness at top level (VERDICT r4 #6):
         # the driver gates on "fresh"/"age_s" without parsing the
         # tunnel_outage block or cached_from.
@@ -549,6 +544,21 @@ def main() -> None:
             cached["age_s"] = round(max(0.0, age))
         except (KeyError, ValueError, TypeError):
             cached["age_s"] = None
+        # Human-unmissable staleness: a cached number quietly re-emitted
+        # (BENCH_r05 shape) reads as fresh evidence unless it screams.
+        age_label = (
+            f"age {cached['age_s'] / 3600.0:.1f}h"
+            if isinstance(cached["age_s"], (int, float)) else "age unknown"
+        )
+        banner = f"bench: *** STALE ({age_label}) ***"
+        print(
+            "=" * 72 + "\n"
+            f"{banner}\n"
+            "bench: tunnel down; re-emitting persisted TPU result "
+            f"{cached['cached_from']} — NOT a fresh measurement\n"
+            + "=" * 72,
+            file=sys.stderr,
+        )
         cached["tunnel_outage"] = _tunnel_outage_evidence()
         print(json.dumps(cached))
         return

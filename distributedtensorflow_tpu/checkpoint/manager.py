@@ -115,6 +115,9 @@ class CheckpointManager:
         if saved:
             _M_SAVES.inc()
             _M_SAVE_S.set(sp.dur_s)
+            # Goodput lost-work anchor: a resume is measured against the
+            # newest save at or before its restored step.
+            obs.goodput.note_checkpoint(step)
             logger.info("checkpoint saved at step %d", step)
         return saved
 
@@ -138,6 +141,7 @@ class CheckpointManager:
                 args=ocp.args.StandardRestore(_as_tree(target)),
             )
         _M_RESTORES.inc()
+        obs.goodput.note_restore(step)
         logger.info("restored checkpoint step %d", step)
         return target.replace(
             step=restored["step"],
@@ -153,6 +157,7 @@ class CheckpointManager:
                 step, args=ocp.args.StandardRestore(_as_tree(target))
             )
         _M_RESTORES.inc()
+        obs.goodput.note_restore(step)
         logger.info("restored checkpoint step %d", step)
         return target.replace(
             step=restored["step"],

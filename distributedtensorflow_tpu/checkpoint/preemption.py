@@ -163,6 +163,11 @@ class PreemptionHandler:
         flight = obs.default_recorder()
         if flight is not None:  # the process is about to exit: persist now
             flight.dump(reason="preemption")
+        ledger = obs.goodput.default_ledger()
+        if ledger is not None:
+            # Close the goodput generation as preempted NOW (the launcher
+            # kills us next); a later clean close cannot overwrite this.
+            ledger.close(ended="preempted")
         if self._on_exit is not None:
             self._on_exit()
 

@@ -361,12 +361,17 @@ def skip_batches(it: Iterator[PyTree], n: int) -> Iterator[PyTree]:
     recordio sources re-read (the tf.data ``skip()`` cost) — callers with a
     step-keyed source can seek instead.
     """
-    for i in range(n):
-        try:
-            next(it)
-        except StopIteration:
-            logger.warning(
-                "input exhausted after skipping %d/%d batches on resume", i, n
-            )
-            break
+    # Spanned as part of restore cost: re-reading N batches is real resume
+    # wall time, and the goodput ledger books `input_fastforward` under its
+    # `checkpoint_restore` bucket.
+    with obs.span("input_fastforward"):
+        for i in range(n):
+            try:
+                next(it)
+            except StopIteration:
+                logger.warning(
+                    "input exhausted after skipping %d/%d batches on resume",
+                    i, n,
+                )
+                break
     return it

@@ -26,11 +26,15 @@ Surfaces:
   live half: point ``curl`` at a run while it is wedged;
 - ``memory`` — per-device HBM, host RSS, and ``jax.live_arrays()`` census
   feeding the registry, the per-step record, and ``/memz``;
+- ``GoodputLedger`` — end-to-end wall-time accounting into exclusive
+  buckets (init/compile/train/data/checkpoint/eval/lost-work/...),
+  persisted to ``goodput.json`` and merged across restarts — the
+  cost-of-training verdict (``goodput_fraction``, ``/goodputz``);
 - ``tools/run_report.py`` — renders a logdir's streams into one
   human-readable run report.
 """
 
-from . import flight_recorder, memory  # noqa: F401
+from . import flight_recorder, goodput, memory  # noqa: F401
 from .aggregate import host_aggregate, straggler_summary  # noqa: F401
 from .anomaly import Anomaly, AnomalyDetector  # noqa: F401
 from .flight_recorder import (  # noqa: F401
@@ -39,6 +43,7 @@ from .flight_recorder import (  # noqa: F401
     install_recorder,
     record_event,
 )
+from .goodput import GoodputLedger  # noqa: F401
 from .mfu import mfu_record_fields, peak_flops  # noqa: F401
 from .registry import (  # noqa: F401
     Counter,

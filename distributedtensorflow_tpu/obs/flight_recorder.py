@@ -54,6 +54,8 @@ import threading
 import time
 from typing import Any
 
+from . import goodput
+
 logger = logging.getLogger("distributedtensorflow_tpu")
 
 __all__ = [
@@ -103,6 +105,10 @@ class FlightRecorder:
             # schema gate treats a decreasing ``t`` as corruption.
             event["t"] = time.time()
             self._events.append(event)
+        # Goodput tap (outside the ring lock — the ledger has its own):
+        # event kinds drive the ledger's preemption-drain stamp and its
+        # per-generation event counts.  `goodput` events originate there.
+        goodput.note_event(event["kind"])
         return event
 
     def record_anomaly(self, anomaly) -> None:

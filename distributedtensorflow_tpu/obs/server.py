@@ -15,7 +15,9 @@ deps, safe to leave on for a whole training job:
 - ``/threadz`` — all-thread stack dump (the watchdog's post-mortem, on
   demand while the process is still alive — THE mid-hang artifact);
 - ``/memz``   — per-device HBM, host RSS, live-array census JSON;
-- ``/flightz`` — the flight recorder's current ring as a JSON array.
+- ``/flightz`` — the flight recorder's current ring as a JSON array;
+- ``/goodputz`` — the goodput ledger (wall-time buckets, merged across
+  restarts) when one is installed (``--goodput``).
 
 Every handler is read-only and must not touch the device (no collectives,
 no blocking fetches) — it has to answer precisely when the main thread is
@@ -50,6 +52,7 @@ _ENDPOINTS = {
     "/threadz": "stack dump of every thread",
     "/memz": "device HBM + host RSS + live-array census",
     "/flightz": "flight-recorder ring (JSON array)",
+    "/goodputz": "goodput ledger: wall-time buckets across restarts",
 }
 
 
@@ -133,6 +136,11 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/flightz":
                 flight = srv.flight
                 self._reply_json(flight.events() if flight is not None else [])
+            elif path == "/goodputz":
+                ledger = srv.goodput
+                self._reply_json(
+                    ledger.report() if ledger is not None else {}
+                )
             else:
                 self._reply(f"unknown endpoint {path}\n", status=404)
         except Exception as e:  # a handler bug must not kill the server
@@ -192,6 +200,12 @@ class StatusServer:
         from . import flight_recorder  # noqa: PLC0415
 
         return flight_recorder.default_recorder()
+
+    @property
+    def goodput(self):
+        from . import goodput as goodput_mod  # noqa: PLC0415
+
+        return goodput_mod.default_ledger()
 
     def status(self) -> dict:
         base = {"uptime_s": round(time.time() - self._t0, 1)}

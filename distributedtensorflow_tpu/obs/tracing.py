@@ -34,7 +34,14 @@ import threading
 import time
 from typing import Any
 
-__all__ = ["Span", "span", "TraceRecorder", "active_recorder"]
+__all__ = [
+    "Span",
+    "span",
+    "TraceRecorder",
+    "active_recorder",
+    "add_root_sink",
+    "remove_root_sink",
+]
 
 _tls = threading.local()
 
@@ -82,11 +89,39 @@ class span:
             rec = _recorder
             if rec is not None:
                 rec._add_root(s)
+            for sink in _root_sinks:
+                # A sink raising inside __exit__ would REPLACE the body's
+                # in-flight exception (StopIteration ends the fit loop) —
+                # swallow unconditionally; sinks are telemetry, not logic.
+                try:
+                    sink(s)
+                except Exception:
+                    pass
         return False
 
 
 _recorder: "TraceRecorder | None" = None
 _recorder_lock = threading.Lock()
+
+#: Extra consumers of completed ROOT spans (the goodput ledger) — fed even
+#: when no TraceRecorder is installed, so pre-fit spans (checkpoint
+#: restore, AOT cost-estimate compile) are observable.  A tuple: reads on
+#: the span hot path are lock-free snapshots.
+_root_sinks: tuple = ()
+
+
+def add_root_sink(fn) -> None:
+    """Register ``fn(span)`` to receive every completed root span."""
+    global _root_sinks
+    with _recorder_lock:
+        if fn not in _root_sinks:
+            _root_sinks = _root_sinks + (fn,)
+
+
+def remove_root_sink(fn) -> None:
+    global _root_sinks
+    with _recorder_lock:
+        _root_sinks = tuple(f for f in _root_sinks if f is not fn)
 
 
 def active_recorder() -> "TraceRecorder | None":

@@ -18,15 +18,50 @@ from __future__ import annotations
 import dataclasses
 import enum
 import functools
+import time
 from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs
+
 PyTree = Any
 
 AxisSpec = str | tuple[str, ...]
+
+# Host-side dispatch timing (obs/): inside jit this measures trace/staging
+# cost, called eagerly it measures the dispatch itself — either way it is
+# the HOST's share of a collective, which is what lets a cross-host
+# straggler row distinguish comms bookkeeping from compute.  Sub-ms
+# buckets: dispatches are far below the step-time-oriented defaults.
+_DISPATCH_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+_M_DISPATCH_S = obs.histogram(
+    "collective_dispatch_seconds",
+    "host-side dispatch/trace seconds of collective wrappers by op",
+    buckets=_DISPATCH_BUCKETS,
+)
+
+
+def _timed_dispatch(fn):
+    """Route a collective wrapper's host-side time through the span tracer
+    (``collective_<op>`` spans — children of the enclosing compile/step
+    span when traced under jit) and the dispatch histogram."""
+    op = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        t0 = time.perf_counter()
+        with obs.span(f"collective_{op}"):
+            out = fn(*args, **kwargs)
+        _M_DISPATCH_S.observe(time.perf_counter() - t0, op=op)
+        return out
+
+    return wrapper
 
 
 class ReduceOp(enum.Enum):
@@ -70,6 +105,7 @@ def _as_tuple(axis: AxisSpec) -> tuple[str, ...]:
     return (axis,) if isinstance(axis, str) else tuple(axis)
 
 
+@_timed_dispatch
 def all_reduce(x: jax.Array, axis: AxisSpec, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
     """All-reduce ``x`` over mesh axis/axes (inside shard_map/jit)."""
     axis = _as_tuple(axis)
@@ -95,6 +131,7 @@ def tree_all_reduce(
     return jax.tree.map(functools.partial(all_reduce, axis=axis, op=op), tree)
 
 
+@_timed_dispatch
 def all_gather(
     x: jax.Array, axis: AxisSpec, *, gather_axis: int = 0, tiled: bool = True
 ) -> jax.Array:
@@ -106,6 +143,7 @@ def all_gather(
     return lax.all_gather(x, _as_tuple(axis), axis=gather_axis, tiled=tiled)
 
 
+@_timed_dispatch
 def reduce_scatter(
     x: jax.Array, axis: AxisSpec, *, scatter_axis: int = 0
 ) -> jax.Array:
@@ -117,6 +155,7 @@ def reduce_scatter(
     return lax.psum_scatter(x, _as_tuple(axis), scatter_dimension=scatter_axis, tiled=True)
 
 
+@_timed_dispatch
 def broadcast(x: jax.Array, axis: AxisSpec, *, src: int = 0) -> jax.Array:
     """Broadcast the value from mesh-position ``src`` on ``axis`` to all.
 
@@ -138,6 +177,7 @@ def _linear_index(axes: tuple[str, ...]) -> jax.Array:
     return idx
 
 
+@_timed_dispatch
 def permute(
     x: jax.Array, axis: str, perm: Sequence[tuple[int, int]]
 ) -> jax.Array:
@@ -145,6 +185,7 @@ def permute(
     return lax.ppermute(x, axis, perm=list(perm))
 
 
+@_timed_dispatch
 def shift(x: jax.Array, axis: str, *, offset: int = 1) -> jax.Array:
     """Rotate shards around mesh ``axis`` — the ring-attention step primitive."""
     n = lax.axis_size(axis)
@@ -152,6 +193,7 @@ def shift(x: jax.Array, axis: str, *, offset: int = 1) -> jax.Array:
     return lax.ppermute(x, axis, perm=perm)
 
 
+@_timed_dispatch
 def all_to_all(
     x: jax.Array, axis: str, *, split_axis: int, concat_axis: int, tiled: bool = True
 ) -> jax.Array:

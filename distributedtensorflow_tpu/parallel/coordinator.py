@@ -59,6 +59,12 @@ _M_FAILED = obs.counter(
 _M_QUEUE_DEPTH = obs.gauge(
     "coordinator_queue_depth", "closures waiting for a worker"
 )
+_M_WASTED_S = obs.histogram(
+    "coordinator_wasted_seconds",
+    "seconds a closure attempt ran before being discarded by a retry or "
+    "failure (host-side badput; the goodput report counts the matching "
+    "coordinator_retry/failure flight events per generation)",
+)
 
 T = TypeVar("T")
 
@@ -385,6 +391,7 @@ class _Worker(threading.Thread):
                     return v._resolve(self.worker_id)
                 return v
             executor = self._coord._executor_for(self.worker_id)
+            attempt_t0 = time.perf_counter()
             try:
                 if executor is not None:
                     result = executor.execute(
@@ -398,6 +405,9 @@ class _Worker(threading.Thread):
                 self.failures += 1
                 closure.attempts += 1
                 _M_RETRIED.inc()
+                _M_WASTED_S.observe(
+                    time.perf_counter() - attempt_t0, outcome="retry"
+                )
                 if closure.attempts >= self._coord._max_retries:
                     err = RuntimeError(
                         f"closure failed {closure.attempts} retryable attempts"
@@ -427,6 +437,9 @@ class _Worker(threading.Thread):
                 closure.output._set_error(e)
                 queue.mark_failed(e)
                 _M_FAILED.inc()
+                _M_WASTED_S.observe(
+                    time.perf_counter() - attempt_t0, outcome="failure"
+                )
                 obs.record_event(
                     "coordinator_failure", worker=self.worker_id,
                     error=repr(e)[:200],

@@ -185,7 +185,10 @@ def estimate_step_flops(step, state, batch_abstract, rng) -> float | None:
     persistent compilation cache absorbs it on reruns.
     """
     try:
-        compiled = step.lower(state, batch_abstract, rng).compile()
+        # Span name keeps this AOT compile in the goodput `compile` bucket
+        # (it runs pre-fit, where unattributed time would read as `init`).
+        with obs.span("compile_cost_estimate"):
+            compiled = step.lower(state, batch_abstract, rng).compile()
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax: one dict per device
             cost = cost[0] if cost else {}

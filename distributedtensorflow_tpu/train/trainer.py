@@ -263,6 +263,9 @@ class Trainer:
                 "fit_begin", step=int(state.step),
                 total_steps=cfg.total_steps,
             )
+        ledger = obs.goodput.default_ledger()
+        if ledger is not None:  # close the goodput `init` window
+            ledger.mark_fit_begin(int(state.step))
         watchdog = None
         if cfg.watchdog_timeout > 0:
             from ..utils.watchdog import Watchdog
@@ -340,6 +343,11 @@ class Trainer:
                         preempted=self._preempted,
                     )
                     self.flight.dump()
+            ledger = obs.goodput.default_ledger()
+            if ledger is not None:
+                # Final-boundary flush (last heartbeat = this generation's
+                # measured end); the entrypoint owns close(ended=...).
+                ledger.heartbeat(step=self._last_step)
 
     def close(self) -> None:
         """Release owned resources — the metric writer, the introspection
@@ -557,6 +565,13 @@ class Trainer:
                         )
                     self.writer.write(step_i + 1, last_metrics)
                     self._export_prometheus()
+                    ledger = obs.goodput.default_ledger()
+                    if ledger is not None:
+                        # Advances the restart-detection heartbeat, updates
+                        # the goodput_* registry metrics, persists
+                        # goodput.json, and emits the periodic `goodput`
+                        # flight event.
+                        ledger.heartbeat(step=step_i + 1)
                     logger.info("step %d: %s", step_i + 1, _fmt(last_metrics))
                     self._last_record = last_metrics  # /statusz snapshot
                     if self.flight is not None:

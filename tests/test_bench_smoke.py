@@ -62,6 +62,41 @@ def test_tunnel_outage_evidence_parses_watcher_log(tmp_path):
     assert bench._tunnel_outage_evidence(str(tmp_path / "missing.log")) is None
 
 
+def test_bench_table_annotates_stale_rows(tmp_path, capsys):
+    """A cached re-emission (fresh: false, as in BENCH_r05) must render
+    as STALE in the evidence table, never as a fresh measurement."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_table
+    finally:
+        sys.path.pop(0)
+
+    assert bench_table.stale_marker({"fresh": True}) == ""
+    assert bench_table.stale_marker({}) == ""
+    assert bench_table.stale_marker(
+        {"fresh": False, "age_s": 7200}
+    ).startswith("**STALE** (2.0h old)")
+    assert "STALE" in bench_table.stale_marker({"cached_from": "r.json"})
+
+    rows = [
+        {"metric": "m", "value": 100.0, "timestamp": "2026-08-01T00:00:00",
+         "fresh": False, "age_s": 3600 * 5, "cached_from": "old.json"},
+        {"metric": "m", "value": 90.0, "timestamp": "2026-08-02T00:00:00"},
+    ]
+    for i, r in enumerate(rows):
+        (tmp_path / f"r{i}.json").write_text(json.dumps(r))
+    argv = sys.argv
+    sys.argv = ["bench_table.py", str(tmp_path)]
+    try:
+        bench_table.main()
+    finally:
+        sys.argv = argv
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("| 2026")]
+    assert "**STALE** (5.0h old) 100.0" in lines[0]
+    assert "STALE" not in lines[1]
+
+
 MATRIX = [
     ("bench_lm.py", {"BENCH_LM_TEST": "1"}),
     ("bench_lm.py", {"BENCH_LM_TEST": "1", "BENCH_LM_INNER": "4"}),

@@ -93,6 +93,78 @@ def test_report_missing_logdir():
         run_report.build_report("/nonexistent/logdir")
 
 
+def test_report_missing_metrics_exits_nonzero(tmp_path):
+    """CI gate: main() must not exit 0 when metrics.jsonl is absent."""
+    with pytest.raises(SystemExit) as exc:
+        run_report.main([str(tmp_path)])
+    assert exc.value.code not in (0, None)
+
+
+def test_report_unparseable_rows_exit_nonzero(tmp_path, capsys):
+    """A metric stream with broken lines still renders from the good rows
+    but exits 1 so CI can gate on it."""
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 1, "loss": 1.0}) + "\n" + "{broken json\n"
+    )
+    assert run_report.main([str(tmp_path)]) == 1
+    assert "RUN REPORT" in capsys.readouterr().out
+
+
+def test_report_empty_metrics_exit_nonzero(tmp_path):
+    (tmp_path / "metrics.jsonl").write_text("not json at all\n")
+    assert run_report.main([str(tmp_path)]) == 1
+
+
+# --- goodput section ---------------------------------------------------------
+
+
+_GOODPUT = {
+    "version": 1,
+    "generations": [
+        {"gen": 0, "start_t": 0.0, "last_t": 100.0, "ended": "preempted",
+         "resumed_step": None, "ckpts": [[4, 60.0]],
+         "buckets": {"init": 10.0, "train_step": 80.0, "other": 10.0}},
+        {"gen": 1, "start_t": 110.0, "last_t": 160.0, "ended": "clean",
+         "resumed_step": 4, "ckpts": [],
+         "buckets": {"init": 5.0, "train_step": 45.0}},
+    ],
+    "merged": {
+        "wall_s": 160.0,
+        "buckets": {"init": 9.0, "train_step": 93.0, "other": 6.0,
+                    "lost_work": 40.0, "badput_restart": 10.0,
+                    "checkpoint_save": 2.0},
+        "goodput_fraction": 0.5813,
+        "generations": 2, "restarts": 1,
+    },
+}
+
+
+def test_report_goodput_section(logdir, capsys):
+    (logdir / "goodput.json").write_text(json.dumps(_GOODPUT))
+    report = run_report.build_report(str(logdir))
+    gp = report["goodput"]
+    assert gp["goodput_fraction"] == 0.5813
+    assert gp["buckets"]["lost_work"] == 40.0
+    assert gp["ended"] == ["preempted", "clean"]
+    assert run_report.main([str(logdir)]) == 0
+    out = capsys.readouterr().out
+    assert "goodput: 58.1% productive" in out
+    assert "lost_work" in out and "badput_restart" in out
+    # --json mode carries the same merged ledger
+    assert run_report.main([str(logdir), "--json"]) == 0
+    as_json = json.loads(capsys.readouterr().out)
+    assert as_json["goodput"]["buckets"] == gp["buckets"]
+
+
+def test_report_unreadable_goodput_exits_nonzero(logdir):
+    (logdir / "goodput.json").write_text("{broken")
+    assert run_report.main([str(logdir)]) == 1
+
+
+def test_report_without_goodput_has_empty_section(logdir):
+    assert run_report.build_report(str(logdir))["goodput"] == {}
+
+
 # --- flight recorder section -------------------------------------------------
 
 

@@ -6,7 +6,9 @@ the server bound to an ephemeral port, a ``flight.jsonl`` in the logdir,
 and per-step memory fields in the metric stream; ``--profiler-port`` must
 bring up the jax.profiler server on the same run (the flag path can only
 be exercised out-of-process — the profiler server binds for the process
-lifetime).
+lifetime).  ISSUE 3 rides the same run: ``--goodput`` must leave a
+``goodput.json`` whose exclusive buckets sum to measured wall time within
+1%, validated by the schema gate and rendered by run_report.
 
 Process-spawning, so slow-laned wholesale via conftest's
 _PROCESS_TEST_FILES (the full suite runs it; the <5-min sanity lane
@@ -35,6 +37,7 @@ def test_train_with_status_port_flight_recorder_and_profiler(tmp_path):
             "--log-every", "1", "--device", "cpu",
             "--status-port", "0",
             "--flight-recorder",
+            "--goodput",
             "--profiler-port", str(profiler_port),
             "--logdir", str(logdir),
         ],
@@ -70,12 +73,34 @@ def test_train_with_status_port_flight_recorder_and_profiler(tmp_path):
     assert len(rows) == 3
     assert all("host_rss_gib" in r and "live_arrays_gib" in r for r in rows)
 
-    # both artifacts satisfy their documented schemas (the CI gate)
+    # --goodput wrote a ledger whose exclusive buckets sum to measured
+    # wall time (the ISSUE 3 acceptance criterion) and that ended clean
+    doc = json.loads((logdir / "goodput.json").read_text())
+    merged = doc["merged"]
+    assert doc["generations"][-1]["ended"] == "clean"
+    assert merged["buckets"].get("train_step", 0) > 0
+    total = sum(merged["buckets"].values())
+    assert abs(total - merged["wall_s"]) <= max(
+        0.01 * merged["wall_s"], 0.05
+    )
+    # the periodic goodput flight events rode the ring
+    assert any(e["kind"] == "goodput" for e in flight)
+
+    # all three artifacts satisfy their documented schemas (the CI gate)
     check = subprocess.run(
         [
             sys.executable, "tools/check_metrics_schema.py",
             str(logdir / "metrics.jsonl"), str(logdir / "flight.jsonl"),
+            str(logdir / "goodput.json"),
         ],
         cwd=REPO, capture_output=True, text=True, timeout=120,
     )
     assert check.returncode == 0, check.stdout + check.stderr
+
+    # run_report renders a Goodput section and exits 0 on the healthy run
+    rep = subprocess.run(
+        [sys.executable, "tools/run_report.py", str(logdir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    assert "goodput:" in rep.stdout

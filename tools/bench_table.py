@@ -53,6 +53,18 @@ def fmt(v) -> str:
     return str(v)
 
 
+def stale_marker(row: dict) -> str:
+    """Annotation for rows that are cached re-emissions (``fresh: false``
+    / ``cached_from`` set) rather than fresh measurements — a cached value
+    must never be presented as fresh evidence in the table."""
+    if row.get("fresh") is False or row.get("cached_from"):
+        age = row.get("age_s")
+        if isinstance(age, (int, float)):
+            return f"**STALE** ({age / 3600.0:.1f}h old) "
+        return "**STALE** "
+    return ""
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("directory", nargs="?", default="BENCH_RESULTS")
@@ -83,7 +95,8 @@ def main() -> None:
                 if r.get(k) not in (None, "")
             )
             err = r.get("error")
-            val = f"ERR:{err}" if err else fmt(r.get("value"))
+            val = (f"ERR:{err}" if err
+                   else stale_marker(r) + fmt(r.get("value")))
             print(
                 f"| {r.get('timestamp', '?')} | {val} "
                 f"| {fmt(r.get('vs_baseline'))} "

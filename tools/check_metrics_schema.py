@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""Validate ``metrics.jsonl`` / ``flight.jsonl`` files against the
-documented row schemas.
+"""Validate ``metrics.jsonl`` / ``flight.jsonl`` / ``goodput.json`` files
+against the documented schemas.
 
 Usage::
 
@@ -8,8 +8,9 @@ Usage::
     python tools/check_metrics_schema.py path/a.jsonl [path/b.jsonl ...]
 
 Files whose basename starts with ``flight`` are validated against the
-flight-recorder event schema; everything else against the metric-row
-schema.
+flight-recorder event schema; basenames starting with ``goodput`` against
+the goodput-ledger document schema; everything else against the
+metric-row schema.
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
@@ -27,6 +28,14 @@ The flight schema (docs/API.md "Live introspection"): every event of a
 ``kind`` (non-empty string), optional ``step`` (non-negative integer), and
 free-form event fields (JSON scalars; non-finite numbers use the same
 sentinel strings); event timestamps must be non-decreasing (ring order).
+
+The goodput schema (docs/API.md "Goodput"): ``goodput.json`` is ONE JSON
+object with a ``generations`` list (each: finite ``start_t <= last_t``,
+``buckets`` mapping bucket name → non-negative finite seconds) and a
+``merged`` object whose exclusive buckets are non-negative, drawn from the
+documented bucket set (unknown names warn), and sum to ``wall_s`` within
+1% (+ a small absolute epsilon for sub-second runs); ``goodput_fraction``
+must lie in [0, 1].
 
 Rows written by the async-PS role (keyed by ``time``/``global_version``
 instead of ``step``, nested ``staleness_hist``) are a different stream and
@@ -47,6 +56,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_GLOB = os.path.join(REPO, "ARTIFACTS", "convergence_*", "metrics.jsonl")
 DEFAULT_FLIGHT_GLOB = os.path.join(
     REPO, "ARTIFACTS", "convergence_*", "flight*.jsonl"
+)
+DEFAULT_GOODPUT_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "convergence_*", "goodput*.json"
+)
+
+#: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
+#: duplicated: this tool is stdlib-only and must run anywhere logs land).
+GOODPUT_BUCKETS = (
+    "init", "compile", "train_step", "data_wait", "checkpoint_save",
+    "checkpoint_restore", "eval", "preemption_drain", "lost_work",
+    "badput_restart", "other",
 )
 
 
@@ -128,7 +148,99 @@ def check_flight_row(row, lineno: int,
     return errors, warnings, (t if t is not None else prev_t)
 
 
+def _check_bucket_map(buckets, where: str) -> tuple[list[str], list[str]]:
+    errors: list[str] = []
+    warnings: list[str] = []
+    if not isinstance(buckets, dict):
+        return [f"{where}: 'buckets' is "
+                f"{type(buckets).__name__}, not an object"], []
+    for k, v in buckets.items():
+        if not isinstance(k, str) or not k:
+            errors.append(f"{where}: bad bucket name {k!r}")
+            continue
+        if k not in GOODPUT_BUCKETS:
+            warnings.append(f"{where}: unknown bucket {k!r}")
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or not math.isfinite(v):
+            errors.append(f"{where}: bucket {k!r} value {v!r} is not a "
+                          "finite number")
+        elif v < 0:
+            errors.append(f"{where}: bucket {k!r} is negative ({v})")
+    return errors, warnings
+
+
+def check_goodput_doc(doc) -> tuple[list[str], list[str]]:
+    """Validate one parsed ``goodput.json`` document (buckets exclusive by
+    construction of a JSON object; non-negative; sum ≈ wall time)."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, not an object"], []
+    gens = doc.get("generations")
+    if not isinstance(gens, list) or not gens:
+        errors.append("'generations' is missing or not a non-empty list")
+        gens = []
+    for i, g in enumerate(gens):
+        where = f"generations[{i}]"
+        if not isinstance(g, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        start = g.get("start_t")
+        last = g.get("last_t")
+        for name, v in (("start_t", start), ("last_t", last)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(v):
+                errors.append(f"{where}: {name!r} {v!r} is not a "
+                              "finite number")
+        if isinstance(start, (int, float)) and isinstance(last, (int, float)) \
+                and math.isfinite(start) and math.isfinite(last) \
+                and last < start:
+            errors.append(f"{where}: last_t {last} precedes start_t {start}")
+        e, w = _check_bucket_map(g.get("buckets"), where)
+        errors.extend(e)
+        warnings.extend(w)
+    merged = doc.get("merged")
+    if not isinstance(merged, dict):
+        errors.append("'merged' is missing or not an object")
+        return errors, warnings
+    e, w = _check_bucket_map(merged.get("buckets"), "merged")
+    errors.extend(e)
+    warnings.extend(w)
+    wall = merged.get("wall_s")
+    if isinstance(wall, bool) or not isinstance(wall, (int, float)) \
+            or not math.isfinite(wall) or wall < 0:
+        errors.append(f"merged: 'wall_s' {wall!r} is not a non-negative "
+                      "finite number")
+    elif not e and isinstance(merged.get("buckets"), dict):
+        total = sum(
+            v for v in merged["buckets"].values()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        )
+        # 1% relative + a small absolute epsilon: per-bucket rounding to
+        # 1 ms dominates on sub-second runs.
+        tol = max(0.01 * wall, 0.05)
+        if abs(total - wall) > tol:
+            errors.append(
+                f"merged: buckets sum to {total:.3f}s but wall_s is "
+                f"{wall:.3f}s (tolerance {tol:.3f}s)"
+            )
+    frac = merged.get("goodput_fraction")
+    if frac is not None and (
+        isinstance(frac, bool) or not isinstance(frac, (int, float))
+        or not math.isfinite(frac) or not 0.0 <= frac <= 1.0
+    ):
+        errors.append(f"merged: 'goodput_fraction' {frac!r} outside [0, 1]")
+    return errors, warnings
+
+
 def check_file(path: str) -> tuple[list[str], list[str]]:
+    if os.path.basename(path).startswith("goodput"):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"invalid JSON ({e})"], []
+        return check_goodput_doc(doc)
     flight = os.path.basename(path).startswith("flight")
     errors: list[str] = []
     warnings: list[str] = []
@@ -155,6 +267,7 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
 def main(argv: list[str] | None = None) -> int:
     paths = list(argv) if argv else sorted(
         glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
+        + glob.glob(DEFAULT_GOODPUT_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

@@ -22,10 +22,17 @@ writes — and prints:
   fields);
 - flight recorder: the last events before exit from ``flight.jsonl`` —
   the first thing to read on a crashed or hung run (a last event that is
-  not ``fit_end`` means the process died mid-flight).
+  not ``fit_end`` means the process died mid-flight);
+- goodput: the merged cross-restart wall-time ledger from ``goodput.json``
+  (``--goodput`` runs) — productive fraction, per-bucket seconds,
+  generation/restart counts.
 
 ``--json`` emits the same content as one machine-readable JSON object.
 Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
+
+Exit status: 0 = report rendered from a healthy stream; 1 = the metric
+stream had unparseable lines or no valid rows (CI gates on this); missing
+``metrics.jsonl`` is a hard SystemExit.
 """
 
 from __future__ import annotations
@@ -42,8 +49,11 @@ _NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
               "-Infinity": float("-inf")}
 
 
-def _load_jsonl(path: str) -> list[dict]:
+def _load_jsonl(path: str) -> tuple[list[dict], int]:
+    """Parsed rows plus the count of unparseable lines (the CI gate:
+    ``main`` exits non-zero when the metric stream had any)."""
     rows = []
+    bad = 0
     with open(path) as f:
         for i, line in enumerate(f):
             line = line.strip()
@@ -54,6 +64,7 @@ def _load_jsonl(path: str) -> list[dict]:
             except json.JSONDecodeError as e:
                 print(f"{path}:{i + 1}: skipping bad row ({e})",
                       file=sys.stderr)
+                bad += 1
                 continue
             if isinstance(row, dict):
                 # decode the writer's strict-JSON non-finite sentinels
@@ -61,7 +72,11 @@ def _load_jsonl(path: str) -> list[dict]:
                     k: _NONFINITE.get(v, v) if isinstance(v, str) else v
                     for k, v in row.items()
                 })
-    return rows
+            else:
+                print(f"{path}:{i + 1}: skipping non-object row",
+                      file=sys.stderr)
+                bad += 1
+    return rows, bad
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -183,15 +198,41 @@ def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     return out
 
 
+def load_goodput(logdir: str) -> tuple[dict, int]:
+    """``(goodput summary, parse errors)`` from ``<logdir>/goodput.json``
+    (the GoodputLedger document; empty summary when absent)."""
+    path = os.path.join(logdir, "goodput.json")
+    if not os.path.exists(path):
+        return {}, 0
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"{path}: unreadable ({e})", file=sys.stderr)
+        return {}, 1
+    merged = doc.get("merged") if isinstance(doc, dict) else None
+    if not isinstance(merged, dict):
+        print(f"{path}: no 'merged' section", file=sys.stderr)
+        return {}, 1
+    gens = doc.get("generations") or []
+    out = dict(merged)
+    out.setdefault("generations", len(gens))
+    out["ended"] = [g.get("ended") for g in gens if isinstance(g, dict)]
+    return out, 0
+
+
 def build_report(logdir: str) -> dict:
     metrics_path = os.path.join(logdir, "metrics.jsonl")
     if not os.path.exists(metrics_path):
         raise SystemExit(f"{metrics_path}: not found (is this a logdir?)")
-    rows = _load_jsonl(metrics_path)
+    rows, bad_metrics = _load_jsonl(metrics_path)
     trace_path = os.path.join(logdir, "trace.jsonl")
-    trace = _load_jsonl(trace_path) if os.path.exists(trace_path) else []
+    trace, _ = (_load_jsonl(trace_path) if os.path.exists(trace_path)
+                else ([], 0))
     flight_path = os.path.join(logdir, "flight.jsonl")
-    flight = _load_jsonl(flight_path) if os.path.exists(flight_path) else []
+    flight, _ = (_load_jsonl(flight_path) if os.path.exists(flight_path)
+                 else ([], 0))
+    goodput, bad_goodput = load_goodput(logdir)
     train, evals = split_rows(rows)
 
     times, source = step_times(train, trace)
@@ -219,6 +260,10 @@ def build_report(logdir: str) -> dict:
         "anomalies": collect_anomalies(trace, train),
         "stragglers": straggler_fields(train),
         "flight": flight_summary(flight),
+        "goodput": goodput,
+        # metric-stream health: any unparseable metrics.jsonl line (or an
+        # unreadable goodput.json) makes main() exit non-zero (CI gate)
+        "parse_errors": bad_metrics + bad_goodput,
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -289,6 +334,24 @@ def render(report: dict) -> str:
                 if k not in ("t", "kind", "stacks", "message")
             )
             lines.append(f"  {rel}  {e.get('kind', '?'):<18} {extra}".rstrip())
+    gp = report.get("goodput")
+    if gp:
+        wall = gp.get("wall_s", 0.0) or 0.0
+        frac = gp.get("goodput_fraction", 0.0) or 0.0
+        gens = gp.get("generations", 1)
+        restarts = gp.get("restarts", max(gens - 1, 0))
+        lines += [
+            "",
+            (
+                f"goodput: {frac * 100:.1f}% productive (train_step) of "
+                f"{wall:.1f}s wall — {gens} generation(s), "
+                f"{restarts} restart(s)"
+            ),
+        ]
+        buckets = gp.get("buckets") or {}
+        for name, secs in sorted(buckets.items(), key=lambda kv: -kv[1]):
+            pct = 100.0 * secs / wall if wall else 0.0
+            lines.append(f"  {name:<18} {secs:10.2f} s  {pct:6.2f}%")
     if report["stragglers"]:
         lines += ["", "straggler summary (last record):"]
         for base, d in report["stragglers"].items():
@@ -324,6 +387,18 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(report, indent=2, default=str))
     else:
         print(render(report), end="")
+    # CI gate: a metric stream that is missing rows or had unparseable
+    # lines must fail the report, not silently render a partial one.
+    if report.get("parse_errors"):
+        print(
+            f"run_report: {report['parse_errors']} unparseable "
+            "metrics/goodput entries", file=sys.stderr,
+        )
+        return 1
+    if not (report["rows"]["train"] or report["rows"]["eval"]):
+        print("run_report: metrics.jsonl contains no valid rows",
+              file=sys.stderr)
+        return 1
     return 0
 
 

@@ -555,6 +555,13 @@ def main() -> None:
                         "checkpoint/anomaly/preemption/compile markers), "
                         "dumped to <logdir>/flight.jsonl on watchdog "
                         "timeout, crash, anomaly, preemption, and exit")
+    p.add_argument("--goodput", action="store_true",
+                   help="account every wall-second of the run into exclusive"
+                        " goodput buckets (init/compile/train_step/data_wait/"
+                        "checkpoint/eval/lost_work/...), persisted to "
+                        "<logdir>/goodput.json and MERGED across restarts; "
+                        "surfaces goodput_fraction in the registry and "
+                        "/goodputz on --status-port")
     p.add_argument("--flops-per-step", type=float, default=0.0,
                    help="per-chip model FLOPs per optimizer step (analytic "
                         "6·N·D-style); enables the mfu fields in "
@@ -752,6 +759,18 @@ def main() -> None:
     )
     from distributedtensorflow_tpu.train.trainer import Trainer, TrainerConfig
     from distributedtensorflow_tpu.workloads import get_workload
+
+    # Goodput ledger FIRST (before mesh/state/restore) so setup time is
+    # honestly booked as `init` — the generation starts here.  Re-loads a
+    # prior <logdir>/goodput.json so a restarted run keeps one ledger.
+    goodput_ledger = None
+    if args.goodput:
+        from distributedtensorflow_tpu.obs import goodput as goodput_lib
+
+        goodput_ledger = goodput_lib.GoodputLedger(
+            os.path.join(args.logdir, "goodput.json")
+            if args.logdir else None
+        ).install()
 
     cluster = parallel.initialize()
     if args.profiler_port is not None:
@@ -952,8 +971,21 @@ def main() -> None:
             eval_iter_fn = lambda: Prefetcher(
                 wl.input_fn(ctx, args.seed + 999), mesh
             )
-    with trainer:  # closes the metric writer on every exit path
-        state = trainer.fit(state, train_iter, rng, eval_iter_fn=eval_iter_fn)
+    try:
+        with trainer:  # closes the metric writer on every exit path
+            state = trainer.fit(
+                state, train_iter, rng, eval_iter_fn=eval_iter_fn
+            )
+    except BaseException:
+        if goodput_ledger is not None:
+            # Crash path: stamp the last heartbeat but leave the generation
+            # open — the restart's merge treats it as died-mid-flight.
+            goodput_ledger.heartbeat()
+        raise
+    if goodput_ledger is not None:
+        # A preemption already closed the generation as "preempted" (first
+        # mark wins); otherwise this run ended cleanly.
+        goodput_ledger.close(ended="clean")
     logging.info("done at step %d", int(state.step))
 
 
