@@ -30,13 +30,24 @@ Surfaces:
   buckets (init/compile/train/data/checkpoint/eval/lost-work/...),
   persisted to ``goodput.json`` and merged across restarts — the
   cost-of-training verdict (``goodput_fraction``, ``/goodputz``);
+- ``CaptureEngine`` — reactive profiling: anomaly-/straggler-triggered
+  and on-demand (``POST /profilez``) ``jax.profiler`` windows with a
+  per-run budget, a ``captures.jsonl`` manifest, and
+  ``capture_begin``/``capture_end`` flight events — the layer that turns
+  the telemetry above into an actionable debugging loop;
 - ``tools/run_report.py`` — renders a logdir's streams into one
-  human-readable run report.
+  human-readable run report; ``tools/timeline.py`` merges them into a
+  single Chrome-trace/Perfetto timeline (restarts included).
 """
 
-from . import flight_recorder, goodput, memory  # noqa: F401
-from .aggregate import host_aggregate, straggler_summary  # noqa: F401
+from . import capture, flight_recorder, goodput, memory  # noqa: F401
+from .aggregate import (  # noqa: F401
+    host_aggregate,
+    spread_ratio,
+    straggler_summary,
+)
 from .anomaly import Anomaly, AnomalyDetector  # noqa: F401
+from .capture import CaptureEngine  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     default_recorder,

@@ -22,7 +22,7 @@ import numpy as np
 
 logger = logging.getLogger("distributedtensorflow_tpu")
 
-__all__ = ["host_aggregate", "straggler_summary"]
+__all__ = ["host_aggregate", "spread_ratio", "straggler_summary"]
 
 
 def host_aggregate(values: dict[str, float]) -> dict[str, float]:
@@ -54,6 +54,23 @@ def host_aggregate(values: dict[str, float]) -> dict[str, float]:
         out[f"{k}_host_max"] = float(col.max())
         out[f"{k}_straggler"] = float(int(col.argmax()))
     return out
+
+
+def spread_ratio(agg: dict[str, float], key: str) -> float:
+    """Cross-host spread of a gathered key: ``host_max / host_median``.
+
+    1.0 = perfectly balanced; large = one host is dragging every
+    collective.  This is the straggler-blowup signal the reactive
+    profiler (``obs.capture.CaptureEngine``) arms on when
+    ``TrainerConfig.auto_profile`` is set.  Returns 1.0 when the fields
+    are absent or the median is non-positive (nothing to compare)."""
+    med = agg.get(f"{key}_host_median")
+    mx = agg.get(f"{key}_host_max")
+    if not isinstance(med, (int, float)) or not isinstance(mx, (int, float)):
+        return 1.0
+    if med <= 0:
+        return 1.0
+    return float(mx) / float(med)
 
 
 def straggler_summary(agg: dict[str, float], key: str) -> str:

@@ -31,7 +31,9 @@ strings).  Kinds emitted by this repo: ``step``, ``log``, ``eval``,
 ``preemption_save``, ``watchdog_timeout``, ``exception``,
 ``compile_begin``/``compile`` (a ring ending in ``compile_begin`` with no
 matching ``compile`` = wedged in XLA compilation, not a collective),
-``coordinator_retry``, ``coordinator_failure``, ``fit_begin``, ``fit_end``.
+``capture_begin``/``capture_end`` (reactive-profiler windows —
+``obs.capture``), ``coordinator_retry``, ``coordinator_failure``,
+``fit_begin``, ``fit_end``.
 
 The hot path is one ``time.time()`` + one deque append under a lock; dumps
 rewrite the whole file atomically (tmp + rename) so a reader — or the

@@ -26,6 +26,9 @@ bucket               meaning
 ``eval``             in-loop and sidecar evaluation
 ``preemption_drain`` preemption notice → process exit, minus the save
                      (which books under ``checkpoint_save``)
+``profile_capture``  profiler start/stop overhead of CaptureEngine
+                     windows (the profiled steps themselves still book
+                     under ``train_step`` — they ran)
 ``lost_work``        wall time a dead generation spent past the checkpoint
                      the next generation resumed from — recomputed at merge
 ``badput_restart``   the gap between a generation's last heartbeat and the
@@ -106,6 +109,7 @@ BUCKETS = (
     "checkpoint_restore",
     "eval",
     "preemption_drain",
+    "profile_capture",
     "lost_work",
     "badput_restart",
     "other",
@@ -124,6 +128,7 @@ _SPAN_BUCKETS = {
     "checkpoint_wait": "checkpoint_save",
     "checkpoint_restore": "checkpoint_restore",
     "input_fastforward": "checkpoint_restore",
+    "profile_capture": "profile_capture",
 }
 
 #: Flight-event kinds NOT counted per generation (per-dispatch rate, or

@@ -17,12 +17,21 @@ deps, safe to leave on for a whole training job:
 - ``/memz``   — per-device HBM, host RSS, live-array census JSON;
 - ``/flightz`` — the flight recorder's current ring as a JSON array;
 - ``/goodputz`` — the goodput ledger (wall-time buckets, merged across
-  restarts) when one is installed (``--goodput``).
+  restarts) when one is installed (``--goodput``);
+- ``/profilez`` — GET: the reactive-profiler (``obs.capture``) state
+  (budget, armed/active window, completed captures); **POST**
+  ``/profilez?steps=N``: arm an on-demand capture of the next N steps —
+  the one write endpoint, so a wedged-but-alive run can be profiled
+  without restarting (the capture opens at the next fit-loop step
+  boundary; a hard-stuck loop never reaches one — use
+  ``--profiler-port`` for that case).
 
-Every handler is read-only and must not touch the device (no collectives,
-no blocking fetches) — it has to answer precisely when the main thread is
-wedged inside one.  ``port=0`` binds an ephemeral port (tests, multiple
-hosts per box); the bound port is ``server.port``.
+Every GET handler is read-only and must not touch the device (no
+collectives, no blocking fetches) — it has to answer precisely when the
+main thread is wedged inside one.  The POST only flips the engine's
+armed flag (no device work on the handler thread).  ``port=0`` binds an
+ephemeral port (tests, multiple hosts per box); the bound port is
+``server.port``.
 
 Exposure: the default bind is loopback — ``/threadz`` stack traces and
 ``/flightz`` exception messages leak paths and config, and there is no
@@ -53,6 +62,7 @@ _ENDPOINTS = {
     "/memz": "device HBM + host RSS + live-array census",
     "/flightz": "flight-recorder ring (JSON array)",
     "/goodputz": "goodput ledger: wall-time buckets across restarts",
+    "/profilez": "reactive profiler: GET state; POST ?steps=N arms a capture",
 }
 
 
@@ -141,10 +151,73 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(
                     ledger.report() if ledger is not None else {}
                 )
+            elif path == "/profilez":
+                engine = srv.capture
+                if engine is None:
+                    self._reply_json(
+                        {"error": "no capture engine installed"}, status=503
+                    )
+                else:
+                    self._reply_json(engine.state())
             else:
                 self._reply(f"unknown endpoint {path}\n", status=404)
         except Exception as e:  # a handler bug must not kill the server
             logger.exception("statusz handler failed for %s", path)
+            try:
+                self._reply(f"internal error: {e!r}\n", status=500)
+            except OSError:
+                pass  # client went away mid-reply
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server contract
+        srv = self.server_ref
+        path, _, query = self.path.partition("?")
+        try:
+            # Drain the body (we take parameters from the query string
+            # only) so HTTP/1.1 keep-alive stays in sync.
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > 0:
+                self.rfile.read(min(length, 1 << 20))
+            if path != "/profilez":
+                self._reply(f"POST not supported on {path}\n", status=404)
+                return
+            engine = srv.capture
+            if engine is None:
+                self._reply_json(
+                    {"error": "no capture engine installed"}, status=503
+                )
+                return
+            from urllib.parse import parse_qs  # noqa: PLC0415
+
+            params = parse_qs(query)
+            steps = None
+            if "steps" in params:
+                try:
+                    steps = int(params["steps"][0])
+                except ValueError:
+                    self._reply_json(
+                        {"error": f"bad steps={params['steps'][0]!r}"},
+                        status=400,
+                    )
+                    return
+                if steps < 1:
+                    self._reply_json(
+                        {"error": f"steps must be >= 1, got {steps}"},
+                        status=400,
+                    )
+                    return
+            # Manual captures skip the cooldown (a human asked) but still
+            # count against the per-run budget.
+            accepted, why = engine.request(
+                "manual", steps=steps, reason=f"POST /profilez from "
+                f"{self.client_address[0]}", cooldown=False,
+            )
+            self._reply_json(
+                {"accepted": accepted, "reason": why,
+                 "state": engine.state()},
+                status=200 if accepted else 409,
+            )
+        except Exception as e:  # a handler bug must not kill the server
+            logger.exception("statusz POST handler failed for %s", path)
             try:
                 self._reply(f"internal error: {e!r}\n", status=500)
             except OSError:
@@ -168,6 +241,7 @@ class StatusServer:
         host: str = "127.0.0.1",
         registry=None,
         flight=None,
+        capture=None,
         status_fn: Callable[[], dict] | None = None,
         health_fn: Callable[[], dict] | None = None,
     ):
@@ -175,6 +249,7 @@ class StatusServer:
 
         self._registry = registry or reglib.default_registry()
         self._flight = flight
+        self._capture = capture
         self._status_fn = status_fn
         self._health_fn = health_fn
         self._t0 = time.time()
@@ -207,6 +282,14 @@ class StatusServer:
 
         return goodput_mod.default_ledger()
 
+    @property
+    def capture(self):
+        if self._capture is not None:
+            return self._capture
+        from . import capture as capture_mod  # noqa: PLC0415
+
+        return capture_mod.default_engine()
+
     def status(self) -> dict:
         base = {"uptime_s": round(time.time() - self._t0, 1)}
         if self._status_fn is not None:
@@ -227,7 +310,8 @@ class StatusServer:
             self._started = True
             self._thread.start()
             logger.info("introspection server listening on port %d "
-                        "(/healthz /statusz /varz /threadz /memz /flightz)",
+                        "(/healthz /statusz /varz /threadz /memz /flightz "
+                        "/profilez)",
                         self.port)
         return self
 

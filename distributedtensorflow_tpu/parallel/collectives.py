@@ -50,14 +50,26 @@ _M_DISPATCH_S = obs.histogram(
 def _timed_dispatch(fn):
     """Route a collective wrapper's host-side time through the span tracer
     (``collective_<op>`` spans — children of the enclosing compile/step
-    span when traced under jit) and the dispatch histogram."""
+    span when traced under jit) and the dispatch histogram.
+
+    While a reactive-profiler window is open (``obs.capture``), the
+    region is additionally labeled with a ``jax.profiler``
+    ``TraceAnnotation`` so the captured host timeline names the
+    collective being dispatched — the disambiguation a straggler-spread
+    capture exists for.  The check is one module-attribute read, so the
+    un-captured hot path pays nothing."""
     op = fn.__name__
+    name = f"collective_{op}"
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         t0 = time.perf_counter()
-        with obs.span(f"collective_{op}"):
-            out = fn(*args, **kwargs)
+        with obs.span(name):
+            if obs.capture.capture_active():
+                with jax.profiler.TraceAnnotation(name):
+                    out = fn(*args, **kwargs)
+            else:
+                out = fn(*args, **kwargs)
         _M_DISPATCH_S.observe(time.perf_counter() - t0, op=op)
         return out
 

@@ -57,9 +57,26 @@ class TrainerConfig:
     logdir: str | None = None
     # Profiling window (SURVEY.md §5.1): capture a jax.profiler trace of
     # steps [profile_start, profile_start + profile_steps) into profile_dir.
+    # Routed through the reactive CaptureEngine (obs.capture) as its
+    # "static" trigger — one capture code path for static, triggered, and
+    # on-demand (/profilez) windows.
     profile_dir: str | None = None
     profile_start: int = 10
     profile_steps: int = 5
+    # Reactive profiling (obs.CaptureEngine): arm a jax.profiler capture
+    # of the next profile_steps steps the moment the anomaly detector
+    # flags a step-time regression, or — multi-host — the cross-host
+    # t_step spread blows past capture_spread_factor× the median.  Every
+    # capture writes <logdir>/captures/<id>/ plus a manifest row in
+    # <logdir>/captures.jsonl, emits capture_begin/capture_end flight
+    # events, and books its overhead into the goodput profile_capture
+    # bucket.  max_captures bounds the per-run artifact budget;
+    # capture_cooldown_s spaces triggered captures (manual /profilez
+    # requests skip the cooldown but not the budget).
+    auto_profile: bool = False
+    max_captures: int = 8
+    capture_cooldown_s: float = 120.0
+    capture_spread_factor: float = 3.0
     # Hang watchdog (SURVEY.md §5.2): dump all thread stacks if no step
     # completes for this many seconds.  0 disables.
     watchdog_timeout: float = 0.0
@@ -213,6 +230,22 @@ class Trainer:
             self.flight = obs.FlightRecorder(config.flight_capacity, path)
             obs.install_recorder(self.flight)
             self.flight.install_crash_hooks()
+        #: Reactive profiler (obs.CaptureEngine): owns every jax.profiler
+        #: window of the fit — the static --profile-dir window, anomaly-/
+        #: straggler-triggered captures (auto_profile), and on-demand
+        #: /profilez requests.  Created whenever any of those paths can
+        #: fire; installed as the process default so a standalone
+        #: StatusServer can find it.
+        self.capture: obs.CaptureEngine | None = None
+        if (config.profile_dir or config.auto_profile
+                or config.status_port is not None):
+            self.capture = obs.CaptureEngine(
+                config.logdir,
+                max_captures=config.max_captures,
+                cooldown_s=config.capture_cooldown_s,
+                window_steps=config.profile_steps,
+            )
+            obs.capture.install_engine(self.capture)
         #: Live introspection server (obs.StatusServer); alive for the
         #: trainer's whole lifetime so a wedged fit can still be probed.
         self.status_server: obs.StatusServer | None = None
@@ -231,6 +264,7 @@ class Trainer:
                     port,
                     host=config.status_host,
                     flight=self.flight,
+                    capture=self.capture,
                     status_fn=self.status,
                     health_fn=self.health,
                 ).start()
@@ -359,6 +393,9 @@ class Trainer:
         self.writer.close()
         if self.status_server is not None:
             self.status_server.stop()
+        if self.capture is not None:
+            if obs.capture.default_engine() is self.capture:
+                obs.capture.install_engine(None)
         if self.flight is not None:
             self.flight.uninstall_crash_hooks()
             if obs.default_recorder() is self.flight:
@@ -384,6 +421,17 @@ class Trainer:
             })
         if self.flight is not None:  # records the event AND dumps the ring
             self.flight.record_anomaly(anomaly)
+        if (
+            self.capture is not None
+            and self.config.auto_profile
+            and anomaly.kind == "step_time_regression"
+        ):
+            # The reactive-profiling loop: a regression arms a capture of
+            # the very next steps — the slow ones, not the average ones.
+            # Budget/cooldown refusals are normal on repeat anomalies.
+            self.capture.request(
+                "step_time_regression", reason=anomaly.message
+            )
         for cb in self.callbacks:
             try:
                 cb.on_anomaly(self, anomaly)
@@ -435,7 +483,14 @@ class Trainer:
         # Profile window is relative to THIS run's first step, so resuming
         # from a checkpoint past profile_start still produces a trace.
         profile_at = start_step + cfg.profile_start
-        profiling = False
+        if cfg.profile_dir and self.capture is not None:
+            # The classic static window, routed through the CaptureEngine
+            # (budget/cooldown-exempt: it was explicitly configured).
+            self.capture.request(
+                "static", steps=cfg.profile_steps, dir=cfg.profile_dir,
+                at_step=profile_at, budget=False, cooldown=False,
+                reason=f"--profile-dir window at step {profile_at}",
+            )
         try:
             step_i = start_step
             while step_i < cfg.total_steps:
@@ -443,16 +498,14 @@ class Trainer:
                 # a non-divisible total never overruns total_steps (the
                 # shorter stack recompiles the scanned program once).
                 k_eff = min(k, cfg.total_steps - step_i)
-                # Trace starts BEFORE the host batch fetch/stacking so the
-                # profile captures input-pipeline time (its purpose is to
-                # split host from chip time).  Uses the pre-shrink k_eff
-                # bound: a short prebundled tail can only shrink the
+                # Capture starts BEFORE the host batch fetch/stacking so
+                # the profile captures input-pipeline time (its purpose is
+                # to split host from chip time).  Uses the pre-shrink
+                # k_eff bound: a short prebundled tail can only shrink the
                 # dispatch, which at worst opens the trace one dispatch
                 # early — never skips the window.
-                if (cfg.profile_dir and not profiling
-                        and step_i <= profile_at < step_i + k_eff):
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    profiling = True
+                if self.capture is not None:
+                    self.capture.maybe_start(step_i, k_eff)
                 if self.tracer is not None:
                     self.tracer.begin_step(step_i + k_eff, k_eff)
                 # data_wait is a plain-class span (obs.span): it must be
@@ -520,15 +573,15 @@ class Trainer:
                     cb.on_step_end(self, step_next, state, metrics)
                 if watchdog is not None:
                     watchdog.ping()
-                if profiling and step_next >= profile_at + cfg.profile_steps:
-                    # Force the profiled steps to actually execute before
-                    # closing the trace (fetch, not block_until_ready — see
-                    # bench.py note on the axon backend).
-                    jax.tree.map(float, metrics)
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    logger.info(
-                        "profiler trace written to %s", cfg.profile_dir
+                if self.capture is not None:
+                    # Closes the window once it has covered its steps.
+                    # fetch= forces the profiled steps to actually execute
+                    # before the trace closes (fetch, not
+                    # block_until_ready — see bench.py note on the axon
+                    # backend).
+                    self.capture.maybe_stop(
+                        step_next,
+                        fetch=lambda m=metrics: jax.tree.map(float, m),
                     )
                 step_i = step_next - 1  # hooks below address the last step
                 if crosses(step_next - k_eff, step_next, cfg.log_every):
@@ -555,7 +608,21 @@ class Trainer:
                             "t_data": breakdown.get("t_data", 0.0),
                         })
                         last_metrics.update(agg)
-                        logger.info(obs.straggler_summary(agg, "t_step"))
+                        summary = obs.straggler_summary(agg, "t_step")
+                        logger.info(summary)
+                        if self.capture is not None and cfg.auto_profile:
+                            # Spread blowup: one host is dragging every
+                            # collective — capture the evidence.  The
+                            # ratio derives from the allgathered fields,
+                            # identical on every host, so all hosts arm
+                            # (and open their windows) consistently.
+                            ratio = obs.spread_ratio(agg, "t_step")
+                            if ratio >= cfg.capture_spread_factor:
+                                self.capture.request(
+                                    "straggler_spread",
+                                    reason=f"t_step spread {ratio:.1f}x "
+                                           f"median: {summary}",
+                                )
                     last_metrics.update(obs.default_registry().scalars())
                     if self.anomaly_detector is not None:
                         self.anomaly_detector.observe(
@@ -643,8 +710,11 @@ class Trainer:
                     self.tracer.end_step()
                 step_i = step_next
         finally:
-            if profiling:  # exception mid-window, or window past total_steps
-                jax.profiler.stop_trace()
+            if self.capture is not None:
+                # Exception mid-window, or a window past total_steps: close
+                # the trace (manifest row marked aborted when incomplete)
+                # and drop any armed-but-never-started request.
+                self.capture.abort(self._last_step)
         if cfg.profile_dir and cfg.total_steps <= profile_at:
             logger.warning(
                 "profile window never opened: run ended at step %d before "
@@ -749,6 +819,17 @@ class Trainer:
             out["checkpoint"] = {
                 "saves": self._ckpt_count,
                 "last_saved_step": self._last_ckpt_step,
+            }
+        if self.capture is not None:
+            cap_state = self.capture.state()
+            out["captures"] = {
+                "completed": len(cap_state["captures"]),
+                "budget": (
+                    f"{cap_state['used']}/{cap_state['max_captures']}"
+                ),
+                "active": cap_state["active"] is not None,
+                "armed": (cap_state["armed"] is not None
+                          or cap_state["scheduled"] is not None),
             }
         if self._last_eval_metrics:
             out["last_eval"] = dict(self._last_eval_metrics)

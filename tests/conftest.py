@@ -44,6 +44,7 @@ _PROCESS_TEST_FILES = {
     "test_sidecar.py",
     "test_combined_axes.py",
     "test_train_introspection_smoke.py",
+    "test_train_auto_profile_smoke.py",
 }
 
 

@@ -1,0 +1,355 @@
+"""obs.capture.CaptureEngine: arming/budget/cooldown logic, manifest
+discipline, flight events, and the /profilez endpoint — with an injected
+fake profiler so the fast lane never opens a real jax.profiler window
+(that path is covered by test_trainer's static-window test and the
+auto-profile smoke)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributedtensorflow_tpu import obs
+from distributedtensorflow_tpu.obs import capture as capture_mod
+from distributedtensorflow_tpu.obs.capture import CaptureEngine
+
+
+class FakeProfiler:
+    def __init__(self, fail_start=False):
+        self.starts: list[str] = []
+        self.stops = 0
+        self.fail_start = fail_start
+
+    def start(self, logdir):
+        if self.fail_start:
+            raise RuntimeError("profiler already active")
+        self.starts.append(logdir)
+
+    def stop(self):
+        self.stops += 1
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_engine(tmp_path, **kw):
+    prof = FakeProfiler()
+    clock = FakeClock()
+    kw.setdefault("max_captures", 3)
+    kw.setdefault("cooldown_s", 60.0)
+    kw.setdefault("window_steps", 5)
+    eng = CaptureEngine(
+        str(tmp_path), time_fn=clock,
+        profiler_start=prof.start, profiler_stop=prof.stop, **kw,
+    )
+    return eng, prof, clock
+
+
+def test_capture_lifecycle_writes_manifest(tmp_path):
+    eng, prof, clock = make_engine(tmp_path)
+    ok, why = eng.request("step_time_regression", reason="3.2x median")
+    assert ok, why
+    # armed but not yet started: nothing profiled
+    assert prof.starts == []
+    assert eng.maybe_start(step=10)
+    assert prof.starts == [str(tmp_path / "captures" / "0")]
+    assert capture_mod.capture_active()
+    # window is 5 steps: step 12 does not close it, 15 does
+    assert eng.maybe_stop(12) is None
+    clock.t += 2.5
+    row = eng.maybe_stop(15)
+    assert row is not None and prof.stops == 1
+    assert not capture_mod.capture_active()
+    assert row["trigger"] == "step_time_regression"
+    assert row["step_begin"] == 10 and row["step_end"] == 15
+    assert row["wall_s"] == pytest.approx(2.5)
+    assert row["dir"] == "captures/0"
+    lines = (tmp_path / "captures.jsonl").read_text().splitlines()
+    assert [json.loads(l)["id"] for l in lines] == [0]
+
+
+def test_budget_exhaustion_and_monotonic_ids(tmp_path):
+    eng, prof, clock = make_engine(tmp_path, max_captures=2, cooldown_s=0.0)
+    for i in range(2):
+        ok, why = eng.request("step_time_regression")
+        assert ok, why
+        assert eng.maybe_start(step=10 * i)
+        clock.t += 1
+        assert eng.maybe_stop(10 * i + 5) is not None
+    ok, why = eng.request("step_time_regression")
+    assert not ok and "budget" in why
+    # manual requests also count against the budget
+    ok, why = eng.request("manual", cooldown=False)
+    assert not ok and "budget" in why
+    # static (budget=False) still passes — it was explicitly configured
+    ok, why = eng.request("static", dir=str(tmp_path / "prof"),
+                          budget=False, cooldown=False)
+    assert ok, why
+    assert eng.maybe_start(step=50)
+    assert eng.maybe_stop(55) is not None
+    ids = [json.loads(l)["id"]
+           for l in (tmp_path / "captures.jsonl").read_text().splitlines()]
+    assert ids == [0, 1, 2]  # monotonic across triggers
+
+
+def test_cooldown_blocks_triggered_but_not_manual(tmp_path):
+    eng, prof, clock = make_engine(tmp_path, cooldown_s=60.0)
+    assert eng.request("step_time_regression")[0]
+    assert eng.maybe_start(step=0)
+    clock.t += 1
+    assert eng.maybe_stop(5) is not None
+    # 10s after the last capture: triggered requests are in cooldown
+    clock.t += 10
+    ok, why = eng.request("step_time_regression")
+    assert not ok and "cooldown" in why
+    # ... but a manual (cooldown-exempt) request goes through
+    ok, why = eng.request("manual", cooldown=False)
+    assert ok, why
+    # and once the cooldown has elapsed the trigger arms again
+    eng.abort()  # drop the armed manual request
+    clock.t += 60
+    assert eng.request("step_time_regression")[0]
+
+
+def test_busy_refusals(tmp_path):
+    eng, prof, clock = make_engine(tmp_path)
+    assert eng.request("manual", cooldown=False)[0]
+    ok, why = eng.request("manual", cooldown=False)
+    assert not ok and "armed" in why
+    assert eng.maybe_start(step=3)
+    ok, why = eng.request("manual", cooldown=False)
+    assert not ok and "active" in why
+    # double-start is a no-op while one is active
+    assert not eng.maybe_start(step=4)
+
+
+def test_at_step_gating_for_static_window(tmp_path):
+    eng, prof, clock = make_engine(tmp_path)
+    assert eng.request("static", at_step=10, steps=2,
+                       budget=False, cooldown=False)[0]
+    assert not eng.maybe_start(step=0, k=1)   # too early
+    assert not eng.maybe_start(step=11, k=1)  # past the window (no start)
+    # re-arm and hit it inside a k-step dispatch
+    eng.abort()
+    assert eng.request("static", at_step=10, steps=2,
+                       budget=False, cooldown=False)[0]
+    assert eng.maybe_start(step=8, k=4)  # 8 <= 10 < 12
+    row = eng.maybe_stop(12)
+    assert row is not None
+    assert row["step_begin"] == 10 and row["step_end"] == 12
+
+
+def test_abort_marks_incomplete_rows(tmp_path):
+    eng, prof, clock = make_engine(tmp_path)
+    assert eng.request("manual", cooldown=False)[0]
+    assert eng.maybe_start(step=0)
+    row = eng.abort(2)  # window wanted 5 steps, fit ended at 2
+    assert row is not None and row["aborted"] is True
+    assert prof.stops == 1
+    # idempotent; a never-started armed request is just dropped
+    assert eng.abort() is None
+    assert eng.request("manual", cooldown=False)[0]
+    assert eng.abort() is None
+
+
+def test_failed_profiler_start_never_raises_and_refunds_budget(tmp_path):
+    prof = FakeProfiler(fail_start=True)
+    eng = CaptureEngine(str(tmp_path), max_captures=1,
+                        profiler_start=prof.start, profiler_stop=prof.stop)
+    assert eng.request("manual", cooldown=False)[0]
+    assert eng.maybe_start(step=0) is False
+    assert not capture_mod.capture_active()
+    assert eng.maybe_stop(100) is None  # nothing active
+    # the failed start refunded its budget charge: with max_captures=1 a
+    # persistent start failure must not lock the engine out for the run
+    assert eng.state()["used"] == 0
+    assert eng.request("manual", cooldown=False)[0]
+
+
+def test_abort_refunds_never_started_requests(tmp_path):
+    eng, prof, clock = make_engine(tmp_path, max_captures=1)
+    assert eng.request("step_time_regression")[0]
+    assert eng.state()["used"] == 1
+    assert eng.abort() is None  # run ended before the window opened
+    assert eng.state()["used"] == 0  # charge refunded: nothing produced
+    assert eng.request("manual", cooldown=False)[0]
+
+
+def test_scheduled_static_window_does_not_block_reactive(tmp_path):
+    """A --profile-dir window armed for a far-future step must not refuse
+    triggered/manual captures in the meantime (separate slots)."""
+    eng, prof, clock = make_engine(tmp_path, cooldown_s=0.0)
+    assert eng.request("static", at_step=1000, steps=2,
+                       budget=False, cooldown=False)[0]
+    ok, why = eng.request("step_time_regression", reason="early anomaly")
+    assert ok, why
+    # the immediate request starts now; the scheduled one stays armed
+    assert eng.maybe_start(step=10)
+    assert eng.state()["scheduled"]["at_step"] == 1000
+    assert eng.maybe_stop(15) is not None
+    # ... and still opens when its step arrives
+    assert eng.maybe_start(step=1000)
+    row = eng.maybe_stop(1002)
+    assert row is not None and row["trigger"] == "static"
+    assert row["step_begin"] == 1000
+
+
+def test_abort_clamps_step_end_to_step_begin(tmp_path):
+    """An abort handed a step below step_begin (dispatch raised before
+    the step count advanced) must still write begin <= end."""
+    eng, prof, clock = make_engine(tmp_path)
+    assert eng.request("static", at_step=17, steps=5,
+                       budget=False, cooldown=False)[0]
+    assert eng.maybe_start(step=15, k=5)  # 15 <= 17 < 20
+    row = eng.abort(15)  # fit died; last completed step is 15 < 17
+    assert row is not None and row["aborted"] is True
+    assert row["step_begin"] == 17 and row["step_end"] == 17
+    from tools import check_metrics_schema
+
+    errors, _ = check_metrics_schema.check_file(
+        str(tmp_path / "captures.jsonl")
+    )
+    assert errors == []
+
+
+def test_no_logdir_requires_explicit_dir(tmp_path):
+    eng = CaptureEngine(None, profiler_start=lambda d: None,
+                        profiler_stop=lambda: None)
+    ok, why = eng.request("manual", cooldown=False)
+    assert not ok and "directory" in why
+    ok, why = eng.request("static", dir=str(tmp_path / "p"),
+                          budget=False, cooldown=False)
+    assert ok, why
+
+
+def test_flight_events_and_counter(tmp_path):
+    rec = obs.FlightRecorder(64)
+    prev = obs.install_recorder(rec)
+    try:
+        eng, prof, clock = make_engine(tmp_path)
+        before = capture_mod._M_CAPTURES.value(trigger="manual")
+        assert eng.request("manual", reason="operator", cooldown=False)[0]
+        assert eng.maybe_start(step=7)
+        clock.t += 1
+        assert eng.maybe_stop(12) is not None
+        kinds = [e["kind"] for e in rec.events()]
+        assert kinds == ["capture_begin", "capture_end"]
+        begin, end = rec.events()
+        assert begin["step"] == 7 and begin["trigger"] == "manual"
+        assert end["step"] == 12 and end["wall_s"] == pytest.approx(1.0)
+        after = capture_mod._M_CAPTURES.value(trigger="manual")
+        assert after == before + 1
+    finally:
+        obs.install_recorder(prev)
+
+
+def test_profile_capture_span_feeds_goodput(tmp_path):
+    """The start/stop overhead books into the goodput profile_capture
+    bucket via the span root sink (the ISSUE 4 overhead accounting)."""
+    from distributedtensorflow_tpu.obs.goodput import (
+        GoodputLedger,
+        install_ledger,
+    )
+
+    led = GoodputLedger(None)
+    prev = install_ledger(led)
+    try:
+        eng, prof, clock = make_engine(tmp_path)
+        assert eng.request("manual", cooldown=False)[0]
+        assert eng.maybe_start(step=0)
+        assert eng.maybe_stop(5) is not None
+        rec = led.report()["generations"][-1]
+        assert rec["buckets"].get("profile_capture", 0.0) > 0.0
+    finally:
+        install_ledger(prev)
+
+
+def test_spread_ratio_blowup_signal():
+    """aggregate.spread_ratio: the multi-host trigger predicate."""
+    agg = {"t_step_host_min": 0.1, "t_step_host_median": 0.1,
+           "t_step_host_max": 0.45, "t_step_straggler": 3.0}
+    assert obs.spread_ratio(agg, "t_step") == pytest.approx(4.5)
+    assert obs.spread_ratio({}, "t_step") == 1.0  # absent fields: no signal
+    assert obs.spread_ratio({"t_step_host_median": 0.0,
+                             "t_step_host_max": 1.0}, "t_step") == 1.0
+
+
+def _http(url, method="GET"):
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_profilez_endpoint(tmp_path):
+    eng, prof, clock = make_engine(tmp_path, max_captures=1)
+    with obs.StatusServer(0, capture=eng) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, state = _http(f"{base}/profilez")
+        assert status == 200
+        assert state["used"] == 0 and state["armed"] is None
+        status, body = _http(f"{base}/profilez?steps=3", method="POST")
+        assert status == 200 and body["accepted"] is True
+        assert body["state"]["armed"]["trigger"] == "manual"
+        assert body["state"]["armed"]["steps"] == 3
+        # busy: one already armed
+        status, body = _http(f"{base}/profilez", method="POST")
+        assert status == 409 and body["accepted"] is False
+        # the armed request starts/stops through the fit-loop hooks
+        assert eng.maybe_start(step=0)
+        assert eng.maybe_stop(3) is not None
+        # budget (max_captures=1) now refuses further manual requests
+        status, body = _http(f"{base}/profilez", method="POST")
+        assert status == 409 and "budget" in body["reason"]
+        status, state = _http(f"{base}/profilez")
+        assert state["captures"][0]["trigger"] == "manual"
+        # bad query values are a 400, not a 500
+        status, body = _http(f"{base}/profilez?steps=zero", method="POST")
+        assert status == 400
+        status, body = _http(f"{base}/profilez?steps=0", method="POST")
+        assert status == 400
+
+
+def test_profilez_without_engine_is_503():
+    prev = capture_mod.install_engine(None)
+    try:
+        with obs.StatusServer(0) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, body = _http(f"{base}/profilez")
+            assert status == 503 and "error" in body
+            status, body = _http(f"{base}/profilez", method="POST")
+            assert status == 503 and "error" in body
+    finally:
+        capture_mod.install_engine(prev)
+
+
+def test_statusz_reports_capture_state(tmp_path):
+    """Trainer wires the engine into /statusz and /profilez (construction
+    only — no fit needed to probe the introspection surface)."""
+    from distributedtensorflow_tpu.train.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    cfg = TrainerConfig(
+        total_steps=2, log_every=0, global_batch_size=8,
+        auto_profile=True, status_port=0,
+        logdir=str(tmp_path),
+    )
+    with Trainer(lambda s, b, r: (s, {}), cfg) as trainer:
+        assert trainer.capture is not None
+        st = trainer.status()
+        assert st["captures"]["budget"].endswith("/8")
+        base = f"http://127.0.0.1:{trainer.status_server.port}"
+        status, state_doc = _http(f"{base}/profilez")
+        assert status == 200 and state_doc["max_captures"] == 8
+    # close() uninstalled the default engine
+    assert capture_mod.default_engine() is None

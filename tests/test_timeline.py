@@ -1,0 +1,153 @@
+"""tools/timeline.py: merge the telemetry streams of a synthetic logdir
+into one Chrome-trace JSON and validate the document's schema — spans,
+flight events, captures, and goodput generations on distinct tracks."""
+
+import json
+
+import pytest
+
+from tools import timeline
+
+
+def _write_jsonl(path, rows):
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture
+def logdir(tmp_path):
+    # flight: fit_begin, per-step anchors, a capture pair, fit_end
+    flight = [
+        {"t": T0, "kind": "fit_begin", "step": 0, "total_steps": 3},
+        {"t": T0 + 1.0, "kind": "step", "step": 1, "k": 1},
+        {"t": T0 + 2.0, "kind": "step", "step": 2, "k": 1},
+        {"t": T0 + 2.1, "kind": "capture_begin", "step": 2, "id": 0,
+         "trigger": "step_time_regression", "dir": "captures/0"},
+        {"t": T0 + 3.0, "kind": "step", "step": 3, "k": 1},
+        {"t": T0 + 3.2, "kind": "capture_end", "step": 3, "id": 0,
+         "trigger": "step_time_regression", "wall_s": 1.1,
+         "overhead_s": 0.1, "dir": "captures/0"},
+        {"t": T0 + 3.5, "kind": "fit_end", "step": 3, "preempted": False},
+    ]
+    _write_jsonl(tmp_path / "flight.jsonl", flight)
+    trace = [
+        {"step": s, "k": 1, "t_wall": 1.0,
+         "spans": [
+             {"name": "data_wait", "dur_s": 0.2},
+             {"name": "train_step", "dur_s": 0.7,
+              "children": [{"name": "collective_all_reduce",
+                            "dur_s": 0.1}]},
+             {"name": "host_block", "dur_s": 0.05},
+         ]}
+        for s in (1, 2, 3)
+    ]
+    trace.append({"kind": "anomaly", "step": 2,
+                  "anomaly": "step_time_regression",
+                  "message": "slow", "value": 2.0})
+    _write_jsonl(tmp_path / "trace.jsonl", trace)
+    _write_jsonl(tmp_path / "captures.jsonl", [
+        {"id": 0, "trigger": "step_time_regression", "reason": "slow",
+         "step_begin": 2, "step_end": 3, "t_begin": T0 + 2.1,
+         "t_end": T0 + 3.2, "wall_s": 1.1, "overhead_s": 0.1,
+         "dir": "captures/0"},
+    ])
+    (tmp_path / "goodput.json").write_text(json.dumps({
+        "version": 1,
+        "generations": [
+            {"gen": 0, "start_t": T0 - 10.0, "last_t": T0 - 5.0,
+             "last_step": 1, "ended": None, "resumed_step": None,
+             "buckets": {"train_step": 4.0, "init": 1.0}},
+            {"gen": 1, "start_t": T0 - 1.0, "last_t": T0 + 3.5,
+             "last_step": 3, "ended": "clean", "resumed_step": 1,
+             "buckets": {"train_step": 3.0, "init": 1.5}},
+        ],
+        "merged": {"wall_s": 13.5, "buckets": {"train_step": 7.0},
+                   "goodput_fraction": 0.5, "generations": 2,
+                   "restarts": 1},
+    }))
+    return tmp_path
+
+
+def test_timeline_schema(logdir):
+    doc = timeline.build_timeline(str(logdir))
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    # valid Chrome-trace JSON: serializable, every event has ph/pid/name,
+    # every duration/instant event has numeric non-negative timestamps
+    json.dumps(doc)
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["name"], str)
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+
+    def named(ph, pid):
+        return [e for e in events if e["ph"] == ph and e["pid"] == pid]
+
+    # distinct tracks: spans, flight, captures, goodput
+    span_events = named("X", timeline.PID_SPANS)
+    flight_events = named("i", timeline.PID_FLIGHT)
+    capture_events = named("X", timeline.PID_CAPTURES)
+    goodput_events = named("X", timeline.PID_GOODPUT)
+    assert {e["name"] for e in span_events} >= {
+        "data_wait", "train_step", "host_block",
+        "collective_all_reduce", "step 1",
+    }
+    assert {e["name"] for e in flight_events} >= {
+        "fit_begin", "step", "capture_begin", "capture_end", "fit_end",
+    }
+    cap = next(e for e in capture_events
+               if e["name"] == "capture 0: step_time_regression")
+    assert cap["dur"] == pytest.approx(1.1e6)
+    names = {e["name"] for e in goodput_events}
+    assert "gen 0 (died)" in names and "gen 1 (clean)" in names
+    assert "badput_restart" in names  # the gap between gen 0 and gen 1
+
+    # span rows anchor to the flight step events: step 1's train_step span
+    # ends at the step-1 flight event (T0 + 1.0 -> relative to origin
+    # T0 - 10.0 = gen 0 start)
+    origin = doc["otherData"]["origin_unix_s"]
+    assert origin == pytest.approx(T0 - 10.0)
+    ts1 = next(e for e in span_events if e["name"] == "step 1")["ts"]
+    # row start = anchor - (data_wait + train_step) = T0 + 1.0 - 0.9
+    assert ts1 == pytest.approx((T0 + 1.0 - 0.9 - origin) * 1e6, rel=1e-6)
+
+
+def test_timeline_main_writes_file(logdir, capsys):
+    assert timeline.main([str(logdir)]) == 0
+    out = json.loads((logdir / "timeline.json").read_text())
+    assert out["traceEvents"]
+    assert "timeline:" in capsys.readouterr().out
+
+
+def test_timeline_partial_streams(tmp_path):
+    # flight-only logdir still renders (relative span track absent)
+    _write_jsonl(tmp_path / "flight.jsonl", [
+        {"t": T0, "kind": "fit_begin", "step": 0},
+        {"t": T0 + 1, "kind": "fit_end", "step": 5},
+    ])
+    doc = timeline.build_timeline(str(tmp_path))
+    assert any(e["ph"] == "i" for e in doc["traceEvents"])
+
+
+def test_timeline_empty_logdir_exits_nonzero(tmp_path):
+    with pytest.raises(SystemExit):
+        timeline.build_timeline(str(tmp_path))
+    assert timeline.main([str(tmp_path / "missing")]) == 1
+
+
+def test_timeline_without_flight_lays_spans_sequentially(tmp_path):
+    _write_jsonl(tmp_path / "trace.jsonl", [
+        {"step": 1, "k": 1, "t_wall": 1.0,
+         "spans": [{"name": "train_step", "dur_s": 0.9}]},
+        {"step": 2, "k": 1, "t_wall": 1.0,
+         "spans": [{"name": "train_step", "dur_s": 0.8}]},
+    ])
+    doc = timeline.build_timeline(str(tmp_path))
+    rows = [e for e in doc["traceEvents"]
+            if e["ph"] == "X" and e["name"].startswith("step ")]
+    assert [e["ts"] for e in rows] == [0.0, pytest.approx(1e6)]

@@ -1,0 +1,148 @@
+"""train.py --auto-profile reactive profiling, end to end in a subprocess.
+
+The ISSUE 4 acceptance scenario: force a synthetic step-time regression
+on a CPU run and assert the CaptureEngine captures it exactly once.  The
+regression is forced with ``--eval-every 15`` at ``--log-every 1``: the
+eval hook runs *after* the log write, so its wall time (eval-step compile
++ 10 eval batches) lands inside the NEXT log window's ``t_step`` — a
+>3x-median spike the anomaly detector flags, with >=14 clean windows of
+history behind it.  The second eval (step 30) forces a repeat anomaly
+that the ``--max-captures 1`` budget must refuse.
+
+Asserts the full artifact chain: exactly one ``captures/<id>/`` dir with
+an xplane trace, one ``captures.jsonl`` manifest row,
+``capture_begin``/``capture_end`` flight events,
+``profiler_captures_total{trigger="step_time_regression"} 1`` in
+``metrics.prom``, schema-gate green, a "captures" section in run_report,
+and a loadable ``tools/timeline.py`` Chrome trace with spans, flight
+events, and the capture window on distinct tracks.
+
+Process-spawning, so slow-laned wholesale via conftest's
+_PROCESS_TEST_FILES (the full suite runs it; the <5-min sanity lane
+skips it).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_forced_regression_captures_exactly_once(tmp_path):
+    logdir = tmp_path / "logs"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [
+            sys.executable, "train.py",
+            "--workload", "mnist_lenet", "--steps", "45", "--test-size",
+            "--log-every", "1", "--device", "cpu",
+            "--eval-every", "15",
+            "--auto-profile", "--max-captures", "1",
+            "--flight-recorder",
+            "--logdir", str(logdir),
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stderr[-4000:]
+    log = res.stderr + res.stdout
+
+    # the detector flagged the eval-inflated window and armed the capture
+    assert "anomaly: step time" in log
+    assert "capture armed: trigger=step_time_regression" in log
+
+    # exactly one manifest row, for the regression trigger
+    rows = [
+        json.loads(line)
+        for line in (logdir / "captures.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    assert len(rows) == 1, rows
+    row = rows[0]
+    assert row["trigger"] == "step_time_regression"
+    assert row["step_begin"] < row["step_end"]
+    assert row["wall_s"] > 0
+    # ... whose capture dir holds a real profiler trace
+    cap_dir = logdir / row["dir"]
+    assert cap_dir.is_dir()
+    assert glob.glob(str(cap_dir / "**" / "*.xplane.pb"), recursive=True)
+
+    # the budget refused the repeat anomaly (eval at step 30): one
+    # capture_begin/capture_end pair, >= 2 step_time_regression anomalies
+    flight = [
+        json.loads(line)
+        for line in (logdir / "flight.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    kinds = [e["kind"] for e in flight]
+    assert kinds.count("capture_begin") == 1
+    assert kinds.count("capture_end") == 1
+    regressions = [
+        e for e in flight
+        if e["kind"] == "anomaly"
+        and e.get("anomaly") == "step_time_regression"
+    ]
+    assert len(regressions) >= 2, (
+        "the second eval spike should re-trigger the detector "
+        f"(got {len(regressions)} regression anomalies)"
+    )
+    begin = next(e for e in flight if e["kind"] == "capture_begin")
+    end = next(e for e in flight if e["kind"] == "capture_end")
+    assert begin["step"] == row["step_begin"]
+    assert end["step"] == row["step_end"]
+
+    # the registry counted it, and the snapshot carries the labeled line
+    prom = (logdir / "metrics.prom").read_text()
+    assert 'profiler_captures_total{trigger="step_time_regression"} 1.0' \
+        in prom
+
+    # schema gate: manifest + flight + metrics all validate
+    check = subprocess.run(
+        [
+            sys.executable, "tools/check_metrics_schema.py",
+            str(logdir / "captures.jsonl"), str(logdir / "flight.jsonl"),
+            str(logdir / "metrics.jsonl"),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+    # run_report renders the captures section and exits 0
+    rep = subprocess.run(
+        [sys.executable, "tools/run_report.py", str(logdir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    assert "captures: 1 profiler window(s)" in rep.stdout
+    assert "step_time_regression" in rep.stdout
+
+    # timeline.py merges the streams into a loadable Chrome trace with
+    # spans, flight events, and the capture window on distinct tracks
+    tl = subprocess.run(
+        [sys.executable, "tools/timeline.py", str(logdir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert tl.returncode == 0, tl.stdout + tl.stderr
+    doc = json.loads((logdir / "timeline.json").read_text())
+    events = doc["traceEvents"]
+    assert events
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["name"], str)
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e["ts"], (int, float))
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    pids = {
+        name: {e["pid"] for e in events if e["ph"] == ph
+               and e["name"] == ev_name}
+        for name, ph, ev_name in (
+            ("spans", "X", "train_step"),
+            ("flight", "i", "step"),
+            ("capture", "X", "capture 0: step_time_regression"),
+        )
+    }
+    assert all(len(v) == 1 for v in pids.values()), pids
+    assert len({next(iter(v)) for v in pids.values()}) == 3, pids

@@ -32,12 +32,36 @@ import sys
 def find_trace(path: str) -> str:
     if os.path.isfile(path):
         return path
+    if not os.path.isdir(path):
+        raise SystemExit(
+            f"analyze_trace: {path}: no such profile dir (did the capture "
+            "run?)"
+        )
     hits = sorted(glob.glob(
         os.path.join(path, "plugins", "profile", "*", "*.trace.json.gz")
     ))
     if not hits:
-        raise SystemExit(f"no *.trace.json.gz under {path}")
+        raise SystemExit(
+            f"analyze_trace: no *.trace.json.gz under {path} (empty or "
+            "partial profile dir)"
+        )
     return hits[-1]  # newest capture
+
+
+def load_trace(trace_path: str) -> dict:
+    """Parsed trace JSON, or a one-line SystemExit on a truncated/corrupt
+    file (a killed capture leaves partial gz; that must not traceback)."""
+    try:
+        trace = json.load(gzip.open(trace_path))
+    except (OSError, EOFError, json.JSONDecodeError, ValueError) as e:
+        raise SystemExit(
+            f"analyze_trace: {trace_path}: unreadable trace ({e})"
+        ) from None
+    if not isinstance(trace, dict) or not trace.get("traceEvents"):
+        raise SystemExit(
+            f"analyze_trace: {trace_path}: no traceEvents (empty capture)"
+        )
+    return trace
 
 
 def device_pid(trace: dict) -> int:
@@ -69,12 +93,17 @@ def analyze_bytes(trace_path: str, n_steps: int | None,
     sizes (each operand counted once), so category GB/s near the HBM peak
     means the program is bandwidth-saturated and only graph-level traffic
     cuts can speed it up."""
-    trace = json.load(gzip.open(trace_path))
+    trace = load_trace(trace_path)
     pid = device_pid(trace)
     all_events = [
         e for e in trace["traceEvents"]
         if e.get("ph") == "X" and e.get("pid") == pid
     ]
+    if not all_events:
+        raise SystemExit(
+            f"analyze_trace: {trace_path}: device lane has no complete "
+            "events (capture closed before any step ran?)"
+        )
     if n_steps is None:
         n_steps = _infer_steps(all_events)
     events = [e for e in all_events if "long_name" in e.get("args", {})]
@@ -112,12 +141,17 @@ def analyze_bytes(trace_path: str, n_steps: int | None,
 
 
 def analyze(trace_path: str, n_steps: int | None) -> None:
-    trace = json.load(gzip.open(trace_path))
+    trace = load_trace(trace_path)
     pid = device_pid(trace)
     events = [
         e for e in trace["traceEvents"]
         if e.get("ph") == "X" and e.get("pid") == pid
     ]
+    if not events:
+        raise SystemExit(
+            f"analyze_trace: {trace_path}: device lane has no complete "
+            "events (capture closed before any step ran?)"
+        )
     if n_steps is None:
         n_steps = _infer_steps(events)
     agg = collections.Counter()
@@ -139,7 +173,7 @@ def analyze(trace_path: str, n_steps: int | None) -> None:
               f"{name[:90]}")
 
 
-def main() -> None:
+def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="profile dir (or a .trace.json.gz file)")
     ap.add_argument("--steps", type=int, default=None,
@@ -150,7 +184,7 @@ def main() -> None:
                          "+ achieved GB/s (docs/RESNET_PERF.md §1)")
     ap.add_argument("--peak-gbps", type=float, default=819.0,
                     help="HBM peak for the %%-of-peak line (default v5e)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     if args.steps is not None and args.steps < 1:
         ap.error("--steps must be >= 1")
     if args.bytes:

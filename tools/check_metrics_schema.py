@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""Validate ``metrics.jsonl`` / ``flight.jsonl`` / ``goodput.json`` files
-against the documented schemas.
+"""Validate ``metrics.jsonl`` / ``flight.jsonl`` / ``goodput.json`` /
+``captures.jsonl`` files against the documented schemas.
 
 Usage::
 
@@ -9,8 +9,9 @@ Usage::
 
 Files whose basename starts with ``flight`` are validated against the
 flight-recorder event schema; basenames starting with ``goodput`` against
-the goodput-ledger document schema; everything else against the
-metric-row schema.
+the goodput-ledger document schema; basenames starting with ``captures``
+against the reactive-profiler manifest schema; everything else against
+the metric-row schema.
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
@@ -28,6 +29,15 @@ The flight schema (docs/API.md "Live introspection"): every event of a
 ``kind`` (non-empty string), optional ``step`` (non-negative integer), and
 free-form event fields (JSON scalars; non-finite numbers use the same
 sentinel strings); event timestamps must be non-decreasing (ring order).
+
+The captures schema (docs/API.md "Reactive profiling"): every row of a
+``captures.jsonl`` manifest is one JSON object with a non-negative
+integer ``id`` (strictly increasing across the file), a ``trigger`` from
+the known set (``static`` / ``manual`` / ``step_time_regression`` /
+``straggler_spread``), integer ``step_begin < step_end`` (``<=`` allowed
+for ``aborted`` rows), finite ``t_begin <= t_end``, non-negative
+``wall_s`` / ``overhead_s``, and a ``dir`` that exists on disk (resolved
+against the manifest's directory when relative).
 
 The goodput schema (docs/API.md "Goodput"): ``goodput.json`` is ONE JSON
 object with a ``generations`` list (each: finite ``start_t <= last_t``,
@@ -60,13 +70,22 @@ DEFAULT_FLIGHT_GLOB = os.path.join(
 DEFAULT_GOODPUT_GLOB = os.path.join(
     REPO, "ARTIFACTS", "convergence_*", "goodput*.json"
 )
+DEFAULT_CAPTURES_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "convergence_*", "captures*.jsonl"
+)
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
 #: duplicated: this tool is stdlib-only and must run anywhere logs land).
 GOODPUT_BUCKETS = (
     "init", "compile", "train_step", "data_wait", "checkpoint_save",
-    "checkpoint_restore", "eval", "preemption_drain", "lost_work",
-    "badput_restart", "other",
+    "checkpoint_restore", "eval", "preemption_drain", "profile_capture",
+    "lost_work", "badput_restart", "other",
+)
+
+#: The known capture trigger kinds (obs/capture.py TRIGGERS — duplicated
+#: for the same stdlib-only reason).
+CAPTURE_TRIGGERS = (
+    "static", "manual", "step_time_regression", "straggler_spread",
 )
 
 
@@ -146,6 +165,100 @@ def check_flight_row(row, lineno: int,
                 "not a JSON scalar"
             )
     return errors, warnings, (t if t is not None else prev_t)
+
+
+def _nonneg_int(v) -> bool:
+    """True when ``v`` is a non-negative integral JSON number.  The
+    finiteness check comes FIRST: ``json.loads`` parses bare ``NaN`` /
+    ``Infinity`` tokens, and ``int(nan)`` raises — a malformed row must
+    become a reported error, never a checker traceback."""
+    return (
+        isinstance(v, (int, float)) and not isinstance(v, bool)
+        and math.isfinite(v) and float(v) == int(v) and v >= 0
+    )
+
+
+def check_capture_row(
+    row, lineno: int, prev_id: int | None, manifest_dir: str,
+) -> tuple[list[str], list[str], int | None]:
+    """Returns (errors, warnings, id) for one captures.jsonl manifest row."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    if not isinstance(row, dict):
+        return ([f"line {lineno}: row is {type(row).__name__}, "
+                 "not an object"], [], prev_id)
+    cap_id = row.get("id")
+    if not _nonneg_int(cap_id):
+        errors.append(f"line {lineno}: 'id' {cap_id!r} is not a "
+                      "non-negative integer")
+        cap_id = None
+    elif prev_id is not None and int(cap_id) <= prev_id:
+        errors.append(
+            f"line {lineno}: 'id' {int(cap_id)} does not increase "
+            f"(previous {prev_id})"
+        )
+    trigger = row.get("trigger")
+    if trigger not in CAPTURE_TRIGGERS:
+        errors.append(
+            f"line {lineno}: 'trigger' {trigger!r} not in "
+            f"{CAPTURE_TRIGGERS}"
+        )
+    aborted = bool(row.get("aborted"))
+    steps = {}
+    for name in ("step_begin", "step_end"):
+        v = row.get(name)
+        if not _nonneg_int(v):
+            errors.append(f"line {lineno}: {name!r} {v!r} is not a "
+                          "non-negative integer")
+        else:
+            steps[name] = int(v)
+    if len(steps) == 2:
+        if aborted:
+            if steps["step_end"] < steps["step_begin"]:
+                errors.append(
+                    f"line {lineno}: step_end {steps['step_end']} precedes "
+                    f"step_begin {steps['step_begin']}"
+                )
+        elif steps["step_end"] <= steps["step_begin"]:
+            errors.append(
+                f"line {lineno}: step_end {steps['step_end']} must exceed "
+                f"step_begin {steps['step_begin']} (window covered no step)"
+            )
+    times = {}
+    for name in ("t_begin", "t_end"):
+        v = row.get(name)
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or not math.isfinite(v):
+            errors.append(f"line {lineno}: {name!r} {v!r} is not a "
+                          "finite number")
+        else:
+            times[name] = float(v)
+    if len(times) == 2 and times["t_end"] < times["t_begin"]:
+        errors.append(
+            f"line {lineno}: t_end {times['t_end']} precedes t_begin "
+            f"{times['t_begin']}"
+        )
+    for name in ("wall_s", "overhead_s"):
+        v = row.get(name)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or not math.isfinite(v) or v < 0:
+            errors.append(f"line {lineno}: {name!r} {v!r} is not a "
+                          "non-negative finite number")
+    cap_dir = row.get("dir")
+    if not isinstance(cap_dir, str) or not cap_dir:
+        errors.append(f"line {lineno}: 'dir' {cap_dir!r} is not a "
+                      "non-empty string")
+    else:
+        resolved = (cap_dir if os.path.isabs(cap_dir)
+                    else os.path.join(manifest_dir, cap_dir))
+        if not os.path.isdir(resolved):
+            errors.append(
+                f"line {lineno}: capture dir {resolved} does not exist"
+            )
+    return (errors, warnings,
+            int(cap_id) if cap_id is not None else prev_id)
 
 
 def _check_bucket_map(buckets, where: str) -> tuple[list[str], list[str]]:
@@ -242,9 +355,12 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
             return [f"invalid JSON ({e})"], []
         return check_goodput_doc(doc)
     flight = os.path.basename(path).startswith("flight")
+    captures = os.path.basename(path).startswith("captures")
+    manifest_dir = os.path.dirname(os.path.abspath(path))
     errors: list[str] = []
     warnings: list[str] = []
     prev_t: float | None = None
+    prev_id: int | None = None
     with open(path) as f:
         for i, line in enumerate(f, start=1):
             line = line.strip()
@@ -257,6 +373,9 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
                 continue
             if flight:
                 e, w, prev_t = check_flight_row(row, i, prev_t)
+            elif captures:
+                e, w, prev_id = check_capture_row(row, i, prev_id,
+                                                  manifest_dir)
             else:
                 e, w = check_row(row, i)
             errors.extend(e)
@@ -267,7 +386,7 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
 def main(argv: list[str] | None = None) -> int:
     paths = list(argv) if argv else sorted(
         glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
-        + glob.glob(DEFAULT_GOODPUT_GLOB)
+        + glob.glob(DEFAULT_GOODPUT_GLOB) + glob.glob(DEFAULT_CAPTURES_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

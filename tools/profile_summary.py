@@ -45,11 +45,22 @@ def find_xplane_files(path: str) -> list[str]:
 
 def load_xspace(path: str):
     os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception as e:  # no TF wheel on the analysis box
+        raise SystemExit(
+            f"profile_summary: cannot import the xplane proto ({e}); "
+            "run where the tensorflow wheel is installed"
+        ) from None
 
     xspace = xplane_pb2.XSpace()
-    with open(path, "rb") as f:
-        xspace.ParseFromString(f.read())
+    try:
+        with open(path, "rb") as f:
+            xspace.ParseFromString(f.read())
+    except Exception as e:  # truncated/corrupt pb from a killed capture
+        raise SystemExit(
+            f"profile_summary: {path}: unreadable xplane proto ({e})"
+        ) from None
     return xspace
 
 
@@ -74,18 +85,28 @@ def summarize_plane(plane, top: int) -> tuple[list, float, float]:
     return [(n, ms, count[n]) for n, ms in rows], busy_ms, span_ms
 
 
-def main() -> None:
+def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("profile_dir", help="trace dir or an .xplane.pb file")
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--all-planes", action="store_true",
                    help="include host/python planes (default: device only)")
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
+    if not os.path.exists(args.profile_dir):
+        print(
+            f"profile_summary: {args.profile_dir}: no such profile dir "
+            "(did the capture run?)", file=sys.stderr,
+        )
+        return 1
     files = find_xplane_files(args.profile_dir)
     if not files:
-        print(f"no *.xplane.pb under {args.profile_dir}", file=sys.stderr)
-        raise SystemExit(1)
+        print(
+            f"profile_summary: no *.xplane.pb under {args.profile_dir} "
+            "(empty or partial profile dir)", file=sys.stderr,
+        )
+        return 1
+    printed = 0
     for path in files:
         xspace = load_xspace(path)
         print(f"== {os.path.relpath(path, args.profile_dir)}")
@@ -98,6 +119,7 @@ def main() -> None:
             rows, busy_ms, span_ms = summarize_plane(plane, args.top)
             if not rows:
                 continue
+            printed += 1
             print(
                 f"-- plane {plane.name!r}: busy {busy_ms:.2f} ms over "
                 f"{span_ms:.2f} ms span "
@@ -109,7 +131,19 @@ def main() -> None:
                     f"  {name[:90]:<{min(width, 90)}}  {ms:9.3f} ms  "
                     f"{100 * ms / busy_ms:5.1f}%  x{n}"
                 )
+    if not printed:
+        # No matching plane had any events — exiting 0 with an empty table
+        # used to read as "nothing is slow"; it actually means "nothing was
+        # captured" (CPU-only trace without --all-planes, or a window that
+        # closed before a step ran).
+        print(
+            f"profile_summary: no plane with events in {len(files)} "
+            "xplane file(s) — CPU-only capture? (re-run with --all-planes "
+            "to include host planes)", file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

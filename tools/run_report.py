@@ -23,6 +23,8 @@ writes — and prints:
 - flight recorder: the last events before exit from ``flight.jsonl`` —
   the first thing to read on a crashed or hung run (a last event that is
   not ``fit_end`` means the process died mid-flight);
+- captures: the reactive profiler's manifest from ``captures.jsonl``
+  (count, per-trigger breakdown, step ranges, per-capture wall cost);
 - goodput: the merged cross-restart wall-time ledger from ``goodput.json``
   (``--goodput`` runs) — productive fraction, per-bucket seconds,
   generation/restart counts.
@@ -185,6 +187,35 @@ def flight_summary(flight: list[dict], last_n: int = 10) -> dict:
     }
 
 
+def capture_summary(rows: list[dict]) -> dict:
+    """Reactive-profiler digest from ``captures.jsonl``: capture count,
+    per-trigger counts, and per-capture windows (step range + wall cost)."""
+    if not rows:
+        return {}
+    triggers: dict[str, int] = {}
+    windows = []
+    for r in rows:
+        t = str(r.get("trigger", "?"))
+        triggers[t] = triggers.get(t, 0) + 1
+        w = {
+            "id": r.get("id"),
+            "trigger": t,
+            "step_begin": r.get("step_begin"),
+            "step_end": r.get("step_end"),
+            "wall_s": r.get("wall_s"),
+            "overhead_s": r.get("overhead_s"),
+            "dir": r.get("dir"),
+        }
+        if r.get("aborted"):
+            w["aborted"] = True
+        windows.append(w)
+    return {
+        "count": len(rows),
+        "triggers": dict(sorted(triggers.items(), key=lambda kv: -kv[1])),
+        "windows": windows,
+    }
+
+
 def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     """Last-row host-spread fields, grouped by base key."""
     out: dict[str, dict[str, float]] = {}
@@ -232,6 +263,11 @@ def build_report(logdir: str) -> dict:
     flight_path = os.path.join(logdir, "flight.jsonl")
     flight, _ = (_load_jsonl(flight_path) if os.path.exists(flight_path)
                  else ([], 0))
+    captures_path = os.path.join(logdir, "captures.jsonl")
+    captures, bad_captures = (
+        _load_jsonl(captures_path) if os.path.exists(captures_path)
+        else ([], 0)
+    )
     goodput, bad_goodput = load_goodput(logdir)
     train, evals = split_rows(rows)
 
@@ -260,10 +296,12 @@ def build_report(logdir: str) -> dict:
         "anomalies": collect_anomalies(trace, train),
         "stragglers": straggler_fields(train),
         "flight": flight_summary(flight),
+        "captures": capture_summary(captures),
         "goodput": goodput,
-        # metric-stream health: any unparseable metrics.jsonl line (or an
-        # unreadable goodput.json) makes main() exit non-zero (CI gate)
-        "parse_errors": bad_metrics + bad_goodput,
+        # metric-stream health: any unparseable metrics.jsonl / captures
+        # line (or an unreadable goodput.json) makes main() exit non-zero
+        # (CI gate)
+        "parse_errors": bad_metrics + bad_goodput + bad_captures,
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -334,6 +372,26 @@ def render(report: dict) -> str:
                 if k not in ("t", "kind", "stacks", "message")
             )
             lines.append(f"  {rel}  {e.get('kind', '?'):<18} {extra}".rstrip())
+    cap = report.get("captures")
+    if cap:
+        trig = ", ".join(f"{k} x{v}" for k, v in cap["triggers"].items())
+        lines += [
+            "",
+            f"captures: {cap['count']} profiler window(s) ({trig})",
+        ]
+        for w in cap["windows"]:
+            wall = w.get("wall_s")
+            over = w.get("overhead_s")
+            note = "  ABORTED" if w.get("aborted") else ""
+            line = (
+                f"  #{w.get('id')} {w['trigger']:<22} steps "
+                f"{w.get('step_begin')}..{w.get('step_end')}"
+            )
+            if isinstance(wall, (int, float)):
+                line += f"  wall {wall:.3g}s"
+            if isinstance(over, (int, float)):
+                line += f"  overhead {over:.3g}s"
+            lines.append(line + f"  {w.get('dir')}{note}")
     gp = report.get("goodput")
     if gp:
         wall = gp.get("wall_s", 0.0) or 0.0

@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""Merge a logdir's telemetry streams into one Chrome-trace/Perfetto JSON.
+
+The obs subsystem leaves four post-hoc streams side by side —
+``trace.jsonl`` (per-step span trees), ``flight.jsonl`` (structured event
+ring), ``goodput.json`` (wall-time generations across restarts), and
+``captures.jsonl`` (reactive-profiler windows).  Each answers one
+question; debugging a run means reading them *against each other*: did
+the step-time spike line up with a checkpoint save?  was the capture
+window actually over the slow steps?  how long was the restart gap
+between generations?  This tool renders all four onto one timeline:
+
+Usage::
+
+    python tools/timeline.py <logdir> [-o timeline.json]
+
+and load the output in ``chrome://tracing`` or https://ui.perfetto.dev.
+Tracks (one Chrome-trace "process" per stream):
+
+- **spans** — every ``trace.jsonl`` step row as nested duration events
+  (``data_wait`` / ``train_step`` / ``host_block`` / ...), one lane;
+- **flight events** — every ``flight.jsonl`` event as an instant, one
+  lane per event kind (``step``, ``log``, ``checkpoint_begin``, ...);
+- **captures** — each reactive-profiler window as a duration bar
+  labelled by its trigger;
+- **goodput** — one bar per process generation (restart gaps show as the
+  space between bars, labelled ``badput_restart`` when the ledger booked
+  them).
+
+Timestamp reconstruction: ``trace.jsonl`` spans carry durations only, so
+step rows are anchored to the flight recorder's absolute ``step`` events
+when present (the event fires right after the ``train_step`` span
+closes); rows with no matching flight event are laid out sequentially
+from the previous row's end.  With no flight recorder at all the span
+track is relative from the earliest absolute timestamp (or zero).  The
+reconstruction is for *reading*, not for metrology — durations are
+exact, absolute placement of un-anchored rows is best-effort.
+
+Pure stdlib; tolerant of missing streams (but exits non-zero with a
+one-line diagnostic when the logdir has none of them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+PID_SPANS = 1
+PID_FLIGHT = 2
+PID_CAPTURES = 3
+PID_GOODPUT = 4
+
+_NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
+              "-Infinity": float("-inf")}
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Parsed object rows; bad lines are skipped with a stderr note."""
+    rows: list[dict] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"{path}:{i}: skipping bad row ({e})", file=sys.stderr)
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+    return rows
+
+
+def load_goodput(logdir: str) -> list[dict]:
+    path = os.path.join(logdir, "goodput.json")
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"{path}: unreadable ({e})", file=sys.stderr)
+        return []
+    gens = doc.get("generations") if isinstance(doc, dict) else None
+    return [g for g in (gens or []) if isinstance(g, dict)]
+
+
+def _num(v) -> float | None:
+    if isinstance(v, str):
+        v = _NONFINITE.get(v)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def _meta(events: list, pid: int, name: str, sort: int) -> None:
+    events.append({"ph": "M", "pid": pid, "name": "process_name",
+                   "args": {"name": name}})
+    events.append({"ph": "M", "pid": pid, "name": "process_sort_index",
+                   "args": {"sort_index": sort}})
+
+
+def _emit_span_tree(events: list, span: dict, t0_us: float,
+                    start_us: float, tid: int) -> float:
+    """Emit one span and its children (laid sequentially from the span's
+    start); returns the span's end in us."""
+    dur_us = max(_num(span.get("dur_s")) or 0.0, 0.0) * 1e6
+    events.append({
+        "ph": "X", "pid": PID_SPANS, "tid": tid,
+        "name": str(span.get("name", "?")),
+        "ts": round(start_us - t0_us, 3), "dur": round(dur_us, 3),
+    })
+    child_t = start_us
+    for child in span.get("children") or []:
+        if isinstance(child, dict):
+            child_t = _emit_span_tree(events, child, t0_us, child_t, tid)
+    return start_us + dur_us
+
+
+def build_timeline(logdir: str) -> dict:
+    """The Chrome-trace document for ``logdir`` (see module docstring)."""
+    trace = load_jsonl(os.path.join(logdir, "trace.jsonl"))
+    flight = load_jsonl(os.path.join(logdir, "flight.jsonl"))
+    captures = load_jsonl(os.path.join(logdir, "captures.jsonl"))
+    gens = load_goodput(logdir)
+    if not (trace or flight or captures or gens):
+        raise SystemExit(
+            f"{logdir}: no telemetry streams (trace.jsonl / flight.jsonl / "
+            "captures.jsonl / goodput.json) — is this a logdir?"
+        )
+
+    # Absolute origin: the earliest timestamp any stream carries.
+    absolutes: list[float] = []
+    for e in flight:
+        t = _num(e.get("t"))
+        if t is not None:
+            absolutes.append(t)
+    for c in captures:
+        t = _num(c.get("t_begin"))
+        if t is not None:
+            absolutes.append(t)
+    for g in gens:
+        t = _num(g.get("start_t"))
+        if t is not None:
+            absolutes.append(t)
+    t0 = min(absolutes) if absolutes else 0.0
+    t0_us = t0 * 1e6
+
+    events: list[dict] = []
+    _meta(events, PID_SPANS, f"spans ({logdir}/trace.jsonl)", 0)
+    _meta(events, PID_FLIGHT, "flight events (flight.jsonl)", 1)
+    _meta(events, PID_CAPTURES, "captures (captures.jsonl)", 2)
+    _meta(events, PID_GOODPUT, "goodput generations (goodput.json)", 3)
+
+    # -- flight events: one lane per kind, instants ---------------------------
+    kind_tid: dict[str, int] = {}
+    for e in flight:
+        t = _num(e.get("t"))
+        if t is None:
+            continue
+        kind = str(e.get("kind", "?"))
+        tid = kind_tid.setdefault(kind, len(kind_tid) + 1)
+        args = {k: v for k, v in e.items()
+                if k not in ("t", "kind") and not isinstance(v, (list, dict))}
+        events.append({
+            "ph": "i", "s": "t", "pid": PID_FLIGHT, "tid": tid,
+            "name": kind, "ts": round(t * 1e6 - t0_us, 3), "args": args,
+        })
+    for kind, tid in kind_tid.items():
+        events.append({"ph": "M", "pid": PID_FLIGHT, "tid": tid,
+                       "name": "thread_name", "args": {"name": kind}})
+
+    # -- span rows: anchored to flight step events when possible --------------
+    step_anchor = {}
+    for e in flight:
+        if e.get("kind") == "step" and _num(e.get("t")) is not None \
+                and isinstance(e.get("step"), int):
+            step_anchor[e["step"]] = float(e["t"])
+    events.append({"ph": "M", "pid": PID_SPANS, "tid": 1,
+                   "name": "thread_name", "args": {"name": "step spans"}})
+    events.append({"ph": "M", "pid": PID_SPANS, "tid": 2,
+                   "name": "thread_name", "args": {"name": "trace events"}})
+    cursor_us = t0_us  # sequential fallback for un-anchored rows
+    for row in trace:
+        spans = row.get("spans")
+        if spans is None:
+            # out-of-band trace events (anomalies): instants on lane 2
+            events.append({
+                "ph": "i", "s": "t", "pid": PID_SPANS, "tid": 2,
+                "name": str(row.get("anomaly") or row.get("kind", "event")),
+                "ts": round(cursor_us - t0_us, 3),
+                "args": {k: v for k, v in row.items()
+                         if not isinstance(v, (list, dict))},
+            })
+            continue
+        durs = [max(_num(s.get("dur_s")) or 0.0, 0.0) for s in spans]
+        t_wall = _num(row.get("t_wall")) or sum(durs)
+        step = row.get("step")
+        anchor = step_anchor.get(step) if isinstance(step, int) else None
+        if anchor is not None:
+            # The flight `step` event fires right after the train_step
+            # span closes: place the row so its spans up to and including
+            # the first train_step end at the anchor.
+            pre = 0.0
+            for s, d in zip(spans, durs):
+                pre += d
+                if s.get("name") == "train_step":
+                    break
+            row_start_us = anchor * 1e6 - pre * 1e6
+        else:
+            row_start_us = cursor_us
+        child_t = row_start_us
+        for s in spans:
+            if isinstance(s, dict):
+                child_t = _emit_span_tree(events, s, t0_us, child_t, 1)
+        # the row umbrella (t_wall covers hook/bookkeeping time too)
+        if isinstance(step, int):
+            events.append({
+                "ph": "X", "pid": PID_SPANS, "tid": 1,
+                "name": f"step {step}",
+                "ts": round(row_start_us - t0_us, 3),
+                "dur": round(t_wall * 1e6, 3),
+                "args": {"step": step, "k": row.get("k", 1)},
+            })
+        cursor_us = row_start_us + t_wall * 1e6
+
+    # -- capture windows ------------------------------------------------------
+    events.append({"ph": "M", "pid": PID_CAPTURES, "tid": 1,
+                   "name": "thread_name", "args": {"name": "profiler"}})
+    for c in captures:
+        tb, te = _num(c.get("t_begin")), _num(c.get("t_end"))
+        if tb is None:
+            continue
+        dur = max((te - tb) if te is not None else 0.0, 0.0)
+        label = f"capture {c.get('id', '?')}: {c.get('trigger', '?')}"
+        if c.get("aborted"):
+            label += " (aborted)"
+        events.append({
+            "ph": "X", "pid": PID_CAPTURES, "tid": 1, "name": label,
+            "ts": round(tb * 1e6 - t0_us, 3), "dur": round(dur * 1e6, 3),
+            "args": {k: v for k, v in c.items()
+                     if not isinstance(v, (list, dict))},
+        })
+
+    # -- goodput generations (+ restart gaps) ---------------------------------
+    events.append({"ph": "M", "pid": PID_GOODPUT, "tid": 1,
+                   "name": "thread_name", "args": {"name": "generations"}})
+    for i, g in enumerate(gens):
+        start, last = _num(g.get("start_t")), _num(g.get("last_t"))
+        if start is None:
+            continue
+        dur = max((last - start) if last is not None else 0.0, 0.0)
+        ended = g.get("ended") or "died"
+        events.append({
+            "ph": "X", "pid": PID_GOODPUT, "tid": 1,
+            "name": f"gen {g.get('gen', i)} ({ended})",
+            "ts": round(start * 1e6 - t0_us, 3), "dur": round(dur * 1e6, 3),
+            "args": {"last_step": g.get("last_step"),
+                     "resumed_step": g.get("resumed_step"),
+                     "ended": g.get("ended")},
+        })
+        nxt = gens[i + 1] if i + 1 < len(gens) else None
+        if nxt is not None and last is not None \
+                and g.get("ended") != "clean":
+            nxt_start = _num(nxt.get("start_t"))
+            if nxt_start is not None and nxt_start > last:
+                events.append({
+                    "ph": "X", "pid": PID_GOODPUT, "tid": 1,
+                    "name": "badput_restart",
+                    "ts": round(last * 1e6 - t0_us, 3),
+                    "dur": round((nxt_start - last) * 1e6, 3),
+                })
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "logdir": logdir,
+            "origin_unix_s": t0,
+            "streams": {
+                "trace_rows": len(trace),
+                "flight_events": len(flight),
+                "captures": len(captures),
+                "goodput_generations": len(gens),
+            },
+        },
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("logdir", help="directory holding trace.jsonl / "
+                                  "flight.jsonl / captures.jsonl / "
+                                  "goodput.json (any subset)")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default <logdir>/timeline.json)")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.logdir):
+        print(f"timeline: {args.logdir}: not a directory", file=sys.stderr)
+        return 1
+    doc = build_timeline(args.logdir)
+    out = args.out or os.path.join(args.logdir, "timeline.json")
+    with open(out, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    n = doc["otherData"]["streams"]
+    print(
+        f"timeline: {len(doc['traceEvents'])} events "
+        f"({n['trace_rows']} span rows, {n['flight_events']} flight, "
+        f"{n['captures']} captures, {n['goodput_generations']} "
+        f"generations) -> {out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

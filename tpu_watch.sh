@@ -54,6 +54,20 @@ cache_stat() {
 
 log() { echo "$(date -Is) watcher: $*" >> "$LOG"; }
 
+# tail_streams <logdir>: land the run's last reactive-profiler manifest
+# rows and flight-recorder events in the watch log, so a window that dies
+# right after a train item still leaves its "what was the run doing"
+# breadcrumbs (captures.jsonl rows name the profile dirs to pull).
+tail_streams() {
+  local d="$1" f
+  for f in "$d"/captures.jsonl "$d"/flight.jsonl; do
+    if [ -f "$f" ]; then
+      echo "--- tail $f" >> "$LOG"
+      tail -n 8 "$f" >> "$LOG" 2>/dev/null
+    fi
+  done
+}
+
 probe() {
   BENCH_PROBE_RETRIES=1 BENCH_DEVICE_TIMEOUT_S=120 timeout 150 \
     python -c "from bench_probe import probe_devices; import sys; sys.exit(0 if probe_devices('watch') else 1)" \
@@ -117,15 +131,21 @@ while true; do
     run lm_xla_cb16   600 env BENCH_LM_BATCH=16 BENCH_LM_ATTN=xla BENCH_LM_XENT=chunked_bf16 python bench_lm.py \
       || { probe || break; }
     # -- p3: TPU convergence artifact (missing #3; gate via the CLI) -----
+    # --flight-recorder/--auto-profile: the run leaves flight.jsonl +
+    # (on any step-time regression) captures/ evidence in the same
+    # ARTIFACTS dir the schema gate sweeps; tail_streams lands the
+    # breadcrumbs in the watch log either way.
     if [ ! -f "$STAMPS/conv_tpu" ]; then
       if timeout 900 python train.py --workload mnist_lenet --steps 600 \
           --eval-every 100 --target-metric accuracy --target-value 0.97 \
+          --flight-recorder --auto-profile \
           --logdir ARTIFACTS/convergence_mnist_tpu --log-every 100 >> "$LOG" 2>&1; then
         touch "$STAMPS/conv_tpu" ARTIFACTS/convergence_mnist_tpu/.done
         log "item conv_tpu: LANDED"
       else
         log "item conv_tpu: failed"; probe || break
       fi
+      tail_streams ARTIFACTS/convergence_mnist_tpu
     fi
     # -- p2: headline refresh (non-LM benches are Pallas-free) -----------
     run resnet        900 python bench.py            || { probe || break; }
@@ -210,18 +230,23 @@ while true; do
         || { probe || break; }
       run attn_16k32k 1200 env BENCH_ATTN_SEQS=16384,32768 python bench_attn.py \
         || { probe || break; }
-      # Fresh profile of the current default step (the instrument).
+      # Fresh profile of the current default step (the instrument).  The
+      # static window now routes through the CaptureEngine; --logdir +
+      # --flight-recorder add the captures.jsonl manifest row and the
+      # capture_begin/capture_end flight breadcrumbs next to the trace.
       if [ ! -f "$STAMPS/profile_lm" ]; then
         if timeout 900 python train.py --workload gpt_lm --steps 25 \
             --batch-size 16 --seq-len 1024 --remat off \
             --profile-dir BENCH_RESULTS/profile_lm_tpu --profile-start 8 \
-            --profile-steps 5 --log-every 10 >> "$LOG" 2>&1 \
+            --profile-steps 5 --log-every 10 --flight-recorder \
+            --logdir BENCH_RESULTS/profile_lm_tpu_run >> "$LOG" 2>&1 \
             && find BENCH_RESULTS/profile_lm_tpu -name '*.xplane.pb' | grep -q .; then
           touch "$STAMPS/profile_lm"; log "item profile_lm: LANDED"
         else
           rm -rf BENCH_RESULTS/profile_lm_tpu
           log "item profile_lm: failed"; probe || break
         fi
+        tail_streams BENCH_RESULTS/profile_lm_tpu_run
       fi
     else
       log "pallas canary FAILED — skipping Pallas rows this window"

@@ -535,7 +535,23 @@ def main() -> None:
     p.add_argument("--profile-start", type=int, default=10,
                    help="steps into this run before the trace window opens")
     p.add_argument("--profile-steps", type=int, default=5,
-                   help="number of steps to trace")
+                   help="number of steps to trace (also the window length "
+                        "of --auto-profile and POST /profilez captures)")
+    p.add_argument("--auto-profile", action="store_true",
+                   help="reactive profiling: capture a jax.profiler window "
+                        "of the next --profile-steps steps the moment the "
+                        "anomaly detector flags a step-time regression (or, "
+                        "multi-host, the t_step spread blows up); captures "
+                        "land in <logdir>/captures/<id>/ with a manifest "
+                        "row in <logdir>/captures.jsonl")
+    p.add_argument("--max-captures", type=int, default=8,
+                   help="per-run budget of reactive/on-demand profiler "
+                        "captures (--auto-profile, POST /profilez); the "
+                        "static --profile-dir window is exempt")
+    p.add_argument("--capture-cooldown", type=float, default=120.0,
+                   help="seconds between triggered captures (repeat "
+                        "anomalies within the cooldown don't re-capture; "
+                        "POST /profilez skips it)")
     p.add_argument("--watchdog-timeout", type=float, default=0.0,
                    help="dump all stacks if no step completes for N seconds")
     p.add_argument("--status-port", type=int, default=None, metavar="PORT",
@@ -930,6 +946,9 @@ def main() -> None:
             profile_dir=args.profile_dir,
             profile_start=args.profile_start,
             profile_steps=args.profile_steps,
+            auto_profile=args.auto_profile,
+            max_captures=args.max_captures,
+            capture_cooldown_s=args.capture_cooldown,
             watchdog_timeout=args.watchdog_timeout,
             target_metric=args.target_metric,
             target_value=args.target_value,
