@@ -1,4 +1,5 @@
-"""Checkpoint/resume (SURVEY.md §5.4)."""
+"""Checkpoint/resume (SURVEY.md §5.4) + integrity verification."""
 
+from .integrity import CheckpointCorruptError  # noqa: F401
 from .manager import CheckpointManager  # noqa: F401
 from .preemption import PreemptionHandler  # noqa: F401

@@ -171,6 +171,14 @@ class PreemptionHandler:
         if self._on_exit is not None:
             self._on_exit()
 
+    def reset(self) -> None:
+        """Re-arm after a supervised in-process resume (resilience): the
+        consumed notice — a synthetic/chaos preemption whose launcher-kill
+        never came — must not make every later ``should_save`` fire."""
+        self._flag.clear()
+        self._pending = None
+        self._recorded = False
+
     def uninstall(self) -> None:
         for sig, prev in self._installed:
             signal.signal(sig, prev)

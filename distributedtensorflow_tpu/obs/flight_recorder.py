@@ -33,7 +33,13 @@ strings).  Kinds emitted by this repo: ``step``, ``log``, ``eval``,
 matching ``compile`` = wedged in XLA compilation, not a collective),
 ``capture_begin``/``capture_end`` (reactive-profiler windows —
 ``obs.capture``), ``coordinator_retry``, ``coordinator_failure``,
-``fit_begin``, ``fit_end``.
+``worker_respawn`` (a process-backed coordinator worker died and was
+respawned — ``parallel.coordinator``), ``checkpoint_corrupt`` (a restore
+rejected a truncated/corrupt checkpoint and fell back —
+``checkpoint.manager``), ``fault`` (chaos-injected fault, mirrored from
+``faults.jsonl`` — ``resilience.chaos``), ``restart`` /
+``supervisor_giving_up`` (supervised in-process restarts —
+``resilience.supervisor``), ``fit_begin``, ``fit_end``.
 
 The hot path is one ``time.time()`` + one deque append under a lock; dumps
 rewrite the whole file atomically (tmp + rename) so a reader — or the

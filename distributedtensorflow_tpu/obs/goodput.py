@@ -96,6 +96,7 @@ __all__ = [
     "merge_generations",
     "note_checkpoint",
     "note_event",
+    "note_restart",
     "note_restore",
 ]
 
@@ -334,6 +335,26 @@ class GoodputLedger:
         with self._lock:
             self._resumed_step = int(step)
 
+    def note_restart(self, seconds: float) -> None:
+        """An IN-PROCESS supervised restart (resilience.Supervisor): book
+        the failure→re-entry window (classification + backoff + restore
+        already books separately via its span) into ``badput_restart``.
+
+        Same bucket the cross-process merge uses for the heartbeat→restart
+        gap — one number answers "what did restarts cost", however the
+        restart happened.  Attributed like span seconds, so the derived
+        ``other`` residual shrinks by the same amount and the generation's
+        buckets still sum to its wall time.
+        """
+        s = max(float(seconds), 0.0)
+        if not s:
+            return
+        with self._lock:
+            self._buckets["badput_restart"] = (
+                self._buckets.get("badput_restart", 0.0) + s
+            )
+            self._attr_total += s
+
     def note_event(self, kind: str) -> None:
         """Flight-event tap: stamps the preemption-drain window and counts
         low-rate event kinds per generation."""
@@ -518,6 +539,13 @@ def note_event(kind: str) -> None:
     led = _default
     if led is not None:
         led.note_event(kind)
+
+
+def note_restart(seconds: float) -> None:
+    """Deep-layer hook (resilience.Supervisor): no-op when no ledger."""
+    led = _default
+    if led is not None:
+        led.note_restart(seconds)
 
 
 def _observe_root(span) -> None:

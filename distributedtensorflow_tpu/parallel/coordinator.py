@@ -65,6 +65,12 @@ _M_WASTED_S = obs.histogram(
     "failure (host-side badput; the goodput report counts the matching "
     "coordinator_retry/failure flight events per generation)",
 )
+_M_RESPAWNS = obs.counter(
+    "worker_respawns_total",
+    "process-backed worker respawns after a worker death, by worker id "
+    "(a climbing single-worker rate = a crash-looping worker approaching "
+    "its respawn budget)",
+)
 
 T = TypeVar("T")
 
@@ -289,12 +295,38 @@ class _SubprocessExecutor:
     coordinator's re-queue path expects — and the executor respawns for the
     next closure.  Closures and their resolved args must be picklable
     (module-level functions; no PerWorker iterators).
+
+    Respawns are BOUNDED (resilience satellite): a crash-looping worker —
+    e.g. one whose host is out of memory, where every fresh process dies
+    the same death — used to respawn forever at full speed.  A death now
+    *schedules* the respawn behind an exponentially-backed-off deadline
+    (``respawn_backoff_s`` base, doubling, clamped at
+    ``respawn_backoff_max_s``); the actual spawn happens lazily at the
+    next :meth:`execute` past the deadline, and executes arriving during
+    the backoff fail fast with :class:`WorkerUnavailableError` — the
+    dying worker must never stall the retry path that re-queues its
+    closure onto healthy workers (nobody sleeps holding the executor
+    lock).  Each scheduled respawn emits a ``worker_respawn`` flight
+    event plus ``worker_respawns_total{worker=}``; after ``max_respawns``
+    the executor goes permanently dead and its closures keep failing
+    fast onto the surviving workers.
     """
 
-    def __init__(self, worker_id: int):
+    def __init__(self, worker_id: int, *, max_respawns: int = 8,
+                 respawn_backoff_s: float = 0.5,
+                 respawn_backoff_max_s: float = 30.0):
         self.worker_id = worker_id
         self._ctx = mp.get_context("spawn")
         self._lock = threading.Lock()
+        self._max_respawns = max(0, int(max_respawns))
+        self._backoff_s = max(0.0, float(respawn_backoff_s))
+        self._backoff_max_s = max(0.0, float(respawn_backoff_max_s))
+        self.respawns = 0
+        self.last_backoff_s = 0.0
+        self._dead = False
+        #: monotonic deadline of a scheduled-but-not-yet-performed respawn
+        #: (None = a live process exists).
+        self._spawn_not_before: float | None = None
         self._spawn()
 
     def _spawn(self) -> None:
@@ -310,8 +342,38 @@ class _SubprocessExecutor:
     def pid(self) -> int:
         return self._proc.pid
 
+    def backoff_remaining(self) -> float | None:
+        """Seconds until this executor may respawn (0.0 = ready), or None
+        when it is permanently dead.  Lock-free on purpose: the dispatch
+        thread polls this while another thread may hold the executor lock
+        inside a long closure; plain attribute reads are safe and a stale
+        answer only shifts a pop by one poll."""
+        if self._dead:
+            return None
+        t = self._spawn_not_before
+        if t is None:
+            return 0.0
+        return max(t - time.monotonic(), 0.0)
+
     def execute(self, fn, args, kwargs):
         with self._lock:
+            if self._dead:
+                raise WorkerUnavailableError(
+                    f"worker process {self.worker_id} is dead (respawn "
+                    f"budget of {self._max_respawns} exhausted)"
+                )
+            if self._spawn_not_before is not None:
+                # A death scheduled a respawn: spawn once the backoff
+                # deadline passes; until then fail fast so the closure
+                # re-queues onto a healthy worker immediately.
+                if time.monotonic() < self._spawn_not_before:
+                    raise WorkerUnavailableError(
+                        f"worker process {self.worker_id} is respawning "
+                        f"(backoff {self.last_backoff_s:.2f}s after death "
+                        f"{self.respawns}/{self._max_respawns})"
+                    )
+                self._spawn_not_before = None
+                self._spawn()
             try:
                 self._conn.send((fn, args, kwargs))
                 status, payload = self._conn.recv()
@@ -325,6 +387,10 @@ class _SubprocessExecutor:
         return payload
 
     def _respawn(self) -> None:
+        """Reap the dead process and SCHEDULE its replacement (or go
+        permanently dead past the budget).  Never sleeps, never spawns —
+        both would stall the caller's failure path, which healthy workers
+        are waiting on to pick up the re-queued closure."""
         try:
             self._conn.close()
         except OSError:
@@ -332,7 +398,30 @@ class _SubprocessExecutor:
         if self._proc.is_alive():
             self._proc.kill()
         self._proc.join(timeout=5)
-        self._spawn()
+        if self.respawns >= self._max_respawns:
+            self._dead = True
+            logger.error(
+                "worker %d exhausted its respawn budget (%d); leaving it "
+                "dead — closures re-queue onto surviving workers",
+                self.worker_id, self._max_respawns,
+            )
+            return
+        self.respawns += 1
+        _M_RESPAWNS.inc(worker=str(self.worker_id))
+        obs.record_event(
+            "worker_respawn", worker=self.worker_id, respawn=self.respawns,
+            budget=self._max_respawns,
+        )
+        self.last_backoff_s = min(
+            self._backoff_s * (2 ** (self.respawns - 1)),
+            self._backoff_max_s,
+        )
+        self._spawn_not_before = time.monotonic() + self.last_backoff_s
+        logger.warning(
+            "worker %d death %d/%d: respawn scheduled in %.2fs",
+            self.worker_id, self.respawns, self._max_respawns,
+            self.last_backoff_s,
+        )
 
     def kill(self) -> None:
         """Fault injection: SIGKILL the worker process."""
@@ -375,6 +464,24 @@ class _Worker(threading.Thread):
     def run(self) -> None:
         queue = self._coord._queue
         while not self._coord._stopping.is_set():
+            executor_state = self._coord._executor_for(self.worker_id)
+            if executor_state is not None:
+                rem = executor_state.backoff_remaining()
+                if rem is None:
+                    # Permanently dead executor: de-prioritize hard so
+                    # surviving workers win every pop; if NO survivor
+                    # exists the pop below still fails closures fast
+                    # enough (bounded by max_retries) to surface the
+                    # error instead of hanging the queue.
+                    time.sleep(0.2)
+                elif rem > 0:
+                    # Respawn backoff window: do not pop AT ALL — a
+                    # popped closure would insta-fail back into the
+                    # queue, burning its retry budget against a worker
+                    # that is known-down (healthy workers pick it up
+                    # instead).
+                    time.sleep(min(rem, 0.1))
+                    continue
             closure = queue.get()
             if closure is None:
                 continue
@@ -470,11 +577,17 @@ class Coordinator:
         retryable_exceptions: tuple[type[BaseException], ...] = (),
         max_retries: int = 16,
         use_processes: bool = False,
+        max_respawns: int = 8,
+        respawn_backoff_s: float = 0.5,
+        respawn_backoff_max_s: float = 30.0,
     ):
         """``use_processes=True`` backs each worker with a real OS process
         (the reference's remote-worker isolation): closures run out-of-
         process, a killed/crashed worker transparently re-queues its
-        closure, and the pool respawns the process.  Requires picklable
+        closure, and the pool respawns the process — at most
+        ``max_respawns`` times per worker, with exponential backoff
+        (``respawn_backoff_s`` base, ``respawn_backoff_max_s`` clamp), so a
+        crash-looping worker cannot fork-bomb the host.  Requires picklable
         closures/args; PerWorker values stay thread-mode only.
         """
         if num_workers < 1:
@@ -486,7 +599,14 @@ class Coordinator:
         self._failed_workers: set[int] = set()
         self._failed_lock = threading.Lock()
         self._executors: list[_SubprocessExecutor] | None = (
-            [_SubprocessExecutor(i) for i in range(num_workers)]
+            [
+                _SubprocessExecutor(
+                    i, max_respawns=max_respawns,
+                    respawn_backoff_s=respawn_backoff_s,
+                    respawn_backoff_max_s=respawn_backoff_max_s,
+                )
+                for i in range(num_workers)
+            ]
             if use_processes
             else None
         )

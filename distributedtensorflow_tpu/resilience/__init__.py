@@ -1,0 +1,32 @@
+"""Self-healing training (resilience tentpole, PR 5).
+
+Three cooperating layers turn the observability stack (PRs 1–4) into a
+closed recovery loop:
+
+- **checkpoint integrity** (``checkpoint/integrity.py`` +
+  ``CheckpointManager``): per-array checksum manifests at save;
+  ``restore_latest`` verifies and transparently falls back past
+  truncated/corrupt checkpoints;
+- **supervision** (:mod:`.supervisor`): bounded-retry/exponential-backoff
+  restarts around ``Trainer.fit`` — classify, restore from the last
+  verified checkpoint, re-enter, escalate to a clean non-zero exit when
+  the budget runs out;
+- **fault injection** (:mod:`.chaos`): deterministic fault plans
+  (``train.py --fault-plan``) that exercise the whole stack on CPU in CI,
+  logging every injection/recovery pair to ``<logdir>/faults.jsonl``.
+"""
+
+from .chaos import (  # noqa: F401
+    FAULT_KINDS,
+    ChaosInjector,
+    DataStallFault,
+    FaultPlan,
+    InjectedFault,
+    WorkerKilledFault,
+)
+from .supervisor import (  # noqa: F401
+    RestartBudgetExhausted,
+    Supervisor,
+    SupervisorConfig,
+    classify_failure,
+)

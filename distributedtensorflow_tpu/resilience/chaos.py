@@ -1,0 +1,428 @@
+"""Deterministic fault injection: make the recovery stack testable on CPU.
+
+None of the recovery paths built since PR 1 — restore fallback, preemption
+save, worker respawn, supervised restart — mean anything until they have
+been *exercised under real faults*, and waiting for production to supply
+the faults means debugging them at 3am on a pod.  This module injects them
+on demand, deterministically, from a JSON *fault plan*
+(``train.py --fault-plan``)::
+
+    {"faults": [
+      {"step": 35,  "kind": "worker_kill"},
+      {"step": 45,  "kind": "checkpoint_truncate"},
+      {"step": 70,  "kind": "nan_loss"},
+      {"step": 100, "kind": "data_stall", "stall_s": 0.1},
+      {"step": 110, "kind": "preemption"}
+    ]}
+
+(a bare JSON list of fault objects is accepted too).  Fault kinds:
+
+``nan_loss``
+    The wrapped train step reports a NaN loss at the trigger step; the
+    streaming AnomalyDetector flags it at the next log boundary and the
+    Supervisor's watch callback turns it into a restart from a checkpoint
+    *before* the poisoned step.
+``checkpoint_truncate``
+    The first checkpoint save at/after the trigger step is truncated on
+    disk post-commit (the torn-write storage fault), so the next
+    ``restore_latest`` must reject it and fall back to an older verified
+    step.
+``worker_kill``
+    SIGKILLs a process-backed coordinator worker when one is attached
+    (:meth:`ChaosInjector.attach_coordinator` — exercising the bounded
+    respawn path), then raises :class:`WorkerKilledFault` out of the fit:
+    sync SPMD training treats worker loss as fatal, and recovery is the
+    supervisor's restore-and-restart.
+``data_stall``
+    Blocks the fit loop for ``stall_s`` seconds at the trigger step (long
+    enough and the hang watchdog fires mid-stall), then raises
+    :class:`DataStallFault` — the dead-input-pipeline failure.
+``preemption``
+    Calls ``PreemptionHandler.trigger()`` (attach via
+    :meth:`attach_preemption`): the trainer's own consistent-save path
+    runs and the fit exits preempted; the supervisor resumes it.
+
+Every injection and recovery is appended to ``<logdir>/faults.jsonl``
+(one JSON object per line, ``t`` non-decreasing)::
+
+    {"t": ..., "id": 0, "step": 35, "kind": "worker_kill",
+     "phase": "injected"}
+    {"t": ..., "id": 0, "step": 35, "kind": "worker_kill",
+     "phase": "recovered", "resumed_step": 20, "attempt": 1}
+
+``id`` is the injection index (strictly increasing across injected rows;
+injected steps non-decreasing), and a healthy run pairs every injected
+``id`` with a recovered row — ``tools/check_metrics_schema.py`` enforces
+exactly that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any
+
+from .. import obs
+from ..train.trainer import Callback
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+__all__ = [
+    "FAULT_KINDS",
+    "ChaosInjector",
+    "DataStallFault",
+    "FaultPlan",
+    "InjectedFault",
+    "WorkerKilledFault",
+]
+
+#: The known fault kinds (duplicated stdlib-side in
+#: tools/check_metrics_schema.py FAULT_KINDS — keep in sync).
+FAULT_KINDS = (
+    "nan_loss",
+    "checkpoint_truncate",
+    "worker_kill",
+    "data_stall",
+    "preemption",
+)
+
+_M_INJECTED = obs.counter(
+    "faults_injected_total", "chaos faults injected, by kind"
+)
+_M_RECOVERED = obs.counter(
+    "faults_recovered_total", "chaos faults recovered from, by kind"
+)
+
+
+class InjectedFault(RuntimeError):
+    """Base of chaos-raised failures; ``kind`` drives the supervisor's
+    classification and ``fault_id`` pairs the recovery row."""
+
+    kind = "injected"
+
+    def __init__(self, message: str, *, fault_id: int, step: int):
+        super().__init__(message)
+        self.fault_id = fault_id
+        self.step = step
+
+
+class WorkerKilledFault(InjectedFault):
+    kind = "worker_kill"
+
+
+class DataStallFault(InjectedFault):
+    kind = "data_stall"
+
+
+@dataclasses.dataclass
+class _Fault:
+    id: int
+    step: int
+    kind: str
+    params: dict
+    injected: bool = False
+    recovered: bool = False
+    #: The step the injection actually fired at (>= the plan's trigger
+    #: step); recovery rows echo it so a pair shares one step.
+    injected_step: int | None = None
+    #: checkpoint_truncate: the step of the save actually truncated.
+    detail_step: int | None = None
+
+
+class FaultPlan:
+    """A validated, step-sorted list of fault triggers."""
+
+    def __init__(self, faults: list[dict]):
+        parsed: list[_Fault] = []
+        for i, f in enumerate(faults):
+            if not isinstance(f, dict):
+                raise ValueError(f"fault[{i}]: not an object: {f!r}")
+            kind = f.get("kind")
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"fault[{i}]: unknown kind {kind!r} "
+                    f"(known: {', '.join(FAULT_KINDS)})"
+                )
+            step = f.get("step")
+            if not isinstance(step, int) or isinstance(step, bool) \
+                    or step < 0:
+                raise ValueError(
+                    f"fault[{i}]: step {step!r} is not a non-negative int"
+                )
+            params = {k: v for k, v in f.items() if k not in ("kind", "step")}
+            parsed.append(_Fault(id=i, step=int(step), kind=kind,
+                                 params=params))
+        parsed.sort(key=lambda f: (f.step, f.id))
+        # Re-id in trigger order so injected ids are strictly increasing.
+        for i, f in enumerate(parsed):
+            f.id = i
+        self.faults = parsed
+
+    @classmethod
+    def load(cls, path: str) -> "FaultPlan":
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict):
+            doc = doc.get("faults")
+        if not isinstance(doc, list):
+            raise ValueError(
+                f"{path}: expected a JSON list of faults or an object "
+                "with a 'faults' list"
+            )
+        return cls(doc)
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+
+class ChaosInjector(Callback):
+    """Executes a :class:`FaultPlan` against a run and logs
+    ``faults.jsonl``.
+
+    Wiring (train.py does all of this under ``--fault-plan``):
+
+    - append the injector itself to the Trainer's callbacks (it is a
+      :class:`~..train.trainer.Callback`; ``on_step_end`` fires the
+      worker-kill / data-stall / preemption triggers);
+    - ``train_step = injector.wrap_train_step(train_step)`` for NaN
+      injection (adds one host sync of ``state.step`` per dispatch —
+      chaos mode is a test harness, not a production path);
+    - ``checkpointer = injector.wrap_checkpointer(checkpointer)`` for
+      post-commit truncation;
+    - :meth:`attach_preemption` / :meth:`attach_coordinator` for the
+      signal-shaped faults.
+
+    The Supervisor closes the loop: :meth:`mark_recovered` after each
+    successful restart writes the paired ``recovered`` rows.
+    """
+
+    def __init__(self, plan: FaultPlan, logdir: str | None = None):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._path = (
+            os.path.join(logdir, "faults.jsonl") if logdir else None
+        )
+        self._preemption = None
+        self._coordinator = None
+        if self._path:
+            os.makedirs(logdir, exist_ok=True)
+            # Truncate a prior run's log: the plan restarts from scratch.
+            open(self._path, "w").close()
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach_preemption(self, handler) -> None:
+        """The PreemptionHandler ``preemption`` faults trigger()."""
+        self._preemption = handler
+
+    def attach_coordinator(self, coord) -> None:
+        """A process-backed Coordinator whose worker 0 ``worker_kill``
+        faults SIGKILL (optional — without one the fault only raises)."""
+        self._coordinator = coord
+
+    def wrap_train_step(self, train_step):
+        """NaN-loss injection: at the trigger step the returned metrics
+        report a NaN loss (the state itself is untouched — the detection
+        and recovery machinery downstream is what is under test)."""
+        import jax.numpy as jnp  # noqa: PLC0415
+
+        def chaotic_step(state, batch, rng):
+            step_before = int(state.step)
+            new_state, metrics = train_step(state, batch, rng)
+            fault = self._pending("nan_loss", step_before + 1)
+            if fault is not None and "loss" in metrics:
+                self._inject(fault, at_step=step_before + 1)
+                metrics = dict(
+                    metrics,
+                    loss=jnp.full_like(
+                        jnp.asarray(metrics["loss"]), jnp.nan
+                    ),
+                )
+            return new_state, metrics
+
+        return chaotic_step
+
+    def wrap_checkpointer(self, manager):
+        """Proxy whose ``save`` truncates the on-disk checkpoint when a
+        ``checkpoint_truncate`` fault has come due."""
+        return _ChaosCheckpointer(manager, self)
+
+    # -- Callback hooks (worker_kill / data_stall / preemption) --------------
+
+    def on_step_end(self, trainer, step: int, state, metrics) -> None:
+        fault = self._pending("preemption", step)
+        if fault is not None:
+            self._inject(fault, at_step=step)
+            if self._preemption is not None:
+                self._preemption.trigger()
+            else:
+                logger.error(
+                    "chaos: preemption fault at step %d but no handler "
+                    "attached; fault is a no-op", step,
+                )
+        fault = self._pending("data_stall", step)
+        if fault is not None:
+            stall_s = float(fault.params.get("stall_s", 0.0))
+            self._inject(fault, at_step=step, stall_s=stall_s)
+            if stall_s > 0:
+                # The fit loop stops making progress right here — a long
+                # enough stall fires the hang watchdog mid-sleep.
+                time.sleep(stall_s)
+            raise DataStallFault(
+                f"chaos: input pipeline stalled at step {step}",
+                fault_id=fault.id, step=step,
+            )
+        fault = self._pending("worker_kill", step)
+        if fault is not None:
+            self._inject(fault, at_step=step)
+            if self._coordinator is not None:
+                try:
+                    self._coordinator.kill_worker_process(
+                        int(fault.params.get("worker", 0))
+                    )
+                except Exception:
+                    logger.exception("chaos: coordinator worker kill failed")
+            raise WorkerKilledFault(
+                f"chaos: worker killed at step {step}",
+                fault_id=fault.id, step=step,
+            )
+
+    # -- recovery bookkeeping (called by the Supervisor) ---------------------
+
+    def mark_recovered(self, *, resumed_step: int, attempt: int,
+                       rejected_steps: list[int] | None = None) -> int:
+        """Write ``recovered`` rows for every injected-but-unrecovered
+        fault this restart resolves: the restart-shaped kinds always; a
+        ``checkpoint_truncate`` only once a fallback restore actually
+        rejected its truncated step (``rejected_steps``).  Returns the
+        number of rows written."""
+        rejected = set(rejected_steps or ())
+        n = 0
+        with self._lock:
+            for f in self.plan.faults:
+                if not f.injected or f.recovered:
+                    continue
+                if f.kind == "checkpoint_truncate":
+                    if f.detail_step not in rejected:
+                        continue
+                f.recovered = True
+                n += 1
+                _M_RECOVERED.inc(kind=f.kind)
+                self._write({
+                    "t": time.time(), "id": f.id,
+                    "step": (f.injected_step if f.injected_step is not None
+                             else f.step),
+                    "kind": f.kind, "phase": "recovered",
+                    "resumed_step": int(resumed_step),
+                    "attempt": int(attempt),
+                })
+        return n
+
+    def unrecovered(self) -> list[dict]:
+        """Injected faults still awaiting a recovery row (a non-empty
+        answer at run end = the run did not actually self-heal)."""
+        with self._lock:
+            return [
+                {"id": f.id, "step": f.step, "kind": f.kind}
+                for f in self.plan.faults
+                if f.injected and not f.recovered
+            ]
+
+    # -- internals -----------------------------------------------------------
+
+    def _pending(self, kind: str, step: int) -> _Fault | None:
+        """The first uninjected fault of ``kind`` whose trigger step has
+        come (<= step), or None."""
+        with self._lock:
+            for f in self.plan.faults:
+                if f.kind == kind and not f.injected and f.step <= step:
+                    return f
+        return None
+
+    def _inject(self, fault: _Fault, *, at_step: int, **fields) -> None:
+        with self._lock:
+            if fault.injected:
+                return
+            fault.injected = True
+            fault.injected_step = int(at_step)
+            _M_INJECTED.inc(kind=fault.kind)
+            row = {
+                "t": time.time(), "id": fault.id, "step": int(at_step),
+                "kind": fault.kind, "phase": "injected",
+            }
+            row.update(fields)
+            self._write(row)
+        logger.warning(
+            "chaos: injected %s (fault #%d) at step %d",
+            fault.kind, fault.id, at_step,
+        )
+        obs.record_event(
+            "fault", step=int(at_step), fault=fault.kind, phase="injected",
+            id=fault.id,
+        )
+
+    def _note_truncated(self, fault: _Fault, save_step: int) -> None:
+        with self._lock:
+            fault.detail_step = int(save_step)
+
+    def _write(self, row: dict[str, Any]) -> None:
+        """Append one faults.jsonl line (caller holds the lock); a write
+        failure must never escalate an injected fault into a crash."""
+        if self._path is None:
+            return
+        try:
+            with open(self._path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            logger.exception("chaos: faults.jsonl append failed")
+
+
+class _ChaosCheckpointer:
+    """CheckpointManager proxy that truncates the bytes of a just-saved
+    step when a ``checkpoint_truncate`` fault is due — the torn-write
+    storage fault, injected at the exact layer it happens in production."""
+
+    def __init__(self, manager, injector: ChaosInjector):
+        self._manager = manager
+        self._injector = injector
+
+    def save(self, step: int, state, **kwargs) -> bool:
+        saved = self._manager.save(step, state, **kwargs)
+        if saved:
+            fault = self._injector._pending("checkpoint_truncate", step)
+            if fault is not None:
+                self._manager.wait()  # the bytes must be on disk to tear
+                self._injector._inject(fault, at_step=step,
+                                       truncated_step=step)
+                self._injector._note_truncated(fault, step)
+                self._truncate(step)
+        return saved
+
+    def _truncate(self, step: int) -> None:
+        directory = getattr(self._manager, "_directory", None)
+        if directory is None:
+            return
+        step_dir = os.path.join(directory, str(int(step)))
+        biggest, size = None, -1
+        for root, _dirs, files in os.walk(step_dir):
+            for name in files:
+                p = os.path.join(root, name)
+                s = os.path.getsize(p)
+                if s > size:
+                    biggest, size = p, s
+        if biggest is None:
+            logger.error("chaos: no files to truncate under %s", step_dir)
+            return
+        with open(biggest, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        logger.warning(
+            "chaos: truncated %s (%d -> %d bytes)", biggest, size,
+            max(size // 2, 1),
+        )
+
+    def __getattr__(self, name):
+        return getattr(self._manager, name)

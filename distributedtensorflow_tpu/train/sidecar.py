@@ -88,6 +88,9 @@ class SidecarEvaluator:
         """Evaluate until a stop condition; returns {step: metrics}."""
         last_evaluated = -1
         last_new_ckpt_t = time.monotonic()
+        # Deferred import (package-cycle hygiene: train <-> checkpoint).
+        from ..checkpoint.integrity import CheckpointCorruptError  # noqa: PLC0415
+
         try:
             while True:
                 # A live writer's finalize is multi-file: the step dir can
@@ -110,6 +113,15 @@ class SidecarEvaluator:
                     logger.info(
                         "sidecar: checkpoint not fully visible (%s); retry",
                         e,
+                    )
+                except CheckpointCorruptError as e:
+                    # A torn/corrupt checkpoint mid-poll is the same
+                    # "nothing evaluable yet" condition: the trainer may
+                    # still be writing, or a later poll will see a newer
+                    # good step — either way, bounded by idle_timeout_s.
+                    logger.warning(
+                        "sidecar: checkpoint step %s failed verification "
+                        "(%s); retry", step, e,
                     )
                 if state is not None:
                     self._evaluate_state(step, state)

@@ -205,6 +205,15 @@ class Trainer:
         self._preempted = False
         #: The fit's hang watchdog while a fit is running (health surface).
         self.watchdog = None
+        #: Whether the LAST fit's watchdog fired (the fit's ``finally``
+        #: nulls ``self.watchdog``, so post-fit failure classification —
+        #: resilience.classify_failure's data-stall-via-watchdog rule —
+        #: needs the flag to outlive the watchdog object).
+        self.watchdog_fired = False
+        #: Set by resilience.Supervisor while it owns this trainer:
+        #: {"restarts", "max_restarts", "last_failure", ...} — surfaced on
+        #: /statusz so a curl of a restarting run shows the retry budget.
+        self.supervisor_status: dict | None = None
         # Last log-boundary record + step — what /statusz and /healthz
         # report (plain dict reads under the GIL; handlers never sync).
         self._last_record: dict = {}
@@ -287,6 +296,7 @@ class Trainer:
         # A fresh fit clears a prior run's early-stop request (the Keras
         # Model.fit contract: stop_training resets on entry).
         self.stop_training = False
+        self.watchdog_fired = False
         self.meter.start()
         self._window_t0 = time.perf_counter()
         self._window_step0 = int(state.step)
@@ -327,6 +337,7 @@ class Trainer:
                     # instead of inflating that step's t_wall.
                     self.tracer.end_step()
                 if watchdog is not None:
+                    self.watchdog_fired = watchdog.fired
                     watchdog.stop()
                     self.watchdog = None
                 close = getattr(train_iter, "close", None)
@@ -406,6 +417,21 @@ class Trainer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    @property
+    def preempted(self) -> bool:
+        """Whether the last fit exited via the preemption save path."""
+        return self._preempted
+
+    def clear_preempted(self) -> None:
+        """Re-arm after a supervised in-process resume (the launcher-kill
+        never came — e.g. a synthetic/chaos preemption): the next fit must
+        not inherit the consumed notice."""
+        self._preempted = False
+        if self.preemption is not None:
+            reset = getattr(self.preemption, "reset", None)
+            if reset is not None:
+                reset()
 
     def _record_anomaly(self, anomaly) -> None:
         """Default anomaly sink: log, count, trace, flight-record, fan out
@@ -820,6 +846,8 @@ class Trainer:
                 "saves": self._ckpt_count,
                 "last_saved_step": self._last_ckpt_step,
             }
+        if self.supervisor_status:
+            out["supervisor"] = dict(self.supervisor_status)
         if self.capture is not None:
             cap_state = self.capture.state()
             out["captures"] = {

@@ -45,6 +45,7 @@ _PROCESS_TEST_FILES = {
     "test_combined_axes.py",
     "test_train_introspection_smoke.py",
     "test_train_auto_profile_smoke.py",
+    "test_train_chaos_smoke.py",
 }
 
 

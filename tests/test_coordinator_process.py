@@ -72,6 +72,32 @@ def test_app_error_from_child_reraised(coord):
         coord.join(timeout=60)
 
 
+def test_respawn_counts_and_stays_bounded():
+    """Real-process respawn accounting (resilience satellite): each kill
+    bumps the worker's respawn count, and the pool keeps serving."""
+    from distributedtensorflow_tpu import obs
+
+    ring = obs.FlightRecorder(64)
+    prev = obs.install_recorder(ring)
+    try:
+        with Coordinator(num_workers=2, use_processes=True,
+                         max_respawns=4, respawn_backoff_s=0.05,
+                         respawn_backoff_max_s=0.1) as c:
+            assert c.schedule(_pid, (1,)).fetch(timeout=60)[1] == 2
+            for _ in range(2):
+                c.kill_worker_process(0)
+                # the next closures land and complete despite the kill
+                rvs = [c.schedule(_pid, (i,)) for i in range(4)]
+                c.join(timeout=60)
+                assert sorted(rv.fetch()[1] for rv in rvs) == [0, 2, 4, 6]
+            respawned = [e for e in ring.events()
+                         if e["kind"] == "worker_respawn"]
+            assert respawned  # at least one respawn was recorded
+            assert all(e["budget"] == 4 for e in respawned)
+    finally:
+        obs.install_recorder(prev)
+
+
 def test_thread_mode_has_no_pids():
     with Coordinator(num_workers=2) as c:
         assert c.worker_pids() is None

@@ -1,0 +1,707 @@
+"""Resilience tests (PR 5 tentpole): checkpoint integrity + verified
+fallback, supervisor restart policy, chaos fault plans, faults.jsonl
+schema, goodput restart booking, bounded worker respawns.
+
+The end-to-end story (train.py --fault-plan under the Supervisor) runs in
+the slow lane (test_train_chaos_smoke.py); everything here is fast-lane:
+small states, fake trainers, stubbed executors.
+"""
+
+import json
+import os
+import pathlib
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflow_tpu import obs
+from distributedtensorflow_tpu.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+)
+from distributedtensorflow_tpu.checkpoint import integrity
+from distributedtensorflow_tpu.parallel.coordinator import (
+    WorkerUnavailableError,
+    _SubprocessExecutor,
+)
+from distributedtensorflow_tpu.resilience import (
+    ChaosInjector,
+    DataStallFault,
+    FaultPlan,
+    RestartBudgetExhausted,
+    Supervisor,
+    SupervisorConfig,
+    WorkerKilledFault,
+    classify_failure,
+)
+from distributedtensorflow_tpu.train import create_sharded_state
+from tools import check_metrics_schema
+
+
+# --- helpers ----------------------------------------------------------------
+
+
+def tiny_state(dp_mesh, seed=0):
+    """A deliberately small sharded TrainState (fast saves)."""
+    init_fn = lambda r: {
+        "params": {
+            "w": jax.random.normal(r, (16, 4), jnp.float32),
+            "b": jnp.zeros((4,), jnp.float32),
+        }
+    }
+    state, _ = create_sharded_state(
+        init_fn, optax.sgd(0.1), dp_mesh, jax.random.PRNGKey(seed)
+    )
+    return state
+
+
+def _corrupt_biggest_file(step_dir, mode):
+    """Flip bytes ('corrupt') or halve ('truncate') the step's OCDBT
+    array-payload files (``.../d/<hash>``) — the two torn-write shapes
+    storage actually produces, applied to the bytes restore must read."""
+    files = [p for p in pathlib.Path(step_dir).rglob("*")
+             if p.is_file() and p.parent.name == "d"]
+    assert files, f"no OCDBT data files under {step_dir}"
+    for f_path in files:
+        size = f_path.stat().st_size
+        if mode == "truncate":
+            with open(f_path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+        else:
+            data = bytearray(f_path.read_bytes())
+            for i in range(len(data)):
+                data[i] ^= 0xFF
+            f_path.write_bytes(bytes(data))
+
+
+def _verify_failures():
+    return obs.default_registry().scalars().get(
+        "checkpoint_verify_failures_total", 0.0
+    )
+
+
+@pytest.fixture()
+def flight_ring():
+    rec = obs.FlightRecorder(256)
+    prev = obs.install_recorder(rec)
+    yield rec
+    obs.install_recorder(prev)
+
+
+# --- checkpoint integrity + verified fallback -------------------------------
+
+
+def test_manifest_written_and_clean_restore_verifies(tmp_path, dp_mesh):
+    state = tiny_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    assert mgr.save(1, state, force=True)
+    mgr.save(2, state.replace(step=jnp.asarray(2)), force=True)
+    mgr.wait()
+    mdir = tmp_path / integrity.MANIFEST_DIRNAME
+    assert sorted(p.name for p in mdir.iterdir()) == ["1.json", "2.json"]
+    doc = json.loads((mdir / "2.json").read_text())
+    assert doc["step"] == 2
+    # every array leaf got a checksum record
+    assert any("params" in k and "w" in k for k in doc["arrays"])
+    restored = mgr.restore_latest(tiny_state(dp_mesh, seed=1))
+    assert int(restored.step) == 2
+    assert mgr.last_restore_report == {"restored_step": 2, "rejected": []}
+    mgr.close()
+
+
+@pytest.mark.parametrize("mode", ["corrupt", "truncate"])
+def test_restore_latest_falls_back_past_bad_latest(tmp_path, dp_mesh, mode,
+                                                   flight_ring):
+    state = tiny_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, state, force=True)
+    mgr.save(2, state.replace(step=jnp.asarray(2)), force=True)
+    mgr.wait()
+    _corrupt_biggest_file(tmp_path / "2", mode)
+    failures_before = _verify_failures()
+    restored = mgr.restore_latest(tiny_state(dp_mesh, seed=1))
+    assert restored is not None
+    # fell back to the older VERIFIED step (saved state had step=0 under
+    # checkpoint label 1 — the label is what the report speaks)
+    assert mgr.last_restore_report["restored_step"] == 1
+    assert [r["step"] for r in mgr.last_restore_report["rejected"]] == [2]
+    assert _verify_failures() == failures_before + 1
+    corrupt_events = [e for e in flight_ring.events()
+                     if e["kind"] == "checkpoint_corrupt"]
+    assert len(corrupt_events) == 1 and corrupt_events[0]["step"] == 2
+    mgr.close()
+
+
+def test_restore_latest_none_when_every_step_is_bad(tmp_path, dp_mesh):
+    state = tiny_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, state, force=True)
+    mgr.save(2, state.replace(step=jnp.asarray(2)), force=True)
+    mgr.wait()
+    _corrupt_biggest_file(tmp_path / "1", "corrupt")
+    _corrupt_biggest_file(tmp_path / "2", "truncate")
+    assert mgr.restore_latest(tiny_state(dp_mesh, seed=1)) is None
+    assert mgr.last_restore_report["restored_step"] is None
+    assert len(mgr.last_restore_report["rejected"]) == 2
+    mgr.close()
+
+
+def test_restore_specific_step_raises_no_fallback(tmp_path, dp_mesh):
+    state = tiny_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, state, force=True)
+    mgr.save(2, state.replace(step=jnp.asarray(2)), force=True)
+    mgr.wait()
+    _corrupt_biggest_file(tmp_path / "2", "corrupt")
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore(2, tiny_state(dp_mesh, seed=1))
+    mgr.close()
+
+
+def test_restore_before_step_skips_newer(tmp_path, dp_mesh):
+    state = tiny_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    for s in (1, 2, 3):
+        mgr.save(s, state.replace(step=jnp.asarray(s)), force=True)
+    mgr.wait()
+    restored = mgr.restore_latest(tiny_state(dp_mesh, seed=1), before_step=3)
+    assert int(restored.step) == 2
+    assert mgr.last_restore_report["restored_step"] == 2
+    mgr.close()
+
+
+def test_half_written_step_dir_is_invisible(tmp_path, dp_mesh):
+    """A step dir without the commit marker (kill mid-save on a
+    non-atomic filesystem) must not appear in all_steps/latest_step and
+    must not break restore_latest."""
+    state = tiny_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, state, force=True)
+    mgr.wait()
+    (tmp_path / "7").mkdir()
+    (tmp_path / "7" / "partial").write_bytes(b"torn write")
+    (tmp_path / "9.orbax-checkpoint-tmp-123").mkdir()
+    mgr.reload()
+    assert mgr.all_steps() == [1]
+    assert mgr.latest_step() == 1
+    restored = mgr.restore_latest(tiny_state(dp_mesh, seed=1))
+    assert restored is not None
+    assert mgr.last_restore_report["restored_step"] == 1
+    mgr.close()
+
+
+def test_verify_tree_detects_value_and_geometry_drift():
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    manifest = {"arrays": integrity.tree_checksums(tree)}
+    assert integrity.verify_tree(tree, manifest) == []
+    flipped = {"w": tree["w"].copy()}
+    flipped["w"][1, 2] += 1.0
+    assert any("checksum mismatch" in p
+               for p in integrity.verify_tree(flipped, manifest))
+    reshaped = {"w": tree["w"].reshape(4, 3)}
+    assert any("geometry" in p
+               for p in integrity.verify_tree(reshaped, manifest))
+    assert any("missing" in p for p in integrity.verify_tree({}, manifest))
+
+
+# --- failure classification -------------------------------------------------
+
+
+def test_classification_table():
+    assert classify_failure(None, preempted=True) == "preemption"
+    assert classify_failure(None, nan_anomaly=True) == "nan_loss"
+    assert classify_failure(
+        WorkerKilledFault("x", fault_id=0, step=1)) == "worker_kill"
+    assert classify_failure(
+        DataStallFault("x", fault_id=0, step=1)) == "data_stall"
+    assert classify_failure(WorkerUnavailableError("x")) == "worker_crash"
+    assert classify_failure(StopIteration()) == "data_exhausted"
+    assert classify_failure(TimeoutError()) == "data_stall"
+    assert classify_failure(FloatingPointError()) == "nan_loss"
+    assert classify_failure(RuntimeError("?"),
+                            watchdog_fired=True) == "data_stall"
+    assert classify_failure(RuntimeError("?")) == "unknown"
+
+
+# --- supervisor policy (fake trainer; no devices) ---------------------------
+
+
+class _FakeTrainer:
+    """Duck-typed Trainer: scripted per-attempt fit behaviors."""
+
+    def __init__(self, behaviors, total_steps=100, checkpointer=None):
+        self.config = types.SimpleNamespace(total_steps=total_steps)
+        self.callbacks = []
+        self.stop_training = False
+        self.watchdog_fired = False
+        self.supervisor_status = None
+        self.checkpointer = checkpointer
+        self.preemption = None
+        self._preempted = False
+        self._behaviors = behaviors
+        self.fit_calls = 0
+
+    @property
+    def preempted(self):
+        return self._preempted
+
+    def clear_preempted(self):
+        self._preempted = False
+
+    def fit(self, state, it, rng, eval_iter_fn=None):
+        b = self._behaviors[min(self.fit_calls, len(self._behaviors) - 1)]
+        self.fit_calls += 1
+        return b(self, state)
+
+
+class _FakeCheckpointer:
+    def __init__(self, step=40, rejected=()):
+        self.step = step
+        self.last_restore_report = None
+        self.calls = []
+
+    def restore_latest(self, template, before_step=None):
+        self.calls.append(before_step)
+        self.last_restore_report = {"restored_step": self.step,
+                                    "rejected": []}
+        return types.SimpleNamespace(step=self.step)
+
+
+def _done(total=100):
+    return lambda t, s: types.SimpleNamespace(step=total)
+
+
+def _raise(exc):
+    def b(t, s):
+        raise exc
+    return b
+
+
+def test_supervisor_retries_then_succeeds(monkeypatch):
+    sleeps = []
+    from distributedtensorflow_tpu.resilience import supervisor as sup_mod
+
+    monkeypatch.setattr(sup_mod.time, "sleep", sleeps.append)
+    trainer = _FakeTrainer([
+        _raise(WorkerKilledFault("boom", fault_id=0, step=10)),
+        _raise(RuntimeError("weird")),
+        _done(),
+    ], checkpointer=_FakeCheckpointer(step=8))
+    sup = Supervisor(
+        trainer, make_train_iter=lambda s: iter(()),
+        config=SupervisorConfig(max_restarts=3, backoff_base_s=1.0,
+                                backoff_factor=10.0, backoff_max_s=2.5),
+    )
+    state = sup.run(types.SimpleNamespace(step=0), rng=None)
+    assert int(state.step) == 100
+    assert trainer.fit_calls == 3
+    assert [r["kind"] for r in sup.restarts] == ["worker_kill", "unknown"]
+    assert [r["resumed_step"] for r in sup.restarts] == [8, 8]
+    # exponential backoff with clamp: 1.0, then min(10.0, 2.5)
+    assert sleeps == [1.0, 2.5]
+    assert trainer.supervisor_status["restarts"] == 2
+
+
+def test_supervisor_budget_exhaustion_escalates(monkeypatch):
+    from distributedtensorflow_tpu.resilience import supervisor as sup_mod
+
+    monkeypatch.setattr(sup_mod.time, "sleep", lambda s: None)
+    trainer = _FakeTrainer([_raise(RuntimeError("always"))])
+    sup = Supervisor(
+        trainer, make_train_iter=lambda s: iter(()),
+        state_template_fn=lambda: types.SimpleNamespace(step=0),
+        config=SupervisorConfig(max_restarts=2, backoff_base_s=0.0),
+    )
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run(types.SimpleNamespace(step=0), rng=None)
+    assert trainer.fit_calls == 3  # initial + 2 restarts
+    assert len(ei.value.failures) == 3
+    assert isinstance(ei.value.last_exception, RuntimeError)
+
+
+def test_supervisor_nan_anomaly_restores_before_poisoned_step(monkeypatch):
+    from distributedtensorflow_tpu.resilience import supervisor as sup_mod
+
+    monkeypatch.setattr(sup_mod.time, "sleep", lambda s: None)
+    ckpt = _FakeCheckpointer(step=30)
+
+    def nan_fit(trainer, state):
+        # the anomaly hook fires mid-fit; the watch stops the loop
+        for cb in trainer.callbacks:
+            cb.on_anomaly(trainer, types.SimpleNamespace(
+                kind="non_finite_loss", step=50, message="nan", value=None,
+            ))
+        assert trainer.stop_training  # the watch requested the stop
+        return types.SimpleNamespace(step=50)
+
+    trainer = _FakeTrainer([nan_fit, _done()], checkpointer=ckpt)
+    sup = Supervisor(
+        trainer, make_train_iter=lambda s: iter(()),
+        config=SupervisorConfig(max_restarts=2, backoff_base_s=0.0),
+    )
+    state = sup.run(types.SimpleNamespace(step=0), rng=None)
+    assert int(state.step) == 100
+    assert ckpt.calls == [50]  # restore constrained to BEFORE the NaN step
+    assert sup.restarts[0]["kind"] == "nan_loss"
+
+
+def test_supervisor_resumes_after_preemption(monkeypatch):
+    from distributedtensorflow_tpu.resilience import supervisor as sup_mod
+
+    monkeypatch.setattr(sup_mod.time, "sleep", lambda s: None)
+
+    def preempted_fit(trainer, state):
+        trainer._preempted = True
+        return types.SimpleNamespace(step=60)
+
+    trainer = _FakeTrainer([preempted_fit, _done()],
+                           checkpointer=_FakeCheckpointer(step=60))
+    sup = Supervisor(
+        trainer, make_train_iter=lambda s: iter(()),
+        config=SupervisorConfig(max_restarts=2, backoff_base_s=0.0),
+    )
+    state = sup.run(types.SimpleNamespace(step=0), rng=None)
+    assert int(state.step) == 100
+    assert sup.restarts[0]["kind"] == "preemption"
+    assert not trainer._preempted  # cleared before the resume
+
+
+def test_supervisor_data_exhausted_is_not_retried(monkeypatch):
+    from distributedtensorflow_tpu.resilience import supervisor as sup_mod
+
+    monkeypatch.setattr(sup_mod.time, "sleep", lambda s: None)
+    trainer = _FakeTrainer([_raise(StopIteration())])
+    sup = Supervisor(trainer, make_train_iter=lambda s: iter(()),
+                     config=SupervisorConfig(max_restarts=5))
+    with pytest.raises(StopIteration):
+        sup.run(types.SimpleNamespace(step=0), rng=None)
+    assert trainer.fit_calls == 1  # no retry for exhausted input
+
+
+def test_supervisor_clean_finish_restarts_nothing():
+    trainer = _FakeTrainer([_done()])
+    sup = Supervisor(trainer, make_train_iter=lambda s: iter(()))
+    state = sup.run(types.SimpleNamespace(step=0), rng=None)
+    assert int(state.step) == 100 and sup.restarts == []
+
+
+# --- chaos: fault plans + faults.jsonl --------------------------------------
+
+
+def test_fault_plan_validates():
+    with pytest.raises(ValueError, match="unknown kind"):
+        FaultPlan([{"step": 1, "kind": "meteor_strike"}])
+    with pytest.raises(ValueError, match="step"):
+        FaultPlan([{"step": -1, "kind": "nan_loss"}])
+    with pytest.raises(ValueError, match="step"):
+        FaultPlan([{"step": "soon", "kind": "nan_loss"}])
+    plan = FaultPlan([
+        {"step": 50, "kind": "nan_loss"},
+        {"step": 10, "kind": "worker_kill"},
+    ])
+    # sorted by trigger step, re-id'd in order
+    assert [(f.id, f.step, f.kind) for f in plan.faults] == [
+        (0, 10, "worker_kill"), (1, 50, "nan_loss"),
+    ]
+
+
+def test_fault_plan_load_accepts_object_and_list(tmp_path):
+    p1 = tmp_path / "a.json"
+    p1.write_text(json.dumps({"faults": [{"step": 3, "kind": "nan_loss"}]}))
+    assert len(FaultPlan.load(str(p1))) == 1
+    p2 = tmp_path / "b.json"
+    p2.write_text(json.dumps([{"step": 3, "kind": "preemption"}]))
+    assert len(FaultPlan.load(str(p2))) == 1
+    p3 = tmp_path / "c.json"
+    p3.write_text(json.dumps({"nope": True}))
+    with pytest.raises(ValueError):
+        FaultPlan.load(str(p3))
+
+
+def test_chaos_nan_injection_and_pairing(tmp_path):
+    plan = FaultPlan([{"step": 3, "kind": "nan_loss"}])
+    injector = ChaosInjector(plan, logdir=str(tmp_path))
+    base_step = lambda state, batch, rng: (
+        types.SimpleNamespace(step=int(state.step) + 1),
+        {"loss": jnp.float32(1.0)},
+    )
+    wrapped = injector.wrap_train_step(base_step)
+    state = types.SimpleNamespace(step=jnp.asarray(0))
+    losses = []
+    for _ in range(4):
+        state, metrics = wrapped(
+            types.SimpleNamespace(step=jnp.asarray(int(state.step))),
+            None, None)
+        losses.append(float(metrics["loss"]))
+    assert losses[:2] == [1.0, 1.0]
+    assert np.isnan(losses[2])  # injected exactly at the trigger step
+    assert losses[3] == 1.0  # one-shot
+    assert injector.unrecovered()[0]["kind"] == "nan_loss"
+    injector.mark_recovered(resumed_step=1, attempt=1)
+    assert injector.unrecovered() == []
+    rows = [json.loads(l) for l in
+            (tmp_path / "faults.jsonl").read_text().splitlines()]
+    assert [r["phase"] for r in rows] == ["injected", "recovered"]
+    assert rows[0]["step"] == rows[1]["step"] == 3
+    # and the file passes the schema gate
+    errors, _ = check_metrics_schema.check_file(
+        str(tmp_path / "faults.jsonl"))
+    assert errors == []
+
+
+def test_chaos_truncate_pairs_only_after_fallback(tmp_path, dp_mesh):
+    state = tiny_state(dp_mesh)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    plan = FaultPlan([{"step": 2, "kind": "checkpoint_truncate"}])
+    injector = ChaosInjector(plan, logdir=str(tmp_path))
+    proxy = injector.wrap_checkpointer(mgr)
+    assert proxy.save(1, state, force=True)
+    assert injector.unrecovered() == []  # step 1 < trigger: nothing yet
+    assert proxy.save(2, state.replace(step=jnp.asarray(2)), force=True)
+    proxy.wait()
+    assert [f["kind"] for f in injector.unrecovered()] == [
+        "checkpoint_truncate"]
+    restored = proxy.restore_latest(tiny_state(dp_mesh, seed=1))
+    assert restored is not None
+    report = proxy.last_restore_report
+    assert report["restored_step"] == 1
+    rejected = [r["step"] for r in report["rejected"]]
+    assert rejected == [2]
+    # a restart that never rejected the truncated step must NOT pair it
+    injector.mark_recovered(resumed_step=1, attempt=1, rejected_steps=[])
+    assert injector.unrecovered() != []
+    injector.mark_recovered(resumed_step=1, attempt=2,
+                            rejected_steps=rejected)
+    assert injector.unrecovered() == []
+    mgr.close()
+
+
+def test_chaos_data_stall_and_worker_kill_raise(tmp_path):
+    plan = FaultPlan([
+        {"step": 2, "kind": "data_stall", "stall_s": 0.0},
+        {"step": 5, "kind": "worker_kill"},
+    ])
+    injector = ChaosInjector(plan, logdir=str(tmp_path))
+    trainer = types.SimpleNamespace()
+    injector.on_step_end(trainer, 1, None, {})  # before triggers: no-op
+    with pytest.raises(DataStallFault):
+        injector.on_step_end(trainer, 2, None, {})
+    with pytest.raises(WorkerKilledFault):
+        injector.on_step_end(trainer, 7, None, {})  # late trigger still fires
+
+
+# --- faults.jsonl schema gate -----------------------------------------------
+
+
+def _write_faults(tmp_path, rows):
+    path = tmp_path / "faults.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(path)
+
+
+def test_faults_schema_flags_unpaired_and_bad_rows(tmp_path):
+    ok = [
+        {"t": 1.0, "id": 0, "step": 5, "kind": "nan_loss",
+         "phase": "injected"},
+        {"t": 2.0, "id": 0, "step": 5, "kind": "nan_loss",
+         "phase": "recovered", "resumed_step": 2, "attempt": 1},
+    ]
+    errors, _ = check_metrics_schema.check_file(_write_faults(tmp_path, ok))
+    assert errors == []
+    unpaired = ok[:1]
+    errors, _ = check_metrics_schema.check_file(
+        _write_faults(tmp_path, unpaired))
+    assert any("never recovered" in e for e in errors)
+    bad_kind = [dict(ok[0], kind="gremlins"),
+                dict(ok[1], kind="gremlins")]
+    errors, _ = check_metrics_schema.check_file(
+        _write_faults(tmp_path, bad_kind))
+    assert any("'kind'" in e for e in errors)
+    decreasing_id = [
+        dict(ok[0], id=1), dict(ok[1], id=1),
+        {"t": 3.0, "id": 0, "step": 9, "kind": "preemption",
+         "phase": "injected"},
+        {"t": 4.0, "id": 0, "step": 9, "kind": "preemption",
+         "phase": "recovered"},
+    ]
+    errors, _ = check_metrics_schema.check_file(
+        _write_faults(tmp_path, decreasing_id))
+    assert any("does not increase" in e for e in errors)
+    decreasing_step = [
+        dict(ok[0], step=9), dict(ok[1], step=9),
+        {"t": 3.0, "id": 1, "step": 4, "kind": "preemption",
+         "phase": "injected"},
+        {"t": 4.0, "id": 1, "step": 4, "kind": "preemption",
+         "phase": "recovered"},
+    ]
+    errors, _ = check_metrics_schema.check_file(
+        _write_faults(tmp_path, decreasing_step))
+    assert any("decreases" in e for e in errors)
+    orphan_recovery = [ok[1]]
+    errors, _ = check_metrics_schema.check_file(
+        _write_faults(tmp_path, orphan_recovery))
+    assert any("never injected" in e for e in errors)
+
+
+# --- goodput: in-process restart booking ------------------------------------
+
+
+def test_goodput_note_restart_books_badput_and_sums(tmp_path):
+    import time as time_mod
+
+    from distributedtensorflow_tpu.obs.goodput import GoodputLedger
+
+    ledger = GoodputLedger(str(tmp_path / "goodput.json"))
+    # The supervisor books an actually-elapsed window (failure -> restore
+    # begin), so elapse one here too — the buckets must stay a partition
+    # of real wall time.
+    time_mod.sleep(0.2)
+    ledger.note_restart(0.15)
+    merged = ledger.heartbeat(step=10)
+    assert merged["buckets"]["badput_restart"] == pytest.approx(0.15,
+                                                                abs=0.01)
+    total = sum(merged["buckets"].values())
+    assert total == pytest.approx(merged["wall_s"], rel=0.01, abs=0.05)
+    # and the persisted document passes the schema gate
+    errors, _ = check_metrics_schema.check_file(str(tmp_path / "goodput.json"))
+    assert errors == []
+
+
+# --- coordinator: bounded respawns ------------------------------------------
+
+
+def _stub_executor(max_respawns):
+    ex = object.__new__(_SubprocessExecutor)
+    ex.worker_id = 0
+    ex._max_respawns = max_respawns
+    ex._backoff_s = 0.0  # zero backoff: deadlines pass immediately
+    ex._backoff_max_s = 0.0
+    ex.respawns = 0
+    ex.last_backoff_s = 0.0
+    ex._dead = False
+    ex._spawn_not_before = None
+    ex._lock = threading.Lock()
+    ex._spawned = []
+    ex._spawn = lambda: ex._spawned.append(1)
+
+    class _DeadConn:
+        def send(self, m):
+            raise OSError("child is gone")
+
+        def close(self):
+            pass
+
+    class _DeadProc:
+        def is_alive(self):
+            return False
+
+        def kill(self):
+            pass
+
+        def join(self, timeout=None):
+            pass
+
+    ex._conn = _DeadConn()
+    ex._proc = _DeadProc()
+    return ex
+
+
+def test_respawn_budget_bounds_a_crash_loop(flight_ring):
+    ex = _stub_executor(max_respawns=2)
+    # deaths 1 and 2: each schedules a respawn (zero backoff, so the next
+    # execute performs it) and fails the closure fast
+    for expected_spawns in (0, 1):
+        with pytest.raises(WorkerUnavailableError, match="died"):
+            ex.execute(lambda: None, (), {})
+        assert len(ex._spawned) == expected_spawns  # spawn is DEFERRED
+    assert ex.respawns == 2 and not ex._dead
+    with pytest.raises(WorkerUnavailableError):  # death 3: budget spent
+        ex.execute(lambda: None, (), {})
+    assert ex._dead and len(ex._spawned) == 2 and ex.respawns == 2
+    with pytest.raises(WorkerUnavailableError, match="respawn budget"):
+        ex.execute(lambda: None, (), {})  # fast-fail, no further respawns
+    respawn_events = [e for e in flight_ring.events()
+                      if e["kind"] == "worker_respawn"]
+    # only ACTUAL scheduled respawns are counted — the budget-exceeding
+    # death is not a respawn
+    assert [e["respawn"] for e in respawn_events] == [1, 2]
+
+
+def test_respawn_backoff_is_exponential_clamped_and_nonblocking():
+    ex = _stub_executor(max_respawns=4)
+    ex._backoff_s = 1.0
+    ex._backoff_max_s = 2.5
+    backoffs = []
+    for _ in range(4):
+        with pytest.raises(WorkerUnavailableError, match="died"):
+            ex.execute(lambda: None, (), {})
+        backoffs.append(ex.last_backoff_s)
+        # inside the backoff window: fail fast, do NOT spawn (the closure
+        # must re-queue onto healthy workers immediately)
+        spawned_before = len(ex._spawned)
+        with pytest.raises(WorkerUnavailableError, match="respawning"):
+            ex.execute(lambda: None, (), {})
+        assert len(ex._spawned) == spawned_before
+        ex._spawn_not_before = 0.0  # the deadline elapses
+    assert backoffs == [1.0, 2.0, 2.5, 2.5]
+
+
+# --- run_report: resilience section -----------------------------------------
+
+
+def test_run_report_resilience_section(tmp_path):
+    from tools import run_report
+
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 10, "loss": 1.0, "t_step": 0.1}) + "\n"
+    )
+    flight = [
+        {"t": 1.0, "kind": "fit_begin", "step": 0},
+        {"t": 2.0, "kind": "checkpoint_corrupt", "step": 60,
+         "reason": "truncated"},
+        {"t": 3.0, "kind": "restart", "step": 40, "failure": "nan_loss",
+         "attempt": 1, "backoff_s": 0.1, "rejected_checkpoints": 1},
+        {"t": 4.0, "kind": "worker_respawn", "worker": 0, "respawn": 1},
+        {"t": 5.0, "kind": "fit_end", "step": 100},
+    ]
+    (tmp_path / "flight.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in flight))
+    faults = [
+        {"t": 1.5, "id": 0, "step": 50, "kind": "nan_loss",
+         "phase": "injected"},
+        {"t": 3.5, "id": 0, "step": 50, "kind": "nan_loss",
+         "phase": "recovered", "resumed_step": 40, "attempt": 1},
+        {"t": 4.5, "id": 1, "step": 70, "kind": "worker_kill",
+         "phase": "injected"},
+    ]
+    (tmp_path / "faults.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in faults))
+    report = run_report.build_report(str(tmp_path))
+    res = report["resilience"]
+    assert res["faults_injected"] == 2
+    assert res["faults_recovered"] == 1
+    assert res["unpaired"][0]["kind"] == "worker_kill"
+    assert res["restarts"] == 1
+    assert res["restarts_by_failure"] == {"nan_loss": 1}
+    assert res["fallback_restores"] == 1
+    assert res["worker_respawns"] == 1
+    text = run_report.render(report)
+    assert "resilience: 2 fault(s) injected" in text
+    assert "UNRECOVERED fault #1 worker_kill" in text
+    assert "fell back past 1 corrupt ckpt" in text
+
+
+def test_run_report_no_resilience_section_when_clean(tmp_path):
+    from tools import run_report
+
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 10, "loss": 1.0}) + "\n"
+    )
+    report = run_report.build_report(str(tmp_path))
+    assert report["resilience"] == {}
+    assert "resilience:" not in run_report.render(report)

@@ -1,0 +1,150 @@
+"""The ISSUE 5 acceptance command, end to end in a subprocess.
+
+``train.py --fault-plan`` injecting a worker kill, a corrupted (truncated)
+latest checkpoint, a NaN-loss step, a data stall, and a synthetic
+preemption must complete to its target step under the Supervisor with:
+
+- >= 2 supervised restarts,
+- the post-truncation restore taken from a *verified* checkpoint (the
+  truncated step rejected — ``checkpoint_corrupt`` in flight.jsonl),
+- ``faults.jsonl`` pairing every injection with a recovery (validated by
+  the schema gate),
+- ``goodput.json`` showing ``badput_restart > 0`` while the buckets still
+  sum to wall within 1% (validated by the schema gate),
+- run_report rendering a resilience section and exiting 0.
+
+Process-spawning, so slow-laned wholesale via conftest's
+_PROCESS_TEST_FILES.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PLAN = {
+    "faults": [
+        {"step": 35, "kind": "worker_kill"},
+        {"step": 45, "kind": "checkpoint_truncate"},
+        {"step": 70, "kind": "nan_loss"},
+        {"step": 100, "kind": "data_stall", "stall_s": 0.1},
+        {"step": 110, "kind": "preemption"},
+    ]
+}
+
+
+def _load_jsonl(path):
+    return [json.loads(line) for line in path.read_text().splitlines()
+            if line.strip()]
+
+
+def test_chaos_plan_self_heals_to_target_step(tmp_path):
+    logdir = tmp_path / "logs"
+    ckptdir = tmp_path / "ckpt"
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json.dumps(PLAN))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [
+            sys.executable, "train.py",
+            "--workload", "mnist_lenet", "--test-size",
+            "--steps", "120", "--batch-size", "32",
+            "--log-every", "10", "--device", "cpu",
+            "--checkpoint-every", "20", "--checkpoint-dir", str(ckptdir),
+            "--logdir", str(logdir),
+            "--fault-plan", str(plan_path),
+            "--restart-backoff", "0.05",
+            "--goodput", "--flight-recorder",
+            "--watchdog-timeout", "60",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=560,
+    )
+    assert res.returncode == 0, (res.stderr[-4000:], res.stdout[-1000:])
+    log = res.stderr + res.stdout
+    assert "done at step 120" in log
+
+    # every injection paired with a recovery, in schema-valid order
+    faults = _load_jsonl(logdir / "faults.jsonl")
+    injected = [r for r in faults if r["phase"] == "injected"]
+    recovered_ids = {r["id"] for r in faults if r["phase"] == "recovered"}
+    assert len(injected) == len(PLAN["faults"])
+    assert {r["kind"] for r in injected} == {
+        f["kind"] for f in PLAN["faults"]}
+    assert {r["id"] for r in injected} == recovered_ids
+
+    # flight: >= 2 supervised restarts, and the truncated checkpoint was
+    # rejected on the way to a VERIFIED restore
+    flight = _load_jsonl(logdir / "flight.jsonl")
+    restarts = [e for e in flight if e["kind"] == "restart"]
+    assert len(restarts) >= 2, [e["kind"] for e in flight]
+    corrupt = [e for e in flight if e["kind"] == "checkpoint_corrupt"]
+    assert len(corrupt) >= 1
+    truncated_step = corrupt[0]["step"]
+    nan_restart = [e for e in restarts if e.get("failure") == "nan_loss"]
+    assert nan_restart and nan_restart[0]["step"] < truncated_step
+
+    # goodput: restarts were booked, and the ledger still balances
+    goodput = json.loads((logdir / "goodput.json").read_text())
+    buckets = goodput["merged"]["buckets"]
+    wall = goodput["merged"]["wall_s"]
+    assert buckets.get("badput_restart", 0.0) > 0.0
+    assert abs(sum(buckets.values()) - wall) <= max(0.01 * wall, 0.05)
+
+    # the schema gate accepts every stream the run produced
+    gate = subprocess.run(
+        [
+            sys.executable, "tools/check_metrics_schema.py",
+            str(logdir / "metrics.jsonl"), str(logdir / "flight.jsonl"),
+            str(logdir / "faults.jsonl"), str(logdir / "goodput.json"),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+
+    # run_report renders the resilience section and exits 0
+    report = subprocess.run(
+        [sys.executable, "tools/run_report.py", str(logdir), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert report.returncode == 0, report.stdout + report.stderr
+    doc = json.loads(report.stdout)
+    res_section = doc["resilience"]
+    assert res_section["faults_injected"] == len(PLAN["faults"])
+    assert res_section["unpaired"] == []
+    assert res_section["restarts"] >= 2
+    assert res_section["fallback_restores"] >= 1
+
+
+def test_budget_exhaustion_exits_nonzero(tmp_path):
+    """A plan whose faults keep firing past the restart budget must end in
+    the clean non-zero escalation exit, not a hang or a traceback-shaped
+    crash loop."""
+    logdir = tmp_path / "logs"
+    plan_path = tmp_path / "plan.json"
+    # no checkpoint dir: every restart cold-starts at step 0, so the
+    # worker_kill at step 5 re-fires... it is one-shot — instead exhaust
+    # the budget explicitly with max-restarts 0 semantics: a single fault
+    # and --max-restarts 1 means the SECOND failure (none here) never
+    # comes; use two faults and a budget of 1.
+    plan_path.write_text(json.dumps([
+        {"step": 5, "kind": "worker_kill"},
+        {"step": 6, "kind": "data_stall"},
+    ]))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [
+            sys.executable, "train.py",
+            "--workload", "mnist_lenet", "--test-size",
+            "--steps", "40", "--batch-size", "32",
+            "--log-every", "10", "--device", "cpu",
+            "--logdir", str(logdir),
+            "--fault-plan", str(plan_path),
+            "--max-restarts", "1",
+            "--restart-backoff", "0.05",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=560,
+    )
+    assert res.returncode == 3, (res.returncode, res.stderr[-3000:])
+    assert "supervisor gave up" in (res.stderr + res.stdout)

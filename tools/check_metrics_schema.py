@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Validate ``metrics.jsonl`` / ``flight.jsonl`` / ``goodput.json`` /
-``captures.jsonl`` files against the documented schemas.
+``captures.jsonl`` / ``faults.jsonl`` files against the documented
+schemas.
 
 Usage::
 
@@ -10,7 +11,8 @@ Usage::
 Files whose basename starts with ``flight`` are validated against the
 flight-recorder event schema; basenames starting with ``goodput`` against
 the goodput-ledger document schema; basenames starting with ``captures``
-against the reactive-profiler manifest schema; everything else against
+against the reactive-profiler manifest schema; basenames starting with
+``faults`` against the chaos fault-log schema; everything else against
 the metric-row schema.
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
@@ -38,6 +40,17 @@ the known set (``static`` / ``manual`` / ``step_time_regression`` /
 for ``aborted`` rows), finite ``t_begin <= t_end``, non-negative
 ``wall_s`` / ``overhead_s``, and a ``dir`` that exists on disk (resolved
 against the manifest's directory when relative).
+
+The faults schema (docs/API.md "Self-healing & fault injection"): every
+row of a ``faults.jsonl`` chaos log is one JSON object with finite
+non-decreasing ``t``, non-negative integer ``id`` and ``step``, ``kind``
+from the known fault set (``nan_loss`` / ``checkpoint_truncate`` /
+``worker_kill`` / ``data_stall`` / ``preemption``), and ``phase``
+``injected`` or ``recovered``; injected ``id``s strictly increase with
+non-decreasing ``step``s, every recovered row must reference an earlier
+injected ``id`` of the same kind, and every injected fault must be paired
+with a recovered row by end of file (an unpaired injection = the run did
+not self-heal).
 
 The goodput schema (docs/API.md "Goodput"): ``goodput.json`` is ONE JSON
 object with a ``generations`` list (each: finite ``start_t <= last_t``,
@@ -73,6 +86,9 @@ DEFAULT_GOODPUT_GLOB = os.path.join(
 DEFAULT_CAPTURES_GLOB = os.path.join(
     REPO, "ARTIFACTS", "convergence_*", "captures*.jsonl"
 )
+DEFAULT_FAULTS_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "convergence_*", "faults*.jsonl"
+)
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
 #: duplicated: this tool is stdlib-only and must run anywhere logs land).
@@ -87,6 +103,14 @@ GOODPUT_BUCKETS = (
 CAPTURE_TRIGGERS = (
     "static", "manual", "step_time_regression", "straggler_spread",
 )
+
+#: The known chaos fault kinds (resilience/chaos.py FAULT_KINDS —
+#: duplicated for the same stdlib-only reason).
+FAULT_KINDS = (
+    "nan_loss", "checkpoint_truncate", "worker_kill", "data_stall",
+    "preemption",
+)
+FAULT_PHASES = ("injected", "recovered")
 
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
@@ -261,6 +285,104 @@ def check_capture_row(
             int(cap_id) if cap_id is not None else prev_id)
 
 
+def check_faults_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate one ``faults.jsonl`` chaos log (see module docstring):
+    per-row shape, time/id/step ordering, and injected/recovered pairing."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    prev_t: float | None = None
+    prev_injected_id: int | None = None
+    prev_injected_step: int | None = None
+    injected_kinds: dict[int, str] = {}
+    recovered_ids: set[int] = set()
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: invalid JSON ({e})")
+                continue
+            if not isinstance(row, dict):
+                errors.append(f"line {i}: row is {type(row).__name__}, "
+                              "not an object")
+                continue
+            t = row.get("t")
+            if isinstance(t, bool) or not isinstance(t, (int, float)) \
+                    or not math.isfinite(t):
+                errors.append(f"line {i}: 't' {t!r} is not a finite number")
+            else:
+                if prev_t is not None and t < prev_t:
+                    errors.append(f"line {i}: 't' {t} decreases")
+                prev_t = float(t)
+            kind = row.get("kind")
+            if kind not in FAULT_KINDS:
+                errors.append(
+                    f"line {i}: 'kind' {kind!r} not in {FAULT_KINDS}"
+                )
+            phase = row.get("phase")
+            if phase not in FAULT_PHASES:
+                errors.append(
+                    f"line {i}: 'phase' {phase!r} not in {FAULT_PHASES}"
+                )
+            fid = row.get("id")
+            if not _nonneg_int(fid):
+                errors.append(f"line {i}: 'id' {fid!r} is not a "
+                              "non-negative integer")
+                continue
+            fid = int(fid)
+            step = row.get("step")
+            if not _nonneg_int(step):
+                errors.append(f"line {i}: 'step' {step!r} is not a "
+                              "non-negative integer")
+                step = None
+            if phase == "injected":
+                if fid in injected_kinds:
+                    errors.append(f"line {i}: fault id {fid} injected twice")
+                elif prev_injected_id is not None \
+                        and fid <= prev_injected_id:
+                    errors.append(
+                        f"line {i}: injected id {fid} does not increase "
+                        f"(previous {prev_injected_id})"
+                    )
+                prev_injected_id = (
+                    fid if prev_injected_id is None
+                    else max(prev_injected_id, fid)
+                )
+                if step is not None:
+                    if prev_injected_step is not None \
+                            and int(step) < prev_injected_step:
+                        errors.append(
+                            f"line {i}: injected step {int(step)} decreases "
+                            f"(previous {prev_injected_step})"
+                        )
+                    prev_injected_step = (
+                        int(step) if prev_injected_step is None
+                        else max(prev_injected_step, int(step))
+                    )
+                injected_kinds[fid] = kind
+            elif phase == "recovered":
+                if fid not in injected_kinds:
+                    errors.append(
+                        f"line {i}: recovered id {fid} was never injected"
+                    )
+                elif kind != injected_kinds[fid]:
+                    errors.append(
+                        f"line {i}: recovered id {fid} kind {kind!r} != "
+                        f"injected kind {injected_kinds[fid]!r}"
+                    )
+                recovered_ids.add(fid)
+    unpaired = sorted(set(injected_kinds) - recovered_ids)
+    for fid in unpaired:
+        errors.append(
+            f"fault id {fid} ({injected_kinds[fid]}) was injected but "
+            "never recovered — the run did not self-heal"
+        )
+    return errors, warnings
+
+
 def _check_bucket_map(buckets, where: str) -> tuple[list[str], list[str]]:
     errors: list[str] = []
     warnings: list[str] = []
@@ -354,6 +476,8 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         except (OSError, json.JSONDecodeError) as e:
             return [f"invalid JSON ({e})"], []
         return check_goodput_doc(doc)
+    if os.path.basename(path).startswith("faults"):
+        return check_faults_file(path)
     flight = os.path.basename(path).startswith("flight")
     captures = os.path.basename(path).startswith("captures")
     manifest_dir = os.path.dirname(os.path.abspath(path))
@@ -387,6 +511,7 @@ def main(argv: list[str] | None = None) -> int:
     paths = list(argv) if argv else sorted(
         glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
         + glob.glob(DEFAULT_GOODPUT_GLOB) + glob.glob(DEFAULT_CAPTURES_GLOB)
+        + glob.glob(DEFAULT_FAULTS_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

@@ -27,7 +27,12 @@ writes — and prints:
   (count, per-trigger breakdown, step ranges, per-capture wall cost);
 - goodput: the merged cross-restart wall-time ledger from ``goodput.json``
   (``--goodput`` runs) — productive fraction, per-bucket seconds,
-  generation/restart counts.
+  generation/restart counts;
+- resilience: the self-healing story — chaos faults from ``faults.jsonl``
+  (injected/recovered pairing by kind, unpaired injections called out),
+  supervised restarts and rejected-checkpoint fallbacks from the flight
+  events, worker respawns, and the ``badput_restart`` seconds the
+  restarts cost.
 
 ``--json`` emits the same content as one machine-readable JSON object.
 Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
@@ -216,6 +221,61 @@ def capture_summary(rows: list[dict]) -> dict:
     }
 
 
+def resilience_summary(faults: list[dict], flight: list[dict],
+                       goodput: dict) -> dict:
+    """The self-healing digest: fault injection/recovery pairing
+    (``faults.jsonl``), supervised restarts + checkpoint fallbacks +
+    worker respawns (flight events), and what the restarts cost
+    (``badput_restart``).  Empty when the run had none of it."""
+    injected = [r for r in faults if r.get("phase") == "injected"]
+    recovered_ids = {r.get("id") for r in faults
+                     if r.get("phase") == "recovered"}
+    restarts = [e for e in flight if e.get("kind") == "restart"]
+    gave_up = [e for e in flight if e.get("kind") == "supervisor_giving_up"]
+    corrupt = [e for e in flight if e.get("kind") == "checkpoint_corrupt"]
+    respawns = [e for e in flight if e.get("kind") == "worker_respawn"]
+    if not (injected or restarts or corrupt or respawns or gave_up):
+        return {}
+    by_kind: dict[str, dict[str, int]] = {}
+    unpaired = []
+    for r in injected:
+        k = str(r.get("kind", "?"))
+        d = by_kind.setdefault(k, {"injected": 0, "recovered": 0})
+        d["injected"] += 1
+        if r.get("id") in recovered_ids:
+            d["recovered"] += 1
+        else:
+            unpaired.append({"id": r.get("id"), "kind": k,
+                             "step": r.get("step")})
+    restart_kinds: dict[str, int] = {}
+    for e in restarts:
+        k = str(e.get("failure", "?"))
+        restart_kinds[k] = restart_kinds.get(k, 0) + 1
+    out = {
+        "faults_injected": len(injected),
+        "faults_recovered": len(injected) - len(unpaired),
+        "unpaired": unpaired,
+        "faults_by_kind": by_kind,
+        "restarts": len(restarts),
+        "restarts_by_failure": dict(
+            sorted(restart_kinds.items(), key=lambda kv: -kv[1])
+        ),
+        "restart_events": [
+            {k: e.get(k) for k in ("step", "failure", "attempt",
+                                   "backoff_s", "rejected_checkpoints")}
+            for e in restarts
+        ],
+        "gave_up": bool(gave_up),
+        "fallback_restores": len(corrupt),
+        "rejected_checkpoint_steps": [e.get("step") for e in corrupt],
+        "worker_respawns": len(respawns),
+    }
+    badput = (goodput.get("buckets") or {}).get("badput_restart")
+    if isinstance(badput, (int, float)):
+        out["badput_restart_s"] = badput
+    return out
+
+
 def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     """Last-row host-spread fields, grouped by base key."""
     out: dict[str, dict[str, float]] = {}
@@ -268,6 +328,11 @@ def build_report(logdir: str) -> dict:
         _load_jsonl(captures_path) if os.path.exists(captures_path)
         else ([], 0)
     )
+    faults_path = os.path.join(logdir, "faults.jsonl")
+    faults, bad_faults = (
+        _load_jsonl(faults_path) if os.path.exists(faults_path)
+        else ([], 0)
+    )
     goodput, bad_goodput = load_goodput(logdir)
     train, evals = split_rows(rows)
 
@@ -298,10 +363,11 @@ def build_report(logdir: str) -> dict:
         "flight": flight_summary(flight),
         "captures": capture_summary(captures),
         "goodput": goodput,
-        # metric-stream health: any unparseable metrics.jsonl / captures
-        # line (or an unreadable goodput.json) makes main() exit non-zero
-        # (CI gate)
-        "parse_errors": bad_metrics + bad_goodput + bad_captures,
+        "resilience": resilience_summary(faults, flight, goodput),
+        # metric-stream health: any unparseable metrics.jsonl / captures /
+        # faults line (or an unreadable goodput.json) makes main() exit
+        # non-zero (CI gate)
+        "parse_errors": bad_metrics + bad_goodput + bad_captures + bad_faults,
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -410,6 +476,53 @@ def render(report: dict) -> str:
         for name, secs in sorted(buckets.items(), key=lambda kv: -kv[1]):
             pct = 100.0 * secs / wall if wall else 0.0
             lines.append(f"  {name:<18} {secs:10.2f} s  {pct:6.2f}%")
+    res = report.get("resilience")
+    if res:
+        healed = (
+            "all recovered" if not res["unpaired"]
+            else f"{len(res['unpaired'])} UNRECOVERED"
+        )
+        lines += [
+            "",
+            (
+                f"resilience: {res['faults_injected']} fault(s) injected "
+                f"({healed}), {res['restarts']} supervised restart(s), "
+                f"{res['fallback_restores']} checkpoint fallback(s), "
+                f"{res['worker_respawns']} worker respawn(s)"
+            ),
+        ]
+        for kind, d in sorted(res["faults_by_kind"].items()):
+            lines.append(
+                f"  fault {kind:<20} injected {d['injected']}  "
+                f"recovered {d['recovered']}"
+            )
+        for e in res["restart_events"]:
+            extra = ""
+            if e.get("rejected_checkpoints"):
+                extra = (f"  (fell back past "
+                         f"{e['rejected_checkpoints']} corrupt ckpt)")
+            lines.append(
+                f"  restart #{e.get('attempt')}: {e.get('failure')} -> "
+                f"resumed step {e.get('step')} after "
+                f"{e.get('backoff_s')}s backoff{extra}"
+            )
+        if res.get("rejected_checkpoint_steps"):
+            lines.append(
+                "  rejected checkpoint step(s): "
+                f"{res['rejected_checkpoint_steps']}"
+            )
+        if "badput_restart_s" in res:
+            lines.append(
+                f"  restart cost (badput_restart): "
+                f"{res['badput_restart_s']:.2f} s"
+            )
+        if res.get("gave_up"):
+            lines.append("  SUPERVISOR GAVE UP — retry budget exhausted")
+        for u in res["unpaired"]:
+            lines.append(
+                f"  UNRECOVERED fault #{u['id']} {u['kind']} "
+                f"(step {u['step']})"
+            )
     if report["stragglers"]:
         lines += ["", "straggler summary (last record):"]
         for base, d in report["stragglers"].items():

@@ -566,6 +566,24 @@ def main() -> None:
                    help="start the jax.profiler server for on-demand remote "
                         "trace capture (TensorBoard 'capture profile' / "
                         "jax.profiler.trace_remote against this port)")
+    p.add_argument("--fault-plan", default=None, metavar="JSON",
+                   help="chaos fault plan: inject NaN losses, checkpoint "
+                        "truncation, worker kills, data stalls, and "
+                        "synthetic preemptions at planned steps "
+                        "(resilience.chaos schema); implies supervised "
+                        "restarts and writes <logdir>/faults.jsonl")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="supervised self-healing: restart the fit (restore "
+                        "from the last VERIFIED checkpoint, exponential "
+                        "backoff) up to N times on NaN loss, worker crash, "
+                        "data stall, or injected fault before exiting "
+                        "non-zero. 0 = die on first failure (unless "
+                        "--fault-plan sets a budget)")
+    p.add_argument("--restart-backoff", type=float, default=1.0,
+                   help="base seconds of the supervised-restart exponential "
+                        "backoff (doubles per restart)")
+    p.add_argument("--restart-backoff-max", type=float, default=60.0,
+                   help="clamp on the supervised-restart backoff")
     p.add_argument("--flight-recorder", action="store_true",
                    help="record a bounded ring of structured events (step/"
                         "checkpoint/anomaly/preemption/compile markers), "
@@ -828,8 +846,13 @@ def main() -> None:
     )
 
     rng = jax.random.PRNGKey(args.seed)
+    # ONE optimizer instance: a supervised restart rebuilds the state
+    # template, and a fresh make_optimizer() would carry new optax
+    # function identities in the TrainState treedef — a pytree-metadata
+    # mismatch against the already-compiled step's in_shardings.
+    optimizer = wl.make_optimizer()
     state, specs = create_sharded_state(
-        wl.init_fn, wl.make_optimizer(), mesh, rng,
+        wl.init_fn, optimizer, mesh, rng,
         rules=wl.layout, fsdp=wl.fsdp,
     )
     if args.steps_per_call > 1:
@@ -883,20 +906,55 @@ def main() -> None:
 
     ctx = current_input_context(wl.global_batch_size)
 
-    if args.data_dir:
-        from distributedtensorflow_tpu.data import repeated_record_dataset
+    def make_raw_iter():
+        if args.data_dir:
+            from distributedtensorflow_tpu.data import repeated_record_dataset
 
-        files = record_files(args.data_dir)
-        logging.info("reading %d record files (%s sharding)",
-                     len(files), args.autoshard)
-        raw_iter = repeated_record_dataset(
-            files, ctx, batch_size=ctx.per_host_batch_size,
-            policy=args.autoshard, shuffle_buffer=args.shuffle_buffer,
-            seed=args.seed,
-            on_epoch=lambda e: logging.info("input epoch %d complete", e),
+            files = record_files(args.data_dir)
+            logging.info("reading %d record files (%s sharding)",
+                         len(files), args.autoshard)
+            return repeated_record_dataset(
+                files, ctx, batch_size=ctx.per_host_batch_size,
+                policy=args.autoshard, shuffle_buffer=args.shuffle_buffer,
+                seed=args.seed,
+                on_epoch=lambda e: logging.info("input epoch %d complete", e),
+            )
+        return wl.input_fn(ctx, args.seed)
+
+    def make_train_iter(start_step: int):
+        """Fresh train iterator positioned after ``start_step`` consumed
+        batches — called once per (re)start, so a supervised restart
+        resumes the input at the restored step (tf.data iterator-
+        checkpoint semantics).  steps_per_call: the Prefetcher stacks k
+        host batches into one (k, B, ...) bundle per dispatch (host-side,
+        BEFORE placement — the only ordering that works multi-host) and
+        buffers 2 bundles so the transfer overlaps compute."""
+        raw_iter = make_raw_iter()
+        if start_step > 0:
+            from distributedtensorflow_tpu.data import skip_batches
+
+            logging.info("fast-forwarding input %d batches", start_step)
+            raw_iter = skip_batches(iter(raw_iter), start_step)
+        return Prefetcher(
+            raw_iter, mesh, buffer_size=2, bundle=args.steps_per_call
         )
-    else:
-        raw_iter = wl.input_fn(ctx, args.seed)
+
+    # Chaos fault injection (resilience tentpole): a --fault-plan run
+    # exercises the whole recovery stack — NaN restarts, checkpoint
+    # fallback, preemption resume — deterministically, on CPU in CI.
+    chaos = None
+    if args.fault_plan:
+        from distributedtensorflow_tpu.resilience import (
+            ChaosInjector,
+            FaultPlan,
+        )
+
+        chaos = ChaosInjector(FaultPlan.load(args.fault_plan),
+                              logdir=args.logdir)
+        logging.warning(
+            "chaos: %d fault(s) planned from %s; faults.jsonl in %s",
+            len(chaos.plan), args.fault_plan, args.logdir,
+        )
 
     checkpointer = None
     preemption = None
@@ -905,28 +963,23 @@ def main() -> None:
             CheckpointManager,
             PreemptionHandler,
         )
-        from distributedtensorflow_tpu.data import skip_batches
 
         checkpointer = CheckpointManager(args.checkpoint_dir)
+        if chaos is not None:
+            # The truncation fault tears the bytes at the storage layer,
+            # exactly where the real fault lives.
+            checkpointer = chaos.wrap_checkpointer(checkpointer)
         # SIGTERM (GCE/Borg preemption notice) -> cluster-consistent save
         # at the next step boundary, then a clean stop; the launcher's
         # restart resumes from that exact step + input position.
         preemption = PreemptionHandler(checkpointer, mesh=mesh)
+        if chaos is not None:
+            chaos.attach_preemption(preemption)
         state = checkpointer.restore_latest(state) or state
-        restored_step = int(state.step)
-        if restored_step > 0:
-            # resume input position: the batches before restored_step were
-            # already consumed by the interrupted run (tf.data iterator-
-            # checkpoint semantics)
-            logging.info("fast-forwarding input %d batches", restored_step)
-            raw_iter = skip_batches(iter(raw_iter), restored_step)
-    # steps_per_call: the Prefetcher stacks k host batches into one
-    # (k, B, ...) bundle per dispatch (host-side, BEFORE placement — the
-    # only ordering that works multi-host) and buffers 2 bundles so the
-    # transfer overlaps compute.
-    train_iter = Prefetcher(
-        raw_iter, mesh, buffer_size=2, bundle=args.steps_per_call
-    )
+    restored_step = int(state.step)
+    train_iter = None  # supervised runs build theirs via make_train_iter
+    if chaos is not None:
+        train_step = chaos.wrap_train_step(train_step)
 
     trainer = Trainer(
         train_step,
@@ -963,6 +1016,9 @@ def main() -> None:
         eval_step=eval_step,
         checkpointer=checkpointer,
         preemption=preemption,
+        # The injector is a Callback: its on_step_end fires the
+        # worker-kill / data-stall / preemption triggers.
+        callbacks=[chaos] if chaos is not None else None,
     )
     eval_iter_fn = None
     if args.eval_every and eval_step is not None:
@@ -990,11 +1046,74 @@ def main() -> None:
             eval_iter_fn = lambda: Prefetcher(
                 wl.input_fn(ctx, args.seed + 999), mesh
             )
+    supervise = chaos is not None or args.max_restarts > 0
     try:
         with trainer:  # closes the metric writer on every exit path
-            state = trainer.fit(
-                state, train_iter, rng, eval_iter_fn=eval_iter_fn
-            )
+            if supervise:
+                from distributedtensorflow_tpu.resilience import (
+                    RestartBudgetExhausted,
+                    Supervisor,
+                    SupervisorConfig,
+                )
+
+                def state_template_fn():
+                    # The state fed to a failed fit was DONATED to the
+                    # device; restores need a pristine sharded template
+                    # (same optimizer INSTANCE — see the note at the
+                    # original create_sharded_state call).
+                    template, _ = create_sharded_state(
+                        wl.init_fn, optimizer, mesh,
+                        jax.random.PRNGKey(args.seed),
+                        rules=wl.layout, fsdp=wl.fsdp,
+                    )
+                    return template
+
+                budget = args.max_restarts
+                if budget <= 0:  # a fault plan implies a restart budget
+                    budget = len(chaos.plan) + 2
+                supervisor = Supervisor(
+                    trainer,
+                    make_train_iter=make_train_iter,
+                    state_template_fn=state_template_fn,
+                    eval_iter_fn=eval_iter_fn,
+                    config=SupervisorConfig(
+                        max_restarts=budget,
+                        backoff_base_s=args.restart_backoff,
+                        backoff_max_s=args.restart_backoff_max,
+                    ),
+                    chaos=chaos,
+                )
+                try:
+                    state = supervisor.run(state, rng)
+                except RestartBudgetExhausted as e:
+                    # The escalation contract: a clean non-zero exit the
+                    # job scheduler can act on, with the failure history
+                    # in the log (and in flight.jsonl / faults.jsonl).
+                    logging.error(
+                        "supervisor gave up: %s; failures: %s",
+                        e, e.failures,
+                    )
+                    if goodput_ledger is not None:
+                        goodput_ledger.close(ended="failed")
+                    raise SystemExit(3) from e
+                if chaos is not None and chaos.unrecovered():
+                    logging.error(
+                        "chaos: run finished with UNRECOVERED faults: %s",
+                        chaos.unrecovered(),
+                    )
+                    if goodput_ledger is not None:
+                        # The run DID end (at its target step, even) —
+                        # close the generation so the ledger doesn't later
+                        # merge it as died-mid-flight.
+                        goodput_ledger.close(ended="failed")
+                    raise SystemExit(4)
+            else:
+                train_iter = make_train_iter(restored_step)
+                state = trainer.fit(
+                    state, train_iter, rng, eval_iter_fn=eval_iter_fn
+                )
+    except SystemExit:
+        raise
     except BaseException:
         if goodput_ledger is not None:
             # Crash path: stamp the last heartbeat but leave the generation
