@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Offered-load benchmark for the serving engine (ISSUE 6).
+
+bench_generate.py measures the raw decode loop; this measures the SYSTEM —
+the continuous-batching engine under request traffic: a Poisson-ish
+arrival sweep drives `serve.Engine` directly (no HTTP, so the number is
+the scheduler's, not the socket stack's) and reports, per offered rate,
+request-level SLOs (TTFT / TPOT / e2e p50+p99), batch occupancy, rejects,
+and delivered tokens/sec.
+
+Evidence discipline (same contract as bench_generate.py): the headline
+operating point is the MEDIAN OF 3 independent trials with its relative
+spread recorded; one JSON line on stdout.
+
+Knobs (env): ``BENCH_SERVE_RATES`` (comma req/s, default "2,8,32"),
+``BENCH_SERVE_N`` (requests per point, default 32), ``BENCH_SERVE_NEW``
+(max_new_tokens, default 32), ``BENCH_SERVE_PROMPT`` (max prompt len,
+default 64), ``BENCH_SERVE_SLOTS`` (default 8), ``BENCH_SERVE_TEST=1``
+CPU smoke (tiny model, 2 slots, few requests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+
+from bench_probe import enable_compile_cache, probe_devices_with_retries
+
+enable_compile_cache()
+
+if not probe_devices_with_retries("bench_serve"):
+    raise SystemExit(2)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+from distributedtensorflow_tpu.serve import QueueFullError  # noqa: E402
+
+
+def _percentile(vals, q):
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, int(round(q * len(s))) - 1))]
+
+
+def _run_point(engine, *, rate: float, n: int, new: int, prompt_max: int,
+               vocab: int, seed: int) -> dict:
+    """Offer ``n`` requests at ``rate`` req/s; block until all terminal."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, size=n)
+    reqs, rejected = [], 0
+    t0 = time.perf_counter()
+    for i in range(n):
+        time.sleep(float(gaps[i]))
+        prompt = rng.integers(0, vocab, size=int(rng.integers(4, prompt_max)))
+        try:
+            reqs.append(engine.submit(list(map(int, prompt)),
+                                      max_new_tokens=new))
+        except QueueFullError:  # backpressure is a data point; any other
+            rejected += 1        # submit error must fail the bench loudly
+    for r in reqs:
+        r.wait()
+    makespan = time.perf_counter() - t0
+    ok = [r for r in reqs if r.status == "ok"]
+    tokens = sum(len(r.tokens) for r in ok)
+    ttft = [r.ttft_s for r in ok]
+    tpot = [r.tpot_s for r in ok if len(r.tokens) > 1]
+    e2e = [r.e2e_s for r in ok]
+    occ = [r.occ_max for r in ok if r.occ_steps]
+    return {
+        "rate_rps": rate,
+        "requests": n,
+        "ok": len(ok),
+        "rejected": rejected,
+        "tokens_per_sec": round(tokens / makespan, 1) if makespan else 0.0,
+        "ttft_p50_s": round(_percentile(ttft, 0.50), 4),
+        "ttft_p99_s": round(_percentile(ttft, 0.99), 4),
+        "tpot_p50_s": round(_percentile(tpot, 0.50), 4),
+        "tpot_p99_s": round(_percentile(tpot, 0.99), 4),
+        "e2e_p50_s": round(_percentile(e2e, 0.50), 4),
+        "e2e_p99_s": round(_percentile(e2e, 0.99), 4),
+        "occupancy_mean": (round(statistics.fmean(
+            r.occ_sum / r.occ_steps for r in ok if r.occ_steps), 2)
+            if any(r.occ_steps for r in ok) else 0.0),
+        "occupancy_max": max(occ, default=0),
+    }
+
+
+def main() -> None:
+    import dataclasses
+
+    from distributedtensorflow_tpu.models import (
+        GPTLM,
+        gpt_small,
+        gpt_tiny,
+    )
+    from distributedtensorflow_tpu.serve import Engine
+
+    test_size = os.environ.get("BENCH_SERVE_TEST") == "1"
+    cfg = gpt_tiny() if test_size else gpt_small()
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "2" if test_size else "8"))
+    n = int(os.environ.get("BENCH_SERVE_N", "6" if test_size else "32"))
+    new = int(os.environ.get("BENCH_SERVE_NEW", "8" if test_size else "32"))
+    prompt_max = int(os.environ.get(
+        "BENCH_SERVE_PROMPT", "16" if test_size else "64"))
+    rates = tuple(
+        float(r) for r in os.environ.get(
+            "BENCH_SERVE_RATES", "16" if test_size else "2,8,32"
+        ).split(",")
+    )
+    max_context = 64 if test_size else 1024
+    cfg = dataclasses.replace(cfg, max_seq=max_context)
+
+    params = GPTLM(cfg).init(
+        jax.random.PRNGKey(0), np.zeros((1, 1), np.int32),
+        deterministic=True,
+    )["params"]
+    engine = Engine(
+        params, cfg, max_slots=slots, max_queue=max(4 * n, 64),
+        block_size=8 if test_size else 16,
+        prefill_chunk=8 if test_size else 32,
+        max_context=max_context,
+    ).start()
+
+    # Warm both compiled programs before any timed trial.
+    engine.generate(list(range(4)), max_new_tokens=2, timeout=300)
+
+    points = []
+    head_rate = rates[-1]  # the highest offered load is the headline
+    head_vals, head_pts = [], []
+    for rate in rates:
+        trials = 3 if rate == head_rate else 1
+        for t in range(trials):
+            pt = _run_point(
+                engine, rate=rate, n=n, new=new, prompt_max=prompt_max,
+                vocab=cfg.vocab_size, seed=17 * t + int(rate),
+            )
+            if rate == head_rate:
+                head_vals.append(pt["tokens_per_sec"])
+                head_pts.append(pt)
+            else:
+                points.append(pt)
+    med = statistics.median(head_vals)
+    head = dict(sorted(head_pts, key=lambda p: p["tokens_per_sec"])[
+        len(head_pts) // 2
+    ])
+    head["spread"] = round(
+        (max(head_vals) - min(head_vals)) / med, 4) if med else 0.0
+    head["trials"] = len(head_vals)
+    points.append(head)
+    engine.stop()
+
+    result = {
+        "metric": "serve_offered_load_tokens_per_sec",
+        "value": med,
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no public anchor for this serving config
+        "headline": head,
+        "curve": points,
+        "max_slots": slots,
+        "requests_per_point": n,
+        "max_new_tokens": new,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    from bench_probe import is_tpu_platform, persist_result
+
+    if is_tpu_platform(result["platform"]) and not test_size:
+        persist_result("serve", result)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 """Model zoo: the reference's five workload models + a long-context decoder
 LM, TPU-first flax modules."""
 
-from .generate import generate  # noqa: F401
+from .generate import decode_step, generate, prefill  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTLM,

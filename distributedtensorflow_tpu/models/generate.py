@@ -52,6 +52,41 @@ def _sample(logits, rng, temperature, *, greedy: bool, top_k: int,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def prefill(params, tokens, positions, *, cfg: GPTConfig, cache=None):
+    """Teacher-forced multi-token step through the KV cache.
+
+    Runs the decode-mode model on a token chunk (causal within the chunk,
+    attending everything already in ``cache``) and returns ``(logits,
+    cache)`` with the chunk's K/V appended.  ``cache=None`` creates the
+    cache collection (flax mutable-apply priming); pass the returned cache
+    back to continue — chunked prefill is a loop of fixed-width calls, so
+    one compiled program covers any prompt length.  This is the serving
+    engine's prefill building block (``serve.engine``) as well as
+    :func:`generate`'s priming step.  Pure function: traceable under jit
+    and scan, caller owns the cache pytree.
+    """
+    model = GPTLM(cfg, decode=True)
+    variables = {"params": params}
+    if cache is not None:
+        variables["cache"] = cache
+    logits, vars_out = model.apply(
+        variables, tokens, positions=positions, mutable=["cache"]
+    )
+    return logits, vars_out["cache"]
+
+
+def decode_step(params, tokens, positions, cache, *, cfg: GPTConfig):
+    """One-token decode step against an existing KV cache.
+
+    ``tokens``/``positions`` are ``(B, 1)``; returns ``(logits, cache)``
+    with the new token's K/V written at the cache index.  The single-step
+    specialization of :func:`prefill` (the cache must already exist) —
+    the body of :func:`generate`'s scan and the dense-cache counterpart of
+    the serving engine's paged decode program.
+    """
+    return prefill(params, tokens, positions, cfg=cfg, cache=cache)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "greedy", "top_k", "top_p",
@@ -60,7 +95,6 @@ def _sample(logits, rng, temperature, *, greedy: bool, top_k: int,
 def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
                    cfg: GPTConfig, max_new_tokens: int, greedy: bool,
                    top_k: int, top_p: float, eos_token_id: int):
-    model = GPTLM(cfg, decode=True)
     b, prompt_pad = prompt.shape
     total = prompt_pad + max_new_tokens
 
@@ -70,12 +104,9 @@ def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
 
     # First token primes the cache (flax creates the cache collection on a
     # mutable apply); the scan then carries it functionally.
-    logits0, vars0 = model.apply(
-        {"params": params}, tokens[:, :1],
-        positions=jnp.zeros((b, 1), jnp.int32),
-        mutable=["cache"],
+    logits0, cache = prefill(
+        params, tokens[:, :1], jnp.zeros((b, 1), jnp.int32), cfg=cfg
     )
-    cache = vars0["cache"]
 
     done0 = jnp.zeros((b,), bool)
 
@@ -99,12 +130,11 @@ def _generate_impl(params, prompt, prompt_lens, rng, temperature, *,
         tokens = jax.lax.dynamic_update_slice_in_dim(
             tokens, nxt[:, None], t + 1, axis=1
         )
-        logits, vars_out = model.apply(
-            {"params": params, "cache": cache}, nxt[:, None],
-            positions=jnp.full((b, 1), t + 1, jnp.int32),
-            mutable=["cache"],
+        logits, cache = decode_step(
+            params, nxt[:, None], jnp.full((b, 1), t + 1, jnp.int32),
+            cache, cfg=cfg,
         )
-        return (tokens, vars_out["cache"], rng, logits, done), None
+        return (tokens, cache, rng, logits, done), None
 
     (tokens, _, _, _, _), _ = jax.lax.scan(
         step, (tokens, cache, rng, logits0, done0), jnp.arange(total - 1)

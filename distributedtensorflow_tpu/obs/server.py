@@ -110,15 +110,29 @@ class _Handler(BaseHTTPRequestHandler):
             status=status, content_type="application/json",
         )
 
+    def _reply_routed(self, result) -> None:
+        """Render an extra-route handler's ``(status, payload)`` result:
+        dict/list payloads as JSON, strings as plain text."""
+        status, payload = result
+        if isinstance(payload, str):
+            self._reply(payload, status=status)
+        else:
+            self._reply_json(payload, status=status)
+
     def do_GET(self) -> None:  # noqa: N802 — http.server contract
         srv = self.server_ref
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         try:
-            if path in ("/", "/helpz"):
+            route = srv.route("GET", path)
+            if route is not None:
+                self._reply_routed(route(query))
+            elif path in ("/", "/helpz"):
+                extra = {p: "application endpoint"
+                         for (m, p) in srv.routes if m == "GET"}
                 self._reply(
                     "distributedtensorflow_tpu introspection server\n\n"
                     + "\n".join(f"  {p:<10} {d}"
-                                for p, d in _ENDPOINTS.items())
+                                for p, d in {**_ENDPOINTS, **extra}.items())
                     + "\n"
                 )
             elif path == "/healthz":
@@ -172,11 +186,33 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self.server_ref
         path, _, query = self.path.partition("?")
         try:
-            # Drain the body (we take parameters from the query string
-            # only) so HTTP/1.1 keep-alive stays in sync.
+            # Read the body so HTTP/1.1 keep-alive stays in sync; built-in
+            # endpoints take parameters from the query string only, extra
+            # routes get the bytes.  An over-limit body is refused whole
+            # with 413 — truncating it would hand routes half a payload
+            # and leave the tail on the socket to be parsed as the next
+            # request.  Moderately-over bodies are drained (so the
+            # client's send completes and reads the 413 cleanly); absurd
+            # claims just drop the connection.
             length = int(self.headers.get("Content-Length") or 0)
-            if length > 0:
-                self.rfile.read(min(length, 1 << 20))
+            if length > (1 << 20):
+                if length <= (8 << 20):
+                    remaining = length
+                    while remaining > 0:
+                        chunk = self.rfile.read(min(remaining, 1 << 16))
+                        if not chunk:
+                            break
+                        remaining -= len(chunk)
+                else:
+                    self.close_connection = True
+                self._reply(f"body too large ({length} bytes > 1 MiB)\n",
+                            status=413)
+                return
+            body = self.rfile.read(length) if length > 0 else b""
+            route = srv.route("POST", path)
+            if route is not None:
+                self._reply_routed(route(query, body))
+                return
             if path != "/profilez":
                 self._reply(f"POST not supported on {path}\n", status=404)
                 return
@@ -244,6 +280,7 @@ class StatusServer:
         capture=None,
         status_fn: Callable[[], dict] | None = None,
         health_fn: Callable[[], dict] | None = None,
+        routes: dict | None = None,
     ):
         from . import registry as reglib  # noqa: PLC0415
 
@@ -252,6 +289,15 @@ class StatusServer:
         self._capture = capture
         self._status_fn = status_fn
         self._health_fn = health_fn
+        #: Extra application endpoints: ``{("GET"|"POST", path): handler}``
+        #: where a GET handler is ``fn(query) -> (status, payload)`` and a
+        #: POST handler ``fn(query, body_bytes) -> (status, payload)``
+        #: (payload: dict/list → JSON, str → text/plain).  Handlers run on
+        #: HTTP threads — same thread-safety contract as status_fn; unlike
+        #: the built-ins they MAY block (the serving frontend's POST
+        #: /generatez waits for generation), each request has its own
+        #: thread.  Built-in endpoints win on collision.
+        self.routes = dict(routes or {})
         self._t0 = time.time()
         handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -263,6 +309,14 @@ class StatusServer:
         self._started = False
 
     # -- sources (read by the handler) ---------------------------------------
+
+    def route(self, method: str, path: str) -> Callable | None:
+        """Extra-route lookup; built-in endpoints always win on collision
+        (an application route can never shadow /healthz & co, nor the
+        index pages)."""
+        if path in _ENDPOINTS or path in ("/", "/helpz"):
+            return None
+        return self.routes.get((method, path))
 
     @property
     def registry(self):

@@ -1,3 +1,7 @@
 """TPU ops: attention (XLA + Pallas kernels), fused primitives."""
 
-from .attention import dot_product_attention, xla_attention  # noqa: F401
+from .attention import (  # noqa: F401
+    dot_product_attention,
+    paged_decode_attention,
+    xla_attention,
+)

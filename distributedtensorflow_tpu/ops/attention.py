@@ -158,6 +158,67 @@ def cached_decode_attention(
     return out, cached_k, cached_v, ix + s_new
 
 
+def paged_decode_attention(
+    q: jax.Array,             # (B, H, D) one new query per serving slot
+    k_pool: jax.Array,        # (num_blocks, block_size, Hkv, D) shared pool
+    v_pool: jax.Array,        # (num_blocks, block_size, Hkv, D)
+    block_tables: jax.Array,  # (B, max_blocks) int32 physical block ids
+    seq_lens: jax.Array,      # (B,) int32 valid tokens incl. this step's
+) -> jax.Array:
+    """Single-token decode attention against a paged (block-pool) KV cache.
+
+    The serving engine's counterpart of :func:`cached_decode_attention`:
+    instead of one dense ``(B, Hkv, max_seq, D)`` buffer per slot, K/V
+    live in a pool of fixed-size blocks shared by every slot and each
+    slot's ``block_tables`` row names the blocks that hold its sequence —
+    so a finished or short sequence pins only the blocks it actually
+    used (``serve.kv_cache`` owns allocation).  Blockwise layout per
+    ``ops/blockwise.py``'s chunking idiom: the sequence axis is tiled in
+    ``block_size`` chunks, here scattered through the pool.
+
+    Each slot gathers its blocks to a ``(max_blocks * block_size, Hkv,
+    D)`` view, masks positions ``>= seq_lens`` (and whatever a scratch /
+    unallocated table entry points at), and runs the same fp32-softmax
+    scaled dot product as the dense decode path — so paged and dense
+    decode agree bit-for-bit up to reduction order (tests pin this).
+    Reference XLA formulation (gather + einsum); a Mosaic kernel that
+    streams blocks without materializing the gather is future work, so
+    compute cost is O(max_blocks * block_size) per slot while *residency*
+    is O(allocated blocks).
+    """
+    b, h, d = q.shape
+    nb, block_size, h_kv, _ = k_pool.shape
+    cap = block_tables.shape[1] * block_size
+    # (B, max_blocks, bs, Hkv, D) -> (B, Hkv, cap, D); the gather is the
+    # page-table walk.
+    k = k_pool[block_tables].reshape(b, cap, h_kv, d).transpose(0, 2, 1, 3)
+    v = v_pool[block_tables].reshape(b, cap, h_kv, d).transpose(0, 2, 1, 3)
+    valid = jnp.arange(cap)[None, :] < seq_lens[:, None]  # (B, cap)
+    if h != h_kv:  # GQA: grouped einsums, pool never broadcast to H
+        g = h // h_kv
+        qg = q.reshape(b, h_kv, g, d)
+        scores = jnp.einsum(
+            "bhgd,bhkd->bhgk", qg, k, preferred_element_type=jnp.float32,
+        ).reshape(b, h, cap) / (d ** 0.5)
+    else:
+        scores = jnp.einsum(
+            "bhd,bhkd->bhk", q, k, preferred_element_type=jnp.float32,
+        ) / (d ** 0.5)
+    scores = jnp.where(valid[:, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    if h != h_kv:
+        wg = weights.astype(q.dtype).reshape(b, h_kv, g, cap)
+        out = jnp.einsum(
+            "bhgk,bhkd->bhgd", wg, v, preferred_element_type=jnp.float32,
+        ).reshape(b, h, d)
+    else:
+        out = jnp.einsum(
+            "bhk,bhkd->bhd", weights.astype(q.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+    return out.astype(q.dtype)
+
+
 def _decode_attn_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, scale):
     """A block of heads of one batch row's single-token decode attention.
 

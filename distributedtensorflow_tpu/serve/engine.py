@@ -1,0 +1,698 @@
+"""Continuous-batching generation engine: queue → slots → paged decode.
+
+The batch serving path (``models.generate``) decodes a whole batch in one
+``lax.scan``: every sequence pays ``max_new_tokens`` steps, a finished
+sequence squats its slot emitting EOS, and nothing can join mid-flight —
+fine for offline eval, fatal for request serving.  This engine is the
+online replacement:
+
+- **thread-safe FIFO queue** (bounded; a full queue rejects loudly so the
+  frontend can return 429 instead of letting latency grow unboundedly);
+- **continuous (in-flight) batching**: every scheduler iteration first
+  admits queued requests into free slots (chunked prefill, one compiled
+  width), then runs ONE paged decode step for all active slots, then
+  evicts finished sequences (EOS / max_new_tokens) — freed slots and KV
+  blocks are available to the very next admission, so the decode batch
+  refills while long requests keep streaming;
+- **paged KV** (``serve.kv_cache``): admission reserves only the
+  request's worst-case footprint (prompt + max_new), not ``max_seq``,
+  and eviction returns the blocks immediately;
+- **admission control**: a request is admitted only when a slot AND its
+  whole block reservation are free (no mid-flight OOM), strictly in
+  arrival order (head-of-line blocking keeps FIFO fairness — a small
+  request never jumps a large one under backpressure).
+
+Observability (wired into the obs registry): ``serve_ttft_seconds``,
+``serve_tpot_seconds``, ``serve_e2e_seconds``, ``serve_batch_occupancy``
+histograms, queue/slot/block gauges, ``serve_requests_total{status=}`` /
+``serve_tokens_generated_total`` / ``serve_admits_total{reused=}``
+counters; a per-request ``requests.jsonl`` log and periodic
+``metrics.jsonl`` rows + ``metrics.prom`` snapshots in ``logdir`` (the
+same streams ``tools/run_report.py`` and ``tools/check_metrics_schema.py``
+consume).
+
+Threading model: HTTP/handler threads only touch :meth:`submit` (queue +
+lock); all device work and all ``PagedKVCache`` mutation happens on the
+single engine loop thread.  Completion is signalled per-request via a
+``threading.Event``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import json
+import math
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import registry as obs_registry
+from ..utils.metrics import json_sanitize
+from .kv_cache import PagedKVCache
+from .model import (
+    make_decode_fn,
+    make_prefill_cache,
+    make_prefill_fn,
+    reset_cache_index,
+)
+
+__all__ = ["Engine", "GenRequest", "QueueFullError"]
+
+#: Terminal request states (the ``requests.jsonl`` ``status`` field).
+TERMINAL_STATES = ("ok", "rejected", "error")
+
+
+class QueueFullError(RuntimeError):
+    """Raised by :meth:`Engine.submit` when the bounded queue is full
+    (HTTP frontends map it to 429)."""
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One generation request plus its lifecycle bookkeeping."""
+
+    id: str
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_token_id: int | None = None
+    seed: int = 0
+
+    # -- lifecycle (engine-owned) --
+    status: str = "queued"          # queued/active/ok/rejected/error
+    finish_reason: str | None = None  # "eos" | "length"
+    error: str | None = None
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    slot: int | None = None
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+    occ_sum: int = 0
+    occ_steps: int = 0
+    occ_max: int = 0
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False
+    )
+    _rng: np.random.Generator | None = dataclasses.field(
+        default=None, repr=False
+    )
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the request reaches a terminal state."""
+        return self._done.wait(timeout)
+
+    @property
+    def ttft_s(self) -> float:
+        return max(self.t_first_token - self.t_submit, 0.0)
+
+    @property
+    def e2e_s(self) -> float:
+        return max(self.t_done - self.t_submit, 0.0)
+
+    @property
+    def tpot_s(self) -> float:
+        """Mean per-output-token latency after the first token."""
+        if len(self.tokens) <= 1:
+            return 0.0
+        return max(self.t_done - self.t_first_token, 0.0) / (
+            len(self.tokens) - 1
+        )
+
+
+class Engine:
+    """Continuous-batching scheduler over the two compiled serving
+    programs (``serve.model``).  See the module docstring for the loop
+    contract; construct, :meth:`start`, :meth:`submit` from any thread,
+    :meth:`stop` to drain."""
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        *,
+        max_slots: int = 4,
+        max_queue: int = 64,
+        block_size: int = 16,
+        num_blocks: int | None = None,
+        prefill_chunk: int = 16,
+        max_context: int | None = None,
+        max_new_cap: int | None = None,
+        logdir: str | None = None,
+        log_every: int = 50,
+        registry=None,
+    ):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        max_context = max_context or cfg.max_seq
+        if max_context % block_size:
+            raise ValueError(
+                f"max_context={max_context} must be a multiple of "
+                f"block_size={block_size}"
+            )
+        if not 0 < prefill_chunk <= max_context:
+            # even a 1-token prompt pads to one prefill chunk — a chunk
+            # wider than the context would 400 every request at submit
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be in "
+                f"[1, max_context={max_context}]"
+            )
+        #: params stay the caller's (possibly mesh-sharded) arrays — GSPMD
+        #: partitions both programs exactly as it does models.generate.
+        self.params = params
+        self.cfg = dataclasses.replace(cfg, max_seq=max_context)
+        self.max_slots = max_slots
+        self.max_queue = max_queue
+        self.max_new_cap = max_new_cap
+        self.prefill_chunk = prefill_chunk
+        self.logdir = logdir
+        self.log_every = max(int(log_every), 1)
+
+        head_dim = cfg.hidden_size // cfg.num_heads
+        blocks_per_slot = max_context // block_size
+        if num_blocks is None:
+            # Full provisioning: every slot can hold max_context.  Pass
+            # fewer to oversubscribe (paged memory is the point) — then
+            # admission control, not OOM, absorbs the pressure.
+            num_blocks = max_slots * blocks_per_slot
+        self.kv = PagedKVCache(
+            num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+            head_dim=head_dim, max_slots=max_slots, num_blocks=num_blocks,
+            block_size=block_size, max_context=max_context, dtype=cfg.dtype,
+        )
+        self._prefill = make_prefill_fn(self.cfg, chunk=prefill_chunk,
+                                        block_size=block_size)
+        self._decode = make_decode_fn(self.cfg)
+        self._prefill_cache = make_prefill_cache(self.cfg)
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: collections.deque[GenRequest] = collections.deque()
+        self._ids = itertools.count()
+        self._slots: list[GenRequest | None] = [None] * max_slots
+        self._slot_reused = [False] * max_slots  # slot saw a previous request
+        self._last_tokens = np.zeros((max_slots,), np.int32)
+        self._thread: threading.Thread | None = None
+        self._stop_flag = False
+        self._crashed: str | None = None  # loop-death reason (healthz/submit)
+        self._stopped = False             # clean shutdown: refuse new work
+        self.decode_steps = 0
+        self.occupancy_max = 0
+        self.counters = {
+            "submitted": 0, "ok": 0, "rejected": 0, "error": 0,
+            "tokens_generated": 0, "admits": 0, "admits_into_freed_slot": 0,
+        }
+
+        reg = registry or obs_registry.default_registry()
+        self._m_ttft = reg.histogram(
+            "serve_ttft_seconds", "request arrival -> first token")
+        self._m_tpot = reg.histogram(
+            "serve_tpot_seconds", "mean per-output-token latency")
+        self._m_e2e = reg.histogram(
+            "serve_e2e_seconds", "request arrival -> completion")
+        self._m_occ = reg.histogram(
+            "serve_batch_occupancy", "active slots per decode step",
+            buckets=tuple(float(i) for i in range(1, max_slots + 1)),
+        )
+        self._m_queue = reg.gauge("serve_queue_depth", "queued requests")
+        self._m_active = reg.gauge("serve_active_slots", "occupied slots")
+        self._m_blocks_free = reg.gauge(
+            "serve_kv_blocks_free", "free KV pool blocks")
+        self._m_requests = reg.counter(
+            "serve_requests_total", "terminal requests by status")
+        self._m_tokens = reg.counter(
+            "serve_tokens_generated_total", "generated tokens")
+        self._m_admits = reg.counter(
+            "serve_admits_total", "admissions (reused=slot had served before)")
+        self._registry = reg
+
+        self._req_log = None
+        self._met_log = None
+        self._log_lock = threading.Lock()
+        if logdir:
+            os.makedirs(logdir, exist_ok=True)
+            self._req_log = open(os.path.join(logdir, "requests.jsonl"), "a")
+            self._met_log = open(os.path.join(logdir, "metrics.jsonl"), "a")
+
+    # -- submission (any thread) ---------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        eos_token_id: int | None = None,
+        seed: int = 0,
+    ) -> GenRequest:
+        """Validate + enqueue; returns the live :class:`GenRequest`.
+
+        Raises ``ValueError`` on a malformed request (frontend: 400),
+        :class:`QueueFullError` on backpressure (frontend: 429), and
+        ``RuntimeError`` once the scheduler loop has died (frontend: 503
+        — queueing onto a loop nothing drains would strand the client
+        for its whole timeout)."""
+        if self._crashed is not None:
+            raise RuntimeError(f"engine loop dead: {self._crashed}")
+        if self._stopped:
+            # A late HTTP handler racing serve.py shutdown must be
+            # refused, not queued onto a loop nothing drains.
+            raise RuntimeError("engine stopped")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be a non-empty token list")
+        if any(t < 0 or t >= self.cfg.vocab_size for t in prompt):
+            raise ValueError(
+                f"prompt tokens must be in [0, {self.cfg.vocab_size})"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        # Sampling parameters are validated HERE, not on the engine loop
+        # thread: a bad value must 400 one request, never kill the loop.
+        temperature = float(temperature)
+        if not math.isfinite(temperature) or temperature < 0.0:
+            raise ValueError(
+                f"temperature must be a finite number >= 0, got {temperature}"
+            )
+        top_k = int(top_k)
+        if not 0 <= top_k <= self.cfg.vocab_size:
+            raise ValueError(
+                f"top_k must be in [0, {self.cfg.vocab_size}], got {top_k}"
+            )
+        if self.max_new_cap and max_new_tokens > self.max_new_cap:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds the server cap "
+                f"{self.max_new_cap}"
+            )
+        if eos_token_id is not None and not (
+            0 <= eos_token_id < self.cfg.vocab_size
+        ):
+            raise ValueError(f"bad eos_token_id {eos_token_id}")
+        footprint = self._footprint(len(prompt), max_new_tokens)
+        if footprint > self.kv.max_context:
+            raise ValueError(
+                f"request footprint {footprint} tokens (prompt "
+                f"{len(prompt)} padded to the {self.prefill_chunk}-token "
+                f"prefill chunk, + {max_new_tokens} new) exceeds "
+                f"max_context={self.kv.max_context}"
+            )
+        # An oversubscribed pool may be smaller than one max_context slot:
+        # a request the WHOLE pool can't hold would wedge the strict-FIFO
+        # queue head forever — reject it at the door instead.
+        if self.kv.blocks_for(footprint) > self.kv.allocator.num_blocks:
+            raise ValueError(
+                f"request footprint {footprint} tokens needs "
+                f"{self.kv.blocks_for(footprint)} KV blocks but the pool "
+                f"has {self.kv.allocator.num_blocks}"
+            )
+        req = GenRequest(
+            id=f"r{next(self._ids)}", prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            eos_token_id=eos_token_id, seed=int(seed),
+            t_submit=time.time(),
+        )
+        req._rng = np.random.default_rng(req.seed)
+        rejected = False
+        with self._cond:
+            # Re-checked under the lock: a submit racing stop() past the
+            # unlocked guard above must not enqueue onto a drained queue.
+            if self._stopped or self._stop_flag or self._crashed is not None:
+                raise RuntimeError("engine stopped")
+            if len(self._queue) >= self.max_queue:
+                rejected = True
+                req.status = "rejected"
+                req.t_done = time.time()
+                req._done.set()
+                self.counters["rejected"] += 1
+                self._m_requests.inc(status="rejected")
+            else:
+                self.counters["submitted"] += 1
+                self._queue.append(req)
+                self._m_queue.set(len(self._queue))
+                self._cond.notify()
+        if rejected:
+            # The disk write happens OUTSIDE the scheduler lock: a 429
+            # storm must not stall the decode loop on log I/O.
+            self._log_request(req)
+            raise QueueFullError(
+                f"queue full ({self.max_queue} requests waiting)"
+            )
+        return req
+
+    def generate(self, prompt, *, timeout: float | None = None,
+                 **kwargs) -> GenRequest:
+        """Blocking convenience: submit + wait (tests, bench)."""
+        req = self.submit(prompt, **kwargs)
+        if not req.wait(timeout):
+            raise TimeoutError(f"request {req.id} still running")
+        return req
+
+    # -- scheduler (engine thread) -------------------------------------------
+
+    def _padded_prompt_len(self, prompt_len: int) -> int:
+        """Prompt length rounded up to whole prefill chunks — the extent
+        the prefill program actually writes K/V through (pad positions
+        included), so reservations MUST be sized from this same number."""
+        c = self.prefill_chunk
+        return -(-prompt_len // c) * c
+
+    def _footprint(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case KV positions a request can touch: the padded prompt
+        (the final prefill chunk writes pad K/V) or the full generation,
+        whichever is larger."""
+        return max(self._padded_prompt_len(prompt_len),
+                   prompt_len + max_new)
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit → decode → evict.  Public so
+        tests can drive the engine synchronously; returns True when any
+        work happened."""
+        admitted = self._admit_from_queue()
+        for req in admitted:
+            self._run_prefill(req)
+        active = [r for r in self._slots if r is not None]
+        if active:
+            self._run_decode_step()
+        did = bool(admitted or active)
+        if did and self.decode_steps % self.log_every == 0:
+            self._log_metrics_row()
+        return did
+
+    def _admit_from_queue(self) -> list[GenRequest]:
+        """Strict-FIFO admission: pop the head only while a slot AND its
+        whole block reservation fit (head-of-line blocking = fairness)."""
+        admitted = []
+        with self._cond:
+            while self._queue:
+                free = [i for i, r in enumerate(self._slots) if r is None]
+                if not free:
+                    break
+                head = self._queue[0]
+                need = self.kv.blocks_for(
+                    self._footprint(len(head.prompt), head.max_new_tokens)
+                )
+                if need > self.kv.allocator.free_blocks:
+                    break
+                self._queue.popleft()
+                slot = free[0]
+                ok = self.kv.admit(
+                    slot,
+                    self._footprint(len(head.prompt), head.max_new_tokens),
+                )
+                assert ok  # free_blocks was checked above
+                head.slot = slot
+                head.status = "active"
+                head.t_admit = time.time()
+                self._slots[slot] = head
+                reused = self._slot_reused[slot]
+                self._slot_reused[slot] = True
+                self.counters["admits"] += 1
+                if reused:
+                    self.counters["admits_into_freed_slot"] += 1
+                self._m_admits.inc(reused=str(reused).lower())
+                admitted.append(head)
+            self._m_queue.set(len(self._queue))
+        self._m_active.set(sum(r is not None for r in self._slots))
+        self._m_blocks_free.set(self.kv.allocator.free_blocks)
+        return admitted
+
+    def _run_prefill(self, req: GenRequest) -> None:
+        """Chunked prefill for one admitted request, then sample its first
+        token (TTFT stops here)."""
+        slot = req.slot
+        c = self.prefill_chunk
+        prompt = np.asarray(req.prompt, np.int32)
+        pad = self._padded_prompt_len(len(prompt))
+        buf = np.zeros((pad,), np.int32)
+        buf[: len(prompt)] = prompt
+        self._prefill_cache = reset_cache_index(self._prefill_cache)
+        table_row = jnp.asarray(self.kv.block_tables[slot])
+        last_logits = None
+        for start in range(0, pad, c):
+            last_ix = min(max(len(prompt) - 1 - start, 0), c - 1)
+            last_logits, self._prefill_cache, self.kv.k_pool, self.kv.v_pool = (
+                self._prefill(
+                    self.params, self.kv.k_pool, self.kv.v_pool,
+                    self._prefill_cache, jnp.asarray(buf[None, start:start + c]),
+                    jnp.int32(start), table_row, jnp.int32(last_ix),
+                )
+            )
+        self.kv.note_written(slot, len(prompt))
+        tok = self._sample(req, np.asarray(last_logits))
+        req.t_first_token = time.time()
+        req.tokens.append(tok)
+        self._last_tokens[slot] = tok
+        self._m_ttft.observe(req.ttft_s)
+        self._maybe_finish(req)
+
+    def _run_decode_step(self) -> None:
+        """One paged decode token for every active slot."""
+        active = np.array([r is not None for r in self._slots])
+        n_active = int(active.sum())
+        logits, self.kv.k_pool, self.kv.v_pool = self._decode(
+            self.params, self.kv.k_pool, self.kv.v_pool,
+            jnp.asarray(self._last_tokens), jnp.asarray(self.kv.block_tables),
+            jnp.asarray(self.kv.seq_lens), jnp.asarray(active),
+        )
+        logits = np.asarray(logits)
+        self.decode_steps += 1
+        self._m_occ.observe(float(n_active))
+        self.occupancy_max = max(self.occupancy_max, n_active)
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self.kv.note_written(slot, int(self.kv.seq_lens[slot]) + 1)
+            req.occ_sum += n_active
+            req.occ_steps += 1
+            req.occ_max = max(req.occ_max, n_active)
+            tok = self._sample(req, logits[slot])
+            req.tokens.append(tok)
+            self._last_tokens[slot] = tok
+            self._maybe_finish(req)
+
+    def _sample(self, req: GenRequest, logits: np.ndarray) -> int:
+        """Host-side greedy / temperature+top-k sampling (deterministic
+        per request seed).  Device-side fused sampling is future work."""
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        scaled = logits.astype(np.float64) / max(req.temperature, 1e-6)
+        if req.top_k > 0:
+            kth = np.partition(scaled, -req.top_k)[-req.top_k]
+            scaled = np.where(scaled < kth, -np.inf, scaled)
+        scaled -= scaled.max()
+        probs = np.exp(scaled)
+        probs /= probs.sum()
+        return int(req._rng.choice(len(probs), p=probs))
+
+    def _maybe_finish(self, req: GenRequest) -> None:
+        last = req.tokens[-1]
+        if req.eos_token_id is not None and last == req.eos_token_id:
+            self._finish(req, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, "length")
+
+    def _finish(self, req: GenRequest, reason: str,
+                status: str = "ok") -> None:
+        """Evict: free the slot + blocks, close out metrics, signal."""
+        if req.slot is not None:
+            self.kv.release(req.slot)
+            self._slots[req.slot] = None
+        req.status = status
+        req.finish_reason = reason if status == "ok" else None
+        req.t_done = time.time()
+        self.counters[status] += 1
+        self._m_requests.inc(status=status)
+        if status == "ok":
+            self.counters["tokens_generated"] += len(req.tokens)
+            self._m_tokens.inc(len(req.tokens))
+            self._m_e2e.observe(req.e2e_s)
+            self._m_tpot.observe(req.tpot_s)
+        self._m_active.set(sum(r is not None for r in self._slots))
+        self._m_blocks_free.set(self.kv.allocator.free_blocks)
+        self._log_request(req)
+        req._done.set()
+
+    # -- loop / lifecycle ----------------------------------------------------
+
+    def start(self) -> "Engine":
+        if self._stopped or self._crashed is not None:
+            # A stopped/crashed engine holds closed log handles and failed
+            # requests — relaunching its loop would only busy-wait while
+            # submit() refuses everything.  Build a fresh Engine instead.
+            raise RuntimeError("engine cannot be restarted after stop()")
+        if self._thread is None:
+            self._stop_flag = False
+            self._thread = threading.Thread(
+                target=self._run, name="dtf-serve-engine", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    @property
+    def healthy(self) -> bool:
+        """False once the scheduler loop has died or been stopped
+        (surfaced as a 503 on ``/healthz`` so a balancer stops routing
+        to this process)."""
+        return self._crashed is None and not self._stopped
+
+    def _run(self) -> None:
+        while True:
+            try:
+                did = self.step()
+            except Exception as e:  # noqa: BLE001 — fail every in-flight req
+                self._crashed = repr(e)
+                self._fail_all(f"engine loop error: {e!r}")
+                raise
+            with self._cond:
+                if self._stop_flag:
+                    return
+                if not did and not self._queue:
+                    self._cond.wait(timeout=0.05)
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop the loop.  ``drain=True`` (default) finishes in-flight and
+        queued requests first; ``drain=False`` errors them out."""
+        if self._thread is not None:
+            if drain:
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    with self._cond:
+                        idle = not self._queue and all(
+                            r is None for r in self._slots
+                        )
+                    if idle:
+                        break
+                    time.sleep(0.01)
+            with self._cond:
+                self._stop_flag = True
+                self._cond.notify_all()
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        self._stopped = True
+        self._fail_all("engine stopped")
+        self._log_metrics_row()
+        with self._log_lock:
+            # Closed under the log lock: an HTTP thread mid-_log_request
+            # (a late 429) must never hit a closed/None file handle.
+            if self._req_log is not None:
+                self._req_log.close()
+                self._req_log = None
+            if self._met_log is not None:
+                self._met_log.close()
+                self._met_log = None
+        if self.logdir:
+            self._registry.write_prometheus(
+                os.path.join(self.logdir, "metrics.prom")
+            )
+
+    def __enter__(self) -> "Engine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _fail_all(self, message: str) -> None:
+        with self._cond:
+            doomed = list(self._queue)
+            self._queue.clear()
+            self._m_queue.set(0)
+        doomed += [r for r in self._slots if r is not None]
+        for req in doomed:
+            req.error = message
+            self._finish(req, "error", status="error")
+
+    # -- introspection / logs ------------------------------------------------
+
+    def state(self) -> dict:
+        """JSON-safe engine state for ``GET /generatez``."""
+        with self._lock:
+            queue_depth = len(self._queue)
+        slots = [
+            None if r is None else {
+                "id": r.id, "seq_len": int(self.kv.seq_lens[i]),
+                "new_tokens": len(r.tokens),
+                "max_new_tokens": r.max_new_tokens,
+            }
+            for i, r in enumerate(self._slots)
+        ]
+        return {
+            "queue_depth": queue_depth,
+            "max_queue": self.max_queue,
+            "max_slots": self.max_slots,
+            "active_slots": sum(s is not None for s in slots),
+            "slots": slots,
+            "decode_steps": self.decode_steps,
+            "occupancy_max": self.occupancy_max,
+            "kv": self.kv.stats(),
+            "counters": dict(self.counters),
+            "prefill_chunk": self.prefill_chunk,
+            "max_context": self.kv.max_context,
+        }
+
+    def _log_request(self, req: GenRequest) -> None:
+        row = {
+            "id": req.id,
+            "status": req.status,
+            "prompt_tokens": len(req.prompt),
+            "new_tokens": len(req.tokens),
+        }
+        if req.status == "ok":
+            row.update(
+                finish_reason=req.finish_reason,
+                ttft_s=round(req.ttft_s, 6),
+                tpot_s=round(req.tpot_s, 6),
+                e2e_s=round(req.e2e_s, 6),
+                queue_s=round(max(req.t_admit - req.t_submit, 0.0), 6),
+                slot=req.slot if req.slot is not None else -1,
+                occ_mean=(round(req.occ_sum / req.occ_steps, 3)
+                          if req.occ_steps else 0.0),
+                occ_max=req.occ_max,
+            )
+        elif req.error:
+            row["error"] = req.error
+        with self._log_lock:
+            # t stamped under the lock so the stream stays time-ordered
+            # across the engine + HTTP threads (schema checker invariant);
+            # the handle re-checked under it so stop() can't close the
+            # file out from under a late writer.
+            if self._req_log is None:
+                return
+            row = {"t": time.time(), **row}
+            self._req_log.write(json.dumps(json_sanitize(row)) + "\n")
+            self._req_log.flush()
+
+    def _log_metrics_row(self) -> None:
+        kv = self.kv.stats()
+        row = {
+            "step": self.decode_steps,
+            "queue_depth": len(self._queue),
+            "active_slots": sum(r is not None for r in self._slots),
+            "occupancy_max": self.occupancy_max,
+            "blocks_free": kv["blocks_free"],
+            "kv_fragmentation": round(kv["fragmentation"], 4),
+            "requests_ok_total": self.counters["ok"],
+            "requests_rejected_total": self.counters["rejected"],
+            "requests_error_total": self.counters["error"],
+            "tokens_generated_total": self.counters["tokens_generated"],
+        }
+        with self._log_lock:
+            if self._met_log is None:
+                return
+            self._met_log.write(json.dumps(json_sanitize(row)) + "\n")
+            self._met_log.flush()
+        if self.logdir:
+            self._registry.write_prometheus(
+                os.path.join(self.logdir, "metrics.prom")
+            )

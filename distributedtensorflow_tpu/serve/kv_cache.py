@@ -1,0 +1,210 @@
+"""Paged KV cache: a block pool + per-slot page tables + an allocator.
+
+The dense serving cache (``models.generate``) pins ``max_seq`` tokens of
+K/V per batch slot for the whole request lifetime — a 16-token reply in a
+slot sized for 2048 tokens wastes 99% of the slot's HBM.  This module is
+the vLLM-style fix, built on the same sequence-chunking idiom as
+``ops/blockwise.py``: K/V live in a pool of fixed-size **blocks** shared
+by every slot, each slot's **page table** row names the blocks holding
+its sequence, and a free-list **allocator** hands blocks out per request
+— so memory held is proportional to tokens actually resident, and a
+finished sequence's blocks return to the pool the moment it is evicted.
+
+Device-side state is functional (jnp arrays threaded through the two
+compiled serving programs — see ``serve.model``); this module owns the
+HOST-side bookkeeping: the allocator free list, the numpy page tables and
+sequence lengths the engine mutates between steps.  Single-writer by
+design: only the engine loop thread touches a ``PagedKVCache`` (the
+HTTP threads go through the engine's queue), so there are no locks here.
+
+Layout: ``(num_layers, num_blocks + 1, block_size, kv_heads, head_dim)``
+per pool — one stacked array for all layers so the decode program indexes
+layers without a pytree of leaves.  The extra physical block at index
+``num_blocks`` is the **scratch block**: inactive slots' writes land
+there (static-shape decode steps always write ``max_slots`` tokens), and
+unallocated page-table entries point at it, so no masking is needed on
+the write path and garbage reads are confined to slots whose outputs the
+engine discards anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class OutOfBlocksError(RuntimeError):
+    """Raised on ``free``/table misuse; ``alloc`` returns None instead."""
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` uniform physical blocks.
+
+    ``alloc(n)`` is all-or-nothing (a request is admitted only when its
+    whole worst-case footprint fits — no mid-flight OOM, see
+    ``serve.engine``); ``free`` returns blocks and rejects double-frees
+    loudly (a double-free means two slots share a block — silent cache
+    corruption).  Blocks are uniform so there is no external
+    fragmentation; the waste mode is *internal* (allocated-but-unused
+    tokens inside a request's last block and its not-yet-generated tail),
+    reported by :meth:`PagedKVCache.stats`.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))  # pop() -> block 0 first
+        self._used: set[int] = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._used)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` physical block ids, or None when fewer than ``n`` are free
+        (all-or-nothing: never a partial grant)."""
+        if n < 0:
+            raise ValueError(f"alloc({n}) is negative")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._used.update(blocks)
+        return blocks
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b not in self._used:
+                raise OutOfBlocksError(
+                    f"free({b}): block is not allocated (double free or "
+                    "foreign id)"
+                )
+            self._used.remove(b)
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class SlotPages:
+    """One slot's page-table bookkeeping (host side)."""
+
+    blocks: list[int]          # physical block ids, logical order
+    capacity_tokens: int       # blocks * block_size
+    used_tokens: int = 0       # K/V positions actually written so far
+
+
+class PagedKVCache:
+    """Block-pool KV storage for ``max_slots`` concurrent sequences.
+
+    Device arrays (``k_pool``/``v_pool``) are created once and threaded
+    functionally through the serving programs; the engine assigns the
+    updated arrays back after every call.  Host state (page tables,
+    lengths) advances in lockstep on the engine thread.
+    """
+
+    def __init__(self, *, num_layers: int, kv_heads: int, head_dim: int,
+                 max_slots: int, num_blocks: int, block_size: int,
+                 max_context: int, dtype=jnp.float32):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_context % block_size:
+            raise ValueError(
+                f"max_context={max_context} must be a multiple of "
+                f"block_size={block_size}"
+            )
+        self.block_size = block_size
+        self.max_slots = max_slots
+        self.max_context = max_context
+        self.blocks_per_slot = max_context // block_size
+        self.scratch_block = num_blocks  # reserved physical block
+        self.allocator = BlockAllocator(num_blocks)
+        shape = (num_layers, num_blocks + 1, block_size, kv_heads, head_dim)
+        self.k_pool = jnp.zeros(shape, dtype)
+        self.v_pool = jnp.zeros(shape, dtype)
+        # Unallocated entries point at the scratch block (always a legal
+        # physical index; reads through it are masked by seq_lens).
+        self.block_tables = np.full(
+            (max_slots, self.blocks_per_slot), self.scratch_block, np.int32
+        )
+        self.seq_lens = np.zeros((max_slots,), np.int32)
+        self.pages: list[SlotPages | None] = [None] * max_slots
+
+    # -- admission / eviction (engine thread only) ---------------------------
+
+    def blocks_for(self, tokens: int) -> int:
+        """Physical blocks needed to hold ``tokens`` K/V positions."""
+        return -(-tokens // self.block_size)
+
+    def admit(self, slot: int, tokens: int) -> bool:
+        """Reserve a slot's worst-case footprint (``tokens`` positions).
+
+        All-or-nothing; False = pool pressure, caller keeps the request
+        queued.  The slot must be empty (engine invariant)."""
+        if self.pages[slot] is not None:
+            raise OutOfBlocksError(f"slot {slot} is already occupied")
+        if tokens > self.max_context:
+            raise ValueError(
+                f"{tokens} tokens exceed max_context={self.max_context}"
+            )
+        n = self.blocks_for(tokens)
+        blocks = self.allocator.alloc(n)
+        if blocks is None:
+            return False
+        self.pages[slot] = SlotPages(blocks, n * self.block_size)
+        self.block_tables[slot, :] = self.scratch_block
+        self.block_tables[slot, : len(blocks)] = blocks
+        self.seq_lens[slot] = 0
+        return True
+
+    def release(self, slot: int) -> None:
+        """Return the slot's blocks to the pool (eviction path)."""
+        pages = self.pages[slot]
+        if pages is None:
+            return
+        self.allocator.free(pages.blocks)
+        self.pages[slot] = None
+        self.block_tables[slot, :] = self.scratch_block
+        self.seq_lens[slot] = 0
+
+    def note_written(self, slot: int, tokens: int) -> None:
+        """Advance a slot's resident-token count (after a program wrote
+        K/V); bounded by the reservation so a scheduler bug trips here,
+        not as silent cross-slot corruption."""
+        pages = self.pages[slot]
+        if pages is None:
+            raise OutOfBlocksError(f"slot {slot} has no pages")
+        if tokens > pages.capacity_tokens:
+            raise OutOfBlocksError(
+                f"slot {slot}: {tokens} tokens exceed reserved capacity "
+                f"{pages.capacity_tokens}"
+            )
+        pages.used_tokens = tokens
+        self.seq_lens[slot] = tokens
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pool occupancy + internal-fragmentation stats (for
+        ``GET /generatez`` and the engine's metrics.jsonl rows)."""
+        used = [p for p in self.pages if p is not None]
+        allocated_tokens = sum(p.capacity_tokens for p in used)
+        used_tokens = sum(p.used_tokens for p in used)
+        return {
+            "block_size": self.block_size,
+            "blocks_total": self.allocator.num_blocks,
+            "blocks_free": self.allocator.free_blocks,
+            "blocks_used": self.allocator.used_blocks,
+            "slots_occupied": len(used),
+            "allocated_tokens": allocated_tokens,
+            "resident_tokens": used_tokens,
+            # 0 = every allocated token holds real K/V; 1 = all waste.
+            "fragmentation": (
+                1.0 - used_tokens / allocated_tokens if allocated_tokens
+                else 0.0
+            ),
+        }

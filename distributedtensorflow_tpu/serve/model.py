@@ -1,0 +1,204 @@
+"""The serving engine's two compiled programs: chunked prefill + paged decode.
+
+Prefill/decode disaggregation: a serving step is either (a) teacher-forced
+ingestion of a prompt chunk — big matmuls, compute-bound — or (b) one
+token for every active slot — cache streaming, memory-bound.  Fusing them
+(the ``models.generate`` whole-batch scan) forces every request in the
+batch to the same phase; splitting them lets the scheduler admit a new
+prompt while other slots keep decoding.  Both programs have fully static
+shapes, so a serving process compiles **exactly two** XLA executables:
+
+- :func:`make_prefill_fn` — one ``prefill_chunk``-wide slice of one
+  prompt through :func:`models.generate.prefill` (the dense flax cache
+  path, so prefill math is byte-identical to training-side decode), plus
+  a scatter of the chunk's K/V into the paged pool.  Any prompt length =
+  a Python loop of these fixed-width calls.
+- :func:`make_decode_fn` — one token for all ``max_slots`` slots against
+  the paged pool (``ops.attention.paged_decode_attention``).  The forward
+  is rebuilt here from the raw param tree (flax's cache collection owns a
+  dense per-slot buffer and can't address a shared pool); equivalence
+  with ``GPTLM`` is pinned by tests/test_serve.py, and every dtype choice
+  (bf16 matmuls, fp32 layernorm/softmax/logits) mirrors ``models/gpt.py``
+  line for line.
+
+The pool arrays are donated: steady-state serving does not allocate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generate import prefill
+from ..models.gpt import GPTConfig, rope, rope_tables
+from ..ops.attention import paged_decode_attention
+from ..ops.layernorm import layer_norm
+from ..ops.xent import tied_head_logits
+
+__all__ = [
+    "make_prefill_cache",
+    "make_prefill_fn",
+    "make_decode_fn",
+    "reset_cache_index",
+]
+
+
+def _check_servable(cfg: GPTConfig) -> None:
+    if cfg.attn_window is not None:
+        raise ValueError(
+            "the paged decode program does not implement sliding-window "
+            "masking yet; serve with attn_window=None"
+        )
+    if cfg.dropout_rate:
+        raise ValueError("serving is deterministic; set dropout_rate=0")
+
+
+def make_prefill_cache(cfg: GPTConfig):
+    """Zeroed dense prefill cache, structurally identical to the flax
+    ``"cache"`` collection ``GPTLM(decode=True)`` would create — built by
+    hand so the engine never traces a third (cache-creating) program.
+    One buffer serves every admission: :func:`reset_cache_index` rewinds
+    it and stale K/V beyond the index is masked by the decode-mode
+    validity rule (``k_idx <= q_pos``)."""
+    head_dim = cfg.hidden_size // cfg.num_heads
+    kv = (1, cfg.kv_heads, cfg.max_seq, head_dim)
+    return {
+        f"h{i}": {"attn": {
+            "cached_key": jnp.zeros(kv, cfg.dtype),
+            "cached_value": jnp.zeros(kv, cfg.dtype),
+            "cache_index": jnp.zeros((), jnp.int32),
+        }}
+        for i in range(cfg.num_layers)
+    }
+
+
+def reset_cache_index(cache):
+    """Rewind a prefill cache to position 0 for the next admission (host
+    dict rebuild; the K/V buffers are reused in place)."""
+    return {
+        name: {"attn": {**layer["attn"],
+                        "cache_index": jnp.zeros((), jnp.int32)}}
+        for name, layer in cache.items()
+    }
+
+
+def make_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int):
+    """Compiled program (a): one fixed-width prompt chunk.
+
+    ``fn(params, k_pool, v_pool, cache, tokens, start, table_row,
+    last_ix) -> (last_logits, cache, k_pool, v_pool)`` where ``tokens``
+    is ``(1, chunk)``, ``start`` the chunk's first absolute position,
+    ``table_row`` the slot's ``(blocks_per_slot,)`` page-table row, and
+    ``last_ix`` the in-chunk index whose logits the engine wants (the
+    final prompt token's, clamped into range on non-final chunks whose
+    logits are discarded).  The chunk's K/V are sliced out of the dense
+    flax cache and scattered to the slot's pool blocks."""
+    _check_servable(cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def prefill_chunk(params, k_pool, v_pool, cache, tokens, start,
+                      table_row, last_ix):
+        positions = (start + jnp.arange(chunk, dtype=jnp.int32))[None, :]
+        logits, cache = prefill(params, tokens, positions, cfg=cfg,
+                                cache=cache)
+        num_layers, nb_total, bs, h_kv, d = k_pool.shape
+        pos = start + jnp.arange(chunk)
+        idx = table_row[pos // block_size] * bs + pos % block_size  # (chunk,)
+        k_new = jnp.stack([
+            jax.lax.dynamic_slice_in_dim(
+                cache[f"h{i}"]["attn"]["cached_key"], start, chunk, axis=2
+            )[0].transpose(1, 0, 2)  # (chunk, Hkv, D)
+            for i in range(num_layers)
+        ])  # (L, chunk, Hkv, D)
+        v_new = jnp.stack([
+            jax.lax.dynamic_slice_in_dim(
+                cache[f"h{i}"]["attn"]["cached_value"], start, chunk, axis=2
+            )[0].transpose(1, 0, 2)
+            for i in range(num_layers)
+        ])
+        k_pool = k_pool.reshape(num_layers, nb_total * bs, h_kv, d) \
+            .at[:, idx].set(k_new).reshape(k_pool.shape)
+        v_pool = v_pool.reshape(num_layers, nb_total * bs, h_kv, d) \
+            .at[:, idx].set(v_new).reshape(v_pool.shape)
+        return logits[0, last_ix], cache, k_pool, v_pool
+
+    return prefill_chunk
+
+
+def make_decode_fn(cfg: GPTConfig):
+    """Compiled program (b): one decode token for every slot.
+
+    ``fn(params, k_pool, v_pool, tokens, block_tables, seq_lens, active)
+    -> (logits, k_pool, v_pool)`` with ``tokens`` ``(max_slots,)`` (each
+    slot's last sampled token), ``seq_lens`` the resident token counts
+    (the new token is written at that position, then attends ``seq_len +
+    1`` positions), and ``active`` masking unoccupied slots: their write
+    lands in the reserved scratch block and their logits are discarded by
+    the engine, so the program shape never depends on occupancy."""
+    _check_servable(cfg)
+    num_layers = cfg.num_layers
+    n_heads = cfg.num_heads
+    h_kv = cfg.kv_heads
+    head_dim = cfg.hidden_size // n_heads
+    hidden = cfg.hidden_size
+    kv_width = h_kv * head_dim
+
+    def _ln(x, p, out_dtype=None):
+        return layer_norm(x, p["scale"], p["bias"], eps=1e-6,
+                          out_dtype=out_dtype or x.dtype)
+
+    def _dense(x, kernel):
+        # flax nn.Dense(dtype=cfg.dtype, use_bias=False): both operands
+        # cast to the compute dtype, default accumulation.
+        return x @ kernel.astype(cfg.dtype)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def decode(params, k_pool, v_pool, tokens, block_tables, seq_lens,
+               active):
+        b = tokens.shape[0]
+        _, nb_total, bs, _, _ = k_pool.shape
+        x = params["wte"]["embedding"].astype(cfg.dtype)[tokens][:, None, :]
+        positions = seq_lens.astype(jnp.int32)[:, None]  # (B, 1)
+        tabs = rope_tables(positions, head_dim, cfg.rope_theta, cfg.dtype)
+        # Write coordinates for the new token: active slots append at
+        # seq_len inside their own pages; inactive slots hit scratch.
+        blk = jnp.take_along_axis(
+            block_tables, (seq_lens // bs)[:, None], axis=1
+        )[:, 0]
+        idx = jnp.where(active, blk * bs + seq_lens % bs,
+                        (nb_total - 1) * bs)
+        attend_lens = jnp.where(active, seq_lens + 1, 1)
+        kf = k_pool.reshape(num_layers, nb_total * bs, h_kv, head_dim)
+        vf = v_pool.reshape(num_layers, nb_total * bs, h_kv, head_dim)
+        for layer in range(num_layers):
+            p = params[f"h{layer}"]
+            h = _ln(x, p["ln1"])
+            qkv = _dense(h, p["attn"]["qkv"]["kernel"])
+            q = qkv[..., :hidden].reshape(b, 1, n_heads, head_dim)
+            k = qkv[..., hidden:hidden + kv_width].reshape(b, 1, h_kv,
+                                                           head_dim)
+            v = qkv[..., hidden + kv_width:].reshape(b, 1, h_kv, head_dim)
+            q = rope(q, positions, cfg.rope_theta, tabs)
+            k = rope(k, positions, cfg.rope_theta, tabs)
+            kf = kf.at[layer, idx].set(k[:, 0])
+            vf = vf.at[layer, idx].set(v[:, 0])
+            out = paged_decode_attention(
+                q[:, 0],
+                kf[layer].reshape(nb_total, bs, h_kv, head_dim),
+                vf[layer].reshape(nb_total, bs, h_kv, head_dim),
+                block_tables, attend_lens,
+            ).reshape(b, 1, hidden).astype(cfg.dtype)
+            x = x + _dense(out, p["attn"]["proj"]["kernel"])
+            h = _ln(x, p["ln2"])
+            m = _dense(jax.nn.gelu(_dense(h, p["fc_in"]["kernel"])),
+                       p["fc_out"]["kernel"])
+            x = x + m
+        xf = _ln(x, params["ln_f"], out_dtype=jnp.float32)
+        logits = tied_head_logits(
+            xf[:, 0], params["wte"]["embedding"], cfg.dtype
+        )
+        return logits, kf.reshape(k_pool.shape), vf.reshape(v_pool.shape)
+
+    return decode

@@ -1,0 +1,179 @@
+"""HTTP serving frontend: ``/generatez`` on the StatusServer pattern.
+
+A thin blocking-JSON frontend over :class:`serve.engine.Engine`, riding
+``obs.server.StatusServer`` (stdlib ``http.server`` background thread, one
+handler thread per request) so a serving process exposes the whole
+introspection family — ``/healthz``, ``/statusz``, ``/varz`` (live
+Prometheus incl. the ``serve_*`` SLO histograms), ``/threadz``, ``/memz``
+— next to the generation endpoint, no third-party deps.
+
+Endpoint contract (docs/API.md "Serving"):
+
+- ``POST /generatez`` — body ``{"prompt": [int, ...], "max_new_tokens":
+  int, "temperature"?: float, "top_k"?: int, "eos_token_id"?: int,
+  "seed"?: int, "timeout_s"?: float}``.  Blocks until the request reaches
+  a terminal state; replies 200 ``{"id", "tokens", "finish_reason",
+  "prompt_tokens", "new_tokens", "ttft_s", "tpot_s", "e2e_s"}``.  Error
+  mapping: malformed body/parameters → 400, queue full (backpressure) →
+  429, engine failure → 500, wall-clock timeout → 504 (the request keeps
+  running server-side; poll ``GET /generatez`` for slot state).
+- ``GET /generatez`` — engine state JSON: queue depth, slot occupancy,
+  paged-KV budget, admission/eviction counters (the scheduler's live
+  control surface).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+
+from ..obs.server import StatusServer
+from .engine import Engine, QueueFullError
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+__all__ = ["ServeServer"]
+
+#: Cap on how long one POST handler thread blocks awaiting generation.
+DEFAULT_TIMEOUT_S = 300.0
+
+
+def _as_int(v) -> int:
+    """Strict JSON-int: 4.9 (or true) must 400, not truncate to 4."""
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise ValueError(f"not an integer: {v!r}")
+    return v
+
+
+def _as_float(v) -> float:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise ValueError(f"not a number: {v!r}")
+    return float(v)
+
+
+class ServeServer:
+    """Background-thread HTTP server wrapping an :class:`Engine`.
+
+    ``port=0`` binds an ephemeral port (``server.port`` tells).  The
+    engine is NOT owned: callers start/stop it (so tests can drive the
+    scheduler synchronously under a live frontend)."""
+
+    def __init__(self, engine: Engine, port: int = 0, *,
+                 host: str = "127.0.0.1", registry=None,
+                 default_timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.engine = engine
+        self._default_timeout_s = default_timeout_s
+        self._srv = StatusServer(
+            port, host=host, registry=registry,
+            status_fn=lambda: {"serving": engine.state()},
+            health_fn=self._health,
+            routes={
+                ("GET", "/generatez"): self._get_state,
+                ("POST", "/generatez"): self._post_generate,
+            },
+        )
+
+    @property
+    def port(self) -> int:
+        return self._srv.port
+
+    def _health(self) -> dict:
+        st = self.engine.state()
+        return {
+            # a dead scheduler loop must flip /healthz to 503 — the
+            # process otherwise looks routable while serving nothing
+            "ok": self.engine.healthy,
+            "queue_depth": st["queue_depth"],
+            "active_slots": st["active_slots"],
+            "decode_steps": st["decode_steps"],
+        }
+
+    # -- handlers (HTTP threads) ---------------------------------------------
+
+    def _get_state(self, query: str):
+        return 200, self.engine.state()
+
+    def _post_generate(self, query: str, body: bytes):
+        try:
+            payload = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            return 400, {"error": f"invalid JSON body: {e}"}
+        if not isinstance(payload, dict):
+            return 400, {"error": "body must be a JSON object"}
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, list) or not prompt or not all(
+            isinstance(t, int) and not isinstance(t, bool) for t in prompt
+        ):
+            return 400, {"error": "'prompt' must be a non-empty list of "
+                                  "token ids"}
+        kwargs = {}
+        for name, cast in (("max_new_tokens", _as_int),
+                           ("temperature", _as_float),
+                           ("top_k", _as_int), ("eos_token_id", _as_int),
+                           ("seed", _as_int)):
+            if payload.get(name) is not None:
+                try:
+                    kwargs[name] = cast(payload[name])
+                except (TypeError, ValueError):
+                    return 400, {"error": f"bad {name!r}: "
+                                          f"{payload[name]!r}"}
+        if "max_new_tokens" not in kwargs:
+            return 400, {"error": "'max_new_tokens' is required"}
+        timeout = payload.get("timeout_s")
+        if timeout is None:
+            timeout = self._default_timeout_s
+        try:
+            timeout = float(timeout)
+        except (TypeError, ValueError):
+            return 400, {"error": f"bad 'timeout_s': {timeout!r}"}
+        if not math.isfinite(timeout) or timeout < 0:
+            # json.loads accepts the Infinity literal; Event.wait would
+            # raise OverflowError AFTER the request had been submitted.
+            return 400, {"error": f"'timeout_s' must be a finite number "
+                                  f">= 0, got {timeout}"}
+        timeout = min(timeout, threading.TIMEOUT_MAX)
+        try:
+            req = self.engine.submit(prompt, **kwargs)
+        except QueueFullError as e:
+            return 429, {"error": str(e)}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except RuntimeError as e:  # dead scheduler loop
+            return 503, {"error": str(e)}
+        if not req.wait(timeout):
+            return 504, {"error": f"generation exceeded timeout_s="
+                                  f"{timeout}", "id": req.id}
+        if req.status != "ok":
+            return 500, {"error": req.error or f"request {req.status}",
+                         "id": req.id}
+        return 200, {
+            "id": req.id,
+            "tokens": req.tokens,
+            "finish_reason": req.finish_reason,
+            "prompt_tokens": len(req.prompt),
+            "new_tokens": len(req.tokens),
+            "ttft_s": round(req.ttft_s, 6),
+            "tpot_s": round(req.tpot_s, 6),
+            "e2e_s": round(req.e2e_s, 6),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServeServer":
+        self._srv.start()
+        logger.info("serving frontend on port %d (POST /generatez)",
+                    self.port)
+        return self
+
+    def stop(self) -> None:
+        self._srv.stop()
+
+    close = stop
+
+    def __enter__(self) -> "ServeServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""serve.py — continuous-batching generation server entry point (ISSUE 6).
+
+Loads a GPT config (checkpoint or random init), builds the paged-KV
+serving engine, and fronts it with the ``/generatez`` HTTP endpoint plus
+the whole ``/statusz`` introspection family.  One process per host; the
+model may be mesh-sharded (GSPMD partitions both serving programs the
+same way it partitions ``models.generate``).
+
+Examples:
+
+  # random-init tiny model on an ephemeral port (CI smoke):
+  python serve.py --config gpt_tiny --port 0 --logdir /tmp/serve
+
+  # serve a trained gpt_lm checkpoint:
+  python serve.py --config gpt_small --checkpoint ckpts/ --port 8600 \\
+      --max-slots 8 --max-queue 128 --block-size 32
+
+On startup one JSON line goes to stdout — ``{"serving": true, "port": N,
+"logdir": ...}`` — so launchers (and the CI smoke) can find an ephemeral
+port.  SIGINT/SIGTERM drain in-flight requests, flush ``requests.jsonl``
+/ ``metrics.prom``, and exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import time
+
+
+#: --config choice -> (GPTConfig factory name, matching train.py workload).
+CONFIGS = {
+    "gpt_tiny": ("gpt_tiny", ("gpt_lm", True)),
+    "gpt_small": ("gpt_small", ("gpt_lm", False)),
+    "gpt_medium": ("gpt_medium", ("gpt_medium_lm", False)),
+}
+
+
+def build_params(args, cfg):
+    """Checkpoint-or-random parameter init.
+
+    ``--checkpoint`` restores the newest VERIFIED train checkpoint (the
+    resilience-tentpole fallback applies) via the matching train.py
+    workload's state template, then serves its ``params``; otherwise a
+    seeded random init (load tests, CI)."""
+    import jax
+
+    if not args.checkpoint:
+        import numpy as np
+
+        from distributedtensorflow_tpu.models import GPTLM
+
+        logging.info("random-init params (no --checkpoint)")
+        return GPTLM(cfg).init(
+            jax.random.PRNGKey(args.seed), np.zeros((1, 1), np.int32),
+            deterministic=True,
+        )["params"]
+    from distributedtensorflow_tpu.checkpoint import CheckpointManager
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributedtensorflow_tpu.train.state import create_sharded_state
+    from distributedtensorflow_tpu.workloads import get_workload
+
+    workload, test_size = CONFIGS[args.config][1]
+    wl = get_workload(workload, test_size=test_size)
+    mesh = build_mesh(MeshSpec(data=-1))
+    state, _ = create_sharded_state(
+        wl.init_fn, wl.make_optimizer(), mesh, jax.random.PRNGKey(args.seed),
+        rules=wl.layout, fsdp=wl.fsdp,
+    )
+    restored = CheckpointManager(args.checkpoint).restore_latest(state)
+    if restored is None:
+        raise SystemExit(
+            f"--checkpoint {args.checkpoint}: no usable checkpoint found"
+        )
+    logging.info("restored checkpoint step %d from %s",
+                 int(restored.step), args.checkpoint)
+    return restored.params
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", choices=sorted(CONFIGS), default="gpt_small")
+    p.add_argument("--checkpoint", default=None,
+                   help="train.py checkpoint dir to serve (default: "
+                        "random init)")
+    p.add_argument("--port", type=int, default=8600,
+                   help="HTTP port (0 = ephemeral; printed on stdout)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (loopback default; the endpoints "
+                        "have no auth)")
+    p.add_argument("--max-slots", type=int, default=4,
+                   help="concurrent decode slots (the batch dimension of "
+                        "the compiled decode program)")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="bounded request queue; beyond it POSTs get 429")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="paged-KV block size in tokens")
+    p.add_argument("--kv-blocks", type=int, default=None,
+                   help="total KV pool blocks (default: max-slots * "
+                        "max-context/block-size = no oversubscription)")
+    p.add_argument("--prefill-chunk", type=int, default=16,
+                   help="prefill program width in tokens")
+    p.add_argument("--max-context", type=int, default=None,
+                   help="serving context cap (default: model max_seq)")
+    p.add_argument("--max-new-cap", type=int, default=None,
+                   help="reject requests asking for more new tokens")
+    p.add_argument("--logdir", default=None,
+                   help="writes requests.jsonl / metrics.jsonl / "
+                        "metrics.prom here")
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(message)s",
+    )
+
+    import jax.numpy as jnp  # noqa: F401 — force backend init before serving
+
+    from distributedtensorflow_tpu import models
+    from distributedtensorflow_tpu.serve import Engine, ServeServer
+
+    cfg = getattr(models, CONFIGS[args.config][0])()
+    params = build_params(args, cfg)
+    engine = Engine(
+        params, cfg,
+        max_slots=args.max_slots, max_queue=args.max_queue,
+        block_size=args.block_size, num_blocks=args.kv_blocks,
+        prefill_chunk=args.prefill_chunk, max_context=args.max_context,
+        max_new_cap=args.max_new_cap, logdir=args.logdir,
+        log_every=args.log_every,
+    ).start()
+    server = ServeServer(engine, args.port, host=args.host).start()
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        logging.info("signal %d: draining and shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+
+    # The launcher/smoke contract: one machine-readable line on stdout.
+    print(json.dumps({
+        "serving": True, "port": server.port, "config": args.config,
+        "max_slots": args.max_slots, "logdir": args.logdir,
+    }), flush=True)
+    logging.info(
+        "serving %s on %s:%d (slots=%d queue=%d block=%d)",
+        args.config, args.host, server.port, args.max_slots,
+        args.max_queue, args.block_size,
+    )
+    while not stop.is_set():
+        time.sleep(0.2)
+    server.stop()
+    engine.stop(drain=True)
+    st = engine.state()
+    logging.info(
+        "served %d ok / %d rejected / %d error; %d tokens, peak "
+        "occupancy %d", st["counters"]["ok"], st["counters"]["rejected"],
+        st["counters"]["error"], st["counters"]["tokens_generated"],
+        st["occupancy_max"],
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

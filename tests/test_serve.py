@@ -1,0 +1,420 @@
+"""Serving engine tests: allocator, paged KV, scheduler invariants.
+
+The load-bearing checks: (1) the paged decode path produces the SAME
+tokens as the dense ``models.generate`` loop (cache correctness is
+equivalence, not plausibility — same bar as test_generate.py); (2) the
+scheduler never leaks a slot or a block, admits strictly FIFO, and
+actually batches continuously (a freed slot is refilled while other
+sequences keep decoding).
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.models import GPTLM, generate, gpt_tiny
+from distributedtensorflow_tpu.serve import (
+    BlockAllocator,
+    Engine,
+    OutOfBlocksError,
+    PagedKVCache,
+    QueueFullError,
+)
+
+# ---------------------------------------------------------------- allocator
+
+
+def test_allocator_all_or_nothing():
+    a = BlockAllocator(4)
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3 and len(set(got)) == 3
+    assert a.alloc(2) is None  # only 1 free: no partial grant
+    assert a.free_blocks == 1 and a.used_blocks == 3
+    a.free(got)
+    assert a.free_blocks == 4 and a.used_blocks == 0
+    assert a.alloc(4) is not None
+
+
+def test_allocator_double_free_raises():
+    a = BlockAllocator(2)
+    got = a.alloc(1)
+    a.free(got)
+    with pytest.raises(OutOfBlocksError, match="double free|not allocated"):
+        a.free(got)
+    with pytest.raises(OutOfBlocksError):
+        a.free([99])
+
+
+def test_allocator_exhaustion_and_reuse():
+    a = BlockAllocator(3)
+    x = a.alloc(3)
+    assert a.alloc(1) is None
+    a.free(x[:1])
+    y = a.alloc(1)
+    assert y == x[:1]  # the freed block is reused
+
+
+# ------------------------------------------------------------- paged kv cache
+
+
+def _kv(num_blocks=8, block_size=4, max_context=16, max_slots=2):
+    return PagedKVCache(
+        num_layers=1, kv_heads=2, head_dim=4, max_slots=max_slots,
+        num_blocks=num_blocks, block_size=block_size,
+        max_context=max_context,
+    )
+
+
+def test_kv_admit_release_no_leak():
+    kv = _kv()
+    assert kv.admit(0, tokens=6)  # 2 blocks of 4
+    assert kv.allocator.used_blocks == 2
+    assert (kv.block_tables[0, :2] != kv.scratch_block).all()
+    assert (kv.block_tables[0, 2:] == kv.scratch_block).all()
+    kv.note_written(0, 5)
+    stats = kv.stats()
+    assert stats["slots_occupied"] == 1
+    assert stats["allocated_tokens"] == 8 and stats["resident_tokens"] == 5
+    assert stats["fragmentation"] == pytest.approx(3 / 8)
+    kv.release(0)
+    assert kv.allocator.used_blocks == 0
+    assert (kv.block_tables == kv.scratch_block).all()
+    assert kv.stats()["fragmentation"] == 0.0
+
+
+def test_kv_admit_pressure_and_guards():
+    kv = _kv(num_blocks=3, block_size=4, max_context=16)
+    assert kv.admit(0, tokens=12)  # 3 blocks: pool drained
+    assert not kv.admit(1, tokens=4)  # pressure: all-or-nothing False
+    with pytest.raises(OutOfBlocksError, match="occupied"):
+        kv.admit(0, tokens=4)
+    with pytest.raises(ValueError, match="max_context"):
+        kv.release(0) or kv.admit(0, tokens=32)
+    kv.admit(0, tokens=4)
+    with pytest.raises(OutOfBlocksError, match="capacity"):
+        kv.note_written(0, 5)
+
+
+# ------------------------------------------------- paged attention equivalence
+
+
+@pytest.mark.parametrize("h,h_kv", [(4, 4), (4, 2)])
+def test_paged_decode_attention_matches_dense(h, h_kv):
+    """Gather-through-page-table attention == plain masked attention over
+    the same (contiguously laid out) K/V, incl. GQA grouping."""
+    from distributedtensorflow_tpu.ops.attention import (
+        paged_decode_attention,
+    )
+
+    b, d, bs, max_blocks = 2, 8, 4, 3
+    rng = np.random.default_rng(0)
+    cap = max_blocks * bs
+    k_seq = rng.standard_normal((b, cap, h_kv, d)).astype(np.float32)
+    v_seq = rng.standard_normal((b, cap, h_kv, d)).astype(np.float32)
+    q = rng.standard_normal((b, h, d)).astype(np.float32)
+    seq_lens = np.array([5, 9], np.int32)
+
+    # scatter the sequences into a shuffled pool (+1 scratch block)
+    num_blocks = b * max_blocks
+    perm = rng.permutation(num_blocks)
+    k_pool = np.zeros((num_blocks + 1, bs, h_kv, d), np.float32)
+    v_pool = np.zeros_like(k_pool)
+    tables = np.full((b, max_blocks), num_blocks, np.int32)
+    for i in range(b):
+        for j in range(max_blocks):
+            phys = int(perm[i * max_blocks + j])
+            tables[i, j] = phys
+            k_pool[phys] = k_seq[i, j * bs: (j + 1) * bs]
+            v_pool[phys] = v_seq[i, j * bs: (j + 1) * bs]
+
+    out = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(seq_lens),
+    ))
+
+    g = h // h_kv
+    for i in range(b):
+        n = seq_lens[i]
+        for head in range(h):
+            kh = k_seq[i, :n, head // g]       # (n, d)
+            vh = v_seq[i, :n, head // g]
+            s = kh @ q[i, head] / np.sqrt(d)
+            w = np.exp(s - s.max())
+            w /= w.sum()
+            np.testing.assert_allclose(
+                out[i, head], w @ vh, rtol=1e-5, atol=1e-5
+            )
+
+
+# ---------------------------------------------------------------- the engine
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32, max_seq=64)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 8), 0, cfg.vocab_size)
+    params = GPTLM(cfg).init(rng, ids)["params"]
+    return cfg, params, ids
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("max_context", 64)
+    return Engine(params, cfg, **kw)
+
+
+def _drain(engine, reqs, max_steps=500):
+    """Drive the scheduler synchronously until every request is terminal."""
+    for _ in range(max_steps):
+        if all(r._done.is_set() for r in reqs):
+            return
+        engine.step()
+    raise AssertionError("engine did not finish within max_steps")
+
+
+def test_engine_matches_dense_generate(served_model):
+    """Continuous-batching greedy output == the dense whole-batch scan,
+    token for token, for BOTH batch rows served as separate requests."""
+    cfg, params, ids = served_model
+    dense = np.asarray(generate(params, ids, cfg=cfg, max_new_tokens=6))
+    eng = _engine(cfg, params)
+    reqs = [
+        eng.submit([int(t) for t in np.asarray(ids)[i]], max_new_tokens=6)
+        for i in range(2)
+    ]
+    _drain(eng, reqs)
+    for i, r in enumerate(reqs):
+        assert r.status == "ok"
+        assert r.tokens == list(dense[i, 8:])
+
+
+def test_engine_matches_dense_generate_bf16():
+    """The same equivalence at the PRODUCTION dtype: the hand-rolled
+    paged decode program's bf16/fp32 recipe must track models/gpt.py
+    exactly (gpt_tiny's default dtype is bfloat16)."""
+    cfg = dataclasses.replace(gpt_tiny(), max_seq=64)  # default bf16
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (1, 8), 0, cfg.vocab_size)
+    params = GPTLM(cfg).init(rng, ids)["params"]
+    dense = np.asarray(generate(params, ids, cfg=cfg, max_new_tokens=5))
+    eng = _engine(cfg, params)
+    req = eng.submit([int(t) for t in np.asarray(ids)[0]], max_new_tokens=5)
+    _drain(eng, [req])
+    assert req.tokens == list(dense[0, 8:])
+
+
+def test_continuous_batching_freed_slot_admission(served_model):
+    """A short request's slot is refilled while the long one still
+    decodes: occupancy hits 2, the queued request is admitted into the
+    freed slot, and nothing leaks."""
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params, max_slots=2)
+    long_req = eng.submit(prompt, max_new_tokens=24)
+    short = eng.submit(prompt, max_new_tokens=2)
+    queued = eng.submit(prompt, max_new_tokens=2)
+    _drain(eng, [long_req, short, queued])
+    assert [r.status for r in (long_req, short, queued)] == ["ok"] * 3
+    assert eng.occupancy_max == 2
+    assert eng.counters["admits_into_freed_slot"] >= 1
+    # the queued request joined while the long one was still active
+    assert queued.t_done < long_req.t_done
+    # no slot / block leak
+    assert all(s is None for s in eng._slots)
+    assert eng.kv.allocator.used_blocks == 0
+    assert eng.kv.allocator.free_blocks == eng.kv.allocator.num_blocks
+
+
+def test_fifo_admission_under_backpressure(served_model):
+    """One slot, three requests: admission (and completion) strictly
+    follows arrival order — a later small request never jumps the head."""
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params, max_slots=1)
+    a = eng.submit(prompt, max_new_tokens=8)
+    b = eng.submit(prompt[:3], max_new_tokens=2)  # smaller, arrives later
+    c = eng.submit(prompt[:2], max_new_tokens=2)
+    _drain(eng, [a, b, c])
+    assert a.t_admit <= b.t_admit <= c.t_admit
+    assert a.t_done <= b.t_done <= c.t_done
+
+
+def test_block_pressure_blocks_admission_head_of_line(served_model):
+    """With a pool too small for two concurrent requests, the second
+    waits for the first's eviction even though a slot is free."""
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]  # 8 tokens
+    # footprint(8 prompt, 4 new) = 12 tokens = 3 blocks of 4; pool of 4
+    # blocks fits one request plus nothing.
+    eng = _engine(cfg, params, max_slots=2, num_blocks=4)
+    a = eng.submit(prompt, max_new_tokens=4)
+    b = eng.submit(prompt, max_new_tokens=4)
+    eng.step()  # admits a only (b would need 3 more blocks)
+    assert a.status == "active" and b.status == "queued"
+    assert eng.occupancy_max <= 1
+    _drain(eng, [a, b])
+    assert a.status == "ok" and b.status == "ok"
+    assert b.t_admit >= a.t_done  # strictly after the eviction freed blocks
+    assert eng.kv.allocator.used_blocks == 0
+
+
+def test_queue_full_rejects(served_model, tmp_path):
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params, max_queue=2, logdir=str(tmp_path))
+    r1 = eng.submit(prompt, max_new_tokens=2)
+    r2 = eng.submit(prompt, max_new_tokens=2)
+    with pytest.raises(QueueFullError, match="queue full"):
+        eng.submit(prompt, max_new_tokens=2)
+    assert eng.counters["rejected"] == 1
+    _drain(eng, [r1, r2])
+    eng.stop()
+    rows = [json.loads(line) for line in
+            open(os.path.join(tmp_path, "requests.jsonl"))]
+    statuses = [r["status"] for r in rows]
+    assert statuses.count("rejected") == 1
+    assert statuses.count("ok") == 2
+
+
+def test_eos_finishes_early_and_frees_blocks(served_model):
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params)
+    probe = eng.submit(prompt, max_new_tokens=4)
+    _drain(eng, [probe])
+    eos = probe.tokens[1]  # a token the greedy run provably emits early
+    req = eng.submit(prompt, max_new_tokens=16, eos_token_id=eos)
+    _drain(eng, [req])
+    assert req.status == "ok"
+    assert req.finish_reason == "eos"
+    assert req.tokens[-1] == eos
+    assert len(req.tokens) <= 2 + 1  # stopped at the eos, not at length
+    assert eng.kv.allocator.used_blocks == 0
+
+
+def test_submit_validation(served_model):
+    cfg, params, _ = served_model
+    eng = _engine(cfg, params)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.submit([], max_new_tokens=2)
+    with pytest.raises(ValueError, match="vocab|in \\[0"):
+        eng.submit([cfg.vocab_size + 1], max_new_tokens=2)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], max_new_tokens=0)
+    with pytest.raises(ValueError, match="max_context"):
+        eng.submit([1] * 60, max_new_tokens=30)
+    eng2 = _engine(cfg, params, max_new_cap=4)
+    with pytest.raises(ValueError, match="cap"):
+        eng2.submit([1, 2], max_new_tokens=8)
+    # sampling params are rejected at submit, never on the loop thread
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit([1, 2], max_new_tokens=2, top_k=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.submit([1, 2], max_new_tokens=2, temperature=float("nan"))
+    with pytest.raises(ValueError, match="temperature"):
+        eng.submit([1, 2], max_new_tokens=2, temperature=-1.0)
+    # a request the WHOLE (oversubscribed) pool can't hold is rejected at
+    # the door — otherwise it would wedge the FIFO head forever
+    eng3 = _engine(cfg, params, num_blocks=2)  # 8-token pool, ctx 64
+    with pytest.raises(ValueError, match="pool"):
+        eng3.submit([1] * 10, max_new_tokens=8)
+    # an unservable configuration fails at construction, not per request
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        _engine(cfg, params, prefill_chunk=128, max_context=64)
+
+
+def test_stopped_engine_refuses_work(served_model):
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params)
+    r = eng.submit(prompt, max_new_tokens=2)
+    _drain(eng, [r])
+    eng.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng.submit(prompt, max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="restarted"):
+        eng.start()
+    assert eng.healthy is False
+
+
+def test_sampling_deterministic_by_seed(served_model):
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params)
+    kw = dict(max_new_tokens=8, temperature=1.0, top_k=16)
+    a = eng.submit(prompt, seed=1, **kw)
+    b = eng.submit(prompt, seed=1, **kw)
+    c = eng.submit(prompt, seed=2, **kw)
+    _drain(eng, [a, b, c])
+    assert a.tokens == b.tokens
+    assert a.tokens != c.tokens
+
+
+def test_requests_jsonl_passes_schema_checker(served_model, tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import check_metrics_schema as checker
+
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params, logdir=str(tmp_path), log_every=2)
+    reqs = [eng.submit(prompt, max_new_tokens=n) for n in (2, 5, 3)]
+    _drain(eng, reqs)
+    eng.stop()
+    req_path = os.path.join(tmp_path, "requests.jsonl")
+    errors, _ = checker.check_file(req_path)
+    assert errors == [], errors
+    # the metrics stream the engine writes is schema-clean too
+    errors, _ = checker.check_file(os.path.join(tmp_path, "metrics.jsonl"))
+    assert errors == [], errors
+    assert checker.main([req_path]) == 0
+
+
+def test_engine_state_is_json_safe(served_model):
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params)
+    r = eng.submit(prompt, max_new_tokens=3)
+    eng.step()  # mid-flight state with an occupied slot
+    mid = eng.state()
+    json.dumps(mid)  # must serialize as-is
+    assert mid["active_slots"] in (0, 1)
+    _drain(eng, [r])
+    final = eng.state()
+    json.dumps(final)
+    assert final["counters"]["ok"] == 1
+    assert final["kv"]["blocks_used"] == 0
+
+
+def test_run_report_serving_section(served_model, tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import run_report
+
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params, logdir=str(tmp_path), log_every=1)
+    reqs = [eng.submit(prompt, max_new_tokens=n) for n in (4, 2)]
+    _drain(eng, reqs)
+    eng.stop()
+    report = run_report.build_report(str(tmp_path))
+    srv = report["serving"]
+    assert srv["requests"] == 2
+    assert srv["by_status"]["ok"] == 2
+    assert srv["tokens_generated"] == 6
+    assert srv["e2e_s"]["p99"] > 0
+    assert srv["ttft_s"]["p99"] > 0
+    text = run_report.render(report)
+    assert "serving: 2 request(s)" in text
+    assert report["parse_errors"] == 0

@@ -1,0 +1,179 @@
+"""Slow-lane serving smoke: the ISSUE 6 acceptance command, end to end.
+
+Boots ``serve.py`` as a real subprocess (random-init gpt_tiny, ephemeral
+port), fires >= 16 concurrent requests with staggered arrivals, and
+asserts the full contract:
+
+- every response terminates correctly (EOS or length, tokens bounded);
+- continuous batching actually happened: max observed batch occupancy
+  > 1 AND at least one admission into a previously-freed slot;
+- clean SIGTERM drain, then the post-hoc story holds: ``run_report.py``
+  renders a serving section with non-zero p99 TTFT/e2e from
+  ``requests.jsonl``, and ``check_metrics_schema.py`` passes on both
+  serving streams.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_REQUESTS = 16
+MAX_SLOTS = 4
+
+
+def _post(port, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generatez",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    r = urllib.request.urlopen(req, timeout=timeout)
+    return r.status, json.loads(r.read().decode())
+
+
+def test_serve_smoke_concurrent_requests(tmp_path):
+    logdir = str(tmp_path / "serve")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--config", "gpt_tiny", "--port", "0",
+            "--max-slots", str(MAX_SLOTS), "--max-queue", "32",
+            "--block-size", "8", "--prefill-chunk", "8",
+            "--max-context", "128", "--logdir", logdir,
+            "--log-every", "10",
+        ],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        boot = json.loads(line)
+        assert boot["serving"] is True
+        port = boot["port"]
+
+        # eos probe: find a token greedy decoding provably emits early so
+        # some requests terminate via EOS, not just length.
+        _, probe = _post(port, {"prompt": [1, 2, 3, 4],
+                                "max_new_tokens": 4})
+        eos = probe["tokens"][1]
+
+        results: dict[int, tuple] = {}
+        errors: dict[int, Exception] = {}
+
+        def client(i):
+            payload = {
+                "prompt": list(range(1, 5 + (i % 7))),
+                "max_new_tokens": 6 + (i % 9),
+                "seed": i,
+            }
+            if i % 3 == 0:
+                payload["eos_token_id"] = eos
+            try:
+                results[i] = _post(port, payload)
+            except Exception as e:  # noqa: BLE001 — assert after join
+                errors[i] = e
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(N_REQUESTS)]
+        for t in threads:  # staggered arrivals, well inside one decode run
+            t.start()
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == N_REQUESTS
+
+        # every response terminates correctly
+        for i, (status, body) in results.items():
+            assert status == 200, body
+            assert body["finish_reason"] in ("eos", "length"), body
+            assert 1 <= body["new_tokens"] <= 6 + (i % 9)
+            if body["finish_reason"] == "eos":
+                assert body["tokens"][-1] == eos
+            assert 0 <= body["ttft_s"] <= body["e2e_s"]
+
+        # continuous batching actually happened
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/generatez", timeout=10
+        )
+        state = json.loads(r.read().decode())
+        assert state["occupancy_max"] > 1, state
+        assert state["counters"]["admits_into_freed_slot"] >= 1, state
+        assert state["counters"]["ok"] >= N_REQUESTS
+        assert state["kv"]["blocks_used"] == 0  # everything evicted
+
+        # the live registry carries the SLO histograms
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/varz", timeout=10
+        )
+        varz = r.read().decode()
+        assert "serve_batch_occupancy_count" in varz
+        assert "serve_ttft_seconds_bucket" in varz
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, err[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+
+    # post-hoc: run_report renders the serving section with non-zero tails
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+         logdir, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    report = json.loads(rep.stdout)
+    srv = report["serving"]
+    assert srv["requests"] >= N_REQUESTS + 1  # + the eos probe
+    assert srv["by_status"]["ok"] >= N_REQUESTS
+    assert srv["ttft_s"]["p99"] > 0
+    assert srv["e2e_s"]["p99"] > 0
+    assert srv["occupancy_max"] > 1
+    assert srv["tokens_generated"] > 0
+
+    text = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+         logdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "serving:" in text.stdout and "peak batch occupancy" in text.stdout
+
+    # and both serving streams are schema-clean
+    chk = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_metrics_schema.py"),
+         os.path.join(logdir, "requests.jsonl"),
+         os.path.join(logdir, "metrics.jsonl")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+
+def test_bench_serve_smoke():
+    """BENCH_SERVE_TEST=1 CPU smoke: one JSON line, same bench contract."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SERVE_TEST="1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serve.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    result = json.loads(r.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "serve_offered_load_tokens_per_sec"
+    assert result["value"] > 0
+    assert result["unit"] == "tokens/sec"
+    head = result["headline"]
+    assert head["trials"] == 3
+    assert head["ok"] > 0
+    assert head["ttft_p99_s"] >= head["ttft_p50_s"] >= 0
+    assert result["curve"]

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Validate ``metrics.jsonl`` / ``flight.jsonl`` / ``goodput.json`` /
-``captures.jsonl`` / ``faults.jsonl`` files against the documented
-schemas.
+``captures.jsonl`` / ``faults.jsonl`` / ``requests.jsonl`` files against
+the documented schemas.
 
 Usage::
 
@@ -12,8 +12,9 @@ Files whose basename starts with ``flight`` are validated against the
 flight-recorder event schema; basenames starting with ``goodput`` against
 the goodput-ledger document schema; basenames starting with ``captures``
 against the reactive-profiler manifest schema; basenames starting with
-``faults`` against the chaos fault-log schema; everything else against
-the metric-row schema.
+``faults`` against the chaos fault-log schema; basenames starting with
+``requests`` against the serving per-request log schema; everything else
+against the metric-row schema.
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
@@ -89,6 +90,9 @@ DEFAULT_CAPTURES_GLOB = os.path.join(
 DEFAULT_FAULTS_GLOB = os.path.join(
     REPO, "ARTIFACTS", "convergence_*", "faults*.jsonl"
 )
+DEFAULT_REQUESTS_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "serve_*", "requests*.jsonl"
+)
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
 #: duplicated: this tool is stdlib-only and must run anywhere logs land).
@@ -111,6 +115,11 @@ FAULT_KINDS = (
     "preemption",
 )
 FAULT_PHASES = ("injected", "recovered")
+
+#: Terminal request states + finish reasons (serve/engine.py — duplicated
+#: for the same stdlib-only reason).
+REQUEST_STATES = ("ok", "rejected", "error")
+FINISH_REASONS = ("eos", "length")
 
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
@@ -383,6 +392,101 @@ def check_faults_file(path: str) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
+def check_requests_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate one serving ``requests.jsonl`` log (docs/API.md
+    "Serving"): every row is one JSON object with finite non-decreasing
+    ``t``, a non-empty string ``id``, ``status`` from the terminal set,
+    and non-negative integer ``prompt_tokens`` / ``new_tokens``.  ``ok``
+    rows must additionally carry ``finish_reason`` from the known set,
+    ``new_tokens > 0`` / ``prompt_tokens > 0``, latencies satisfying
+    ``0 <= ttft_s <= e2e_s`` (plus non-negative ``tpot_s`` /
+    ``queue_s``), occupancy fields (``occ_mean`` non-negative finite,
+    ``occ_max`` non-negative integer), and an integer ``slot >= -1``."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    prev_t: float | None = None
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: invalid JSON ({e})")
+                continue
+            if not isinstance(row, dict):
+                errors.append(f"line {i}: row is {type(row).__name__}, "
+                              "not an object")
+                continue
+            t = row.get("t")
+            if isinstance(t, bool) or not isinstance(t, (int, float)) \
+                    or not math.isfinite(t):
+                errors.append(f"line {i}: 't' {t!r} is not a finite number")
+            else:
+                if prev_t is not None and t < prev_t:
+                    errors.append(f"line {i}: 't' {t} decreases")
+                prev_t = float(t)
+            rid = row.get("id")
+            if not isinstance(rid, str) or not rid:
+                errors.append(f"line {i}: 'id' {rid!r} is not a non-empty "
+                              "string")
+            status = row.get("status")
+            if status not in REQUEST_STATES:
+                errors.append(
+                    f"line {i}: 'status' {status!r} not in {REQUEST_STATES}"
+                )
+                continue
+            for name in ("prompt_tokens", "new_tokens"):
+                if not _nonneg_int(row.get(name)):
+                    errors.append(f"line {i}: {name!r} {row.get(name)!r} is "
+                                  "not a non-negative integer")
+            if status != "ok":
+                continue
+            if not (_nonneg_int(row.get("prompt_tokens"))
+                    and row.get("prompt_tokens", 0) > 0):
+                errors.append(f"line {i}: ok row has no prompt tokens")
+            if not (_nonneg_int(row.get("new_tokens"))
+                    and row.get("new_tokens", 0) > 0):
+                errors.append(f"line {i}: ok row generated no tokens")
+            if row.get("finish_reason") not in FINISH_REASONS:
+                errors.append(
+                    f"line {i}: 'finish_reason' {row.get('finish_reason')!r} "
+                    f"not in {FINISH_REASONS}"
+                )
+            lat = {}
+            for name in ("ttft_s", "tpot_s", "e2e_s"):
+                v = row.get(name)
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(v) or v < 0:
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative finite number")
+                else:
+                    lat[name] = float(v)
+            if "ttft_s" in lat and "e2e_s" in lat \
+                    and lat["ttft_s"] > lat["e2e_s"]:
+                errors.append(
+                    f"line {i}: ttft_s {lat['ttft_s']} exceeds e2e_s "
+                    f"{lat['e2e_s']}"
+                )
+            for name in ("queue_s", "occ_mean"):
+                v = row.get(name)
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(v) or v < 0:
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative finite number")
+            if not _nonneg_int(row.get("occ_max")):
+                errors.append(f"line {i}: 'occ_max' {row.get('occ_max')!r} "
+                              "is not a non-negative integer")
+            slot = row.get("slot")
+            if isinstance(slot, bool) or not isinstance(slot, (int, float)) \
+                    or not math.isfinite(slot) or float(slot) != int(slot) \
+                    or slot < -1:
+                errors.append(f"line {i}: 'slot' {slot!r} is not an "
+                              "integer >= -1")
+    return errors, warnings
+
+
 def _check_bucket_map(buckets, where: str) -> tuple[list[str], list[str]]:
     errors: list[str] = []
     warnings: list[str] = []
@@ -478,6 +582,8 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         return check_goodput_doc(doc)
     if os.path.basename(path).startswith("faults"):
         return check_faults_file(path)
+    if os.path.basename(path).startswith("requests"):
+        return check_requests_file(path)
     flight = os.path.basename(path).startswith("flight")
     captures = os.path.basename(path).startswith("captures")
     manifest_dir = os.path.dirname(os.path.abspath(path))
@@ -511,7 +617,7 @@ def main(argv: list[str] | None = None) -> int:
     paths = list(argv) if argv else sorted(
         glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
         + glob.glob(DEFAULT_GOODPUT_GLOB) + glob.glob(DEFAULT_CAPTURES_GLOB)
-        + glob.glob(DEFAULT_FAULTS_GLOB)
+        + glob.glob(DEFAULT_FAULTS_GLOB) + glob.glob(DEFAULT_REQUESTS_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

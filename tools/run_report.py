@@ -32,7 +32,10 @@ writes — and prints:
   (injected/recovered pairing by kind, unpaired injections called out),
   supervised restarts and rejected-checkpoint fallbacks from the flight
   events, worker respawns, and the ``badput_restart`` seconds the
-  restarts cost.
+  restarts cost;
+- serving: the request-level story from ``requests.jsonl`` (serve.py
+  logdirs) — terminal-state counts, TTFT/TPOT/e2e p50+p99, batch
+  occupancy, rejects, delivered tokens/sec.
 
 ``--json`` emits the same content as one machine-readable JSON object.
 Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
@@ -276,6 +279,65 @@ def resilience_summary(faults: list[dict], flight: list[dict],
     return out
 
 
+def serving_summary(rows: list[dict]) -> dict:
+    """The serving digest from ``requests.jsonl`` (serve.py logdirs):
+    terminal-state counts, SLO percentiles (TTFT / TPOT / e2e p50+p99),
+    batch occupancy (per-request mean/max fields written by the engine),
+    and delivered token throughput over the log's time span."""
+    if not rows:
+        return {}
+    by_status: dict[str, int] = {}
+    for r in rows:
+        s = str(r.get("status", "?"))
+        by_status[s] = by_status.get(s, 0) + 1
+    ok = [r for r in rows if r.get("status") == "ok"]
+
+    def pcts(name):
+        rows_for = ok
+        if name == "tpot_s":
+            # single-token completions have no per-output-token interval
+            # (the engine writes tpot_s=0.0) — including them would
+            # deflate the tail; bench_serve applies the same filter.
+            rows_for = [r for r in ok if r.get("new_tokens", 0) > 1]
+        vals = sorted(
+            r[name] for r in rows_for
+            if isinstance(r.get(name), (int, float))
+        )
+        if not vals:
+            return {}
+        return {"p50": _percentile(vals, 0.50),
+                "p99": _percentile(vals, 0.99)}
+
+    tokens = sum(
+        r.get("new_tokens", 0) for r in ok
+        if isinstance(r.get("new_tokens"), (int, float))
+    )
+    ts = [r["t"] for r in rows if isinstance(r.get("t"), (int, float))]
+    span = max(ts) - min(ts) if len(ts) > 1 else 0.0
+    occ_max = [r["occ_max"] for r in ok
+               if isinstance(r.get("occ_max"), (int, float))]
+    occ_mean = [r["occ_mean"] for r in ok
+                if isinstance(r.get("occ_mean"), (int, float))]
+    reasons: dict[str, int] = {}
+    for r in ok:
+        fr = str(r.get("finish_reason", "?"))
+        reasons[fr] = reasons.get(fr, 0) + 1
+    return {
+        "requests": len(rows),
+        "by_status": dict(sorted(by_status.items(), key=lambda kv: -kv[1])),
+        "rejected": by_status.get("rejected", 0),
+        "finish_reasons": reasons,
+        "tokens_generated": tokens,
+        "tokens_per_sec": tokens / span if span else 0.0,
+        "ttft_s": pcts("ttft_s"),
+        "tpot_s": pcts("tpot_s"),
+        "e2e_s": pcts("e2e_s"),
+        "occupancy_max": max(occ_max, default=0),
+        "occupancy_mean": (sum(occ_mean) / len(occ_mean)
+                           if occ_mean else 0.0),
+    }
+
+
 def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     """Last-row host-spread fields, grouped by base key."""
     out: dict[str, dict[str, float]] = {}
@@ -333,6 +395,11 @@ def build_report(logdir: str) -> dict:
         _load_jsonl(faults_path) if os.path.exists(faults_path)
         else ([], 0)
     )
+    requests_path = os.path.join(logdir, "requests.jsonl")
+    requests, bad_requests = (
+        _load_jsonl(requests_path) if os.path.exists(requests_path)
+        else ([], 0)
+    )
     goodput, bad_goodput = load_goodput(logdir)
     train, evals = split_rows(rows)
 
@@ -364,10 +431,12 @@ def build_report(logdir: str) -> dict:
         "captures": capture_summary(captures),
         "goodput": goodput,
         "resilience": resilience_summary(faults, flight, goodput),
+        "serving": serving_summary(requests),
         # metric-stream health: any unparseable metrics.jsonl / captures /
-        # faults line (or an unreadable goodput.json) makes main() exit
-        # non-zero (CI gate)
-        "parse_errors": bad_metrics + bad_goodput + bad_captures + bad_faults,
+        # faults / requests line (or an unreadable goodput.json) makes
+        # main() exit non-zero (CI gate)
+        "parse_errors": (bad_metrics + bad_goodput + bad_captures
+                         + bad_faults + bad_requests),
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -523,6 +592,32 @@ def render(report: dict) -> str:
                 f"  UNRECOVERED fault #{u['id']} {u['kind']} "
                 f"(step {u['step']})"
             )
+    srv = report.get("serving")
+    if srv:
+        stat = ", ".join(f"{k} x{v}" for k, v in srv["by_status"].items())
+        lines += [
+            "",
+            (
+                f"serving: {srv['requests']} request(s) ({stat}) — "
+                f"{srv['tokens_generated']} tokens at "
+                f"{srv['tokens_per_sec']:.1f} tok/s, peak batch occupancy "
+                f"{srv['occupancy_max']}"
+            ),
+        ]
+        for name, label in (("ttft_s", "ttft"), ("tpot_s", "tpot"),
+                            ("e2e_s", "e2e")):
+            d = srv.get(name) or {}
+            if d:
+                lines.append(
+                    f"  {label:<5} p50 {d['p50']:.4g}s   p99 {d['p99']:.4g}s"
+                )
+        if srv.get("finish_reasons"):
+            fr = ", ".join(f"{k} x{v}"
+                           for k, v in sorted(srv["finish_reasons"].items()))
+            lines.append(f"  finish: {fr}")
+        if srv.get("rejected"):
+            lines.append(f"  REJECTED {srv['rejected']} request(s) "
+                         "(queue backpressure)")
     if report["stragglers"]:
         lines += ["", "straggler summary (last record):"]
         for base, d in report["stragglers"].items():
