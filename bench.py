@@ -46,9 +46,13 @@ PEAK_FLOPS_BY_KIND = {
     "v3": 123e12,
 }
 
-#: ResNet-50 @224 fwd ≈ 4.1 GFLOPs/image (MACs×2); train step ≈ 3× fwd.
-#: Used when XLA's compiled cost analysis is unavailable on the backend.
-RESNET50_TRAIN_FLOPS_PER_IMAGE = 12.3e9
+#: ResNet-50 @224 fwd ≈ 4.1 GMACs/image = 8.2 GFLOPs (multiply-add = 2
+#: FLOPs — the convention XLA's cost analysis uses; obs/mfu.py pins both
+#: paths to it on a known matmul); train step ≈ 3× fwd.  The previous
+#: value (12.3e9) treated the 4.1e9 MAC count as if it were already
+#: MACs×2 — exactly the 2× by which mfu_analytic (0.16) undershot
+#: mfu_xla_cost (0.32) on BENCH_r02.
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 24.6e9
 
 #: Peak HBM bandwidth (bytes/s) by device_kind substring (public specs).
 #: The resnet step is HBM-roofline-bound (docs/RESNET_PERF.md §1: 812 GB/s
@@ -272,7 +276,12 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         n_steps = -(-n_steps // inner)
         warmup = max(1, warmup // inner)
     compiled = step.lower(state, batch, rng).compile()
-    from bench_probe import compiled_cost, mfu_fields, timed_steps
+    from bench_probe import (
+        compiled_cost,
+        mfu_fields,
+        state_bytes_fields,
+        timed_steps,
+    )
 
     cost = compiled_cost(compiled)
     state, dt = timed_steps(compiled, state, batch, rng,
@@ -288,7 +297,7 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         compiled, dt, n_steps, device_kind,
         inner * RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
         * (image_size / 224.0) ** 2 / n_chips,
-        "analytic_12.3GF_per_image",
+        "analytic_24.6GF_per_image",
         xla_flops_scale=inner,
         cost=cost,
     )
@@ -307,6 +316,7 @@ def run_bench(per_chip_batch: int, n_steps: int, warmup: int,
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 4),
         **mfu,
         "hbm_bw_util": round(hbm_bw_util, 4) if hbm_bw_util else None,
+        **state_bytes_fields(state),
         **experiment_fields,
         "platform": platform,
         "device_kind": device_kind,
@@ -463,7 +473,7 @@ def run_bench_records(per_chip_batch: int, n_steps: int, warmup: int,
         None, dt, n_steps, device_kind,
         RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
         * (image_size / 224.0) ** 2 / n_chips,
-        "analytic_12.3GF_per_image", cost={},
+        "analytic_24.6GF_per_image", cost={},
     )
     return {
         "metric": "resnet50_records_imagenet_images_per_sec_per_chip",

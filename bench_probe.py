@@ -217,6 +217,27 @@ def probe_devices_or_die(name: str = "bench") -> None:
 # --- shared measurement harness (used by bench.py / bench_lm / bench_bert) ---
 
 
+def state_bytes_fields(state) -> dict:
+    """Per-device params/optimizer-state bytes for a bench result JSON.
+
+    The worst (max) device's resident bytes — the number cross-replica
+    weight-update sharding (``--zero``, parallel/zero.py) divides by the
+    ZeRO degree, emitted by every bench row so a sharding win shows up in
+    the result stream as a number.  Empty on states whose arrays don't
+    report shards (never raises into a bench run).
+    """
+    try:
+        from distributedtensorflow_tpu.obs import memory
+
+        return memory.state_bytes_record_fields(
+            memory.state_bytes_report(state.params, state.opt_state)
+        )
+    except Exception as e:
+        print(f"bench: state bytes accounting unavailable ({e})",
+              file=sys.stderr)
+        return {}
+
+
 def timed_steps(compiled, state, batch, rng, *, n_steps: int, warmup: int):
     """Run warmup + timed steps of a compiled ``(state, batch, rng) ->
     (state, metrics)`` executable.  Sync is a host fetch of the loss (NOT
@@ -245,17 +266,12 @@ def compiled_cost(compiled) -> dict | None:
     (mfu_fields, bench.py's hbm_bw_util) so the flaky-tunnel RPC is paid
     once per executable and cannot return inconsistent outcomes.
 
-    Older jax (this image's 0.4.37) returns a LIST of per-device dicts;
-    normalized here to the first device's dict so every consumer sees one
-    shape."""
-    try:
-        cost = compiled.cost_analysis()
-    except Exception as e:
-        print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
-        return None
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0] if cost else None
-    return cost
+    Delegates to ``obs.mfu.xla_cost_analysis`` — the ONE normalization of
+    jax's cost-analysis return shapes — so the bench and live-stream MFU
+    numerators cannot drift apart on a jax version change."""
+    from distributedtensorflow_tpu.obs.mfu import xla_cost_analysis
+
+    return xla_cost_analysis(compiled)
 
 
 def mfu_fields(compiled, dt: float, n_steps: int, device_kind: str,

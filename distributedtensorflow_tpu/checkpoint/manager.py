@@ -108,6 +108,12 @@ class CheckpointManager:
                 best_mode=best_mode,
                 create=True,
             ),
+            # Pre-register the state's handler so a FRESH process (the
+            # restore side of a restart) can answer item_metadata() —
+            # the ZeRO-degree probe — before its first save/restore;
+            # without it orbax only learns the handler lazily from the
+            # first args=StandardSave/StandardRestore call.
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
         self._best_metric = best_metric
         self._best_mode = best_mode
@@ -294,6 +300,13 @@ class CheckpointManager:
             obs.record_event("checkpoint_corrupt", step=step,
                              reason=str(e)[:300])
             raise
+
+    def item_metadata(self, step: int):
+        """Array metadata (shapes/dtypes, no tensor I/O) of a saved step's
+        tree — the probe :func:`~..parallel.zero.saved_opt_layout` uses to
+        detect which ZeRO degree a checkpoint's optimizer state was saved
+        at before building a restore target."""
+        return self._mgr.item_metadata(step)
 
     def latest_step(self) -> int | None:
         steps = self.all_steps()

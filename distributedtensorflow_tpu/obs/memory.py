@@ -37,6 +37,11 @@ __all__ = [
     "record_fields",
     "update_registry",
     "memz",
+    "tree_bytes_by_device",
+    "state_bytes_report",
+    "state_bytes_record_fields",
+    "set_train_state_bytes",
+    "train_state_record_fields",
 ]
 
 _GIB = 1.0 / (1024 ** 3)
@@ -190,5 +195,106 @@ def update_registry(registry=None, snapshot: dict | None = None) -> None:
 
 def memz(top: int = 10) -> dict:
     """Full ``/memz`` payload — :func:`collect` with the ``top`` largest
-    arrays itemized."""
-    return collect(top=top)
+    arrays itemized, plus the train-state bytes breakdown when a trainer
+    has installed one (:func:`set_train_state_bytes`)."""
+    out = collect(top=top)
+    if _TRAIN_STATE_BYTES is not None:
+        out["train_state"] = _TRAIN_STATE_BYTES
+    return out
+
+
+# --- train-state bytes: the number weight-update sharding shrinks -----------
+#
+# Shapes and shardings are fixed for a fit, so the breakdown is computed
+# ONCE at fit begin (never per step) and served statically on /memz, the
+# labeled registry gauges, and the per-record fields.
+
+_TRAIN_STATE_BYTES: dict | None = None
+
+
+def tree_bytes_by_device(tree) -> dict[int, int]:
+    """THIS host's resident bytes of a pytree, summed per device id —
+    a replicated tree charges every device its full size; a ZeRO-sharded
+    optimizer state charges each device only its 1/degree chunk."""
+    out: dict[int, int] = {}
+    for leaf in _jax_leaves(tree):
+        try:
+            shards = leaf.addressable_shards
+        except Exception:
+            continue
+        for s in shards:
+            dev = int(getattr(s.device, "id", 0))
+            out[dev] = out.get(dev, 0) + int(s.data.size) * s.data.dtype.itemsize
+    return out
+
+
+def _jax_leaves(tree):
+    import jax  # noqa: PLC0415
+
+    return [l for l in jax.tree.leaves(tree) if hasattr(l, "addressable_shards")]
+
+
+def state_bytes_report(params, opt_state) -> dict:
+    """The per-device train-state bytes breakdown — THE byte-accounting
+    rule (one place): trainer fit-begin, bench rows, and /memz all
+    derive from this shape."""
+    return {
+        "params": tree_bytes_by_device(params),
+        "opt_state": tree_bytes_by_device(opt_state),
+    }
+
+
+def state_bytes_record_fields(report: dict) -> dict[str, float]:
+    """Flatten a :func:`state_bytes_report` into the record/bench fields:
+    the WORST (max) device's bytes of params and optimizer state."""
+    out: dict[str, float] = {}
+    for key, field in (("params", "params_bytes_per_device"),
+                       ("opt_state", "opt_state_bytes_per_device")):
+        per_dev = report.get(key)
+        if per_dev:
+            out[field] = float(max(per_dev.values()))
+    return out
+
+
+def set_train_state_bytes(report: dict | None,
+                          registry=None) -> None:
+    """Install (or clear, with None) the per-device train-state bytes
+    breakdown: ``{"params": {dev: bytes}, "opt_state": {...}, ...}`` plus
+    scalar annotations (``zero_stage``, ``zero_degree``).  Refreshes the
+    ``params_bytes_per_device`` / ``optimizer_state_bytes_per_device``
+    labeled gauges so /varz and metrics.prom carry the breakdown too."""
+    global _TRAIN_STATE_BYTES
+    _TRAIN_STATE_BYTES = report
+    if report is None:
+        return
+    from . import registry as reglib  # noqa: PLC0415
+
+    reg = registry or reglib.default_registry()
+    gauges = {
+        "params": reg.gauge(
+            "params_bytes_per_device", "parameter bytes resident per device"
+        ),
+        "opt_state": reg.gauge(
+            "optimizer_state_bytes_per_device",
+            "optimizer-state bytes resident per device (the bytes "
+            "weight-update sharding divides by the ZeRO degree)",
+        ),
+    }
+    for key, gauge in gauges.items():
+        for dev, nbytes in (report.get(key) or {}).items():
+            gauge.set(nbytes, device=str(dev))
+
+
+def train_state_record_fields() -> dict[str, float]:
+    """Flat scalars for the metric record: the WORST (max) per-device
+    bytes of params and optimizer state, plus the ZeRO annotations —
+    what run_report and bench_probe surface so a sharding win is a
+    number, not an assertion."""
+    rep = _TRAIN_STATE_BYTES
+    if not rep:
+        return {}
+    out = state_bytes_record_fields(rep)
+    for key in ("zero_stage", "zero_degree"):
+        if isinstance(rep.get(key), (int, float)):
+            out[key] = float(rep[key])
+    return out

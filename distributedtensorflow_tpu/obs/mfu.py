@@ -8,6 +8,19 @@ The repo-root imports are resolved ONCE and cached (a failed import is not
 cached by sys.modules, and this runs at every log boundary).
 Only numeric fields are returned (the ``metrics.jsonl`` writer is
 numbers-only; ``mfu_analytic_source`` stays in the bench JSON world).
+
+FLOP-counting convention (the 2× reconciliation, BENCH_r02): BOTH
+estimators count one multiply-add as **2 FLOPs** — XLA's
+``cost_analysis()["flops"]`` reports exactly ``2·M·N·K`` for an
+``(M,K)×(K,N)`` matmul (:func:`matmul_flops`, pinned by
+``tests/test_mfu.py``), so any analytic ``flops_per_step`` fed into these
+fields must use the same MACs×2 convention.  The historical 0.16-vs-0.32
+ResNet-50 disagreement was an analytic constant (bench.py
+``RESNET50_TRAIN_FLOPS_PER_IMAGE``) that passed a MAC count where a FLOP
+count was owed; with both sides on MACs×2 the two paths agree within the
+cost model's coarseness (see ``bench_probe.mfu_fields`` for the one
+legitimate residual: a ``lax.scan`` body is counted once regardless of
+trip count — callers pass ``xla_flops_scale``).
 """
 
 from __future__ import annotations
@@ -16,7 +29,8 @@ import logging
 
 logger = logging.getLogger("distributedtensorflow_tpu")
 
-__all__ = ["mfu_record_fields", "peak_flops"]
+__all__ = ["matmul_flops", "mfu_record_fields", "peak_flops",
+           "xla_cost_analysis", "xla_cost_flops"]
 
 #: bench.py's PEAK_FLOPS_BY_KIND, duplicated as the in-package fallback for
 #: deployments where the repo root (bench.py) is not on sys.path.
@@ -62,6 +76,41 @@ def peak_flops(device_kind: str) -> float:
         if sub in kind:
             return peak
     return _DEFAULT_PEAK
+
+
+def matmul_flops(m: int, n: int, k: int) -> float:
+    """Analytic FLOPs of an ``(m, k) @ (k, n)`` matmul under the MACs×2
+    convention — the shared numerator contract between the analytic and
+    xla-cost MFU paths (see module docstring)."""
+    return 2.0 * m * n * k
+
+
+def xla_cost_analysis(compiled) -> dict | None:
+    """One best-effort ``cost_analysis()`` call, normalized to a single
+    dict: older jax (0.4.37) returns a LIST of per-device dicts — the
+    first device's is returned so every consumer sees one shape; None
+    when the backend can't answer.  THE one implementation of this
+    normalization (``bench_probe.compiled_cost`` delegates here) so the
+    analytic and xla-cost MFU paths cannot drift apart again on a jax
+    return-shape change."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception as e:
+        logger.info("xla cost analysis unavailable (%s)", e)
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    return cost or None
+
+
+def xla_cost_flops(compiled) -> float | None:
+    """Executed FLOPs of a compiled executable per XLA's cost analysis
+    (the partitioned, per-device module — the per-chip MFU numerator), or
+    None when the backend can't answer."""
+    cost = xla_cost_analysis(compiled)
+    if not cost or not cost.get("flops"):
+        return None
+    return float(cost["flops"])
 
 
 def mfu_record_fields(

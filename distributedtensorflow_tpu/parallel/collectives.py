@@ -47,10 +47,15 @@ _M_DISPATCH_S = obs.histogram(
 )
 
 
-def _timed_dispatch(fn):
+def _timed_dispatch(fn=None, *, op: str | None = None):
     """Route a collective wrapper's host-side time through the span tracer
     (``collective_<op>`` spans — children of the enclosing compile/step
     span when traced under jit) and the dispatch histogram.
+
+    ``op`` overrides the histogram label (default: the function name) —
+    the GSPMD constraint wrappers below use it so a reduce-scatter
+    expressed as a sharding constraint lands under the same
+    ``op=reduce_scatter`` label as the shard_map primitive.
 
     While a reactive-profiler window is open (``obs.capture``), the
     region is additionally labeled with a ``jax.profiler``
@@ -58,22 +63,26 @@ def _timed_dispatch(fn):
     collective being dispatched — the disambiguation a straggler-spread
     capture exists for.  The check is one module-attribute read, so the
     un-captured hot path pays nothing."""
-    op = fn.__name__
-    name = f"collective_{op}"
 
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        t0 = time.perf_counter()
-        with obs.span(name):
-            if obs.capture.capture_active():
-                with jax.profiler.TraceAnnotation(name):
-                    out = fn(*args, **kwargs)
-            else:
-                out = fn(*args, **kwargs)
-        _M_DISPATCH_S.observe(time.perf_counter() - t0, op=op)
-        return out
+    def decorate(f):
+        label = op or f.__name__
+        name = f"collective_{label}"
 
-    return wrapper
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            with obs.span(name):
+                if obs.capture.capture_active():
+                    with jax.profiler.TraceAnnotation(name):
+                        out = f(*args, **kwargs)
+                else:
+                    out = f(*args, **kwargs)
+            _M_DISPATCH_S.observe(time.perf_counter() - t0, op=label)
+            return out
+
+        return wrapper
+
+    return decorate(fn) if fn is not None else decorate
 
 
 class ReduceOp(enum.Enum):
@@ -165,6 +174,62 @@ def reduce_scatter(
     ``collective_nccl_reducer.h:34``).
     """
     return lax.psum_scatter(x, _as_tuple(axis), scatter_dimension=scatter_axis, tiled=True)
+
+
+def tree_reduce_scatter(
+    tree: PyTree, axis: AxisSpec, *, scatter_axis: int = 0
+) -> PyTree:
+    """Reduce-scatter every leaf of a pytree — the ZeRO gradient-sync
+    primitive (each replica receives the cross-replica sum of only its
+    shard; shard_map/jit contexts with bound axis names)."""
+    return jax.tree.map(
+        functools.partial(reduce_scatter, axis=axis,
+                          scatter_axis=scatter_axis),
+        tree,
+    )
+
+
+def tree_all_gather(
+    tree: PyTree, axis: AxisSpec, *, gather_axis: int = 0
+) -> PyTree:
+    """All-gather every leaf of a pytree — the ZeRO parameter
+    re-assembly primitive (inverse of :func:`tree_reduce_scatter`)."""
+    return jax.tree.map(
+        functools.partial(all_gather, axis=axis, gather_axis=gather_axis),
+        tree,
+    )
+
+
+def _constrain_tree(tree: PyTree, shardings) -> PyTree:
+    """``with_sharding_constraint`` over a pytree; ``shardings`` is one
+    ``Sharding`` applied to every leaf, or a matching pytree of them."""
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, shardings), tree
+        )
+    return jax.tree.map(
+        jax.lax.with_sharding_constraint, tree, shardings,
+    )
+
+
+@_timed_dispatch(op="reduce_scatter")
+def gspmd_reduce_scatter(tree: PyTree, shardings) -> PyTree:
+    """Constrain partial-sum gradients to a sharded layout inside a
+    GSPMD-jitted program — XLA lowers the cross-replica sum feeding the
+    constraint to a reduce-scatter (the ZeRO weight-update path on
+    jax versions whose partial-manual shard_map lowering is limited; see
+    parallel/zero.py).  Timed under ``op=reduce_scatter`` like the
+    shard_map primitive above."""
+    return _constrain_tree(tree, shardings)
+
+
+@_timed_dispatch(op="all_gather")
+def gspmd_all_gather(tree: PyTree, shardings) -> PyTree:
+    """Constrain shard-local values back to their full layout inside a
+    GSPMD-jitted program — XLA lowers the constraint to an all-gather
+    (the ZeRO post-update parameter re-assembly).  Timed under
+    ``op=all_gather``."""
+    return _constrain_tree(tree, shardings)
 
 
 @_timed_dispatch

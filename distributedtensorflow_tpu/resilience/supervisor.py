@@ -14,9 +14,10 @@ loop-closer:
    anomaly (observed via a Callback that stops the fit) is ``nan_loss``;
    a consumed preemption notice is ``preemption``;
 2. **restore** from the newest *verified* checkpoint
-   (``CheckpointManager.restore_latest`` — corrupt steps are rejected and
-   fallen back past; NaN failures restore from strictly *before* the
-   poisoned step);
+   (:func:`~..parallel.zero.restore_latest_zero` — corrupt steps are
+   rejected and fallen back past, saved ZeRO layouts that differ from the
+   restart's are rechunked rather than mistaken for corruption; NaN
+   failures restore from strictly *before* the poisoned step);
 3. **re-enter** ``fit`` after an exponential backoff (base × 2^attempt,
    clamped), rebuilding the input iterator at the resumed step;
 4. **escalate** once the retry budget is exhausted: a
@@ -325,9 +326,22 @@ class Supervisor:
                 self._state_template_fn() if self._state_template_fn
                 else state
             )
-            resumed = trainer.checkpointer.restore_latest(
-                template, before_step=before_step
-            )
+            if getattr(template, "tx", None) is not None:
+                # Layout-aware: a mixed-layout history (a replicated run
+                # restarted --zero, or vice versa) must rechunk the saved
+                # optimizer state, not reject every differently-chunked
+                # step as corrupt and cold-start.  Needs the template's
+                # ``tx`` for the layout probe; templates without one
+                # (host-only tests) take the plain path.
+                from ..parallel.zero import restore_latest_zero  # noqa: PLC0415
+
+                resumed = restore_latest_zero(
+                    trainer.checkpointer, template, before_step=before_step
+                )
+            else:
+                resumed = trainer.checkpointer.restore_latest(
+                    template, before_step=before_step
+                )
             report = getattr(trainer.checkpointer, "last_restore_report",
                              None) or {}
             rejected_steps = [

@@ -10,6 +10,12 @@ jitted SPMD program:
   mesh axes; XLA's sharding propagation inserts the gradient all-reduce
   (reduce-scatter + all-gather under fsdp) over ICI — the compiled
   equivalent of ``NcclReducer`` (SURVEY.md §2.2);
+- cross-replica weight-update sharding (``--zero``, parallel/zero.py)
+  changes nothing here: the state carries its ZeroSharder, so the same
+  ``apply_gradients`` call inside :func:`_step_body` compiles to
+  reduce-scatter → 1/N-sharded optimizer update → all-gather, with the
+  chunked optimizer-state shardings arriving via ``state_specs`` like any
+  other layout;
 - gradient accumulation (the reference's BERT config,
   ``base_optimizer.py:79-108``) is a ``lax.scan`` over microbatches inside
   the same program;

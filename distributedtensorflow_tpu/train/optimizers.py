@@ -59,6 +59,15 @@ def build_schedule(
 #: Optimizers whose optax builder takes decoupled weight decay.
 _DECAY_CAPABLE = ("adamw", "lamb", "lars", "lion")
 
+#: Optimizers whose update is purely elementwise, so cross-replica
+#: weight-update sharding (--zero, parallel/zero.py) reproduces the
+#: replicated trajectory exactly: the chunked view never changes an
+#: elementwise result, and the zero-gradient pad tail stays zero.
+#: lamb/lars (per-parameter trust-ratio norms) and adafactor
+#: (shape-factored second moments) would compute per-SHARD statistics
+#: instead — train.py warns when --zero is combined with one of those.
+ZERO_SAFE = ("sgd", "momentum", "adam", "adamw", "adagrad", "lion")
+
 
 def exclude_bias_and_norm_mask(params) -> object:
     """Weight-decay mask: True = decay this leaf.

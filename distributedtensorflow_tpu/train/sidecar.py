@@ -9,8 +9,11 @@ poll the checkpoint directory, evaluate each new checkpoint, write metrics.
 
 TPU-first shape: the evaluator restores *sharded* checkpoints into its own
 (usually single-chip) mesh — Orbax reshards on read, so the training job's
-topology never leaks in — and the eval step is the same compiled SPMD
-program ``train.make_eval_step`` builds for inline eval.
+topology never leaks in; ZeRO-chunked optimizer state likewise rechunks on
+read via :func:`..parallel.zero.restore_step_zero`, so a ``--zero`` trainer
+and an evaluator at a different replica count interoperate — and the eval
+step is the same compiled SPMD program ``train.make_eval_step`` builds for
+inline eval.
 
 Run it via ``train.py --job evaluator`` (automatic when TF_CONFIG says
 ``task.type == "evaluator"``).
@@ -23,6 +26,7 @@ import time
 from typing import Any, Callable, Iterable
 
 from .. import obs
+from ..parallel.zero import restore_step_zero
 from ..utils.metrics import MetricWriter
 from .state import TrainState
 from .trainer import weighted_evaluate
@@ -106,8 +110,13 @@ class SidecarEvaluator:
                     self.checkpointer.reload()  # other-process writes
                     step = self.checkpointer.latest_step()
                     if step is not None and step > last_evaluated:
-                        state = self.checkpointer.restore(
-                            step, self.state_template
+                        # Layout-aware: the trainer may save --zero-chunked
+                        # optimizer state while this evaluator's template
+                        # is unchunked (or chunked at a different replica
+                        # count) — restore_step_zero rechunks instead of
+                        # mistaking the shape mismatch for corruption.
+                        state, _ = restore_step_zero(
+                            self.checkpointer, step, self.state_template
                         )
                 except OSError as e:
                     logger.info(

@@ -34,8 +34,15 @@ class TrainState(struct.PyTreeNode):
     model_state: PyTree  # non-trainable collections (batch_stats, ...)
     opt_state: PyTree
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    #: Weight-update sharding policy (parallel.zero.ZeroSharder) or None.
+    #: When set, ``opt_state`` lives in the sharder's chunked layout and
+    #: ``apply_gradients`` runs the reduce-scatter → sharded-update →
+    #: all-gather path instead of the replicated one.
+    zero: Any = struct.field(pytree_node=False, default=None)
 
     def apply_gradients(self, grads: PyTree) -> "TrainState":
+        if self.zero is not None:
+            return self.zero.apply_gradients(self, grads)
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         return self.replace(
@@ -70,6 +77,7 @@ def create_sharded_state(
     *,
     rules: shardlib.LayoutMap | Callable | None = None,
     fsdp: bool = False,
+    zero=None,
 ) -> tuple[TrainState, "TrainState"]:
     """Initialize a TrainState directly into its target sharding.
 
@@ -79,6 +87,12 @@ def create_sharded_state(
     each device — no host-side full copy (the reference initializes under
     ``strategy.scope()`` for the same reason, SURVEY.md §3.3).
 
+    ``zero`` (a :class:`~..parallel.zero.ZeroSharder`) switches the
+    optimizer state to cross-replica weight-update sharding: slots are
+    initialized in the sharder's chunked ``(degree, chunk)`` layout and
+    sharded over the batch axes — each replica holds 1/degree of the
+    optimizer state from the first step on, never a full copy.
+
     Returns ``(state, state_specs)`` where ``state_specs`` is a TrainState of
     PartitionSpecs (for use as jit shardings).
     """
@@ -87,19 +101,29 @@ def create_sharded_state(
     param_specs = shardlib.specs_for_tree(param_shapes, mesh, rules, fsdp=fsdp)
     mstate_specs = shardlib.specs_for_tree(mstate_shapes, mesh, rules)
 
-    opt_shapes = jax.eval_shape(lambda p: tx.init(p), param_shapes)
-    opt_specs = _opt_state_specs(opt_shapes, param_shapes, param_specs)
+    if zero is not None:
+        zero.bind(param_specs)
+        chunked_shapes = jax.eval_shape(zero.chunk_tree, param_shapes)
+        opt_shapes = jax.eval_shape(lambda p: tx.init(p), chunked_shapes)
+        opt_specs = zero.opt_state_specs(opt_shapes, param_shapes)
+    else:
+        opt_shapes = jax.eval_shape(lambda p: tx.init(p), param_shapes)
+        opt_specs = _opt_state_specs(opt_shapes, param_shapes, param_specs)
 
     state_specs = TrainState(
         step=P(), params=param_specs, model_state=mstate_specs,
-        opt_state=opt_specs, tx=tx,
+        opt_state=opt_specs, tx=tx, zero=zero,
     )
 
     def build(r):
         params, model_state = split_variables(init_fn(r))
+        opt_state = (
+            tx.init(zero.chunk_tree(params)) if zero is not None
+            else tx.init(params)
+        )
         return TrainState(
             step=jnp.zeros((), jnp.int32), params=params,
-            model_state=model_state, opt_state=tx.init(params), tx=tx,
+            model_state=model_state, opt_state=opt_state, tx=tx, zero=zero,
         )
 
     out_shardings = jax.tree.map(

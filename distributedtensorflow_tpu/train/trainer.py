@@ -77,6 +77,12 @@ class TrainerConfig:
     max_captures: int = 8
     capture_cooldown_s: float = 120.0
     capture_spread_factor: float = 3.0
+    # Weight-update sharding (parallel/zero.py): informational — the
+    # sharding itself is compiled into the train step at state-creation
+    # time.  zero_stage > 0 stamps the mode into every metric record and
+    # /statusz so run_report can attribute the optimizer-state-bytes
+    # numbers to the mode that produced them.
+    zero_stage: int = 0
     # Hang watchdog (SURVEY.md §5.2): dump all thread stacks if no step
     # completes for this many seconds.  0 disables.
     watchdog_timeout: float = 0.0
@@ -307,6 +313,23 @@ class Trainer:
                 "fit_begin", step=int(state.step),
                 total_steps=cfg.total_steps,
             )
+        # Per-device params/optimizer-state bytes: shapes and shardings are
+        # fixed for the whole fit, so the breakdown is computed ONCE here
+        # and served statically (/memz "train_state" section, labeled
+        # gauges, per-record fields) — the measurement that makes a
+        # --zero memory win a number instead of an assertion.
+        try:
+            report: dict = obs.memory.state_bytes_report(
+                state.params, state.opt_state
+            )
+            if cfg.zero_stage:
+                report["zero_stage"] = cfg.zero_stage
+                zero = getattr(state, "zero", None)
+                if zero is not None:
+                    report["zero_degree"] = zero.degree
+            obs.memory.set_train_state_bytes(report)
+        except Exception:
+            logger.exception("train-state bytes accounting failed")
         ledger = obs.goodput.default_ledger()
         if ledger is not None:  # close the goodput `init` window
             ledger.mark_fit_begin(int(state.step))
@@ -402,6 +425,7 @@ class Trainer:
         ``metrics.jsonl`` handle is released on any exit path (it used to
         leak on every non-happy path)."""
         self.writer.close()
+        obs.memory.set_train_state_bytes(None)
         if self.status_server is not None:
             self.status_server.stop()
         if self.capture is not None:
@@ -623,6 +647,7 @@ class Trainer:
                     # feeds both — the census is O(#live arrays).
                     mem_snap = obs.memory.collect()
                     last_metrics.update(obs.memory.record_fields(mem_snap))
+                    last_metrics.update(obs.memory.train_state_record_fields())
                     obs.memory.update_registry(snapshot=mem_snap)
                     breakdown = self._window_breakdown(step_next)
                     last_metrics.update(breakdown)
@@ -811,6 +836,8 @@ class Trainer:
                 "stop_requested": self.stop_training,
             },
         }
+        if self.config.zero_stage:
+            out["run"]["zero_stage"] = self.config.zero_stage
         core = {
             k: rec[k] for k in (
                 "loss", "accuracy", "steps_per_sec",

@@ -72,7 +72,15 @@ def build_params(args, cfg):
         wl.init_fn, wl.make_optimizer(), mesh, jax.random.PRNGKey(args.seed),
         rules=wl.layout, fsdp=wl.fsdp,
     )
-    restored = CheckpointManager(args.checkpoint).restore_latest(state)
+    # ZeRO-aware: a checkpoint trained under --zero stores its optimizer
+    # state replica-chunked; the layout probe rechunks it into this
+    # unchunked template (serving only reads params, but the restore
+    # target must match the saved tree to verify the manifest).
+    from distributedtensorflow_tpu.parallel.zero import restore_latest_zero
+
+    restored = restore_latest_zero(
+        CheckpointManager(args.checkpoint), state, mesh, None
+    )
     if restored is None:
         raise SystemExit(
             f"--checkpoint {args.checkpoint}: no usable checkpoint found"

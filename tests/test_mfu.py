@@ -1,0 +1,70 @@
+"""MFU estimator reconciliation (ISSUE 7 satellite).
+
+BENCH_r02 showed the analytic and xla-cost MFU paths disagreeing 2x on
+ResNet-50 (0.16 vs 0.32): the analytic constant passed a MAC count where
+a MACs x 2 FLOP count was owed.  These tests PIN both estimator paths to
+the same convention on a known matmul — XLA's ``cost_analysis()`` counts
+an ``(M,K) @ (K,N)`` matmul as exactly ``2*M*N*K`` FLOPs, and the
+analytic side (:func:`obs.mfu.matmul_flops`, bench.py's per-image
+constants) must use the same arithmetic — so the two numbers can only
+diverge for the documented structural reason (scan bodies counted once;
+``xla_flops_scale``), never by a units mismatch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.obs import mfu as mfu_lib
+
+M, K, N = 128, 96, 64
+
+
+@pytest.fixture(scope="module")
+def compiled_matmul():
+    a = jnp.zeros((M, K), jnp.float32)
+    b = jnp.zeros((K, N), jnp.float32)
+    return jax.jit(lambda x, y: x @ y).lower(a, b).compile()
+
+
+def test_analytic_matmul_convention():
+    assert mfu_lib.matmul_flops(M, N, K) == 2 * M * N * K
+
+
+def test_xla_cost_matches_analytic_on_known_matmul(compiled_matmul):
+    """The pin: XLA's cost analysis and the analytic MACs x 2 convention
+    agree exactly on a bare matmul (no fusion freedom, no scan)."""
+    xla = mfu_lib.xla_cost_flops(compiled_matmul)
+    if xla is None:
+        pytest.skip("backend reports no cost-analysis flops")
+    assert xla == pytest.approx(mfu_lib.matmul_flops(M, N, K), rel=0.01)
+
+
+def test_mfu_fields_agree_on_known_matmul(compiled_matmul):
+    """bench_probe.mfu_fields emits mfu_analytic == mfu_xla_cost when fed
+    the convention-correct analytic count — the end-to-end reconciliation
+    (the 2x ResNet-50 disagreement was exactly this pair diverging)."""
+    from bench_probe import mfu_fields
+
+    analytic = mfu_lib.matmul_flops(M, N, K)
+    fields = mfu_fields(
+        compiled_matmul, dt=1.0, n_steps=1, device_kind="cpu",
+        analytic_flops_per_step=analytic,
+        analytic_source="matmul_2mnk",
+    )
+    assert fields["mfu"] == fields["mfu_analytic"]
+    if fields["mfu_xla_cost"] is None:
+        pytest.skip("backend reports no cost-analysis flops")
+    assert fields["mfu_xla_cost"] == pytest.approx(
+        fields["mfu_analytic"], rel=0.02, abs=1e-6
+    )
+
+
+def test_resnet_constant_uses_macs_times_two():
+    """Change-detector for the BENCH_r02 2x bug: the ResNet-50 analytic
+    constant must be the MACs x 2 figure (fwd 4.1 GMACs = 8.2 GF, train
+    ~3x fwd = 24.6 GF/image), not the bare MAC count."""
+    import bench
+
+    assert bench.RESNET50_TRAIN_FLOPS_PER_IMAGE == pytest.approx(24.6e9)

@@ -287,3 +287,83 @@ def test_flight_schema_selected_by_basename(tmp_path):
     p2 = tmp_path / "flight.3.jsonl"  # non-chief hosts' dumps also match
     _write_jsonl(p2, [{"t": 100.0, "kind": "step"}])
     assert check_metrics_schema.check_file(str(p2)) == ([], [])
+
+
+def test_report_sharding_section(tmp_path, capsys):
+    """The weight-update-sharding digest: per-device params/opt-state
+    bytes + the ZeRO mode, from the per-record state-bytes fields."""
+    p = tmp_path / "metrics.jsonl"
+    _write_jsonl(p, [
+        {"step": 10, "loss": 1.0, "t_step": 0.1,
+         "params_bytes_per_device": 8 << 20,
+         "opt_state_bytes_per_device": 2 << 20,
+         "zero_stage": 1, "zero_degree": 8},
+    ])
+    report = run_report.build_report(str(tmp_path))
+    assert report["sharding"] == {
+        "params_bytes_per_device": 8 << 20,
+        "opt_state_bytes_per_device": 2 << 20,
+        "zero_stage": 1, "zero_degree": 8,
+    }
+    out = run_report.render(report)
+    assert "weight-update sharding: ZeRO stage 1 (degree 8)" in out
+    assert "optimizer state" in out
+
+    # replicated run: fields present, zero_stage absent -> "replicated"
+    _write_jsonl(p, [
+        {"step": 10, "loss": 1.0,
+         "params_bytes_per_device": 8 << 20,
+         "opt_state_bytes_per_device": 16 << 20},
+    ])
+    out = run_report.render(run_report.build_report(str(tmp_path)))
+    assert "weight-update sharding: replicated" in out
+
+
+def test_report_without_state_bytes_has_empty_sharding(logdir):
+    report = run_report.build_report(str(logdir))
+    assert report["sharding"] == {}
+    assert "weight-update sharding" not in run_report.render(report)
+
+
+def test_prom_schema_validates_collective_op_labels(tmp_path):
+    """metrics.prom validation: well-formed samples pass; an unknown
+    collective_dispatch_seconds op label is an error (a typo'd op would
+    silently fork the histogram's time series)."""
+    p = tmp_path / "metrics.prom"
+    p.write_text(
+        "# snapshot_unix_time 1.0\n"
+        "# TYPE collective_dispatch_seconds histogram\n"
+        'collective_dispatch_seconds_bucket{le="0.001",op="reduce_scatter"} 2\n'
+        'collective_dispatch_seconds_bucket{le="+Inf",op="all_gather"} 3\n'
+        'collective_dispatch_seconds_count{op="all_reduce"} 3\n'
+        'collective_dispatch_seconds_sum{op="all_to_all"} 0.004\n'
+        "steps_per_sec 10.0\n"
+    )
+    assert check_metrics_schema.check_file(str(p)) == ([], [])
+    assert check_metrics_schema.main([str(p)]) == 0
+
+    p.write_text(
+        'collective_dispatch_seconds_count{op="not_a_collective"} 1\n'
+        "not a sample line\n"
+        "steps_per_sec oops\n"
+    )
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 3
+    assert any("not_a_collective" in e for e in errors)
+    assert check_metrics_schema.main([str(p)]) == 1
+
+
+def test_metrics_rows_validate_flattened_collective_ops(tmp_path):
+    """The jsonl-flattened registry scalars carry the same known-op rule
+    (collective_dispatch_seconds_count.op_<op>)."""
+    p = tmp_path / "metrics.jsonl"
+    _write_jsonl(p, [
+        {"step": 1, "collective_dispatch_seconds_count.op_reduce_scatter": 2,
+         "collective_dispatch_seconds_avg.op_all_gather": 0.001},
+    ])
+    assert check_metrics_schema.check_file(str(p)) == ([], [])
+    _write_jsonl(p, [
+        {"step": 1, "collective_dispatch_seconds_count.op_bogus": 2},
+    ])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 1 and "bogus" in errors[0]

@@ -119,6 +119,45 @@ def test_sidecar_stop_after_step(tmp_path, dp_mesh):
     mgr.close()
 
 
+def test_sidecar_restores_zero_checkpoint_into_unchunked_template(
+    tmp_path, dp_mesh
+):
+    """A --zero trainer saves degree-chunked optimizer state; an evaluator
+    whose own template is unchunked (e.g. a single-chip eval host) must
+    rechunk on restore instead of rejecting every checkpoint as corrupt
+    until idle timeout."""
+    from distributedtensorflow_tpu.parallel.zero import ZeroSharder
+
+    model = LeNet5()
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))
+    zstate, _ = create_sharded_state(
+        init_fn, optax.adam(1e-3), dp_mesh, jax.random.PRNGKey(0),
+        zero=ZeroSharder(dp_mesh),
+    )
+    writer = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    writer.save(3, zstate.replace(step=jnp.asarray(3)), force=True)
+    writer.wait()
+    writer.close()
+
+    # The evaluator's own topology: unchunked template, adam slots full.
+    state, specs = create_sharded_state(
+        init_fn, optax.adam(1e-3), dp_mesh, jax.random.PRNGKey(1)
+    )
+    eval_step = make_eval_step(classification_eval(model), dp_mesh, specs)
+    sidecar = SidecarEvaluator(
+        CheckpointManager(str(tmp_path / "ckpt"), async_save=False),
+        eval_step,
+        lambda: iter(_batches()),
+        state,
+        poll_interval_s=0.05,
+        max_evaluations=1,
+        idle_timeout_s=10,  # pre-fix behavior: retry-forever, bounded here
+    )
+    history = sidecar.run()
+    assert set(history) == {3}
+    assert np.isfinite(history[3]["loss"])
+
+
 def test_cli_evaluator_job(tmp_path, dp_mesh):
     """train.py --job auto + TF_CONFIG evaluator task runs the sidecar and
     writes eval metrics for the trainer's checkpoints."""

@@ -13,8 +13,11 @@ flight-recorder event schema; basenames starting with ``goodput`` against
 the goodput-ledger document schema; basenames starting with ``captures``
 against the reactive-profiler manifest schema; basenames starting with
 ``faults`` against the chaos fault-log schema; basenames starting with
-``requests`` against the serving per-request log schema; everything else
-against the metric-row schema.
+``requests`` against the serving per-request log schema; files ending in
+``.prom`` against the Prometheus exposition snapshot (well-formed samples;
+``collective_dispatch_seconds`` ``op`` labels restricted to the known
+collective set — see :data:`COLLECTIVE_OPS`); everything else against the
+metric-row schema.
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
@@ -74,7 +77,17 @@ import glob
 import json
 import math
 import os
+import re
 import sys
+
+#: jsonl-flattened label suffix of the collective histogram (.op_<op>).
+_FLAT_OP_RE = re.compile(r"\.op_([A-Za-z0-9_]+)$")
+
+#: One Prometheus exposition sample: name, optional {labels}, value.
+_PROM_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$"
+)
+_PROM_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"')
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_GLOB = os.path.join(REPO, "ARTIFACTS", "convergence_*", "metrics.jsonl")
@@ -92,6 +105,9 @@ DEFAULT_FAULTS_GLOB = os.path.join(
 )
 DEFAULT_REQUESTS_GLOB = os.path.join(
     REPO, "ARTIFACTS", "serve_*", "requests*.jsonl"
+)
+DEFAULT_PROM_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "convergence_*", "metrics.prom"
 )
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
@@ -121,6 +137,16 @@ FAULT_PHASES = ("injected", "recovered")
 REQUEST_STATES = ("ok", "rejected", "error")
 FINISH_REASONS = ("eos", "length")
 
+#: The known ``op`` labels of the ``collective_dispatch_seconds``
+#: histogram (parallel/collectives.py wrappers — duplicated for the same
+#: stdlib-only reason).  ``reduce_scatter`` / ``all_gather`` cover both
+#: the shard_map primitives and the GSPMD-constraint wrappers the ZeRO
+#: weight-update sharding path dispatches through.
+COLLECTIVE_OPS = (
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "permute",
+    "shift", "all_to_all",
+)
+
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
     """Returns (errors, warnings) for one parsed row."""
@@ -141,6 +167,14 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
         if not isinstance(k, str) or not k or any(ord(c) < 32 for c in k):
             errors.append(f"line {lineno}: bad field name {k!r}")
             continue
+        if k.startswith("collective_dispatch_seconds"):
+            # flattened label suffix: ..._count.op_<op> (registry.scalars)
+            m = _FLAT_OP_RE.search(k)
+            if m and m.group(1) not in COLLECTIVE_OPS:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown collective "
+                    f"op {m.group(1)!r} (known: {COLLECTIVE_OPS})"
+                )
         if v in ("NaN", "Infinity", "-Infinity"):
             warnings.append(f"line {lineno}: field {k!r} is non-finite ({v})")
         elif isinstance(v, bool) or not isinstance(v, (int, float)):
@@ -487,6 +521,42 @@ def check_requests_file(path: str) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
+def check_prom_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate one ``metrics.prom`` snapshot (obs registry text
+    exposition): every non-comment line must be a well-formed sample with
+    a parseable value, and every ``collective_dispatch_seconds*`` sample
+    carrying an ``op`` label must use a KNOWN collective op
+    (:data:`COLLECTIVE_OPS`) — a typo'd or unregistered op label would
+    silently fork the histogram's time series."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = _PROM_SAMPLE_RE.match(line)
+            if not m:
+                errors.append(f"line {i}: not a prometheus sample: {line!r}")
+                continue
+            name, labelstr, value = m.groups()
+            try:
+                float(value)  # accepts nan/+Inf/-Inf spellings
+            except ValueError:
+                errors.append(
+                    f"line {i}: sample {name} value {value!r} is not a number"
+                )
+            if name.startswith("collective_dispatch_seconds") and labelstr:
+                labels = dict(_PROM_LABEL_RE.findall(labelstr))
+                op = labels.get("op")
+                if op is not None and op not in COLLECTIVE_OPS:
+                    errors.append(
+                        f"line {i}: {name} carries unknown collective op "
+                        f"{op!r} (known: {COLLECTIVE_OPS})"
+                    )
+    return errors, warnings
+
+
 def _check_bucket_map(buckets, where: str) -> tuple[list[str], list[str]]:
     errors: list[str] = []
     warnings: list[str] = []
@@ -582,6 +652,8 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         return check_goodput_doc(doc)
     if os.path.basename(path).startswith("faults"):
         return check_faults_file(path)
+    if path.endswith(".prom"):
+        return check_prom_file(path)
     if os.path.basename(path).startswith("requests"):
         return check_requests_file(path)
     flight = os.path.basename(path).startswith("flight")
@@ -618,6 +690,7 @@ def main(argv: list[str] | None = None) -> int:
         glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
         + glob.glob(DEFAULT_GOODPUT_GLOB) + glob.glob(DEFAULT_CAPTURES_GLOB)
         + glob.glob(DEFAULT_FAULTS_GLOB) + glob.glob(DEFAULT_REQUESTS_GLOB)
+        + glob.glob(DEFAULT_PROM_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

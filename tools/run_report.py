@@ -338,6 +338,29 @@ def serving_summary(rows: list[dict]) -> dict:
     }
 
 
+def sharding_summary(train: list[dict]) -> dict:
+    """The weight-update-sharding digest from the per-record state-bytes
+    fields (written once per log boundary from the fit's static
+    accounting): per-device params / optimizer-state bytes and the ZeRO
+    mode that produced them — the number ``--zero`` exists to shrink.
+    Empty when the run predates the fields."""
+    last = {}
+    for r in train:  # last row carrying the fields wins
+        if isinstance(r.get("opt_state_bytes_per_device"), (int, float)) \
+                or isinstance(r.get("params_bytes_per_device"), (int, float)):
+            last = r
+    if not last:
+        return {}
+    out: dict = {}
+    for key in ("params_bytes_per_device", "opt_state_bytes_per_device"):
+        if isinstance(last.get(key), (int, float)):
+            out[key] = last[key]
+    out["zero_stage"] = int(last.get("zero_stage", 0) or 0)
+    if isinstance(last.get("zero_degree"), (int, float)):
+        out["zero_degree"] = int(last["zero_degree"])
+    return out
+
+
 def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     """Last-row host-spread fields, grouped by base key."""
     out: dict[str, dict[str, float]] = {}
@@ -426,6 +449,7 @@ def build_report(logdir: str) -> dict:
             for p, s, f in breakdown_table(train)
         ],
         "anomalies": collect_anomalies(trace, train),
+        "sharding": sharding_summary(train),
         "stragglers": straggler_fields(train),
         "flight": flight_summary(flight),
         "captures": capture_summary(captures),
@@ -618,6 +642,23 @@ def render(report: dict) -> str:
         if srv.get("rejected"):
             lines.append(f"  REJECTED {srv['rejected']} request(s) "
                          "(queue backpressure)")
+    sh = report.get("sharding")
+    if sh:
+        mode = (
+            f"ZeRO stage {sh['zero_stage']}"
+            + (f" (degree {sh['zero_degree']})" if "zero_degree" in sh
+               else "")
+            if sh.get("zero_stage") else "replicated"
+        )
+        lines += ["", f"weight-update sharding: {mode}"]
+        for key, label in (
+            ("params_bytes_per_device", "params"),
+            ("opt_state_bytes_per_device", "optimizer state"),
+        ):
+            if key in sh:
+                lines.append(
+                    f"  {label:<16} {sh[key] / (1 << 20):10.2f} MiB/device"
+                )
     if report["stragglers"]:
         lines += ["", "straggler summary (last record):"]
         for base, d in report["stragglers"].items():

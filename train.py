@@ -177,11 +177,33 @@ def apply_optimizer_flags(wl, args):
     opt_name, wd, clip = args.optimizer, args.weight_decay, args.clipnorm
     return dataclasses.replace(
         wl,
-        make_optimizer=lambda: build_optimizer(
+        # decay_mask is overridable so --zero can swap the callable for a
+        # concrete pytree resolved on the UNCHUNKED shapes (see
+        # _concrete_decay_mask).
+        make_optimizer=lambda decay_mask=mask: build_optimizer(
             opt_name, lr, weight_decay=wd, global_clipnorm=clip,
-            decay_mask=mask,
+            decay_mask=decay_mask,
         ),
     )
+
+
+def _concrete_decay_mask(wl, rng):
+    """Resolve the bias-norm decay mask into a concrete bool pytree on the
+    workload's UNCHUNKED param shapes.
+
+    Under ``--zero`` optax re-evaluates a *callable* mask on whatever tree
+    ``tx`` sees — the chunked ``(degree, chunk)`` view, where every leaf
+    is rank-2, so ``exclude_bias_and_norm_mask``'s rank<=1 exclusion would
+    silently start decaying unnamed 1-D parameters and diverge from the
+    replicated trajectory.  A concrete pytree is layout-invariant
+    (chunking preserves the treedef)."""
+    from distributedtensorflow_tpu.train.optimizers import (
+        exclude_bias_and_norm_mask,
+    )
+    from distributedtensorflow_tpu.train.state import split_variables
+
+    params, _ = split_variables(jax.eval_shape(wl.init_fn, rng))
+    return exclude_bias_and_norm_mask(params)
 
 
 def run_evaluator(args) -> None:
@@ -222,9 +244,24 @@ def run_evaluator(args) -> None:
                  wl.name, dict(mesh.shape), args.checkpoint_dir)
 
     rng = jax.random.PRNGKey(args.seed)
+    # Mirror the trainer's --zero so the restore template's optimizer
+    # state matches the watched checkpoints' chunked layout.
+    zero_sharder = None
+    if args.zero:
+        from distributedtensorflow_tpu.parallel.mesh import replica_count
+        from distributedtensorflow_tpu.parallel.zero import ZeroSharder
+
+        if replica_count(mesh) > 1:
+            zero_sharder = ZeroSharder(mesh)
+    # Same decay-mask resolution as the trainer: the restore template's
+    # optax MaskedState treedef must match the watched checkpoints'.
+    if zero_sharder is not None and args.decay_mask == "bias-norm":
+        tx = wl.make_optimizer(_concrete_decay_mask(wl, rng))
+    else:
+        tx = wl.make_optimizer()
     state, specs = create_sharded_state(
-        wl.init_fn, wl.make_optimizer(), mesh, rng,
-        rules=wl.layout, fsdp=wl.fsdp,
+        wl.init_fn, tx, mesh, rng,
+        rules=wl.layout, fsdp=wl.fsdp, zero=zero_sharder,
     )
     eval_step = make_eval_step(wl.eval_fn, mesh, specs)
     ctx = InputContext(1, 0, wl.global_batch_size)
@@ -515,6 +552,14 @@ def main() -> None:
                    help="mesh axes, e.g. 'data=-1' or 'data=2,model=4' "
                         "(default: workload preset = its reference strategy)")
     p.add_argument("--accum-steps", type=int, default=None)
+    p.add_argument("--zero", action="store_true",
+                   help="cross-replica weight-update sharding (ZeRO stage "
+                        "1, arxiv 2004.13336): reduce-scatter gradients, "
+                        "shard the optimizer state + update 1/N per "
+                        "data-parallel replica, all-gather updated params "
+                        "— per-device optimizer-state bytes shrink by the "
+                        "replica count; exact for elementwise optimizers "
+                        "(sgd/momentum/adam/adamw/adagrad/lion)")
     p.add_argument("--steps-per-call", type=int, default=1,
                    help="optimizer steps bundled into one XLA dispatch"
                         " (Keras steps_per_execution analogue; amortizes"
@@ -846,14 +891,45 @@ def main() -> None:
     )
 
     rng = jax.random.PRNGKey(args.seed)
+    # --zero: cross-replica weight-update sharding (parallel/zero.py).
+    # ONE sharder instance for the same treedef-identity reason as the
+    # optimizer: the supervised-restart template must chunk identically.
+    zero_sharder = None
+    if args.zero:
+        if shard_div <= 1:
+            logging.warning(
+                "--zero: mesh %s has a single data-parallel replica; "
+                "nothing to shard the weight update over — running "
+                "replicated", dict(mesh.shape),
+            )
+        else:
+            from distributedtensorflow_tpu.parallel.zero import ZeroSharder
+            from distributedtensorflow_tpu.train.optimizers import ZERO_SAFE
+
+            if args.optimizer and args.optimizer not in ZERO_SAFE:
+                logging.warning(
+                    "--zero with --optimizer %s: its update is not "
+                    "elementwise (per-shard norms/factored stats), so the "
+                    "trajectory will deviate from replicated data "
+                    "parallelism; elementwise optimizers (%s) are exact",
+                    args.optimizer, ", ".join(ZERO_SAFE),
+                )
+            zero_sharder = ZeroSharder(mesh)
+            logging.info(
+                "zero: sharding optimizer state + weight update %d-way "
+                "over axes %s", zero_sharder.degree, zero_sharder.axes,
+            )
     # ONE optimizer instance: a supervised restart rebuilds the state
     # template, and a fresh make_optimizer() would carry new optax
     # function identities in the TrainState treedef — a pytree-metadata
     # mismatch against the already-compiled step's in_shardings.
-    optimizer = wl.make_optimizer()
+    if zero_sharder is not None and args.decay_mask == "bias-norm":
+        optimizer = wl.make_optimizer(_concrete_decay_mask(wl, rng))
+    else:
+        optimizer = wl.make_optimizer()
     state, specs = create_sharded_state(
         wl.init_fn, optimizer, mesh, rng,
-        rules=wl.layout, fsdp=wl.fsdp,
+        rules=wl.layout, fsdp=wl.fsdp, zero=zero_sharder,
     )
     if args.steps_per_call > 1:
         from distributedtensorflow_tpu.train import make_multi_train_step
@@ -975,7 +1051,17 @@ def main() -> None:
         preemption = PreemptionHandler(checkpointer, mesh=mesh)
         if chaos is not None:
             chaos.attach_preemption(preemption)
-        state = checkpointer.restore_latest(state) or state
+        # The ZeRO-aware restore handles a checkpoint saved at a DIFFERENT
+        # weight-update-sharding degree (or none) by rechunking the
+        # verified optimizer state; matching layouts take the manager's
+        # own fast path unchanged.
+        from distributedtensorflow_tpu.parallel.zero import (
+            restore_latest_zero,
+        )
+
+        state = restore_latest_zero(
+            checkpointer, state, mesh, zero_sharder
+        ) or state
     restored_step = int(state.step)
     train_iter = None  # supervised runs build theirs via make_train_iter
     if chaos is not None:
@@ -994,6 +1080,7 @@ def main() -> None:
             checkpoint_every=args.checkpoint_every,
             steps_per_call=args.steps_per_call,
             input_prebundled=args.steps_per_call > 1,
+            zero_stage=1 if zero_sharder is not None else 0,
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
             profile_dir=args.profile_dir,
@@ -1064,7 +1151,7 @@ def main() -> None:
                     template, _ = create_sharded_state(
                         wl.init_fn, optimizer, mesh,
                         jax.random.PRNGKey(args.seed),
-                        rules=wl.layout, fsdp=wl.fsdp,
+                        rules=wl.layout, fsdp=wl.fsdp, zero=zero_sharder,
                     )
                     return template
 
