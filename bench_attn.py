@@ -113,6 +113,14 @@ def main() -> None:
             f"BENCH_ATTN_IMPLS must be a non-empty subset of flash,xla; "
             f"got {os.environ.get('BENCH_ATTN_IMPLS')!r}"
         )
+    from distributedtensorflow_tpu.ops import flash_tuning
+    from distributedtensorflow_tpu.ops.flash_attention import (
+        _default_chain,
+        _resolve_blocks,
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+    )
+
     b, h, d = 4, 8, 64
     platform = jax.devices()[0].platform
     interpret = not is_tpu_platform(platform)
@@ -124,9 +132,27 @@ def main() -> None:
             jax.random.normal(kk, (b, seq, h, d), jnp.bfloat16) for kk in ks
         )
 
+        # Resolved tiling (env > autotune cache > default chain) vs the
+        # default chain, recorded per row so the autotuner's pick is
+        # auditable; when they differ, BOTH are timed.
+        res_bq, res_bk = _resolve_blocks(b, h, seq, d, jnp.bfloat16,
+                                         None, None)
+        def_bq = _default_chain(seq, DEFAULT_BLOCK_Q)
+        def_bk = _default_chain(seq, DEFAULT_BLOCK_K)
+        tuned = flash_tuning.lookup(
+            platform=jax.default_backend(), dtype="bfloat16",
+            seq=seq, depth=d, batch=b, heads=h,
+        )
+
         flash_f = jax.jit(
             lambda q, k, v: flash_attention(
                 q, k, v, causal=True, interpret=interpret
+            )
+        )
+        flash_default_f = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, interpret=interpret,
+                block_q=def_bq, block_k=def_bk,
             )
         )
         xla_f = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=True))
@@ -159,12 +185,27 @@ def main() -> None:
                  loss(lambda q, k, v: xla_attention(q, k, v, causal=True)),
                  (q, k, v)),
             ]
-        row = {"seq": seq}
+        if "flash" in impls and (res_bq, res_bk) != (def_bq, def_bk):
+            # An autotuned (or env-pinned) tiling is in force: time the
+            # default chain too so the pick is auditable as a delta.
+            measurements.append(
+                ("flash_fwd_default_ms", flash_default_f, (q, k, v))
+            )
+        row = {
+            "seq": seq,
+            "block_q": res_bq, "block_k": res_bk,
+            "default_block_q": def_bq, "default_block_k": def_bk,
+            "autotuned": tuned is not None and (res_bq, res_bk) == tuned,
+        }
         for key, fn, fargs in measurements:
             try:
                 row[key] = round(1e3 * bench_one(fn, fargs, n_steps), 3)
             except Exception as e:
                 row[key.removesuffix("_ms")] = _classify_failure(e)
+        if "flash_fwd_ms" in row and "flash_fwd_default_ms" in row:
+            row["tuned_vs_default"] = round(
+                row["flash_fwd_default_ms"] / row["flash_fwd_ms"], 3
+            )
         if "flash_fwd_ms" in row and "xla_fwd_ms" in row:
             row["fwd_speedup"] = round(row["xla_fwd_ms"] / row["flash_fwd_ms"], 3)
         if "flash_bwd_ms" in row and "xla_bwd_ms" in row:

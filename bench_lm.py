@@ -84,11 +84,18 @@ def main() -> None:
     xent_impl = os.environ.get("BENCH_LM_XENT") or None
     window_env = os.environ.get("BENCH_LM_WINDOW")
     attn_window = int(window_env) if window_env else None
+    # BENCH_LM_QUANT: int8 / int8_stochastic / fp8 (ops/quant.py) —
+    # validated by get_workload; BENCH_LM_OVERLAP=1: bucketed backward
+    # gradient sync (parallel/overlap.py).
+    quant = os.environ.get("BENCH_LM_QUANT") or None
+    if quant == "none":
+        quant = None
+    overlap = os.environ.get("BENCH_LM_OVERLAP") == "1"
     wl = get_workload(
         workload, test_size=test_size,
         global_batch_size=per_chip_batch * n_chips,
         seq_len=seq, remat=remat, attn_impl=attn_impl, xent_impl=xent_impl,
-        attn_window=attn_window,
+        attn_window=attn_window, quant=quant,
     )
     wl = wl.for_mesh(mesh)
     if seq is None:  # resolved by the preset; recover it for data + MFU
@@ -107,7 +114,18 @@ def main() -> None:
     state, specs = create_sharded_state(
         wl.init_fn, wl.make_optimizer(), mesh, rng, rules=wl.layout
     )
-    step = make_train_step(wl.loss_fn, mesh, specs)
+    overlap_plan = None
+    if overlap and mesh.size > 1:
+        from distributedtensorflow_tpu.parallel.overlap import OverlapPlan
+        from distributedtensorflow_tpu.train.state import split_variables
+
+        param_shapes, _ = split_variables(jax.eval_shape(wl.init_fn, rng))
+        overlap_plan = OverlapPlan.build(
+            mesh, param_shapes, specs.params,
+            bucket_bytes=int(float(
+                os.environ.get("BENCH_LM_OVERLAP_MB", "4")) * 2 ** 20),
+        )
+    step = make_train_step(wl.loss_fn, mesh, specs, overlap=overlap_plan)
     ids = np.random.default_rng(0).integers(
         0, wl.model.cfg.vocab_size, size=(wl.global_batch_size, seq)
     ).astype(np.int32)
@@ -124,7 +142,8 @@ def main() -> None:
         from distributedtensorflow_tpu.train import make_multi_train_step
 
         step = make_multi_train_step(
-            wl.loss_fn, mesh, specs, steps_per_call=inner
+            wl.loss_fn, mesh, specs, steps_per_call=inner,
+            overlap=overlap_plan,
         )
         batch = jax.tree.map(
             lambda x: jnp.broadcast_to(x[None], (inner,) + x.shape), batch
@@ -154,6 +173,8 @@ def main() -> None:
             "attn_impl": attn_label,
             "attn_window": _cfg.attn_window,
             "xent_impl": xent_label,
+            "quant": quant or "none",
+            "overlap": overlap_plan is not None,
             "steps_per_call": inner,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
@@ -205,6 +226,11 @@ def main() -> None:
         "attn_impl": attn_label,
         "attn_window": _cfg.attn_window,
         "xent_impl": xent_label,
+        "quant": quant or "none",
+        "overlap": overlap_plan is not None,
+        "overlap_buckets": (
+            len(overlap_plan.buckets) if overlap_plan is not None else 0
+        ),
         "step_time_ms": round(1000 * dt / n_opt_steps, 2),
         "steps_per_call": inner,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -22,7 +22,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.sharding import LayoutMap
-from .layers import FusedLayerNorm
+from .layers import FusedLayerNorm, QuantDenseGeneral
+from .layers import dense as dense_layer
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +37,17 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
+    #: Quantized compute (ops/quant.py): routes the block matmuls —
+    #: query/key/value/out projections and the MLP pair — through the
+    #: int8/fp8 per-channel quantized dot (STE backward).  Embeddings,
+    #: layer norms, and the MLM head stay high-precision.  Same param
+    #: tree either way (checkpoint-compatible).
+    quant: str | None = None
+
+    def __post_init__(self):
+        from ..ops.quant import validate_mode
+
+        validate_mode(self.quant)
 
 
 def bert_base() -> "BertConfig":
@@ -57,16 +69,27 @@ class SelfAttention(nn.Module):
     def __call__(self, x, mask, deterministic: bool, segment_ids=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
-        dense = lambda name: nn.DenseGeneral(
-            (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name
-        )
+        if cfg.quant and cfg.quant != "none":
+            dense = lambda name: QuantDenseGeneral(
+                (cfg.num_heads, head_dim), quant=cfg.quant,
+                dtype=cfg.dtype, name=name,
+            )
+            out_proj = QuantDenseGeneral(
+                cfg.hidden_size, quant=cfg.quant, axis=(-2, -1),
+                dtype=cfg.dtype, name="out",
+            )
+        else:
+            dense = lambda name: nn.DenseGeneral(
+                (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name
+            )
+            out_proj = nn.DenseGeneral(
+                cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+            )
         q = dense("query")(x)
         k = dense("key")(x)
         v = dense("value")(x)
         out = dot_product_attention(q, k, v, mask=mask, segment_ids=segment_ids)
-        out = nn.DenseGeneral(
-            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
-        )(out)
+        out = out_proj(out)
         if not deterministic:
             out = nn.Dropout(cfg.dropout_rate)(out, deterministic=False)
         return out
@@ -83,9 +106,11 @@ class TransformerBlock(nn.Module):
             x, mask, deterministic, segment_ids
         )
         x = ln("ln_attn")(x + attn_out)
-        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
+        h = dense_layer(cfg.intermediate_size, dtype=cfg.dtype,
+                        quant=cfg.quant, name="mlp_in")(x)
         h = nn.gelu(h)
-        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(h)
+        h = dense_layer(cfg.hidden_size, dtype=cfg.dtype,
+                        quant=cfg.quant, name="mlp_out")(h)
         if not deterministic:
             h = nn.Dropout(cfg.dropout_rate)(h, deterministic=False)
         return ln("ln_mlp")(x + h)

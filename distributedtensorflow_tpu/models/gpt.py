@@ -29,7 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.sharding import LayoutMap
-from .layers import FusedLayerNorm
+from .layers import FusedLayerNorm, dense
 
 AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
@@ -82,8 +82,18 @@ class GPTConfig:
     #: "fused" (Pallas ops/fused_xent.py unconditionally — logits never
     #: leave VMEM; ~4.1x less head HBM traffic at equal FLOPs).
     xent_impl: str = "auto"
+    #: Quantized compute (ops/quant.py): None/"none" = full-width; "int8"
+    #: / "int8_stochastic" / "fp8" route every block dense matmul (qkv,
+    #: proj, fc_in, fc_out) through the per-channel-absmax quantized
+    #: dot with a straight-through-estimator backward.  Embeddings, layer
+    #: norms, rope, and the fp32 tied head stay high-precision.  Param
+    #: tree is unchanged, so checkpoints move between modes freely.
+    quant: str | None = None
 
     def __post_init__(self):
+        from ..ops.quant import validate_mode
+
+        validate_mode(self.quant)
         kv = self.num_kv_heads
         if kv is not None and (kv <= 0 or self.num_heads % kv):
             raise ValueError(
@@ -226,9 +236,9 @@ class CausalSelfAttention(nn.Module):
         # MHA default the fused dim is exactly 3E and the split matches
         # the historical jnp.split(qkv, 3) — same param tree, same values.
         kv_width = n_kv * head_dim
-        qkv = nn.Dense(
-            cfg.hidden_size + 2 * kv_width, dtype=cfg.dtype, use_bias=False,
-            name="qkv",
+        qkv = dense(
+            cfg.hidden_size + 2 * kv_width, dtype=cfg.dtype,
+            quant=cfg.quant, use_bias=False, name="qkv",
         )(x)
         q = qkv[..., :cfg.hidden_size]
         k = qkv[..., cfg.hidden_size:cfg.hidden_size + kv_width]
@@ -269,8 +279,9 @@ class CausalSelfAttention(nn.Module):
             )
         out = out.reshape(*x.shape[:2], cfg.hidden_size)
         # Row-parallel output projection (its input dim is head-sharded).
-        return nn.Dense(
-            cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="proj"
+        return dense(
+            cfg.hidden_size, dtype=cfg.dtype, quant=cfg.quant,
+            use_bias=False, name="proj",
         )(out)
 
     def _cached_attention(self, q, k, v):
@@ -299,10 +310,10 @@ class GPTBlock(nn.Module):
         )(h, positions, deterministic, rope_tabs)
         h = FusedLayerNorm(name="ln2")(x)
         # Column- then row-parallel MLP (Megatron split over `model`).
-        fc_in = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
-                         use_bias=False, name="fc_in")
-        fc_out = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, use_bias=False,
-                          name="fc_out")
+        fc_in = dense(cfg.intermediate_size, dtype=cfg.dtype,
+                      quant=cfg.quant, use_bias=False, name="fc_in")
+        fc_out = dense(cfg.hidden_size, dtype=cfg.dtype, quant=cfg.quant,
+                       use_bias=False, name="fc_out")
 
         def mlp(hc):
             return fc_out(nn.gelu(fc_in(hc)))

@@ -2,10 +2,14 @@
 
 from __future__ import annotations
 
+import math
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..ops.layernorm import layer_norm
+from ..ops.quant import quantized_matmul, validate_mode
 
 
 class FusedLayerNorm(nn.Module):
@@ -32,3 +36,91 @@ class FusedLayerNorm(nn.Module):
         bias = self.param("bias", nn.initializers.zeros, (d,), jnp.float32)
         return layer_norm(x, scale, bias, eps=self.epsilon,
                           out_dtype=self.out_dtype or x.dtype)
+
+
+class QuantDenseGeneral(nn.Module):
+    """``nn.DenseGeneral`` drop-in whose matmul runs the quantized path.
+
+    Same parameter tree as the flax module it replaces — ``kernel`` of
+    shape ``(*contracted_dims, *feature_dims)`` (fp32 param_dtype) and an
+    optional ``bias`` — so checkpoints restore unchanged between quantized
+    and full-width runs, and the tensor-parallel :class:`LayoutMap` rules
+    keyed on ``.../kernel`` keep matching.  The forward flattens the
+    contracted/feature dims to one 2-D ``(K, N)`` matmul through
+    :func:`~..ops.quant.quantized_matmul` (int8/fp8 per-channel absmax,
+    straight-through-estimator backward); quantization runs at the layer's
+    compute ``dtype`` operands, so ``quant="none"`` reproduces the plain
+    dense layer.
+
+    ``"int8_stochastic"`` draws its rounding noise from the ``"dropout"``
+    rng stream when the caller provides one (the training path — unique
+    per module instance and step) and falls back to a fixed key for
+    deterministic/eval applies.
+    """
+
+    features: int | tuple[int, ...]
+    quant: str = "int8"
+    axis: int | tuple[int, ...] = -1
+    use_bias: bool = True
+    dtype: jnp.dtype | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        mode = validate_mode(self.quant)
+        feats = (
+            (self.features,) if isinstance(self.features, int)
+            else tuple(self.features)
+        )
+        axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        axes = tuple(a % x.ndim for a in axes)
+        if axes != tuple(range(x.ndim - len(axes), x.ndim)):
+            raise ValueError(
+                f"QuantDenseGeneral contracts trailing axes only, got "
+                f"axis={self.axis} for input rank {x.ndim}"
+            )
+        in_shape = tuple(x.shape[a] for a in axes)
+        k = math.prod(in_shape)
+        n = math.prod(feats)
+
+        def kernel_init(key, shape, dtype):
+            # lecun_normal over the FLATTENED (K, N) view — the same
+            # fan-in statistics nn.DenseGeneral produces for these shapes.
+            w = nn.initializers.lecun_normal()(key, (k, n), dtype)
+            return w.reshape(shape)
+
+        kernel = self.param("kernel", kernel_init, in_shape + feats,
+                            jnp.float32)
+        dtype = self.dtype or x.dtype
+        x2 = x.reshape(*x.shape[: x.ndim - len(axes)], k).astype(dtype)
+        w2 = kernel.reshape(k, n).astype(dtype)
+        key = None
+        if mode == "int8_stochastic":
+            key = (
+                self.make_rng("dropout") if self.has_rng("dropout")
+                else jax.random.PRNGKey(0)
+            )
+        y = quantized_matmul(x2, w2, mode=mode, key=key)
+        y = y.reshape(*x.shape[: x.ndim - len(axes)], *feats)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, feats,
+                              jnp.float32)
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class QuantDense(QuantDenseGeneral):
+    """``nn.Dense`` drop-in over the quantized matmul (axis=-1, int
+    features); see :class:`QuantDenseGeneral`."""
+
+
+def dense(features: int, *, dtype, quant: str | None = None,
+          use_bias: bool = True, name: str | None = None) -> nn.Module:
+    """The model zoo's dense-layer picker: ``quant`` in (None, "none")
+    returns a plain ``nn.Dense``; any other mode returns the
+    checkpoint-compatible :class:`QuantDense`.  ONE switch shared by the
+    GPT/BERT/ViT call sites so a new mode cannot be wired into one model
+    family and silently ignored by another."""
+    if not quant or quant == "none":
+        return nn.Dense(features, dtype=dtype, use_bias=use_bias, name=name)
+    return QuantDense(features, quant=quant, dtype=dtype,
+                      use_bias=use_bias, name=name)

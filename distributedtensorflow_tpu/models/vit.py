@@ -20,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.sharding import LayoutMap
-from .layers import FusedLayerNorm
+from .layers import FusedLayerNorm, dense
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,6 +34,16 @@ class ViTConfig:
     intermediate_size: int = 1536
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
+    #: Quantized compute (ops/quant.py): routes the block matmuls (qkv,
+    #: proj, fc_in, fc_out) through the int8/fp8 quantized dot (STE
+    #: backward).  The patch-embed conv, layer norms, pos embedding, and
+    #: the fp32 classifier head stay high-precision.
+    quant: str | None = None
+
+    def __post_init__(self):
+        from ..ops.quant import validate_mode
+
+        validate_mode(self.quant)
 
 
 def vit_s16() -> ViTConfig:
@@ -59,24 +69,26 @@ class ViTBlock(nn.Module):
         # Fused QKV as one (D, 3H) matmul, like the GPT blocks: the flat 3H
         # output dim shards over `model` for any tp dividing 3*hidden (the
         # per-head layout would require tp | num_heads — ViT-S has 6).
-        qkv = nn.Dense(
-            3 * cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="qkv"
+        qkv = dense(
+            3 * cfg.hidden_size, dtype=cfg.dtype, quant=cfg.quant,
+            use_bias=False, name="qkv",
         )(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (*h.shape[:2], cfg.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         attn = dot_product_attention(q, k, v)  # bidirectional
         attn = attn.reshape(*h.shape[:2], cfg.hidden_size)
-        attn = nn.Dense(
-            cfg.hidden_size, dtype=cfg.dtype, use_bias=False, name="proj"
+        attn = dense(
+            cfg.hidden_size, dtype=cfg.dtype, quant=cfg.quant,
+            use_bias=False, name="proj",
         )(attn)
         x = x + attn
         h = FusedLayerNorm(name="ln2")(x)
-        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
-                     use_bias=False, name="fc_in")(h)
+        h = dense(cfg.intermediate_size, dtype=cfg.dtype, quant=cfg.quant,
+                  use_bias=False, name="fc_in")(h)
         h = nn.gelu(h)
-        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
-                     use_bias=False, name="fc_out")(h)
+        h = dense(cfg.hidden_size, dtype=cfg.dtype, quant=cfg.quant,
+                  use_bias=False, name="fc_out")(h)
         if cfg.dropout_rate and not deterministic:
             h = nn.Dropout(cfg.dropout_rate)(h, deterministic=False)
         return x + h

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -61,19 +62,34 @@ def _env_block(name: str) -> int | None:
     return n
 
 
-def _pick_block_q(seq_len: int) -> int | None:
-    o = _env_block("DTFT_FLASH_BLOCK_Q")
-    if o:
-        if seq_len % o == 0:
-            return o
-        import sys
+def _env_divisible(name: str, seq_len: int) -> int | None:
+    """The env-override block when set AND it divides the sequence; a
+    non-dividing override warns (``warnings.warn`` — NOT a bare print:
+    bench JSON consumers parse this process's stdout/stderr) and falls
+    through to the next resolution tier."""
+    o = _env_block(name)
+    if not o:
+        return None
+    if seq_len % o == 0:
+        return o
+    warnings.warn(
+        f"flash_attention: {name}={o} does not divide seq {seq_len}; "
+        "using the default chain",
+        stacklevel=3,
+    )
+    return None
 
-        print(f"flash_attention: DTFT_FLASH_BLOCK_Q={o} does not divide "
-              f"seq {seq_len}; using the default chain", file=sys.stderr)
-    for b in (DEFAULT_BLOCK_Q, 512, 256, 128, 64, 32, 16, 8):
+
+def _default_chain(seq_len: int, first: int) -> int | None:
+    for b in (first, 512, 256, 128, 64, 32, 16, 8):
         if seq_len % b == 0:
             return b
     return None
+
+
+def _pick_block_q(seq_len: int) -> int | None:
+    o = _env_divisible("DTFT_FLASH_BLOCK_Q", seq_len)
+    return o or _default_chain(seq_len, DEFAULT_BLOCK_Q)
 
 
 def _on_tpu() -> bool:
@@ -155,18 +171,47 @@ DEFAULT_BLOCK_K = 1024  # see the DEFAULT_BLOCK_Q sweep note
 
 
 def _pick_block_k(seq_len: int) -> int | None:
-    o = _env_block("DTFT_FLASH_BLOCK_K")
-    if o:
-        if seq_len % o == 0:
-            return o
-        import sys
+    o = _env_divisible("DTFT_FLASH_BLOCK_K", seq_len)
+    return o or _default_chain(seq_len, DEFAULT_BLOCK_K)
 
-        print(f"flash_attention: DTFT_FLASH_BLOCK_K={o} does not divide "
-              f"seq {seq_len}; using the default chain", file=sys.stderr)
-    for b in (DEFAULT_BLOCK_K, 512, 256, 128, 64, 32, 16, 8):
-        if seq_len % b == 0:
-            return b
-    return None
+
+def _tuned_blocks(batch: int, heads: int, seq: int,
+                  depth: int, dtype) -> tuple[int, int] | None:
+    """Autotune-cache consult (ops/flash_tuning.py): the (block_q,
+    block_k) a sweep or XPlane analysis recorded for this (shape, dtype,
+    platform), or None.  Never raises — a broken cache must degrade to
+    the default chain, not break the kernel."""
+    try:
+        from . import flash_tuning
+
+        return flash_tuning.lookup(
+            platform=jax.default_backend(),
+            dtype=jnp.dtype(dtype).name,
+            seq=seq, depth=depth, batch=batch, heads=heads,
+        )
+    except Exception:
+        return None
+
+
+def _resolve_blocks(batch: int, heads: int, seq: int, depth: int, dtype,
+                    block_q: int | None,
+                    block_k: int | None) -> tuple[int, int]:
+    """The kernel's block tiling, resolved: explicit argument > env
+    override > autotune cache > retuned default chain.  Callers
+    validated divisibility of explicit args; env/cache tiers self-skip
+    when they don't divide."""
+    if block_q is not None and block_k is not None:
+        return block_q, block_k
+    env_q = _env_divisible("DTFT_FLASH_BLOCK_Q", seq)
+    env_k = _env_divisible("DTFT_FLASH_BLOCK_K", seq)
+    tuned = None
+    if (block_q or env_q) is None or (block_k or env_k) is None:
+        tuned = _tuned_blocks(batch, heads, seq, depth, dtype)
+    bq = (block_q or env_q or (tuned[0] if tuned else None)
+          or _default_chain(seq, DEFAULT_BLOCK_Q))
+    bk = (block_k or env_k or (tuned[1] if tuned else None)
+          or _default_chain(seq, DEFAULT_BLOCK_K))
+    return bq, bk
 
 
 def _segment_mask(s, qseg_ref, kseg_ref):
@@ -437,19 +482,22 @@ def _wrap_kernel(inner, n_fixed_in, extra_names, **kw):
 
 
 def _flash_forward(q, k, v, mask, segment_ids, kv_segment_ids=None, *,
-                   causal, interpret, window=None):
+                   causal, interpret, window=None,
+                   block_q=None, block_k=None):
     # Mosaic needs the trailing two block dims tile-aligned or full-size:
     # run the kernel in BHSD so (seq, depth) are the trailing dims.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
 
     o, lse, _ = _flash_forward_bhsd(qt, kt, vt, mask, segment_ids,
                                     kv_segment_ids, causal=causal,
-                                    interpret=interpret, window=window)
+                                    interpret=interpret, window=window,
+                                    block_q=block_q, block_k=block_k)
     return o, lse
 
 
 def _flash_forward_bhsd(qt, kt, vt, mask, segment_ids, kv_segment_ids=None,
-                        *, causal, interpret, window=None):
+                        *, causal, interpret, window=None,
+                        block_q=None, block_k=None):
     """Forward on already-BHSD operands; returns (o BSHD, lse, o BHSD).
 
     The BHSD output is handed back so the custom VJP can save the
@@ -463,8 +511,9 @@ def _flash_forward_bhsd(qt, kt, vt, mask, segment_ids, kv_segment_ids=None,
     broadcast ever exists in HBM)."""
     batch, heads, seq, depth = qt.shape
     group = heads // kt.shape[1]
-    block_q = _pick_block_q(seq)
-    block_k = _pick_block_k(seq)
+    block_q, block_k = _resolve_blocks(
+        batch, heads, seq, depth, qt.dtype, block_q, block_k
+    )
     scale = 1.0 / (depth ** 0.5)
     grid = (batch, heads, seq // block_q, seq // block_k)
     mem = pl.ANY if interpret else pltpu.VMEM
@@ -732,7 +781,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False,
-                           window=None):
+                           window=None, block_q=None, block_k=None):
     """Backward from the custom-VJP residuals (BHSD operands + BHSD o).
 
     GQA residuals hold K/V compact (Hkv heads).  The forward shares
@@ -756,7 +805,7 @@ def _flash_backward_pallas(res, g, *, causal, interpret, force_split=False,
     dqt, dkt, dvt = _flash_backward_pallas_bhsd(
         qt, kt, vt, gt, mask, lse, delta, segment_ids=segment_ids,
         causal=causal, interpret=interpret, force_split=force_split,
-        window=window,
+        window=window, block_q=block_q, block_k=block_k,
     )
     if kv_heads != heads:
         b, _, s, d = dkt.shape
@@ -789,7 +838,7 @@ def _flash_backward_pallas_core(q, k, v, mask, g, lse, delta, *,
 def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
                                 segment_ids=None, kv_segment_ids=None,
                                 causal, interpret, force_split=False,
-                                window=None):
+                                window=None, block_q=None, block_k=None):
     """The dq/dk/dv kernels on BHSD operands; grads returned BHSD.
 
     Dispatch: the fused single-sweep kernel (one p-recompute) when the
@@ -797,8 +846,9 @@ def _flash_backward_pallas_bhsd(qt, kt, vt, gt, mask, lse, delta, *,
     or under ``force_split`` — the original dq + dkv pair.
     """
     batch, heads, seq, depth = qt.shape
-    block_q = _pick_block_q(seq)
-    block_k = _pick_block_k(seq)
+    block_q, block_k = _resolve_blocks(
+        batch, heads, seq, depth, qt.dtype, block_q, block_k
+    )
     scale = 1.0 / (depth ** 0.5)
     mem = pl.ANY if interpret else pltpu.VMEM
 
@@ -1037,16 +1087,17 @@ def _flash_backward_xla(res, g, *, causal, window=None):
 # --- Public entry with custom VJP -------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, mask, segment_ids, causal, interpret, backward_impl,
-           window):
+           window, block_q, block_k):
     o, _ = _flash_forward(q, k, v, mask, segment_ids, causal=causal,
-                          interpret=interpret, window=window)
+                          interpret=interpret, window=window,
+                          block_q=block_q, block_k=block_k)
     return o
 
 
 def _flash_fwd(q, k, v, mask, segment_ids, causal, interpret, backward_impl,
-               window):
+               window, block_q, block_k):
     # Residuals are saved in the BHSD layout the kernels consume: the
     # forward already paid for these relayouts, and saving the BSHD
     # originals instead would make the backward re-emit all four
@@ -1054,16 +1105,19 @@ def _flash_fwd(q, k, v, mask, segment_ids, causal, interpret, backward_impl,
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     o, lse, ot = _flash_forward_bhsd(qt, kt, vt, mask, segment_ids,
                                      causal=causal, interpret=interpret,
-                                     window=window)
+                                     window=window,
+                                     block_q=block_q, block_k=block_k)
     return o, (qt, kt, vt, mask, segment_ids, ot, lse)
 
 
-def _flash_bwd(causal, interpret, backward_impl, window, res, g):
+def _flash_bwd(causal, interpret, backward_impl, window, block_q, block_k,
+               res, g):
     impl = backward_impl or BACKWARD_IMPL
     if impl in ("pallas", "pallas_split"):
         dq, dk, dv = _flash_backward_pallas(
             res, g, causal=causal, interpret=interpret,
             force_split=(impl == "pallas_split"), window=window,
+            block_q=block_q, block_k=block_k,
         )
     else:
         qt, kt, vt, mask, segment_ids, ot, lse = res
@@ -1087,7 +1141,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
-                    interpret=None, backward_impl=None, window=None):
+                    interpret=None, backward_impl=None, window=None,
+                    block_q=None, block_k=None):
     """Flash attention, BSHD layout; differentiable.
 
     ``mask`` is a padding mask (B, S) or (B, 1, 1, S), True = attend.
@@ -1104,6 +1159,11 @@ def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
     entirely below the band are skipped outright, so cost scales
     O(S * window) instead of O(S^2); ``window >= seq`` degrades to plain
     causal.
+    ``block_q`` / ``block_k`` pin the kernel tiling explicitly (the sweep
+    driver ``tools/autotune_flash.py`` and A/B benches use this); left
+    None, the tiling resolves env override > autotune cache
+    (``ops/flash_tuning.py``, keyed on shape/dtype/platform) > the
+    retuned default chain.
     Raises ValueError for shapes/masks the kernel cannot handle (callers
     wanting silent fallback should go through
     ``ops.attention.dot_product_attention`` with ``implementation="auto"``).
@@ -1139,8 +1199,14 @@ def flash_attention(q, k, v, *, mask=None, segment_ids=None, causal=False,
             raise ValueError(f"window must be >= 1, got {window}")
         if window >= q.shape[1]:
             window = None  # full causal attention; skip the dead masking
+    for name, b in (("block_q", block_q), ("block_k", block_k)):
+        if b is not None and (b <= 0 or q.shape[1] % b):
+            raise ValueError(
+                f"{name}={b} must be a positive divisor of seq "
+                f"{q.shape[1]}"
+            )
     if interpret is None:
         interpret = not _on_tpu()
     pad = _as_padding_mask(mask, q.shape)
     return _flash(q, k, v, pad, segment_ids, causal, interpret,
-                  backward_impl, window)
+                  backward_impl, window, block_q, block_k)

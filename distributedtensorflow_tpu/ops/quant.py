@@ -1,0 +1,296 @@
+"""Quantized matmuls: int8 (and fp8-ready) dot_general with QAT hooks.
+
+The step-time lever the pjit LM scaling recipe (PAPERS.md 2204.06514) and
+the MLPerf TPU-pod study (1909.09756) both pull first: run the parameter
+matmuls at a narrower width than the activation dtype.  On TPU an int8
+contraction runs the MXU at ~2x the bf16 rate and halves the weight-side
+HBM stream; on CPU (this sandbox's verification backend) the same program
+is numerically exercised end to end, so quantized-vs-reference parity is
+CI-checkable without the chip.
+
+Scheme — symmetric per-channel absmax, the standard W8A8 recipe:
+
+- both operands are quantized to int8 with a per-channel scale
+  ``absmax / 127`` (lhs: per row of the contraction; rhs: per output
+  column), accumulated in **int32**, and rescaled in fp32 — one
+  ``s_row * s_col`` outer-product correction, exactly the factorization
+  the MXU path needs;
+- rounding is round-to-nearest by default; ``stochastic=True`` rounds
+  ``floor(x/s + u)`` with ``u ~ U[0, 1)`` so the quantizer is *unbiased*
+  (the accumulation-over-steps property QAT wants for weight gradients);
+- the public :func:`quantized_matmul` carries a **straight-through
+  estimator** custom VJP: the backward is the exact fp gradient of the
+  un-quantized matmul (``dx = g @ w.T``, ``dw = x.T @ g`` at full
+  precision), so training through quantized layers ("QAT-safe") follows
+  the fp loss surface while the forward pays int8 prices;
+- ``mode="fp8"`` quantizes to ``float8_e4m3fn`` with the same per-channel
+  scale machinery (absmax / 448) when the installed jax exposes the dtype
+  — the fp8-ready path; it raises a clear error otherwise instead of
+  silently degrading.
+
+Dynamic loss scaling (:class:`DynamicLossScale`) rides along for recipes
+whose narrow-width gradients underflow: multiply the loss by ``scale``,
+un-scale the grads, and :func:`loss_scale_update` grows the scale 2x every
+``growth_interval`` finite steps / halves it on overflow — the standard
+mixed-precision controller, expressed as a pure pytree so it lives inside
+the jitted step.
+
+Layer surface: :class:`~..models.layers.QuantDense` /
+``QuantDenseGeneral`` (models/layers.py) wrap this module behind the same
+parameter tree as ``nn.Dense`` / ``nn.DenseGeneral`` so checkpoints move
+freely between quantized and full-width runs; ``GPTConfig.quant`` /
+``BertConfig.quant`` / ``ViTConfig.quant`` (and ``train.py --quant``)
+switch the dense/einsum call sites per model while embeddings, layer
+norms, and the fp32 heads stay high-precision.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "QUANT_MODES",
+    "quantize",
+    "dequantize",
+    "int8_dot",
+    "quantized_matmul",
+    "DynamicLossScale",
+    "scale_loss",
+    "unscale_grads",
+    "grads_finite",
+    "loss_scale_update",
+]
+
+#: The recognized quantized-compute modes ("none" = full-width passthrough).
+QUANT_MODES = ("none", "int8", "int8_stochastic", "fp8")
+
+_INT8_MAX = 127.0
+_FP8_MAX = 448.0  # float8_e4m3fn finite max
+
+
+def _fp8_dtype():
+    dt = getattr(jnp, "float8_e4m3fn", None)
+    if dt is None:
+        raise NotImplementedError(
+            "quant mode 'fp8' needs jnp.float8_e4m3fn, which this jax "
+            "build does not expose — use 'int8' or upgrade jax"
+        )
+    return dt
+
+
+def validate_mode(mode: str | None) -> str:
+    mode = mode or "none"
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"unknown quant mode {mode!r}; expected one of {QUANT_MODES}"
+        )
+    return mode
+
+
+def _absmax_scale(x32: jax.Array, axis: int, qmax: float) -> jax.Array:
+    """Per-channel symmetric scale ``absmax / qmax`` (fp32, keepdims).
+
+    A zero channel gets scale ``1/qmax`` (any positive value works: the
+    channel quantizes to all-zeros either way and the rescale multiplies
+    zeros) — never 0, which would NaN the divide."""
+    amax = jnp.max(jnp.abs(x32), axis=axis, keepdims=True)
+    return jnp.where(amax > 0, amax, 1.0) / qmax
+
+
+def quantize(
+    x: jax.Array,
+    *,
+    axis: int = -1,
+    mode: str = "int8",
+    key: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Quantize ``x`` along ``axis`` (the contraction axis).
+
+    Returns ``(q, scale)`` with ``q`` int8 (or fp8) and ``scale`` the fp32
+    per-channel absmax scale, keepdims over ``axis`` so ``q * scale``
+    broadcasts back to ``x``'s shape.  ``mode="int8_stochastic"`` (or any
+    mode with a ``key``) rounds stochastically — unbiased:
+    ``E[q * scale] == x``.
+    """
+    mode = validate_mode(mode)
+    if mode == "none":
+        raise ValueError("quantize called with mode='none'")
+    x32 = x.astype(jnp.float32)
+    if mode == "fp8":
+        scale = _absmax_scale(x32, axis, _FP8_MAX)
+        return (x32 / scale).astype(_fp8_dtype()), scale
+    scale = _absmax_scale(x32, axis, _INT8_MAX)
+    y = x32 / scale
+    if mode == "int8_stochastic" or key is not None:
+        if key is None:
+            raise ValueError("stochastic rounding needs a PRNG key")
+        y = jnp.floor(y + jax.random.uniform(key, y.shape, jnp.float32))
+    else:
+        y = jnp.round(y)
+    q = jnp.clip(y, -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * scale
+
+
+def int8_dot(
+    x: jax.Array,
+    w: jax.Array,
+    *,
+    mode: str = "int8",
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """``x @ w`` through the quantized path, fp32 result.
+
+    ``x`` is ``(..., K)``; ``w`` is ``(K, N)``.  lhs rows and rhs columns
+    each get their own absmax scale; the contraction accumulates in int32
+    (fp32 for fp8 operands) and the two scale vectors rescale the
+    accumulator — the only fp work outside the quantizers.
+    """
+    mode = validate_mode(mode)
+    kx = kw = None
+    if mode == "int8_stochastic":
+        if key is None:
+            raise ValueError("mode 'int8_stochastic' needs a PRNG key")
+        kx, kw = jax.random.split(key)
+    xq, sx = quantize(x, axis=-1, mode=mode, key=kx)   # (..., K), (..., 1)
+    wq, sw = quantize(w, axis=0, mode=mode, key=kw)    # (K, N),  (1, N)
+    acc_t = jnp.float32 if mode == "fp8" else jnp.int32
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=acc_t,
+    )
+    return acc.astype(jnp.float32) * sx * jnp.squeeze(sw, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _qmatmul(x, w, key, mode):
+    return int8_dot(x, w, mode=mode, key=key).astype(x.dtype)
+
+
+def _qmatmul_fwd(x, w, key, mode):
+    return _qmatmul(x, w, key, mode), (x, w, np.shape(key))
+
+
+def _qmatmul_bwd(mode, res, g):
+    # Straight-through estimator: the exact gradient of the UN-quantized
+    # matmul, computed at full precision from the saved fp operands — the
+    # QAT contract (forward pays int8, backward follows the fp surface).
+    x, w, key_shape = res
+    g32 = g.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    dx = jax.lax.dot_general(
+        g32, w32, (((g.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    x2 = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    g2 = g32.reshape(-1, g.shape[-1])
+    dw = jax.lax.dot_general(
+        x2, g2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(w.dtype)
+    dkey = np.zeros(key_shape, jax.dtypes.float0)  # PRNG keys carry no grad
+    return dx, dw, dkey
+
+
+_qmatmul.defvjp(_qmatmul_fwd, _qmatmul_bwd)
+
+
+def quantized_matmul(
+    x: jax.Array,
+    w: jax.Array,
+    *,
+    mode: str = "int8",
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Differentiable quantized ``x @ w`` (straight-through estimator).
+
+    ``x``: ``(..., K)`` activations; ``w``: ``(K, N)`` weights; output
+    ``(..., N)`` in ``x.dtype``.  ``mode`` is one of :data:`QUANT_MODES`
+    (``"none"`` falls through to the plain matmul — one call site, no
+    branching at the layer); ``"int8_stochastic"`` requires ``key``.
+    """
+    mode = validate_mode(mode)
+    if mode == "none":
+        return jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        )
+    if mode == "fp8":
+        _fp8_dtype()  # fail loudly before tracing the custom_vjp
+    if mode == "int8_stochastic" and key is None:
+        raise ValueError("mode 'int8_stochastic' needs a PRNG key")
+    if key is None:
+        # a concrete dummy so the custom_vjp signature stays uniform; the
+        # deterministic path never folds it in
+        key = jax.random.PRNGKey(0)
+    return _qmatmul(x, w, key, mode)
+
+
+# --- dynamic loss scaling (the mixed-precision controller) -------------------
+
+
+class DynamicLossScale(NamedTuple):
+    """Pure-pytree loss-scale state; lives inside the jitted step.
+
+    ``scale`` multiplies the loss (and divides the grads back);
+    ``good_steps`` counts consecutive finite-gradient steps since the last
+    change.  Defaults follow the classic AMP recipe: start at 2^15, double
+    every 2000 clean steps, halve on overflow, never below 1.
+    """
+
+    scale: jax.Array
+    good_steps: jax.Array
+
+    @classmethod
+    def init(cls, initial: float = 2.0 ** 15) -> "DynamicLossScale":
+        return cls(
+            scale=jnp.asarray(initial, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+        )
+
+
+def scale_loss(loss: jax.Array, state: DynamicLossScale) -> jax.Array:
+    return loss * state.scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, state: DynamicLossScale):
+    inv = (1.0 / state.scale).astype(jnp.float32)
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+
+
+def grads_finite(grads) -> jax.Array:
+    """Scalar bool: every leaf of ``grads`` is entirely finite."""
+    leaves = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+    if not leaves:
+        return jnp.asarray(True)
+    return functools.reduce(jnp.logical_and, leaves)
+
+
+def loss_scale_update(
+    state: DynamicLossScale,
+    finite: jax.Array,
+    *,
+    growth_interval: int = 2000,
+    factor: float = 2.0,
+    min_scale: float = 1.0,
+) -> DynamicLossScale:
+    """Next controller state: grow after ``growth_interval`` consecutive
+    finite steps, shrink immediately on a non-finite one (that step's
+    update should be skipped by the caller — the grads are garbage)."""
+    good = jnp.where(finite, state.good_steps + 1, 0)
+    grow = finite & (good >= growth_interval)
+    scale = jnp.where(
+        grow, state.scale * factor,
+        jnp.where(finite, state.scale,
+                  jnp.maximum(state.scale / factor, min_scale)),
+    )
+    return DynamicLossScale(
+        scale=scale, good_steps=jnp.where(grow, 0, good)
+    )

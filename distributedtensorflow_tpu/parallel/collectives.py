@@ -47,7 +47,8 @@ _M_DISPATCH_S = obs.histogram(
 )
 
 
-def _timed_dispatch(fn=None, *, op: str | None = None):
+def _timed_dispatch(fn=None, *, op: str | None = None,
+                    overlapped: bool | None = None):
     """Route a collective wrapper's host-side time through the span tracer
     (``collective_<op>`` spans — children of the enclosing compile/step
     span when traced under jit) and the dispatch histogram.
@@ -56,6 +57,13 @@ def _timed_dispatch(fn=None, *, op: str | None = None):
     the GSPMD constraint wrappers below use it so a reduce-scatter
     expressed as a sharding constraint lands under the same
     ``op=reduce_scatter`` label as the shard_map primitive.
+
+    ``overlapped`` (non-None) adds an ``overlapped="0"|"1"`` label: the
+    backward-pass bucketed gradient sync (``parallel/overlap.py``)
+    dispatches through its own wrappers so the PR-4 timeline and the
+    metric stream can tell an overlap-issued collective from the
+    step-end one.  Wrappers without the flag keep their historical
+    un-labeled series (field names in existing artifacts don't move).
 
     While a reactive-profiler window is open (``obs.capture``), the
     region is additionally labeled with a ``jax.profiler``
@@ -67,6 +75,9 @@ def _timed_dispatch(fn=None, *, op: str | None = None):
     def decorate(f):
         label = op or f.__name__
         name = f"collective_{label}"
+        hist_labels = {"op": label}
+        if overlapped is not None:
+            hist_labels["overlapped"] = "1" if overlapped else "0"
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
@@ -77,7 +88,7 @@ def _timed_dispatch(fn=None, *, op: str | None = None):
                         out = f(*args, **kwargs)
                 else:
                     out = f(*args, **kwargs)
-            _M_DISPATCH_S.observe(time.perf_counter() - t0, op=label)
+            _M_DISPATCH_S.observe(time.perf_counter() - t0, **hist_labels)
             return out
 
         return wrapper
@@ -229,6 +240,32 @@ def gspmd_all_gather(tree: PyTree, shardings) -> PyTree:
     GSPMD-jitted program — XLA lowers the constraint to an all-gather
     (the ZeRO post-update parameter re-assembly).  Timed under
     ``op=all_gather``."""
+    return _constrain_tree(tree, shardings)
+
+
+@_timed_dispatch(op="all_reduce", overlapped=True)
+def gspmd_overlap_all_reduce(tree: PyTree, shardings) -> PyTree:
+    """Backward-pass bucketed gradient sync, data-parallel flavor: pin a
+    gradient bucket to its bound parameter layout the moment the backward
+    produces it, so XLA schedules the cross-replica sum (an all-reduce
+    under pure DP; a reduce over the batch axes only, under TP layouts)
+    DURING the remaining backward matmuls instead of after them
+    (``parallel/overlap.py``).  Numerically an identity — it is a layout
+    constraint on an already-global value.  Timed under
+    ``op=all_reduce, overlapped=1``."""
+    return _constrain_tree(tree, shardings)
+
+
+@_timed_dispatch(op="reduce_scatter", overlapped=True)
+def gspmd_overlap_reduce_scatter(tree: PyTree, shardings) -> PyTree:
+    """Backward-pass bucketed gradient sync, ZeRO flavor: constrain a
+    bucket's chunked ``(degree, chunk)`` gradient views to the dim-0
+    batch-axes sharding inside the backward, so the reduce-scatter the
+    weight-update sharding needs anyway is issued per layer group as the
+    grads appear (``parallel/overlap.py``; composes with
+    ``parallel/zero.py`` — the update-time constraint then finds the
+    layout already satisfied).  Timed under
+    ``op=reduce_scatter, overlapped=1``."""
     return _constrain_tree(tree, shardings)
 
 

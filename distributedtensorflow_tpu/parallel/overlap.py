@@ -1,0 +1,273 @@
+"""Collective–matmul overlap: bucketed backward-pass gradient sync.
+
+Under GSPMD data parallelism the gradient all-reduce is implicit — XLA
+materializes the cross-replica sum wherever the consuming op (the
+optimizer update) forces it, which in practice parks the whole gradient
+sync AFTER the backward pass: the ICI sits idle through the backward
+matmuls and the MXU sits idle through the sync.  The classic fix (the
+pjit LM scaling recipe, PAPERS.md 2204.06514; DDP gradient bucketing) is
+to issue the collective for each layer group **as soon as its gradient
+is produced**, so communication hides under the remaining backward
+compute.
+
+Mechanism — no scheduler, no side effects, exact numerics: every
+parameter bucket is passed through a ``jax.custom_vjp`` **identity tag**
+whose backward applies a GSPMD sharding constraint to the bucket's
+cotangents (``collectives.gspmd_overlap_all_reduce`` /
+``gspmd_overlap_reduce_scatter``).  The constraint pins the gradient
+value's layout at that exact point of the backward graph, which forces
+XLA to schedule the cross-replica reduction there — adjacent to the
+producing matmuls, overlappable with everything still to run — instead
+of deferring it to the update.  Because a sharding constraint is
+numerically the identity, the bucketed step is bit-equivalent to the
+unbucketed one (pinned by ``tests/test_overlap.py`` on an 8-device CPU
+mesh, including composed with ``--zero``).
+
+Buckets are **per-layer groups**: leaves grouped by their top-level
+module path (``h0`` … ``h11``, ``wte``, …), with adjacent small groups
+greedily merged up to ``bucket_bytes`` so tiny layers don't each pay a
+collective launch.  One tag per bucket; tags are created once at plan
+build so the jitted step's Python identities are stable across restarts
+(the supervisor re-traces against the same plan).
+
+Composition:
+
+- **ZeRO** (``parallel/zero.py``): the backward hook chunks each
+  gradient to the sharder's ``(degree, chunk)`` view and constrains it
+  to the dim-0 batch-axes sharding — the reduce-scatter the weight
+  update needs anyway, just issued early; ``ZeroSharder.apply_gradients``
+  then finds the layout already satisfied.
+- **Tensor parallelism**: the DP-flavor constraint targets each leaf's
+  BOUND parameter spec, so model-axis-sharded gradients keep their
+  layout and only the batch-axes reduction is forced early.
+- **Gradient accumulation**: the tag fires once per microbatch, so
+  ``accum_steps > 1`` trades ``accum_steps``× the collective volume for
+  the overlap — worth it on DCN-free single-pod meshes, documented as
+  the caveat it is (docs/API.md).
+
+Telemetry: the bucket dispatches land in the span tracer and in
+``collective_dispatch_seconds{op=..., overlapped="1"}``, so the PR-4
+timeline and run_report's step-time section show the overlapped share;
+the Trainer stamps ``overlap_buckets`` / ``overlap_coverage`` into every
+metric record.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import collectives
+from . import zero as zero_lib
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+PyTree = Any
+
+__all__ = ["OverlapPlan", "plan_buckets"]
+
+
+def _leaf_bytes(leaf) -> int:
+    size = math.prod(leaf.shape) if leaf.shape else 1
+    itemsize = getattr(leaf.dtype, "itemsize", None)
+    if itemsize is None:
+        itemsize = jax.numpy.dtype(leaf.dtype).itemsize
+    return size * itemsize
+
+
+def _group_key(path) -> str:
+    """The per-layer-group key of one leaf path: its first path
+    component (``h3/attn/qkv/kernel`` → ``h3``).  flax param trees put
+    the block name first, so this is exactly "one bucket per transformer
+    block" before merging."""
+    if not path:
+        return "<root>"
+    p = path[0]
+    key = getattr(p, "key", None)
+    if key is None:
+        key = getattr(p, "name", None)
+    if key is None:
+        key = getattr(p, "idx", p)
+    return str(key)
+
+
+def plan_buckets(
+    param_shapes: PyTree, bucket_bytes: int
+) -> list[list[int]]:
+    """Group flattened-leaf indices into per-layer-group buckets.
+
+    Leaves sharing a top-level module are never split; adjacent groups
+    (in flatten order) merge greedily while the running size stays under
+    ``bucket_bytes``.  Every leaf lands in exactly one bucket — coverage
+    is total by construction."""
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(param_shapes)[0]
+    groups: list[tuple[str, list[int], int]] = []
+    for i, (path, leaf) in enumerate(leaves_with_path):
+        key = _group_key(path)
+        nbytes = _leaf_bytes(leaf)
+        if groups and groups[-1][0] == key:
+            groups[-1][1].append(i)
+            groups[-1] = (key, groups[-1][1], groups[-1][2] + nbytes)
+        else:
+            groups.append((key, [i], nbytes))
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for _key, idxs, nbytes in groups:
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.extend(idxs)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+class OverlapPlan:
+    """The compiled-in bucketing policy for one (mesh, model) pair.
+
+    Build once per run with :meth:`build` and hand to
+    ``train.make_train_step(..., overlap=plan)`` — the engine wraps the
+    loss function so parameters flow through the bucket tags and every
+    bucket's gradient sync is issued inside the backward pass.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        buckets: Sequence[Sequence[int]],
+        leaf_shardings: Sequence[NamedSharding],
+        treedef,
+        *,
+        zero: "zero_lib.ZeroSharder | None" = None,
+    ):
+        self.mesh = mesh
+        self.buckets = [list(b) for b in buckets]
+        self.zero = zero
+        self._leaf_shardings = list(leaf_shardings)
+        self._treedef = treedef
+        self._n_leaves = len(leaf_shardings)
+        covered = sorted(i for b in self.buckets for i in b)
+        if covered != list(range(self._n_leaves)):
+            raise ValueError(
+                f"buckets cover {len(covered)} leaf slots of "
+                f"{self._n_leaves} (or cover one twice)"
+            )
+        #: Fraction of parameter BYTES whose gradient sync the plan
+        #: issues in-backward.  1.0 by construction today; kept as data
+        #: (not a constant) so a future skip-list shows up in telemetry.
+        self.coverage = 1.0
+        self._tags = [
+            self._make_tag(list(bucket)) for bucket in self.buckets
+        ]
+
+    # --- construction -------------------------------------------------------
+
+    @classmethod
+    def build(
+        cls,
+        mesh: Mesh,
+        param_shapes: PyTree,
+        param_specs: PyTree,
+        *,
+        zero: "zero_lib.ZeroSharder | None" = None,
+        bucket_bytes: int = 4 << 20,
+    ) -> "OverlapPlan":
+        """Plan buckets for a model.
+
+        ``param_shapes``: abstract params (``jax.eval_shape`` of the
+        init); ``param_specs``: their bound PartitionSpecs (the tree
+        ``create_sharded_state`` returns) — the layout the DP-flavor
+        constraint pins each gradient to.  ``zero`` switches the hook to
+        the chunked reduce-scatter flavor at that sharder's degree.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten(param_shapes)
+        spec_leaves = jax.tree_util.tree_flatten(
+            param_specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+        if len(spec_leaves) != len(leaves):
+            raise ValueError(
+                f"param_specs has {len(spec_leaves)} leaves, params have "
+                f"{len(leaves)}"
+            )
+        shardings = [
+            s if isinstance(s, NamedSharding) else NamedSharding(mesh, s)
+            for s in spec_leaves
+        ]
+        buckets = plan_buckets(param_shapes, bucket_bytes)
+        return cls(mesh, buckets, shardings, treedef, zero=zero)
+
+    # --- the backward hook --------------------------------------------------
+
+    def _sync_bucket(self, idxs: list[int], grads: list):
+        """Issue one bucket's gradient sync (runs at TRACE time, inside
+        the backward of the jitted step)."""
+        if self.zero is not None:
+            degree = self.zero.degree
+            cshard = self.zero.chunk_sharding()
+            chunked = [zero_lib.chunk_array(g, degree) for g in grads]
+            chunked = collectives.gspmd_overlap_reduce_scatter(
+                chunked, cshard
+            )
+            return [
+                zero_lib.unchunk_array(c, g.shape)
+                for c, g in zip(chunked, grads)
+            ]
+        shardings = [self._leaf_shardings[i] for i in idxs]
+        return collectives.gspmd_overlap_all_reduce(grads, shardings)
+
+    def _make_tag(self, idxs: list[int]) -> Callable:
+        plan = self
+
+        @jax.custom_vjp
+        def tag(xs):
+            return xs
+
+        def fwd(xs):
+            return xs, None
+
+        def bwd(_, gs):
+            return (plan._sync_bucket(idxs, list(gs)),)
+
+        tag.defvjp(fwd, bwd)
+        return tag
+
+    # --- wiring -------------------------------------------------------------
+
+    def tag_params(self, params: PyTree) -> PyTree:
+        """Route every bucket of ``params`` through its identity tag; the
+        forward is free (XLA elides it), the backward issues the sync."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        if len(leaves) != self._n_leaves:
+            raise ValueError(
+                f"params have {len(leaves)} leaves; the plan was built "
+                f"for {self._n_leaves} — rebuild the OverlapPlan for "
+                "this model"
+            )
+        out = list(leaves)
+        for tag, idxs in zip(self._tags, self.buckets):
+            tagged = tag([leaves[i] for i in idxs])
+            for i, t in zip(idxs, tagged):
+                out[i] = t
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def wrap_loss_fn(self, loss_fn: Callable) -> Callable:
+        """The engine hook: same LossFn contract, parameters tagged."""
+
+        def wrapped(params, model_state, batch, rng):
+            return loss_fn(self.tag_params(params), model_state, batch, rng)
+
+        return wrapped
+
+    def describe(self) -> dict:
+        return {
+            "buckets": len(self.buckets),
+            "coverage": self.coverage,
+            "mode": "reduce_scatter" if self.zero is not None
+            else "all_reduce",
+        }

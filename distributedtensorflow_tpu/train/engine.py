@@ -212,6 +212,7 @@ def make_train_step(
     *,
     accum_steps: int = 1,
     donate: bool = True,
+    overlap=None,
 ) -> Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, dict[str, jax.Array]]]:
     """Compile the full train step over ``mesh``.
 
@@ -219,11 +220,16 @@ def make_train_step(
     metrics)``.  ``batch`` leaves must have a leading global-batch dimension;
     it is sharded over the batch axes.  ``state`` is donated: parameters are
     updated in place in HBM (no double-buffering of the model).
+
+    ``overlap`` (a :class:`~..parallel.overlap.OverlapPlan`) routes the
+    parameters through per-layer-group backward tags so each bucket's
+    gradient collective is issued inside the backward pass (collective–
+    matmul overlap) instead of after it; numerically identity.
     """
     batch_sharding = NamedSharding(mesh, shardlib.batch_spec(mesh))
     state_shardings = shardlib.named_shardings(mesh, state_specs)
     repl = NamedSharding(mesh, P())
-    step = _step_body(loss_fn, accum_steps)
+    step = _step_body(loss_fn, accum_steps, overlap)
 
     return _InstrumentedStep(
         jax.jit(
@@ -236,14 +242,18 @@ def make_train_step(
     )
 
 
-def _step_body(loss_fn: LossFn, accum_steps: int):
+def _step_body(loss_fn: LossFn, accum_steps: int, overlap=None):
     """The one train-step function both engines compile.
 
     Folds the step counter into the rng (dropout etc. differs per step
     without threading a new key from the host), accumulates gradients over
     microbatches, applies the update.  Shared so the single-step and
     multi-step (scanned) engines can never drift apart semantically.
+    ``overlap`` wraps the loss so parameter cotangents flow through the
+    plan's bucket tags (see :func:`make_train_step`).
     """
+    if overlap is not None:
+        loss_fn = overlap.wrap_loss_fn(loss_fn)
 
     def step(state: TrainState, batch: PyTree, rng: jax.Array):
         r = jax.random.fold_in(rng, state.step)
@@ -266,6 +276,7 @@ def make_multi_train_step(
     steps_per_call: int,
     accum_steps: int = 1,
     donate: bool = True,
+    overlap=None,
 ) -> Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, dict[str, jax.Array]]]:
     """Compile ``steps_per_call`` optimizer steps into ONE dispatch.
 
@@ -288,7 +299,7 @@ def make_multi_train_step(
     if steps_per_call <= 1:
         return make_train_step(
             loss_fn, mesh, state_specs, accum_steps=accum_steps,
-            donate=donate,
+            donate=donate, overlap=overlap,
         )
     batch_sharding = NamedSharding(
         mesh, shardlib.batch_spec(mesh, leading_unsharded=1)
@@ -296,7 +307,7 @@ def make_multi_train_step(
     state_shardings = shardlib.named_shardings(mesh, state_specs)
     repl = NamedSharding(mesh, P())
 
-    one_step = _step_body(loss_fn, accum_steps)
+    one_step = _step_body(loss_fn, accum_steps, overlap)
 
     def multi_step(state: TrainState, batches: PyTree, rng: jax.Array):
         def body(s, b):

@@ -83,6 +83,19 @@ class TrainerConfig:
     # /statusz so run_report can attribute the optimizer-state-bytes
     # numbers to the mode that produced them.
     zero_stage: int = 0
+    # Quantized compute (ops/quant.py): informational — the mode is
+    # compiled into the model at workload-build time.  Anything but
+    # "none" stamps ``quant_mode`` into every metric record (a string
+    # field; check_metrics_schema knows the set) so run_report's
+    # step-time section can attribute throughput to the mode.
+    quant: str = "none"
+    # Collective-matmul overlap (parallel/overlap.py): informational —
+    # the bucketed backward-pass gradient sync is compiled into the step.
+    # buckets > 0 stamps ``overlap_buckets`` / ``overlap_coverage``
+    # (fraction of parameter bytes whose gradient sync is issued inside
+    # the backward) into every metric record.
+    overlap_buckets: int = 0
+    overlap_coverage: float = 0.0
     # Hang watchdog (SURVEY.md §5.2): dump all thread stacks if no step
     # completes for this many seconds.  0 disables.
     watchdog_timeout: float = 0.0
@@ -675,6 +688,15 @@ class Trainer:
                                            f"median: {summary}",
                                 )
                     last_metrics.update(obs.default_registry().scalars())
+                    if cfg.quant and cfg.quant != "none":
+                        last_metrics["quant_mode"] = cfg.quant
+                    if cfg.overlap_buckets:
+                        last_metrics["overlap_buckets"] = float(
+                            cfg.overlap_buckets
+                        )
+                        last_metrics["overlap_coverage"] = float(
+                            cfg.overlap_coverage
+                        )
                     if self.anomaly_detector is not None:
                         self.anomaly_detector.observe(
                             step_i + 1,
@@ -838,6 +860,10 @@ class Trainer:
         }
         if self.config.zero_stage:
             out["run"]["zero_stage"] = self.config.zero_stage
+        if self.config.quant and self.config.quant != "none":
+            out["run"]["quant"] = self.config.quant
+        if self.config.overlap_buckets:
+            out["run"]["overlap_buckets"] = self.config.overlap_buckets
         core = {
             k: rec[k] for k in (
                 "loss", "accuracy", "steps_per_sec",
@@ -999,4 +1025,7 @@ def weighted_evaluate(
 
 
 def _fmt(metrics: dict) -> str:
-    return " ".join(f"{k}={v:.4g}" for k, v in metrics.items())
+    return " ".join(
+        f"{k}={v}" if isinstance(v, str) else f"{k}={v:.4g}"
+        for k, v in metrics.items()
+    )

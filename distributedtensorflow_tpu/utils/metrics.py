@@ -69,15 +69,20 @@ class MetricWriter:
     def write(self, step: int, scalars: Mapping[str, Any]) -> None:
         if not self._chief or self._closed:
             return
+        # Strings pass through to the jsonl record (mode stamps like
+        # ``quant_mode``); everything else is coerced to float.  TB only
+        # understands scalars, so string fields skip that sink.
         scalars = {
-            k: float(v) for k, v in scalars.items() if v is not None
+            k: (v if isinstance(v, str) else float(v))
+            for k, v in scalars.items() if v is not None
         }
         if self._tb is not None:
             import tensorflow as tf  # noqa: PLC0415
 
             with self._tb.as_default(step=step):
                 for k, v in scalars.items():
-                    tf.summary.scalar(k, v)
+                    if not isinstance(v, str):
+                        tf.summary.scalar(k, v)
             self._tb.flush()
         if self._jsonl is not None:
             self._jsonl.write(

@@ -179,7 +179,7 @@ def synthetic_recsys(ctx: InputContext, cfg: WideDeepConfig, seed: int = 0):
 
 
 def _apply_gpt_overrides(cfg, *, seq, remat, attn_impl, xent_impl,
-                         kv_heads, attn_window):
+                         kv_heads, attn_window, quant=None):
     """CLI/bench knob overrides shared by the gpt and gpt_moe families.
 
     ONE definition so a new knob cannot be wired into one preset family
@@ -188,7 +188,7 @@ def _apply_gpt_overrides(cfg, *, seq, remat, attn_impl, xent_impl,
     blocks; "attn" = attention-only."""
     if (remat is None and attn_impl is None and xent_impl is None
             and kv_heads is None and attn_window is None
-            and seq <= cfg.max_seq):
+            and quant is None and seq <= cfg.max_seq):
         return cfg
     return dataclasses.replace(
         cfg,
@@ -200,6 +200,7 @@ def _apply_gpt_overrides(cfg, *, seq, remat, attn_impl, xent_impl,
                       else cfg.num_kv_heads),
         attn_window=(attn_window if attn_window is not None
                      else cfg.attn_window),
+        quant=quant if quant is not None else cfg.quant,
         max_seq=max(cfg.max_seq, seq),
     )
 
@@ -214,7 +215,8 @@ def get_workload(name: str, *, test_size: bool = False,
                  attn_impl: str | None = None,
                  xent_impl: str | None = None,
                  kv_heads: int | None = None,
-                 attn_window: int | None = None) -> Workload:
+                 attn_window: int | None = None,
+                 quant: str | None = None) -> Workload:
     """Build a preset by name.  ``test_size`` shrinks models for CI.
 
     ``sp_scheme`` picks the sequence-parallel attention used by ``gpt_lm``
@@ -229,8 +231,24 @@ def get_workload(name: str, *, test_size: bool = False,
     gpt family (num_kv_heads; see models.gpt.GPTConfig).  ``seq_len`` / ``remat``
     override the LM presets' sequence length and rematerialization (remat
     trades ~1/3 extra FLOPs for activation memory; benches turn it off when
-    the batch fits).
+    the batch fits).  ``quant`` ("int8" / "int8_stochastic" / "fp8",
+    ops/quant.py) routes the transformer presets' block matmuls through the
+    quantized dot; conv/recsys presets have no quantizable dense trunk and
+    reject it rather than silently training full-width.
     """
+    if quant and quant not in (None, "none"):
+        # The MoE presets are excluded on purpose: their expert MLPs (the
+        # dominant matmul FLOPs) are raw-einsum weights outside the
+        # dense() switch, so accepting quant= would stamp quant_mode on a
+        # mostly-full-width run — the mislabeling this check exists to
+        # prevent.
+        quantizable = ("gpt_lm", "gpt_medium_lm", "lm_long_context",
+                       "bert_mlm", "bert_mlm_packed", "imagenet_vit")
+        if name not in quantizable:
+            raise ValueError(
+                f"workload {name!r} has no quantized-compute path; "
+                f"quant={quant!r} is supported for: {', '.join(quantizable)}"
+            )
     if name == "mnist_lenet":
         model = LeNet5()
         gbs = global_batch_size or 128
@@ -281,6 +299,8 @@ def get_workload(name: str, *, test_size: bool = False,
         from .models import ViT, vit_layout, vit_s16, vit_tiny
 
         cfg = vit_tiny() if test_size else vit_s16()
+        if quant:
+            cfg = dataclasses.replace(cfg, quant=quant)
         model = ViT(cfg)
         gbs = global_batch_size or 1024
         size = (cfg.image_size, cfg.image_size, 3)
@@ -314,6 +334,8 @@ def get_workload(name: str, *, test_size: bool = False,
             # grow the position table with the override (same contract as
             # the gpt presets' max_seq growth)
             cfg = dataclasses.replace(cfg, max_position=seq)
+        if quant:
+            cfg = dataclasses.replace(cfg, quant=quant)
         model = BertForMLM(cfg)
         if packed:
             input_fn = lambda ctx, seed: synthetic_packed_mlm(
@@ -401,6 +423,7 @@ def get_workload(name: str, *, test_size: bool = False,
         cfg = _apply_gpt_overrides(
             cfg, seq=seq, remat=remat, attn_impl=attn_impl,
             xent_impl=xent_impl, kv_heads=kv_heads, attn_window=attn_window,
+            quant=quant,
         )
         gbs = global_batch_size or (8 if test_size else 64)
 
@@ -491,6 +514,8 @@ def get_workload(name: str, *, test_size: bool = False,
         seq = seq_len or (128 if test_size else 512)
         if seq > cfg.max_position:
             cfg = dataclasses.replace(cfg, max_position=seq)
+        if quant:
+            cfg = dataclasses.replace(cfg, quant=quant)
         model = BertMoEForMLM(cfg)  # local experts until for_mesh
         max_p = max_predictions_for(seq)
 
@@ -541,6 +566,7 @@ def get_workload(name: str, *, test_size: bool = False,
         cfg = _apply_gpt_overrides(
             cfg, seq=seq, remat=remat, attn_impl=attn_impl,
             xent_impl=xent_impl, kv_heads=kv_heads, attn_window=attn_window,
+            quant=quant,
         )
         gbs = global_batch_size or (8 if test_size else 64)
         model = GPTMoELM(cfg)  # local (replicated) experts until for_mesh

@@ -1,0 +1,238 @@
+"""ops/quant.py: int8/fp8 matmul numerics, STE gradients, loss scaling,
+and the QuantDense layer surface (PR 8 tentpole)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.ops import quant
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+class TestQuantize:
+    def test_per_channel_scale_is_absmax_over_contraction(self):
+        x = _rand((8, 64))
+        q, scale = quant.quantize(x, axis=-1, mode="int8")
+        amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        np.testing.assert_allclose(scale, amax / 127.0, rtol=1e-6)
+        assert q.dtype == jnp.int8
+        # every channel's absmax element hits +-127 exactly
+        assert int(jnp.max(jnp.abs(q))) == 127
+
+    def test_roundtrip_error_bounded_by_half_scale(self):
+        x = _rand((4, 128), seed=3)
+        q, scale = quant.quantize(x, axis=-1, mode="int8")
+        err = jnp.abs(quant.dequantize(q, scale) - x)
+        assert float(jnp.max(err - 0.5 * scale)) <= 1e-6
+
+    def test_rhs_axis0_scale(self):
+        w = _rand((64, 32), seed=1)
+        q, scale = quant.quantize(w, axis=0, mode="int8")
+        assert scale.shape == (1, 32)
+
+    def test_zero_channel_does_not_nan(self):
+        x = jnp.zeros((2, 16))
+        q, scale = quant.quantize(x, axis=-1, mode="int8")
+        assert not bool(jnp.any(jnp.isnan(quant.dequantize(q, scale))))
+
+    def test_stochastic_rounding_is_unbiased(self):
+        # a constant exactly halfway between two int levels: RTN would
+        # bias every element the same way; stochastic must average out
+        x = jnp.full((200_000,), 38.1, jnp.float32)
+        q, s = quant.quantize(x, axis=-1, mode="int8_stochastic",
+                              key=jax.random.PRNGKey(7))
+        mean = float(jnp.mean(quant.dequantize(q, s)))
+        assert abs(mean - 38.1) < 0.05
+
+    def test_stochastic_requires_key(self):
+        with pytest.raises(ValueError, match="PRNG key"):
+            quant.quantize(_rand((2, 8)), axis=-1, mode="int8_stochastic")
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown quant mode"):
+            quant.validate_mode("int4")
+
+
+class TestQuantizedMatmul:
+    def test_int8_close_to_fp32_reference(self):
+        x = _rand((8, 256), seed=0)
+        w = _rand((256, 64), seed=1)
+        ref = x @ w
+        out = quant.quantized_matmul(x, w, mode="int8")
+        rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < 0.02, rel
+
+    def test_batched_lhs(self):
+        x = _rand((2, 5, 32), seed=2)
+        w = _rand((32, 16), seed=3)
+        out = quant.quantized_matmul(x, w, mode="int8")
+        assert out.shape == (2, 5, 16)
+        ref = jnp.einsum("bsk,kn->bsn", x, w)
+        # absolute error scales with sqrt(K)·(row scale)·(col scale); at
+        # K=32 the worst element sits around 0.16
+        assert float(jnp.max(jnp.abs(out - ref))) < 0.25
+
+    def test_mode_none_is_exact(self):
+        x, w = _rand((4, 32)), _rand((32, 8), seed=1)
+        np.testing.assert_allclose(
+            quant.quantized_matmul(x, w, mode="none"), x @ w, rtol=1e-6
+        )
+
+    def test_ste_gradients_match_fp_matmul(self):
+        # The straight-through contract: grads are EXACTLY the fp
+        # matmul's (computed from the saved full-precision operands).
+        x = _rand((4, 64), seed=4)
+        w = _rand((64, 16), seed=5)
+        g = _rand((4, 16), seed=6)
+
+        def fq(x, w):
+            return jnp.sum(quant.quantized_matmul(x, w, mode="int8") * g)
+
+        def fp(x, w):
+            return jnp.sum((x @ w) * g)
+
+        qx, qw = jax.grad(fq, argnums=(0, 1))(x, w)
+        px, pw = jax.grad(fp, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(qx, px, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(qw, pw, rtol=1e-5, atol=1e-6)
+
+    def test_grad_under_jit_and_dtype_preserved(self):
+        x = _rand((4, 32), jnp.bfloat16)
+        w = _rand((32, 8), jnp.bfloat16, seed=1)
+        out = jax.jit(
+            lambda x, w: quant.quantized_matmul(x, w, mode="int8")
+        )(x, w)
+        assert out.dtype == jnp.bfloat16
+        gx = jax.jit(jax.grad(
+            lambda x, w: jnp.sum(
+                quant.quantized_matmul(x, w, mode="int8").astype(jnp.float32)
+            )
+        ))(x, w)
+        assert gx.dtype == jnp.bfloat16
+
+    def test_fp8_mode(self):
+        if not hasattr(jnp, "float8_e4m3fn"):
+            pytest.skip("no fp8 dtype in this jax")
+        x, w = _rand((8, 64)), _rand((64, 32), seed=1)
+        ref = x @ w
+        out = quant.quantized_matmul(x, w, mode="fp8")
+        rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < 0.05, rel
+
+
+class TestDynamicLossScale:
+    def test_overflow_halves_and_resets(self):
+        st = quant.DynamicLossScale.init(1024.0)
+        st = quant.loss_scale_update(st, jnp.asarray(False))
+        assert float(st.scale) == 512.0
+        assert int(st.good_steps) == 0
+
+    def test_growth_after_interval(self):
+        st = quant.DynamicLossScale.init(8.0)
+        for _ in range(3):
+            st = quant.loss_scale_update(st, jnp.asarray(True),
+                                         growth_interval=3)
+        assert float(st.scale) == 16.0
+        assert int(st.good_steps) == 0
+
+    def test_min_scale_clamp(self):
+        st = quant.DynamicLossScale.init(1.0)
+        st = quant.loss_scale_update(st, jnp.asarray(False))
+        assert float(st.scale) == 1.0
+
+    def test_scale_unscale_roundtrip_and_finiteness(self):
+        st = quant.DynamicLossScale.init(64.0)
+        loss = jnp.asarray(2.0)
+        assert float(quant.scale_loss(loss, st)) == 128.0
+        grads = {"a": jnp.asarray([64.0, 128.0])}
+        un = quant.unscale_grads(grads, st)
+        np.testing.assert_allclose(un["a"], [1.0, 2.0])
+        assert bool(quant.grads_finite(grads))
+        assert not bool(quant.grads_finite(
+            {"a": jnp.asarray([1.0, jnp.nan])}
+        ))
+
+
+class TestQuantDense:
+    def test_param_tree_matches_nn_dense(self):
+        import flax.linen as nn
+
+        from distributedtensorflow_tpu.models.layers import dense
+
+        x = _rand((2, 16))
+        plain = dense(8, dtype=jnp.float32, quant=None, name="d")
+        quantized = dense(8, dtype=jnp.float32, quant="int8", name="d")
+        assert isinstance(plain, nn.Dense)
+        v0 = plain.init(jax.random.PRNGKey(0), x)
+        v1 = quantized.init(jax.random.PRNGKey(0), x)
+        assert (jax.tree_util.tree_structure(v0)
+                == jax.tree_util.tree_structure(v1))
+        assert [l.shape for l in jax.tree.leaves(v0)] \
+            == [l.shape for l in jax.tree.leaves(v1)]
+
+    def test_dense_general_shapes_match_flax(self):
+        import flax.linen as nn
+
+        from distributedtensorflow_tpu.models.layers import (
+            QuantDenseGeneral,
+        )
+
+        x = _rand((2, 6, 32))
+        ref = nn.DenseGeneral((4, 8), name="d")
+        q = QuantDenseGeneral((4, 8), quant="int8", name="d")
+        v_ref = ref.init(jax.random.PRNGKey(0), x)
+        v_q = q.init(jax.random.PRNGKey(0), x)
+        assert [l.shape for l in jax.tree.leaves(v_ref)] \
+            == [l.shape for l in jax.tree.leaves(v_q)]
+        # contracting two trailing axes (the BERT out-projection shape)
+        y = _rand((2, 6, 4, 8))
+        ref2 = nn.DenseGeneral(32, axis=(-2, -1), name="o")
+        q2 = QuantDenseGeneral(32, quant="int8", axis=(-2, -1), name="o")
+        v_ref2 = ref2.init(jax.random.PRNGKey(0), y)
+        v_q2 = q2.init(jax.random.PRNGKey(0), y)
+        assert [l.shape for l in jax.tree.leaves(v_ref2)] \
+            == [l.shape for l in jax.tree.leaves(v_q2)]
+        out = q2.apply(v_q2, y)
+        assert out.shape == (2, 6, 32)
+
+    def test_gpt_tiny_quant_loss_tracks_full_width(self, dp_mesh):
+        from distributedtensorflow_tpu.data import (
+            InputContext,
+            device_put_batch,
+        )
+        from distributedtensorflow_tpu.train import (
+            create_sharded_state,
+            make_train_step,
+        )
+        from distributedtensorflow_tpu.workloads import get_workload
+
+        rng = jax.random.PRNGKey(0)
+
+        def run(quant):
+            wl = get_workload("gpt_lm", test_size=True,
+                              quant=quant).for_mesh(dp_mesh)
+            state, specs = create_sharded_state(
+                wl.init_fn, wl.make_optimizer(), dp_mesh, rng,
+                rules=wl.layout,
+            )
+            step = make_train_step(wl.loss_fn, dp_mesh, specs)
+            it = wl.input_fn(InputContext(1, 0, wl.global_batch_size), 0)
+            for _ in range(6):
+                state, m = step(
+                    state, device_put_batch(next(it), dp_mesh), rng
+                )
+            return float(m["loss"])
+
+        full = run(None)
+        int8 = run("int8")
+        assert abs(int8 - full) / full < 0.02, (full, int8)
+
+    def test_conv_workload_rejects_quant(self):
+        from distributedtensorflow_tpu.workloads import get_workload
+
+        with pytest.raises(ValueError, match="no quantized-compute path"):
+            get_workload("imagenet_resnet50", test_size=True, quant="int8")

@@ -13,11 +13,15 @@ flight-recorder event schema; basenames starting with ``goodput`` against
 the goodput-ledger document schema; basenames starting with ``captures``
 against the reactive-profiler manifest schema; basenames starting with
 ``faults`` against the chaos fault-log schema; basenames starting with
-``requests`` against the serving per-request log schema; files ending in
-``.prom`` against the Prometheus exposition snapshot (well-formed samples;
+``requests`` against the serving per-request log schema; basenames
+starting with ``flash_blocks`` against the flash-attention autotune cache
+schema (ops/flash_tuning.py: version 1, entries with platform/dtype/
+shape, blocks dividing seq, known sources); files ending in ``.prom``
+against the Prometheus exposition snapshot (well-formed samples;
 ``collective_dispatch_seconds`` ``op`` labels restricted to the known
-collective set — see :data:`COLLECTIVE_OPS`); everything else against the
-metric-row schema.
+collective set — see :data:`COLLECTIVE_OPS` — and ``overlapped`` labels
+to "0"/"1"); everything else against the metric-row schema (where
+``quant_mode`` is the one string-typed field, from :data:`QUANT_MODES`).
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
@@ -80,8 +84,12 @@ import os
 import re
 import sys
 
-#: jsonl-flattened label suffix of the collective histogram (.op_<op>).
-_FLAT_OP_RE = re.compile(r"\.op_([A-Za-z0-9_]+)$")
+#: jsonl-flattened label suffix of the collective histogram (.op_<op>);
+#: label suffixes sort alphabetically, so an ``overlapped`` label can
+#: follow the op one — match mid-key, not just at end of field name.
+_FLAT_OP_RE = re.compile(r"\.op_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``overlapped`` label (parallel/overlap.py wrappers).
+_FLAT_OVERLAPPED_RE = re.compile(r"\.overlapped_([A-Za-z0-9_]+?)(?=\.|$)")
 
 #: One Prometheus exposition sample: name, optional {labels}, value.
 _PROM_SAMPLE_RE = re.compile(
@@ -108,6 +116,9 @@ DEFAULT_REQUESTS_GLOB = os.path.join(
 )
 DEFAULT_PROM_GLOB = os.path.join(
     REPO, "ARTIFACTS", "convergence_*", "metrics.prom"
+)
+DEFAULT_FLASH_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "flash_blocks*.json"
 )
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
@@ -147,6 +158,19 @@ COLLECTIVE_OPS = (
     "shift", "all_to_all",
 )
 
+#: Values of the ``overlapped`` histogram label (parallel/overlap.py —
+#: "1" = issued by the backward-pass bucketed gradient sync).
+OVERLAPPED_VALUES = ("0", "1")
+
+#: Allowed values of the string-typed ``quant_mode`` metric-row field
+#: (ops/quant.py QUANT_MODES minus the unstamped "none" — duplicated for
+#: the same stdlib-only reason).
+QUANT_MODES = ("none", "int8", "int8_stochastic", "fp8")
+
+#: Provenance tags of a flash-blocks autotune cache entry
+#: (ops/flash_tuning.py SOURCES — duplicated, stdlib-only).
+FLASH_SOURCES = ("sweep", "xplane")
+
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
     """Returns (errors, warnings) for one parsed row."""
@@ -175,6 +199,22 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                     f"line {lineno}: field {k!r} carries unknown collective "
                     f"op {m.group(1)!r} (known: {COLLECTIVE_OPS})"
                 )
+            m = _FLAT_OVERLAPPED_RE.search(k)
+            if m and m.group(1) not in OVERLAPPED_VALUES:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown "
+                    f"overlapped value {m.group(1)!r} "
+                    f"(known: {OVERLAPPED_VALUES})"
+                )
+        if k == "quant_mode":
+            # the one STRING-typed metric-row field: the quantized-compute
+            # mode stamp (TrainerConfig.quant)
+            if v not in QUANT_MODES:
+                errors.append(
+                    f"line {lineno}: 'quant_mode' {v!r} not in "
+                    f"{QUANT_MODES}"
+                )
+            continue
         if v in ("NaN", "Infinity", "-Infinity"):
             warnings.append(f"line {lineno}: field {k!r} is non-finite ({v})")
         elif isinstance(v, bool) or not isinstance(v, (int, float)):
@@ -521,6 +561,69 @@ def check_requests_file(path: str) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
+def check_flash_cache_doc(doc) -> tuple[list[str], list[str]]:
+    """Validate one parsed flash-blocks autotune cache
+    (``ops/flash_tuning.py`` format): version 1, an ``entries`` list
+    whose rows carry non-empty ``platform``/``dtype`` strings, positive
+    int ``seq``/``depth``/``block_q``/``block_k`` with both blocks
+    dividing ``seq`` (a non-dividing entry can never be consulted — it
+    is a corrupt or hand-mangled cache), a known ``source``, and a
+    non-negative finite ``ms`` when present."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, not an object"], []
+    if doc.get("version") != 1:
+        errors.append(f"'version' {doc.get('version')!r} != 1")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        errors.append("'entries' is missing or not a list")
+        return errors, warnings
+    for i, e in enumerate(entries):
+        where = f"entries[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        for k in ("platform", "dtype"):
+            if not isinstance(e.get(k), str) or not e.get(k):
+                errors.append(f"{where}: {k!r} {e.get(k)!r} is not a "
+                              "non-empty string")
+        ints = {}
+        for k in ("seq", "depth", "block_q", "block_k"):
+            v = e.get(k)
+            if isinstance(v, bool) or not isinstance(v, int) or v <= 0:
+                errors.append(f"{where}: {k!r} {v!r} is not a positive "
+                              "integer")
+            else:
+                ints[k] = v
+        if "seq" in ints:
+            for k in ("block_q", "block_k"):
+                if k in ints and ints["seq"] % ints[k]:
+                    errors.append(
+                        f"{where}: {k} {ints[k]} does not divide seq "
+                        f"{ints['seq']}"
+                    )
+        for k in ("batch", "heads"):
+            v = e.get(k)
+            if v is not None and (
+                isinstance(v, bool) or not isinstance(v, int) or v <= 0
+            ):
+                errors.append(f"{where}: {k!r} {v!r} is not a positive "
+                              "integer")
+        src = e.get("source")
+        if src is not None and src not in FLASH_SOURCES:
+            errors.append(f"{where}: 'source' {src!r} not in "
+                          f"{FLASH_SOURCES}")
+        ms = e.get("ms")
+        if ms is not None and (
+            isinstance(ms, bool) or not isinstance(ms, (int, float))
+            or not math.isfinite(ms) or ms < 0
+        ):
+            errors.append(f"{where}: 'ms' {ms!r} is not a non-negative "
+                          "finite number")
+    return errors, warnings
+
+
 def check_prom_file(path: str) -> tuple[list[str], list[str]]:
     """Validate one ``metrics.prom`` snapshot (obs registry text
     exposition): every non-comment line must be a well-formed sample with
@@ -553,6 +656,12 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                     errors.append(
                         f"line {i}: {name} carries unknown collective op "
                         f"{op!r} (known: {COLLECTIVE_OPS})"
+                    )
+                ov = labels.get("overlapped")
+                if ov is not None and ov not in OVERLAPPED_VALUES:
+                    errors.append(
+                        f"line {i}: {name} carries unknown overlapped "
+                        f"value {ov!r} (known: {OVERLAPPED_VALUES})"
                     )
     return errors, warnings
 
@@ -650,6 +759,13 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         except (OSError, json.JSONDecodeError) as e:
             return [f"invalid JSON ({e})"], []
         return check_goodput_doc(doc)
+    if os.path.basename(path).startswith("flash_blocks"):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"invalid JSON ({e})"], []
+        return check_flash_cache_doc(doc)
     if os.path.basename(path).startswith("faults"):
         return check_faults_file(path)
     if path.endswith(".prom"):
@@ -690,7 +806,7 @@ def main(argv: list[str] | None = None) -> int:
         glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
         + glob.glob(DEFAULT_GOODPUT_GLOB) + glob.glob(DEFAULT_CAPTURES_GLOB)
         + glob.glob(DEFAULT_FAULTS_GLOB) + glob.glob(DEFAULT_REQUESTS_GLOB)
-        + glob.glob(DEFAULT_PROM_GLOB)
+        + glob.glob(DEFAULT_PROM_GLOB) + glob.glob(DEFAULT_FLASH_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

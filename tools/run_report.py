@@ -338,6 +338,60 @@ def serving_summary(rows: list[dict]) -> dict:
     }
 
 
+def step_time_opt_summary(train: list[dict], logdir: str) -> dict:
+    """The step-time-attack digest: quantized-compute mode
+    (``quant_mode`` row stamp), collective-matmul overlap (bucket count +
+    coverage stamps, plus the overlapped share of collective dispatches
+    from the flattened histogram fields), and the flash-attention
+    autotuner's block choices (``<logdir>/flash_blocks.json`` when the
+    run's sweep landed its cache there).  Empty when the run used none
+    of the three."""
+    last: dict = {}
+    for r in train:
+        if "quant_mode" in r or "overlap_buckets" in r:
+            last = r
+    out: dict = {}
+    if isinstance(last.get("quant_mode"), str):
+        out["quant_mode"] = last["quant_mode"]
+    if isinstance(last.get("overlap_buckets"), (int, float)) \
+            and last["overlap_buckets"]:
+        overlap: dict = {"buckets": int(last["overlap_buckets"])}
+        if isinstance(last.get("overlap_coverage"), (int, float)):
+            overlap["coverage"] = last["overlap_coverage"]
+        # Overlapped share of collective dispatches, from the flattened
+        # histogram counts in the same record.
+        overlapped = 0.0
+        total = 0.0
+        for k, v in last.items():
+            if not k.startswith("collective_dispatch_seconds_count"):
+                continue
+            if not isinstance(v, (int, float)):
+                continue
+            total += v
+            if ".overlapped_1" in k:
+                overlapped += v
+        if total:
+            overlap["dispatch_share"] = overlapped / total
+        out["overlap"] = overlap
+    cache_path = os.path.join(logdir, "flash_blocks.json")
+    if os.path.exists(cache_path):
+        try:
+            with open(cache_path) as f:
+                doc = json.load(f)
+            entries = doc.get("entries") if isinstance(doc, dict) else None
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{cache_path}: unreadable ({e})", file=sys.stderr)
+            entries = None
+        if isinstance(entries, list) and entries:
+            out["autotuned_blocks"] = [
+                {k: e.get(k) for k in ("platform", "dtype", "seq", "depth",
+                                       "block_q", "block_k", "ms",
+                                       "source")}
+                for e in entries if isinstance(e, dict)
+            ]
+    return out
+
+
 def sharding_summary(train: list[dict]) -> dict:
     """The weight-update-sharding digest from the per-record state-bytes
     fields (written once per log boundary from the fit's static
@@ -450,6 +504,7 @@ def build_report(logdir: str) -> dict:
         ],
         "anomalies": collect_anomalies(trace, train),
         "sharding": sharding_summary(train),
+        "step_time_opt": step_time_opt_summary(train, logdir),
         "stragglers": straggler_fields(train),
         "flight": flight_summary(flight),
         "captures": capture_summary(captures),
@@ -642,6 +697,37 @@ def render(report: dict) -> str:
         if srv.get("rejected"):
             lines.append(f"  REJECTED {srv['rejected']} request(s) "
                          "(queue backpressure)")
+    sto = report.get("step_time_opt")
+    if sto:
+        parts = []
+        if "quant_mode" in sto:
+            parts.append(f"quant={sto['quant_mode']}")
+        ov = sto.get("overlap")
+        if ov:
+            cov = ov.get("coverage")
+            parts.append(
+                f"overlap {ov['buckets']} bucket(s)"
+                + (f", {cov * 100:.0f}% coverage"
+                   if isinstance(cov, (int, float)) else "")
+            )
+        if sto.get("autotuned_blocks"):
+            parts.append(f"{len(sto['autotuned_blocks'])} autotuned "
+                         "flash tiling(s)")
+        lines += ["", "step-time attack: " + (", ".join(parts) or "none")]
+        if ov and isinstance(ov.get("dispatch_share"), (int, float)):
+            lines.append(
+                f"  overlapped collective dispatches: "
+                f"{ov['dispatch_share'] * 100:.1f}%"
+            )
+        for b in sto.get("autotuned_blocks", []):
+            lines.append(
+                f"  flash {b.get('platform')}/{b.get('dtype')} "
+                f"seq {b.get('seq')} d {b.get('depth')}: "
+                f"block_q {b.get('block_q')} block_k {b.get('block_k')}"
+                + (f"  ({b.get('ms'):.3g} ms, {b.get('source')})"
+                   if isinstance(b.get("ms"), (int, float)) else
+                   f"  ({b.get('source')})")
+            )
     sh = report.get("sharding")
     if sh:
         mode = (

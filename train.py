@@ -233,6 +233,9 @@ def run_evaluator(args) -> None:
         kv_heads=args.kv_heads,
         attn_window=args.attn_window,
         remat=REMAT_FLAG[args.remat],
+        # the restore template's param tree is quant-invariant, but the
+        # eval forward should run the trainer's compute mode
+        quant=None if args.quant == "none" else args.quant,
     )
     if wl.eval_fn is None:
         raise SystemExit(f"workload {wl.name!r} has no eval_fn to sidecar")
@@ -564,6 +567,25 @@ def main() -> None:
                    help="optimizer steps bundled into one XLA dispatch"
                         " (Keras steps_per_execution analogue; amortizes"
                         " host dispatch/RTT, hooks fire every k steps)")
+    p.add_argument("--quant",
+                   choices=("none", "int8", "int8_stochastic", "fp8"),
+                   default="none",
+                   help="quantized compute (ops/quant.py): run the "
+                        "transformer presets' block matmuls as int8 (or "
+                        "fp8) with per-channel absmax scales and a "
+                        "straight-through-estimator backward (QAT-safe); "
+                        "embeddings/layernorms/heads stay high-precision; "
+                        "stamps quant_mode into every metric record")
+    p.add_argument("--overlap", action="store_true",
+                   help="collective-matmul overlap (parallel/overlap.py): "
+                        "issue the backward-pass gradient all-reduce "
+                        "(reduce-scatter under --zero) in per-layer-group "
+                        "buckets as each gradient is produced, so the sync "
+                        "hides under the remaining backward matmuls; "
+                        "numerically identical to the unbucketed step")
+    p.add_argument("--overlap-bucket-mb", type=float, default=4.0,
+                   help="greedy merge threshold (MiB of parameter bytes) "
+                        "for --overlap's per-layer-group gradient buckets")
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--eval-every", type=int, default=0)
     p.add_argument("--target-metric", default=None,
@@ -869,6 +891,7 @@ def main() -> None:
         xent_impl=args.xent_impl,
         kv_heads=args.kv_heads,
         attn_window=args.attn_window,
+        quant=None if args.quant == "none" else args.quant,
     )
     wl = apply_optimizer_flags(wl, args)
     spec = parse_mesh(args.mesh) or wl.mesh_spec
@@ -931,16 +954,49 @@ def main() -> None:
         wl.init_fn, optimizer, mesh, rng,
         rules=wl.layout, fsdp=wl.fsdp, zero=zero_sharder,
     )
+    # Collective-matmul overlap: bucket the backward-pass gradient sync
+    # per layer group so it hides under the remaining backward matmuls.
+    overlap_plan = None
+    if args.overlap:
+        if shard_div <= 1:
+            logging.warning(
+                "--overlap: mesh %s has a single data-parallel replica; "
+                "there is no gradient collective to overlap — running "
+                "without bucketing", dict(mesh.shape),
+            )
+        else:
+            from distributedtensorflow_tpu.parallel.overlap import (
+                OverlapPlan,
+            )
+            from distributedtensorflow_tpu.train.state import (
+                split_variables,
+            )
+
+            param_shapes, _ = split_variables(
+                jax.eval_shape(wl.init_fn, rng)
+            )
+            overlap_plan = OverlapPlan.build(
+                mesh, param_shapes, specs.params, zero=zero_sharder,
+                bucket_bytes=int(args.overlap_bucket_mb * 2 ** 20),
+            )
+            logging.info(
+                "overlap: %d gradient bucket(s), mode=%s, coverage=%.0f%%",
+                len(overlap_plan.buckets),
+                overlap_plan.describe()["mode"],
+                100 * overlap_plan.coverage,
+            )
     if args.steps_per_call > 1:
         from distributedtensorflow_tpu.train import make_multi_train_step
 
         train_step = make_multi_train_step(
             wl.loss_fn, mesh, specs,
             steps_per_call=args.steps_per_call, accum_steps=accum,
+            overlap=overlap_plan,
         )
     else:
         train_step = make_train_step(
-            wl.loss_fn, mesh, specs, accum_steps=accum
+            wl.loss_fn, mesh, specs, accum_steps=accum,
+            overlap=overlap_plan,
         )
     eval_step = (
         make_eval_step(wl.eval_fn, mesh, specs) if wl.eval_fn else None
@@ -1081,6 +1137,13 @@ def main() -> None:
             steps_per_call=args.steps_per_call,
             input_prebundled=args.steps_per_call > 1,
             zero_stage=1 if zero_sharder is not None else 0,
+            quant=args.quant,
+            overlap_buckets=(
+                len(overlap_plan.buckets) if overlap_plan is not None else 0
+            ),
+            overlap_coverage=(
+                overlap_plan.coverage if overlap_plan is not None else 0.0
+            ),
             global_batch_size=wl.global_batch_size,
             logdir=args.logdir,
             profile_dir=args.profile_dir,
