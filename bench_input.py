@@ -24,7 +24,19 @@ the Python baseline does no integrity checking (pure-Python CRC32C would
 be ~100x slower).  Multi-thread rows still need >1 core to pull ahead —
 ``hw_concurrency`` is emitted so the judge can see the bound.
 
+Round 4 adds the **data-service rows** (ISSUE 9): a loopback dispatcher +
+2 workers serving identical batch streams, measured through the old
+per-connection client (fresh TCP connection + blocking round-trip + npz
+archive per batch — the pre-streaming protocol, kept in the client as
+``protocol="per_connection"``) versus the streaming client (persistent
+pipelined connections, credit window, raw tensor wire).  Same batch
+contents on every row, so the delta is pure protocol + codec cost;
+loopback, so it runs with or without the tunnel.  The headline
+``service.speedup_stream_raw_vs_per_conn_npz`` is the acceptance number
+(>= 2x batches/sec).
+
 Prints one JSON line like bench.py; persists to BENCH_RESULTS/.
+``BENCH_INPUT_TEST=1`` shrinks everything for smoke tests.
 """
 
 from __future__ import annotations
@@ -35,9 +47,18 @@ import struct
 import tempfile
 import time
 
-N_FILES = 8
-RECORDS_PER_FILE = 20_000
+_TEST = os.environ.get("BENCH_INPUT_TEST") == "1"
+
+N_FILES = 2 if _TEST else 8
+RECORDS_PER_FILE = 500 if _TEST else 20_000
 RECORD_BYTES = 1024  # ~160 MB total
+
+#: Data-service row shape: batches of one (64, 1024) f32 tensor (256 KiB)
+#: — small enough that per-batch protocol overhead is visible, big enough
+#: that MB/sec is meaningful.
+SERVICE_BATCHES = 40 if _TEST else 300
+SERVICE_BATCH_SHAPE = (64, 1024)
+SERVICE_WORKERS = 2
 
 
 def write_files(tmpdir: str) -> list[str]:
@@ -95,6 +116,97 @@ def median_rate(measure_once, total: int) -> int:
         assert n == total, (n, total)
         rates.append(total / dt)
     return round(statistics.median(rates))
+
+
+def bench_service() -> dict:
+    """Data-service protocol rows: batches/sec + MB/sec per
+    (protocol, wire) combination over identical batch streams."""
+    import numpy as np
+    import statistics
+
+    from distributedtensorflow_tpu.data import (
+        DataServiceClient,
+        DispatchServer,
+        WorkerServer,
+    )
+
+    batch_bytes = int(np.prod(SERVICE_BATCH_SHAPE)) * 4
+    total = SERVICE_BATCHES - SERVICE_BATCHES % SERVICE_WORKERS
+
+    def input_fn(split, num_shards):
+        rng = np.random.default_rng(split)
+        x = rng.standard_normal(SERVICE_BATCH_SHAPE).astype(np.float32)
+        for _ in range(total // num_shards):
+            yield {"x": x}
+
+    dispatcher = DispatchServer(port=0)
+    workers = [
+        WorkerServer(dispatcher.target(), input_fn, port=0)
+        for _ in range(SERVICE_WORKERS)
+    ]
+    epoch = [0]
+
+    def run_client(protocol, wire, window):
+        client = DataServiceClient(
+            dispatcher.target(),
+            epoch=epoch[0],
+            protocol=protocol,
+            wire=wire,
+            window=window,
+            adaptive_window=False,
+        )
+        epoch[0] += 1
+        t0 = time.perf_counter()
+        count = 0
+        try:
+            for batch in client:
+                assert batch["x"].nbytes == batch_bytes
+                count += 1
+        finally:
+            client.close()
+        return count, time.perf_counter() - t0
+
+    rows = {}
+    try:
+        combos = (
+            ("service_per_conn_npz", "per_connection", "npz", 1),
+            ("service_per_conn_raw", "per_connection", "raw", 1),
+            ("service_stream_npz", "streaming", "npz", 8),
+            ("service_stream_raw", "streaming", "raw", 8),
+        )
+        for name, protocol, wire, window in combos:
+            rates = []
+            for _ in range(REPEATS):
+                n, dt = run_client(protocol, wire, window)
+                assert n == total, (name, n, total)
+                rates.append(total / dt)
+            rows[name] = round(statistics.median(rates), 1)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+    baseline = max(rows["service_per_conn_npz"], 1e-9)
+    return {
+        "rows": rows,
+        "unit": "batches/sec",
+        "batch_bytes": batch_bytes,
+        "batches_per_pass": total,
+        "workers": SERVICE_WORKERS,
+        "window": 8,
+        "mb_per_sec": {
+            k: round(v * batch_bytes / 1e6, 1) for k, v in rows.items()
+        },
+        "speedup_stream_raw_vs_per_conn_npz": round(
+            rows["service_stream_raw"] / baseline, 2
+        ),
+        "speedup_stream_npz_vs_per_conn_npz": round(
+            rows["service_stream_npz"] / baseline, 2
+        ),
+        "speedup_raw_wire_per_conn": round(
+            rows["service_per_conn_raw"] / baseline, 2
+        ),
+    }
 
 
 def main() -> None:
@@ -168,6 +280,7 @@ def main() -> None:
         "repeats_per_row": REPEATS,
         "aggregation": "median",
         "hw_concurrency": available_cpus(),
+        "service": bench_service(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     persist_result("input", result)

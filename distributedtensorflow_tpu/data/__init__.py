@@ -13,9 +13,16 @@ from .service import (  # noqa: F401
     DispatchServer,
     WorkerServer,
 )
+from .wire import (  # noqa: F401
+    WireError,
+    decode_tensors,
+    encode_tensors,
+)
 from .input_pipeline import (  # noqa: F401
+    AdaptiveDepthController,
     InputContext,
     Prefetcher,
+    input_record_fields,
     current_input_context,
     device_put_batch,
     device_put_bundle,

@@ -1,0 +1,190 @@
+"""Adaptive prefetch-depth control for the input plane.
+
+One controller class drives both knobs the pod-scale input plane tunes at
+runtime (ROADMAP item 4: "host-side prefetch depth tuned from the goodput
+ledger's data_wait bucket"):
+
+- the :class:`data.Prefetcher` host→device buffer depth, and
+- the :class:`data.DataServiceClient` per-split credit window.
+
+Policy — driven by the same consumer-blocking signal the
+``data_wait_seconds`` histogram records:
+
+- **grow** while the consumer blocks (mean wait over the last ``interval``
+  pops above ``grow_wait_s``): the pipeline is input-bound or bursty, more
+  in-flight batches absorb the jitter;
+- **shrink** when waits are ~0 (below ``shrink_wait_s``): the buffer is
+  always full and every extra slot is idle host/device memory;
+- always bounded by ``[min_depth, max_depth]`` AND a **bytes budget**: the
+  depth cap is ``bytes_budget // observed_batch_bytes`` (EWMA of
+  :meth:`note_bytes`), so a fatter batch automatically means a shallower
+  queue.
+
+Every decision is exported: the ``data_prefetch_depth{component=}`` gauge
+tracks the live depth, ``data_prefetch_resizes_total{component=,direction=}``
+counts decisions, and :func:`input_record_fields` (re-exported from
+``data.input_pipeline``) stamps the depths into every metric record the
+Trainer logs.
+
+Telemetry degrades to no-ops where the obs registry (which pulls jax) is
+unavailable — the controller also runs inside bare data-worker hosts.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# The one guarded obs import for the data package (service.py shares
+# these shims): telemetry degrades to no-ops where obs — which pulls jax
+# — is absent.
+try:  # pragma: no cover - exercised implicitly everywhere obs imports
+    from ..obs.registry import counter as _counter
+    from ..obs.registry import gauge as _gauge
+    from ..obs.registry import histogram as _histogram
+    from ..obs.flight_recorder import record_event as _record_event
+except Exception:  # pragma: no cover
+    class _Null:
+        def inc(self, *a, **k): pass
+        def set(self, *a, **k): pass
+        def observe(self, *a, **k): pass
+        def value(self, *a, **k): return 0.0
+
+    def _counter(name, help=""): return _Null()
+    def _gauge(name, help=""): return _Null()
+    def _histogram(name, help=""): return _Null()
+    def _record_event(kind, **fields): pass
+
+#: Live controllers by component name ("prefetcher" / "client"), for the
+#: per-record fields.  Last constructed wins — one Prefetcher + one client
+#: per training process is the wiring train.py builds.
+_CONTROLLERS: dict[str, "AdaptiveDepthController"] = {}
+_CONTROLLERS_LOCK = threading.Lock()
+
+#: Component → metric-record field name.
+_RECORD_FIELDS = {
+    "prefetcher": "data_prefetch_depth",
+    "client": "data_client_window",
+}
+
+
+class AdaptiveDepthController:
+    """Autotunes a queue depth / credit window from consumer wait times.
+
+    Thread contract: ``observe_wait`` is called by the consumer thread,
+    ``note_bytes`` by producer threads, ``depth`` read from anywhere; all
+    state updates run under one small lock (per-batch cadence, not
+    per-element — nowhere near hot).
+    """
+
+    def __init__(
+        self,
+        *,
+        initial: int = 2,
+        min_depth: int = 1,
+        max_depth: int = 16,
+        grow_wait_s: float = 2e-3,
+        shrink_wait_s: float = 2e-4,
+        interval: int = 8,
+        bytes_budget: int | None = None,
+        component: str = "prefetcher",
+    ):
+        if min_depth < 1 or max_depth < min_depth:
+            raise ValueError(
+                f"bad depth bounds [{min_depth}, {max_depth}]"
+            )
+        if shrink_wait_s > grow_wait_s:
+            raise ValueError(
+                f"shrink_wait_s {shrink_wait_s} exceeds grow_wait_s "
+                f"{grow_wait_s} (the controller would oscillate)"
+            )
+        self.min_depth = int(min_depth)
+        self.max_depth = int(max_depth)
+        self.grow_wait_s = float(grow_wait_s)
+        self.shrink_wait_s = float(shrink_wait_s)
+        self.interval = max(1, int(interval))
+        self.bytes_budget = bytes_budget
+        self.component = component
+        self._lock = threading.Lock()
+        self._depth = min(max(int(initial), self.min_depth), self.max_depth)
+        self._waits: list[float] = []
+        self._item_bytes = 0.0  # EWMA of observed batch bytes
+        self._g_depth = _gauge(
+            "data_prefetch_depth",
+            "live adaptive prefetch depth / credit window",
+        )
+        self._m_resizes = _counter(
+            "data_prefetch_resizes_total",
+            "adaptive depth-controller decisions",
+        )
+        self._g_depth.set(self._depth, component=component)
+        with _CONTROLLERS_LOCK:
+            _CONTROLLERS[component] = self
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def item_bytes(self) -> float:
+        return self._item_bytes
+
+    def byte_cap(self) -> int:
+        """Depth allowed by the bytes budget (max_depth when unbudgeted
+        or before the first batch size lands)."""
+        if not self.bytes_budget or self._item_bytes <= 0:
+            return self.max_depth
+        return min(
+            self.max_depth,
+            max(self.min_depth, int(self.bytes_budget // self._item_bytes)),
+        )
+
+    def note_bytes(self, nbytes: int) -> None:
+        with self._lock:
+            self._item_bytes = (
+                float(nbytes) if self._item_bytes == 0.0
+                else 0.9 * self._item_bytes + 0.1 * float(nbytes)
+            )
+            # A budget violation shrinks immediately, without waiting for
+            # the next wait-window decision.
+            cap = self.byte_cap()
+            if self._depth > cap:
+                self._set_depth(cap, "shrink")
+
+    def observe_wait(self, seconds: float) -> int:
+        """Record one consumer blocking time; returns the (possibly
+        updated) depth."""
+        with self._lock:
+            self._waits.append(float(seconds))
+            if len(self._waits) >= self.interval:
+                mean = sum(self._waits) / len(self._waits)
+                self._waits.clear()
+                cap = self.byte_cap()
+                d = self._depth
+                if mean > self.grow_wait_s:
+                    d += 1
+                elif mean < self.shrink_wait_s:
+                    d -= 1
+                d = min(max(d, self.min_depth), cap)
+                if d != self._depth:
+                    self._set_depth(
+                        d, "grow" if d > self._depth else "shrink"
+                    )
+            return self._depth
+
+    def _set_depth(self, d: int, direction: str) -> None:
+        self._depth = d
+        self._g_depth.set(d, component=self.component)
+        self._m_resizes.inc(direction=direction, component=self.component)
+
+
+def input_record_fields() -> dict[str, float]:
+    """Live input-plane depths as per-record metric fields
+    (``data_prefetch_depth`` / ``data_client_window``); empty when no
+    adaptive controller is running."""
+    out: dict[str, float] = {}
+    with _CONTROLLERS_LOCK:
+        for component, ctl in _CONTROLLERS.items():
+            field = _RECORD_FIELDS.get(component)
+            if field is not None:
+                out[field] = float(ctl.depth)
+    return out

@@ -32,11 +32,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 from .. import obs
+from .adaptive import (  # noqa: F401  (re-exported API surface)
+    AdaptiveDepthController,
+    input_record_fields,
+)
 from ..parallel import sharding as shardlib
 
 logger = logging.getLogger("distributedtensorflow_tpu")
 
 PyTree = Any
+
+
+def _host_bytes(tree: PyTree) -> int:
+    """Host bytes of a (pre-placement) numpy batch pytree."""
+    return sum(
+        int(getattr(x, "nbytes", 0)) for x in jax.tree.leaves(tree)
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,15 +139,40 @@ class Prefetcher:
     yielded at its true (shorter) length so the consumer sees exactly the
     batches that exist; the Trainer treats a too-short final bundle as
     end-of-data (StopIteration parity with per-step iteration).
+
+    ``adaptive=True`` hands the depth to an
+    :class:`AdaptiveDepthController` seeded at ``buffer_size``: the
+    worker admits new batches only while fewer than the LIVE depth are
+    buffered, so the queue deepens while the consumer blocks on data
+    (input-bound — absorb the jitter) and shallows when waits are ~0
+    (each buffered batch is device memory held for nothing), bounded by
+    ``[1, max_depth]`` and ``bytes_budget`` host bytes.  The live depth
+    is exported as the ``data_prefetch_depth{component="prefetcher"}``
+    gauge and the ``data_prefetch_depth`` per-record field.
     """
 
     _DONE = object()
 
     def __init__(self, it: Iterable[PyTree], mesh: Mesh, buffer_size: int = 2,
-                 *, bundle: int = 1):
+                 *, bundle: int = 1, adaptive: bool = False,
+                 max_depth: int = 16, bytes_budget: int | None = None,
+                 controller: "AdaptiveDepthController | None" = None):
         self._mesh = mesh
         self._bundle = bundle
-        self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
+        if controller is None and adaptive:
+            controller = AdaptiveDepthController(
+                initial=buffer_size,
+                min_depth=1,
+                max_depth=max_depth,
+                bytes_budget=bytes_budget,
+                component="prefetcher",
+            )
+        self._controller = controller
+        self._depth = max(1, int(buffer_size))
+        # Unbounded queue; admission is gated on the LIVE depth via the
+        # condition below (a Queue's maxsize is frozen at construction).
+        self._q: queue.Queue = queue.Queue()
+        self._cond = threading.Condition()
         self._err: BaseException | None = None
         self._stop = threading.Event()
         # obs registry handles, resolved once (hot-path discipline).  The
@@ -151,6 +187,7 @@ class Prefetcher:
         self._m_put = obs.histogram(
             "data_device_put_seconds", "host->device placement time per batch"
         )
+        self._src = it  # kept so close() can release the source too
         self._thread = threading.Thread(
             target=self._run, args=(iter(it),), daemon=True
         )
@@ -167,11 +204,28 @@ class Prefetcher:
             if len(group) < self._bundle:
                 return
 
+    def _admit(self, item) -> bool:
+        """Admission gate on the LIVE depth; re-checks stop so close()
+        can't deadlock against a full buffer."""
+        with self._cond:
+            while not self._stop.is_set() and self._q.qsize() >= self._live_depth():
+                self._cond.wait(0.1)
+            if self._stop.is_set():
+                return False
+            self._q.put(item)
+            return True
+
+    def _live_depth(self) -> int:
+        return self._controller.depth if self._controller else self._depth
+
     def _run(self, it: Iterator[PyTree]):
         try:
             for batch in self._batches(it):
                 if self._stop.is_set():
                     return
+                if self._controller is not None:
+                    # Budget unit: host bytes of the (pre-placement) batch.
+                    self._controller.note_bytes(_host_bytes(batch))
                 t0 = time.perf_counter()
                 out = (
                     device_put_bundle(batch, self._mesh)
@@ -179,28 +233,17 @@ class Prefetcher:
                     else device_put_batch(batch, self._mesh)
                 )
                 self._m_put.observe(time.perf_counter() - t0)
-                # bounded put that re-checks stop, so close() can't deadlock
-                # against a full queue
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(out, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                if not self._admit(out):
+                    return
         except BaseException as e:  # surfaced on the consumer thread
             self._err = e
         finally:
-            # The DONE sentinel must not be droppable: with a full queue a
-            # put_nowait would lose it and the consumer would block forever
-            # after draining the buffered batches (finite sources end while
-            # the queue is full whenever the consumer is slower than the
-            # producer).  Bounded put that yields to close().
-            while not self._stop.is_set():
-                try:
-                    self._q.put(self._DONE, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
+            # The DONE sentinel must not be droppable: a lost sentinel
+            # leaves the consumer blocked forever after draining the
+            # buffered batches (finite sources end while the buffer is
+            # full whenever the consumer is slower than the producer).
+            # Same admission gate, yielding to close().
+            self._admit(self._DONE)
 
     def close(self) -> None:
         """Stop the worker and release buffered device batches.
@@ -210,12 +253,24 @@ class Prefetcher:
         ``buffer_size`` global batches in device memory.
         """
         self._stop.set()
+        with self._cond:
+            self._cond.notify_all()  # wake a producer parked on admission
         while True:
             try:
                 self._q.get_nowait()
             except queue.Empty:
                 break
         self._thread.join(timeout=5)
+        # Release the SOURCE too: a DataServiceClient left open would keep
+        # one fetcher thread + persistent worker connection per split
+        # streaming forever (every supervised restart would leak a set);
+        # generator sources get their GeneratorExit.
+        close = getattr(self._src, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:  # pragma: no cover - source cleanup only
+                logger.warning("input source close() failed", exc_info=True)
 
     def __enter__(self):
         return self
@@ -229,13 +284,23 @@ class Prefetcher:
     def __next__(self):
         t0 = time.perf_counter()
         item = self._q.get()
-        self._m_wait.observe(time.perf_counter() - t0)
+        wait = time.perf_counter() - t0
+        self._m_wait.observe(wait)
+        with self._cond:
+            self._cond.notify_all()  # freed a buffer slot
         if item is self._DONE:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        if self._controller is not None:
+            self._controller.observe_wait(wait)
         self._m_batches.inc()
         return item
+
+    @property
+    def depth(self) -> int:
+        """The live prefetch depth (fixed unless ``adaptive=True``)."""
+        return self._live_depth()
 
 
 # --- Sources -----------------------------------------------------------------

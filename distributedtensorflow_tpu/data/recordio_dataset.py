@@ -14,8 +14,12 @@ C++-reader role) to the input pipeline, reproducing the reference's
 - **AUTO**: FILE when the file count divides evenly, else DATA.
 - **OFF**: no sharding (every host sees everything).
 
-Examples on disk are ``.npz``-serialized feature dicts (one archive per
-record, numpy arrays only — no pickle), written by :func:`write_example`.
+Examples on disk are raw-tensor-wire feature dicts (``data.wire``: one
+JSON header + raw array bytes per record — numpy arrays only, no pickle,
+no per-record zip container), written by :func:`write_example`.
+:func:`decode_example` sniffs the payload, so files written by the older
+``.npz``-per-record codec keep reading; the record framing's own CRC32C
+already covers integrity, so the wire-level checksum stays off here.
 """
 
 from __future__ import annotations
@@ -25,19 +29,28 @@ from collections.abc import Callable, Iterator, Sequence
 
 import numpy as np
 
+from . import wire as wirelib
 from .input_pipeline import InputContext
 from ..native import RecordReader, RecordWriter
 
 Example = dict[str, np.ndarray]
 
 
-def encode_example(example: Example) -> bytes:
+def encode_example(example: Example, wire: str = "raw") -> bytes:
+    """Serialize one example (``wire="raw"`` default; ``"npz"`` writes the
+    legacy per-record zip archive for old readers)."""
+    if wire == "raw":
+        return wirelib.encode_tensors(example)
+    if wire != "npz":
+        raise ValueError(f"unknown wire format {wire!r}")
     buf = io.BytesIO()
     np.savez(buf, **example)
     return buf.getvalue()
 
 
 def decode_example(record: bytes) -> Example:
+    if wirelib.is_raw(record):
+        return wirelib.decode_tensors(record)
     with np.load(io.BytesIO(record)) as z:
         return {k: z[k] for k in z.files}
 

@@ -1,26 +1,57 @@
-"""Disaggregated input service: dispatcher + data workers + client.
+"""Disaggregated input service: dispatcher + data workers + streaming client.
 
 The tf.data-service equivalent (SURVEY.md §2.3: ``DispatchServer``
 `tf/python/data/experimental/service/server_lib.py:131`, ``WorkerServer``
 `:349`): input preprocessing runs on a separate pool of cheap CPU hosts so
 TPU hosts never stall on data.  Shapes of the design kept from the
 reference; the implementation is this framework's own socket protocol (the
-reference's is gRPC/protobuf into the tf.data C++ runtime):
+reference's is gRPC/protobuf into the tf.data C++ runtime).
 
-- a **dispatcher** process tracks the worker pool and assigns each worker a
-  shard index (``distributed_epoch`` semantics: the dataset is partitioned
-  across workers, every element produced exactly once per epoch);
-- **data workers** run the actual input pipeline (e.g. the native
-  ``RecordReader`` + decode) and serve batches over TCP;
-- the **client** (one per trainer host) round-robins over workers; a worker
-  death mid-epoch drops that worker's remaining shard after a configurable
-  policy (``ignore_errors=True``) or raises — the reference's fault
-  semantics for dynamic worker pools.
+Throughput architecture (the pod-scale input plane, ROADMAP item 4 /
+MLPerf 1909.09756):
+
+- a **dispatcher** tracks the worker pool AND owns per-epoch split
+  assignment: ``start_epoch`` snapshots the pool into ``num_shards``
+  splits (``distributed_epoch`` semantics — the dataset is partitioned,
+  every element produced exactly once per epoch) under an epoch
+  **generation counter** that bumps on every re-assignment;
+- **data workers** run the actual input pipeline and serve batches over
+  persistent TCP connections — one handler loop per connection serves
+  any number of pipelined ``get_next`` requests (a v1 single-shot client
+  that closes after one response still works);
+- the **client** opens one fetcher thread per split, each holding a
+  persistent connection with a **credit window** of W outstanding
+  ``get_next`` requests (pipelined: W requests on the wire before the
+  first response is read), feeding one bounded client-side buffer the
+  consumer pops from.  W autotunes from the observed consumer wait
+  (``data.AdaptiveDepthController``) unless pinned.
+
+**Elastic re-sharding** (mid-epoch worker death): the client counts every
+fully-received batch per split; on a dead connection it reports the
+cumulative counts to the dispatcher (``report_worker_failure``), which
+evicts the worker, bumps the epoch generation, and re-assigns the dead
+worker's splits to survivors with ``skip`` = batches already delivered.
+Survivors rebuild ``input_fn(split, num_shards)`` and fast-forward past
+the delivered prefix, so every batch is delivered **exactly once** as
+long as ``input_fn`` is deterministic in ``(split, num_shards)`` — the
+same contract ``data.skip_batches`` resume already relies on.  A batch is
+counted only after it is fully received, so a response torn mid-wire is
+re-fetched and a buffered one is never duplicated.  One client per epoch
+owns the accounting (multi-host setups give each host its own epoch key
+or pre-partitioned splits).
 
 Wire format: every frame is ``uint64 LE length + payload``.  A request is
 one JSON frame; a response is one JSON frame optionally followed by one
-binary frame carrying an ``.npz`` archive of the batch (numpy arrays only —
-no pickle on the wire).
+binary frame carrying the batch — ``wire="raw"`` (default for the
+streaming client) uses the header+raw-bytes tensor format of
+:mod:`data.wire` (optional CRC32C via the native layer), ``wire="npz"``
+the legacy ``np.savez`` archive.  :func:`decode_batch` sniffs both.
+
+Telemetry (obs registry, no-op when obs/jax is unavailable on a plain
+CPU worker host): ``data_service_fetch_seconds{worker=}`` per-worker
+fetch histogram, ``data_service_client_wait_seconds`` consumer blocking,
+``data_service_workers_dropped_total`` / ``data_service_resharded_splits_
+total`` counters, and a ``data_reshard`` flight event per re-assignment.
 """
 
 from __future__ import annotations
@@ -28,6 +59,7 @@ from __future__ import annotations
 import io
 import json
 import logging
+import queue
 import socket
 import socketserver
 import threading
@@ -36,14 +68,40 @@ from collections.abc import Callable, Iterator
 
 import numpy as np
 
+from . import wire as wirelib
+from .adaptive import AdaptiveDepthController
+
 logger = logging.getLogger("distributedtensorflow_tpu")
 
 Batch = dict[str, np.ndarray]
 # input_fn(shard_index, num_shards) -> iterator of batches
 WorkerInputFn = Callable[[int, int], Iterator[Batch]]
 
-_HEARTBEAT_INTERVAL_S = 2.0
-_WORKER_TIMEOUT_S = 10.0
+DEFAULT_HEARTBEAT_INTERVAL_S = 2.0
+DEFAULT_WORKER_TIMEOUT_S = 10.0
+# Back-compat aliases (pre-knob module constants).
+_HEARTBEAT_INTERVAL_S = DEFAULT_HEARTBEAT_INTERVAL_S
+_WORKER_TIMEOUT_S = DEFAULT_WORKER_TIMEOUT_S
+
+WIRE_FORMATS = wirelib.WIRE_FORMATS
+PROTOCOLS = ("streaming", "per_connection")
+
+#: Worker-side iterator caches are pruned to the newest epochs so a
+#: supervisor that rebuilds its client per restart (fresh epoch key each
+#: time) cannot grow worker memory without bound.
+_MAX_CACHED_EPOCHS = 4
+#: Dispatcher-side epoch-assignment state kept, same reason.
+_MAX_TRACKED_EPOCHS = 16
+
+
+# Telemetry degrades to no-ops where obs (which pulls jax) is absent —
+# data workers are deliberately runnable on bare CPU hosts.  One guarded
+# import, shared with the adaptive controller.
+from .adaptive import (  # noqa: F401  (shared degradation shims)
+    _counter,
+    _histogram,
+    _record_event,
+)
 
 
 # --- framing ----------------------------------------------------------------
@@ -90,13 +148,24 @@ def _rpc(addr: str, request: dict, *, timeout: float = 30.0) -> tuple[dict, byte
         return _recv_msg(s)
 
 
-def encode_batch(batch: Batch) -> bytes:
+def encode_batch(batch: Batch, wire: str = "npz", *, crc: bool = False) -> bytes:
+    """Serialize a batch for the wire.  ``"npz"`` (the legacy default —
+    the param-server shard protocol still speaks it) or ``"raw"`` (the
+    header+raw-bytes format of :mod:`data.wire`; ``crc`` adds a CRC32C
+    over the payload when the native layer is available)."""
+    if wire == "raw":
+        return wirelib.encode_tensors(batch, crc=crc)
+    if wire != "npz":
+        raise ValueError(f"unknown wire format {wire!r} (known: {WIRE_FORMATS})")
     buf = io.BytesIO()
     np.savez(buf, **batch)
     return buf.getvalue()
 
 
 def decode_batch(data: bytes) -> Batch:
+    """Decode either wire format (sniffed by magic)."""
+    if wirelib.is_raw(data):
+        return wirelib.decode_tensors(data)
     with np.load(io.BytesIO(data)) as z:
         return {k: z[k] for k in z.files}
 
@@ -105,17 +174,32 @@ def decode_batch(data: bytes) -> Batch:
 
 
 class DispatchServer:
-    """Tracks the data-worker pool; hands out shard assignments.
+    """Tracks the data-worker pool; owns shard assignment per epoch.
 
     The reference's ``DispatchServer`` (`server_lib.py:131`).  State is
     in-memory: workers re-register after a dispatcher restart (the
-    fault-tolerance mode the reference calls non-fault-tolerant dispatch).
+    fault-tolerance mode the reference calls non-fault-tolerant dispatch);
+    epoch assignment state does NOT survive a dispatcher restart, so
+    elastic re-sharding degrades to the configured client fault policy
+    then.
+
+    Binds loopback by default (the StatusServer hardening pattern): pass
+    ``host="0.0.0.0"`` only on a trusted cluster network.
     """
 
-    def __init__(self, port: int = 0):
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        *,
+        worker_timeout_s: float = DEFAULT_WORKER_TIMEOUT_S,
+    ):
         self._lock = threading.Lock()
+        self._worker_timeout_s = float(worker_timeout_s)
         # addr -> {"shard": int, "last_seen": float}
         self._workers: dict[str, dict] = {}
+        # epoch -> {"num_shards", "gen", "splits": {int: {"addr", "skip"}}}
+        self._epochs: dict[str, dict] = {}
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -123,30 +207,41 @@ class DispatchServer:
                 try:
                     req, _ = _recv_msg(self.request)
                     _send_msg(self.request, outer._handle(req))
-                except (ConnectionError, json.JSONDecodeError):
+                except (ConnectionError, json.JSONDecodeError, OSError):
                     pass
 
         self._server = socketserver.ThreadingTCPServer(
-            ("0.0.0.0", port), Handler, bind_and_activate=True
+            (host, port), Handler, bind_and_activate=True
         )
         self._server.daemon_threads = True
+        self.host = host
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="dtf-dispatcher", daemon=True
         )
         self._thread.start()
-        logger.info("data-service dispatcher on port %d", self.port)
+        logger.info("data-service dispatcher on %s:%d", host, self.port)
 
     def _evict_stale(self, now: float) -> None:
         stale = [
             a
             for a, w in self._workers.items()
-            if now - w["last_seen"] >= _WORKER_TIMEOUT_S
+            if now - w["last_seen"] >= self._worker_timeout_s
         ]
         for a in stale:
             logger.warning("data worker %s timed out; freeing shard %d",
                            a, self._workers[a]["shard"])
             del self._workers[a]
+
+    @staticmethod
+    def _epoch_view(ep: dict) -> dict:
+        return {
+            "num_shards": ep["num_shards"],
+            "gen": ep["gen"],
+            "splits": {
+                str(s): dict(v) for s, v in sorted(ep["splits"].items())
+            },
+        }
 
     def _handle(self, req: dict) -> dict:
         kind = req.get("kind")
@@ -181,10 +276,96 @@ class DispatchServer:
                         a: w["shard"] for a, w in self._workers.items()
                     },
                 }
+            if kind == "start_epoch":
+                epoch = str(req.get("epoch", 0))
+                ep = self._epochs.get(epoch)
+                if ep is None:
+                    if not self._workers:
+                        return {"ok": False, "error": "no data workers"}
+                    ordered = sorted(
+                        self._workers, key=lambda a: self._workers[a]["shard"]
+                    )
+                    ep = {
+                        "num_shards": len(ordered),
+                        "gen": 0,
+                        "splits": {
+                            i: {"addr": a, "skip": 0}
+                            for i, a in enumerate(ordered)
+                        },
+                    }
+                    self._epochs[epoch] = ep
+                    while len(self._epochs) > _MAX_TRACKED_EPOCHS:
+                        self._epochs.pop(next(iter(self._epochs)))
+                return {"ok": True, **self._epoch_view(ep)}
+            if kind == "get_assignments":
+                ep = self._epochs.get(str(req.get("epoch", 0)))
+                if ep is None:
+                    return {"ok": False, "error": "unknown epoch"}
+                return {"ok": True, **self._epoch_view(ep)}
+            if kind == "report_worker_failure":
+                return self._reshard_locked(req)
             return {"ok": False, "error": f"unknown rpc {kind!r}"}
 
+    def _reshard_locked(self, req: dict) -> dict:
+        """Evict a client-reported dead worker and hand its splits (with
+        delivered-batch skip counts) to survivors under a new generation.
+
+        With ``split`` in the request only THAT split moves — the protocol
+        the streaming client uses: each split's own fetcher reports its
+        own cumulative count, so a sibling fetcher mid-decode can never
+        have its count snapshotted one batch short (which would deliver
+        that batch twice).  Without ``split``, all of the dead worker's
+        splits move at once using the supplied count map."""
+        epoch = str(req.get("epoch", 0))
+        addr = req.get("addr")
+        received = req.get("received") or {}
+        ep = self._epochs.get(epoch)
+        if ep is None:
+            return {
+                "ok": False,
+                "error": f"unknown epoch {epoch!r} (dispatcher restarted?)",
+            }
+        self._workers.pop(addr, None)
+        if req.get("split") is not None:
+            orphans = [int(req["split"])]
+            if ep["splits"].get(orphans[0], {}).get("addr") != addr:
+                # already moved (e.g. a full-worker report raced in) —
+                # idempotent success with the current view
+                return {"ok": True, "moved": [], **self._epoch_view(ep)}
+        else:
+            orphans = sorted(
+                s for s, a in ep["splits"].items() if a["addr"] == addr
+            )
+        if orphans:
+            survivors = sorted(
+                self._workers, key=lambda a: self._workers[a]["shard"]
+            )
+            if not survivors:
+                return {
+                    "ok": False,
+                    "error": (
+                        f"no surviving workers to take over splits {orphans}"
+                    ),
+                }
+            ep["gen"] += 1
+            for i, split in enumerate(orphans):
+                # The client's cumulative delivered count is authoritative;
+                # a split it never pulled from keeps its prior skip.
+                skip = received.get(str(split), ep["splits"][split]["skip"])
+                ep["splits"][split] = {
+                    "addr": survivors[i % len(survivors)],
+                    "skip": int(skip),
+                }
+            logger.warning(
+                "data worker %s reported dead; splits %s resharded to "
+                "%d survivor(s) (epoch %s gen %d)",
+                addr, orphans, len(survivors), epoch, ep["gen"],
+            )
+        return {"ok": True, "moved": orphans, **self._epoch_view(ep)}
+
     def target(self) -> str:
-        return f"127.0.0.1:{self.port}"
+        host = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
+        return f"{host}:{self.port}"
 
     def stop(self) -> None:
         self._server.shutdown()
@@ -194,13 +375,36 @@ class DispatchServer:
 # --- worker -----------------------------------------------------------------
 
 
+class _IterSlot:
+    """One (epoch, gen, split) iterator: built lazily (skip draining runs
+    under the per-slot lock, not the worker-global one)."""
+
+    __slots__ = ("factory", "lock", "num_shards", "it")
+
+    def __init__(self, factory, num_shards: int):
+        self.factory = factory
+        self.lock = threading.Lock()
+        self.num_shards = num_shards
+        self.it = None
+
+    def ensure(self) -> Iterator[Batch]:
+        if self.it is None:
+            self.it = self.factory()
+        return self.it
+
+
 class WorkerServer:
     """Runs the input pipeline; serves batches (reference `server_lib.py:349`).
 
     ``input_fn(shard_index, num_shards_hint)`` builds the batch iterator.
-    ``num_shards_hint`` is the pool size at epoch start — with
-    distributed_epoch sharding each worker reads only its ``shard_index``-th
-    slice of the files.
+    A connection is served in a loop, so a streaming client pipelines any
+    number of ``get_next`` requests over one socket; a v1 client that
+    closes after one response ends the loop via EOF.
+
+    Binds ``host`` (loopback by default — the StatusServer hardening
+    pattern) and advertises ``advertise_host or host`` to the dispatcher;
+    pass ``advertise_host`` when binding ``0.0.0.0``.  ``wire_crc=True``
+    adds a CRC32C to every raw-wire batch (native layer permitting).
     """
 
     def __init__(
@@ -210,33 +414,79 @@ class WorkerServer:
         *,
         port: int = 0,
         host: str = "127.0.0.1",
+        advertise_host: str | None = None,
         pool_size_hint: int | None = None,
+        heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S,
+        wire_crc: bool = False,
+        max_cached_epochs: int = _MAX_CACHED_EPOCHS,
     ):
         self._dispatcher = dispatcher
         self._input_fn = input_fn
-        self._lock = threading.Lock()  # guards _iters/_epoch_locks/shard_index
-        # epoch -> (iterator, per-epoch lock, num_shards it was built for).
-        # Per-epoch locking: requests for different epochs (or the
-        # iterator-creation fast path) don't serialize the whole worker
-        # behind one long next(it).
-        self._iters: dict[str, tuple[Iterator[Batch], threading.Lock, int]] = {}
+        self._heartbeat_interval_s = float(heartbeat_interval_s)
+        self._wire_crc = bool(wire_crc)
+        self._max_cached_epochs = max(1, int(max_cached_epochs))
+        self._lock = threading.Lock()  # guards _iters/_epoch_order/shard_index
+        # (epoch, gen, split) -> _IterSlot
+        self._iters: dict[tuple[str, int, int], _IterSlot] = {}
+        self._epoch_order: list[str] = []
+        # Epochs whose slots were dropped (cache pruning or a dispatcher-
+        # restart shard move).  Requests for them must be REFUSED: the
+        # stream-start `skip` frozen into a client's pipelined requests
+        # predates the drop, so silently rebuilding the iterator would
+        # re-serve batches the client already counted — duplicated data
+        # with exactly-once still claimed.  Insertion-ordered and bounded
+        # (dict-as-ordered-set): a long-lived worker must not grow with
+        # restart count, and a client stale past ~1k retirements is gone.
+        self._retired_epochs: dict[str, None] = {}
+        self._m_served = _counter(
+            "data_service_batches_served_total",
+            "batches this data worker put on the wire",
+        )
+        # Live connections, so kill() can sever in-flight streams (the
+        # listening socket alone leaves established handlers serving).
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
                 try:
-                    req, _ = _recv_msg(self.request)
-                    header, data = outer._handle(req)
-                    _send_msg(self.request, header, data)
-                except (ConnectionError, json.JSONDecodeError):
+                    while True:  # persistent connection: loop until EOF
+                        req, _ = _recv_msg(self.request)
+                        try:
+                            header, data = outer._handle(req)
+                        except Exception as e:
+                            # A request that fails (input_fn raised, batch
+                            # not wire-encodable, bad wire value) must be
+                            # ANSWERED, not die with the connection: a
+                            # severed stream reads as worker death, and an
+                            # elastic client would evict this healthy
+                            # worker and cascade the same deterministic
+                            # failure across every takeover.
+                            logger.exception(
+                                "data worker %s: request failed", outer.addr
+                            )
+                            header, data = {
+                                "ok": False,
+                                "error": f"{type(e).__name__}: {e}",
+                            }, None
+                        _send_msg(self.request, header, data)
+                except (ConnectionError, json.JSONDecodeError, OSError):
                     pass
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
 
         self._server = socketserver.ThreadingTCPServer(
-            ("0.0.0.0", port), Handler, bind_and_activate=True
+            (host, port), Handler, bind_and_activate=True
         )
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
-        self.addr = f"{host}:{self.port}"
+        if advertise_host is None:
+            advertise_host = socket.gethostname() if host == "0.0.0.0" else host
+        self.addr = f"{advertise_host}:{self.port}"
         self._pool_size_hint = pool_size_hint
 
         resp = _rpc(dispatcher, {"kind": "register_worker", "addr": self.addr})
@@ -264,7 +514,7 @@ class WorkerServer:
         )
 
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(_HEARTBEAT_INTERVAL_S):
+        while not self._stop.wait(self._heartbeat_interval_s):
             try:
                 resp, _ = _rpc(
                     self._dispatcher,
@@ -290,56 +540,138 @@ class WorkerServer:
                                 self.addr, self.shard_index, new_shard,
                             )
                             self.shard_index = new_shard
+                            for old in self._epoch_order:
+                                self._retire_epoch_locked(old)
                             self._iters.clear()
+                            self._epoch_order.clear()
             except OSError:
                 logger.warning("data worker %s: dispatcher unreachable", self.addr)
+
+    def _retire_epoch_locked(self, epoch: str) -> None:
+        self._retired_epochs[epoch] = None
+        while len(self._retired_epochs) > 1024:
+            self._retired_epochs.pop(next(iter(self._retired_epochs)))
+
+    def _prune_epochs_locked(self, epoch: str) -> None:
+        if epoch in self._epoch_order:
+            return
+        self._epoch_order.append(epoch)
+        while len(self._epoch_order) > self._max_cached_epochs:
+            old = self._epoch_order.pop(0)
+            self._retire_epoch_locked(old)
+            for key in [k for k in self._iters if k[0] == old]:
+                del self._iters[key]
 
     def _handle(self, req: dict) -> tuple[dict, bytes | None]:
         if req.get("kind") != "get_next":
             return {"ok": False, "error": "unknown rpc"}, None
         epoch = str(req.get("epoch", 0))
+        gen = int(req.get("gen", 0))
         num_shards = int(req.get("num_shards") or self._pool_size_hint or 1)
+        skip = int(req.get("skip", 0))
+        wire_fmt = str(req.get("wire", "npz"))
+        split = req.get("split")
         with self._lock:
-            # A worker evicted by heartbeat timeout that re-registered may
-            # hold a shard index outside the client's num_shards snapshot
-            # (the pool grew past it); serving input_fn(shard, num_shards)
-            # then would overlap another worker's slice and break the
-            # exactly-once epoch guarantee.  Refuse instead.
-            if self.shard_index >= num_shards:
+            if epoch in self._retired_epochs:
                 return {
                     "ok": False,
                     "error": (
-                        f"shard {self.shard_index} >= num_shards "
-                        f"{num_shards}: worker pool changed since the "
-                        "client snapshotted it"
+                        f"epoch {epoch} was retired on this worker (cache "
+                        "pruned past it or the shard moved); its iterators "
+                        "cannot be rebuilt without re-serving delivered "
+                        "batches"
                     ),
                 }, None
-            entry = self._iters.get(epoch)
+            if split is None:
+                # v1 client: serve this worker's registered shard.  A
+                # worker evicted by heartbeat timeout that re-registered
+                # may hold a shard index outside the client's num_shards
+                # snapshot; serving it would overlap another worker's
+                # slice.  Refuse instead.
+                if self.shard_index >= num_shards:
+                    return {
+                        "ok": False,
+                        "error": (
+                            f"shard {self.shard_index} >= num_shards "
+                            f"{num_shards}: worker pool changed since the "
+                            "client snapshotted it"
+                        ),
+                    }, None
+                split = self.shard_index
+            split = int(split)
+            key = (epoch, gen, split)
+            entry = self._iters.get(key)
             if entry is None:
-                entry = (
-                    self._input_fn(self.shard_index, num_shards),
-                    threading.Lock(),
+                entry = _IterSlot(
+                    self._make_iter_factory(split, num_shards, skip),
                     num_shards,
                 )
-                self._iters[epoch] = entry
-            elif entry[2] != num_shards:
+                self._iters[key] = entry
+                self._prune_epochs_locked(epoch)
+            elif entry.num_shards != num_shards:
                 # Cached iterator was built for a different pool snapshot;
                 # its slice doesn't partition cleanly under this client's
                 # num_shards.
                 return {
                     "ok": False,
                     "error": (
-                        f"epoch {epoch} iterator built with num_shards="
-                        f"{entry[2]}, request has {num_shards}"
+                        f"epoch {epoch} gen {gen} split {split} iterator "
+                        f"built with num_shards={entry.num_shards}, "
+                        f"request has {num_shards}"
                     ),
                 }, None
-        it, epoch_lock, _ = entry
-        with epoch_lock:  # iterators aren't thread-safe; serialize per epoch
+        with entry.lock:  # iterators aren't thread-safe; serialize per slot
             try:
-                batch = next(it)
+                batch = next(entry.ensure())
             except StopIteration:
-                return {"ok": True, "eof": True}, None
-        return {"ok": True, "eof": False}, encode_batch(batch)
+                return {"ok": True, "eof": True, "split": split}, None
+        self._m_served.inc()
+        return (
+            {"ok": True, "eof": False, "split": split},
+            encode_batch(batch, wire=wire_fmt, crc=self._wire_crc),
+        )
+
+    def _make_iter_factory(self, split: int, num_shards: int, skip: int):
+        def factory() -> Iterator[Batch]:
+            it = self._input_fn(split, num_shards)
+            for i in range(skip):
+                # Elastic takeover: fast-forward past batches the dead
+                # worker already delivered (deterministic input_fn).
+                try:
+                    next(it)
+                except StopIteration:
+                    logger.warning(
+                        "split %d exhausted after %d/%d skip batches",
+                        split, i, skip,
+                    )
+                    return iter(())
+            if skip:
+                logger.info(
+                    "data worker %s took over split %d (skipped %d "
+                    "delivered batches)", self.addr, split, skip,
+                )
+            return it
+
+        return factory
+
+    def kill(self) -> None:
+        """Tear down WITHOUT deregistering — a simulated crash (tests /
+        chaos): established streams are severed mid-flight and the
+        dispatcher learns via heartbeat timeout or a client failure
+        report."""
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        with self._conns_lock:
+            for s in list(self._conns):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
 
     def stop(self) -> None:
         self._stop.set()
@@ -358,55 +690,372 @@ class WorkerServer:
 # --- client -----------------------------------------------------------------
 
 
-class DataServiceClient:
-    """Round-robin batch puller over the live worker pool.
+class _WorkerRefusal(RuntimeError):
+    """Worker answered but refused the request (pool-snapshot mismatch)."""
 
-    One epoch = every worker's shard drained to EOF.  ``ignore_errors``
-    controls mid-epoch worker death: True drops the dead worker's remaining
-    data (dynamic-pool semantics), False raises.
+
+class DataServiceClient:
+    """Streaming batch puller over the live worker pool.
+
+    One epoch = every split of the dispatcher's epoch snapshot drained to
+    EOF.  ``protocol="streaming"`` (default) keeps one persistent
+    connection + fetcher thread per split with a pipelined credit window;
+    ``protocol="per_connection"`` is the v1 blocking round-robin (one TCP
+    connection and one full round-trip per batch) kept as the measurable
+    baseline (bench_input.py) and for v1 workers.
+
+    Fault policy on mid-epoch worker death:
+
+    - ``elastic=True`` (default, streaming only): report the death to the
+      dispatcher, which re-assigns the dead worker's splits to survivors
+      with delivered-batch skip counts — the epoch completes exactly-once.
+    - ``elastic=False, ignore_errors=True``: drop the dead worker's
+      remaining data (the reference's dynamic-pool semantics).
+    - ``elastic=False, ignore_errors=False``: raise ``ConnectionError``.
+
+    ``window`` is the per-split credit window (outstanding pipelined
+    requests); with ``adaptive_window=True`` it autotunes between 1 and
+    ``max_window`` from consumer blocking time, bounded by
+    ``bytes_budget`` (see :class:`data.AdaptiveDepthController`).
     """
+
+    _DONE = object()
+    _ERR = object()
 
     def __init__(
         self,
         dispatcher: str,
         *,
-        epoch: int = 0,
+        epoch: int | str = 0,
         ignore_errors: bool = False,
+        elastic: bool = True,
+        protocol: str = "streaming",
+        wire: str = "raw",
+        window: int = 2,
+        adaptive_window: bool = True,
+        max_window: int = 8,
+        bytes_budget: int | None = None,
+        buffer_batches: int | None = None,
         wait_for_workers_s: float = 30.0,
         get_next_timeout_s: float = 120.0,
     ):
+        if protocol not in PROTOCOLS:
+            raise ValueError(f"unknown protocol {protocol!r} ({PROTOCOLS})")
+        if wire not in WIRE_FORMATS:
+            raise ValueError(f"unknown wire {wire!r} ({WIRE_FORMATS})")
         self._dispatcher = dispatcher
-        self._epoch = epoch
+        self._epoch = str(epoch)
         self._ignore_errors = ignore_errors
+        self._protocol = protocol
+        self._elastic = elastic and protocol == "streaming"
+        self._wire = wire
         self._timeout = get_next_timeout_s
+        self._window = max(1, int(window))
+
+        # metric handles resolved once (hot-path discipline)
+        self._m_batches = _counter(
+            "data_batches_total", "batches handed to the consumer"
+        )
+        self._m_wait = _histogram(
+            "data_service_client_wait_seconds",
+            "consumer blocking time per data-service batch",
+        )
+        self._m_fetch = _histogram(
+            "data_service_fetch_seconds",
+            "per-worker wire time per pipelined batch response",
+        )
+        self._m_dropped = _counter(
+            "data_service_workers_dropped_total",
+            "data workers dropped from this client's pool",
+        )
+        self._m_resharded = _counter(
+            "data_service_resharded_splits_total",
+            "splits elastically re-assigned after a worker death",
+        )
+
         deadline = time.monotonic() + wait_for_workers_s
-        self._workers: list[str] = []
+        resp: dict = {}
         while time.monotonic() < deadline:
             try:
-                resp, _ = _rpc(dispatcher, {"kind": "get_workers"}, timeout=5.0)
+                resp, _ = _rpc(
+                    dispatcher,
+                    {"kind": "start_epoch", "epoch": self._epoch},
+                    timeout=5.0,
+                )
             except OSError:
                 # Dispatcher still starting up — that's what the grace
                 # window is for.
                 time.sleep(0.2)
                 continue
-            self._workers = sorted(
-                resp.get("workers", {}), key=lambda a: resp["workers"][a]
-            )
-            if self._workers:
+            if resp.get("ok"):
                 break
             time.sleep(0.2)
-        if not self._workers:
+        if not resp.get("ok"):
             raise TimeoutError("no data workers registered")
-        self._num_shards = len(self._workers)
-        self._live = list(self._workers)
-        self._rr = 0
+        self._num_shards = int(resp["num_shards"])
+        self._gen = int(resp["gen"])
+        self._assignments: dict[int, dict] = {
+            int(s): dict(v) for s, v in resp["splits"].items()
+        }
+        self._received: dict[int, int] = {s: 0 for s in self._assignments}
+        self._dead_workers: set[str] = set()
+        self._reshard_lock = threading.Lock()
+        self._err: BaseException | None = None
+        self._closed = False
+        self._finished = False
+
+        if protocol == "per_connection":
+            # v1 path: blocking round-robin, no threads.  _rr indexes the
+            # CURRENT live list (clamped on every shrink), so dropping a
+            # worker can no longer skew rotation order.
+            self._live = [
+                self._assignments[s]["addr"]
+                for s in sorted(self._assignments)
+            ]
+            self._rr = 0
+            return
+
+        self._controller = (
+            AdaptiveDepthController(
+                initial=self._window,
+                min_depth=1,
+                max_depth=max_window,
+                bytes_budget=bytes_budget,
+                component="client",
+            )
+            if adaptive_window
+            else None
+        )
+        n = max(1, len(self._assignments))
+        self._q: queue.Queue = queue.Queue(
+            maxsize=buffer_batches or max(4, 2 * n)
+        )
+        self._pending = n  # fetchers still running
+        self._pending_lock = threading.Lock()
+        self._fetchers = [
+            threading.Thread(
+                target=self._fetch_loop,
+                args=(split,),
+                name=f"dtf-data-fetch-{split}",
+                daemon=True,
+            )
+            for split in sorted(self._assignments)
+        ]
+        for t in self._fetchers:
+            t.start()
+
+    # -- streaming fetchers ---------------------------------------------------
+
+    def _window_depth(self) -> int:
+        return self._controller.depth if self._controller else self._window
+
+    def _buffer_put(self, item) -> bool:
+        """Bounded put that re-checks close, so a consumer that stops
+        popping can never wedge a fetcher forever."""
+        while not self._closed:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fail(self, err: BaseException) -> None:
+        self._err = err
+        self._buffer_put(self._ERR)
+
+    def _fetch_loop(self, split: int) -> None:
+        try:
+            while not self._closed:
+                with self._reshard_lock:
+                    asg = dict(self._assignments[split])
+                    gen = self._gen
+                addr = asg["addr"]
+                try:
+                    self._stream_split(split, addr, asg["skip"], gen)
+                    return  # EOF: split fully delivered
+                except _WorkerRefusal as e:
+                    # Config-level refusal (pool-snapshot mismatch), not a
+                    # death — re-sharding can't fix it.
+                    if self._ignore_errors:
+                        self._m_dropped.inc()
+                        logger.warning("dropping data worker %s: %s", addr, e)
+                        return
+                    self._fail(RuntimeError(str(e)))
+                    return
+                except (OSError, ConnectionError, wirelib.WireError) as e:
+                    if not self._handle_stream_failure(split, addr, e):
+                        return
+        except BaseException as e:  # pragma: no cover - belt and braces
+            self._fail(e)
+        finally:
+            with self._pending_lock:
+                self._pending -= 1
+                last = self._pending == 0
+            if last:
+                self._buffer_put(self._DONE)
+
+    def _stream_split(self, split: int, addr: str, skip: int, gen: int) -> None:
+        """Pipelined pull of one split over one persistent connection."""
+        request = {
+            "kind": "get_next",
+            "epoch": self._epoch,
+            "split": split,
+            "num_shards": self._num_shards,
+            "skip": skip,
+            "gen": gen,
+            "wire": self._wire,
+        }
+        host, port = addr.rsplit(":", 1)
+        with socket.create_connection(
+            (host, int(port)), timeout=self._timeout
+        ) as s:
+            s.settimeout(self._timeout)
+            outstanding = 0
+            while not self._closed:
+                # Credit window: keep W get_nexts on the wire.  Requests
+                # are tiny JSON frames; the responses stream back in order
+                # on the same socket while we decode/enqueue.
+                target = max(1, self._window_depth())
+                while outstanding < target:
+                    _send_msg(s, request)
+                    outstanding += 1
+                t0 = time.perf_counter()
+                header, data = _recv_msg(s)
+                self._m_fetch.observe(time.perf_counter() - t0, worker=addr)
+                outstanding -= 1
+                if not header.get("ok"):
+                    raise _WorkerRefusal(
+                        f"data worker {addr}: {header.get('error')}"
+                    )
+                if header.get("eof"):
+                    # In-flight requests beyond EOF answer eof too; the
+                    # socket just closes under them.
+                    return
+                batch = decode_batch(data)
+                # Exactly-once accounting: count only fully-received,
+                # decoded batches — a response torn mid-wire is refetched
+                # by the takeover worker, a counted one never is.
+                with self._reshard_lock:
+                    self._received[split] += 1
+                if self._controller:
+                    self._controller.note_bytes(wirelib.tensor_bytes(batch))
+                if not self._buffer_put((split, batch)):
+                    return
+
+    def _handle_stream_failure(
+        self, split: int, addr: str, err: BaseException
+    ) -> bool:
+        """True = assignment refreshed, retry the split; False = stop."""
+        with self._reshard_lock:
+            if self._assignments[split]["addr"] != addr:
+                return True  # assignment already refreshed elsewhere
+            # Snapshot ONLY this fetcher's split with ONLY its own count:
+            # a sibling fetcher of the same dead worker may be holding a
+            # decoded-but-not-yet-counted batch, and a whole-worker report
+            # would snapshot its count one short (delivering that batch
+            # twice after takeover).
+            count = int(self._received[split])
+        if self._elastic:
+            # The RPC runs OUTSIDE the lock: holding it across a blocking
+            # (up to 10 s) dispatcher round-trip would stall every healthy
+            # fetcher at its per-batch count increment.
+            try:
+                resp, _ = _rpc(
+                    self._dispatcher,
+                    {
+                        "kind": "report_worker_failure",
+                        "epoch": self._epoch,
+                        "addr": addr,
+                        "split": split,
+                        "received": {str(split): count},
+                    },
+                    timeout=10.0,
+                )
+            except OSError as e:
+                resp = {"ok": False, "error": f"dispatcher unreachable: {e}"}
+            if resp.get("ok"):
+                with self._reshard_lock:
+                    # Concurrent reports interleave; only move forward (a
+                    # lower-gen response must not roll assignments back).
+                    if int(resp["gen"]) >= self._gen:
+                        self._gen = int(resp["gen"])
+                        self._assignments = {
+                            int(s): dict(v)
+                            for s, v in resp["splits"].items()
+                        }
+                    if addr not in self._dead_workers:
+                        self._dead_workers.add(addr)
+                        self._m_dropped.inc()
+                    gen = self._gen
+                moved = resp.get("moved", [])
+                self._m_resharded.inc(len(moved))
+                _record_event(
+                    "data_reshard",
+                    worker=addr,
+                    splits=len(moved),
+                    gen=gen,
+                    epoch=self._epoch,
+                )
+                logger.warning(
+                    "data worker %s died mid-epoch (%s); splits %s "
+                    "resharded at gen %d",
+                    addr, err, moved, gen,
+                )
+                return True
+            logger.warning(
+                "elastic reshard for %s failed: %s",
+                addr, resp.get("error"),
+            )
+        if self._ignore_errors:
+            self._m_dropped.inc()
+            logger.warning(
+                "dropping dead data worker %s (split %d remainder lost)",
+                addr, split,
+            )
+            return False
+        e = ConnectionError(f"data worker {addr} died mid-epoch")
+        e.__cause__ = err
+        self._fail(e)
+        return False
+
+    # -- consumer -------------------------------------------------------------
 
     def __iter__(self) -> Iterator[Batch]:
         return self
 
     def __next__(self) -> Batch:
+        if self._protocol == "per_connection":
+            return self._next_per_connection()
+        if self._finished:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        t0 = time.perf_counter()
+        try:
+            item = self._q.get(timeout=self._timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"no batch from the data service within {self._timeout}s"
+            ) from None
+        wait = time.perf_counter() - t0
+        self._m_wait.observe(wait)
+        if self._controller:
+            self._controller.observe_wait(wait)
+        if item is self._ERR or item is self._DONE:
+            self._finished = True
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        _split, batch = item
+        self._m_batches.inc()
+        return batch
+
+    def _next_per_connection(self) -> Batch:
         while self._live:
-            addr = self._live[self._rr % len(self._live)]
+            if self._rr >= len(self._live):
+                self._rr = 0
+            addr = self._live[self._rr]
             try:
                 header, data = _rpc(
                     addr,
@@ -414,6 +1063,7 @@ class DataServiceClient:
                         "kind": "get_next",
                         "epoch": self._epoch,
                         "num_shards": self._num_shards,
+                        "wire": self._wire,
                     },
                     timeout=self._timeout,
                 )
@@ -423,6 +1073,7 @@ class DataServiceClient:
                         f"data worker {addr} died mid-epoch"
                     ) from e
                 logger.warning("dropping dead data worker %s", addr)
+                self._m_dropped.inc()
                 self._live.remove(addr)
                 continue
             if not header.get("ok"):
@@ -435,11 +1086,47 @@ class DataServiceClient:
                 logger.warning(
                     "dropping data worker %s: %s", addr, header.get("error")
                 )
+                self._m_dropped.inc()
                 self._live.remove(addr)
                 continue
             if header.get("eof"):
                 self._live.remove(addr)
                 continue
-            self._rr += 1
+            self._rr = (self._rr + 1) % len(self._live)
+            self._m_batches.inc()
             return decode_batch(data)
         raise StopIteration
+
+    def received_counts(self) -> dict[int, int]:
+        """Cumulative fully-received batches per split (the exactly-once
+        ledger the elastic re-shard skip counts come from)."""
+        if self._protocol == "per_connection":
+            return {}
+        with self._reshard_lock:
+            return dict(self._received)
+
+    def close(self) -> None:
+        """Stop fetcher threads and release buffered batches."""
+        if self._protocol == "per_connection":
+            return
+        self._closed = True
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        # The drain above may have discarded the DONE sentinel; re-arm it
+        # so a consumer blocked in __next__ wakes NOW instead of sitting
+        # out the full get_next_timeout_s.
+        try:
+            self._q.put_nowait(self._DONE)
+        except queue.Full:  # pragma: no cover - queue was just drained
+            pass
+        for t in self._fetchers:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

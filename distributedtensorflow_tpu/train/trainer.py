@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..data.adaptive import input_record_fields
 from ..utils.metrics import MetricWriter, ThroughputMeter
 from .state import TrainState
 
@@ -661,6 +662,9 @@ class Trainer:
                     mem_snap = obs.memory.collect()
                     last_metrics.update(obs.memory.record_fields(mem_snap))
                     last_metrics.update(obs.memory.train_state_record_fields())
+                    # live input-plane depths (adaptive prefetch / credit
+                    # window) ride every logged record
+                    last_metrics.update(input_record_fields())
                     obs.memory.update_registry(snapshot=mem_snap)
                     breakdown = self._window_breakdown(step_next)
                     last_metrics.update(breakdown)

@@ -48,6 +48,7 @@ _PROCESS_TEST_FILES = {
     "test_train_chaos_smoke.py",
     "test_train_zero_smoke.py",
     "test_train_quant_smoke.py",
+    "test_train_data_service_smoke.py",
     "test_serve_smoke.py",
 }
 

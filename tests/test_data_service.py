@@ -2,8 +2,13 @@
 
 Reference model: SURVEY.md §2.3 "tf.data service" — dispatcher + worker
 pool + client, distributed_epoch sharding, dynamic worker-pool fault
-semantics.
+semantics.  ISSUE 9 adds the streaming protocol (persistent pipelined
+connections + credit window), the raw tensor wire, and elastic
+re-sharding (mid-epoch worker death loses zero records).
 """
+
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -14,6 +19,7 @@ from distributedtensorflow_tpu.data import (
     WorkerServer,
 )
 from distributedtensorflow_tpu.data.service import decode_batch, encode_batch
+from distributedtensorflow_tpu.obs.registry import counter as obs_counter
 
 
 def _sharded_input_fn(n_total=24, batch=2):
@@ -84,20 +90,21 @@ def test_separate_epochs_restart_iteration(dispatcher):
         w.stop()
 
 
-def test_worker_death_raises_by_default(dispatcher):
+def test_worker_death_raises_when_not_elastic(dispatcher):
     workers = [
         WorkerServer(dispatcher.target(), _sharded_input_fn(96), port=0)
         for _ in range(2)
     ]
-    client = DataServiceClient(dispatcher.target())
+    client = DataServiceClient(dispatcher.target(), elastic=False)
     next(client)  # pool is live
-    workers[0].stop()
-    dead = workers.pop(0)
+    workers[0].kill()
+    workers.pop(0)
     try:
         with pytest.raises(ConnectionError):
             for _ in range(200):
                 next(client)
     finally:
+        client.close()
         for w in workers:
             w.stop()
 
@@ -107,9 +114,11 @@ def test_worker_death_ignored_when_configured(dispatcher):
         WorkerServer(dispatcher.target(), _sharded_input_fn(96), port=0)
         for _ in range(2)
     ]
-    client = DataServiceClient(dispatcher.target(), ignore_errors=True)
+    client = DataServiceClient(
+        dispatcher.target(), elastic=False, ignore_errors=True
+    )
     first = next(client)
-    workers[0].stop()
+    workers[0].kill()
     survivor_shard = workers[1].shard_index
     try:
         rest = list(client)
@@ -118,7 +127,183 @@ def test_worker_death_ignored_when_configured(dispatcher):
         survivor_ids = set(np.arange(96)[survivor_shard::2].tolist())
         assert survivor_ids.issubset(set(got.tolist()))
     finally:
+        client.close()
         workers[1].stop()
+
+
+def test_elastic_reshard_loses_zero_records(dispatcher):
+    """THE exactly-once acceptance: a worker killed mid-epoch loses no
+    records — the dispatcher re-assigns its unread range (minus the
+    batches the client already counted) to survivors, and every record
+    arrives exactly once across the epoch."""
+    n_total = 240
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(n_total), port=0)
+        for _ in range(3)
+    ]
+    dropped = obs_counter("data_service_workers_dropped_total")
+    resharded = obs_counter("data_service_resharded_splits_total")
+    d0, r0 = dropped.value(), resharded.value()
+    client = DataServiceClient(dispatcher.target(), window=2)
+    got = [next(client) for _ in range(6)]  # epoch under way on all splits
+    workers[0].kill()  # crash, not deregistration
+    try:
+        got += list(client)
+        ids = np.concatenate([b["id"] for b in got])
+        assert sorted(ids.tolist()) == list(range(n_total)), (
+            "elastic re-shard lost or duplicated records"
+        )
+        assert dropped.value() == d0 + 1
+        assert resharded.value() >= r0 + 1
+    finally:
+        client.close()
+        for w in workers[1:]:
+            w.stop()
+
+
+def test_elastic_reshard_chained_deaths(dispatcher):
+    """Two successive mid-epoch deaths: the generation counter keeps the
+    takeover iterators distinct and the epoch still delivers exactly
+    once."""
+    n_total = 240
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(n_total), port=0)
+        for _ in range(3)
+    ]
+    client = DataServiceClient(dispatcher.target(), window=2)
+    got = [next(client) for _ in range(4)]
+    workers[0].kill()
+    got += [next(client) for _ in range(4)]
+    workers[1].kill()
+    try:
+        got += list(client)
+        ids = np.concatenate([b["id"] for b in got])
+        assert sorted(ids.tolist()) == list(range(n_total))
+    finally:
+        client.close()
+        workers[2].stop()
+
+
+def test_elastic_with_no_survivors_raises(dispatcher):
+    w = WorkerServer(dispatcher.target(), _sharded_input_fn(96), port=0)
+    client = DataServiceClient(dispatcher.target(), get_next_timeout_s=30.0)
+    next(client)
+    w.kill()
+    try:
+        with pytest.raises(ConnectionError):
+            for _ in range(200):
+                next(client)
+    finally:
+        client.close()
+
+
+def test_credit_window_backpressure(dispatcher):
+    """A stalled consumer bounds worker-side production: at most
+    buffer + per-split window (+ one in-flight per fetcher) batches run
+    ahead of consumption."""
+    produced = []
+    lock = threading.Lock()
+
+    def counting_input_fn(shard, num_shards):
+        def gen():
+            for i in range(1000):
+                with lock:
+                    produced.append((shard, i))
+                yield {"id": np.array([shard * 1000 + i], np.int64)}
+        return gen()
+
+    window, buffer_batches = 3, 2
+    workers = [
+        WorkerServer(dispatcher.target(), counting_input_fn, port=0)
+        for _ in range(2)
+    ]
+    client = DataServiceClient(
+        dispatcher.target(), window=window, adaptive_window=False,
+        buffer_batches=buffer_batches,
+    )
+    try:
+        consumed = 2
+        for _ in range(consumed):
+            next(client)
+        time.sleep(1.0)  # consumer stalls; fetchers must hit the gate
+        with lock:
+            ahead = len(produced) - consumed
+        # per fetcher: window outstanding + 1 decoded awaiting buffer
+        # space; plus the shared buffer itself
+        bound = buffer_batches + 2 * (window + 1)
+        assert ahead <= bound, (
+            f"workers ran {ahead} batches ahead (bound {bound}): "
+            "credit window is not applying backpressure"
+        )
+    finally:
+        client.close()
+        for w in workers:
+            w.stop()
+
+
+def test_streaming_wire_formats_deliver_identical_batches(dispatcher):
+    w = WorkerServer(dispatcher.target(), _sharded_input_fn(), port=0)
+    try:
+        by_wire = {}
+        for i, wire_fmt in enumerate(("raw", "npz")):
+            client = DataServiceClient(
+                dispatcher.target(), epoch=i, wire=wire_fmt
+            )
+            by_wire[wire_fmt] = [b["id"] for b in client]
+            client.close()
+        np.testing.assert_array_equal(
+            np.concatenate(by_wire["raw"]), np.concatenate(by_wire["npz"])
+        )
+    finally:
+        w.stop()
+
+
+def test_per_connection_protocol_round_robin_bounded(dispatcher):
+    """The v1 baseline protocol still works, and _rr stays an index into
+    the LIVE list (no unbounded growth / rotation skew on shrink)."""
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(96), port=0)
+        for _ in range(3)
+    ]
+    client = DataServiceClient(
+        dispatcher.target(), protocol="per_connection",
+        elastic=False, ignore_errors=True,
+    )
+    try:
+        for _ in range(6):
+            next(client)
+        assert client._rr < 3
+        workers[1].kill()
+        drained = list(client)  # drops the dead worker, drains survivors
+        assert drained
+        assert client._rr == 0  # every live list is empty at exhaustion
+    finally:
+        for i, w in enumerate(workers):
+            if i != 1:
+                w.stop()
+
+
+def test_loopback_binds_and_ctor_knobs(dispatcher):
+    """Dispatcher/worker bind loopback by default (the StatusServer
+    hardening pattern); heartbeat/timeout are constructor knobs."""
+    assert dispatcher._server.server_address[0] == "127.0.0.1"
+    d = DispatchServer(port=0, worker_timeout_s=0.6)
+    w = WorkerServer(
+        d.target(), _sharded_input_fn(), port=0, heartbeat_interval_s=0.1
+    )
+    try:
+        assert w._server.server_address[0] == "127.0.0.1"
+        resp_workers = lambda: __import__(
+            "distributedtensorflow_tpu.data.service", fromlist=["_rpc"]
+        )._rpc(d.target(), {"kind": "get_workers"})[0]["workers"]
+        assert list(resp_workers()) == [w.addr]
+        w.kill()  # no deregistration: eviction must come from the timeout
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and resp_workers():
+            time.sleep(0.1)
+        assert resp_workers() == {}
+    finally:
+        d.stop()
 
 
 def test_client_times_out_with_no_workers(dispatcher):
@@ -201,3 +386,27 @@ def test_training_from_data_service(dispatcher):
     finally:
         for w in workers:
             w.stop()
+
+
+def test_worker_refuses_retired_epoch(dispatcher):
+    """A pruned epoch must be REFUSED, not silently rebuilt: a rebuilt
+    iterator would restart at the stream-start skip and re-serve batches
+    the client already counted (duplicates under a claimed exactly-once)."""
+    w = WorkerServer(
+        dispatcher.target(), _sharded_input_fn(), port=0,
+        max_cached_epochs=1,
+    )
+    try:
+        req = {"kind": "get_next", "epoch": "0", "gen": 0, "split": 0,
+               "num_shards": 1, "skip": 0, "wire": "raw"}
+        header, data = w._handle(req)
+        assert header["ok"] and not header["eof"]
+        # a new epoch evicts epoch 0 from the 1-entry cache
+        header, _ = w._handle(dict(req, epoch="1"))
+        assert header["ok"]
+        # epoch 0 is now retired: rebuilt iterators are refused
+        header, _ = w._handle(req)
+        assert not header["ok"]
+        assert "retired" in header["error"]
+    finally:
+        w.stop()

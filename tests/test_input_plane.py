@@ -1,0 +1,292 @@
+"""Input-plane unit tests: raw tensor wire format, adaptive depth
+controller, adaptive Prefetcher (ISSUE 9).
+
+Server-based coverage (streaming protocol, elastic re-shard, credit-window
+backpressure) lives in test_data_service.py; this file is threads-and-
+bytes only so it stays in the fast lane.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.data import wire
+from distributedtensorflow_tpu.data.adaptive import (
+    AdaptiveDepthController,
+    input_record_fields,
+)
+from distributedtensorflow_tpu.data.input_pipeline import Prefetcher
+from distributedtensorflow_tpu.data.recordio_dataset import (
+    decode_example,
+    encode_example,
+)
+from distributedtensorflow_tpu.data.service import decode_batch, encode_batch
+
+
+# --- raw wire format ---------------------------------------------------------
+
+
+def _assert_tree_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k].dtype == b[k].dtype, k
+        # shape asserted explicitly: assert_array_equal broadcasts, so a
+        # 0-d tensor decoded as (1,) would slip through it
+        assert a[k].shape == b[k].shape, k
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_wire_roundtrip_dtypes_and_shapes():
+    rng = np.random.default_rng(0)
+    batch = {
+        "f32": rng.normal(size=(4, 3)).astype(np.float32),
+        "f16": rng.normal(size=(2, 2, 2)).astype(np.float16),
+        "i64": np.arange(7, dtype=np.int64),
+        "u8": np.arange(5, dtype=np.uint8),
+        "bool": np.array([True, False, True]),
+        "scalar": np.array(3.5, dtype=np.float64),
+        "empty": np.zeros((0, 4), dtype=np.int32),
+    }
+    out = wire.decode_tensors(wire.encode_tensors(batch))
+    _assert_tree_equal(out, batch)
+
+
+def test_wire_preserves_key_order():
+    batch = {"b": np.zeros(2), "a": np.ones(3), "c": np.zeros(1)}
+    assert list(wire.decode_tensors(wire.encode_tensors(batch))) == [
+        "b", "a", "c",
+    ]
+
+
+def test_wire_noncontiguous_input():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    batch = {"x": a[::2, ::3]}  # strided view
+    out = wire.decode_tensors(wire.encode_tensors(batch))
+    np.testing.assert_array_equal(out["x"], a[::2, ::3])
+
+
+def test_wire_rejects_object_dtype():
+    with pytest.raises(wire.WireError):
+        wire.encode_tensors({"x": np.array([object()])})
+
+
+def test_wire_crc_roundtrip_and_corruption():
+    batch = {"x": np.arange(64, dtype=np.float32)}
+    enc = wire.encode_tensors(batch, crc=True)
+    if b'"crc"' not in enc[: len(enc) - batch["x"].nbytes]:
+        pytest.skip("native CRC32C unavailable in this environment")
+    np.testing.assert_array_equal(
+        wire.decode_tensors(enc)["x"], batch["x"]
+    )
+    # flip one payload byte -> checksum failure
+    bad = bytearray(enc)
+    bad[-1] ^= 0xFF
+    with pytest.raises(wire.WireError, match="CRC"):
+        wire.decode_tensors(bytes(bad))
+
+
+def test_wire_truncation_and_trailing_bytes_rejected():
+    enc = wire.encode_tensors({"x": np.arange(16, dtype=np.float32)})
+    with pytest.raises(wire.WireError):
+        wire.decode_tensors(enc[:-8])  # tensor overruns payload
+    with pytest.raises(wire.WireError, match="trailing"):
+        wire.decode_tensors(enc + b"\x00\x00")
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.decode_tensors(b"NOPE" + enc[4:])
+
+
+def test_decode_batch_sniffs_both_formats():
+    batch = {"x": np.arange(6, dtype=np.int32).reshape(2, 3)}
+    for fmt in ("npz", "raw"):
+        out = decode_batch(encode_batch(batch, fmt))
+        np.testing.assert_array_equal(out["x"], batch["x"])
+    with pytest.raises(ValueError):
+        encode_batch(batch, "protobuf")
+
+
+def test_record_example_codec_raw_default_npz_compat():
+    ex = {"input_ids": np.arange(9, dtype=np.int32)}
+    raw = encode_example(ex)
+    assert wire.is_raw(raw)
+    np.testing.assert_array_equal(
+        decode_example(raw)["input_ids"], ex["input_ids"]
+    )
+    legacy = encode_example(ex, wire="npz")
+    assert not wire.is_raw(legacy)
+    np.testing.assert_array_equal(
+        decode_example(legacy)["input_ids"], ex["input_ids"]
+    )
+
+
+# --- adaptive depth controller ----------------------------------------------
+
+
+def _ctl(**kw):
+    kw.setdefault("initial", 2)
+    kw.setdefault("interval", 4)
+    kw.setdefault("component", "prefetcher")
+    return AdaptiveDepthController(**kw)
+
+
+def test_controller_grows_while_consumer_blocks():
+    c = _ctl(max_depth=6)
+    for _ in range(8):
+        c.observe_wait(0.05)  # way above grow_wait_s
+    assert c.depth == 4  # two decision windows, +1 each
+
+
+def test_controller_shrinks_on_zero_waits():
+    c = _ctl(initial=5, max_depth=8)
+    for _ in range(8):
+        c.observe_wait(0.0)
+    assert c.depth == 3
+
+
+def test_controller_respects_bounds():
+    c = _ctl(initial=1, min_depth=1, max_depth=2)
+    for _ in range(40):
+        c.observe_wait(0.5)
+    assert c.depth == 2  # clamped at max
+    for _ in range(40):
+        c.observe_wait(0.0)
+    assert c.depth == 1  # clamped at min
+
+
+def test_controller_bytes_budget_caps_growth():
+    # budget admits exactly 3 batches of 1 MiB
+    c = _ctl(initial=2, max_depth=16, bytes_budget=3 * 2**20)
+    c.note_bytes(2**20)
+    for _ in range(40):
+        c.observe_wait(0.5)
+    assert c.depth == 3
+    # fatter batches shrink the cap immediately, without a wait window
+    c.note_bytes(40 * 2**20)
+    assert c.depth < 3
+
+
+def test_controller_validates_thresholds():
+    with pytest.raises(ValueError):
+        _ctl(grow_wait_s=1e-4, shrink_wait_s=1e-3)
+    with pytest.raises(ValueError):
+        _ctl(min_depth=0)
+
+
+def test_input_record_fields_exposes_live_depths():
+    _ctl(initial=3, component="prefetcher")
+    c = _ctl(initial=5, component="client")
+    fields = input_record_fields()
+    assert fields["data_prefetch_depth"] == 3.0
+    assert fields["data_client_window"] == 5.0
+    for _ in range(4):
+        c.observe_wait(0.5)
+    assert input_record_fields()["data_client_window"] == 6.0
+
+
+# --- adaptive Prefetcher -----------------------------------------------------
+
+
+def _mesh1():
+    import jax
+    from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=1), jax.devices()[:1])
+
+
+def test_prefetcher_starved_consumer_grows_depth():
+    mesh = _mesh1()
+
+    def slow_source():
+        for i in range(30):
+            time.sleep(0.02)  # producer-bound: the consumer will block
+            yield {"x": np.full((2, 2), i, np.float32)}
+
+    ctl = AdaptiveDepthController(
+        initial=2, max_depth=8, interval=4, component="prefetcher"
+    )
+    with Prefetcher(slow_source(), mesh, buffer_size=2,
+                    controller=ctl) as pf:
+        n = sum(1 for _ in pf)
+    assert n == 30
+    assert ctl.depth > 2, "starved consumer must grow the prefetch depth"
+
+
+def test_prefetcher_throttled_consumer_shrinks_depth():
+    mesh = _mesh1()
+
+    def fast_source():
+        for i in range(30):
+            yield {"x": np.full((2, 2), i, np.float32)}
+
+    ctl = AdaptiveDepthController(
+        initial=6, max_depth=8, interval=4, component="prefetcher"
+    )
+    with Prefetcher(fast_source(), mesh, buffer_size=6,
+                    controller=ctl) as pf:
+        n = 0
+        for _ in pf:
+            time.sleep(0.02)  # consumer-bound: waits are ~0
+            n += 1
+    assert n == 30
+    assert ctl.depth < 6, "throttled consumer must shrink the prefetch depth"
+
+
+def test_prefetcher_depth_within_bytes_budget():
+    mesh = _mesh1()
+    item = np.zeros((64, 64), np.float32)  # 16 KiB
+
+    def source():
+        for _ in range(40):
+            time.sleep(0.005)
+            yield {"x": item}
+
+    budget = 4 * item.nbytes
+    ctl = AdaptiveDepthController(
+        initial=2, max_depth=32, interval=4,
+        bytes_budget=budget, component="prefetcher",
+    )
+    with Prefetcher(source(), mesh, buffer_size=2, controller=ctl) as pf:
+        for _ in pf:
+            pass
+    assert ctl.depth <= 4, (
+        f"depth {ctl.depth} exceeds the bytes budget cap "
+        f"({budget} B / {item.nbytes} B per batch)"
+    )
+
+
+def test_prefetcher_fixed_depth_without_controller():
+    mesh = _mesh1()
+    out = list(Prefetcher(
+        ({"x": np.full((2,), i, np.float32)} for i in range(6)),
+        mesh, buffer_size=2,
+    ))
+    assert [int(b["x"][0]) for b in out] == list(range(6))
+
+
+def test_prefetcher_close_releases_source():
+    mesh = _mesh1()
+
+    class Source:
+        def __init__(self):
+            self.closed = False
+            self._it = iter(
+                {"x": np.full((2,), i, np.float32)} for i in range(100)
+            )
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return next(self._it)
+
+        def close(self):
+            self.closed = True
+
+    src = Source()
+    pf = Prefetcher(src, mesh, buffer_size=2)
+    next(iter(pf))
+    pf.close()
+    assert src.closed, (
+        "Prefetcher.close() must release the source (an open "
+        "DataServiceClient would leak fetcher threads per restart)"
+    )

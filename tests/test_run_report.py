@@ -367,3 +367,92 @@ def test_metrics_rows_validate_flattened_collective_ops(tmp_path):
     ])
     errors, _ = check_metrics_schema.check_file(str(p))
     assert len(errors) == 1 and "bogus" in errors[0]
+
+
+def test_report_input_plane_section(tmp_path, capsys):
+    """The input-plane digest: data-wait share, live adaptive depths,
+    per-worker fetch throughput, dropped workers, and elastic RESHARD
+    events (data_reshard flights)."""
+    _write_jsonl(tmp_path / "metrics.jsonl", [
+        {"step": 10, "loss": 1.0, "t_step": 0.1, "t_data": 0.025,
+         "data_prefetch_depth": 4, "data_client_window": 3,
+         "data_batches_total": 40,
+         "data_service_workers_dropped_total": 1,
+         "data_service_resharded_splits_total": 1,
+         "data_service_fetch_seconds_count.worker_127_0_0_1:9001": 25,
+         "data_service_fetch_seconds_sum.worker_127_0_0_1:9001": 0.5,
+         "data_service_fetch_seconds_count.worker_127_0_0_1:9002": 15,
+         "data_service_fetch_seconds_sum.worker_127_0_0_1:9002": 0.6},
+    ])
+    _write_jsonl(tmp_path / "flight.jsonl", [
+        {"t": 100.0, "kind": "fit_begin"},
+        {"t": 101.0, "kind": "data_reshard", "worker": "127.0.0.1:9001",
+         "splits": 1, "gen": 1, "epoch": "0"},
+        {"t": 102.0, "kind": "fit_end"},
+    ])
+    report = run_report.build_report(str(tmp_path))
+    ip = report["input_plane"]
+    assert ip["data_wait_share"] == pytest.approx(0.25)
+    assert ip["data_prefetch_depth"] == 4
+    assert ip["data_client_window"] == 3
+    assert ip["workers"]["127_0_0_1:9001"]["batches"] == 25
+    assert ip["workers"]["127_0_0_1:9001"]["mean_fetch_ms"] == pytest.approx(20.0)
+    assert len(ip["reshard_events"]) == 1
+    out = run_report.render(report)
+    assert "input plane: data-wait 25.0% of step time" in out
+    assert "prefetch depth 4" in out
+    assert "credit window 3" in out
+    assert "worker 127_0_0_1:9001: 25 batches, mean fetch 20.00 ms" in out
+    assert "workers dropped: 1" in out
+    assert "elastically re-assigned splits: 1" in out
+    assert ("RESHARD: worker 127.0.0.1:9001 died, 1 split(s) "
+            "re-assigned at gen 1") in out
+
+
+def test_report_without_input_fields_has_empty_input_plane(tmp_path):
+    _write_jsonl(tmp_path / "metrics.jsonl", [
+        {"step": 10, "loss": 1.0, "t_step": 0.1, "t_data": 0.01},
+    ])
+    report = run_report.build_report(str(tmp_path))
+    assert report["input_plane"] == {}
+    assert "input plane" not in run_report.render(report)
+
+
+def test_metrics_rows_validate_prefetch_component_labels(tmp_path):
+    """Flattened data_prefetch_depth/resizes fields: known component and
+    direction labels pass; typos are errors (a forked time series)."""
+    p = tmp_path / "metrics.jsonl"
+    _write_jsonl(p, [{
+        "step": 1,
+        "data_prefetch_depth.component_prefetcher": 4,
+        "data_prefetch_depth.component_client": 2,
+        "data_prefetch_resizes_total.component_client.direction_grow": 1,
+    }])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert errors == []
+    _write_jsonl(p, [{
+        "step": 1,
+        "data_prefetch_depth.component_sidecar": 4,
+    }])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 1 and "component" in errors[0]
+    _write_jsonl(p, [{
+        "step": 1,
+        "data_prefetch_resizes_total.component_client.direction_explode": 1,
+    }])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 1 and "direction" in errors[0]
+
+
+def test_prom_schema_validates_prefetch_labels(tmp_path):
+    p = tmp_path / "metrics.prom"
+    p.write_text(
+        'data_prefetch_depth{component="prefetcher"} 4\n'
+        'data_prefetch_depth{component="client"} 2\n'
+        'data_prefetch_resizes_total{component="client",direction="grow"} 1\n'
+    )
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert errors == []
+    p.write_text('data_prefetch_depth{component="mystery"} 4\n')
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 1 and "component" in errors[0]

@@ -19,9 +19,13 @@ schema (ops/flash_tuning.py: version 1, entries with platform/dtype/
 shape, blocks dividing seq, known sources); files ending in ``.prom``
 against the Prometheus exposition snapshot (well-formed samples;
 ``collective_dispatch_seconds`` ``op`` labels restricted to the known
-collective set — see :data:`COLLECTIVE_OPS` — and ``overlapped`` labels
-to "0"/"1"); everything else against the metric-row schema (where
-``quant_mode`` is the one string-typed field, from :data:`QUANT_MODES`).
+collective set — see :data:`COLLECTIVE_OPS` — ``overlapped`` labels to
+"0"/"1", and the input-plane ``data_prefetch_depth`` /
+``data_prefetch_resizes_total`` ``component``/``direction`` labels to
+:data:`PREFETCH_COMPONENTS` / :data:`PREFETCH_DIRECTIONS`); everything
+else against the metric-row schema (where ``quant_mode`` is the one
+string-typed field, from :data:`QUANT_MODES`; the input-plane label
+checks apply to the jsonl-flattened field names too).
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
@@ -90,6 +94,11 @@ import sys
 _FLAT_OP_RE = re.compile(r"\.op_([A-Za-z0-9_]+?)(?=\.|$)")
 #: jsonl-flattened ``overlapped`` label (parallel/overlap.py wrappers).
 _FLAT_OVERLAPPED_RE = re.compile(r"\.overlapped_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``component`` label of the input-plane depth metrics
+#: (data/adaptive.py controller).
+_FLAT_COMPONENT_RE = re.compile(r"\.component_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``direction`` label of the resize-decision counter.
+_FLAT_DIRECTION_RE = re.compile(r"\.direction_([A-Za-z0-9_]+?)(?=\.|$)")
 
 #: One Prometheus exposition sample: name, optional {labels}, value.
 _PROM_SAMPLE_RE = re.compile(
@@ -171,6 +180,15 @@ QUANT_MODES = ("none", "int8", "int8_stochastic", "fp8")
 #: (ops/flash_tuning.py SOURCES — duplicated, stdlib-only).
 FLASH_SOURCES = ("sweep", "xplane")
 
+#: ``component`` labels of the adaptive input-plane depth metrics
+#: (``data_prefetch_depth`` gauge / ``data_prefetch_resizes_total``
+#: counter — data/adaptive.py, duplicated for the same stdlib-only
+#: reason).  "prefetcher" = the host->device Prefetcher buffer,
+#: "client" = the data-service credit window.
+PREFETCH_COMPONENTS = ("prefetcher", "client")
+#: ``direction`` labels of the resize-decision counter.
+PREFETCH_DIRECTIONS = ("grow", "shrink")
+
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
     """Returns (errors, warnings) for one parsed row."""
@@ -205,6 +223,23 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                     f"line {lineno}: field {k!r} carries unknown "
                     f"overlapped value {m.group(1)!r} "
                     f"(known: {OVERLAPPED_VALUES})"
+                )
+        if k.startswith(("data_prefetch_depth", "data_prefetch_resizes")):
+            # input-plane depth telemetry: a typo'd component/direction
+            # label silently forks the adaptive controller's time series
+            m = _FLAT_COMPONENT_RE.search(k)
+            if m and m.group(1) not in PREFETCH_COMPONENTS:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown prefetch "
+                    f"component {m.group(1)!r} "
+                    f"(known: {PREFETCH_COMPONENTS})"
+                )
+            m = _FLAT_DIRECTION_RE.search(k)
+            if m and m.group(1) not in PREFETCH_DIRECTIONS:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown resize "
+                    f"direction {m.group(1)!r} "
+                    f"(known: {PREFETCH_DIRECTIONS})"
                 )
         if k == "quant_mode":
             # the one STRING-typed metric-row field: the quantized-compute
@@ -662,6 +697,24 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                     errors.append(
                         f"line {i}: {name} carries unknown overlapped "
                         f"value {ov!r} (known: {OVERLAPPED_VALUES})"
+                    )
+            if name.startswith(
+                ("data_prefetch_depth", "data_prefetch_resizes")
+            ) and labelstr:
+                labels = dict(_PROM_LABEL_RE.findall(labelstr))
+                comp = labels.get("component")
+                if comp is not None and comp not in PREFETCH_COMPONENTS:
+                    errors.append(
+                        f"line {i}: {name} carries unknown prefetch "
+                        f"component {comp!r} (known: {PREFETCH_COMPONENTS})"
+                    )
+                direction = labels.get("direction")
+                if direction is not None \
+                        and direction not in PREFETCH_DIRECTIONS:
+                    errors.append(
+                        f"line {i}: {name} carries unknown resize "
+                        f"direction {direction!r} "
+                        f"(known: {PREFETCH_DIRECTIONS})"
                     )
     return errors, warnings
 

@@ -35,7 +35,10 @@ writes — and prints:
   restarts cost;
 - serving: the request-level story from ``requests.jsonl`` (serve.py
   logdirs) — terminal-state counts, TTFT/TPOT/e2e p50+p99, batch
-  occupancy, rejects, delivered tokens/sec.
+  occupancy, rejects, delivered tokens/sec;
+- input plane: data-wait share of step time, live adaptive prefetch
+  depth / data-service credit window, per-worker fetch throughput,
+  dropped workers, and elastic ``data_reshard`` events.
 
 ``--json`` emits the same content as one machine-readable JSON object.
 Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
@@ -51,6 +54,7 @@ import argparse
 import json
 import math
 import os
+import re
 import statistics
 import sys
 
@@ -392,6 +396,68 @@ def step_time_opt_summary(train: list[dict], logdir: str) -> dict:
     return out
 
 
+_WORKER_COUNT_RE = re.compile(
+    r"^data_service_fetch_seconds_count\.worker_(.+)$"
+)
+_WORKER_SUM_RE = re.compile(r"^data_service_fetch_seconds_sum\.worker_(.+)$")
+
+
+def input_plane_summary(train: list[dict], flight: list[dict]) -> dict:
+    """The input-plane digest: what the data path cost and how the
+    adaptive machinery behaved — data-wait share of step time, the live
+    prefetch depth / data-service credit window (per-record fields from
+    the adaptive controller), per-worker fetch counts + mean wire time
+    (flattened ``data_service_fetch_seconds{worker=}`` fields), dropped
+    workers, and elastic re-shard events (``data_reshard`` flights).
+    Empty when the run carried no input-plane telemetry."""
+    last: dict = {}
+    for r in train:  # last row carrying any input-plane field wins
+        if any(k.startswith("data_") for k in r):
+            last = r
+    reshards = [e for e in flight if e.get("kind") == "data_reshard"]
+    if not last and not reshards:
+        return {}
+    out: dict = {}
+    rows_with = [
+        r for r in train
+        if isinstance(r.get("t_step"), (int, float)) and r["t_step"] > 0
+    ]
+    if rows_with:
+        t_step = sum(r["t_step"] for r in rows_with)
+        t_data = sum(
+            r["t_data"] for r in rows_with
+            if isinstance(r.get("t_data"), (int, float))
+        )
+        out["data_wait_share"] = t_data / t_step if t_step else 0.0
+    for field in ("data_prefetch_depth", "data_client_window",
+                  "data_batches_total",
+                  "data_service_workers_dropped_total",
+                  "data_service_resharded_splits_total"):
+        if isinstance(last.get(field), (int, float)):
+            out[field] = last[field]
+    workers: dict[str, dict] = {}
+    for k, v in last.items():
+        if not isinstance(v, (int, float)):
+            continue
+        m = _WORKER_COUNT_RE.match(k)
+        if m:
+            workers.setdefault(m.group(1), {})["batches"] = v
+        m = _WORKER_SUM_RE.match(k)
+        if m:
+            workers.setdefault(m.group(1), {})["fetch_s"] = v
+    for d in workers.values():
+        n = d.get("batches", 0)
+        d["mean_fetch_ms"] = 1e3 * d.get("fetch_s", 0.0) / n if n else 0.0
+    if workers:
+        out["workers"] = dict(sorted(workers.items()))
+    if reshards:
+        out["reshard_events"] = [
+            {k: e.get(k) for k in ("t", "worker", "splits", "gen", "epoch")}
+            for e in reshards
+        ]
+    return out
+
+
 def sharding_summary(train: list[dict]) -> dict:
     """The weight-update-sharding digest from the per-record state-bytes
     fields (written once per log boundary from the fit's static
@@ -504,6 +570,7 @@ def build_report(logdir: str) -> dict:
         ],
         "anomalies": collect_anomalies(trace, train),
         "sharding": sharding_summary(train),
+        "input_plane": input_plane_summary(train, flight),
         "step_time_opt": step_time_opt_summary(train, logdir),
         "stragglers": straggler_fields(train),
         "flight": flight_summary(flight),
@@ -727,6 +794,39 @@ def render(report: dict) -> str:
                 + (f"  ({b.get('ms'):.3g} ms, {b.get('source')})"
                    if isinstance(b.get("ms"), (int, float)) else
                    f"  ({b.get('source')})")
+            )
+    ip = report.get("input_plane")
+    if ip:
+        parts = []
+        if isinstance(ip.get("data_wait_share"), (int, float)):
+            parts.append(
+                f"data-wait {ip['data_wait_share'] * 100:.1f}% of step time"
+            )
+        if "data_prefetch_depth" in ip:
+            parts.append(f"prefetch depth {int(ip['data_prefetch_depth'])}")
+        if "data_client_window" in ip:
+            parts.append(f"credit window {int(ip['data_client_window'])}")
+        if "data_batches_total" in ip:
+            parts.append(f"{int(ip['data_batches_total'])} batches")
+        lines += ["", "input plane: " + (", ".join(parts) or "telemetry only")]
+        for addr, d in (ip.get("workers") or {}).items():
+            lines.append(
+                f"  worker {addr}: {int(d.get('batches', 0))} batches, "
+                f"mean fetch {d.get('mean_fetch_ms', 0.0):.2f} ms"
+            )
+        dropped = ip.get("data_service_workers_dropped_total")
+        if dropped:
+            lines.append(f"  workers dropped: {int(dropped)}")
+        moved = ip.get("data_service_resharded_splits_total")
+        if moved:
+            lines.append(
+                f"  elastically re-assigned splits: {int(moved)}"
+            )
+        for e in ip.get("reshard_events", []):
+            lines.append(
+                f"  RESHARD: worker {e.get('worker')} died, "
+                f"{e.get('splits')} split(s) re-assigned at gen "
+                f"{e.get('gen')} (epoch {e.get('epoch')})"
             )
     sh = report.get("sharding")
     if sh:

@@ -705,6 +705,36 @@ def main() -> None:
                                         "--data-dir (reference AutoShardPolicy)")
     p.add_argument("--shuffle-buffer", type=int, default=4096,
                    help="record shuffle buffer for --data-dir (0 = off)")
+    p.add_argument("--data-service", type=int, default=0, metavar="N",
+                   help="disaggregated input: spawn a loopback dispatcher "
+                        "plus N in-process data workers serving the "
+                        "workload input (or --data-dir records, partitioned "
+                        "N ways under this host's slice) and consume via "
+                        "the streaming DataServiceClient — persistent "
+                        "pipelined connections, credit window, elastic "
+                        "re-sharding on worker death. 0 = direct host input")
+    p.add_argument("--data-service-wire", choices=("raw", "npz"),
+                   default="raw",
+                   help="data-service batch wire format: 'raw' "
+                        "(dtype/shape header + raw tensor bytes, the fast "
+                        "path) or 'npz' (legacy per-batch archive)")
+    p.add_argument("--data-service-window", type=int, default=0,
+                   metavar="W",
+                   help="per-split credit window of outstanding pipelined "
+                        "get_next requests (0 = adaptive: autotuned from "
+                        "consumer waits within --prefetch-budget-mb)")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="host->device prefetch buffer depth (batches in "
+                        "flight; the Prefetcher's buffer_size)")
+    p.add_argument("--adaptive-prefetch", action="store_true",
+                   help="autotune the prefetch depth from consumer "
+                        "blocking time (grow while the trainer waits on "
+                        "data, shrink when waits are ~0), bounded by "
+                        "--prefetch-budget-mb; live depth exported as the "
+                        "data_prefetch_depth gauge + per-record field")
+    p.add_argument("--prefetch-budget-mb", type=float, default=256.0,
+                   help="host-bytes budget bounding the adaptive prefetch "
+                        "depth and data-service credit window")
     p.add_argument("--pp-virtual", type=int, default=1,
                    help="virtual pipeline chunks per rank (>1 = circular/"
                         "interleaved schedule, smaller bubble)")
@@ -852,7 +882,11 @@ def main() -> None:
         return
 
     from distributedtensorflow_tpu import parallel
-    from distributedtensorflow_tpu.data import current_input_context, Prefetcher
+    from distributedtensorflow_tpu.data import (
+        InputContext,
+        Prefetcher,
+        current_input_context,
+    )
     from distributedtensorflow_tpu.train import (
         create_sharded_state,
         make_eval_step,
@@ -1038,7 +1072,72 @@ def main() -> None:
 
     ctx = current_input_context(wl.global_batch_size)
 
+    # Disaggregated input (--data-service N): a loopback dispatcher + N
+    # in-process data workers each serving full per-host batches; the
+    # trainer consumes through the streaming DataServiceClient (pipelined
+    # credit window, raw tensor wire, elastic re-sharding).  In-process
+    # loopback is the CPU-verifiable topology; a real pod points the
+    # client at a remote dispatcher and runs WorkerServer on input hosts.
+    data_service = None
+    if args.data_service:
+        from distributedtensorflow_tpu.data import DispatchServer, WorkerServer
+
+        def _worker_input_fn(split, num_shards):
+            if args.data_dir:
+                from distributedtensorflow_tpu.data import (
+                    repeated_record_dataset,
+                )
+
+                files = record_files(args.data_dir)
+                # Partition the files/records num_shards ways UNDER this
+                # host's slice: worker `split` of this host behaves as
+                # input pipeline (host_id * N + split) of (hosts * N).
+                wctx = InputContext(
+                    num_input_pipelines=ctx.num_input_pipelines * num_shards,
+                    input_pipeline_id=(
+                        ctx.input_pipeline_id * num_shards + split
+                    ),
+                    global_batch_size=wl.global_batch_size * num_shards,
+                )
+                return repeated_record_dataset(
+                    files, wctx, batch_size=ctx.per_host_batch_size,
+                    policy=args.autoshard,
+                    shuffle_buffer=args.shuffle_buffer,
+                    seed=args.seed + split,
+                )
+            # Synthetic sources: each worker generates a distinct
+            # deterministic stream (seed offset by split) of full
+            # per-host batches.
+            return wl.input_fn(ctx, args.seed + 1009 * (split + 1))
+
+        _dispatch = DispatchServer(port=0)
+        _workers = [
+            WorkerServer(_dispatch.target(), _worker_input_fn, port=0)
+            for _ in range(args.data_service)
+        ]
+        data_service = _dispatch
+        logging.info("data service: dispatcher %s + %d loopback worker(s), "
+                     "wire=%s", _dispatch.target(), len(_workers),
+                     args.data_service_wire)
+
+    # Each (re)start consumes a FRESH service epoch so worker iterators
+    # restart from batch 0 and the resume fast-forward lands correctly.
+    _ds_epoch = [0]
+
     def make_raw_iter():
+        if data_service is not None:
+            from distributedtensorflow_tpu.data import DataServiceClient
+
+            epoch = _ds_epoch[0]
+            _ds_epoch[0] += 1
+            return DataServiceClient(
+                data_service.target(),
+                epoch=epoch,
+                wire=args.data_service_wire,
+                window=args.data_service_window or 2,
+                adaptive_window=args.data_service_window == 0,
+                bytes_budget=int(args.prefetch_budget_mb * 2**20),
+            )
         if args.data_dir:
             from distributedtensorflow_tpu.data import repeated_record_dataset
 
@@ -1068,7 +1167,10 @@ def main() -> None:
             logging.info("fast-forwarding input %d batches", start_step)
             raw_iter = skip_batches(iter(raw_iter), start_step)
         return Prefetcher(
-            raw_iter, mesh, buffer_size=2, bundle=args.steps_per_call
+            raw_iter, mesh, buffer_size=args.prefetch_depth,
+            bundle=args.steps_per_call,
+            adaptive=args.adaptive_prefetch,
+            bytes_budget=int(args.prefetch_budget_mb * 2**20),
         )
 
     # Chaos fault injection (resilience tentpole): a --fault-plan run
