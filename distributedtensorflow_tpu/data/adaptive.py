@@ -42,6 +42,7 @@ try:  # pragma: no cover - exercised implicitly everywhere obs imports
     from ..obs.registry import gauge as _gauge
     from ..obs.registry import histogram as _histogram
     from ..obs.flight_recorder import record_event as _record_event
+    from ..obs.tracing import remote_span as _remote_span
 except Exception:  # pragma: no cover
     class _Null:
         def inc(self, *a, **k): pass
@@ -53,6 +54,13 @@ except Exception:  # pragma: no cover
     def _gauge(name, help=""): return _Null()
     def _histogram(name, help=""): return _Null()
     def _record_event(kind, **fields): pass
+
+    class _remote_span:  # no-op cross-process span (context stays None)
+        context = None
+
+        def __init__(self, name, **fields): pass
+        def __enter__(self): return self
+        def __exit__(self, *exc): return False
 
 #: Live controllers by component name ("prefetcher" / "client"), for the
 #: per-record fields.  Last constructed wins — one Prefetcher + one client

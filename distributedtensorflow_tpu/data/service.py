@@ -101,6 +101,7 @@ from .adaptive import (  # noqa: F401  (shared degradation shims)
     _counter,
     _histogram,
     _record_event,
+    _remote_span,
 )
 
 
@@ -141,20 +142,35 @@ def _recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:
     return header, data
 
 
-def _rpc(addr: str, request: dict, *, timeout: float = 30.0) -> tuple[dict, bytes | None]:
+def _rpc(addr: str, request: dict, *, timeout: float = 30.0,
+         trace: dict | None = None) -> tuple[dict, bytes | None]:
+    if trace:
+        # Distributed tracing: the context rides the request frame so the
+        # server's span parents under the caller's (obs.tracing schema).
+        request = dict(request, trace=trace)
     host, port = addr.rsplit(":", 1)
     with socket.create_connection((host, int(port)), timeout=timeout) as s:
         _send_msg(s, request)
         return _recv_msg(s)
 
 
-def encode_batch(batch: Batch, wire: str = "npz", *, crc: bool = False) -> bytes:
+def _request_trace(req: dict) -> dict | None:
+    """The trace context a request frame carries, or None."""
+    trace = req.get("trace")
+    if isinstance(trace, dict) and trace.get("trace_id"):
+        return trace
+    return None
+
+
+def encode_batch(batch: Batch, wire: str = "npz", *, crc: bool = False,
+                 trace: dict | None = None) -> bytes:
     """Serialize a batch for the wire.  ``"npz"`` (the legacy default —
     the param-server shard protocol still speaks it) or ``"raw"`` (the
     header+raw-bytes format of :mod:`data.wire`; ``crc`` adds a CRC32C
-    over the payload when the native layer is available)."""
+    over the payload when the native layer is available; ``trace`` echoes
+    a distributed-tracing context in the raw header)."""
     if wire == "raw":
-        return wirelib.encode_tensors(batch, crc=crc)
+        return wirelib.encode_tensors(batch, crc=crc, trace=trace)
     if wire != "npz":
         raise ValueError(f"unknown wire format {wire!r} (known: {WIRE_FORMATS})")
     buf = io.BytesIO()
@@ -206,7 +222,20 @@ class DispatchServer:
             def handle(self) -> None:
                 try:
                     req, _ = _recv_msg(self.request)
-                    _send_msg(self.request, outer._handle(req))
+                    ctx = _request_trace(req)
+                    if ctx is not None:
+                        # Traced RPC: the dispatcher's span lands in THIS
+                        # process's trace.jsonl under the caller's
+                        # trace_id (rare control-plane calls only — the
+                        # batch hot path never passes through here).
+                        with _remote_span(
+                            f"dispatcher.{req.get('kind')}", context=ctx,
+                            epoch=str(req.get("epoch", "")),
+                        ):
+                            resp = outer._handle(req)
+                    else:
+                        resp = outer._handle(req)
+                    _send_msg(self.request, resp)
                 except (ConnectionError, json.JSONDecodeError, OSError):
                     pass
 
@@ -405,6 +434,13 @@ class WorkerServer:
     pattern) and advertises ``advertise_host or host`` to the dispatcher;
     pass ``advertise_host`` when binding ``0.0.0.0``.  ``wire_crc=True``
     adds a CRC32C to every raw-wire batch (native layer permitting).
+
+    ``status_port`` (None = off; 0 = ephemeral, loopback-default via
+    ``status_host``) embeds an ``obs.StatusServer`` so worker health is a
+    first-class scrape target of the chief's ``FleetAggregator`` instead
+    of being inferable only from client-side fetch histograms — the
+    bound address is ``worker.status_addr``.  Degrades to a warning on a
+    bare host where ``obs`` (which pulls jax) cannot import.
     """
 
     def __init__(
@@ -419,6 +455,8 @@ class WorkerServer:
         heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S,
         wire_crc: bool = False,
         max_cached_epochs: int = _MAX_CACHED_EPOCHS,
+        status_port: int | None = None,
+        status_host: str = "127.0.0.1",
     ):
         self._dispatcher = dispatcher
         self._input_fn = input_fn
@@ -442,6 +480,7 @@ class WorkerServer:
             "data_service_batches_served_total",
             "batches this data worker put on the wire",
         )
+        self._served = 0  # local count (the registry counter may be shared)
         # Live connections, so kill() can sever in-flight streams (the
         # listening socket alone leaves established handlers serving).
         self._conns: set[socket.socket] = set()
@@ -509,9 +548,58 @@ class WorkerServer:
         ]
         for t in self._threads:
             t.start()
+
+        #: Embedded introspection server (fleet scrape target); None when
+        #: off or unavailable on this host.
+        self.status_server = None
+        self.status_addr: str | None = None
+        if status_port is not None:
+            try:
+                from ..obs.server import StatusServer  # noqa: PLC0415
+
+                self.status_server = StatusServer(
+                    status_port,
+                    host=status_host,
+                    status_fn=self._status,
+                    health_fn=self._health,
+                ).start()
+                # Advertise a reachable address, not the bind wildcard —
+                # the same advertise_host rule the data port follows
+                # (a remote aggregator scraping "0.0.0.0:P" connects to
+                # itself).
+                adv = (advertise_host
+                       if status_host in ("0.0.0.0", "") else status_host)
+                self.status_addr = f"{adv}:{self.status_server.port}"
+            except Exception:  # bare host without obs/jax, or bind failure
+                logger.exception(
+                    "data worker %s: embedded status server unavailable; "
+                    "continuing without it", self.addr,
+                )
         logger.info(
-            "data worker %s up (shard %d)", self.addr, self.shard_index
+            "data worker %s up (shard %d)%s", self.addr, self.shard_index,
+            f" status {self.status_addr}" if self.status_addr else "",
         )
+
+    def _status(self) -> dict:
+        with self._lock:
+            cached = len(self._iters)
+            retired = len(self._retired_epochs)
+        return {
+            "data_worker": {
+                "addr": self.addr,
+                "shard": self.shard_index,
+                "batches_served": self._served,
+                "cached_iterators": cached,
+                "retired_epochs": retired,
+            }
+        }
+
+    def _health(self) -> dict:
+        return {
+            "ok": not self._stop.is_set(),
+            "addr": self.addr,
+            "shard": self.shard_index,
+        }
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._heartbeat_interval_s):
@@ -563,6 +651,23 @@ class WorkerServer:
                 del self._iters[key]
 
     def _handle(self, req: dict) -> tuple[dict, bytes | None]:
+        ctx = _request_trace(req)
+        if ctx is None:
+            return self._get_next(req, None)
+        # Traced request (the streaming client injects its context into
+        # the FIRST get_next of each stream only — never per batch): the
+        # worker's span lands in this process's trace.jsonl under the
+        # client's trace_id, and the response batch echoes the context in
+        # its wire header.
+        with _remote_span(
+            "data_worker.get_next", context=ctx,
+            epoch=str(req.get("epoch", "")), split=req.get("split"),
+            worker=self.addr,
+        ) as sp:
+            return self._get_next(req, sp.context)
+
+    def _get_next(self, req: dict,
+                  trace_ctx: dict | None) -> tuple[dict, bytes | None]:
         if req.get("kind") != "get_next":
             return {"ok": False, "error": "unknown rpc"}, None
         epoch = str(req.get("epoch", 0))
@@ -626,9 +731,11 @@ class WorkerServer:
             except StopIteration:
                 return {"ok": True, "eof": True, "split": split}, None
         self._m_served.inc()
+        self._served += 1
         return (
             {"ok": True, "eof": False, "split": split},
-            encode_batch(batch, wire=wire_fmt, crc=self._wire_crc),
+            encode_batch(batch, wire=wire_fmt, crc=self._wire_crc,
+                         trace=trace_ctx),
         )
 
     def _make_iter_factory(self, split: int, num_shards: int, skip: int):
@@ -660,6 +767,7 @@ class WorkerServer:
         dispatcher learns via heartbeat timeout or a client failure
         report."""
         self._stop.set()
+        self._close_status_server()  # the fleet aggregator sees it refuse
         self._server.shutdown()
         self._server.server_close()
         with self._conns_lock:
@@ -673,8 +781,17 @@ class WorkerServer:
                 except OSError:
                     pass
 
+    def _close_status_server(self) -> None:
+        if self.status_server is not None:
+            try:
+                self.status_server.stop()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            self.status_server = None
+
     def stop(self) -> None:
         self._stop.set()
+        self._close_status_server()
         try:  # planned shutdown: free our shard immediately, don't wait
             _rpc(
                 self._dispatcher,
@@ -773,23 +890,34 @@ class DataServiceClient:
             "splits elastically re-assigned after a worker death",
         )
 
+        # Distributed tracing: ONE trace per epoch.  This root span is the
+        # client anchor; the dispatcher's start_epoch span and every
+        # split's fetch-stream span (and through it the workers') parent
+        # under its trace_id, so `timeline.py --fleet` can stitch one
+        # data-service fetch across processes.
         deadline = time.monotonic() + wait_for_workers_s
         resp: dict = {}
-        while time.monotonic() < deadline:
-            try:
-                resp, _ = _rpc(
-                    dispatcher,
-                    {"kind": "start_epoch", "epoch": self._epoch},
-                    timeout=5.0,
-                )
-            except OSError:
-                # Dispatcher still starting up — that's what the grace
-                # window is for.
+        with _remote_span(
+            "data_service.start_epoch", epoch=self._epoch,
+            dispatcher=dispatcher,
+        ) as _ep_span:
+            while time.monotonic() < deadline:
+                try:
+                    resp, _ = _rpc(
+                        dispatcher,
+                        {"kind": "start_epoch", "epoch": self._epoch},
+                        timeout=5.0,
+                        trace=_ep_span.context,
+                    )
+                except OSError:
+                    # Dispatcher still starting up — that's what the grace
+                    # window is for.
+                    time.sleep(0.2)
+                    continue
+                if resp.get("ok"):
+                    break
                 time.sleep(0.2)
-                continue
-            if resp.get("ok"):
-                break
-            time.sleep(0.2)
+        self._trace_ctx = getattr(_ep_span, "context", None)
         if not resp.get("ok"):
             raise TimeoutError("no data workers registered")
         self._num_shards = int(resp["num_shards"])
@@ -896,7 +1024,23 @@ class DataServiceClient:
                 self._buffer_put(self._DONE)
 
     def _stream_split(self, split: int, addr: str, skip: int, gen: int) -> None:
-        """Pipelined pull of one split over one persistent connection."""
+        """Pipelined pull of one split over one persistent connection.
+
+        One cross-process span per stream (parented under the epoch's
+        trace); its context rides the FIRST ``get_next`` only — the
+        worker records one matching span per stream, never per batch."""
+        with _remote_span(
+            "data_service.fetch_split", context=self._trace_ctx,
+            split=split, worker=addr, skip=skip, gen=gen,
+        ) as sp:
+            self._stream_split_traced(
+                split, addr, skip, gen, getattr(sp, "context", None)
+            )
+
+    def _stream_split_traced(
+        self, split: int, addr: str, skip: int, gen: int,
+        trace_ctx: dict | None,
+    ) -> None:
         request = {
             "kind": "get_next",
             "epoch": self._epoch,
@@ -912,13 +1056,18 @@ class DataServiceClient:
         ) as s:
             s.settimeout(self._timeout)
             outstanding = 0
+            traced_sent = trace_ctx is None  # inject once per stream
             while not self._closed:
                 # Credit window: keep W get_nexts on the wire.  Requests
                 # are tiny JSON frames; the responses stream back in order
                 # on the same socket while we decode/enqueue.
                 target = max(1, self._window_depth())
                 while outstanding < target:
-                    _send_msg(s, request)
+                    if not traced_sent:
+                        traced_sent = True
+                        _send_msg(s, dict(request, trace=trace_ctx))
+                    else:
+                        _send_msg(s, request)
                     outstanding += 1
                 t0 = time.perf_counter()
                 header, data = _recv_msg(s)
@@ -960,20 +1109,28 @@ class DataServiceClient:
             # The RPC runs OUTSIDE the lock: holding it across a blocking
             # (up to 10 s) dispatcher round-trip would stall every healthy
             # fetcher at its per-batch count increment.
-            try:
-                resp, _ = _rpc(
-                    self._dispatcher,
-                    {
-                        "kind": "report_worker_failure",
-                        "epoch": self._epoch,
-                        "addr": addr,
-                        "split": split,
-                        "received": {str(split): count},
-                    },
-                    timeout=10.0,
-                )
-            except OSError as e:
-                resp = {"ok": False, "error": f"dispatcher unreachable: {e}"}
+            with _remote_span(
+                "data_service.report_failure", context=self._trace_ctx,
+                worker=addr, split=split,
+            ) as _rp_span:
+                try:
+                    resp, _ = _rpc(
+                        self._dispatcher,
+                        {
+                            "kind": "report_worker_failure",
+                            "epoch": self._epoch,
+                            "addr": addr,
+                            "split": split,
+                            "received": {str(split): count},
+                        },
+                        timeout=10.0,
+                        trace=getattr(_rp_span, "context", None),
+                    )
+                except OSError as e:
+                    resp = {
+                        "ok": False,
+                        "error": f"dispatcher unreachable: {e}",
+                    }
             if resp.get("ok"):
                 with self._reshard_lock:
                     # Concurrent reports interleave; only move forward (a

@@ -58,12 +58,18 @@ def _crc32c(data) -> int | None:
         return None
 
 
-def encode_tensors(tensors: Mapping[str, Any], *, crc: bool = False) -> bytes:
+def encode_tensors(tensors: Mapping[str, Any], *, crc: bool = False,
+                   trace: Mapping[str, str] | None = None) -> bytes:
     """Serialize a dict of arrays to the raw wire format.
 
     Arrays are made C-contiguous (a copy only when the input is not);
     object/void dtypes are rejected — the wire carries numeric/bool bytes
     only, never pickle.
+
+    ``trace`` (a ``{"trace_id", "span_id"}`` dict — the distributed
+    request-tracing context of ``obs.tracing``) is echoed verbatim in the
+    header so a traced batch carries its trace id end to end; decoders
+    that don't care ignore it, :func:`peek_trace` reads it back.
     """
     meta = []
     parts: list[bytes | memoryview] = []
@@ -90,6 +96,8 @@ def encode_tensors(tensors: Mapping[str, Any], *, crc: bool = False) -> bytes:
         else:
             parts.append(memoryview(a).cast("B"))
     header: dict = {"v": 1, "t": meta}
+    if trace:
+        header["trace"] = {str(k): str(v) for k, v in dict(trace).items()}
     if crc:
         # The checksum needs the contiguous payload; this path pays one
         # extra full-payload copy.
@@ -107,6 +115,35 @@ def encode_tensors(tensors: Mapping[str, Any], *, crc: bool = False) -> bytes:
 def is_raw(data) -> bool:
     """True when ``data`` starts with the raw-wire magic."""
     return bytes(data[:4]) == MAGIC
+
+
+def peek_header(data) -> dict:
+    """Parse and return just the JSON header of a raw payload (no tensor
+    decode, no CRC verification) — cheap wire introspection."""
+    mv = memoryview(data)
+    if bytes(mv[:4]) != MAGIC:
+        raise WireError("not a raw tensor payload (bad magic)")
+    if len(mv) < 8:
+        raise WireError("truncated header length")
+    (hlen,) = _HEADER_LEN.unpack(mv[4:8])
+    if 8 + hlen > len(mv):
+        raise WireError("truncated header")
+    try:
+        header = json.loads(bytes(mv[8:8 + hlen]))
+    except json.JSONDecodeError as e:
+        raise WireError(f"bad header JSON: {e}") from e
+    if not isinstance(header, dict):
+        raise WireError("header is not an object")
+    return header
+
+
+def peek_trace(data) -> dict | None:
+    """The echoed trace context of a raw payload (``encode_tensors``'s
+    ``trace=``), or None — including for npz payloads, which carry none."""
+    if not is_raw(data):
+        return None
+    trace = peek_header(data).get("trace")
+    return trace if isinstance(trace, dict) else None
 
 
 def decode_tensors(data) -> dict[str, np.ndarray]:

@@ -35,12 +35,27 @@ Surfaces:
   per-run budget, a ``captures.jsonl`` manifest, and
   ``capture_begin``/``capture_end`` flight events — the layer that turns
   the telemetry above into an actionable debugging loop;
+- ``FleetAggregator`` — the fleet observability plane: a chief-side
+  scraper over peer StatusServers' ``/varz`` (trainer hosts, data-service
+  workers, the serve server, coordinator subprocess workers) merging
+  samples into one min/median/max/sum view with per-peer up/stale/down
+  liveness and ``spread_ratio`` straggler detection, served at
+  ``/fleetz`` and persisted to ``fleet.json``;
+- ``SLOMonitor`` — declarative SLO rules (JSON) evaluated over registry
+  histograms/counters as multi-window burn rates
+  (``slo_burn_rate{slo=,window=}``), raising ``slo_violation`` flight
+  events, serving ``/sloz``, and optionally arming the CaptureEngine on
+  a fast-burn trip;
+- ``remote_span`` / ``record_remote_span`` — cross-process request
+  tracing: a trace context (trace_id, parent span_id) propagated over
+  RPC frames so spans in every process's ``trace.jsonl`` stitch into one
+  timeline (``tools/timeline.py --fleet``);
 - ``tools/run_report.py`` — renders a logdir's streams into one
   human-readable run report; ``tools/timeline.py`` merges them into a
   single Chrome-trace/Perfetto timeline (restarts included).
 """
 
-from . import capture, flight_recorder, goodput, memory  # noqa: F401
+from . import capture, fleet, flight_recorder, goodput, memory, slo  # noqa: F401
 from .aggregate import (  # noqa: F401
     host_aggregate,
     spread_ratio,
@@ -48,6 +63,7 @@ from .aggregate import (  # noqa: F401
 )
 from .anomaly import Anomaly, AnomalyDetector  # noqa: F401
 from .capture import CaptureEngine  # noqa: F401
+from .fleet import FleetAggregator  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     default_recorder,
@@ -68,4 +84,14 @@ from .registry import (  # noqa: F401
     set_default_registry,
 )
 from .server import StatusServer  # noqa: F401
-from .tracing import Span, TraceRecorder, active_recorder, span  # noqa: F401
+from .slo import SLOMonitor, SLORule  # noqa: F401
+from .tracing import (  # noqa: F401
+    Span,
+    TraceRecorder,
+    active_recorder,
+    current_context,
+    new_trace_id,
+    record_remote_span,
+    remote_span,
+    span,
+)

@@ -85,7 +85,8 @@ __all__ = [
 
 #: The known capture trigger kinds (the ``captures.jsonl`` schema —
 #: ``tools/check_metrics_schema.py`` validates against this set).
-TRIGGERS = ("static", "manual", "step_time_regression", "straggler_spread")
+TRIGGERS = ("static", "manual", "step_time_regression", "straggler_spread",
+            "slo_burn")
 
 _M_CAPTURES = counter(
     "profiler_captures_total", "profiler captures started, by trigger"

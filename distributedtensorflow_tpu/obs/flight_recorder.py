@@ -39,7 +39,9 @@ rejected a truncated/corrupt checkpoint and fell back —
 ``checkpoint.manager``), ``fault`` (chaos-injected fault, mirrored from
 ``faults.jsonl`` — ``resilience.chaos``), ``restart`` /
 ``supervisor_giving_up`` (supervised in-process restarts —
-``resilience.supervisor``), ``fit_begin``, ``fit_end``.
+``resilience.supervisor``), ``data_reshard`` (elastic data-service
+re-assignment — ``data.service``), ``slo_violation`` (an SLO burn-rate
+threshold trip — ``obs.slo``), ``fit_begin``, ``fit_end``.
 
 The hot path is one ``time.time()`` + one deque append under a lock; dumps
 rewrite the whole file atomically (tmp + rename) so a reader — or the

@@ -183,6 +183,45 @@ class Histogram(_Metric):
                 return lo + (hi - lo) * (target - prev) / c
         return self.buckets[-1]
 
+    def count_under(self, bound: float, **labels) -> float:
+        """Estimated observations ``<= bound`` from the cumulative buckets
+        (linear interpolation inside the containing bucket — the inverse of
+        :meth:`quantile`).  The SLO monitor's good-event counter: "requests
+        under the latency objective".  Observations in the +Inf bucket are
+        past every finite bound and count only when ``bound`` is +Inf —
+        a threshold above the last bucket edge is therefore conservative
+        (tail observations read as bad)."""
+        with self._lock:
+            counts, _total, n = self._hist.get(
+                _label_key(labels), ([0] * (len(self.buckets) + 1), 0.0, 0)
+            )
+            counts = list(counts)
+        if n == 0:
+            return 0.0
+        if math.isinf(bound) and bound > 0:
+            return float(n)
+        cum = 0.0
+        for i, c in enumerate(counts[:-1]):
+            hi = self.buckets[i]
+            lo = self.buckets[i - 1] if i > 0 else 0.0
+            if bound >= hi:
+                cum += c
+            elif bound > lo and hi > lo:
+                cum += c * (bound - lo) / (hi - lo)
+                break
+            else:
+                break
+        return cum
+
+    def total_count(self, **labels) -> float:
+        """Total observations (all buckets incl. +Inf) — the SLO
+        monitor's event denominator."""
+        with self._lock:
+            _counts, _total, n = self._hist.get(
+                _label_key(labels), ([0] * (len(self.buckets) + 1), 0.0, 0)
+            )
+        return float(n)
+
     def _hist_items(self):
         with self._lock:
             return [
@@ -220,6 +259,15 @@ class Registry:
     def histogram(self, name: str, help: str = "",
                   buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        """Read-only lookup: the metric registered under ``name``, or None
+        — never creates.  Observers (the SLO monitor) must use this
+        instead of the get-or-create accessors, which would squat the
+        name with the observer's kind and crash the real producer's later
+        registration with a kind mismatch."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def metrics(self) -> list[_Metric]:
         with self._lock:

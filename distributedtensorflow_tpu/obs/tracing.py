@@ -24,6 +24,20 @@ Design constraints:
      "spans": [{"name": str, "dur_s": float, "children": [...]}, ...]}
     {"kind": "anomaly", "step": int, "anomaly": str, "message": str,
      "value": float}
+    {"kind": "span", "name": str, "trace_id": str, "span_id": str,
+     "parent_id": str?, "t0": float unix seconds, "dur_s": float,
+     "proc": int, ...}
+
+The ``kind: "span"`` rows are **cross-process trace spans** (the fleet
+observability plane, ISSUE 11): unlike the per-step span trees they carry
+absolute wall-clock ``t0`` and a ``trace_id`` shared across process
+boundaries, so ``tools/timeline.py --fleet`` can stitch a client span in
+one process's ``trace.jsonl`` against the dispatcher/worker spans it
+caused in another's.  The context travels as a two-field dict
+``{"trace_id", "span_id"}`` — injected into RPC frames by the data-service
+client, echoed through ``data/wire.py`` headers, and attached per serve
+request — and :class:`remote_span` is the emitting context manager
+(near-free when no recorder is installed).
 """
 
 from __future__ import annotations
@@ -32,6 +46,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Any
 
 __all__ = [
@@ -41,6 +56,11 @@ __all__ = [
     "active_recorder",
     "add_root_sink",
     "remove_root_sink",
+    "current_context",
+    "new_trace_id",
+    "new_span_id",
+    "record_remote_span",
+    "remote_span",
 ]
 
 _tls = threading.local()
@@ -273,3 +293,124 @@ class TraceRecorder:
             if self._f is not None:
                 self._f.close()
                 self._f = None
+
+
+# -- cross-process trace context (fleet observability plane) -----------------
+
+_ctx_tls = threading.local()
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id (shared across every process a
+    request touches)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    """A fresh 16-hex-char span id (unique per emitted span)."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_context() -> dict[str, str] | None:
+    """The calling thread's live trace context ``{"trace_id", "span_id"}``
+    (the innermost open :class:`remote_span`), or None.  The returned dict
+    is the wire-injectable form — put it in an RPC frame verbatim and the
+    receiving process opens its span with ``remote_span(..., context=...)``
+    to parent under it."""
+    ctx = getattr(_ctx_tls, "ctx", None)
+    return dict(ctx) if ctx else None
+
+
+def record_remote_span(
+    name: str,
+    *,
+    t0: float,
+    dur_s: float,
+    trace_id: str,
+    span_id: str | None = None,
+    parent_id: str | None = None,
+    **fields: Any,
+) -> dict[str, Any] | None:
+    """Write one already-measured cross-process span row to the active
+    recorder's ``trace.jsonl`` (the ``kind: "span"`` schema above).
+
+    ``t0`` is absolute unix seconds — cross-process stitching cannot use
+    the per-step rows' relative durations.  No-op (returns None) when no
+    recorder is installed or it has no file; never raises (spans are
+    telemetry, not logic)."""
+    rec = _recorder
+    if rec is None:
+        return None
+    row: dict[str, Any] = {
+        "kind": "span",
+        "name": str(name),
+        "trace_id": str(trace_id),
+        "span_id": str(span_id or new_span_id()),
+        "t0": round(float(t0), 6),
+        "dur_s": round(max(float(dur_s), 0.0), 6),
+        "proc": os.getpid(),
+    }
+    if parent_id:
+        row["parent_id"] = str(parent_id)
+    row.update(fields)
+    try:
+        rec.write_event(row)
+    except Exception:
+        return None
+    return row
+
+
+class remote_span:
+    """``with remote_span("data_service.fetch_split", split=3): ...`` —
+    a cross-process span: absolute wall-clock timing plus trace-context
+    propagation.
+
+    On entry it resolves its trace context — an explicit ``context``
+    (the ``{"trace_id", "span_id"}`` dict received over the wire, which
+    becomes the parent), else the thread's current context, else a fresh
+    trace — and installs itself as the thread's current context so nested
+    ``remote_span``s and wire injections (:func:`current_context`) parent
+    correctly.  On exit it restores the previous context and writes one
+    ``kind: "span"`` row via :func:`record_remote_span`.
+
+    Exception-transparent (plain class context manager, the ``span``
+    rule) and near-free when no recorder is installed.  ``.context`` is
+    readable while open AND after exit — a client stores it to parent
+    later work under the same span."""
+
+    __slots__ = ("name", "fields", "trace_id", "span_id", "parent_id",
+                 "row", "_t0", "_prev")
+
+    def __init__(self, name: str, *, context: dict | None = None,
+                 **fields: Any):
+        self.name = name
+        self.fields = fields
+        parent = context if isinstance(context, dict) else None
+        if parent is None or not parent.get("trace_id"):
+            parent = getattr(_ctx_tls, "ctx", None)
+        self.trace_id = str((parent or {}).get("trace_id") or new_trace_id())
+        self.parent_id = (parent or {}).get("span_id")
+        self.span_id = new_span_id()
+        self.row: dict[str, Any] | None = None
+        self._t0 = 0.0
+        self._prev = None
+
+    @property
+    def context(self) -> dict[str, str]:
+        """Wire-injectable ``{"trace_id", "span_id"}`` of THIS span."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def __enter__(self) -> "remote_span":
+        self._prev = getattr(_ctx_tls, "ctx", None)
+        _ctx_tls.ctx = {"trace_id": self.trace_id, "span_id": self.span_id}
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.time() - self._t0
+        _ctx_tls.ctx = self._prev
+        self.row = record_remote_span(
+            self.name, t0=self._t0, dur_s=dur, trace_id=self.trace_id,
+            span_id=self.span_id, parent_id=self.parent_id, **self.fields,
+        )
+        return False

@@ -266,8 +266,29 @@ class PerWorker(Generic[T]):
             return self._values[worker_id]
 
 
-def _subprocess_worker_main(conn) -> None:
-    """Loop of a process-backed worker: recv (fn, args, kwargs), send result."""
+def _subprocess_worker_main(conn, status_port: int | None = None) -> None:
+    """Loop of a process-backed worker: recv (fn, args, kwargs), send result.
+
+    ``status_port`` (0 = ephemeral) embeds an ``obs.StatusServer`` in the
+    child so the chief's FleetAggregator can scrape its ``/varz`` — the
+    bound port (or None on failure) is sent to the parent as a
+    ``("status_port", port)`` handshake message BEFORE the closure loop
+    starts, so it can never interleave with an execute round-trip."""
+    server = None
+    if status_port is not None:
+        state = {"closures_done": 0, "pid": os.getpid()}
+        try:
+            from ..obs.server import StatusServer  # noqa: PLC0415
+
+            server = StatusServer(
+                status_port,
+                status_fn=lambda: {"coordinator_worker": dict(state)},
+            ).start()
+            conn.send(("status_port", server.port))
+        except Exception:  # bind failure — degrade, the worker still works
+            conn.send(("status_port", None))
+    else:
+        state = {"closures_done": 0}
     while True:
         try:
             msg = conn.recv()
@@ -278,6 +299,7 @@ def _subprocess_worker_main(conn) -> None:
         fn, args, kwargs = msg
         try:
             result = fn(*args, **kwargs)
+            state["closures_done"] += 1
             conn.send(("ok", result))
         except BaseException as e:  # noqa: BLE001 — shipped to the parent
             try:
@@ -314,29 +336,58 @@ class _SubprocessExecutor:
 
     def __init__(self, worker_id: int, *, max_respawns: int = 8,
                  respawn_backoff_s: float = 0.5,
-                 respawn_backoff_max_s: float = 30.0):
+                 respawn_backoff_max_s: float = 30.0,
+                 status_port: int | None = None,
+                 defer_status_handshake: bool = False):
         self.worker_id = worker_id
         self._ctx = mp.get_context("spawn")
         self._lock = threading.Lock()
         self._max_respawns = max(0, int(max_respawns))
         self._backoff_s = max(0.0, float(respawn_backoff_s))
         self._backoff_max_s = max(0.0, float(respawn_backoff_max_s))
+        self._status_port = status_port
+        #: ``host:port`` of the child's embedded StatusServer (fleet
+        #: scrape target), or None — refreshed on every (re)spawn.
+        self.status_addr: str | None = None
         self.respawns = 0
         self.last_backoff_s = 0.0
         self._dead = False
         #: monotonic deadline of a scheduled-but-not-yet-performed respawn
         #: (None = a live process exists).
         self._spawn_not_before: float | None = None
-        self._spawn()
+        # defer_status_handshake: the Coordinator spawns ALL executors
+        # first (children import obs/jax concurrently), then collects the
+        # handshakes — otherwise startup serializes on N jax imports.
+        self._spawn(wait_handshake=not defer_status_handshake)
 
-    def _spawn(self) -> None:
+    def _spawn(self, *, wait_handshake: bool = True) -> None:
         self._conn, child = self._ctx.Pipe()
         self._proc = self._ctx.Process(
-            target=_subprocess_worker_main, args=(child,), daemon=True,
+            target=_subprocess_worker_main,
+            args=(child, self._status_port), daemon=True,
             name=f"coordinator-proc-{self.worker_id}",
         )
         self._proc.start()
         child.close()
+        self.status_addr = None
+        if self._status_port is not None and wait_handshake:
+            self.wait_status_handshake()
+
+    def wait_status_handshake(self, timeout: float = 60.0) -> None:
+        """Consume the child's ``("status_port", port)`` handshake (the
+        spawn context re-imports this module — and obs/jax with it — in
+        the child, so allow a generous import window).  A handshake that
+        outlives the poll is consumed safely by execute()'s tag loop
+        instead — results never shift by one message."""
+        if self._status_port is None:
+            return
+        try:
+            if self._conn.poll(timeout):
+                tag, port = self._conn.recv()
+                if tag == "status_port" and port:
+                    self.status_addr = f"127.0.0.1:{int(port)}"
+        except (EOFError, OSError):
+            pass
 
     @property
     def pid(self) -> int:
@@ -373,10 +424,21 @@ class _SubprocessExecutor:
                         f"{self.respawns}/{self._max_respawns})"
                     )
                 self._spawn_not_before = None
-                self._spawn()
+                # No handshake wait on the respawn path: execute's tag
+                # loop below consumes it — blocking the failure path 60s
+                # would stall exactly the retry the re-queue depends on.
+                self._spawn(wait_handshake=False)
             try:
                 self._conn.send((fn, args, kwargs))
                 status, payload = self._conn.recv()
+                while status == "status_port":
+                    # Late status handshake (the spawn-time poll gave up
+                    # before the child finished binding): consume it here
+                    # so closure results can never shift by one message.
+                    self.status_addr = (
+                        f"127.0.0.1:{int(payload)}" if payload else None
+                    )
+                    status, payload = self._conn.recv()
             except (EOFError, OSError) as e:
                 self._respawn()
                 raise WorkerUnavailableError(
@@ -580,6 +642,7 @@ class Coordinator:
         max_respawns: int = 8,
         respawn_backoff_s: float = 0.5,
         respawn_backoff_max_s: float = 30.0,
+        worker_status_ports: bool = False,
     ):
         """``use_processes=True`` backs each worker with a real OS process
         (the reference's remote-worker isolation): closures run out-of-
@@ -589,9 +652,19 @@ class Coordinator:
         (``respawn_backoff_s`` base, ``respawn_backoff_max_s`` clamp), so a
         crash-looping worker cannot fork-bomb the host.  Requires picklable
         closures/args; PerWorker values stay thread-mode only.
+
+        ``worker_status_ports=True`` (process mode only) embeds an
+        ephemeral loopback ``obs.StatusServer`` in every worker process so
+        the fleet aggregator can scrape them; the bound addresses are
+        :meth:`worker_status_addrs`.
         """
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
+        if worker_status_ports and not use_processes:
+            raise ValueError(
+                "worker_status_ports requires use_processes=True (thread "
+                "workers share this process's own StatusServer)"
+            )
         self._queue = _ClosureQueue(queue_size)
         self._max_retries = max_retries
         self._stopping = threading.Event()
@@ -604,12 +677,20 @@ class Coordinator:
                     i, max_respawns=max_respawns,
                     respawn_backoff_s=respawn_backoff_s,
                     respawn_backoff_max_s=respawn_backoff_max_s,
+                    status_port=0 if worker_status_ports else None,
+                    # spawn everything first; handshakes collected below
+                    # so the children's obs/jax imports overlap instead
+                    # of serializing Coordinator startup N-fold
+                    defer_status_handshake=True,
                 )
                 for i in range(num_workers)
             ]
             if use_processes
             else None
         )
+        if self._executors and worker_status_ports:
+            for e in self._executors:
+                e.wait_status_handshake()
         self._workers = [_Worker(i, self) for i in range(num_workers)]
         for w in self._workers:
             w.start()
@@ -622,6 +703,15 @@ class Coordinator:
         if not self._executors:
             return None
         return [e.pid for e in self._executors]
+
+    def worker_status_addrs(self) -> list[str | None] | None:
+        """Embedded StatusServer addresses of process-backed workers
+        (``worker_status_ports=True``) — the fleet aggregator's scrape
+        targets; None in thread mode, per-entry None where the child's
+        server failed to bind."""
+        if not self._executors:
+            return None
+        return [e.status_addr for e in self._executors]
 
     def kill_worker_process(self, worker_id: int) -> None:
         """Fault injection: SIGKILL a process-backed worker (its in-flight

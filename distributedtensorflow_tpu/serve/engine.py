@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import registry as obs_registry
+from ..obs import tracing as obs_tracing
 from ..utils.metrics import json_sanitize
 from .kv_cache import PagedKVCache
 from .model import (
@@ -83,6 +84,10 @@ class GenRequest:
     top_k: int = 0
     eos_token_id: int | None = None
     seed: int = 0
+    #: Distributed-tracing id (client-supplied or generated at submit):
+    #: the queue/prefill/decode spans the engine emits into trace.jsonl
+    #: carry it, so a slow request's time is attributable end to end.
+    trace_id: str = ""
 
     # -- lifecycle (engine-owned) --
     status: str = "queued"          # queued/active/ok/rejected/error
@@ -253,6 +258,7 @@ class Engine:
         top_k: int = 0,
         eos_token_id: int | None = None,
         seed: int = 0,
+        trace_id: str | None = None,
     ) -> GenRequest:
         """Validate + enqueue; returns the live :class:`GenRequest`.
 
@@ -299,6 +305,13 @@ class Engine:
             0 <= eos_token_id < self.cfg.vocab_size
         ):
             raise ValueError(f"bad eos_token_id {eos_token_id}")
+        if trace_id is not None:
+            trace_id = str(trace_id)
+            if not 1 <= len(trace_id) <= 64:
+                raise ValueError(
+                    f"trace_id must be 1..64 characters, got "
+                    f"{len(trace_id)}"
+                )
         footprint = self._footprint(len(prompt), max_new_tokens)
         if footprint > self.kv.max_context:
             raise ValueError(
@@ -321,6 +334,7 @@ class Engine:
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=int(top_k),
             eos_token_id=eos_token_id, seed=int(seed),
+            trace_id=trace_id or obs_tracing.new_trace_id(),
             t_submit=time.time(),
         )
         req._rng = np.random.default_rng(req.seed)
@@ -519,10 +533,46 @@ class Engine:
             self._m_tokens.inc(len(req.tokens))
             self._m_e2e.observe(req.e2e_s)
             self._m_tpot.observe(req.tpot_s)
+            self._emit_trace_spans(req)
         self._m_active.set(sum(r is not None for r in self._slots))
         self._m_blocks_free.set(self.kv.allocator.free_blocks)
         self._log_request(req)
         req._done.set()
+
+    def _emit_trace_spans(self, req: GenRequest) -> None:
+        """Distributed request tracing: one root span per completed
+        request plus its queue/prefill/decode phases, written to the
+        active TraceRecorder's trace.jsonl under the request's trace_id
+        (client-supplied via POST /generatez, so a slow request stitches
+        against whatever upstream spans share the id).  Phase boundaries
+        are the lifecycle stamps already taken — zero extra clock reads
+        on the hot path; a no-op when no recorder is installed."""
+        if obs_tracing.active_recorder() is None:
+            return
+        root = obs_tracing.new_span_id()
+        obs_tracing.record_remote_span(
+            "serve.request", t0=req.t_submit, dur_s=req.e2e_s,
+            trace_id=req.trace_id, span_id=root, request=req.id,
+            prompt_tokens=len(req.prompt), new_tokens=len(req.tokens),
+        )
+        obs_tracing.record_remote_span(
+            "serve.queue", t0=req.t_submit,
+            dur_s=max(req.t_admit - req.t_submit, 0.0),
+            trace_id=req.trace_id, parent_id=root, request=req.id,
+        )
+        obs_tracing.record_remote_span(
+            "serve.prefill", t0=req.t_admit,
+            dur_s=max(req.t_first_token - req.t_admit, 0.0),
+            trace_id=req.trace_id, parent_id=root, request=req.id,
+            slot=req.slot if req.slot is not None else -1,
+        )
+        if len(req.tokens) > 1:
+            obs_tracing.record_remote_span(
+                "serve.decode", t0=req.t_first_token,
+                dur_s=max(req.t_done - req.t_first_token, 0.0),
+                trace_id=req.trace_id, parent_id=root, request=req.id,
+                tokens=len(req.tokens),
+            )
 
     # -- loop / lifecycle ----------------------------------------------------
 
@@ -647,6 +697,7 @@ class Engine:
             "status": req.status,
             "prompt_tokens": len(req.prompt),
             "new_tokens": len(req.tokens),
+            "trace_id": req.trace_id,
         }
         if req.status == "ok":
             row.update(

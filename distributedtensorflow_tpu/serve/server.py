@@ -11,9 +11,11 @@ Endpoint contract (docs/API.md "Serving"):
 
 - ``POST /generatez`` — body ``{"prompt": [int, ...], "max_new_tokens":
   int, "temperature"?: float, "top_k"?: int, "eos_token_id"?: int,
-  "seed"?: int, "timeout_s"?: float}``.  Blocks until the request reaches
-  a terminal state; replies 200 ``{"id", "tokens", "finish_reason",
-  "prompt_tokens", "new_tokens", "ttft_s", "tpot_s", "e2e_s"}``.  Error
+  "seed"?: int, "timeout_s"?: float, "trace_id"?: str}``.  Blocks until
+  the request reaches a terminal state; replies 200 ``{"id", "tokens",
+  "trace_id", "finish_reason", "prompt_tokens", "new_tokens", "ttft_s",
+  "tpot_s", "e2e_s"}``.  ``trace_id`` is the distributed-tracing id the
+  engine's queue/prefill/decode spans carry (generated when absent).  Error
   mapping: malformed body/parameters → 400, queue full (backpressure) →
   429, engine failure → 500, wall-clock timeout → 504 (the request keeps
   running server-side; poll ``GET /generatez`` for slot state).
@@ -79,6 +81,13 @@ class ServeServer:
     def port(self) -> int:
         return self._srv.port
 
+    @property
+    def status_server(self):
+        """The underlying :class:`obs.server.StatusServer` — exposed so
+        fleet components (``SLOMonitor.install``, extra routes) can
+        register endpoints next to ``/generatez``."""
+        return self._srv
+
     def _health(self) -> dict:
         st = self.engine.state()
         return {
@@ -121,6 +130,15 @@ class ServeServer:
                                           f"{payload[name]!r}"}
         if "max_new_tokens" not in kwargs:
             return 400, {"error": "'max_new_tokens' is required"}
+        trace_id = payload.get("trace_id")
+        if trace_id is not None:
+            # Distributed tracing: the caller's trace id rides the
+            # request so the engine's queue/prefill/decode spans stitch
+            # against upstream spans (timeline.py --fleet).
+            if not isinstance(trace_id, str) or not 1 <= len(trace_id) <= 64:
+                return 400, {"error": f"bad 'trace_id': {trace_id!r} "
+                                      "(a 1..64-char string)"}
+            kwargs["trace_id"] = trace_id
         timeout = payload.get("timeout_s")
         if timeout is None:
             timeout = self._default_timeout_s
@@ -151,6 +169,7 @@ class ServeServer:
         return 200, {
             "id": req.id,
             "tokens": req.tokens,
+            "trace_id": req.trace_id,
             "finish_reason": req.finish_reason,
             "prompt_tokens": len(req.prompt),
             "new_tokens": len(req.tokens),

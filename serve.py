@@ -119,8 +119,17 @@ def main(argv=None) -> int:
                    help="reject requests asking for more new tokens")
     p.add_argument("--logdir", default=None,
                    help="writes requests.jsonl / metrics.jsonl / "
-                        "metrics.prom here")
+                        "metrics.prom (and, with tracing, trace.jsonl) "
+                        "here")
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--slo-rules", default=None, metavar="JSON",
+                   help="SLO rule file (obs.slo schema): evaluate burn "
+                        "rates over the serve_* histograms on a "
+                        "background thread, expose slo_burn_rate{slo=,"
+                        "window=} in /varz and GET /sloz, raise "
+                        "slo_violation flight events on threshold trips")
+    p.add_argument("--slo-interval", type=float, default=5.0,
+                   help="seconds between SLO burn-rate evaluations")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     logging.basicConfig(
@@ -135,6 +144,19 @@ def main(argv=None) -> int:
 
     cfg = getattr(models, CONFIGS[args.config][0])()
     params = build_params(args, cfg)
+    # Distributed request tracing: with a logdir, every completed request
+    # leaves queue/prefill/decode spans in <logdir>/trace.jsonl keyed by
+    # its trace_id (client-suppliable via POST /generatez) — the stream
+    # tools/timeline.py --fleet stitches across processes.
+    tracer = None
+    if args.logdir:
+        import os
+
+        from distributedtensorflow_tpu.obs.tracing import TraceRecorder
+
+        tracer = TraceRecorder(
+            os.path.join(args.logdir, "trace.jsonl")
+        ).install()
     engine = Engine(
         params, cfg,
         max_slots=args.max_slots, max_queue=args.max_queue,
@@ -144,6 +166,20 @@ def main(argv=None) -> int:
         log_every=args.log_every,
     ).start()
     server = ServeServer(engine, args.port, host=args.host).start()
+
+    slo_monitor = None
+    if args.slo_rules:
+        from distributedtensorflow_tpu.obs.slo import SLOMonitor, load_rules
+
+        try:
+            rules = load_rules(args.slo_rules)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            raise SystemExit(f"--slo-rules {args.slo_rules}: {e}")
+        slo_monitor = SLOMonitor(
+            rules, interval_s=args.slo_interval
+        ).install(server.status_server).start()
+        logging.info("slo monitor: %d rule(s) from %s (GET /sloz)",
+                     len(rules), args.slo_rules)
 
     stop = threading.Event()
 
@@ -166,8 +202,13 @@ def main(argv=None) -> int:
     )
     while not stop.is_set():
         time.sleep(0.2)
+    if slo_monitor is not None:
+        slo_monitor.stop()
     server.stop()
     engine.stop(drain=True)
+    if tracer is not None:
+        tracer.uninstall()
+        tracer.close()
     st = engine.state()
     logging.info(
         "served %d ok / %d rejected / %d error; %d tokens, peak "

@@ -103,3 +103,42 @@ def test_thread_mode_has_no_pids():
         assert c.worker_pids() is None
         with pytest.raises(RuntimeError):
             c.kill_worker_process(0)
+
+
+def test_worker_status_ports_scrapeable():
+    """ISSUE 11: worker_status_ports embeds a StatusServer in every
+    worker process — the fleet aggregator's scrape target — reporting
+    the worker's closure count."""
+    import urllib.request
+
+    c = Coordinator(num_workers=2, use_processes=True,
+                    worker_status_ports=True)
+    try:
+        addrs = c.worker_status_addrs()
+        assert len(addrs) == 2 and all(a for a in addrs)
+        rvs = [c.schedule(_pid, (i,)) for i in range(4)]
+        c.join()
+        pids = {rv.fetch()[0] for rv in rvs}
+        done = 0
+        for addr in addrs:
+            body = urllib.request.urlopen(
+                f"http://{addr}/statusz", timeout=10
+            ).read().decode()
+            assert "coordinator_worker" in body
+            assert any(str(pid) in body for pid in pids)
+            for line in body.splitlines():
+                if "closures_done" in line:
+                    done += int(line.split()[-1])
+        assert done == 4  # every closure accounted across the pool
+        # /varz answers too (the aggregator scrapes this endpoint)
+        status = urllib.request.urlopen(
+            f"http://{addrs[0]}/varz", timeout=10
+        ).status
+        assert status == 200
+    finally:
+        c.shutdown()
+
+
+def test_worker_status_ports_requires_processes():
+    with pytest.raises(ValueError):
+        Coordinator(num_workers=1, worker_status_ports=True)

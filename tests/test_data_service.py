@@ -410,3 +410,110 @@ def test_worker_refuses_retired_epoch(dispatcher):
         assert "retired" in header["error"]
     finally:
         w.stop()
+
+
+def test_trace_context_stitches_client_dispatcher_worker(dispatcher, tmp_path):
+    """ISSUE 11 distributed tracing: one data-service epoch leaves
+    client -> dispatcher -> worker spans in trace.jsonl under ONE
+    trace_id, and the first raw-wire batch echoes the context in its
+    header (data/wire.py)."""
+    import json
+
+    from distributedtensorflow_tpu.data import wire as wirelib
+    from distributedtensorflow_tpu.obs.tracing import TraceRecorder
+
+    rec = TraceRecorder(str(tmp_path / "trace.jsonl")).install()
+    workers = [
+        WorkerServer(dispatcher.target(), _sharded_input_fn(), port=0)
+        for _ in range(2)
+    ]
+    try:
+        with DataServiceClient(dispatcher.target(), epoch=0) as client:
+            batches = list(client)
+        assert len(batches) == 12
+    finally:
+        rec.uninstall()
+        rec.close()
+        for w in workers:
+            w.stop()
+    rows = [json.loads(l)
+            for l in (tmp_path / "trace.jsonl").read_text().splitlines()]
+    spans = [r for r in rows if r.get("kind") == "span"]
+    names = {s["name"] for s in spans}
+    assert {"data_service.start_epoch", "dispatcher.start_epoch",
+            "data_service.fetch_split", "data_worker.get_next"} <= names
+    assert len({s["trace_id"] for s in spans}) == 1  # ONE shared trace
+    root = next(s for s in spans if s["name"] == "data_service.start_epoch")
+    assert "parent_id" not in root
+    # dispatcher + fetch spans parent under the client root
+    for name in ("dispatcher.start_epoch", "data_service.fetch_split"):
+        child = next(s for s in spans if s["name"] == name)
+        assert child["parent_id"] == root["span_id"]
+    # worker spans parent under SOME fetch-split span
+    fetch_ids = {s["span_id"] for s in spans
+                 if s["name"] == "data_service.fetch_split"}
+    worker_spans = [s for s in spans if s["name"] == "data_worker.get_next"]
+    assert len(worker_spans) == 2  # one per split STREAM, not per batch
+    assert all(s["parent_id"] in fetch_ids for s in worker_spans)
+    # absolute timestamps: spans nest in wall-clock time
+    assert all(s["t0"] >= root["t0"] - 0.001 for s in spans)
+
+    # wire-header echo: a traced get_next's response batch carries the
+    # context verbatim
+    w = WorkerServer(dispatcher.target(), _sharded_input_fn(), port=0)
+    try:
+        ctx = {"trace_id": "cafe0123", "span_id": "beef4567"}
+        header, data = w._handle({
+            "kind": "get_next", "epoch": "9", "gen": 0, "split": 0,
+            "num_shards": 1, "skip": 0, "wire": "raw", "trace": ctx,
+        })
+        assert header["ok"]
+        echoed = wirelib.peek_trace(data)
+        assert echoed is not None
+        assert echoed["trace_id"] == "cafe0123"
+        # the worker's own span id, parented under the client's
+        assert echoed["span_id"] != "beef4567"
+        # untraced requests carry no header echo
+        header, data = w._handle({
+            "kind": "get_next", "epoch": "9", "gen": 0, "split": 0,
+            "num_shards": 1, "skip": 0, "wire": "raw",
+        })
+        assert wirelib.peek_trace(data) is None
+    finally:
+        w.stop()
+
+
+def test_worker_embedded_status_server(dispatcher):
+    """The satellite: a worker with status_port=0 serves the whole
+    /statusz family; kill() severs it so a fleet scrape flips to down."""
+    import urllib.error
+    import urllib.request
+
+    w = WorkerServer(
+        dispatcher.target(), _sharded_input_fn(), port=0, status_port=0,
+    )
+    try:
+        assert w.status_addr is not None
+        body = urllib.request.urlopen(
+            f"http://{w.status_addr}/statusz", timeout=5
+        ).read().decode()
+        assert "data_worker" in body and w.addr in body
+        health = urllib.request.urlopen(
+            f"http://{w.status_addr}/healthz", timeout=5
+        ).read().decode()
+        assert '"ok": true' in health
+        # serve one batch; the worker-side count shows on /statusz
+        header, _ = w._handle({
+            "kind": "get_next", "epoch": "0", "gen": 0, "split": 0,
+            "num_shards": 1, "skip": 0, "wire": "raw",
+        })
+        assert header["ok"]
+        body = urllib.request.urlopen(
+            f"http://{w.status_addr}/statusz", timeout=5
+        ).read().decode()
+        assert "batches_served" in body
+        addr = w.status_addr
+    finally:
+        w.kill()  # simulated crash: the status server dies with it
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(f"http://{addr}/healthz", timeout=2)

@@ -1,0 +1,610 @@
+"""Fleet observability plane (ISSUE 11): /varz aggregation + peer
+liveness, the SLO burn-rate monitor, cross-process trace spans, and the
+new schema gates — all in-process (stdlib HTTP threads, no subprocesses).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributedtensorflow_tpu import obs
+from distributedtensorflow_tpu.obs import fleet as fleet_mod
+from distributedtensorflow_tpu.obs import slo as slo_mod
+from distributedtensorflow_tpu.obs import tracing
+from distributedtensorflow_tpu.obs.aggregate import spread_ratio
+from tools import check_metrics_schema
+
+
+def _get(addr, path, timeout=10):
+    r = urllib.request.urlopen(f"http://{addr}{path}", timeout=timeout)
+    return r.status, r.read().decode()
+
+
+# --- spread_ratio degenerate inputs (satellite) ------------------------------
+
+
+def test_spread_ratio_normal_and_degenerate():
+    agg = {"t_host_median": 2.0, "t_host_max": 5.0}
+    assert spread_ratio(agg, "t") == pytest.approx(2.5)
+    # absent fields -> 1.0 (nothing to compare)
+    assert spread_ratio({}, "t") == 1.0
+    assert spread_ratio({"t_host_median": 2.0}, "t") == 1.0
+    # zero / negative median -> 1.0, never a ZeroDivisionError
+    assert spread_ratio({"t_host_median": 0.0, "t_host_max": 9.0}, "t") == 1.0
+    assert spread_ratio({"t_host_median": -1.0, "t_host_max": 9.0}, "t") == 1.0
+    # non-numeric junk -> 1.0
+    assert spread_ratio({"t_host_median": "x", "t_host_max": 9.0}, "t") == 1.0
+
+
+# --- merge arithmetic degenerate inputs (satellite) --------------------------
+
+
+def test_merge_samples_single_peer():
+    merged = fleet_mod.merge_samples({"only": {"x": 3.0, "y": 0.0}})
+    assert merged["x"] == {"min": 3.0, "median": 3.0, "max": 3.0,
+                           "sum": 3.0, "n": 1.0, "max_peer": "only"}
+    assert merged["y"]["n"] == 1.0
+
+
+def test_merge_samples_multi_peer_and_disjoint_keys():
+    merged = fleet_mod.merge_samples({
+        "a": {"x": 1.0, "only_a": 7.0},
+        "b": {"x": 3.0},
+        "c": {"x": 2.0},
+    })
+    x = merged["x"]
+    assert (x["min"], x["median"], x["max"], x["sum"], x["n"]) == \
+        (1.0, 2.0, 3.0, 6.0, 3.0)
+    assert x["max_peer"] == "b"
+    assert merged["only_a"]["n"] == 1.0
+
+
+def test_merge_samples_empty_and_nonfinite():
+    assert fleet_mod.merge_samples({}) == {}
+    assert fleet_mod.merge_samples({"a": {}}) == {}
+    # one peer's NaN/Inf must not poison the merged view
+    merged = fleet_mod.merge_samples({
+        "a": {"x": float("nan")}, "b": {"x": 2.0}, "c": {"x": float("inf")},
+    })
+    assert merged["x"]["n"] == 1.0
+    assert merged["x"]["max"] == 2.0
+
+
+def test_parse_prometheus_roundtrip_and_malformed():
+    reg = obs.Registry()
+    reg.counter("c_total").inc(2, worker="w0")
+    reg.gauge("g").set(1.5)
+    reg.histogram("h", buckets=(0.1, 1.0)).observe(0.5)
+    samples = fleet_mod.parse_prometheus(reg.to_prometheus())
+    assert samples['c_total{worker="w0"}'] == 2.0
+    assert samples["g"] == 1.5
+    assert samples["h_count"] == 1.0
+    with pytest.raises(fleet_mod.FleetScrapeError):
+        fleet_mod.parse_prometheus("this is { not exposition\n")
+    with pytest.raises(fleet_mod.FleetScrapeError):
+        fleet_mod.parse_prometheus("metric_name not_a_number\n")
+
+
+# --- aggregator over real StatusServers --------------------------------------
+
+
+class _GarbageHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        body = b"%% this is (not) prometheus %%\n"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture
+def two_peers():
+    """Two StatusServers with DISTINCT registries (distinct sample
+    values, so the merge has a spread to see)."""
+    servers = []
+    for v in (10.0, 30.0):
+        reg = obs.Registry()
+        reg.counter("data_service_batches_served_total").inc(v)
+        reg.gauge("g").set(v)
+        servers.append(obs.StatusServer(0, registry=reg).start())
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def test_aggregator_merges_and_detects_straggler(two_peers, tmp_path):
+    agg = fleet_mod.FleetAggregator(
+        interval_s=0.1, logdir=str(tmp_path), registry=obs.Registry(),
+        spread_threshold=1.4,
+    )
+    agg.add_peer("p0", f"127.0.0.1:{two_peers[0].port}")
+    agg.add_peer("p1", f"127.0.0.1:{two_peers[1].port}")
+    view = agg.scrape_once()
+    assert view["states"] == {"up": 2, "stale": 0, "down": 0}
+    g = view["metrics"]["g"]
+    assert (g["min"], g["max"], g["sum"], g["n"]) == (10.0, 30.0, 40.0, 2.0)
+    # straggler: served-batches spread 30/20 = 1.5 >= threshold
+    ws = view["worst_spread"]
+    assert ws["key"] == "data_service_batches_served_total"
+    assert ws["ratio"] == pytest.approx(1.5)
+    assert ws["peer"] == "p1"
+    assert ws["straggling"] is True
+    # snapshot persisted and passes its schema gate
+    doc = json.loads((tmp_path / "fleet.json").read_text())
+    assert doc["states"]["up"] == 2
+    errors, _ = check_metrics_schema.check_fleet_doc(doc)
+    assert errors == []
+
+
+def test_killed_peer_flips_down_within_one_scrape(two_peers, tmp_path):
+    agg = fleet_mod.FleetAggregator(
+        interval_s=0.1, registry=obs.Registry(),
+    )
+    agg.add_peer("p0", f"127.0.0.1:{two_peers[0].port}")
+    agg.add_peer("p1", f"127.0.0.1:{two_peers[1].port}")
+    agg.scrape_once()
+    two_peers[1].stop()  # the kill: connection now refused
+    view = agg.scrape_once()  # ONE scrape round flips it
+    assert view["peers"]["p0"]["state"] == "up"
+    assert view["peers"]["p1"]["state"] == "down"
+    # the dead peer's samples left the merged view
+    assert view["metrics"]["g"]["n"] == 1.0
+
+
+def test_malformed_exposition_marks_down_never_poisons(two_peers):
+    garbage = ThreadingHTTPServer(("127.0.0.1", 0), _GarbageHandler)
+    t = threading.Thread(target=garbage.serve_forever, daemon=True)
+    t.start()
+    try:
+        agg = fleet_mod.FleetAggregator(
+            interval_s=0.1, registry=obs.Registry(),
+        )
+        agg.add_peer("ok", f"127.0.0.1:{two_peers[0].port}")
+        agg.add_peer("sick", f"127.0.0.1:{garbage.server_address[1]}")
+        view = agg.scrape_once()  # must not raise
+        assert view["peers"]["sick"]["state"] == "down"
+        assert "FleetScrapeError" in view["peers"]["sick"]["last_error"]
+        assert view["peers"]["ok"]["state"] == "up"
+        # merged view carries ONLY the healthy peer
+        assert view["metrics"]["g"]["n"] == 1.0
+    finally:
+        garbage.shutdown()
+        garbage.server_close()
+
+
+def test_all_stale_then_down_peers_keep_merge_sane(two_peers):
+    """All peers failing: a soft failure keeps last-known samples
+    (stale); past stale_after_s — or on a hard refusal — the merge goes
+    empty rather than serving ghost data forever."""
+    agg = fleet_mod.FleetAggregator(
+        interval_s=0.1, stale_after_s=30.0, registry=obs.Registry(),
+    )
+    agg.add_peer("p0", f"127.0.0.1:{two_peers[0].port}")
+    agg.scrape_once()
+    two_peers[0].stop()
+    view = agg.scrape_once()
+    # a refused connection is a HARD failure: down, merge empty
+    assert view["peers"]["p0"]["state"] == "down"
+    assert view["metrics"] == {}
+    assert view["worst_spread"] is None
+
+
+def test_fleetz_endpoint_text_and_json(two_peers):
+    reg = obs.Registry()
+    chief = obs.StatusServer(0, registry=reg).start()
+    try:
+        agg = fleet_mod.FleetAggregator(interval_s=0.1, registry=reg)
+        agg.add_peer("p0", f"127.0.0.1:{two_peers[0].port}")
+        agg.install(chief)
+        agg.scrape_once()
+        status, body = _get(f"127.0.0.1:{chief.port}", "/fleetz")
+        assert status == 200
+        assert "1 up" in body and "p0" in body
+        status, body = _get(f"127.0.0.1:{chief.port}", "/fleetz?json")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["peers"]["p0"]["state"] == "up"
+        assert "g" in doc["metrics"]
+        # ?metric filter renders a table
+        status, body = _get(f"127.0.0.1:{chief.port}", "/fleetz?metric=g")
+        assert "median" in body
+        # the registry gained the fleet gauge families
+        assert reg.gauge("fleet_peers").value(state="up") == 1.0
+        prom = reg.to_prometheus()
+        assert "fleet_scrape_seconds" in prom
+    finally:
+        chief.stop()
+
+
+def test_fleet_background_loop_scrapes(two_peers):
+    agg = fleet_mod.FleetAggregator(interval_s=0.05, registry=obs.Registry())
+    agg.add_peer("p0", f"127.0.0.1:{two_peers[0].port}")
+    with agg:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if agg.view()["scrape_rounds"] >= 2:
+                break
+            time.sleep(0.02)
+    assert agg.view()["scrape_rounds"] >= 2
+    assert agg.view()["peers"]["p0"]["state"] == "up"
+
+
+# --- SLO monitor -------------------------------------------------------------
+
+
+def _latency_rule(**kw):
+    base = dict(
+        name="e2e_p99", kind="histogram_under", metric="serve_e2e_seconds",
+        threshold=0.25, objective=0.9, fast_window_s=10.0,
+        slow_window_s=60.0, fast_burn=5.0, slow_burn=2.0,
+    )
+    base.update(kw)
+    return base
+
+
+def test_slo_rule_validation():
+    slo_mod.SLORule.from_dict(_latency_rule())  # valid
+    for bad in (
+        _latency_rule(kind="nope"),
+        _latency_rule(objective=1.0),
+        _latency_rule(objective=-0.1),
+        _latency_rule(threshold=0),
+        _latency_rule(fast_window_s=100.0, slow_window_s=10.0),
+        _latency_rule(fast_burn=0),
+        {"name": "", "kind": "histogram_under", "metric": "m",
+         "objective": 0.5, "threshold": 1.0},
+        {"name": "g", "kind": "gauge_good_fraction", "metric": "m",
+         "objective": 0.5, "threshold": 1.0},  # threshold on a gauge rule
+    ):
+        with pytest.raises(ValueError):
+            slo_mod.SLORule.from_dict(bad)
+    assert slo_mod.validate_rules_doc(
+        {"slos": [_latency_rule(), _latency_rule()]}
+    )  # duplicate names
+    assert slo_mod.validate_rules_doc({"nope": 1})
+    assert slo_mod.validate_rules_doc([_latency_rule()]) == []
+
+
+def test_load_rules_file(tmp_path):
+    path = tmp_path / "slo_rules.json"
+    path.write_text(json.dumps({"slos": [_latency_rule()]}))
+    rules = slo_mod.load_rules(str(path))
+    assert rules[0].name == "e2e_p99"
+    path.write_text(json.dumps({"slos": [_latency_rule(objective=2.0)]}))
+    with pytest.raises(ValueError):
+        slo_mod.load_rules(str(path))
+
+
+def test_histogram_count_under_interpolation():
+    reg = obs.Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.total_count() == 4.0
+    assert h.count_under(0.1) == 2.0
+    # halfway through the (0.1, 1.0] bucket: 2 + 1 * (0.55-0.1)/0.9
+    assert h.count_under(0.55) == pytest.approx(2.5)
+    # past the last finite edge: the +Inf tail stays conservative (bad)
+    assert h.count_under(1.0) == 3.0
+    assert h.count_under(2.0) == 3.0
+    assert h.count_under(float("inf")) == 4.0
+    assert h.count_under(0.0) == 0.0
+
+
+def test_slo_burn_violation_and_flight_event():
+    reg = obs.Registry()
+    flight = obs.FlightRecorder(capacity=16)
+    prev = obs.install_recorder(flight)
+    try:
+        h = reg.histogram("serve_e2e_seconds")
+        mon = slo_mod.SLOMonitor(
+            [_latency_rule()], registry=reg, interval_s=1.0,
+        )
+        # healthy traffic: all under threshold
+        for _ in range(10):
+            h.observe(0.01)
+        res = mon.evaluate(now=1000.0)[0]
+        assert res["burn_fast"] == 0.0 and not res["violating_fast"]
+        # breach: every request above the objective threshold
+        for _ in range(20):
+            h.observe(3.0)
+        res = mon.evaluate(now=1003.0)[0]
+        # 20/30 bad in-window -> burn (20/30)/0.1 ~ 6.7 > fast 5.0, slow 2.0
+        assert res["burn_fast"] > 5.0
+        assert res["violating_fast"] and res["violating_slow"]
+        assert res["violations"] == 2
+        events = [e for e in flight.events()
+                  if e["kind"] == "slo_violation"]
+        assert {e["window"] for e in events} == {"fast", "slow"}
+        assert all(e["slo"] == "e2e_p99" for e in events)
+        # edge-triggered: a repeat evaluation while still burning does
+        # NOT re-fire
+        res = mon.evaluate(now=1004.0)[0]
+        assert res["violations"] == 2
+        assert len([e for e in flight.events()
+                    if e["kind"] == "slo_violation"]) == 2
+        # burn gauges exported, non-negative
+        assert reg.gauge("slo_burn_rate").value(
+            slo="e2e_p99", window="fast") >= 0.0
+        assert reg.counter("slo_violations_total").value(
+            slo="e2e_p99") == 2.0
+    finally:
+        obs.install_recorder(prev)
+
+
+def test_slo_gauge_rules_and_no_data():
+    reg = obs.Registry()
+    rules = [
+        {"name": "goodput", "kind": "gauge_good_fraction",
+         "metric": "goodput_fraction", "objective": 0.7,
+         "fast_window_s": 10, "slow_window_s": 60,
+         "fast_burn": 2.0, "slow_burn": 1.5},
+        {"name": "data_wait", "kind": "gauge_bad_fraction",
+         "metric": "data_wait_share", "objective": 0.8,
+         "fast_window_s": 10, "slow_window_s": 60,
+         "fast_burn": 2.0, "slow_burn": 1.5},
+    ]
+    mon = slo_mod.SLOMonitor(rules, registry=reg, interval_s=1.0)
+    # nothing written yet: no data, burn 0, no violation
+    res = {r["name"]: r for r in mon.evaluate(now=10.0)}
+    assert res["goodput"]["no_data_fast"] and res["goodput"]["burn_fast"] == 0
+    assert not res["goodput"]["violating_fast"]
+    # healthy values
+    reg.gauge("goodput_fraction").set(0.95)
+    reg.gauge("data_wait_share").set(0.05)
+    res = {r["name"]: r for r in mon.evaluate(now=11.0)}
+    assert res["goodput"]["burn_fast"] == pytest.approx(0.05 / 0.3)
+    assert not res["data_wait"]["violating_fast"]
+    # breach: goodput collapses, data-wait blows up
+    reg.gauge("goodput_fraction").set(0.1)
+    reg.gauge("data_wait_share").set(0.9)
+    res = {r["name"]: r for r in mon.evaluate(now=25.0)}
+    assert res["goodput"]["violating_fast"]
+    assert res["data_wait"]["violating_fast"]
+    assert res["data_wait"]["burn_fast"] >= 0.0
+
+
+class _FakeCapture:
+    def __init__(self):
+        self.requests = []
+
+    def request(self, trigger, **kw):
+        self.requests.append((trigger, kw))
+        return True, "armed"
+
+
+def test_slo_fast_burn_arms_capture_engine():
+    reg = obs.Registry()
+    cap = _FakeCapture()
+    h = reg.histogram("serve_e2e_seconds")
+    mon = slo_mod.SLOMonitor(
+        [_latency_rule()], registry=reg, interval_s=1.0, capture_engine=cap,
+    )
+    mon.evaluate(now=100.0)
+    for _ in range(20):
+        h.observe(3.0)
+    mon.evaluate(now=103.0)
+    assert [t for t, _ in cap.requests] == ["slo_burn"]
+    assert "slo_burn" in __import__(
+        "distributedtensorflow_tpu.obs.capture", fromlist=["TRIGGERS"]
+    ).TRIGGERS
+
+
+def test_sloz_endpoint():
+    reg = obs.Registry()
+    srv = obs.StatusServer(0, registry=reg).start()
+    try:
+        mon = slo_mod.SLOMonitor(
+            [_latency_rule()], registry=reg, interval_s=1.0,
+        ).install(srv)
+        mon.evaluate(now=50.0)
+        status, body = _get(f"127.0.0.1:{srv.port}", "/sloz")
+        assert status == 200 and "e2e_p99" in body
+        status, body = _get(f"127.0.0.1:{srv.port}", "/sloz?json")
+        doc = json.loads(body)
+        assert doc["rules"][0]["name"] == "e2e_p99"
+    finally:
+        srv.stop()
+
+
+# --- cross-process trace spans ----------------------------------------------
+
+
+def test_remote_span_context_propagation(tmp_path):
+    rec = tracing.TraceRecorder(str(tmp_path / "trace.jsonl")).install()
+    try:
+        with tracing.remote_span("root", role="client") as root:
+            ctx = tracing.current_context()
+            assert ctx == root.context
+            wire_ctx = dict(ctx)  # "sent over the wire"
+            with tracing.remote_span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+        assert tracing.current_context() is None
+        # the receiving "process" parents under the wire context
+        with tracing.remote_span("server_side", context=wire_ctx) as srv:
+            assert srv.trace_id == root.trace_id
+            assert srv.parent_id == root.span_id
+    finally:
+        rec.uninstall()
+        rec.close()
+    rows = [json.loads(l) for l in
+            (tmp_path / "trace.jsonl").read_text().splitlines()]
+    spans = [r for r in rows if r.get("kind") == "span"]
+    assert [s["name"] for s in spans] == ["child", "root", "server_side"]
+    assert len({s["trace_id"] for s in spans}) == 1
+    assert all(s["dur_s"] >= 0 and s["t0"] > 0 for s in spans)
+    assert spans[1]["role"] == "client"
+
+
+def test_remote_span_noop_without_recorder():
+    with tracing.remote_span("orphan") as sp:
+        pass
+    assert sp.row is None  # nothing installed, nothing written, no crash
+
+
+# --- schema gates for the new artifacts --------------------------------------
+
+
+def test_schema_checker_slo_rules(tmp_path):
+    good = tmp_path / "slo_rules.json"
+    good.write_text(json.dumps({"slos": [_latency_rule()]}))
+    errors, _ = check_metrics_schema.check_file(str(good))
+    assert errors == []
+    assert check_metrics_schema.main([str(good)]) == 0
+    bad = tmp_path / "slo_bad.json"
+    bad.write_text(json.dumps({"slos": [
+        _latency_rule(objective=1.5, kind="nope", fast_burn=-1),
+    ]}))
+    errors, _ = check_metrics_schema.check_file(str(bad))
+    assert len(errors) >= 3
+    assert check_metrics_schema.main([str(bad)]) == 1
+
+
+def test_schema_checker_fleet_doc(tmp_path):
+    doc = {
+        "t": 1.0, "interval_s": 2.0, "scrape_rounds": 3,
+        "peers": {"chief": {"addr": "127.0.0.1:1", "state": "up",
+                            "age_s": 0.5, "ok": 3, "errors": 0}},
+        "states": {"up": 1, "stale": 0, "down": 0},
+        "worst_spread": {"key": "x", "ratio": 1.2, "peer": "chief",
+                         "straggling": False},
+        "metrics_merged": 10,
+    }
+    p = tmp_path / "fleet.json"
+    p.write_text(json.dumps(doc))
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert errors == []
+    doc["peers"]["chief"]["state"] = "zombie"
+    doc["worst_spread"]["ratio"] = -1
+    p.write_text(json.dumps(doc))
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 2
+
+
+def test_schema_checker_prom_and_jsonl_fleet_slo_labels(tmp_path):
+    prom = tmp_path / "metrics.prom"
+    prom.write_text(
+        "# TYPE fleet_peers gauge\n"
+        'fleet_peers{state="up"} 3\n'
+        "# TYPE slo_burn_rate gauge\n"
+        'slo_burn_rate{slo="e2e",window="fast"} 0.5\n'
+    )
+    errors, _ = check_metrics_schema.check_file(str(prom))
+    assert errors == []
+    prom.write_text(
+        'fleet_peers{state="zombie"} 3\n'
+        'slo_burn_rate{slo="e2e",window="daily"} 0.5\n'
+        'slo_burn_rate{window="fast"} -2\n'
+    )
+    errors, _ = check_metrics_schema.check_file(str(prom))
+    assert len(errors) == 4  # bad state, bad window, missing slo, negative
+    rows = tmp_path / "metrics.jsonl"
+    rows.write_text(json.dumps({
+        "step": 1, "fleet_peers.state_up": 3,
+        "slo_burn_rate.slo_e2e.window_fast": 0.5,
+    }) + "\n")
+    errors, _ = check_metrics_schema.check_file(str(rows))
+    assert errors == []
+    rows.write_text(json.dumps({
+        "step": 1, "fleet_peers.state_zombie": 3,
+        "slo_burn_rate.slo_e2e.window_daily": -0.5,
+    }) + "\n")
+    errors, _ = check_metrics_schema.check_file(str(rows))
+    assert len(errors) == 3  # bad state, bad window, negative burn
+
+
+def test_schema_checker_timeline_doc(tmp_path):
+    p = tmp_path / "timeline_fleet.json"
+    p.write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name", "args": {"name": "x"}},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "s", "ts": 0.0, "dur": 5.0},
+    ]}))
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert errors == []
+    p.write_text(json.dumps({"traceEvents": [
+        {"pid": 1}, {"ph": "X", "ts": "NaN-ish"}, {"ph": "X", "dur": -1},
+    ]}))
+    errors, _ = check_metrics_schema.check_file(str(p))
+    assert len(errors) == 3
+
+
+def test_peer_states_and_windows_stay_in_sync():
+    assert set(check_metrics_schema.FLEET_PEER_STATES) == \
+        set(fleet_mod.PEER_STATES)
+    assert set(check_metrics_schema.SLO_WINDOWS) == set(slo_mod.SLO_WINDOWS)
+    assert set(check_metrics_schema.SLO_RULE_KINDS) == \
+        set(slo_mod.RULE_KINDS)
+
+
+def test_slo_monitor_never_creates_or_squats_metrics():
+    """Review finding: the monitor's lookup must be READ-ONLY — a rule on
+    a not-yet-created metric must not register the name with the
+    monitor's kind (which would crash the real producer's later
+    registration with a kind mismatch)."""
+    reg = obs.Registry()
+    mon = slo_mod.SLOMonitor(
+        [_latency_rule(metric="late_histogram"),
+         {"name": "g", "kind": "gauge_bad_fraction",
+          "metric": "late_gauge", "objective": 0.5}],
+        registry=reg, interval_s=1.0,
+    )
+    res = {r["name"]: r for r in mon.evaluate(now=1.0)}
+    assert res["e2e_p99"]["no_data_fast"] and res["g"]["no_data_fast"]
+    # the PRODUCER registers them afterwards — with custom buckets — and
+    # must not hit a kind clash or bucket clobbering
+    h = reg.histogram("late_histogram", buckets=(0.05, 0.5))
+    assert h.buckets == (0.05, 0.5)
+    reg.gauge("late_gauge").set(0.9)
+    mon.evaluate(now=2.0)  # first histogram snapshot (window baseline)
+    for _ in range(5):
+        h.observe(3.0)
+    res = {r["name"]: r for r in mon.evaluate(now=3.0)}
+    assert res["e2e_p99"]["burn_fast"] > 0
+    assert res["g"]["burn_fast"] > 0
+    # a rule whose metric exists as the WRONG kind stays no-data forever
+    # instead of raising
+    reg.counter("a_counter").inc()
+    mon2 = slo_mod.SLOMonitor(
+        [_latency_rule(name="wrong", metric="a_counter")],
+        registry=reg, interval_s=1.0,
+    )
+    assert mon2.evaluate(now=1.0)[0]["no_data_fast"]
+
+
+class _Http500Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        self.send_response(500)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+def test_http_error_peer_is_hard_down(two_peers):
+    """Review finding: urlopen raises HTTPError for non-2xx, which must
+    classify as DOWN (not stale) — a sick peer's stale samples must not
+    keep feeding the merge for stale_after_s."""
+    sick = ThreadingHTTPServer(("127.0.0.1", 0), _Http500Handler)
+    t = threading.Thread(target=sick.serve_forever, daemon=True)
+    t.start()
+    try:
+        agg = fleet_mod.FleetAggregator(
+            interval_s=0.1, stale_after_s=60.0, registry=obs.Registry(),
+        )
+        agg.add_peer("ok", f"127.0.0.1:{two_peers[0].port}")
+        agg.add_peer("sick", f"127.0.0.1:{sick.server_address[1]}")
+        view = agg.scrape_once()
+        assert view["peers"]["sick"]["state"] == "down"
+        assert view["metrics"]["g"]["n"] == 1.0
+    finally:
+        sick.shutdown()
+        sick.server_close()

@@ -456,3 +456,79 @@ def test_prom_schema_validates_prefetch_labels(tmp_path):
     p.write_text('data_prefetch_depth{component="mystery"} 4\n')
     errors, _ = check_metrics_schema.check_file(str(p))
     assert len(errors) == 1 and "component" in errors[0]
+
+
+def test_report_fleet_section(logdir, capsys):
+    """ISSUE 11: fleet.json peers + worst spread, last-record SLO burn
+    fields, slo_violation flight events, and the cross-process trace
+    census render in text and --json."""
+    (logdir / "fleet.json").write_text(json.dumps({
+        "t": 1.0, "interval_s": 0.5, "scrape_rounds": 4,
+        "peers": {
+            "chief": {"addr": "127.0.0.1:1", "state": "up", "age_s": 0.1,
+                      "ok": 4, "errors": 0},
+            "data_worker0": {"addr": "127.0.0.1:2", "state": "down",
+                             "age_s": 3.0, "ok": 2, "errors": 2},
+        },
+        "states": {"up": 1, "stale": 0, "down": 1},
+        "worst_spread": {"key": "data_service_batches_served_total",
+                         "ratio": 2.5, "peer": "data_worker0",
+                         "straggling": True},
+        "metrics_merged": 12,
+    }))
+    # burn fields ride the last metric record (registry flattening)
+    rows, _ = run_report._load_jsonl(str(logdir / "metrics.jsonl"))
+    rows[-1]["slo_burn_rate.slo_e2e_p99.window_fast"] = 3.5
+    rows[-1]["slo_burn_rate.slo_e2e_p99.window_slow"] = 1.2
+    _write_jsonl(logdir / "metrics.jsonl", rows)
+    _write_jsonl(logdir / "flight.jsonl", [
+        {"t": 1.0, "kind": "fit_begin", "step": 0},
+        {"t": 2.0, "kind": "slo_violation", "slo": "e2e_p99",
+         "window": "fast", "burn": 3.5, "limit": 2.0,
+         "metric": "serve_e2e_seconds"},
+        {"t": 3.0, "kind": "fit_end", "step": 100},
+    ])
+    # cross-process span rows in the trace stream
+    trace, _ = run_report._load_jsonl(str(logdir / "trace.jsonl"))
+    trace += [
+        {"kind": "span", "name": "data_service.start_epoch",
+         "trace_id": "aaaa", "span_id": "1", "t0": 1.0, "dur_s": 0.5},
+        {"kind": "span", "name": "data_worker.get_next",
+         "trace_id": "aaaa", "span_id": "2", "parent_id": "1",
+         "t0": 1.1, "dur_s": 0.1},
+        {"kind": "span", "name": "serve.request", "trace_id": "bbbb",
+         "span_id": "3", "t0": 2.0, "dur_s": 0.2},
+    ]
+    _write_jsonl(logdir / "trace.jsonl", trace)
+
+    report = run_report.build_report(str(logdir))
+    flt = report["fleet"]
+    assert flt["peer_states"] == {"up": 1, "down": 1}
+    assert flt["worst_spread"]["ratio"] == 2.5
+    assert flt["slo_burn_rates"]["e2e_p99"]["fast"] == 3.5
+    assert len(flt["slo_violations"]) == 1
+    assert flt["cross_process_traces"] == 2
+    assert flt["cross_process_spans"] == 3
+    text = run_report.render(report)
+    assert "fleet: 2 peer(s) — 1 up, 0 stale, 1 down" in text
+    assert "worst straggler spread: 2.50x" in text
+    assert "slo e2e_p99: fast burn 3.50x" in text
+    assert "SLO VIOLATIONS: 1" in text
+    assert "2 cross-process trace(s) (3 spans)" in text
+    assert run_report.main([str(logdir)]) == 0
+
+
+def test_report_unparseable_trace_exits_nonzero(logdir, capsys):
+    """The satellite: a corrupt trace.jsonl gates the exit code with a
+    one-line diagnostic (the stream-gating convention)."""
+    with open(logdir / "trace.jsonl", "a") as f:
+        f.write("{this is not json\n")
+    assert run_report.main([str(logdir)]) == 1
+    err = capsys.readouterr().err
+    assert "unparseable telemetry entries" in err
+
+
+def test_report_unreadable_fleet_json_exits_nonzero(logdir, capsys):
+    (logdir / "fleet.json").write_text("{truncated")
+    assert run_report.main([str(logdir)]) == 1
+    assert "fleet.json: unreadable" in capsys.readouterr().err

@@ -151,3 +151,82 @@ def test_timeline_without_flight_lays_spans_sequentially(tmp_path):
     rows = [e for e in doc["traceEvents"]
             if e["ph"] == "X" and e["name"].startswith("step ")]
     assert [e["ts"] for e in rows] == [0.0, pytest.approx(1e6)]
+
+
+def test_fleet_mode_stitches_logdirs(tmp_path, capsys):
+    """--fleet: two processes' logdirs land on one clock, with every
+    cross-process span row grouped by trace_id on the shared fleet
+    track."""
+    a = tmp_path / "trainer"
+    b = tmp_path / "serve"
+    a.mkdir(), b.mkdir()
+    _write_jsonl(a / "flight.jsonl", [
+        {"t": T0, "kind": "fit_begin", "step": 0},
+        {"t": T0 + 2.0, "kind": "fit_end", "step": 1},
+    ])
+    _write_jsonl(a / "trace.jsonl", [
+        {"kind": "span", "name": "data_service.start_epoch",
+         "trace_id": "aaaa", "span_id": "s1", "t0": T0 + 0.5,
+         "dur_s": 0.2, "proc": 100},
+        {"kind": "span", "name": "data_worker.get_next",
+         "trace_id": "aaaa", "span_id": "s2", "parent_id": "s1",
+         "t0": T0 + 0.6, "dur_s": 0.05, "proc": 101},
+    ])
+    _write_jsonl(b / "trace.jsonl", [
+        {"kind": "span", "name": "serve.request", "trace_id": "bbbb",
+         "span_id": "s3", "t0": T0 + 1.0, "dur_s": 0.4, "proc": 200},
+    ])
+    doc = timeline.build_fleet_timeline([str(a), str(b)])
+    od = doc["otherData"]
+    assert od["fleet"] is True
+    assert od["cross_process_traces"] == 2
+    assert od["cross_process_spans"] == 3
+    assert od["origin_unix_s"] == T0
+    fleet_events = [e for e in doc["traceEvents"]
+                    if e["pid"] == timeline.PID_FLEET_TRACES
+                    and e.get("ph") == "X"]
+    assert len(fleet_events) == 3
+    # spans of one trace share a lane; different traces get distinct lanes
+    lanes = {}
+    for e in fleet_events:
+        lanes.setdefault(e["args"]["trace_id"], set()).add(e["tid"])
+    assert all(len(tids) == 1 for tids in lanes.values())
+    assert lanes["aaaa"] != lanes["bbbb"]
+    # absolute placement on the common origin: serve.request at +1.0s
+    srv = next(e for e in fleet_events if e["name"] == "serve.request")
+    assert srv["ts"] == pytest.approx(1.0 * 1e6, abs=1.0)
+    # per-logdir groups got distinct pid ranges and prefixed names
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert any(n.startswith("trainer: ") for n in names)
+    assert any(n.startswith("serve: ") for n in names)
+
+    # CLI: writes timeline_fleet.json that passes the schema gate
+    out = tmp_path / "out.json"
+    assert timeline.main(
+        ["--fleet", str(a), str(b), "-o", str(out)]
+    ) == 0
+    from tools import check_metrics_schema
+
+    fleet_doc = out.read_text()
+    target = tmp_path / "timeline_fleet.json"
+    target.write_text(fleet_doc)
+    errors, _ = check_metrics_schema.check_file(str(target))
+    assert errors == []
+
+
+def test_single_logdir_renders_cross_process_spans_absolutely(tmp_path):
+    _write_jsonl(tmp_path / "trace.jsonl", [
+        {"kind": "span", "name": "serve.request", "trace_id": "cccc",
+         "span_id": "r1", "t0": T0 + 3.0, "dur_s": 0.5, "proc": 7},
+        {"kind": "span", "name": "serve.queue", "trace_id": "cccc",
+         "span_id": "r2", "parent_id": "r1", "t0": T0 + 3.0,
+         "dur_s": 0.1, "proc": 7},
+    ])
+    doc = timeline.build_timeline(str(tmp_path))
+    xs = [e for e in doc["traceEvents"]
+          if e.get("ph") == "X" and e.get("tid") == 3]
+    assert {e["name"] for e in xs} == {"serve.request", "serve.queue"}
+    # the span t0s anchor the absolute origin
+    assert doc["otherData"]["origin_unix_s"] == T0 + 3.0
+    assert min(e["ts"] for e in xs) == 0.0

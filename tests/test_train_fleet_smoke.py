@@ -1,0 +1,246 @@
+"""train.py --data-service 2 --fleet --slo-rules end to end (ISSUE 11).
+
+The acceptance surface: a data-service training run with the fleet
+aggregator enabled serves ``/fleetz`` listing >= 3 peers up (chief + 2
+embedded worker StatusServers) LIVE while training; an injected SLO
+breach (a latency objective the input plane cannot meet) raises a
+``slo_violation`` flight event with ``slo_burn_rate`` exposed in
+``metrics.prom``; the client -> dispatcher -> worker spans of one
+data-service fetch share one trace_id and render through
+``tools/timeline.py --fleet``; and every new stream passes
+``tools/check_metrics_schema.py``.
+
+Process-spawning, so slow-laned wholesale via conftest's
+_PROCESS_TEST_FILES.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: An SLO the run is GUARANTEED to breach (no input pipeline serves
+#: batches in under a nanosecond) plus one that stays silent (no serve
+#: traffic in a training run -> no_data, never a false violation).
+SLO_RULES = {
+    "slos": [
+        {
+            "name": "data_fetch_instant",
+            "kind": "histogram_under",
+            "metric": "data_service_client_wait_seconds",
+            "threshold": 1e-9,
+            "objective": 0.5,
+            "fast_window_s": 10.0,
+            "slow_window_s": 60.0,
+            "fast_burn": 1.5,
+            "slow_burn": 1.2,
+        },
+        {
+            "name": "serve_e2e_p99",
+            "kind": "histogram_under",
+            "metric": "serve_e2e_seconds",
+            "threshold": 2.5,
+            "objective": 0.99,
+        },
+    ]
+}
+
+
+def _get_json(port, path, timeout=5):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def test_train_fleet_end_to_end(tmp_path):
+    logdir = tmp_path / "logs"
+    rules_path = tmp_path / "slo_rules.json"
+    rules_path.write_text(json.dumps(SLO_RULES))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "train.py",
+            "--workload", "mnist_lenet", "--test-size", "--device", "cpu",
+            # long enough (~30s of stepping) that the LIVE /fleetz poll
+            # below has a real window while the run is still training
+            "--steps", "480", "--log-every", "60",
+            "--data-service", "2",
+            "--status-port", "0",
+            "--fleet", "--fleet-interval", "0.25",
+            "--slo-rules", str(rules_path), "--slo-interval", "0.25",
+            "--flight-recorder",
+            "--logdir", str(logdir),
+        ],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    lines: list[str] = []
+
+    def _pump(stream):
+        for line in stream:
+            lines.append(line)
+
+    threads = [
+        threading.Thread(target=_pump, args=(s,), daemon=True)
+        for s in (proc.stdout, proc.stderr)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        # The CHIEF's ephemeral port comes from the fleet log line — the
+        # generic "introspection server listening" line is ambiguous
+        # (every embedded worker StatusServer logs it too).
+        port = None
+        deadline = time.time() + 420
+        while time.time() < deadline and port is None:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "train.py exited before the fleet aggregator came "
+                    "up:\n" + "".join(lines)[-4000:]
+                )
+            m = re.search(r"GET /fleetz on port (\d+)", "".join(lines))
+            if m:
+                port = int(m.group(1))
+            else:
+                time.sleep(0.1)
+        assert port, "".join(lines)[-4000:]
+
+        # LIVE: /fleetz lists >= 3 peers up (chief + 2 data workers)
+        fleet_view = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                view = _get_json(port, "/fleetz?json")
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if view["states"].get("up", 0) >= 3:
+                fleet_view = view
+                break
+            time.sleep(0.2)
+        assert fleet_view is not None, "".join(lines)[-4000:]
+        assert len(fleet_view["peers"]) >= 3
+        assert {"chief", "data_worker0", "data_worker1"} <= set(
+            fleet_view["peers"]
+        )
+        # /sloz answers next to it
+        sloz = _get_json(port, "/sloz?json")
+        assert {r["name"] for r in sloz["rules"]} == {
+            "data_fetch_instant", "serve_e2e_p99",
+        }
+    finally:
+        try:
+            proc.wait(timeout=600)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+        for t in threads:
+            t.join(timeout=5)
+    log = "".join(lines)
+    assert proc.returncode == 0, log[-4000:]
+    assert "done at step 480" in log
+
+    # the injected breach raised slo_violation flight events
+    flight = [
+        json.loads(line)
+        for line in (logdir / "flight.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    violations = [e for e in flight if e["kind"] == "slo_violation"]
+    assert violations, [e["kind"] for e in flight]
+    assert all(e["slo"] == "data_fetch_instant" for e in violations)
+    assert all(e["burn"] > 0 for e in violations)
+
+    # slo_burn_rate exposed in metrics.prom; the silent rule burned 0
+    prom = (logdir / "metrics.prom").read_text()
+    assert re.search(
+        r'slo_burn_rate\{slo="data_fetch_instant",window="fast"\} ', prom
+    )
+    assert "fleet_peers" in prom and "fleet_scrape_seconds" in prom
+
+    # fleet.json snapshot: 3 peers, all scraped
+    fleet_doc = json.loads((logdir / "fleet.json").read_text())
+    assert len(fleet_doc["peers"]) == 3
+    assert fleet_doc["scrape_rounds"] >= 2
+
+    # one data-service fetch traced across client/dispatcher/worker
+    trace = [
+        json.loads(line)
+        for line in (logdir / "trace.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    spans = [r for r in trace if r.get("kind") == "span"]
+    names = {s["name"] for s in spans}
+    assert {"data_service.start_epoch", "dispatcher.start_epoch",
+            "data_service.fetch_split", "data_worker.get_next"} <= names
+    by_trace: dict[str, set] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+    assert any(
+        {"data_service.start_epoch", "dispatcher.start_epoch",
+         "data_worker.get_next"} <= names_
+        for names_ in by_trace.values()
+    )
+
+    # timeline --fleet renders the multi-process trace
+    tl = subprocess.run(
+        [sys.executable, "tools/timeline.py", "--fleet", str(logdir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert tl.returncode == 0, tl.stdout + tl.stderr
+    tl_doc = json.loads((logdir / "timeline_fleet.json").read_text())
+    assert tl_doc["otherData"]["cross_process_traces"] >= 1
+    assert tl_doc["otherData"]["cross_process_spans"] >= 4
+
+    # every new stream passes the schema gate
+    check = subprocess.run(
+        [
+            sys.executable, "tools/check_metrics_schema.py",
+            str(logdir / "metrics.jsonl"), str(logdir / "metrics.prom"),
+            str(logdir / "flight.jsonl"), str(logdir / "fleet.json"),
+            str(rules_path), str(logdir / "timeline_fleet.json"),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+    # run_report renders the fleet section and exits 0
+    rep = subprocess.run(
+        [sys.executable, "tools/run_report.py", str(logdir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    assert "fleet:" in rep.stdout
+    assert "slo data_fetch_instant" in rep.stdout
+    rep_json = subprocess.run(
+        [sys.executable, "tools/run_report.py", str(logdir), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert rep_json.returncode == 0
+    doc = json.loads(rep_json.stdout)
+    assert doc["fleet"]["peer_states"].get("up", 0) >= 1
+    assert doc["fleet"]["cross_process_traces"] >= 1
+    assert doc["fleet"]["slo_violations"]
+
+
+def test_fleet_requires_status_port(tmp_path):
+    res = subprocess.run(
+        [
+            sys.executable, "train.py",
+            "--workload", "mnist_lenet", "--test-size", "--device", "cpu",
+            "--steps", "2", "--fleet",
+        ],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode != 0
+    assert "--fleet requires --status-port" in res.stderr

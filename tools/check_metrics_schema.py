@@ -16,16 +16,30 @@ against the reactive-profiler manifest schema; basenames starting with
 ``requests`` against the serving per-request log schema; basenames
 starting with ``flash_blocks`` against the flash-attention autotune cache
 schema (ops/flash_tuning.py: version 1, entries with platform/dtype/
-shape, blocks dividing seq, known sources); files ending in ``.prom``
-against the Prometheus exposition snapshot (well-formed samples;
+shape, blocks dividing seq, known sources); basenames starting with
+``slo`` and ending ``.json`` against the SLO rule-file schema
+(``obs/slo.py``: known rule kinds, objective in [0, 1), positive windows
+with fast <= slow, positive burn-rate thresholds, unique names);
+basenames starting with ``fleet`` and ending ``.json`` against the fleet
+aggregator snapshot schema (``obs/fleet.py``: peer states from
+:data:`FLEET_PEER_STATES`, non-negative counts/ages, a non-negative
+``worst_spread`` ratio); basenames starting with ``timeline`` and ending
+``.json`` against the Chrome-trace document shape (a ``traceEvents``
+list of objects with a ``ph`` phase and finite ``ts``/non-negative
+``dur`` where present — the fleet-mode stitcher's output rides the
+default sweep); files ending in ``.prom`` against the Prometheus
+exposition snapshot (well-formed samples;
 ``collective_dispatch_seconds`` ``op`` labels restricted to the known
 collective set — see :data:`COLLECTIVE_OPS` — ``overlapped`` labels to
-"0"/"1", and the input-plane ``data_prefetch_depth`` /
+"0"/"1", the input-plane ``data_prefetch_depth`` /
 ``data_prefetch_resizes_total`` ``component``/``direction`` labels to
-:data:`PREFETCH_COMPONENTS` / :data:`PREFETCH_DIRECTIONS`); everything
-else against the metric-row schema (where ``quant_mode`` is the one
-string-typed field, from :data:`QUANT_MODES`; the input-plane label
-checks apply to the jsonl-flattened field names too).
+:data:`PREFETCH_COMPONENTS` / :data:`PREFETCH_DIRECTIONS`, the fleet
+``fleet_peers`` ``state`` label to :data:`FLEET_PEER_STATES`, and
+``slo_burn_rate`` samples to a known ``window`` label with a
+non-negative value); everything else against the metric-row schema
+(where ``quant_mode`` is the one string-typed field, from
+:data:`QUANT_MODES`; the input-plane/fleet/slo label checks apply to the
+jsonl-flattened field names too).
 
 The metric schema (docs/API.md "Telemetry"): every row of a *training-run*
 ``metrics.jsonl`` is one JSON object with
@@ -48,7 +62,8 @@ The captures schema (docs/API.md "Reactive profiling"): every row of a
 ``captures.jsonl`` manifest is one JSON object with a non-negative
 integer ``id`` (strictly increasing across the file), a ``trigger`` from
 the known set (``static`` / ``manual`` / ``step_time_regression`` /
-``straggler_spread``), integer ``step_begin < step_end`` (``<=`` allowed
+``straggler_spread`` / ``slo_burn``), integer ``step_begin < step_end``
+(``<=`` allowed
 for ``aborted`` rows), finite ``t_begin <= t_end``, non-negative
 ``wall_s`` / ``overhead_s``, and a ``dir`` that exists on disk (resolved
 against the manifest's directory when relative).
@@ -99,6 +114,10 @@ _FLAT_OVERLAPPED_RE = re.compile(r"\.overlapped_([A-Za-z0-9_]+?)(?=\.|$)")
 _FLAT_COMPONENT_RE = re.compile(r"\.component_([A-Za-z0-9_]+?)(?=\.|$)")
 #: jsonl-flattened ``direction`` label of the resize-decision counter.
 _FLAT_DIRECTION_RE = re.compile(r"\.direction_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``state`` label of the ``fleet_peers`` gauge.
+_FLAT_STATE_RE = re.compile(r"\.state_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``window`` label of the ``slo_burn_rate`` gauge.
+_FLAT_WINDOW_RE = re.compile(r"\.window_([A-Za-z0-9_]+?)(?=\.|$)")
 
 #: One Prometheus exposition sample: name, optional {labels}, value.
 _PROM_SAMPLE_RE = re.compile(
@@ -129,6 +148,15 @@ DEFAULT_PROM_GLOB = os.path.join(
 DEFAULT_FLASH_GLOB = os.path.join(
     REPO, "ARTIFACTS", "*", "flash_blocks*.json"
 )
+DEFAULT_SLO_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "slo*.json"
+)
+DEFAULT_FLEET_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "fleet*.json"
+)
+DEFAULT_TIMELINE_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "timeline*.json"
+)
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
 #: duplicated: this tool is stdlib-only and must run anywhere logs land).
@@ -142,6 +170,7 @@ GOODPUT_BUCKETS = (
 #: for the same stdlib-only reason).
 CAPTURE_TRIGGERS = (
     "static", "manual", "step_time_regression", "straggler_spread",
+    "slo_burn",
 )
 
 #: The known chaos fault kinds (resilience/chaos.py FAULT_KINDS —
@@ -188,6 +217,16 @@ FLASH_SOURCES = ("sweep", "xplane")
 PREFETCH_COMPONENTS = ("prefetcher", "client")
 #: ``direction`` labels of the resize-decision counter.
 PREFETCH_DIRECTIONS = ("grow", "shrink")
+
+#: Peer states of the fleet aggregator (obs/fleet.py PEER_STATES —
+#: duplicated for the same stdlib-only reason).
+FLEET_PEER_STATES = ("up", "stale", "down")
+#: ``window`` labels of the SLO burn-rate gauge (obs/slo.py SLO_WINDOWS).
+SLO_WINDOWS = ("fast", "slow")
+#: SLO rule kinds (obs/slo.py RULE_KINDS — duplicated, stdlib-only).
+SLO_RULE_KINDS = (
+    "histogram_under", "gauge_good_fraction", "gauge_bad_fraction",
+)
 
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
@@ -240,6 +279,27 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                     f"line {lineno}: field {k!r} carries unknown resize "
                     f"direction {m.group(1)!r} "
                     f"(known: {PREFETCH_DIRECTIONS})"
+                )
+        if k.startswith("fleet_peers"):
+            m = _FLAT_STATE_RE.search(k)
+            if m and m.group(1) not in FLEET_PEER_STATES:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown fleet "
+                    f"peer state {m.group(1)!r} "
+                    f"(known: {FLEET_PEER_STATES})"
+                )
+        if k.startswith("slo_burn_rate"):
+            m = _FLAT_WINDOW_RE.search(k)
+            if m and m.group(1) not in SLO_WINDOWS:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown slo "
+                    f"window {m.group(1)!r} (known: {SLO_WINDOWS})"
+                )
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and math.isfinite(v) and v < 0:
+                errors.append(
+                    f"line {lineno}: field {k!r} is negative ({v}) — burn "
+                    "rates are non-negative by construction"
                 )
         if k == "quant_mode":
             # the one STRING-typed metric-row field: the quantized-compute
@@ -716,6 +776,213 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                         f"direction {direction!r} "
                         f"(known: {PREFETCH_DIRECTIONS})"
                     )
+            if name.startswith("fleet_peers") and labelstr:
+                labels = dict(_PROM_LABEL_RE.findall(labelstr))
+                state = labels.get("state")
+                if state is not None and state not in FLEET_PEER_STATES:
+                    errors.append(
+                        f"line {i}: {name} carries unknown fleet peer "
+                        f"state {state!r} (known: {FLEET_PEER_STATES})"
+                    )
+            if name == "slo_burn_rate":
+                labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
+                window = labels.get("window")
+                if window not in SLO_WINDOWS:
+                    errors.append(
+                        f"line {i}: {name} carries unknown slo window "
+                        f"{window!r} (known: {SLO_WINDOWS})"
+                    )
+                if not labels.get("slo"):
+                    errors.append(
+                        f"line {i}: {name} sample is missing the 'slo' "
+                        "label"
+                    )
+                try:
+                    if float(value) < 0:
+                        errors.append(
+                            f"line {i}: {name} value {value!r} is "
+                            "negative — burn rates are non-negative by "
+                            "construction"
+                        )
+                except ValueError:
+                    pass  # already reported above
+    return errors, warnings
+
+
+def check_slo_rules_doc(doc) -> tuple[list[str], list[str]]:
+    """Validate one parsed SLO rule file (``obs/slo.py`` schema: a
+    ``{"slos": [...]}`` object or bare rule list — see the module
+    docstring for the per-rule constraints)."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    if isinstance(doc, dict):
+        rules = doc.get("slos")
+        if not isinstance(rules, list):
+            return ["'slos' is missing or not a list"], []
+    elif isinstance(doc, list):
+        rules = doc
+    else:
+        return [f"document is {type(doc).__name__}, not an object or "
+                "list"], []
+    seen: set[str] = set()
+    for i, rule in enumerate(rules):
+        where = f"slos[{i}]"
+        if not isinstance(rule, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        name = rule.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{where}: 'name' {name!r} is not a non-empty "
+                          "string")
+        elif name in seen:
+            errors.append(f"{where}: duplicate rule name {name!r}")
+        else:
+            seen.add(name)
+        kind = rule.get("kind")
+        if kind not in SLO_RULE_KINDS:
+            errors.append(f"{where}: 'kind' {kind!r} not in "
+                          f"{SLO_RULE_KINDS}")
+        metric = rule.get("metric")
+        if not isinstance(metric, str) or not metric:
+            errors.append(f"{where}: 'metric' {metric!r} is not a "
+                          "non-empty string")
+        obj = rule.get("objective")
+        if isinstance(obj, bool) or not isinstance(obj, (int, float)) \
+                or not math.isfinite(obj) or not 0.0 <= obj < 1.0:
+            errors.append(f"{where}: 'objective' {obj!r} must be a finite "
+                          "number in [0, 1)")
+        thr = rule.get("threshold")
+        if kind == "histogram_under":
+            if isinstance(thr, bool) or not isinstance(thr, (int, float)) \
+                    or not math.isfinite(thr) or thr <= 0:
+                errors.append(f"{where}: 'threshold' {thr!r} must be a "
+                              "positive finite number for histogram_under")
+        elif thr is not None:
+            errors.append(f"{where}: 'threshold' is only valid for "
+                          "histogram_under rules")
+        windows = {}
+        for key in ("fast_window_s", "slow_window_s"):
+            v = rule.get(key, 60.0 if key.startswith("fast") else 600.0)
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(v) or v <= 0:
+                errors.append(f"{where}: {key!r} {v!r} must be a positive "
+                              "finite number")
+            else:
+                windows[key] = float(v)
+        if len(windows) == 2 \
+                and windows["fast_window_s"] > windows["slow_window_s"]:
+            errors.append(
+                f"{where}: fast_window_s {windows['fast_window_s']} "
+                f"exceeds slow_window_s {windows['slow_window_s']}"
+            )
+        for key in ("fast_burn", "slow_burn"):
+            v = rule.get(key, 1.0)
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(v) or v <= 0:
+                errors.append(f"{where}: {key!r} {v!r} must be a positive "
+                              "finite number (burn-rate threshold)")
+    return errors, warnings
+
+
+def check_fleet_doc(doc) -> tuple[list[str], list[str]]:
+    """Validate one parsed fleet aggregator snapshot (``obs/fleet.py``
+    ``fleet.json``): peer states from the known set, non-negative
+    scrape/age counts, a non-negative worst-spread ratio."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, not an object"], []
+    peers = doc.get("peers")
+    if not isinstance(peers, dict):
+        errors.append("'peers' is missing or not an object")
+        peers = {}
+    for name, p in peers.items():
+        where = f"peers[{name!r}]"
+        if not isinstance(p, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        state = p.get("state")
+        if state not in FLEET_PEER_STATES:
+            errors.append(f"{where}: 'state' {state!r} not in "
+                          f"{FLEET_PEER_STATES}")
+        addr = p.get("addr")
+        if not isinstance(addr, str) or not addr:
+            errors.append(f"{where}: 'addr' {addr!r} is not a non-empty "
+                          "string")
+        age = p.get("age_s")
+        if age is not None and (
+            isinstance(age, bool) or not isinstance(age, (int, float))
+            or not math.isfinite(age) or age < 0
+        ):
+            errors.append(f"{where}: 'age_s' {age!r} is not a "
+                          "non-negative finite number or null")
+        for key in ("ok", "errors"):
+            if not _nonneg_int(p.get(key)):
+                errors.append(f"{where}: {key!r} {p.get(key)!r} is not a "
+                              "non-negative integer")
+    states = doc.get("states")
+    if states is not None:
+        if not isinstance(states, dict):
+            errors.append("'states' is not an object")
+        else:
+            for s, n in states.items():
+                if s not in FLEET_PEER_STATES:
+                    errors.append(f"states: unknown state {s!r} "
+                                  f"(known: {FLEET_PEER_STATES})")
+                if not _nonneg_int(n):
+                    errors.append(f"states[{s!r}]: {n!r} is not a "
+                                  "non-negative integer")
+    worst = doc.get("worst_spread")
+    if worst is not None:
+        if not isinstance(worst, dict):
+            errors.append("'worst_spread' is not an object or null")
+        else:
+            ratio = worst.get("ratio")
+            if isinstance(ratio, bool) \
+                    or not isinstance(ratio, (int, float)) \
+                    or not math.isfinite(ratio) or ratio < 0:
+                errors.append(f"worst_spread: 'ratio' {ratio!r} is not a "
+                              "non-negative finite number")
+    for key in ("scrape_rounds", "metrics_merged"):
+        v = doc.get(key)
+        if v is not None and not _nonneg_int(v):
+            errors.append(f"{key!r} {v!r} is not a non-negative integer")
+    return errors, warnings
+
+
+def check_timeline_doc(doc) -> tuple[list[str], list[str]]:
+    """Validate one Chrome-trace timeline document (``tools/timeline.py``
+    output, fleet mode included): a ``traceEvents`` list of objects, each
+    with a non-empty ``ph`` phase string, finite ``ts`` and non-negative
+    finite ``dur`` where present."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, not an object"], []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["'traceEvents' is missing or not a list"], []
+    for i, e in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = e.get("ph")
+        if not isinstance(ph, str) or not ph:
+            errors.append(f"{where}: 'ph' {ph!r} is not a non-empty string")
+        ts = e.get("ts")
+        if ts is not None and (
+            isinstance(ts, bool) or not isinstance(ts, (int, float))
+            or not math.isfinite(ts)
+        ):
+            errors.append(f"{where}: 'ts' {ts!r} is not a finite number")
+        dur = e.get("dur")
+        if dur is not None and (
+            isinstance(dur, bool) or not isinstance(dur, (int, float))
+            or not math.isfinite(dur) or dur < 0
+        ):
+            errors.append(f"{where}: 'dur' {dur!r} is not a non-negative "
+                          "finite number")
     return errors, warnings
 
 
@@ -804,7 +1071,24 @@ def check_goodput_doc(doc) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
+def _load_json_doc(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
 def check_file(path: str) -> tuple[list[str], list[str]]:
+    base = os.path.basename(path)
+    if base.endswith(".json") and base.startswith(("slo", "fleet",
+                                                   "timeline")):
+        try:
+            doc = _load_json_doc(path)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"invalid JSON ({e})"], []
+        if base.startswith("slo"):
+            return check_slo_rules_doc(doc)
+        if base.startswith("fleet"):
+            return check_fleet_doc(doc)
+        return check_timeline_doc(doc)
     if os.path.basename(path).startswith("goodput"):
         try:
             with open(path) as f:
@@ -860,6 +1144,8 @@ def main(argv: list[str] | None = None) -> int:
         + glob.glob(DEFAULT_GOODPUT_GLOB) + glob.glob(DEFAULT_CAPTURES_GLOB)
         + glob.glob(DEFAULT_FAULTS_GLOB) + glob.glob(DEFAULT_REQUESTS_GLOB)
         + glob.glob(DEFAULT_PROM_GLOB) + glob.glob(DEFAULT_FLASH_GLOB)
+        + glob.glob(DEFAULT_SLO_GLOB) + glob.glob(DEFAULT_FLEET_GLOB)
+        + glob.glob(DEFAULT_TIMELINE_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

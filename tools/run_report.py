@@ -38,13 +38,22 @@ writes — and prints:
   occupancy, rejects, delivered tokens/sec;
 - input plane: data-wait share of step time, live adaptive prefetch
   depth / data-service credit window, per-worker fetch throughput,
-  dropped workers, and elastic ``data_reshard`` events.
+  dropped workers, and elastic ``data_reshard`` events;
+- fleet: the fleet observability plane — peer states (up/stale/down)
+  and the worst straggler spread from ``fleet.json`` (the aggregator's
+  snapshot), the SLO burn-rate summary (last-record ``slo_burn_rate``
+  fields + ``slo_violation`` flight events), and the cross-process trace
+  count (distinct ``trace_id``s among the ``kind: "span"`` rows of
+  ``trace.jsonl``).
 
 ``--json`` emits the same content as one machine-readable JSON object.
 Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
 
 Exit status: 0 = report rendered from a healthy stream; 1 = the metric
-stream had unparseable lines or no valid rows (CI gates on this); missing
+stream had unparseable lines or no valid rows (CI gates on this —
+``trace.jsonl``, ``captures.jsonl``, ``faults.jsonl``,
+``requests.jsonl``, ``goodput.json``, and ``fleet.json`` parse errors
+gate it too, matching the stream-gating convention); missing
 ``metrics.jsonl`` is a hard SystemExit.
 """
 
@@ -494,6 +503,73 @@ def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     return out
 
 
+_SLO_FIELD_RE = re.compile(
+    r"^slo_burn_rate\.slo_(?P<slo>.+)\.window_(?P<window>[A-Za-z0-9_]+)$"
+)
+
+
+def fleet_summary(logdir: str, train: list[dict], trace: list[dict],
+                  flight: list[dict]) -> tuple[dict, int]:
+    """``(fleet digest, parse errors)``: peer states + worst straggler
+    spread from ``<logdir>/fleet.json``, SLO burn rates from the last
+    metric record's flattened ``slo_burn_rate`` fields + ``slo_violation``
+    flight events, and the cross-process trace census from the
+    ``kind: "span"`` rows of ``trace.jsonl``.  Empty when the run carried
+    none of it."""
+    out: dict = {}
+    bad = 0
+    path = os.path.join(logdir, "fleet.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"{path}: unreadable ({e})", file=sys.stderr)
+            doc, bad = None, 1
+        if isinstance(doc, dict):
+            peers = doc.get("peers") or {}
+            states: dict[str, int] = {}
+            for p in peers.values():
+                s = str(p.get("state", "?")) if isinstance(p, dict) else "?"
+                states[s] = states.get(s, 0) + 1
+            out["peers"] = {
+                name: {k: p.get(k) for k in ("addr", "state", "age_s",
+                                             "ok", "errors")}
+                for name, p in peers.items() if isinstance(p, dict)
+            }
+            out["peer_states"] = states
+            if isinstance(doc.get("worst_spread"), dict):
+                out["worst_spread"] = doc["worst_spread"]
+            if isinstance(doc.get("scrape_rounds"), (int, float)):
+                out["scrape_rounds"] = doc["scrape_rounds"]
+    # SLO burn: the last record carrying any slo_burn_rate field wins.
+    last: dict = {}
+    for r in train:
+        if any(k.startswith("slo_burn_rate") for k in r):
+            last = r
+    burns: dict[str, dict[str, float]] = {}
+    for k, v in last.items():
+        m = _SLO_FIELD_RE.match(k)
+        if m and isinstance(v, (int, float)):
+            burns.setdefault(m.group("slo"), {})[m.group("window")] = v
+    if burns:
+        out["slo_burn_rates"] = {k: burns[k] for k in sorted(burns)}
+    violations = [e for e in flight if e.get("kind") == "slo_violation"]
+    if violations:
+        out["slo_violations"] = [
+            {k: e.get(k) for k in ("t", "slo", "window", "burn", "limit",
+                                   "metric")}
+            for e in violations
+        ]
+    spans = [r for r in trace if r.get("kind") == "span"]
+    if spans:
+        trace_ids = {r.get("trace_id") for r in spans
+                     if isinstance(r.get("trace_id"), str)}
+        out["cross_process_traces"] = len(trace_ids)
+        out["cross_process_spans"] = len(spans)
+    return out, bad
+
+
 def load_goodput(logdir: str) -> tuple[dict, int]:
     """``(goodput summary, parse errors)`` from ``<logdir>/goodput.json``
     (the GoodputLedger document; empty summary when absent)."""
@@ -523,8 +599,10 @@ def build_report(logdir: str) -> dict:
         raise SystemExit(f"{metrics_path}: not found (is this a logdir?)")
     rows, bad_metrics = _load_jsonl(metrics_path)
     trace_path = os.path.join(logdir, "trace.jsonl")
-    trace, _ = (_load_jsonl(trace_path) if os.path.exists(trace_path)
-                else ([], 0))
+    # trace.jsonl parse errors gate the exit code like every other stream
+    # (a truncated/corrupt trace used to pass silently).
+    trace, bad_trace = (_load_jsonl(trace_path) if os.path.exists(trace_path)
+                        else ([], 0))
     flight_path = os.path.join(logdir, "flight.jsonl")
     flight, _ = (_load_jsonl(flight_path) if os.path.exists(flight_path)
                  else ([], 0))
@@ -545,6 +623,7 @@ def build_report(logdir: str) -> dict:
     )
     goodput, bad_goodput = load_goodput(logdir)
     train, evals = split_rows(rows)
+    fleet, bad_fleet = fleet_summary(logdir, train, trace, flight)
 
     times, source = step_times(train, trace)
     times_sorted = sorted(times)
@@ -578,11 +657,13 @@ def build_report(logdir: str) -> dict:
         "goodput": goodput,
         "resilience": resilience_summary(faults, flight, goodput),
         "serving": serving_summary(requests),
-        # metric-stream health: any unparseable metrics.jsonl / captures /
-        # faults / requests line (or an unreadable goodput.json) makes
-        # main() exit non-zero (CI gate)
-        "parse_errors": (bad_metrics + bad_goodput + bad_captures
-                         + bad_faults + bad_requests),
+        "fleet": fleet,
+        # metric-stream health: any unparseable metrics.jsonl / trace /
+        # captures / faults / requests line (or an unreadable
+        # goodput.json / fleet.json) makes main() exit non-zero (CI gate)
+        "parse_errors": (bad_metrics + bad_trace + bad_goodput
+                         + bad_captures + bad_faults + bad_requests
+                         + bad_fleet),
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -764,6 +845,51 @@ def render(report: dict) -> str:
         if srv.get("rejected"):
             lines.append(f"  REJECTED {srv['rejected']} request(s) "
                          "(queue backpressure)")
+    flt = report.get("fleet")
+    if flt:
+        parts = []
+        ps = flt.get("peer_states")
+        if ps:
+            parts.append(
+                f"{sum(ps.values())} peer(s) — "
+                + ", ".join(f"{ps.get(s, 0)} {s}"
+                            for s in ("up", "stale", "down"))
+            )
+        if "cross_process_traces" in flt:
+            parts.append(
+                f"{flt['cross_process_traces']} cross-process trace(s) "
+                f"({flt['cross_process_spans']} spans)"
+            )
+        lines += ["", "fleet: " + (", ".join(parts) or "telemetry only")]
+        for name, p in sorted((flt.get("peers") or {}).items()):
+            lines.append(
+                f"  peer {name}: {p.get('addr')}  {p.get('state')}  "
+                f"ok {p.get('ok')} err {p.get('errors')}"
+            )
+        ws = flt.get("worst_spread")
+        if ws:
+            flag = "  ** STRAGGLER **" if ws.get("straggling") else ""
+            lines.append(
+                f"  worst straggler spread: {ws.get('ratio', 0.0):.2f}x "
+                f"on {ws.get('key')} (peer {ws.get('peer')}){flag}"
+            )
+        for slo, windows in (flt.get("slo_burn_rates") or {}).items():
+            lines.append(
+                "  slo " + slo + ": "
+                + ", ".join(f"{w} burn {windows[w]:.2f}x"
+                            for w in sorted(windows))
+            )
+        if flt.get("slo_violations"):
+            lines.append(
+                f"  SLO VIOLATIONS: {len(flt['slo_violations'])} "
+                "flight event(s)"
+            )
+            for v in flt["slo_violations"][:10]:
+                lines.append(
+                    f"    {v.get('slo')} {v.get('window')}-window burn "
+                    f"{v.get('burn')}x (limit {v.get('limit')}x, "
+                    f"{v.get('metric')})"
+                )
     sto = report.get("step_time_opt")
     if sto:
         parts = []
@@ -884,8 +1010,9 @@ def main(argv: list[str] | None = None) -> int:
     # lines must fail the report, not silently render a partial one.
     if report.get("parse_errors"):
         print(
-            f"run_report: {report['parse_errors']} unparseable "
-            "metrics/goodput entries", file=sys.stderr,
+            f"run_report: {report['parse_errors']} unparseable telemetry "
+            "entries (metrics/trace/captures/faults/requests/goodput/"
+            "fleet)", file=sys.stderr,
         )
         return 1
     if not (report["rows"]["train"] or report["rows"]["eval"]):

@@ -13,8 +13,19 @@ between generations?  This tool renders all four onto one timeline:
 Usage::
 
     python tools/timeline.py <logdir> [-o timeline.json]
+    python tools/timeline.py --fleet <logdir> [<logdir> ...] [-o out.json]
 
 and load the output in ``chrome://tracing`` or https://ui.perfetto.dev.
+``--fleet`` (the fleet observability plane, ISSUE 11) stitches SEVERAL
+processes' logdirs — trainer, serve server, remote data workers — into
+one timeline: each logdir keeps its own track group (aligned on absolute
+wall-clock where the streams carry it), and every cross-process trace
+span (the ``kind: "span"`` rows of ``trace.jsonl``, keyed by
+``trace_id``) additionally lands on a shared "fleet traces" group with
+one lane per trace_id, so a request's client/dispatcher/worker (or
+queue/prefill/decode) spans read as one causal chain regardless of which
+process recorded them.
+
 Tracks (one Chrome-trace "process" per stream):
 
 - **spans** — every ``trace.jsonl`` step row as nested duration events
@@ -51,6 +62,10 @@ PID_SPANS = 1
 PID_FLIGHT = 2
 PID_CAPTURES = 3
 PID_GOODPUT = 4
+#: --fleet: the shared cross-process trace group; per-logdir pids are
+#: offset by _FLEET_PID_STRIDE * index.
+PID_FLEET_TRACES = 90
+_FLEET_PID_STRIDE = 100
 
 _NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
               "-Infinity": float("-inf")}
@@ -105,6 +120,26 @@ def _meta(events: list, pid: int, name: str, sort: int) -> None:
                    "args": {"sort_index": sort}})
 
 
+def _remote_span_event(row: dict, pid: int, tid: int,
+                       t0_us: float) -> dict | None:
+    """One ``kind: "span"`` trace row (obs.tracing.remote_span) as a
+    Chrome-trace X event placed on its ABSOLUTE wall-clock position — the
+    ONE construction both the per-logdir lane and the fleet-mode shared
+    group use (two copies would drift)."""
+    span_t0 = _num(row.get("t0"))
+    if span_t0 is None:
+        return None
+    dur = max(_num(row.get("dur_s")) or 0.0, 0.0)
+    return {
+        "ph": "X", "pid": pid, "tid": tid,
+        "name": str(row.get("name", "span")),
+        "ts": round(span_t0 * 1e6 - t0_us, 3),
+        "dur": round(dur * 1e6, 3),
+        "args": {k: v for k, v in row.items()
+                 if not isinstance(v, (list, dict))},
+    }
+
+
 def _emit_span_tree(events: list, span: dict, t0_us: float,
                     start_us: float, tid: int) -> float:
     """Emit one span and its children (laid sequentially from the span's
@@ -140,6 +175,11 @@ def build_timeline(logdir: str) -> dict:
         t = _num(e.get("t"))
         if t is not None:
             absolutes.append(t)
+    for row in trace:
+        if row.get("kind") == "span":
+            t = _num(row.get("t0"))
+            if t is not None:
+                absolutes.append(t)
     for c in captures:
         t = _num(c.get("t_begin"))
         if t is not None:
@@ -185,10 +225,21 @@ def build_timeline(logdir: str) -> dict:
                    "name": "thread_name", "args": {"name": "step spans"}})
     events.append({"ph": "M", "pid": PID_SPANS, "tid": 2,
                    "name": "thread_name", "args": {"name": "trace events"}})
+    events.append({"ph": "M", "pid": PID_SPANS, "tid": 3,
+                   "name": "thread_name",
+                   "args": {"name": "cross-process spans"}})
     cursor_us = t0_us  # sequential fallback for un-anchored rows
     for row in trace:
         spans = row.get("spans")
         if spans is None:
+            if row.get("kind") == "span":
+                # cross-process trace span (obs.tracing.remote_span):
+                # absolute wall-clock + trace_id — a duration bar on its
+                # own lane, placed exactly (no anchoring heuristics).
+                e = _remote_span_event(row, PID_SPANS, 3, t0_us)
+                if e is not None:
+                    events.append(e)
+                    continue
             # out-of-band trace events (anomalies): instants on lane 2
             events.append({
                 "ph": "i", "s": "t", "pid": PID_SPANS, "tid": 2,
@@ -292,14 +343,133 @@ def build_timeline(logdir: str) -> dict:
     }
 
 
+def build_fleet_timeline(logdirs: list[str]) -> dict:
+    """Stitch several processes' logdirs into one Chrome-trace document.
+
+    Each logdir's per-stream tracks are built by :func:`build_timeline`
+    unchanged, then re-based onto a common absolute origin (the earliest
+    across the fleet; a logdir whose streams carry no absolute timestamp
+    stays at the common origin, best-effort) with its pids offset and its
+    process names prefixed by the logdir basename.  On top, every
+    ``kind: "span"`` trace row from every logdir lands in one shared
+    "fleet traces" group — one lane per ``trace_id`` — the cross-process
+    request view."""
+    docs: list[tuple[str, dict]] = []
+    skipped: list[str] = []
+    for d in logdirs:
+        try:
+            docs.append((d, build_timeline(d)))
+        except SystemExit as e:
+            print(f"timeline: skipping {d}: {e}", file=sys.stderr)
+            skipped.append(d)
+    if not docs:
+        raise SystemExit(
+            f"none of the {len(logdirs)} logdir(s) carried any telemetry "
+            "stream"
+        )
+    origins = [doc["otherData"]["origin_unix_s"] for _, doc in docs]
+    real = [o for o in origins if o]
+    t0 = min(real) if real else 0.0
+
+    events: list[dict] = []
+    for i, (d, doc) in enumerate(docs):
+        label = os.path.basename(os.path.normpath(d)) or d
+        offset_us = (origins[i] - t0) * 1e6 if origins[i] else 0.0
+        pid_base = i * _FLEET_PID_STRIDE
+        for e in doc["traceEvents"]:
+            e = dict(e)
+            e["pid"] = pid_base + int(e.get("pid", 0))
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    e = dict(e, args={
+                        "name": f"{label}: {e.get('args', {}).get('name')}"
+                    })
+                elif e.get("name") == "process_sort_index":
+                    e = dict(e, args={
+                        "sort_index": pid_base
+                        + int(e.get("args", {}).get("sort_index", 0))
+                    })
+            elif "ts" in e:
+                e["ts"] = round(e["ts"] + offset_us, 3)
+            events.append(e)
+
+    # -- the shared cross-process trace group ---------------------------------
+    _meta(events, PID_FLEET_TRACES, "fleet traces (by trace_id)",
+          len(docs) * _FLEET_PID_STRIDE)
+    trace_tids: dict[str, int] = {}
+    fleet_spans = 0
+    for d, _doc in docs:
+        for row in load_jsonl(os.path.join(d, "trace.jsonl")):
+            if row.get("kind") != "span":
+                continue
+            trace_id = row.get("trace_id")
+            if not isinstance(trace_id, str):
+                continue
+            tid = trace_tids.setdefault(trace_id, len(trace_tids) + 1)
+            e = _remote_span_event(row, PID_FLEET_TRACES, tid, t0 * 1e6)
+            if e is None:
+                continue
+            e["args"]["logdir"] = d
+            events.append(e)
+            fleet_spans += 1
+    for trace_id, tid in trace_tids.items():
+        events.append({"ph": "M", "pid": PID_FLEET_TRACES, "tid": tid,
+                       "name": "thread_name",
+                       "args": {"name": f"trace {trace_id}"}})
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "fleet": True,
+            "logdirs": [d for d, _ in docs],
+            "skipped_logdirs": skipped,
+            "origin_unix_s": t0,
+            "cross_process_traces": len(trace_tids),
+            "cross_process_spans": fleet_spans,
+            "streams": {
+                d: doc["otherData"]["streams"] for d, doc in docs
+            },
+        },
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("logdir", help="directory holding trace.jsonl / "
-                                  "flight.jsonl / captures.jsonl / "
-                                  "goodput.json (any subset)")
+    p.add_argument("logdir", nargs="?", default=None,
+                   help="directory holding trace.jsonl / "
+                        "flight.jsonl / captures.jsonl / "
+                        "goodput.json (any subset)")
+    p.add_argument("--fleet", nargs="+", default=None, metavar="LOGDIR",
+                   help="fleet mode: stitch SEVERAL processes' logdirs "
+                        "into one timeline (per-logdir track groups on a "
+                        "common clock + a shared per-trace_id group for "
+                        "cross-process spans)")
     p.add_argument("-o", "--out", default=None,
-                   help="output path (default <logdir>/timeline.json)")
+                   help="output path (default <logdir>/timeline.json, or "
+                        "<first logdir>/timeline_fleet.json with --fleet)")
     args = p.parse_args(argv)
+    if args.fleet:
+        logdirs = ([args.logdir] if args.logdir else []) + args.fleet
+        for d in logdirs:
+            if not os.path.isdir(d):
+                print(f"timeline: {d}: not a directory", file=sys.stderr)
+                return 1
+        doc = build_fleet_timeline(logdirs)
+        out = args.out or os.path.join(logdirs[0], "timeline_fleet.json")
+        with open(out, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        od = doc["otherData"]
+        print(
+            f"timeline: {len(doc['traceEvents'])} events across "
+            f"{len(od['logdirs'])} logdir(s), "
+            f"{od['cross_process_traces']} cross-process trace(s) "
+            f"({od['cross_process_spans']} spans) -> {out}"
+        )
+        return 0
+    if args.logdir is None:
+        p.error("a logdir is required (or use --fleet <logdir>...)")
     if not os.path.isdir(args.logdir):
         print(f"timeline: {args.logdir}: not a directory", file=sys.stderr)
         return 1

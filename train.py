@@ -629,6 +629,33 @@ def main() -> None:
                    help="bind address for --status-port; the loopback "
                         "default keeps /threadz stacks private — set "
                         "0.0.0.0 only on a trusted cluster network")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet observability plane (obs.fleet): scrape "
+                        "the /varz of every registered peer StatusServer "
+                        "(this process + the --data-service workers' "
+                        "embedded servers) on a background thread, merge "
+                        "into a min/median/max/sum view with per-peer "
+                        "up/stale/down liveness + spread_ratio straggler "
+                        "detection, served at GET /fleetz on "
+                        "--status-port and persisted to <logdir>/"
+                        "fleet.json (requires --status-port)")
+    p.add_argument("--fleet-interval", type=float, default=2.0,
+                   help="seconds between fleet /varz scrape rounds")
+    p.add_argument("--fleet-peer", action="append", default=None,
+                   metavar="NAME=HOST:PORT",
+                   help="extra fleet scrape target (repeatable): another "
+                        "trainer host's --status-port, a serve.py server, "
+                        "a remote data worker's embedded status server")
+    p.add_argument("--slo-rules", default=None, metavar="JSON",
+                   help="SLO rule file (obs.slo schema): evaluate "
+                        "multi-window burn rates over registry histograms"
+                        "/gauges on a background thread, expose "
+                        "slo_burn_rate{slo=,window=} gauges + GET /sloz, "
+                        "raise slo_violation flight events on threshold "
+                        "trips, and (with --auto-profile) arm a slo_burn "
+                        "reactive capture on a fast-burn trip")
+    p.add_argument("--slo-interval", type=float, default=5.0,
+                   help="seconds between SLO burn-rate evaluations")
     p.add_argument("--profiler-port", type=int, default=None, metavar="PORT",
                    help="start the jax.profiler server for on-demand remote "
                         "trace capture (TensorBoard 'capture profile' / "
@@ -1079,6 +1106,7 @@ def main() -> None:
     # loopback is the CPU-verifiable topology; a real pod points the
     # client at a remote dispatcher and runs WorkerServer on input hosts.
     data_service = None
+    _workers: list = []
     if args.data_service:
         from distributedtensorflow_tpu.data import DispatchServer, WorkerServer
 
@@ -1112,13 +1140,34 @@ def main() -> None:
 
         _dispatch = DispatchServer(port=0)
         _workers = [
-            WorkerServer(_dispatch.target(), _worker_input_fn, port=0)
+            WorkerServer(
+                _dispatch.target(), _worker_input_fn, port=0,
+                # Under --fleet every worker embeds an ephemeral loopback
+                # StatusServer and registers as a scrape target, so worker
+                # health stops being inferable only from client-side
+                # fetch histograms.
+                status_port=0 if args.fleet else None,
+            )
             for _ in range(args.data_service)
         ]
         data_service = _dispatch
         logging.info("data service: dispatcher %s + %d loopback worker(s), "
                      "wire=%s", _dispatch.target(), len(_workers),
                      args.data_service_wire)
+
+    # Cross-process trace spans are emitted through the ACTIVE recorder,
+    # but the Trainer's own TraceRecorder only exists per fit — and the
+    # DataServiceClient's epoch-start handshake (client/dispatcher/worker
+    # spans) happens at iterator construction, BEFORE fit.  A pre-fit
+    # recorder on the same trace.jsonl (append mode) catches those; the
+    # Trainer's recorder takes over for the fit itself.
+    _prefit_tracer = None
+    if args.logdir and not args.no_trace:
+        from distributedtensorflow_tpu.obs.tracing import TraceRecorder
+
+        _prefit_tracer = TraceRecorder(
+            os.path.join(args.logdir, "trace.jsonl")
+        ).install()
 
     # Each (re)start consumes a FRESH service epoch so worker iterators
     # restart from batch 0 and the resume fast-forward lands correctly.
@@ -1272,6 +1321,72 @@ def main() -> None:
         # worker-kill / data-stall / preemption triggers.
         callbacks=[chaos] if chaos is not None else None,
     )
+
+    # Fleet observability plane (ISSUE 11): the chief scrapes every peer
+    # StatusServer — itself, the data-service workers' embedded servers,
+    # and any --fleet-peer extras — into one /fleetz view; the SLO monitor
+    # watches registry metrics for burn-rate breaches next to it.
+    fleet_agg = None
+    slo_monitor = None
+    if args.fleet:
+        if trainer.status_server is None:
+            raise SystemExit(
+                "--fleet requires --status-port (the aggregator serves "
+                "/fleetz on the chief's StatusServer and scrapes its "
+                "/varz as the chief peer)"
+            )
+        from distributedtensorflow_tpu.obs.fleet import FleetAggregator
+
+        fleet_agg = FleetAggregator(
+            interval_s=args.fleet_interval, logdir=args.logdir
+        )
+        # Scrape the chief on the interface it actually bound (loopback
+        # only when it bound the wildcard or the default).
+        chief_host = ("127.0.0.1"
+                      if args.status_host in ("0.0.0.0", "")
+                      else args.status_host)
+        fleet_agg.add_peer(
+            "chief", f"{chief_host}:{trainer.status_server.port}"
+        )
+        for i, w in enumerate(_workers):
+            if w.status_addr is not None:
+                fleet_agg.add_peer(f"data_worker{i}", w.status_addr)
+        for spec_str in args.fleet_peer or []:
+            name, sep, addr = spec_str.partition("=")
+            if not sep or not name or not addr:
+                raise SystemExit(
+                    f"--fleet-peer {spec_str!r}: expected NAME=HOST:PORT"
+                )
+            fleet_agg.add_peer(name, addr)
+        fleet_agg.install(trainer.status_server).start()
+        logging.info(
+            "fleet: aggregating %d peer(s) every %.1fs (GET /fleetz on "
+            "port %d)", len(fleet_agg.peers()), args.fleet_interval,
+            trainer.status_server.port,
+        )
+    if args.slo_rules:
+        import json as jsonlib2
+
+        from distributedtensorflow_tpu.obs.slo import SLOMonitor, load_rules
+
+        try:
+            slo_rules = load_rules(args.slo_rules)
+        except (OSError, ValueError, jsonlib2.JSONDecodeError) as e:
+            raise SystemExit(f"--slo-rules {args.slo_rules}: {e}")
+        slo_monitor = SLOMonitor(
+            slo_rules,
+            interval_s=args.slo_interval,
+            # --auto-profile: a fast-burn trip arms a slo_burn capture so
+            # the breach profiles itself.
+            capture_engine=trainer.capture if args.auto_profile else None,
+        )
+        if trainer.status_server is not None:
+            slo_monitor.install(trainer.status_server)
+        slo_monitor.start()
+        logging.info("slo monitor: %d rule(s) from %s evaluated every "
+                     "%.1fs", len(slo_rules), args.slo_rules,
+                     args.slo_interval)
+
     eval_iter_fn = None
     if args.eval_every and eval_step is not None:
         if args.data_dir or args.eval_data_dir:
@@ -1372,6 +1487,33 @@ def main() -> None:
             # open — the restart's merge treats it as died-mid-flight.
             goodput_ledger.heartbeat()
         raise
+    finally:
+        # One last evaluation/scrape, then re-export the registry
+        # snapshot: the trainer's own metrics.prom export ran at the last
+        # log boundary, BEFORE these final gauge updates — without the
+        # rewrite a run shorter than --slo-interval would end with no
+        # slo_burn_rate samples on disk at all.
+        if slo_monitor is not None:
+            slo_monitor.stop()
+            try:
+                slo_monitor.evaluate()
+            except Exception:
+                logging.exception("final slo evaluation failed")
+        if fleet_agg is not None:
+            fleet_agg.stop()
+        if (slo_monitor is not None or fleet_agg is not None) \
+                and args.logdir:
+            from distributedtensorflow_tpu.obs import registry as _reglib
+
+            try:
+                _reglib.default_registry().write_prometheus(
+                    os.path.join(args.logdir, "metrics.prom")
+                )
+            except OSError:
+                logging.exception("final metrics.prom export failed")
+        if _prefit_tracer is not None:
+            _prefit_tracer.uninstall()
+            _prefit_tracer.close()
     if goodput_ledger is not None:
         # A preemption already closed the generation as "preempted" (first
         # mark wins); otherwise this run ended cleanly.
