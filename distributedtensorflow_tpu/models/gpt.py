@@ -20,7 +20,7 @@ sequences trade FLOPs for HBM.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable
+from typing import Any, Callable
 
 import flax.linen as nn
 import jax
@@ -224,26 +224,37 @@ class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
     attn_fn: AttnFn | None = None  # None = dense causal (flash-capable)
     decode: bool = False  # KV-cache incremental decoding (serving path)
+    #: Manual tensor parallelism (the pipeline's full-manual shard_map
+    #: region, where GSPMD cannot partition the kernels): ``n_heads`` /
+    #: ``n_kv`` override the LOCAL head counts (this shard's slice of the
+    #: fused qkv / proj kernels), and ``reduce_fn`` — typically
+    #: ``lax.psum(., "model")`` — completes the row-parallel output
+    #: projection.  Defaults (None) are exactly the historical behavior.
+    n_heads: int | None = None
+    n_kv: int | None = None
+    reduce_fn: Any = None
 
     @nn.compact
     def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
-        n_kv = cfg.kv_heads
+        nh = self.n_heads or cfg.num_heads
+        n_kv = self.n_kv or cfg.kv_heads
         # Fused QKV projection: one large MXU matmul (column-parallel under
         # the model axis — gpt_layout shards the fused output dim).  Under
         # GQA (kv_heads < num_heads) the K/V column groups shrink; at the
         # MHA default the fused dim is exactly 3E and the split matches
         # the historical jnp.split(qkv, 3) — same param tree, same values.
+        q_width = nh * head_dim
         kv_width = n_kv * head_dim
         qkv = dense(
-            cfg.hidden_size + 2 * kv_width, dtype=cfg.dtype,
+            q_width + 2 * kv_width, dtype=cfg.dtype,
             quant=cfg.quant, use_bias=False, name="qkv",
         )(x)
-        q = qkv[..., :cfg.hidden_size]
-        k = qkv[..., cfg.hidden_size:cfg.hidden_size + kv_width]
-        v = qkv[..., cfg.hidden_size + kv_width:]
-        q = q.reshape(*x.shape[:2], cfg.num_heads, head_dim)
+        q = qkv[..., :q_width]
+        k = qkv[..., q_width:q_width + kv_width]
+        v = qkv[..., q_width + kv_width:]
+        q = q.reshape(*x.shape[:2], nh, head_dim)
         k = k.reshape(*x.shape[:2], n_kv, head_dim)
         v = v.reshape(*x.shape[:2], n_kv, head_dim)
         q = rope(q, positions, cfg.rope_theta, rope_tabs)
@@ -258,7 +269,7 @@ class CausalSelfAttention(nn.Module):
                 )
             out = self._cached_attention(q, k, v)
         elif self.attn_fn is not None:
-            if n_kv != cfg.num_heads:
+            if n_kv != nh:
                 raise ValueError(
                     "GQA (kv_heads < num_heads) is not supported with a "
                     "custom attn_fn (ring/Ulysses sequence parallelism "
@@ -277,12 +288,15 @@ class CausalSelfAttention(nn.Module):
                 q, k, v, causal=True, window=cfg.attn_window,
                 implementation=cfg.attn_impl,
             )
-        out = out.reshape(*x.shape[:2], cfg.hidden_size)
+        out = out.reshape(*x.shape[:2], q_width)
         # Row-parallel output projection (its input dim is head-sharded).
-        return dense(
+        out = dense(
             cfg.hidden_size, dtype=cfg.dtype, quant=cfg.quant,
             use_bias=False, name="proj",
         )(out)
+        if self.reduce_fn is not None:
+            out = self.reduce_fn(out)
+        return out
 
     def _cached_attention(self, q, k, v):
         """One decode step against the KV cache (shared helper)."""
@@ -294,6 +308,14 @@ class GPTBlock(nn.Module):
     cfg: GPTConfig
     attn_fn: AttnFn | None = None
     decode: bool = False
+    #: Manual tensor parallelism (see :class:`CausalSelfAttention`):
+    #: per-shard head counts / MLP width, and the cross-shard reduction
+    #: applied to the attention projection and MLP outputs (row-parallel
+    #: psum).  Defaults are the historical single-shard behavior.
+    n_heads: int | None = None
+    n_kv: int | None = None
+    ffn_size: int | None = None
+    reduce_fn: Any = None
 
     @nn.compact
     def __call__(self, x, positions, deterministic: bool, rope_tabs=None):
@@ -306,11 +328,13 @@ class GPTBlock(nn.Module):
             # rope_tabs at 4 is a traced array input, NOT static).
             attn_cls = nn.remat(CausalSelfAttention, static_argnums=(3,))
         x = x + attn_cls(
-            cfg, self.attn_fn, self.decode, name="attn"
+            cfg, self.attn_fn, self.decode, name="attn",
+            n_heads=self.n_heads, n_kv=self.n_kv, reduce_fn=self.reduce_fn,
         )(h, positions, deterministic, rope_tabs)
         h = FusedLayerNorm(name="ln2")(x)
         # Column- then row-parallel MLP (Megatron split over `model`).
-        fc_in = dense(cfg.intermediate_size, dtype=cfg.dtype,
+        fc_in = dense(self.ffn_size or cfg.intermediate_size,
+                      dtype=cfg.dtype,
                       quant=cfg.quant, use_bias=False, name="fc_in")
         fc_out = dense(cfg.hidden_size, dtype=cfg.dtype, quant=cfg.quant,
                        use_bias=False, name="fc_out")
@@ -339,6 +363,12 @@ class GPTBlock(nn.Module):
             )
         else:
             m = mlp(h)
+        if self.reduce_fn is not None:
+            # Completes the row-parallel fc_out (manual TP): each shard
+            # holds F/tp of the intermediate, its fc_out output is a
+            # partial sum.  Applied before dropout/residual, mirroring
+            # where GSPMD inserts the all-reduce on auto meshes.
+            m = self.reduce_fn(m)
         if cfg.dropout_rate:
             m = nn.Dropout(cfg.dropout_rate)(m, deterministic=deterministic)
         return x + m

@@ -34,12 +34,17 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+import numpy as np
+
 from ..parallel import mesh as mesh_lib
 from ..parallel.pipeline import (
+    SCHEDULES,
     circular_bubble_fraction,
     circular_pipeline_apply,
+    fb_schedule,
     gpipe_bubble_fraction,
     pipeline_apply,
+    pipeline_fb_step,
 )
 from .gpt import GPTBlock, GPTConfig, rope_tables
 from .layers import FusedLayerNorm
@@ -64,6 +69,17 @@ class PipelinedGPT:
     #: n_virtual non-adjacent stage chunks, shrinking the bubble
     #: n_virtual-fold (`circular_bubble_fraction`).
     n_virtual: int = 1
+    #: Training schedule: "gpipe" (all forwards, then autodiff — O(n_micro)
+    #: live microbatch activations; with n_virtual>1 the circular forward
+    #: order), "1f1b" (forward/backward interleaved, O(n_stages) live
+    #: stage inputs; n_virtual must be 1), or "interleaved"
+    #: (interleaved-1F1B over n_virtual>=2 chunks per rank,
+    #: O(n_stages*n_virtual) live stage inputs; n_microbatches must be a
+    #: multiple of n_stages).  The fb schedules compute the LM head loss
+    #: in-loop at the last stage (parallel.pipeline.pipeline_fb_step), so
+    #: they apply to the training loss_fn; apply()/eval always run the
+    #: forward-only schedule.
+    schedule: str = "gpipe"
     #: Sequence-parallel attention inside the stages when the mesh has a
     #: real ``seq`` axis: "ring" (ppermute KV rotation) or "ulysses"
     #: (all_to_all head<->sequence reshard).
@@ -74,10 +90,9 @@ class PipelinedGPT:
     #: after; with a bf16 model the stage output is an upcast bf16 value,
     #: so the roundtrip is BIT-EXACT (asserted by test) — requires
     #: cfg.dtype=bfloat16 for that reason.  Scan carries, schedule
-    #: buffers, and the region boundary stay fp32: jax 0.9's
-    #: partial-manual partitioner hard-aborts on bf16 region boundaries
-    #: under autodiff (the wire cast is the safe subset of the
-    #: optimization; see :meth:`apply`).
+    #: buffers, and the region boundary stay fp32 (numerics: cross-stage
+    #: residuals accumulate in fp32; the wire cast is the safe subset of
+    #: the bf16 optimization; see :meth:`apply`).
     handoff_dtype: str | None = None
 
     def __post_init__(self):
@@ -111,6 +126,34 @@ class PipelinedGPT:
                 f"circular schedule needs n_microbatches >= n_stages "
                 f"({self.n_microbatches} < {self.n_stages})"
             )
+        if self.schedule not in SCHEDULES:
+            raise ValueError(
+                f"schedule must be one of {SCHEDULES}, got {self.schedule!r}"
+            )
+        if self.schedule == "1f1b" and self.n_virtual != 1:
+            raise ValueError(
+                "schedule='1f1b' runs one chunk per rank; use "
+                "schedule='interleaved' for n_virtual > 1"
+            )
+        if self.schedule == "interleaved":
+            if self.n_virtual < 2:
+                raise ValueError(
+                    "schedule='interleaved' needs n_virtual >= 2 "
+                    "(--pp-virtual on the CLI); with one chunk per rank "
+                    "use schedule='1f1b'"
+                )
+            if self.n_microbatches % self.n_stages:
+                raise ValueError(
+                    f"interleaved schedule needs n_microbatches a multiple "
+                    f"of n_stages ({self.n_microbatches} vs {self.n_stages})"
+                )
+        if self.schedule != "gpipe" and self.seq_parallel:
+            raise NotImplementedError(
+                "1f1b/interleaved compute the LM-head loss inside the "
+                "pipeline region, and the next-token shift crosses seq "
+                "shards there — use schedule='gpipe' with sequence "
+                "parallelism"
+            )
         if cfg.dropout_rate:
             raise NotImplementedError(
                 "dropout inside the pipeline needs per-stage rng plumbing; "
@@ -136,6 +179,30 @@ class PipelinedGPT:
         self._embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="wte"
         )
+        # Manual Megatron tensor parallelism: the pipeline region is
+        # FULL-manual shard_map (this jax's partial-manual lowering
+        # hard-aborts — see apply()), so GSPMD cannot partition the stage
+        # kernels inside it.  The stage block instead runs with per-shard
+        # head counts / MLP width and an explicit row-parallel psum over
+        # ``model`` (reduce_fn), against kernels sliced by the region's
+        # in_specs.
+        self.tp = dict(self.mesh.shape).get(mesh_lib.AXIS_MODEL, 1)
+        tp_kwargs = {}
+        if self.tp > 1:
+            if (cfg.num_heads % self.tp or cfg.kv_heads % self.tp
+                    or cfg.intermediate_size % self.tp):
+                raise ValueError(
+                    f"manual tensor parallelism needs num_heads="
+                    f"{cfg.num_heads}, kv_heads={cfg.kv_heads} and "
+                    f"intermediate_size={cfg.intermediate_size} divisible "
+                    f"by model={self.tp}"
+                )
+            tp_kwargs = dict(
+                n_heads=cfg.num_heads // self.tp,
+                n_kv=cfg.kv_heads // self.tp,
+                ffn_size=cfg.intermediate_size // self.tp,
+                reduce_fn=lambda y: lax.psum(y, mesh_lib.AXIS_MODEL),
+            )
         # _block initializes params (dense attention; attn_fn carries no
         # params, so the tree is identical either way).  _apply_block is
         # what stages execute: under seq parallelism it swaps in ring
@@ -156,11 +223,15 @@ class PipelinedGPT:
                 functools.partial(
                     sp_fn, axis_name=self.seq_axis, causal=True
                 ),
+                **tp_kwargs,
             )
+        elif self.tp > 1:
+            self._apply_block = GPTBlock(cfg, **tp_kwargs)
         else:
             self._apply_block = self._block
         self._ln_f = FusedLayerNorm(out_dtype=jnp.float32, name="ln_f")
         self._region = None  # jitted pipeline region, built on first apply
+        self._fb = None  # cached custom_vjp fb-region (1f1b/interleaved)
 
     # --- init ---------------------------------------------------------------
 
@@ -203,10 +274,10 @@ class PipelinedGPT:
     def layout(self) -> Callable[[str, tuple], P]:
         """(path, shape) -> spec rule: stage dim of block leaves on ``pipe``,
         plus Megatron ``model``-axis sharding of the per-layer kernels when
-        the mesh has a real model axis (pipe x tp: the model axis stays
-        *auto* inside the pipeline's hybrid shard_map, so GSPMD partitions
-        the stage matmuls and inserts the row-parallel all-reduce exactly
-        as on an unpipelined mesh)."""
+        the mesh has a real model axis (pipe x tp: the region is
+        full-manual, so apply() re-slices the stored kernels head-major at
+        the boundary and the stage block runs per-shard Megatron math with
+        explicit row-parallel psums — see ``_split_tp_blocks``)."""
         axis = self.axis_name
         circular = self.n_virtual > 1
         tp = dict(self.mesh.shape).get(mesh_lib.AXIS_MODEL, 1) > 1
@@ -282,48 +353,292 @@ class PipelinedGPT:
         x, _ = lax.scan(one, x, stage_params)
         return x
 
+    # --- manual-TP kernel plumbing ------------------------------------------
+
+    def _split_tp_blocks(self, blocks: PyTree, nh: int | None = None,
+                         nkv: int | None = None) -> PyTree:
+        """Re-key the fused qkv kernel head-major for manual TP slicing.
+
+        The fused qkv out dim is laid out ``[q | k | v]``: a contiguous
+        ``model``-axis slice of it would cross the q/k/v boundaries, so a
+        per-shard slice would NOT be "this shard's heads".  Outside the
+        region the kernel is split into head-major leaves
+        ``(..., D, heads, head_dim)`` whose head dim the region's in_specs
+        shard; inside, each shard re-fuses ITS slice back into the local
+        fused layout the block expects (:meth:`_fuse_tp_blocks`).  Pure
+        slices/reshapes — autodiff carries kernel gradients back through
+        them into the stored fused layout.  ``nh``/``nkv`` override the
+        head counts for splitting a per-shard (local) fused tree — the fb
+        engine's gradient un-fusing path.
+        """
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        nh = nh if nh is not None else cfg.num_heads
+        nkv = nkv if nkv is not None else cfg.kv_heads
+        attn = dict(blocks["attn"])
+        qkv = dict(attn["qkv"])
+        kern = qkv["kernel"]
+        *lead, d, _ = kern.shape
+        qkv["kernel"] = {
+            "q": kern[..., :nh * hd].reshape(*lead, d, nh, hd),
+            "k": kern[..., nh * hd:(nh + nkv) * hd].reshape(
+                *lead, d, nkv, hd),
+            "v": kern[..., (nh + nkv) * hd:].reshape(*lead, d, nkv, hd),
+        }
+        attn["qkv"] = qkv
+        out = dict(blocks)
+        out["attn"] = attn
+        return out
+
+    @staticmethod
+    def _fuse_tp_blocks(blocks: PyTree) -> PyTree:
+        """Inverse of :meth:`_split_tp_blocks` on a per-shard slice."""
+        attn = dict(blocks["attn"])
+        qkv = dict(attn["qkv"])
+        parts = qkv["kernel"]
+
+        def flat(a):  # (..., D, h_local, hd) -> (..., D, h_local*hd)
+            return a.reshape(*a.shape[:-2], a.shape[-2] * a.shape[-1])
+
+        qkv["kernel"] = jnp.concatenate(
+            [flat(parts["q"]), flat(parts["k"]), flat(parts["v"])], axis=-1
+        )
+        attn["qkv"] = qkv
+        out = dict(blocks)
+        out["attn"] = attn
+        return out
+
+    def _block_specs(self, blocks_t: PyTree) -> PyTree:
+        """in_specs for the (possibly TP-split) stacked block tree."""
+        prefix = ((None, self.axis_name) if self.n_virtual > 1
+                  else (self.axis_name,))
+        tp = self.tp
+
+        def rule(path, leaf):
+            pstr = "/".join(str(getattr(k, "key", k)) for k in path)
+            tail = [None] * (leaf.ndim - len(prefix) - 1)
+            if tp > 1:
+                if "qkv/kernel" in pstr:
+                    tail[-2] = mesh_lib.AXIS_MODEL  # (..., D, heads, hd)
+                elif "proj/kernel" in pstr or "fc_out/kernel" in pstr:
+                    tail[-2] = mesh_lib.AXIS_MODEL  # row-parallel: in dim
+                elif "fc_in/kernel" in pstr:
+                    tail[-1] = mesh_lib.AXIS_MODEL  # column-parallel: out
+            return P(*prefix, None, *tail)
+
+        return jax.tree.map_with_path(rule, blocks_t)
+
+    # --- 1f1b / interleaved training loss -----------------------------------
+
+    def _head_fn(self, head_ps, y, ids_mb):
+        """In-loop loss head for the fb schedules: ln_f + tied chunked
+        next-token xent on ONE microbatch (mean over its tokens) — the
+        same math the gpipe path applies outside the region, per unit.
+        Collective-free by construction (the ``pipeline_fb_step``
+        contract: it runs under a rank-local ``lax.cond``)."""
+        from ..ops.xent import chunked_softmax_xent
+
+        h = self._ln_f.apply({"params": head_ps["ln_f"]}, y)
+        return chunked_softmax_xent(
+            h[:, :-1], head_ps["wte"]["embedding"], ids_mb[:, 1:],
+            compute_dtype=self.cfg.dtype,
+        )
+
+    def _build_fb(self, blocks_t: PyTree, head_ps: PyTree):
+        """Build the cached custom_vjp fb-region callable.
+
+        The region runs the hand-scheduled forward+backward
+        (:func:`..parallel.pipeline.pipeline_fb_step`) and returns loss
+        AND gradients; the custom_vjp wrapper exposes the loss with the
+        precomputed gradients as its backward, so ``jax.value_and_grad``
+        of the workload loss_fn — and everything stacked on it: gradient
+        accumulation, ``--zero``, ``--overlap`` — works unchanged.  The
+        embedding lookup stays OUTSIDE: its cotangent is the region's
+        ``dx0`` output, and jax transposes the lookup (and the tied
+        table's double use) automatically.
+        """
+        cfg = self.cfg
+        mesh = self.mesh
+        sched = fb_schedule(
+            self.n_stages, self.n_microbatches,
+            self.n_virtual if self.schedule == "interleaved" else 1,
+        )
+        batch_axes = mesh_lib.data_axes(mesh)
+        replicas = mesh_lib.replica_count(mesh)
+        scale = 1.0 / (replicas * self.n_microbatches)
+        n_micro = self.n_microbatches
+        circular = self.n_virtual > 1
+        tp = self.tp
+        x_spec = P(batch_axes if batch_axes else None, None, None)
+        ids_spec = P(batch_axes if batch_axes else None, None)
+        block_specs = self._block_specs(blocks_t)
+        head_specs = jax.tree.map(lambda _: P(), head_ps)
+
+        def psum_axes(spec):
+            """Mesh axes a leaf with this in_spec is replicated over —
+            exactly the psums shard_map's own transpose inserts for the
+            autodiff (gpipe) path, reproduced by hand here because the fb
+            backward is hand-scheduled."""
+            named = set()
+            for entry in spec:
+                if entry is None:
+                    continue
+                named.update(
+                    entry if isinstance(entry, tuple) else (entry,)
+                )
+            return tuple(
+                a for a in mesh.axis_names
+                if mesh.shape[a] > 1 and a not in named
+            )
+
+        # Cotangent convention: jax transposes ``lax.psum`` to ``lax.psum``
+        # and seeds the cotangent of a replicated output at ct/rep per
+        # shard — the interior psum-transposes (the row-parallel
+        # reduce_fn) restore full scale at each reduce point.  The
+        # hand-seeded head cotangent must follow the same convention, so
+        # it is divided by the replication factor of the non-batch,
+        # non-pipe axes (model TP), and EVERY gradient is psum'd over the
+        # axes its in_spec leaves unmapped — including the head over
+        # ``model``, whose per-shard value carries the 1/rep seed.
+        loss_reduce = tuple(
+            a for a in (*batch_axes, self.axis_name) if mesh.shape[a] > 1
+        )
+        head_reduce = psum_axes(P())
+        rep = 1
+        for a in head_reduce:
+            if a not in loss_reduce:
+                rep *= mesh.shape[a]
+        spec_leaves = jax.tree.leaves(
+            block_specs, is_leaf=lambda x: isinstance(x, P)
+        )
+
+        def region(blocks_in, head_in, x0l, idsl):
+            if tp > 1:
+                blocks_in = self._fuse_tp_blocks(blocks_in)
+            if circular:
+                stacks = jax.tree.map(lambda p: p[:, 0], blocks_in)
+            else:
+                stacks = blocks_in  # (1, lps, ...): rank dim = chunk dim
+            mb = x0l.reshape(
+                n_micro, x0l.shape[0] // n_micro, *x0l.shape[1:]
+            )
+            labs = idsl.reshape(
+                n_micro, idsl.shape[0] // n_micro, *idsl.shape[1:]
+            )
+            loss_sum, d_stage, d_head, dx0 = pipeline_fb_step(
+                self._stage_fn, self._head_fn, stacks, head_in, mb, labs,
+                sched, axis_name=self.axis_name,
+                cotangent_scale=scale / rep,
+                wire_dtype=self._wire,
+            )
+            loss = loss_sum * jnp.float32(scale)
+            if loss_reduce:
+                loss = lax.psum(loss, loss_reduce)
+            if head_reduce:
+                d_head = jax.tree.map(
+                    lambda g: lax.psum(g, head_reduce), d_head
+                )
+            if circular:
+                d_stage = jax.tree.map(lambda g: g[:, None], d_stage)
+            if tp > 1:
+                d_stage = self._split_tp_blocks(
+                    d_stage, nh=cfg.num_heads // tp,
+                    nkv=cfg.kv_heads // tp,
+                )
+            flat_g, treedef = jax.tree.flatten(d_stage)
+            d_stage = jax.tree.unflatten(treedef, [
+                lax.psum(g, ax) if (ax := psum_axes(sp)) else g
+                for g, sp in zip(flat_g, spec_leaves)
+            ])
+            dx0 = dx0.reshape(x0l.shape)
+            dx_axes = psum_axes(x_spec)
+            if dx_axes:
+                dx0 = lax.psum(dx0, dx_axes)
+            return loss, d_stage, d_head, dx0
+
+        region_sm = jax.jit(jax.shard_map(
+            region, mesh=mesh,
+            in_specs=(block_specs, head_specs, x_spec, ids_spec),
+            out_specs=(P(), block_specs, head_specs, x_spec),
+            check_vma=False,
+        ))
+
+        @jax.custom_vjp
+        def fb(blocks_in, head_in, x0, ids):
+            return region_sm(blocks_in, head_in, x0, ids)[0]
+
+        def fb_fwd(blocks_in, head_in, x0, ids):
+            loss, gb, gh, dx0 = region_sm(blocks_in, head_in, x0, ids)
+            return loss, (gb, gh, dx0, ids)
+
+        def fb_bwd(res, ct):
+            gb, gh, dx0, ids = res
+
+            def sc(tree):
+                return jax.tree.map(lambda g: (g * ct).astype(g.dtype),
+                                    tree)
+
+            ids_ct = np.zeros(ids.shape, jax.dtypes.float0)
+            return sc(gb), sc(gh), (dx0 * ct).astype(dx0.dtype), ids_ct
+
+        fb.defvjp(fb_fwd, fb_bwd)
+        self._fb = fb
+
+    def fb_train_loss(self, params: PyTree, input_ids: jax.Array):
+        """Scalar LM loss via the fb (1f1b/interleaved) schedule, with
+        gradients precomputed in-region (see :meth:`_build_fb`)."""
+        x0 = self._embed.apply(
+            {"params": params["wte"]}, input_ids
+        ).astype(jnp.float32)
+        head_ps = {"ln_f": params["ln_f"], "wte": params["wte"]}
+        blocks_t = (self._split_tp_blocks(params["blocks"])
+                    if self.tp > 1 else params["blocks"])
+        if self._fb is None:
+            self._build_fb(blocks_t, head_ps)
+        return self._fb(blocks_t, head_ps, x0, input_ids)
+
     def apply(self, variables: dict, input_ids: jax.Array, *,
               return_hidden: bool = False) -> jax.Array:
         params = variables["params"] if "params" in variables else variables
         cfg = self.cfg
         x = self._embed.apply({"params": params["wte"]}, input_ids)
 
-        # Hybrid shard_map: only the axes whose collectives the pipeline
-        # emits by hand (pipe ppermute, seq ring) are manual; data and
-        # model stay AUTO — GSPMD shards the batch and partitions the
-        # Megatron kernels (incl. the row-parallel all-reduce) inside the
-        # region exactly as it would outside it.
-        manual = {self.axis_name}
-        if self.seq_parallel:
-            manual.add(self.seq_axis)
+        # FULL-manual shard_map: every mesh axis is manual inside the
+        # region.  This jax's (0.4.37) partial-manual lowering goes
+        # through `PartitionId`, which XLA's SPMD partitioner rejects
+        # outright ("meaning is ambiguous"), and the grad path hard-aborts
+        # on `IsManualSubgroup` — probed by tests/test_jax_workarounds.py.
+        # Full-manual sidesteps the partitioner entirely: the batch is
+        # manually sharded over the data axes, the stage kernels are
+        # manually sliced over ``model`` with the block running per-shard
+        # Megatron math + explicit row-parallel psums (__post_init__),
+        # and the seq axis was always manual (ring/Ulysses collectives).
+        # Embed and head stay OUTSIDE the region on GSPMD-auto axes, so
+        # the pipe-sharded vocab table partitions exactly as before.
+        batch_axes = mesh_lib.data_axes(self.mesh)
         x_spec = P(
-            None,  # batch dim: auto (data/fsdp sharding propagates)
+            batch_axes if batch_axes else None,
             self.seq_axis if self.seq_parallel else None,
             None,
         )
         circular = self.n_virtual > 1
-        if circular:
-            block_specs = jax.tree.map(
-                lambda p: P(None, self.axis_name, *([None] * (p.ndim - 2))),
-                params["blocks"],
-            )
-        else:
-            block_specs = jax.tree.map(
-                lambda p: P(self.axis_name, *([None] * (p.ndim - 1))),
-                params["blocks"],
-            )
+        blocks_t = (self._split_tp_blocks(params["blocks"])
+                    if self.tp > 1 else params["blocks"])
+        block_specs = self._block_specs(blocks_t)
         n_micro = self.n_microbatches
         n_virtual = self.n_virtual
 
         def inner(block_params, xl):
-            # xl stays fp32 through the pipeline machinery (scan carries,
-            # ppermute handoffs); _stage_fn casts to cfg.dtype internally.
-            # xl's batch dim is GLOBAL here (data is an auto axis)
+            # xl is this shard's LOCAL batch; it stays fp32 through the
+            # pipeline machinery (scan carries, ppermute handoffs) —
+            # _stage_fn casts to cfg.dtype internally.
             if xl.shape[0] % n_micro:
                 raise ValueError(
-                    f"global batch {xl.shape[0]} not divisible by "
+                    f"per-replica batch {xl.shape[0]} not divisible by "
                     f"n_microbatches={n_micro}"
                 )
+            if self.tp > 1:
+                block_params = self._fuse_tp_blocks(block_params)
             mb = xl.reshape(
                 n_micro, xl.shape[0] // n_micro, *xl.shape[1:]
             )
@@ -341,36 +656,22 @@ class PipelinedGPT:
                 )
             return out.reshape(xl.shape)
 
-        # Everything crossing or carried by the partial-manual region is
-        # fp32: jax 0.9's partial-manual shard_map partitioner crashed on
-        # bf16 copies ("invalid binary instruction opcode copy") when the
-        # region composes with GSPMD-auto tensor-parallel kernels inside
-        # (pipe x model), and hard-ABORTS the process under autodiff of a
-        # bf16-boundary region on every composition (probed round 4).
-        # Plain data x pipe bf16 FORWARD regions do compile
-        # (tests/test_jax_workarounds.py pins the facts), but training is
-        # the product, so the boundary stays fp32 unconditionally; the
-        # safe subset of the bf16 optimization is the ppermute PAYLOAD
-        # cast (``handoff_dtype="bfloat16"`` -> pipeline wire_dtype),
-        # which is bit-exact for bf16 models.  Stage compute is still
-        # cfg.dtype (see _stage_fn); fp32 handoffs are (mb, S, D)
-        # residuals — tiny next to the stage matmuls — and ln_f upcasts
-        # the output anyway.
-        # The jit wrapper is load-bearing: partial-manual shard_map has no
-        # eager impl path in jax 0.9 (_unmatch_spec only supports
-        # all-manual), and grad-of-eager interprets the region the same
-        # broken way.  Under an outer jit this inlines.  Cached on self so
-        # eager callers don't pay a retrace per apply() (the specs depend
-        # only on construction-time state; `inner` closes over nothing
+        # The region boundary and schedule buffers stay fp32: stage
+        # compute is still cfg.dtype (_stage_fn), the fp32 handoffs are
+        # (mb, S, D) residuals — tiny next to the stage matmuls — and the
+        # safe half of the bf16-wire optimization is the ppermute PAYLOAD
+        # cast (``handoff_dtype="bfloat16"`` -> wire_dtype), bit-exact for
+        # bf16 models.  The jit wrapper is cached on self so eager callers
+        # don't pay a retrace per apply() (specs depend only on
+        # construction-time state; `inner` closes over nothing
         # call-specific).
         if self._region is None:
             self._region = jax.jit(jax.shard_map(
                 inner, mesh=self.mesh,
                 in_specs=(block_specs, x_spec), out_specs=x_spec,
-                axis_names=frozenset(manual),
                 check_vma=False,
             ))
-        x = self._region(params["blocks"], x.astype(jnp.float32))
+        x = self._region(blocks_t, x.astype(jnp.float32))
 
         x = self._ln_f.apply({"params": params["ln_f"]}, x)
         if return_hidden:
@@ -381,6 +682,11 @@ class PipelinedGPT:
         return tied_head_logits(x, wte, self.cfg.dtype)
 
     def bubble_fraction(self) -> float:
+        if self.schedule in ("1f1b", "interleaved"):
+            return fb_schedule(
+                self.n_stages, self.n_microbatches,
+                self.n_virtual if self.schedule == "interleaved" else 1,
+            ).bubble_fraction()
         if self.n_virtual > 1:
             return circular_bubble_fraction(
                 self.n_stages, self.n_microbatches, self.n_virtual
@@ -391,7 +697,19 @@ class PipelinedGPT:
 def pipelined_lm_loss(model: PipelinedGPT):
     """Next-token cross-entropy through the pipeline (same math as
     ``gpt.lm_loss`` incl. the vocab-chunked head; rng unused — dropout is
-    rejected at construction)."""
+    rejected at construction).  For the fb schedules (1f1b/interleaved)
+    the head loss is computed INSIDE the scheduled loop and the gradients
+    ride a custom_vjp (:meth:`PipelinedGPT.fb_train_loss`), so this
+    loss_fn still plugs into ``jax.value_and_grad`` unchanged."""
+    if model.schedule != "gpipe":
+        def fb_loss_fn(params, model_state, batch, rng):
+            loss = model.fb_train_loss(
+                params, jnp.asarray(batch["input_ids"])
+            )
+            return loss, ({"perplexity": jnp.exp(loss)}, model_state)
+
+        return fb_loss_fn
+
     from ..ops.xent import chunked_softmax_xent
 
     def loss_fn(params, model_state, batch, rng):

@@ -20,16 +20,26 @@ microbatch counts >= 4x stages.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 
 PyTree = Any
+
+#: Pipeline schedules.  ``gpipe`` (all-forward-then-autodiff; with
+#: ``n_virtual > 1`` the circular/interleaved *forward* order) keeps
+#: O(n_micro) microbatch activations live across the backward.  The
+#: forward/backward-interleaved training schedules ``1f1b`` and
+#: ``interleaved`` (:func:`fb_schedule` + :func:`pipeline_fb_step`) bound
+#: live stage inputs at O(n_stages) / O(n_stages * n_virtual) slots.
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
 
 
 def gpipe_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
@@ -368,3 +378,335 @@ def make_circular_pipelined_fn(
         stage_fn, mesh, param_specs, n_microbatches=n_microbatches,
         n_virtual=n_virtual, axis_name=axis_name, remat=remat,
     )
+
+
+# --- 1F1B / interleaved-1F1B: forward/backward-interleaved schedules ----------
+#
+# GPipe above is "all forwards, then autodiff": jax reverses the tick scan,
+# so every microbatch's stage input stays live until its backward runs —
+# O(n_micro) live microbatch activations per rank.  The 1F1B family
+# (PipeDream-flush; Megatron's interleaved variant — PAPERS.md 2412.14374
+# positions both) interleaves: each tick runs ONE forward unit and ONE
+# backward unit per rank, a microbatch's backward starts as soon as its
+# forward clears the last stage, and its saved stage input is freed on the
+# spot.  Live stage inputs are bounded by the schedule DEPTH — O(n_stages)
+# slots for 1F1B, O(n_stages * n_virtual) for interleaved — independent of
+# n_micro.  The backward is written BY HAND inside the same scan (per-unit
+# jax.vjp with the saved stage input, i.e. per-stage rematerialization), so
+# the loss head must be evaluated inside the loop at the last stage: the
+# engine takes a ``head_fn`` and returns loss + gradients directly instead
+# of being differentiated from outside.
+
+
+@dataclasses.dataclass(frozen=True)
+class FBSchedule:
+    """Static schedule tables for :func:`pipeline_fb_step`.
+
+    Each table is an int32 ``(ticks, n_stages)`` array; column ``s`` is
+    rank ``s``'s program.  Per tick a rank runs at most one forward unit
+    (``f_*``: chunk, microbatch, act-slot to save the stage input into,
+    whether the input comes from the microbatch buffer) and one backward
+    unit (``b_*``: chunk, microbatch, act-slot to restore, whether the
+    cotangent comes from the in-loop loss head).  ``n_slots`` is the exact
+    peak number of saved stage inputs any rank holds — the schedule's
+    activation-memory bound, asserted by the generator.
+    """
+
+    n_stages: int
+    n_micro: int
+    n_virtual: int
+    n_slots: int
+    ticks: int
+    tables: dict[str, np.ndarray]
+
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the fb schedule's tick timeline: the warmup/
+        drain ticks where a rank has no unit to run, over total ticks
+        (both phases weighted equally — on real chips the backward unit
+        costs ~2x the forward one, which shifts the fraction slightly in
+        the schedule's favor)."""
+        busy = 2 * self.n_virtual * self.n_micro
+        total = 2 * self.ticks
+        return (total - busy) / total
+
+
+def _fb_units(n: int, m_total: int, v: int, forward: bool) -> list:
+    """Unit execution order for one rank: ``[(chunk, microbatch), ...]``.
+
+    Megatron's interleaved grouping: microbatches advance in groups of
+    ``n`` per chunk, so the cross-chunk wrap-around (rank n-1 -> rank 0)
+    always arrives exactly one tick before its consumer — both wraps ride
+    the ppermute rings with zero extra buffering.  Backward mirrors the
+    chunk order (last chunk first).
+    """
+    units = []
+    for u in range(v * m_total):
+        if v == 1:
+            c, m = 0, u
+        else:
+            c = (u % (n * v)) // n
+            m = (u // (n * v)) * n + (u % n)
+        units.append((v - 1 - c, m) if (not forward and v > 1) else (c, m))
+    return units
+
+
+def fb_schedule(
+    n_stages: int, n_microbatches: int, n_virtual: int = 1
+) -> FBSchedule:
+    """Build (and statically validate) a 1F1B / interleaved-1F1B schedule.
+
+    ``n_virtual == 1`` is plain 1F1B; ``> 1`` is the interleaved variant
+    (requires ``n_microbatches`` a positive multiple of ``n_stages``, the
+    Megatron grouping constraint).  Every wire hop, act-slot reuse, and
+    the peak-slot bound are checked here in plain Python — an off-by-one
+    would otherwise surface as silently-wrong gradients.
+    """
+    n, m_total, v = n_stages, n_microbatches, n_virtual
+    if n < 1 or m_total < 1 or v < 1:
+        raise ValueError(
+            f"need n_stages>=1, n_microbatches>=1, n_virtual>=1; got "
+            f"{n}/{m_total}/{v}"
+        )
+    if v > 1 and (m_total % n or m_total < n):
+        raise ValueError(
+            f"interleaved schedule needs n_microbatches a positive "
+            f"multiple of n_stages ({m_total} vs {n})"
+        )
+    fwd = _fb_units(n, m_total, v, forward=True)
+    bwd = _fb_units(n, m_total, v, forward=False)
+    b0 = (v - 1) * n + (n - 1)
+    ticks = b0 + (n - 1) + v * m_total
+    shape = (ticks, n)
+    tabs = {
+        k: np.zeros(shape, np.int32)
+        for k in ("f_on", "f_c", "f_m", "f_slot", "f_inp",
+                  "b_on", "b_c", "b_m", "b_slot", "b_head")
+    }
+    n_slots = 0
+    for s in range(n):
+        fwd_tick = {}
+        slot_of = {}
+        free: list[int] = []
+        next_slot = 0
+        high = 0
+        for t in range(ticks):
+            u = t - s
+            if 0 <= u < v * m_total:
+                c, m = fwd[u]
+                fwd_tick[(c, m)] = t
+                slot = free.pop() if free else next_slot
+                if slot == next_slot:
+                    next_slot += 1
+                slot_of[(c, m)] = slot
+                high = max(high, next_slot)
+                tabs["f_on"][t, s] = 1
+                tabs["f_c"][t, s] = c
+                tabs["f_m"][t, s] = m
+                tabs["f_slot"][t, s] = slot
+                tabs["f_inp"][t, s] = int(s == 0 and c == 0)
+            w = t - b0 - (n - 1 - s)
+            if 0 <= w < v * m_total:
+                c, m = bwd[w]
+                assert (c, m) in slot_of, (
+                    f"rank {s}: backward of {(c, m)} at tick {t} before "
+                    f"its forward"
+                )
+                assert fwd_tick[(c, m)] <= t
+                slot = slot_of.pop((c, m))
+                free.append(slot)
+                tabs["b_on"][t, s] = 1
+                tabs["b_c"][t, s] = c
+                tabs["b_m"][t, s] = m
+                tabs["b_slot"][t, s] = slot
+                tabs["b_head"][t, s] = int(s == n - 1 and c == v - 1)
+        assert not slot_of, f"rank {s}: units never backwarded: {slot_of}"
+        n_slots = max(n_slots, high)
+    # Wire freshness: the engine keeps ONE recv buffer per direction, so
+    # every consumed message must have been sent exactly one tick earlier
+    # by the ring neighbor, carrying exactly the consumer's unit.
+    for s in range(n):
+        for t in range(ticks):
+            if tabs["f_on"][t, s] and not tabs["f_inp"][t, s]:
+                src = (s - 1) % n
+                assert t >= 1 and tabs["f_on"][t - 1, src], (s, t)
+                sent = (tabs["f_c"][t - 1, src], tabs["f_m"][t - 1, src])
+                want = (tabs["f_c"][t, s], tabs["f_m"][t, s])
+                if s > 0:
+                    assert sent == want, (s, t, sent, want)
+                else:  # wrap: rank n-1's chunk c-1 output feeds chunk c
+                    assert sent == (want[0] - 1, want[1]), (s, t, sent, want)
+            if tabs["b_on"][t, s] and not tabs["b_head"][t, s]:
+                src = (s + 1) % n
+                assert t >= 1 and tabs["b_on"][t - 1, src], (s, t)
+                sent = (tabs["b_c"][t - 1, src], tabs["b_m"][t - 1, src])
+                want = (tabs["b_c"][t, s], tabs["b_m"][t, s])
+                if s < n - 1:
+                    assert sent == want, (s, t, sent, want)
+                else:  # wrap: rank 0's chunk c cotangent feeds chunk c-1
+                    assert sent == (want[0] + 1, want[1]), (s, t, sent, want)
+    return FBSchedule(
+        n_stages=n, n_micro=m_total, n_virtual=v, n_slots=n_slots,
+        ticks=ticks, tables=tabs,
+    )
+
+
+def pipeline_fb_step(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    head_fn: Callable[[PyTree, jax.Array, PyTree], jax.Array],
+    stage_params: PyTree,  # leaves (n_virtual, lps, ...): this rank's chunks
+    head_params: PyTree,
+    microbatches: jax.Array,  # (n_micro, mb, ...) — this shard's batch slice
+    labels: PyTree,  # leaves (n_micro, mb, ...)
+    sched: FBSchedule,
+    *,
+    axis_name: str = mesh_lib.AXIS_PIPE,
+    cotangent_scale: float = 1.0,
+    wire_dtype: object | None = None,
+) -> tuple[jax.Array, PyTree, PyTree, jax.Array]:
+    """Run one fused forward+backward 1F1B pass (shard_map-internal).
+
+    Per tick every rank runs (a) its forward unit — stage_fn on the
+    recv'd/new microbatch, saving the stage INPUT into its act-slot ring —
+    and (b) its backward unit — ``jax.vjp(stage_fn)`` on the saved input
+    (per-stage rematerialization), with the cotangent either received
+    from the right neighbor or, at the last stage, produced in-tick by
+    ``jax.vjp(head_fn)`` seeded with ``cotangent_scale``.  Both phases are
+    ``lax.cond``-gated (the predicate depends only on (tick, pipe rank),
+    so model/seq peers inside ``stage_fn`` always agree — its collectives
+    stay uniform; ``head_fn`` must be collective-free).
+
+    Returns per-shard ``(loss_sum, stage_grads, head_grads, dx0)``: the
+    caller applies the cross-shard psums that shard_map's own transpose
+    would have inserted (grads of replicated inputs) and scales the loss.
+    ``head_fn(head_params, y, labels_mb) -> scalar`` must be the mean loss
+    of one microbatch.  Because this scan never gets differentiated from
+    outside, XLA stores no per-tick residuals: live activation memory is
+    exactly the ``sched.n_slots`` act ring plus carries.
+    """
+    n = sched.n_stages
+    s = lax.axis_index(axis_name)
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+    perm_bwd = [(i, (i - 1) % n) for i in range(n)]
+    act_shape = microbatches.shape[1:]
+
+    def pick_chunk(params, c):
+        return jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, c, keepdims=False), params
+        )
+
+    def tick(carry, trow):
+        recv_f, recv_b, acts, d_stage, d_head, dx0, loss_acc = carry
+
+        def g(key):
+            return lax.dynamic_index_in_dim(trow[key], s, keepdims=False)
+
+        # ---- forward phase ----
+        f_on = g("f_on") > 0
+        f_c, f_m, f_slot = g("f_c"), g("f_m"), g("f_slot")
+        x_new = lax.dynamic_index_in_dim(microbatches, f_m, keepdims=False)
+        x = jnp.where(g("f_inp") > 0, x_new, recv_f)
+
+        y = lax.cond(
+            f_on,
+            lambda opr: stage_fn(*opr),
+            lambda opr: jnp.zeros(act_shape, x.dtype),
+            (pick_chunk(stage_params, f_c), x),
+        )
+        old_slot = lax.dynamic_index_in_dim(acts, f_slot, keepdims=False)
+        acts = lax.dynamic_update_index_in_dim(
+            acts, jnp.where(f_on, x, old_slot), f_slot, axis=0
+        )
+
+        # ---- backward phase ----
+        b_on = g("b_on") > 0
+        b_c, b_m, b_slot = g("b_c"), g("b_m"), g("b_slot")
+        b_head = g("b_head") > 0
+        x_saved = lax.dynamic_index_in_dim(acts, b_slot, keepdims=False)
+        lab = jax.tree.map(
+            lambda v: lax.dynamic_index_in_dim(v, b_m, keepdims=False),
+            labels,
+        )
+        params_b = pick_chunk(stage_params, b_c)
+
+        def bwd_branch(opr):
+            params_c, xx, rb, lab_ = opr
+            yb, pull = jax.vjp(stage_fn, params_c, xx)
+
+            def head_branch(o):
+                hp, yy, ll = o
+                loss_u, hpull = jax.vjp(
+                    lambda hp_, y_: head_fn(hp_, y_, ll), hp, yy
+                )
+                d_hp, d_y = hpull(
+                    jnp.asarray(cotangent_scale, loss_u.dtype)
+                )
+                return loss_u.astype(jnp.float32), d_hp, d_y
+
+            def no_head(o):
+                hp, yy, _ = o
+                return (jnp.zeros((), jnp.float32),
+                        jax.tree.map(jnp.zeros_like, hp),
+                        jnp.zeros_like(yy))
+
+            loss_u, d_hp, d_y = lax.cond(
+                b_head, head_branch, no_head, (head_params, yb, lab_)
+            )
+            cot = jnp.where(b_head, d_y, rb.astype(yb.dtype))
+            d_pc, dxx = pull(cot)
+            return loss_u, d_hp, d_pc, dxx
+
+        def bwd_zero(opr):
+            params_c, xx, _, _ = opr
+            return (jnp.zeros((), jnp.float32),
+                    jax.tree.map(jnp.zeros_like, head_params),
+                    jax.tree.map(jnp.zeros_like, params_c),
+                    jnp.zeros_like(xx))
+
+        loss_u, d_hp, d_pc, dx = lax.cond(
+            b_on, bwd_branch, bwd_zero, (params_b, x_saved, recv_b, lab)
+        )
+        loss_acc = loss_acc + loss_u
+        d_head = jax.tree.map(jnp.add, d_head, d_hp)
+        d_stage = jax.tree.map(
+            lambda acc, gl: lax.dynamic_update_index_in_dim(
+                acc,
+                lax.dynamic_index_in_dim(acc, b_c, keepdims=False)
+                + gl.astype(acc.dtype),
+                b_c, axis=0,
+            ),
+            d_stage, d_pc,
+        )
+        is_dx0 = b_on & (s == 0) & (b_c == 0)
+        old0 = lax.dynamic_index_in_dim(dx0, b_m, keepdims=False)
+        dx0 = lax.dynamic_update_index_in_dim(
+            dx0,
+            jnp.where(is_dx0, old0 + dx.astype(dx0.dtype), old0),
+            b_m, axis=0,
+        )
+
+        recv_f = _wire_ppermute(y, axis_name, perm_fwd, wire_dtype)
+        # Cotangents ride the reverse wire at FULL precision: unlike the
+        # forward activations (bf16-upcast values for bf16 models, where
+        # the wire_dtype roundtrip is bit-exact), gradient cotangents are
+        # full-range fp32 — casting them would silently round every
+        # gradient and break handoff_dtype's bit-exactness contract.
+        recv_b = _wire_ppermute(
+            jnp.where(b_on, dx, jnp.zeros_like(dx)).astype(
+                microbatches.dtype
+            ),
+            axis_name, perm_bwd, None,
+        )
+        return (recv_f, recv_b, acts, d_stage, d_head, dx0, loss_acc), None
+
+    init = (
+        jnp.zeros(act_shape, microbatches.dtype),
+        jnp.zeros(act_shape, microbatches.dtype),
+        jnp.zeros((sched.n_slots, *act_shape), microbatches.dtype),
+        jax.tree.map(jnp.zeros_like, stage_params),
+        jax.tree.map(jnp.zeros_like, head_params),
+        jnp.zeros((sched.n_micro, *act_shape), microbatches.dtype),
+        jnp.zeros((), jnp.float32),
+    )
+    xs = {k: jnp.asarray(v) for k, v in sched.tables.items()}
+    (_, _, _, d_stage, d_head, dx0, loss_sum), _ = lax.scan(tick, init, xs)
+    return loss_sum, d_stage, d_head, dx0

@@ -1,0 +1,697 @@
+"""MPMD pipeline parallelism: each stage is a separate *program*.
+
+The SPMD schedules in ``parallel/pipeline.py`` run every stage inside one
+jitted program on one mesh.  This module is the contrasting design from
+"Scaling Deep Learning Training with MPMD Pipeline Parallelism"
+(PAPERS.md, arxiv 2412.14374): each pipeline stage is its own OS process
+with its own params, its own compiled programs, and its own optimizer —
+driven through :class:`..parallel.coordinator.Coordinator`'s
+process-backed workers, so stage death rides the coordinator's
+retry/respawn machinery instead of killing the run.
+
+Wire contract (the ``data/wire.py`` raw tensor frames, PR 9 idiom):
+
+- stage ``i`` holds ONE persistent loopback TCP link to stage ``i+1``
+  (``u64 LE frame length | DTW1 frame``); activations flow down the link,
+  cotangents flow back up the same link;
+- every frame is a raw tensor dict (optional CRC32C) whose header echoes
+  the sender's trace context, so the receiver's ``pipeline.handoff`` span
+  parents under the sender's step span and ``tools/timeline.py --fleet``
+  stitches the per-stage ``trace.jsonl`` files into one cross-process
+  schedule rendering;
+- the sender may have at most ``window`` microbatches in flight per link
+  (activation sent, cotangent not yet returned) — the credit window that
+  bounds per-stage live activations exactly like the SPMD 1F1B slot ring;
+- each link runs a reader and a writer thread, so stage compute overlaps
+  the transfer in steady state (the socket drains while the next
+  microbatch computes).
+
+Training semantics: a GPT split layer-wise.  Stage 0 owns the embedding
+and the first layers; the last stage owns the final layers, ``ln_f`` and
+an UNTIED head (a tied head would need a cross-stage gradient exchange
+for the shared table — exactly the coupling MPMD removes).  Backward is
+save-the-stage-input + recompute (the 1F1B remat pattern): on a returned
+cotangent the stage re-runs its forward under ``jax.grad``.  Gradients
+are stage-local by construction, so each stage applies its own optimizer
+step with NO cross-stage collective — the MPMD property that removes the
+``PartitionId``-class single-program lowering ceilings entirely.
+
+Failure contract: a killed stage severs its links; every peer's closure
+raises :class:`..parallel.coordinator.WorkerUnavailableError`, the
+coordinator re-queues all stage closures, the killed process respawns
+(budget + backoff), and the run re-executes deterministically from its
+seeds — completion-despite-kill is the smoke-test acceptance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from .. import obs
+from ..data import wire
+from ..obs.tracing import (
+    TraceRecorder,
+    current_context,
+    new_trace_id,
+    record_remote_span,
+    remote_span,
+)
+from .coordinator import Coordinator, WorkerUnavailableError
+from .pipeline import fb_schedule
+
+_LEN = struct.Struct("<Q")
+
+_H_HANDOFF = obs.histogram(
+    "pipeline_handoff_seconds",
+    "MPMD stage handoff latency: sender's frame stamp to receiver decode, "
+    "labeled by the RECEIVING stage",
+)
+_H_STALL = obs.histogram(
+    "pipeline_mpmd_stall_seconds",
+    "seconds a stage spent blocked on its credit window (activations in "
+    "flight == window) before the next cotangent freed a slot, by stage",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MPMDConfig:
+    """Model + schedule shape for one MPMD pipeline run (picklable — it
+    rides the coordinator's closure pipe into every stage process)."""
+
+    n_stages: int = 2
+    n_steps: int = 8
+    n_microbatches: int = 4
+    microbatch_size: int = 4
+    seq_len: int = 32
+    vocab_size: int = 256
+    hidden_size: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    #: credit window: activation microbatches in flight per link before
+    #: the sender blocks (the per-stage live-activation bound)
+    window: int = 2
+    lr: float = 1e-2
+    seed: int = 0
+    crc: bool = True
+    recv_timeout_s: float = 120.0
+    connect_timeout_s: float = 60.0
+
+    def validate(self) -> None:
+        if self.n_stages < 2:
+            raise ValueError("MPMD pipeline needs n_stages >= 2")
+        if self.num_layers % self.n_stages:
+            raise ValueError(
+                f"num_layers={self.num_layers} not divisible by "
+                f"n_stages={self.n_stages}"
+            )
+        if self.window < 1:
+            raise ValueError("window must be >= 1")
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide into num_heads")
+
+
+# --- framed link over one TCP socket -----------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed the link")
+        buf += chunk
+    return bytes(buf)
+
+
+class _Link:
+    """One persistent stage-to-stage connection: reader + writer threads
+    (compute/transfer overlap), framed raw-tensor payloads."""
+
+    def __init__(self, sock: socket.socket, name: str, crc: bool):
+        self._sock = sock
+        self._name = name
+        self._crc = crc
+        self.rx: queue.Queue = queue.Queue()
+        self._tx: queue.Queue = queue.Queue()
+        self._dead: BaseException | None = None
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"{name}-rx", daemon=True
+        )
+        self._writer = threading.Thread(
+            target=self._write_loop, name=f"{name}-tx", daemon=True
+        )
+        self._reader.start()
+        self._writer.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                (ln,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+                if ln > (1 << 31):
+                    # The DTW1 CRC covers the payload, not this prefix: a
+                    # desynced length must fail the link immediately, not
+                    # allocate toward 2^64 bytes until the recv timeout
+                    # (same bound as the data-service framing).
+                    raise ConnectionError(f"oversized frame ({ln} bytes)")
+                payload = _recv_exact(self._sock, ln)
+                trace = wire.peek_trace(payload)
+                tensors = wire.decode_tensors(payload)
+                self.rx.put(("frame", tensors, trace))
+        except BaseException as e:  # noqa: BLE001 — surfaced to the loop
+            self._dead = e
+            self.rx.put(("dead", e, None))
+
+    def _write_loop(self) -> None:
+        try:
+            while True:
+                payload = self._tx.get()
+                if payload is None:
+                    return
+                self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        except BaseException as e:  # noqa: BLE001
+            self._dead = e
+            self.rx.put(("dead", e, None))
+
+    def send(self, tensors: dict, trace: dict | None = None) -> None:
+        if self._dead is not None:
+            raise WorkerUnavailableError(
+                f"link {self._name} is dead: {self._dead!r}"
+            )
+        self._tx.put(wire.encode_tensors(tensors, crc=self._crc, trace=trace))
+
+    def poll(self, timeout: float) -> tuple[dict, dict | None] | None:
+        """One frame, or None when nothing arrives within ``timeout``
+        (raises on a severed link)."""
+        try:
+            if timeout > 0:
+                kind, a, b = self.rx.get(timeout=timeout)
+            else:
+                kind, a, b = self.rx.get_nowait()
+        except queue.Empty:
+            return None
+        if kind == "dead":
+            raise WorkerUnavailableError(
+                f"link {self._name} severed: {a!r}"
+            )
+        return a, b
+
+    def recv(self, timeout: float) -> tuple[dict, dict | None]:
+        got = self.poll(timeout)
+        if got is None:
+            raise WorkerUnavailableError(
+                f"link {self._name}: no frame within {timeout:.0f}s "
+                "(stalled or dead peer)"
+            )
+        return got
+
+    def close(self) -> None:
+        self._tx.put(None)
+        # Drain the writer BEFORE severing the socket: the last cotangent
+        # of a finishing stage may still be in the tx queue, and a
+        # premature shutdown would cut it off mid-flight (the peer would
+        # then read a severed link where a clean final frame was owed).
+        self._writer.join(timeout=10.0)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+# --- loopback rendezvous ------------------------------------------------------
+
+
+def _port_file(rdir: str, link: int) -> str:
+    return os.path.join(rdir, f"link{link}.port")
+
+
+def _serve_link(rdir: str, link: int, timeout_s: float) -> socket.socket:
+    """Bind an ephemeral loopback listener, publish its port (atomic
+    rename — a respawned server republishes a FRESH port and the client's
+    connect-retry loop re-reads it), accept exactly one peer."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    os.makedirs(rdir, exist_ok=True)
+    tmp = _port_file(rdir, link) + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+    os.replace(tmp, _port_file(rdir, link))
+    srv.settimeout(timeout_s)
+    try:
+        conn, _ = srv.accept()
+    except socket.timeout:
+        raise WorkerUnavailableError(
+            f"link {link}: no upstream connection within {timeout_s:.0f}s"
+        ) from None
+    finally:
+        srv.close()
+    conn.settimeout(None)  # idleness policing lives at the queue level
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return conn
+
+
+def _connect_link(rdir: str, link: int, timeout_s: float) -> socket.socket:
+    deadline = time.monotonic() + timeout_s
+    path = _port_file(rdir, link)
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                port = int(f.read().strip())
+            sock = socket.create_connection(("127.0.0.1", port), timeout=2.0)
+            sock.settimeout(None)  # connect-only timeout; reads block
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+        except (OSError, ValueError):
+            time.sleep(0.1)
+    raise WorkerUnavailableError(
+        f"link {link}: could not connect within {timeout_s:.0f}s"
+    )
+
+
+# --- per-stage model ---------------------------------------------------------
+
+
+def _build_stage_fns(cfg: MPMDConfig, stage_id: int):
+    """Compiled programs of one stage: ``(init, fwd, bwd | loss_grad)``.
+
+    Backward is recompute-from-saved-input (``jax.grad`` of the stage
+    forward), so in-flight memory per microbatch is one stage INPUT.
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models.gpt import GPTBlock, GPTConfig
+    from ..models.layers import FusedLayerNorm
+
+    gcfg = GPTConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+        intermediate_size=4 * cfg.hidden_size, max_seq=cfg.seq_len,
+        dtype=jnp.float32, remat=False,
+    )
+    lps = cfg.num_layers // cfg.n_stages
+    first = stage_id == 0
+    last = stage_id == cfg.n_stages - 1
+
+    class Stage(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            if first:
+                x = nn.Embed(
+                    gcfg.vocab_size, gcfg.hidden_size,
+                    dtype=jnp.float32, name="wte",
+                )(x)
+            positions = jnp.broadcast_to(
+                jnp.arange(x.shape[1]), x.shape[:2]
+            )
+            for i in range(lps):
+                x = GPTBlock(gcfg, name=f"h{i}")(x, positions, True)
+            if last:
+                x = FusedLayerNorm(out_dtype=jnp.float32, name="ln_f")(x)
+                x = nn.Dense(
+                    gcfg.vocab_size, use_bias=False,
+                    dtype=jnp.float32, name="head",
+                )(x)
+            return x
+
+    module = Stage()
+    sample = (
+        jnp.zeros((1, cfg.seq_len), jnp.int32) if first
+        else jnp.zeros((1, cfg.seq_len, cfg.hidden_size), jnp.float32)
+    )
+    params = module.init(
+        jax.random.PRNGKey(cfg.seed * 7919 + stage_id), sample
+    )["params"]
+    tx = optax.adam(cfg.lr)
+    opt_state = tx.init(params)
+
+    fwd = jax.jit(lambda p, x: module.apply({"params": p}, x))
+
+    if last:
+        def _loss(p, x, ids):
+            logits = module.apply({"params": p}, x)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            tgt = ids[:, 1:]
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            return jnp.mean(nll)
+
+        loss_grad = jax.jit(jax.value_and_grad(_loss, argnums=(0, 1)))
+        bwd = None
+    elif first:
+        loss_grad = None
+
+        def _vjp_first(p, x, dy):
+            # x is the int token batch — params are the only diff input
+            _, pull = jax.vjp(lambda p_: module.apply({"params": p_}, x), p)
+            (gp,) = pull(dy)
+            return gp
+
+        bwd = jax.jit(_vjp_first)
+    else:
+        loss_grad = None
+
+        def _vjp_apply(p, x, dy):
+            _, pull = jax.vjp(lambda p_, x_: module.apply({"params": p_}, x_),
+                              p, x)
+            return pull(dy)
+
+        bwd = jax.jit(_vjp_apply)
+
+    update = jax.jit(
+        lambda p, o, g: (lambda up, no: (optax.apply_updates(p, up), no))(
+            *tx.update(g, o, p)
+        )
+    )
+    return params, opt_state, fwd, bwd, loss_grad, update
+
+
+def _make_ids(cfg: MPMDConfig, step: int, micro: int) -> np.ndarray:
+    """Deterministic learnable LM microbatch (modular sequences — the
+    test-suite make_batch idiom), identical across restart attempts."""
+    r = np.random.default_rng(cfg.seed * 100003 + step * 1009 + micro)
+    start = r.integers(0, cfg.vocab_size, (cfg.microbatch_size, 1))
+    delta = r.integers(1, 7, (cfg.microbatch_size, 1))
+    ids = (start + delta * np.arange(cfg.seq_len)) % cfg.vocab_size
+    return ids.astype(np.int32)
+
+
+def _observe_handoff(stage_id: int, tensors: dict, trace: dict | None,
+                     trace_id: str) -> None:
+    t_send = float(tensors["t_send"][()])
+    dur = max(time.time() - t_send, 0.0)
+    _H_HANDOFF.observe(dur, stage=str(stage_id))
+    record_remote_span(
+        "pipeline.handoff",
+        t0=t_send, dur_s=dur,
+        trace_id=(trace or {}).get("trace_id") or trace_id,
+        parent_id=(trace or {}).get("span_id"),
+        stage=stage_id,
+        step=int(tensors["step"][()]),
+        micro=int(tensors["micro"][()]),
+    )
+
+
+def _grads_add(acc, g):
+    import jax
+
+    if acc is None:
+        return g
+    return jax.tree.map(lambda a, b: a + b, acc, g)
+
+
+def _stage_worker(cfg: MPMDConfig, stage_id: int, rdir: str, logdir: str,
+                  trace_id: str):
+    """One stage process's whole life: rendezvous, train loop, teardown.
+
+    Runs inside a coordinator process worker; any link failure raises
+    WorkerUnavailableError so the closure re-queues (all-stage restart).
+    Returns the per-step mean losses from the LAST stage, None elsewhere.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cfg.validate()
+    first = stage_id == 0
+    last = stage_id == cfg.n_stages - 1
+    stage_dir = os.path.join(logdir, f"stage{stage_id}")
+    os.makedirs(stage_dir, exist_ok=True)
+    recorder = TraceRecorder(
+        os.path.join(stage_dir, "trace.jsonl"), chief_only=False
+    ).install()
+    up = down = None
+    losses: list[float] = []
+    step_seconds: list[float] = []
+    # The stage's own metrics stream: one row per optimizer step, carrying
+    # the pipeline_* stamps plus the flattened registry scalars (handoff/
+    # stall histograms) — run_report's pipeline section and the schema
+    # gates read stage dirs exactly like trainer logdirs.
+    predicted_bubble = fb_schedule(
+        cfg.n_stages, cfg.n_microbatches
+    ).bubble_fraction()
+    metrics_path = os.path.join(stage_dir, "metrics.jsonl")
+    # Each attempt restarts training from scratch (deterministic seeds),
+    # so the metrics stream restarts too — truncate rather than appending
+    # a step-0 regression onto a dead attempt's rows.
+    open(metrics_path, "w").close()
+
+    def write_metrics_row(step: int, extra: dict) -> None:
+        import json
+
+        row = {
+            "step": step,
+            "t": time.time(),
+            "pipeline_schedule": "mpmd",
+            "pipeline_stages": cfg.n_stages,
+            "pipeline_microbatches": cfg.n_microbatches,
+            "pipeline_virtual": 1,
+            "pipeline_bubble": predicted_bubble,
+        }
+        try:
+            row.update(obs.default_registry().scalars())
+        except Exception:
+            pass
+        row.update(extra)
+        with open(metrics_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    try:
+        params, opt_state, fwd, bwd, loss_grad, update = _build_stage_fns(
+            cfg, stage_id
+        )
+        # Rendezvous order: every stage serves its UPSTREAM link first
+        # (stage i accepts from i-1 on link i-1), then dials downstream.
+        # Stage 0 only dials, the last stage only serves — no cycles.
+        if not first:
+            up = _Link(
+                _serve_link(rdir, stage_id - 1, cfg.connect_timeout_s),
+                f"up{stage_id}", cfg.crc,
+            )
+        if not last:
+            down = _Link(
+                _connect_link(rdir, stage_id, cfg.connect_timeout_s),
+                f"down{stage_id}", cfg.crc,
+            )
+        m_total = cfg.n_microbatches
+        for step in range(cfg.n_steps):
+            t_step0 = time.monotonic()
+            grads = None
+            if first:
+                with remote_span("mpmd.step", step=step, stage=stage_id):
+                    sent = done = 0
+                    saved: dict[int, np.ndarray] = {}
+                    while done < m_total:
+                        if sent < m_total and (sent - done) < cfg.window:
+                            ids = _make_ids(cfg, step, sent)
+                            y = fwd(params, jnp.asarray(ids))
+                            saved[sent] = ids
+                            down.send(
+                                {
+                                    "x": np.asarray(y, np.float32),
+                                    "ids": ids,
+                                    "step": np.int32(step),
+                                    "micro": np.int32(sent),
+                                    "t_send": np.float64(time.time()),
+                                },
+                                trace=current_context(),
+                            )
+                            sent += 1
+                            continue
+                        window_blocked = sent < m_total
+                        t0w = time.monotonic()
+                        tens, _tr = down.recv(cfg.recv_timeout_s)
+                        if window_blocked:
+                            _H_STALL.observe(
+                                time.monotonic() - t0w, stage=str(stage_id)
+                            )
+                        m = int(tens["micro"][()])
+                        ids = saved.pop(m)
+                        gp = bwd(
+                            params, jnp.asarray(ids),
+                            jnp.asarray(np.asarray(tens["dx"])),
+                        )
+                        grads = _grads_add(grads, gp)
+                        done += 1
+            elif not last:
+                done = 0
+                saved_x: dict[tuple[int, int], Any] = {}
+                fwded = 0
+
+                def process_cot(tens, tr):
+                    m = int(tens["micro"][()])
+                    x_in = saved_x.pop((int(tens["step"][()]), m))
+                    gp, dx = bwd(
+                        params, x_in,
+                        jnp.asarray(np.asarray(tens["dx"])),
+                    )
+                    up.send(
+                        {
+                            "dx": np.asarray(dx, np.float32),
+                            "step": tens["step"],
+                            "micro": tens["micro"],
+                            "t_send": np.float64(time.time()),
+                        },
+                        trace=tr,
+                    )
+                    return gp
+
+                # Both directions are polled in one loop: blocking on the
+                # upstream act alone would deadlock a >=3-stage pipeline
+                # (the windowed sender upstream is itself waiting for the
+                # cotangents parked in our downstream queue).
+                idle_deadline = time.monotonic() + cfg.recv_timeout_s
+                while done < m_total:
+                    if fwded > done:
+                        got = down.poll(0.0)  # prefer cotangents (1F1B)
+                        if got is not None:
+                            grads = _grads_add(grads, process_cot(*got))
+                            done += 1
+                            idle_deadline = (
+                                time.monotonic() + cfg.recv_timeout_s
+                            )
+                            continue
+                    if fwded < m_total:
+                        got = up.poll(0.002)
+                        if got is not None:
+                            tens, tr = got
+                            _observe_handoff(stage_id, tens, tr, trace_id)
+                            x_in = jnp.asarray(np.asarray(tens["x"]))
+                            y = fwd(params, x_in)
+                            saved_x[(int(tens["step"][()]),
+                                     int(tens["micro"][()]))] = x_in
+                            down.send(
+                                {
+                                    "x": np.asarray(y, np.float32),
+                                    "ids": np.asarray(tens["ids"]),
+                                    "step": tens["step"],
+                                    "micro": tens["micro"],
+                                    "t_send": np.float64(time.time()),
+                                },
+                                trace=tr,
+                            )
+                            fwded += 1
+                            idle_deadline = (
+                                time.monotonic() + cfg.recv_timeout_s
+                            )
+                            continue
+                    elif fwded > done:
+                        got = down.poll(0.002)
+                        if got is not None:
+                            grads = _grads_add(grads, process_cot(*got))
+                            done += 1
+                            idle_deadline = (
+                                time.monotonic() + cfg.recv_timeout_s
+                            )
+                            continue
+                    if time.monotonic() > idle_deadline:
+                        raise WorkerUnavailableError(
+                            f"stage {stage_id}: no frames for "
+                            f"{cfg.recv_timeout_s:.0f}s (dead pipeline?)"
+                        )
+            else:  # last stage: loss + immediate backward per microbatch
+                step_losses = []
+                for _ in range(m_total):
+                    tens, tr = up.recv(cfg.recv_timeout_s)
+                    _observe_handoff(stage_id, tens, tr, trace_id)
+                    x_in = jnp.asarray(np.asarray(tens["x"]))
+                    ids = jnp.asarray(np.asarray(tens["ids"]))
+                    loss, (gp, dx) = loss_grad(params, x_in, ids)
+                    up.send(
+                        {
+                            "dx": np.asarray(dx, np.float32),
+                            "step": tens["step"],
+                            "micro": tens["micro"],
+                            "t_send": np.float64(time.time()),
+                        },
+                        trace=tr,
+                    )
+                    grads = _grads_add(grads, gp)
+                    step_losses.append(float(loss))
+                losses.append(float(np.mean(step_losses)))
+            scale = 1.0 / m_total
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            params, opt_state = update(params, opt_state, grads)
+            step_seconds.append(time.monotonic() - t_step0)
+            extra: dict = {"t_step": step_seconds[-1]}
+            if last:
+                extra["loss"] = losses[-1]
+            write_metrics_row(step, extra)
+        if last:
+            return {"losses": losses, "step_seconds": step_seconds}
+        return None
+    except (ConnectionError, OSError, socket.timeout) as e:
+        raise WorkerUnavailableError(
+            f"stage {stage_id} link failure: {e!r}"
+        ) from e
+    finally:
+        for link in (up, down):
+            if link is not None:
+                link.close()
+        try:
+            obs.default_registry().write_prometheus(
+                os.path.join(stage_dir, "metrics.prom")
+            )
+        except Exception:
+            pass
+        recorder.uninstall()
+        recorder.close()
+
+
+def run_mpmd_pipeline(
+    cfg: MPMDConfig,
+    logdir: str,
+    *,
+    coordinator: Coordinator | None = None,
+    join_timeout_s: float = 600.0,
+) -> dict:
+    """Drive an MPMD pipeline run to completion through the Coordinator.
+
+    Schedules one stage closure per stage onto process-backed workers
+    (pass ``coordinator=`` to share/kill-inject one; otherwise an owned
+    ``Coordinator(num_workers=n_stages, use_processes=True)`` is built
+    and shut down).  Returns ``{"losses": [per-step mean loss...],
+    "trace_id", "stages", "logdir"}`` — losses come from the last stage's
+    closure; a mid-run stage kill re-queues every stage closure and the
+    run completes on the respawned pool.
+    """
+    cfg.validate()
+    os.makedirs(logdir, exist_ok=True)
+    rdir = os.path.join(logdir, "rendezvous")
+    os.makedirs(rdir, exist_ok=True)
+    trace_id = new_trace_id()
+    owns = coordinator is None
+    coord = coordinator or Coordinator(
+        num_workers=cfg.n_stages, use_processes=True
+    )
+    try:
+        rvs = [
+            coord.schedule(
+                _stage_worker, (cfg, i, rdir, logdir, trace_id)
+            )
+            for i in range(cfg.n_stages)
+        ]
+        coord.join(timeout=join_timeout_s)
+        result = rvs[-1].fetch(timeout=30.0)
+    finally:
+        if owns:
+            coord.shutdown()
+    return {
+        "losses": result["losses"],
+        "step_seconds": result["step_seconds"],
+        "trace_id": trace_id,
+        "stages": cfg.n_stages,
+        "logdir": logdir,
+    }

@@ -20,7 +20,9 @@ padded_size // degree)`` so any shape shards evenly (the pad tail carries
 zero gradients, so it is inert under elementwise optimizers).
 
 Implementation note: on jax 0.4.37 the partial-manual ``shard_map`` path
-hits the XLA ``PartitionId`` lowering ceiling (ROADMAP item 3), so the
+hits the XLA ``PartitionId`` lowering ceiling (pinned by
+tests/test_jax_workarounds.py; the pipeline went full-manual for the
+same reason), so the
 collectives here are expressed as GSPMD sharding *constraints* inside the
 jitted step — XLA lowers the constraint on the summed gradient to a
 reduce-scatter and the constraint back to the parameter layout to an

@@ -97,6 +97,18 @@ class TrainerConfig:
     # the backward) into every metric record.
     overlap_buckets: int = 0
     overlap_coverage: float = 0.0
+    # Pipeline parallelism (parallel/pipeline.py): informational — the
+    # schedule is compiled into the workload loss at build time.  stages
+    # > 0 stamps ``pipeline_schedule`` (a string field, like quant_mode),
+    # ``pipeline_stages``/``pipeline_microbatches``/``pipeline_virtual``
+    # and the schedule's predicted ``pipeline_bubble`` into every metric
+    # record, so run_report's pipeline section can attribute step time to
+    # the schedule that produced it.
+    pipeline_schedule: str = "none"
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
+    pipeline_virtual: int = 1
+    pipeline_bubble: float = 0.0
     # Hang watchdog (SURVEY.md §5.2): dump all thread stacks if no step
     # completes for this many seconds.  0 disables.
     watchdog_timeout: float = 0.0
@@ -701,6 +713,22 @@ class Trainer:
                         last_metrics["overlap_coverage"] = float(
                             cfg.overlap_coverage
                         )
+                    if cfg.pipeline_stages:
+                        last_metrics["pipeline_schedule"] = (
+                            cfg.pipeline_schedule
+                        )
+                        last_metrics["pipeline_stages"] = float(
+                            cfg.pipeline_stages
+                        )
+                        last_metrics["pipeline_microbatches"] = float(
+                            cfg.pipeline_microbatches
+                        )
+                        last_metrics["pipeline_virtual"] = float(
+                            cfg.pipeline_virtual
+                        )
+                        last_metrics["pipeline_bubble"] = float(
+                            cfg.pipeline_bubble
+                        )
                     if self.anomaly_detector is not None:
                         self.anomaly_detector.observe(
                             step_i + 1,
@@ -868,6 +896,14 @@ class Trainer:
             out["run"]["quant"] = self.config.quant
         if self.config.overlap_buckets:
             out["run"]["overlap_buckets"] = self.config.overlap_buckets
+        if self.config.pipeline_stages:
+            out["run"]["pipeline"] = {
+                "schedule": self.config.pipeline_schedule,
+                "stages": self.config.pipeline_stages,
+                "microbatches": self.config.pipeline_microbatches,
+                "virtual": self.config.pipeline_virtual,
+                "bubble": round(self.config.pipeline_bubble, 4),
+            }
         core = {
             k: rec[k] for k in (
                 "loss", "accuracy", "steps_per_sec",

@@ -210,6 +210,7 @@ def get_workload(name: str, *, test_size: bool = False,
                  sp_scheme: str = "ring",
                  pp_virtual: int = 1,
                  pp_handoff: str | None = None,
+                 pp_schedule: str = "gpipe",
                  seq_len: int | None = None,
                  remat: bool | str | None = None,
                  attn_impl: str | None = None,
@@ -223,7 +224,11 @@ def get_workload(name: str, *, test_size: bool = False,
     on meshes with a ``seq`` axis: ``"ring"`` (ppermute KV rotation, flash
     chunk kernels) or ``"ulysses"`` (all_to_all head<->sequence reshard).
     ``pp_virtual > 1`` selects the circular (interleaved) pipeline schedule
-    for ``gpt_lm`` on meshes with a ``pipe`` axis.  ``pp_handoff``
+    for ``gpt_lm`` on meshes with a ``pipe`` axis.  ``pp_schedule`` picks
+    the pipeline *training* schedule ("gpipe" | "1f1b" | "interleaved" —
+    the fb schedules interleave forward and backward microbatches,
+    bounding live activations at O(stages) instead of O(n_micro); see
+    parallel.pipeline).  ``pp_handoff``
     ("bfloat16" or None) sets the dtype of the pipeline's inter-stage
     ppermute payload — bf16 halves the wire (ICI) traffic, bit-exactly
     for bf16 models; carries/buffers stay fp32 (see
@@ -453,9 +458,17 @@ def get_workload(name: str, *, test_size: bool = False,
                 )
                 while n_micro > 1 and local_batch % n_micro:
                     n_micro //= 2
+                if pp_schedule == "interleaved":
+                    # interleaved grouping: microbatch count must be a
+                    # multiple of the stage count
+                    while n_micro > shape["pipe"] and (
+                        n_micro % shape["pipe"] or local_batch % n_micro
+                    ):
+                        n_micro -= 1
                 pp = PipelinedGPT(cfg, mesh, n_microbatches=n_micro,
                                   n_virtual=pp_virtual, sp_scheme=sp_scheme,
-                                  handoff_dtype=pp_handoff)
+                                  handoff_dtype=pp_handoff,
+                                  schedule=pp_schedule)
                 return dataclasses.replace(
                     wl,
                     model=pp,

@@ -93,7 +93,8 @@ def test_moe_with_model_axis(devices):
 
 def test_four_axis_mesh_trains_subprocess():
     """data x pipe x seq x model — ALL parallelism axes in ONE train step
-    (ring attention + Megatron TP inside the pipeline's hybrid region).
+    (ring attention + manual Megatron TP inside the pipeline's
+    full-manual region).
 
     Needs 16 virtual devices, so it runs in a subprocess with its own
     device count (the conftest pins this process to 8)."""
@@ -104,7 +105,14 @@ def test_four_axis_mesh_trains_subprocess():
     code = textwrap.dedent("""
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 16)
+        try:
+            jax.config.update("jax_num_cpu_devices", 16)
+        except AttributeError:  # pre-0.4.3x spelling: XLA_FLAGS only
+            import os
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=16"
+            )
         import numpy as np
         from distributedtensorflow_tpu.workloads import get_workload
         from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh

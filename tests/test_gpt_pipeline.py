@@ -490,3 +490,162 @@ def test_pipe_x_model_workload_trains_sharded(devices):
         state, metrics = step(state, make_batch(b=8, s=32, seed=i), rng)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+# --- 1F1B / interleaved training schedules -----------------------------------
+
+
+def _grads_match(ga, gb, atol=3e-5, rtol=3e-5):
+    flat = dict((str(k), v) for k, v in jax.tree_util.tree_leaves_with_path(gb))
+    for k, v in jax.tree_util.tree_leaves_with_path(ga):
+        np.testing.assert_allclose(
+            np.asarray(v, np.float32), np.asarray(flat[str(k)], np.float32),
+            atol=atol, rtol=rtol, err_msg=str(k),
+        )
+
+
+def test_1f1b_gradients_match_gpipe(pipe_mesh):
+    """The hand-scheduled 1F1B forward/backward reproduces the autodiff
+    (GPipe) gradients — including the tied table's embed+head double use
+    and ln_f — on the 8-device mesh."""
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32)
+    batch = {"input_ids": jnp.asarray(make_batch(b=16, seed=3)["input_ids"])}
+    rng = jax.random.PRNGKey(0)
+    pp_g = PipelinedGPT(cfg, pipe_mesh, n_microbatches=4)
+    variables = pp_g.init(jax.random.PRNGKey(1))
+    (lg, _), gg = jax.value_and_grad(pipelined_lm_loss(pp_g), has_aux=True)(
+        variables["params"], {}, batch, rng
+    )
+    pp_f = PipelinedGPT(cfg, pipe_mesh, n_microbatches=4, schedule="1f1b")
+    (lf, _), gf = jax.value_and_grad(pipelined_lm_loss(pp_f), has_aux=True)(
+        variables["params"], {}, batch, rng
+    )
+    np.testing.assert_allclose(float(lf), float(lg), rtol=2e-6)
+    _grads_match(gf, gg)
+
+
+def test_interleaved_gradients_match_gpipe(pipe_mesh):
+    """interleaved-1F1B (n_virtual=2 chunks/rank) matches the circular
+    GPipe gradients on a 4-layer model."""
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32, num_layers=4)
+    batch = {"input_ids": jnp.asarray(make_batch(b=16, seed=5)["input_ids"])}
+    rng = jax.random.PRNGKey(0)
+    pp_g = PipelinedGPT(cfg, pipe_mesh, n_microbatches=4, n_virtual=2)
+    variables = pp_g.init(jax.random.PRNGKey(2))
+    (lg, _), gg = jax.value_and_grad(pipelined_lm_loss(pp_g), has_aux=True)(
+        variables["params"], {}, batch, rng
+    )
+    pp_i = PipelinedGPT(cfg, pipe_mesh, n_microbatches=4, n_virtual=2,
+                        schedule="interleaved")
+    (li, _), gi = jax.value_and_grad(pipelined_lm_loss(pp_i), has_aux=True)(
+        variables["params"], {}, batch, rng
+    )
+    np.testing.assert_allclose(float(li), float(lg), rtol=2e-6)
+    _grads_match(gi, gg)
+
+
+def test_1f1b_x_model_tp_matches_gpipe(devices):
+    """1F1B composes with manual Megatron TP: grads match the gpipe path
+    on data x pipe x model (the fb engine's per-leaf boundary psums and
+    the ct/rep head-seed convention)."""
+    mesh = build_mesh(MeshSpec(data=2, pipe=2, model=2), devices)
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32)
+    batch = {"input_ids": jnp.asarray(make_batch(b=16, seed=7)["input_ids"])}
+    rng = jax.random.PRNGKey(0)
+    pp_g = PipelinedGPT(cfg, mesh, n_microbatches=4)
+    variables = pp_g.init(jax.random.PRNGKey(1))
+    (lg, _), gg = jax.value_and_grad(pipelined_lm_loss(pp_g), has_aux=True)(
+        variables["params"], {}, batch, rng
+    )
+    pp_f = PipelinedGPT(cfg, mesh, n_microbatches=4, schedule="1f1b")
+    (lf, _), gf = jax.value_and_grad(pipelined_lm_loss(pp_f), has_aux=True)(
+        variables["params"], {}, batch, rng
+    )
+    np.testing.assert_allclose(float(lf), float(lg), rtol=2e-6)
+    _grads_match(gf, gg)
+
+
+def test_1f1b_peak_activation_memory_below_gpipe(devices):
+    """THE memory claim: at n_micro = 4x stages the 1F1B schedule's
+    compiled within-step scratch (XLA temp bytes — live activations) is
+    strictly below GPipe's, at identical loss."""
+    mesh = build_mesh(MeshSpec(data=2, pipe=4), devices)
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32, num_layers=4)
+    batch = make_batch(b=32, seed=3)
+    rng = jax.random.PRNGKey(0)
+
+    def temp_bytes(schedule):
+        pp = PipelinedGPT(cfg, mesh, n_microbatches=16, schedule=schedule)
+        state, specs = create_sharded_state(
+            pp.init, optax.sgd(1e-3), mesh, jax.random.PRNGKey(0),
+            rules=pp.layout(),
+        )
+        step = make_train_step(pipelined_lm_loss(pp), mesh, specs)
+        comp = step.lower(state, batch, rng).compile()
+        _, metrics = comp(state, batch, rng)
+        return comp.memory_analysis().temp_size_in_bytes, float(
+            metrics["loss"]
+        )
+
+    t_gpipe, l_gpipe = temp_bytes("gpipe")
+    t_1f1b, l_1f1b = temp_bytes("1f1b")
+    assert t_1f1b < t_gpipe, (t_1f1b, t_gpipe)
+    np.testing.assert_allclose(l_1f1b, l_gpipe, rtol=1e-5)
+
+
+def test_1f1b_composes_with_zero_and_overlap(pipe_mesh):
+    """--zero (chunked optimizer state) and --overlap (bucketed backward
+    gradient sync) stack on the fb custom_vjp loss: the 1f1b trajectory
+    matches the gpipe one under the SAME zero+overlap step."""
+    from distributedtensorflow_tpu.parallel.overlap import OverlapPlan
+    from distributedtensorflow_tpu.parallel.zero import ZeroSharder
+
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32)
+
+    def run(schedule):
+        pp = PipelinedGPT(cfg, pipe_mesh, n_microbatches=4,
+                          schedule=schedule)
+        zero = ZeroSharder(pipe_mesh)
+        from distributedtensorflow_tpu.train.state import split_variables
+
+        state, specs = create_sharded_state(
+            pp.init, optax.adamw(1e-2), pipe_mesh, jax.random.PRNGKey(0),
+            rules=pp.layout(), zero=zero,
+        )
+        shapes, _ = split_variables(
+            jax.eval_shape(pp.init, jax.random.PRNGKey(0))
+        )
+        plan = OverlapPlan.build(pipe_mesh, shapes, specs.params, zero=zero)
+        step = make_train_step(
+            pipelined_lm_loss(pp), pipe_mesh, specs, overlap=plan
+        )
+        rng = jax.random.PRNGKey(0)
+        losses = []
+        for i in range(4):
+            state, m = step(state, make_batch(b=16, seed=i), rng)
+            losses.append(float(m["loss"]))
+        return losses
+
+    l_g = run("gpipe")
+    l_f = run("1f1b")
+    np.testing.assert_allclose(l_f, l_g, rtol=1e-4, atol=1e-5)
+    assert l_f[-1] < l_f[0]
+
+
+def test_fb_schedule_validation():
+    mesh = build_mesh(MeshSpec(data=4, pipe=2), jax.devices()[:8])
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.float32)
+    cfg4 = dataclasses.replace(cfg, num_layers=4)
+    with pytest.raises(ValueError, match="schedule"):
+        PipelinedGPT(cfg, mesh, n_microbatches=4, schedule="bogus")
+    with pytest.raises(ValueError, match="interleaved"):
+        PipelinedGPT(cfg4, mesh, n_microbatches=4, n_virtual=2,
+                     schedule="1f1b")
+    with pytest.raises(ValueError, match="n_virtual"):
+        PipelinedGPT(cfg, mesh, n_microbatches=4, schedule="interleaved")
+    with pytest.raises(ValueError, match="multiple"):
+        PipelinedGPT(cfg4, mesh, n_microbatches=3, n_virtual=2,
+                     schedule="interleaved")
+    seq_mesh = build_mesh(MeshSpec(data=2, pipe=2, seq=2), jax.devices()[:8])
+    with pytest.raises(NotImplementedError, match="seq"):
+        PipelinedGPT(cfg, seq_mesh, n_microbatches=2, schedule="1f1b")

@@ -215,3 +215,47 @@ def test_circular_v1_matches_gpipe(pipe_mesh):
         np.asarray(circular(circ_stack, x)), np.asarray(gpipe(stacked, x)),
         atol=1e-6, rtol=1e-6,
     )
+
+
+# --- 1F1B / interleaved schedule tables (parallel.pipeline.fb_schedule) ------
+
+
+def test_fb_schedule_1f1b_slot_bound():
+    """The 1F1B act ring is O(n_stages): at M = 4x stages the peak saved
+    stage inputs stay strictly below M (the GPipe residual count)."""
+    from distributedtensorflow_tpu.parallel.pipeline import fb_schedule
+
+    s = fb_schedule(4, 16)
+    assert s.n_slots <= 2 * 4 - 1 < 16
+    assert s.ticks == 16 + 2 * (4 - 1)
+    # generator self-validates wires and slot reuse; tables are complete
+    assert s.tables["f_on"].sum() == 16 * 4
+    assert s.tables["b_on"].sum() == 16 * 4
+
+
+def test_fb_schedule_interleaved_slot_bound():
+    from distributedtensorflow_tpu.parallel.pipeline import fb_schedule
+
+    s = fb_schedule(4, 16, 2)
+    assert s.n_virtual == 2
+    assert s.n_slots <= 2 * 2 * 4  # O(stages * virtual), not O(M)
+    assert s.tables["f_on"].sum() == 2 * 16 * 4
+    assert s.bubble_fraction() < fb_schedule(8, 16).bubble_fraction()
+
+
+def test_fb_schedule_validation():
+    import pytest as _pytest
+
+    from distributedtensorflow_tpu.parallel.pipeline import fb_schedule
+
+    with _pytest.raises(ValueError, match="multiple"):
+        fb_schedule(4, 6, 2)  # interleaved needs M % n == 0
+    with _pytest.raises(ValueError, match="n_stages"):
+        fb_schedule(0, 4)
+
+
+def test_fb_bubble_shrinks_with_microbatches():
+    from distributedtensorflow_tpu.parallel.pipeline import fb_schedule
+
+    assert (fb_schedule(4, 32).bubble_fraction()
+            < fb_schedule(4, 8).bubble_fraction())

@@ -587,7 +587,9 @@ def _stub_executor(max_respawns):
     ex._spawn_not_before = None
     ex._lock = threading.Lock()
     ex._spawned = []
-    ex._spawn = lambda: ex._spawned.append(1)
+    # accepts the real _spawn's wait_handshake= kwarg (the respawn path
+    # passes wait_handshake=False so the failure path never blocks)
+    ex._spawn = lambda **kw: ex._spawned.append(1)
 
     class _DeadConn:
         def send(self, m):

@@ -118,6 +118,9 @@ _FLAT_DIRECTION_RE = re.compile(r"\.direction_([A-Za-z0-9_]+?)(?=\.|$)")
 _FLAT_STATE_RE = re.compile(r"\.state_([A-Za-z0-9_]+?)(?=\.|$)")
 #: jsonl-flattened ``window`` label of the ``slo_burn_rate`` gauge.
 _FLAT_WINDOW_RE = re.compile(r"\.window_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``stage`` label of the pipeline handoff/stall
+#: histograms (parallel/pipeline_mpmd.py).
+_FLAT_STAGE_RE = re.compile(r"\.stage_([A-Za-z0-9_]+?)(?=\.|$)")
 
 #: One Prometheus exposition sample: name, optional {labels}, value.
 _PROM_SAMPLE_RE = re.compile(
@@ -228,6 +231,11 @@ SLO_RULE_KINDS = (
     "histogram_under", "gauge_good_fraction", "gauge_bad_fraction",
 )
 
+#: Values of the string-typed ``pipeline_schedule`` metric-row field
+#: (parallel/pipeline.py SCHEDULES + the MPMD stage-per-process variant
+#: — duplicated for the same stdlib-only reason).
+PIPELINE_SCHEDULES = ("gpipe", "1f1b", "interleaved", "mpmd")
+
 
 def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
     """Returns (errors, warnings) for one parsed row."""
@@ -310,6 +318,39 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                     f"{QUANT_MODES}"
                 )
             continue
+        if k == "pipeline_schedule":
+            # the pipeline-schedule stamp (TrainerConfig.pipeline_schedule
+            # / MPMD stage rows) — string-typed like quant_mode
+            if v not in PIPELINE_SCHEDULES:
+                errors.append(
+                    f"line {lineno}: 'pipeline_schedule' {v!r} not in "
+                    f"{PIPELINE_SCHEDULES}"
+                )
+            continue
+        if k in ("pipeline_stages", "pipeline_microbatches",
+                 "pipeline_virtual"):
+            if not _nonneg_int(v):
+                errors.append(
+                    f"line {lineno}: {k!r} {v!r} is not a non-negative "
+                    "integer"
+                )
+            continue
+        if k == "pipeline_bubble":
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not math.isfinite(v) or not 0.0 <= v < 1.0:
+                errors.append(
+                    f"line {lineno}: 'pipeline_bubble' {v!r} is not in "
+                    "[0, 1)"
+                )
+            continue
+        if k.startswith(("pipeline_handoff_seconds",
+                         "pipeline_mpmd_stall_seconds")):
+            m = _FLAT_STAGE_RE.search(k)
+            if m and not m.group(1).isdigit():
+                errors.append(
+                    f"line {lineno}: field {k!r} carries non-numeric "
+                    f"pipeline stage label {m.group(1)!r}"
+                )
         if v in ("NaN", "Infinity", "-Infinity"):
             warnings.append(f"line {lineno}: field {k!r} is non-finite ({v})")
         elif isinstance(v, bool) or not isinstance(v, (int, float)):
@@ -783,6 +824,21 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                     errors.append(
                         f"line {i}: {name} carries unknown fleet peer "
                         f"state {state!r} (known: {FLEET_PEER_STATES})"
+                    )
+            if name.startswith(
+                ("pipeline_handoff_seconds", "pipeline_mpmd_stall_seconds")
+            ):
+                labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
+                stage = labels.get("stage")
+                if stage is None:
+                    errors.append(
+                        f"line {i}: {name} sample is missing the 'stage' "
+                        "label"
+                    )
+                elif not stage.isdigit():
+                    errors.append(
+                        f"line {i}: {name} carries non-numeric stage "
+                        f"label {stage!r}"
                     )
             if name == "slo_burn_rate":
                 labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))

@@ -490,6 +490,59 @@ def sharding_summary(train: list[dict]) -> dict:
     return out
 
 
+_STALL_FIELD_RE = re.compile(
+    r"^pipeline_mpmd_stall_seconds_(count|sum)\.stage_(\d+)$"
+)
+
+
+def pipeline_summary(train: list[dict], trace: list[dict]) -> dict:
+    """Pipeline-parallelism digest: the schedule stamps from the last
+    record carrying them (trainer SPMD runs and MPMD stage dirs both
+    write the ``pipeline_*`` fields), the stage-handoff span latencies
+    from the trace stream (MPMD ``pipeline.handoff`` rows), and the
+    credit-window stall accounting from the flattened stall-histogram
+    fields.  Empty when the run is unpipelined."""
+    last = {}
+    for r in train:
+        if r.get("pipeline_schedule"):
+            last = r
+    out: dict = {}
+    if last:
+        out["schedule"] = last.get("pipeline_schedule")
+        for k in ("pipeline_stages", "pipeline_microbatches",
+                  "pipeline_virtual"):
+            if isinstance(last.get(k), (int, float)):
+                out[k.replace("pipeline_", "")] = int(last[k])
+        if isinstance(last.get("pipeline_bubble"), (int, float)):
+            out["predicted_bubble"] = float(last["pipeline_bubble"])
+    durs = sorted(
+        float(r.get("dur_s", 0.0)) for r in trace
+        if isinstance(r, dict) and r.get("kind") == "span"
+        and r.get("name") == "pipeline.handoff"
+    )
+    if durs:
+        out["handoff"] = {
+            "count": len(durs),
+            "p50_s": _percentile(durs, 0.50),
+            "p99_s": _percentile(durs, 0.99),
+        }
+    stalls: dict[str, dict[str, float]] = {}
+    for r in train:
+        for k, v in r.items():
+            m = _STALL_FIELD_RE.match(k)
+            if m and isinstance(v, (int, float)):
+                stalls.setdefault(m.group(2), {})[m.group(1)] = float(v)
+    if stalls:
+        out["link_stalls"] = {
+            f"stage{sid}": {
+                "count": int(d.get("count", 0)),
+                "total_s": d.get("sum", 0.0),
+            }
+            for sid, d in sorted(stalls.items())
+        }
+    return out
+
+
 def straggler_fields(train: list[dict]) -> dict[str, dict[str, float]]:
     """Last-row host-spread fields, grouped by base key."""
     out: dict[str, dict[str, float]] = {}
@@ -649,6 +702,7 @@ def build_report(logdir: str) -> dict:
         ],
         "anomalies": collect_anomalies(trace, train),
         "sharding": sharding_summary(train),
+        "pipeline": pipeline_summary(train, trace),
         "input_plane": input_plane_summary(train, flight),
         "step_time_opt": step_time_opt_summary(train, logdir),
         "stragglers": straggler_fields(train),
@@ -971,6 +1025,29 @@ def render(report: dict) -> str:
                 lines.append(
                     f"  {label:<16} {sh[key] / (1 << 20):10.2f} MiB/device"
                 )
+    pp = report.get("pipeline")
+    if pp:
+        lines += ["", "pipeline:"]
+        if "schedule" in pp:
+            lines.append(
+                f"  schedule {pp['schedule']}  stages "
+                f"{pp.get('stages', '?')}  microbatches "
+                f"{pp.get('microbatches', '?')}  virtual "
+                f"{pp.get('virtual', 1)}  predicted bubble "
+                f"{pp.get('predicted_bubble', 0.0):.1%}"
+            )
+        if "handoff" in pp:
+            h = pp["handoff"]
+            lines.append(
+                f"  stage handoffs: {h['count']}  "
+                f"p50 {h['p50_s'] * 1e3:.3g}ms  "
+                f"p99 {h['p99_s'] * 1e3:.3g}ms"
+            )
+        for stage, d in (pp.get("link_stalls") or {}).items():
+            lines.append(
+                f"  link stalls {stage}: {d['count']} "
+                f"({d['total_s']:.3g}s blocked on the credit window)"
+            )
     if report["stragglers"]:
         lines += ["", "straggler summary (last record):"]
         for base, d in report["stragglers"].items():

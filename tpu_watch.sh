@@ -161,6 +161,31 @@ while true; do
     # dies mid-Pallas must not cost the records evidence.
     run resnet_records 1200 env BENCH_INPUT=records python bench.py \
       || { probe || break; }
+    # Pipeline-schedule bubble measurement on real chips (PR 12: the CPU
+    # bench is a ratio-only proxy — the 8 virtual devices timeshare one
+    # core, so bubbles cost ~nothing there).  gpipe vs 1f1b at the same
+    # mesh/model; --attn-impl xla keeps the item Pallas-free so it rides
+    # p2.  run_report's "pipeline" section + metrics.jsonl pipeline_*
+    # stamps are the artifact.
+    if [ ! -f "$STAMPS/pipe_sched" ]; then
+      if timeout 1200 env BENCH_SKIP_PROBE=1 bash -c '
+            python train.py --workload gpt_lm --mesh data=2,pipe=4 \
+              --steps 60 --log-every 10 --attn-impl xla \
+              --pipeline-schedule gpipe \
+              --logdir ARTIFACTS/pipe_gpipe_tpu &&
+            python train.py --workload gpt_lm --mesh data=2,pipe=4 \
+              --steps 60 --log-every 10 --attn-impl xla \
+              --pipeline-schedule 1f1b \
+              --logdir ARTIFACTS/pipe_1f1b_tpu &&
+            python tools/run_report.py ARTIFACTS/pipe_gpipe_tpu &&
+            python tools/run_report.py ARTIFACTS/pipe_1f1b_tpu
+          ' >> "$LOG" 2>&1; then
+        touch "$STAMPS/pipe_sched"; log "item pipe_sched: LANDED"
+      else
+        log "item pipe_sched: failed"; probe || break
+      fi
+      tail_streams ARTIFACTS/pipe_1f1b_tpu
+    fi
     # -- p3: Pallas rows (the default stack), canary-gated ---------------
     pallas_missing=0
     for s in "${PALLAS_STAMPS[@]}"; do

@@ -29,6 +29,14 @@ REMAT_FLAG = {"on": True, "off": False, "attn": "attn", None: None}
 _PP_HANDOFF = {"fp32": None, "bf16": "bfloat16"}
 
 
+def _is_pipelined(wl) -> bool:
+    """True when the mesh-bound workload runs the pipeline-parallel model
+    (the record-stamping hook for the pipeline_* metric fields)."""
+    from distributedtensorflow_tpu.models.gpt_pipeline import PipelinedGPT
+
+    return isinstance(wl.model, PipelinedGPT)
+
+
 def parse_mesh(s: str | None):
     from distributedtensorflow_tpu.parallel import MeshSpec
 
@@ -228,6 +236,7 @@ def run_evaluator(args) -> None:
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual, seq_len=args.seq_len,
         pp_handoff=_PP_HANDOFF[args.pp_handoff_dtype],
+        pp_schedule=args.pipeline_schedule,
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
         kv_heads=args.kv_heads,
@@ -765,13 +774,23 @@ def main() -> None:
     p.add_argument("--pp-virtual", type=int, default=1,
                    help="virtual pipeline chunks per rank (>1 = circular/"
                         "interleaved schedule, smaller bubble)")
+    p.add_argument("--pipeline-schedule",
+                   choices=("gpipe", "1f1b", "interleaved"),
+                   default="gpipe",
+                   help="pipeline training schedule on meshes with a pipe "
+                        "axis: gpipe (all forwards, then autodiff — "
+                        "O(n_micro) live microbatch activations), 1f1b "
+                        "(forward/backward interleaved — O(stages) live "
+                        "stage inputs), or interleaved (interleaved-1F1B "
+                        "over --pp-virtual>=2 chunks per rank — smaller "
+                        "bubble, O(stages*virtual) live stage inputs)")
     p.add_argument("--pp-handoff-dtype", choices=("fp32", "bf16"),
                    default="fp32",
                    help="dtype of the inter-stage ppermute PAYLOAD: bf16 "
                         "halves the pipeline's wire (ICI) traffic and is "
                         "bit-exact for bf16 models (requires one); scan "
-                        "carries and schedule buffers stay fp32 — a jax "
-                        "0.9 partial-manual partitioner limitation")
+                        "carries and schedule buffers stay fp32 (fp32 "
+                        "cross-stage residual accumulation)")
     p.add_argument("--job", choices=("auto", "train", "evaluator",
                                      "async-ps"),
                    default="auto",
@@ -946,6 +965,7 @@ def main() -> None:
         global_batch_size=args.batch_size, sp_scheme=args.sp_scheme,
         pp_virtual=args.pp_virtual,
         pp_handoff=_PP_HANDOFF[args.pp_handoff_dtype],
+        pp_schedule=args.pipeline_schedule,
         seq_len=args.seq_len,
         remat=REMAT_FLAG[args.remat],
         attn_impl=args.attn_impl,
@@ -1289,6 +1309,16 @@ def main() -> None:
             input_prebundled=args.steps_per_call > 1,
             zero_stage=1 if zero_sharder is not None else 0,
             quant=args.quant,
+            **(
+                dict(
+                    pipeline_schedule=wl.model.schedule,
+                    pipeline_stages=wl.model.n_stages,
+                    pipeline_microbatches=wl.model.n_microbatches,
+                    pipeline_virtual=wl.model.n_virtual,
+                    pipeline_bubble=wl.model.bubble_fraction(),
+                )
+                if _is_pipelined(wl) else {}
+            ),
             overlap_buckets=(
                 len(overlap_plan.buckets) if overlap_plan is not None else 0
             ),
