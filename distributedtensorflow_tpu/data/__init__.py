@@ -10,6 +10,7 @@ from .recordio_dataset import (  # noqa: F401
 )
 from .service import (  # noqa: F401
     DataServiceClient,
+    DispatcherJournal,
     DispatchServer,
     WorkerServer,
 )

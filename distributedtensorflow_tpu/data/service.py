@@ -47,11 +47,33 @@ streaming client) uses the header+raw-bytes tensor format of
 :mod:`data.wire` (optional CRC32C via the native layer), ``wire="npz"``
 the legacy ``np.savez`` archive.  :func:`decode_batch` sniffs both.
 
+**Resilient transport** (ISSUE 13): every control-plane RPC routes
+through :mod:`..net.rpc` — per-call deadlines propagated in the wire
+header, bounded retries with backoff+jitter, per-endpoint circuit
+breakers — and the streaming client treats a delayed or severed stream
+as a TRANSPORT fault first: it reconnects to the SAME worker (bounded
+retries, resuming via a per-stream ``sid`` token + its absolute
+delivered count) and only reports the worker dead to the dispatcher once
+reconnection fails.  The worker honors resume by comparing the incoming
+stream's ``skip`` against its slot position: a matching position adopts
+the new stream in place, a short one rebuilds the deterministic iterator
+from the requested skip — exactly-once either way.
+
+**Durable dispatcher** (:class:`DispatcherJournal`): with
+``journal_path``, every state mutation — worker registration, epoch
+start, reshard, client progress report — is appended to
+``dispatcher.journal`` (one JSON line, fsync'd) and replayed on
+construction, so a dispatcher restart mid-epoch preserves epoch
+generations, split assignments and per-client received counts instead of
+orphaning every fetcher.
+
 Telemetry (obs registry, no-op when obs/jax is unavailable on a plain
 CPU worker host): ``data_service_fetch_seconds{worker=}`` per-worker
 fetch histogram, ``data_service_client_wait_seconds`` consumer blocking,
 ``data_service_workers_dropped_total`` / ``data_service_resharded_splits_
-total`` counters, and a ``data_reshard`` flight event per re-assignment.
+total`` counters, a ``data_reshard`` flight event per re-assignment,
+``data_service_stream_resumes_total`` same-worker stream reconnections,
+plus the ``rpc_*`` / ``breaker_*`` families from :mod:`..net`.
 """
 
 from __future__ import annotations
@@ -59,15 +81,19 @@ from __future__ import annotations
 import io
 import json
 import logging
+import os
 import queue
+import random
 import socket
 import socketserver
 import threading
 import time
+import uuid
 from collections.abc import Callable, Iterator
 
 import numpy as np
 
+from ..net import rpc as netrpc
 from . import wire as wirelib
 from .adaptive import AdaptiveDepthController
 
@@ -105,53 +131,27 @@ from .adaptive import (  # noqa: F401  (shared degradation shims)
 )
 
 
-# --- framing ----------------------------------------------------------------
+# --- framing (shared substrate: net/rpc.py owns the wire now) ----------------
 
-
-def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(len(payload).to_bytes(8, "little") + payload)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed mid-frame")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _recv_frame(sock: socket.socket) -> bytes:
-    (n,) = (int.from_bytes(_recv_exact(sock, 8), "little"),)
-    if n > (1 << 31):
-        raise ConnectionError(f"oversized frame ({n} bytes)")
-    return _recv_exact(sock, n)
-
-
-def _send_msg(sock: socket.socket, header: dict, data: bytes | None = None) -> None:
-    header = dict(header, has_data=data is not None)
-    _send_frame(sock, json.dumps(header).encode())
-    if data is not None:
-        _send_frame(sock, data)
-
-
-def _recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:
-    header = json.loads(_recv_frame(sock))
-    data = _recv_frame(sock) if header.get("has_data") else None
-    return header, data
+_send_frame = netrpc.send_frame
+_recv_exact = netrpc.recv_exact
+_recv_frame = netrpc.recv_frame
+_send_msg = netrpc.send_msg
+_recv_msg = netrpc.recv_msg
 
 
 def _rpc(addr: str, request: dict, *, timeout: float = 30.0,
-         trace: dict | None = None) -> tuple[dict, bytes | None]:
-    if trace:
-        # Distributed tracing: the context rides the request frame so the
-        # server's span parents under the caller's (obs.tracing schema).
-        request = dict(request, trace=trace)
-    host, port = addr.rsplit(":", 1)
-    with socket.create_connection((host, int(port)), timeout=timeout) as s:
-        _send_msg(s, request)
-        return _recv_msg(s)
+         trace: dict | None = None, endpoint: str | None = None,
+         policy: netrpc.RetryPolicy | None = None) -> tuple[dict, bytes | None]:
+    """One resilient unary RPC (delegates to :func:`net.rpc.call`):
+    ``timeout`` is the TOTAL deadline including retries; the remaining
+    budget rides the wire header as ``deadline_s``."""
+    if policy is None:
+        policy = netrpc.RetryPolicy(deadline_s=timeout)
+    return netrpc.call(
+        addr, request, endpoint=endpoint or f"data_worker:{addr}",
+        policy=policy, deadline_s=timeout, trace=trace,
+    )
 
 
 def _request_trace(req: dict) -> dict | None:
@@ -186,18 +186,147 @@ def decode_batch(data: bytes) -> Batch:
         return {k: z[k] for k in z.files}
 
 
+# --- dispatcher journal ------------------------------------------------------
+
+
+#: Journal record kinds, in the only orders replay accepts (the schema
+#: checker mirrors this tuple stdlib-side): ``open``/``replay`` are
+#: lifecycle markers; ``epoch_start`` must precede any ``reshard`` /
+#: ``client_progress`` for its epoch; reshard generations are strictly
+#: increasing per epoch.
+JOURNAL_KINDS = (
+    "open", "replay", "worker_register", "worker_deregister",
+    "epoch_start", "reshard", "client_progress",
+)
+
+
+class DispatcherJournal:
+    """Append-only durability log for the dispatcher's control-plane
+    state (``<logdir>/dispatcher.journal``).
+
+    One JSON object per line, each carrying a strictly-increasing ``seq``
+    and a wall ``t``.  Appends are a single ``write`` + flush + fsync —
+    a crash can tear at most the final line, and :meth:`replay`
+    tolerates exactly that (a torn last line is dropped; a torn line
+    anywhere else is corruption and raises).
+
+    The journal is one continuous file across dispatcher restarts: a
+    restarting dispatcher replays it, appends a ``replay`` marker, and
+    keeps appending — so the file itself is the audit trail
+    ``tools/check_metrics_schema.py`` validates (monotonic seq, known
+    kinds, per-epoch generation ordering) and ``tools/run_report.py``
+    summarizes.
+    """
+
+    def __init__(self, path: str, *, next_seq: int | None = None):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._truncate_torn_tail(path)
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+        # The dispatcher's replay already parsed the file and hands the
+        # continuation seq in; a standalone journal parses once itself.
+        self._seq = self._last_seq() + 1 if next_seq is None else next_seq
+
+    @staticmethod
+    def _truncate_torn_tail(path: str) -> None:
+        """Drop a torn (newline-less) final fragment BEFORE appending:
+        the first post-crash append would otherwise concatenate onto the
+        fragment and turn the one legal tail tear into mid-file
+        corruption that poisons every future replay."""
+        try:
+            with open(path, "rb+") as f:
+                data = f.read()
+                if not data or data.endswith(b"\n"):
+                    return
+                cut = data.rfind(b"\n") + 1  # 0 when no newline at all
+                f.truncate(cut)
+                logger.warning(
+                    "dispatcher journal %s: truncated %d torn tail "
+                    "byte(s) before reopening", path, len(data) - cut,
+                )
+        except FileNotFoundError:
+            return
+        except OSError:  # pragma: no cover - leave the tail to replay()
+            logger.exception("journal tail check failed for %s", path)
+
+    def _last_seq(self) -> int:
+        try:
+            records, _torn = self.replay(self.path)
+        except (OSError, ValueError):
+            return -1
+        return records[-1]["seq"] if records else -1
+
+    def append(self, kind: str, **fields) -> None:
+        if kind not in JOURNAL_KINDS:
+            raise ValueError(f"unknown journal record kind {kind!r}")
+        with self._lock:
+            row = {"seq": self._seq, "t": time.time(), "kind": kind,
+                   **fields}
+            self._seq += 1
+            self._f.write(json.dumps(row) + "\n")
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:  # pragma: no cover
+                pass
+
+    @staticmethod
+    def replay(path: str) -> tuple[list[dict], bool]:
+        """Parse ``path`` into ``(records, torn_tail)``: all well-formed
+        records in order, plus whether a torn final line was dropped.
+        Raises ``ValueError`` on corruption anywhere but the tail."""
+        records: list[dict] = []
+        torn = False
+        with open(path) as f:
+            lines = f.read().split("\n")
+        # split() leaves one trailing "" for a well-terminated file.
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    torn = True  # torn tail: the one legal partial write
+                    break
+                raise ValueError(
+                    f"{path}: corrupt journal line {i + 1}"
+                ) from None
+            if not isinstance(row, dict) or not isinstance(
+                row.get("seq"), int
+            ):
+                raise ValueError(f"{path}: malformed record at line {i + 1}")
+            records.append(row)
+        return records, torn
+
+
 # --- dispatcher -------------------------------------------------------------
 
 
 class DispatchServer:
     """Tracks the data-worker pool; owns shard assignment per epoch.
 
-    The reference's ``DispatchServer`` (`server_lib.py:131`).  State is
-    in-memory: workers re-register after a dispatcher restart (the
-    fault-tolerance mode the reference calls non-fault-tolerant dispatch);
-    epoch assignment state does NOT survive a dispatcher restart, so
-    elastic re-sharding degrades to the configured client fault policy
-    then.
+    The reference's ``DispatchServer`` (`server_lib.py:131`).  Without a
+    journal, state is in-memory: workers re-register after a dispatcher
+    restart (the fault-tolerance mode the reference calls
+    non-fault-tolerant dispatch) and epoch assignment state is lost.
+    With ``journal_path``, every mutation is appended to a
+    :class:`DispatcherJournal` and REPLAYED on construction: a restarted
+    dispatcher comes back knowing its workers' shard assignments (so
+    re-registration returns the same shard and no worker retires its
+    epochs), every epoch's generation + split map, and the per-client
+    received counts — elastic re-sharding and exactly-once accounting
+    survive the restart.
 
     Binds loopback by default (the StatusServer hardening pattern): pass
     ``host="0.0.0.0"`` only on a trusted cluster network.
@@ -209,13 +338,30 @@ class DispatchServer:
         host: str = "127.0.0.1",
         *,
         worker_timeout_s: float = DEFAULT_WORKER_TIMEOUT_S,
+        journal_path: str | None = None,
     ):
         self._lock = threading.Lock()
         self._worker_timeout_s = float(worker_timeout_s)
         # addr -> {"shard": int, "last_seen": float}
         self._workers: dict[str, dict] = {}
-        # epoch -> {"num_shards", "gen", "splits": {int: {"addr", "skip"}}}
+        # epoch -> {"num_shards", "gen",
+        #           "splits": {int: {"addr", "skip"}},
+        #           "received": {int: count}}   (client progress reports)
         self._epochs: dict[str, dict] = {}
+        self._journal: DispatcherJournal | None = None
+        if journal_path:
+            replayed, last_seq = self._replay_journal(journal_path)
+            self._journal = DispatcherJournal(journal_path,
+                                              next_seq=last_seq + 1)
+            if replayed:
+                self._journal.append(
+                    "replay",
+                    restored_workers=len(self._workers),
+                    restored_epochs=len(self._epochs),
+                    replayed_records=replayed,
+                )
+            else:
+                self._journal.append("open")
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -239,9 +385,13 @@ class DispatchServer:
                 except (ConnectionError, json.JSONDecodeError, OSError):
                     pass
 
-        self._server = socketserver.ThreadingTCPServer(
-            (host, port), Handler, bind_and_activate=True
-        )
+        class _Server(socketserver.ThreadingTCPServer):
+            # A journal-replaying dispatcher restarts on its OLD port
+            # (clients hold the address); without reuse the bind races
+            # TIME_WAIT remnants of its predecessor's connections.
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), Handler, bind_and_activate=True)
         self._server.daemon_threads = True
         self.host = host
         self.port = self._server.server_address[1]
@@ -250,6 +400,78 @@ class DispatchServer:
         )
         self._thread.start()
         logger.info("data-service dispatcher on %s:%d", host, self.port)
+
+    def _replay_journal(self, path: str) -> tuple[int, int]:
+        """Restore workers + epochs from an existing journal; returns
+        ``(records_replayed, last_seq)`` (``(0, -1)`` when the file is
+        absent/empty/unusable) so the journal continues the seq chain
+        without re-parsing the file.  Replayed workers get
+        ``last_seen = now``: a genuinely dead one is re-evicted after the
+        normal timeout, a live one's next heartbeat simply confirms its
+        (unchanged) shard."""
+        if not os.path.exists(path):
+            return 0, -1
+        try:
+            records, torn = DispatcherJournal.replay(path)
+        except (OSError, ValueError) as e:
+            logger.error("dispatcher journal %s unusable (%s); starting "
+                         "with empty state", path, e)
+            return 0, -1
+        if torn:
+            logger.warning("dispatcher journal %s had a torn final line "
+                           "(dropped)", path)
+        now = time.monotonic()
+        for row in records:
+            kind = row.get("kind")
+            if kind == "worker_register":
+                self._workers[row["addr"]] = {
+                    "shard": int(row["shard"]), "last_seen": now,
+                }
+            elif kind == "worker_deregister":
+                self._workers.pop(row.get("addr"), None)
+            elif kind == "epoch_start":
+                self._epochs[str(row["epoch"])] = {
+                    "num_shards": int(row["num_shards"]),
+                    "gen": int(row["gen"]),
+                    "splits": {
+                        int(s): {"addr": v["addr"], "skip": int(v["skip"])}
+                        for s, v in row["splits"].items()
+                    },
+                    "received": {},
+                }
+                while len(self._epochs) > _MAX_TRACKED_EPOCHS:
+                    self._epochs.pop(next(iter(self._epochs)))
+            elif kind == "reshard":
+                self._workers.pop(row.get("dead_worker"), None)
+                ep = self._epochs.get(str(row["epoch"]))
+                if ep is not None:
+                    ep["gen"] = int(row["gen"])
+                    ep["splits"] = {
+                        int(s): {"addr": v["addr"], "skip": int(v["skip"])}
+                        for s, v in row["splits"].items()
+                    }
+            elif kind == "client_progress":
+                ep = self._epochs.get(str(row["epoch"]))
+                if ep is not None:
+                    rec = ep.setdefault("received", {})
+                    for s, n in (row.get("received") or {}).items():
+                        rec[int(s)] = max(rec.get(int(s), 0), int(n))
+        if records:
+            logger.warning(
+                "dispatcher journal %s replayed: %d record(s) -> "
+                "%d worker(s), %d epoch(s)", path, len(records),
+                len(self._workers), len(self._epochs),
+            )
+        return len(records), (records[-1]["seq"] if records else -1)
+
+    def _journal_append(self, kind: str, **fields) -> None:
+        if self._journal is not None:
+            try:
+                self._journal.append(kind, **fields)
+            except OSError:
+                # Durability is best-effort: a full disk must not take
+                # the live control plane down with it.
+                logger.exception("dispatcher journal append failed")
 
     def _evict_stale(self, now: float) -> None:
         stale = [
@@ -261,6 +483,8 @@ class DispatchServer:
             logger.warning("data worker %s timed out; freeing shard %d",
                            a, self._workers[a]["shard"])
             del self._workers[a]
+            self._journal_append("worker_deregister", addr=a,
+                                 reason="timeout")
 
     @staticmethod
     def _epoch_view(ep: dict) -> dict:
@@ -286,11 +510,15 @@ class DispatchServer:
                     used = {w["shard"] for w in self._workers.values()}
                     shard = next(i for i in range(len(used) + 1) if i not in used)
                     self._workers[addr] = {"shard": shard, "last_seen": now}
+                    self._journal_append("worker_register", addr=addr,
+                                         shard=shard)
                 else:
                     self._workers[addr]["last_seen"] = now
                 return {"ok": True, "shard": self._workers[addr]["shard"]}
             if kind == "deregister_worker":
-                self._workers.pop(req["addr"], None)
+                if self._workers.pop(req["addr"], None) is not None:
+                    self._journal_append("worker_deregister",
+                                         addr=req["addr"], reason="planned")
                 return {"ok": True}
             if kind == "heartbeat":
                 w = self._workers.get(req["addr"])
@@ -321,16 +549,46 @@ class DispatchServer:
                             i: {"addr": a, "skip": 0}
                             for i, a in enumerate(ordered)
                         },
+                        "received": {},
                     }
                     self._epochs[epoch] = ep
                     while len(self._epochs) > _MAX_TRACKED_EPOCHS:
                         self._epochs.pop(next(iter(self._epochs)))
+                    self._journal_append(
+                        "epoch_start", epoch=epoch,
+                        num_shards=ep["num_shards"], gen=0,
+                        splits={str(s): dict(v)
+                                for s, v in ep["splits"].items()},
+                    )
                 return {"ok": True, **self._epoch_view(ep)}
             if kind == "get_assignments":
                 ep = self._epochs.get(str(req.get("epoch", 0)))
                 if ep is None:
                     return {"ok": False, "error": "unknown epoch"}
                 return {"ok": True, **self._epoch_view(ep)}
+            if kind == "report_progress":
+                # Exactly-once bookkeeping for a dispatcher restart: the
+                # streaming client periodically reports its cumulative
+                # fully-received counts; they are journaled and become the
+                # reshard skip fallback when a later failure report cannot
+                # supply a count itself.
+                ep = self._epochs.get(str(req.get("epoch", 0)))
+                if ep is None:
+                    return {"ok": False, "error": "unknown epoch"}
+                rec = ep.setdefault("received", {})
+                changed = False
+                for s, n in (req.get("received") or {}).items():
+                    n = int(n)
+                    if n > rec.get(int(s), -1):
+                        rec[int(s)] = n
+                        changed = True
+                if changed:
+                    self._journal_append(
+                        "client_progress", epoch=str(req.get("epoch", 0)),
+                        client=str(req.get("client", "")),
+                        received={str(s): n for s, n in rec.items()},
+                    )
+                return {"ok": True}
             if kind == "report_worker_failure":
                 return self._reshard_locked(req)
             return {"ok": False, "error": f"unknown rpc {kind!r}"}
@@ -377,14 +635,26 @@ class DispatchServer:
                     ),
                 }
             ep["gen"] += 1
+            progress = ep.get("received") or {}
             for i, split in enumerate(orphans):
                 # The client's cumulative delivered count is authoritative;
-                # a split it never pulled from keeps its prior skip.
-                skip = received.get(str(split), ep["splits"][split]["skip"])
+                # without one (a whole-worker report, or a client that
+                # itself restarted), the journaled progress report is the
+                # next-best truth; a split never pulled from keeps its
+                # prior skip.
+                skip = received.get(
+                    str(split),
+                    progress.get(split, ep["splits"][split]["skip"]),
+                )
                 ep["splits"][split] = {
                     "addr": survivors[i % len(survivors)],
                     "skip": int(skip),
                 }
+            self._journal_append(
+                "reshard", epoch=epoch, gen=ep["gen"],
+                dead_worker=addr,
+                splits={str(s): dict(v) for s, v in ep["splits"].items()},
+            )
             logger.warning(
                 "data worker %s reported dead; splits %s resharded to "
                 "%d survivor(s) (epoch %s gen %d)",
@@ -399,6 +669,16 @@ class DispatchServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self._journal is not None:
+            self._journal.close()
+
+    def kill(self) -> None:
+        """Simulated crash (chaos ``dispatcher_kill``): the sockets die,
+        the journal file handle is abandoned WITHOUT a clean close —
+        durability must come from the per-record fsync, not a shutdown
+        hook."""
+        self._server.shutdown()
+        self._server.server_close()
 
 
 # --- worker -----------------------------------------------------------------
@@ -406,15 +686,31 @@ class DispatchServer:
 
 class _IterSlot:
     """One (epoch, gen, split) iterator: built lazily (skip draining runs
-    under the per-slot lock, not the worker-global one)."""
+    under the per-slot lock, not the worker-global one).
 
-    __slots__ = ("factory", "lock", "num_shards", "it")
+    ``sid`` is the OWNING stream's resume token, ``rid`` its monotonic
+    per-split attempt number, and ``pos`` the absolute batch index the
+    next ``next()`` will serve (initial skip + batches served) —
+    together they implement reconnect-with-resume: a new stream (higher
+    ``rid``) whose ``skip`` matches ``pos`` adopts the slot in place, a
+    mismatch (batches died on the severed wire) rebuilds the
+    deterministic iterator from the client's own delivered count, and a
+    STALE stream's leftover pipelined frames (lower ``rid``, buffered on
+    the dead connection) are refused instead of stealing the slot back
+    and rewinding the iterator into duplicates."""
 
-    def __init__(self, factory, num_shards: int):
+    __slots__ = ("factory", "lock", "num_shards", "it", "sid", "rid",
+                 "pos")
+
+    def __init__(self, factory, num_shards: int, *,
+                 sid: str | None = None, rid: int = 0, pos: int = 0):
         self.factory = factory
         self.lock = threading.Lock()
         self.num_shards = num_shards
         self.it = None
+        self.sid = sid
+        self.rid = int(rid)
+        self.pos = int(pos)
 
     def ensure(self) -> Iterator[Batch]:
         if self.it is None:
@@ -528,7 +824,8 @@ class WorkerServer:
         self.addr = f"{advertise_host}:{self.port}"
         self._pool_size_hint = pool_size_hint
 
-        resp = _rpc(dispatcher, {"kind": "register_worker", "addr": self.addr})
+        resp = _rpc(dispatcher, {"kind": "register_worker", "addr": self.addr},
+                    endpoint=f"dispatcher:{dispatcher}")
         if not resp[0].get("ok"):
             raise ConnectionError(f"worker registration failed: {resp[0]}")
         self.shard_index = int(resp[0]["shard"])
@@ -602,18 +899,23 @@ class WorkerServer:
         }
 
     def _heartbeat_loop(self) -> None:
+        ep = f"dispatcher:{self._dispatcher}"
+        # Single-shot per tick: the loop itself IS the retry schedule —
+        # stacking per-call retries on top would stretch a tick past the
+        # heartbeat interval.
+        policy = netrpc.RetryPolicy(deadline_s=5.0, max_attempts=1)
         while not self._stop.wait(self._heartbeat_interval_s):
             try:
                 resp, _ = _rpc(
                     self._dispatcher,
                     {"kind": "heartbeat", "addr": self.addr},
-                    timeout=5.0,
+                    timeout=5.0, endpoint=ep, policy=policy,
                 )
                 if resp.get("reregister"):
                     resp, _ = _rpc(
                         self._dispatcher,
                         {"kind": "register_worker", "addr": self.addr},
-                        timeout=5.0,
+                        timeout=5.0, endpoint=ep, policy=policy,
                     )
                     new_shard = int(resp["shard"])
                     with self._lock:
@@ -675,6 +977,7 @@ class WorkerServer:
         num_shards = int(req.get("num_shards") or self._pool_size_hint or 1)
         skip = int(req.get("skip", 0))
         wire_fmt = str(req.get("wire", "npz"))
+        sid = req.get("sid")
         split = req.get("split")
         with self._lock:
             if epoch in self._retired_epochs:
@@ -704,12 +1007,13 @@ class WorkerServer:
                     }, None
                 split = self.shard_index
             split = int(split)
+            rid = int(req.get("rid", 0))
             key = (epoch, gen, split)
             entry = self._iters.get(key)
             if entry is None:
                 entry = _IterSlot(
                     self._make_iter_factory(split, num_shards, skip),
-                    num_shards,
+                    num_shards, sid=sid, rid=rid, pos=skip,
                 )
                 self._iters[key] = entry
                 self._prune_epochs_locked(epoch)
@@ -725,11 +1029,55 @@ class WorkerServer:
                         f"request has {num_shards}"
                     ),
                 }, None
+            elif sid is not None and sid != entry.sid:
+                rid = int(req.get("rid", 0))
+                if rid <= entry.rid:
+                    # A STALE stream's leftover pipelined frame (its
+                    # connection was severed, but frames it had already
+                    # put on the wire are still being read): honoring it
+                    # would rewind the slot under the live resume stream
+                    # and re-serve counted batches.  Refuse — the answer
+                    # goes to a dead socket anyway.
+                    return {
+                        "ok": False,
+                        "error": (
+                            f"stale resume token (attempt {rid} <= "
+                            f"current {entry.rid}) for epoch {epoch} "
+                            f"split {split}"
+                        ),
+                    }, None
+                # Reconnect-with-resume: a NEW stream took over a live
+                # slot.  The slot lock is taken INSIDE the worker lock
+                # (serve path takes it alone — consistent order, no
+                # deadlock) so any in-flight next() for the dead stream
+                # lands its pos increment before the comparison.
+                with entry.lock:
+                    entry.rid = rid
+                    if skip == entry.pos:
+                        # Nothing was lost on the severed wire: adopt the
+                        # iterator in place and keep streaming.
+                        entry.sid = sid
+                    else:
+                        # Batches died in flight (served but never
+                        # received): rebuild the deterministic iterator
+                        # from the client's own delivered count.
+                        logger.info(
+                            "data worker %s: stream resume rebuilt "
+                            "epoch %s split %d at %d (slot was at %d)",
+                            self.addr, epoch, split, skip, entry.pos,
+                        )
+                        entry = _IterSlot(
+                            self._make_iter_factory(split, num_shards,
+                                                    skip),
+                            num_shards, sid=sid, rid=rid, pos=skip,
+                        )
+                        self._iters[key] = entry
         with entry.lock:  # iterators aren't thread-safe; serialize per slot
             try:
                 batch = next(entry.ensure())
             except StopIteration:
                 return {"ok": True, "eof": True, "split": split}, None
+            entry.pos += 1
         self._m_served.inc()
         self._served += 1
         return (
@@ -796,7 +1144,8 @@ class WorkerServer:
             _rpc(
                 self._dispatcher,
                 {"kind": "deregister_worker", "addr": self.addr},
-                timeout=5.0,
+                timeout=5.0, endpoint=f"dispatcher:{self._dispatcher}",
+                policy=netrpc.RetryPolicy(deadline_s=5.0, max_attempts=1),
             )
         except OSError:
             pass
@@ -855,12 +1204,15 @@ class DataServiceClient:
         buffer_batches: int | None = None,
         wait_for_workers_s: float = 30.0,
         get_next_timeout_s: float = 120.0,
+        stream_retries: int = 2,
+        progress_interval_s: float = 2.0,
     ):
         if protocol not in PROTOCOLS:
             raise ValueError(f"unknown protocol {protocol!r} ({PROTOCOLS})")
         if wire not in WIRE_FORMATS:
             raise ValueError(f"unknown wire {wire!r} ({WIRE_FORMATS})")
         self._dispatcher = dispatcher
+        self._dispatcher_ep = f"dispatcher:{dispatcher}"
         self._epoch = str(epoch)
         self._ignore_errors = ignore_errors
         self._protocol = protocol
@@ -868,6 +1220,16 @@ class DataServiceClient:
         self._wire = wire
         self._timeout = get_next_timeout_s
         self._window = max(1, int(window))
+        #: Bounded SAME-WORKER stream reconnections per fault before the
+        #: failure is reported to the dispatcher (elastic eviction): a
+        #: transient delay/sever is a transport fault, not a dead worker.
+        self._stream_retries = max(0, int(stream_retries))
+        self._stream_policy = netrpc.RetryPolicy(
+            deadline_s=get_next_timeout_s, backoff_base_s=0.05,
+            backoff_max_s=0.5,
+        )
+        self._client_id = uuid.uuid4().hex[:8]
+        self._progress_interval_s = float(progress_interval_s)
 
         # metric handles resolved once (hot-path discipline)
         self._m_batches = _counter(
@@ -889,6 +1251,11 @@ class DataServiceClient:
             "data_service_resharded_splits_total",
             "splits elastically re-assigned after a worker death",
         )
+        self._m_resumes = _counter(
+            "data_service_stream_resumes_total",
+            "same-worker stream reconnections (transport fault absorbed "
+            "without evicting the worker)",
+        )
 
         # Distributed tracing: ONE trace per epoch.  This root span is the
         # client anchor; the dispatcher's start_epoch span and every
@@ -908,6 +1275,10 @@ class DataServiceClient:
                         {"kind": "start_epoch", "epoch": self._epoch},
                         timeout=5.0,
                         trace=_ep_span.context,
+                        endpoint=self._dispatcher_ep,
+                        # this grace loop IS the retry schedule
+                        policy=netrpc.RetryPolicy(deadline_s=5.0,
+                                                  max_attempts=1),
                     )
                 except OSError:
                     # Dispatcher still starting up — that's what the grace
@@ -926,6 +1297,11 @@ class DataServiceClient:
             int(s): dict(v) for s, v in resp["splits"].items()
         }
         self._received: dict[int, int] = {s: 0 for s in self._assignments}
+        # Monotonic per-split stream-attempt counter: rides each stream's
+        # requests as ``rid`` so the worker can refuse a severed stream's
+        # leftover pipelined frames (stale < current) instead of letting
+        # them steal the slot back from the live resume stream.
+        self._stream_rids: dict[int, int] = {s: 0 for s in self._assignments}
         self._dead_workers: set[str] = set()
         self._reshard_lock = threading.Lock()
         self._err: BaseException | None = None
@@ -971,6 +1347,40 @@ class DataServiceClient:
         ]
         for t in self._fetchers:
             t.start()
+        # Periodic exactly-once progress reports: the dispatcher journals
+        # them, so a dispatcher restart mid-epoch still knows how far each
+        # split got even before any failure report supplies a count.
+        self._progress_stop = threading.Event()
+        self._progress_thread = None
+        if self._progress_interval_s > 0:
+            self._progress_thread = threading.Thread(
+                target=self._progress_loop,
+                name="dtf-data-progress",
+                daemon=True,
+            )
+            self._progress_thread.start()
+
+    def _progress_loop(self) -> None:
+        policy = netrpc.RetryPolicy(deadline_s=2.0, max_attempts=1)
+        while not self._progress_stop.wait(self._progress_interval_s):
+            with self._reshard_lock:
+                received = {str(s): n for s, n in self._received.items()}
+            try:
+                _rpc(
+                    self._dispatcher,
+                    {
+                        "kind": "report_progress",
+                        "epoch": self._epoch,
+                        "client": self._client_id,
+                        "received": received,
+                    },
+                    timeout=2.0, endpoint=self._dispatcher_ep,
+                    policy=policy,
+                )
+            except (OSError, ConnectionError):
+                # Best-effort durability: a briefly-unreachable (or
+                # breaker-open) dispatcher costs one report, nothing more.
+                pass
 
     # -- streaming fetchers ---------------------------------------------------
 
@@ -993,14 +1403,21 @@ class DataServiceClient:
         self._buffer_put(self._ERR)
 
     def _fetch_loop(self, split: int) -> None:
+        resume_attempts = 0
         try:
             while not self._closed:
                 with self._reshard_lock:
                     asg = dict(self._assignments[split])
                     gen = self._gen
+                    # Resume position: the stream always starts at this
+                    # client's ABSOLUTE delivered count (>= the
+                    # assignment's skip once any batch has landed) — the
+                    # worker's sid/pos reconciliation fast-forwards or
+                    # adopts accordingly.
+                    skip = max(int(asg["skip"]), self._received[split])
                 addr = asg["addr"]
                 try:
-                    self._stream_split(split, addr, asg["skip"], gen)
+                    self._stream_split(split, addr, skip, gen)
                     return  # EOF: split fully delivered
                 except _WorkerRefusal as e:
                     # Config-level refusal (pool-snapshot mismatch), not a
@@ -1012,8 +1429,35 @@ class DataServiceClient:
                     self._fail(RuntimeError(str(e)))
                     return
                 except (OSError, ConnectionError, wirelib.WireError) as e:
+                    if self._closed:
+                        return
+                    with self._reshard_lock:
+                        progressed = self._received[split] > skip
+                        moved = self._assignments[split]["addr"] != addr
+                    if progressed or moved:
+                        # A fresh fault (or a reshard by a sibling) gets
+                        # the full same-worker retry budget back.
+                        resume_attempts = 0
+                    if not moved and resume_attempts < self._stream_retries:
+                        # Transport fault first: reconnect to the SAME
+                        # worker with backoff+jitter before telling the
+                        # dispatcher to evict it.
+                        delay = netrpc.backoff_s(
+                            self._stream_policy, resume_attempts
+                        )
+                        resume_attempts += 1
+                        self._m_resumes.inc()
+                        logger.info(
+                            "data stream split %d to %s faulted (%s); "
+                            "resume attempt %d/%d in %.2fs",
+                            split, addr, e, resume_attempts,
+                            self._stream_retries, delay,
+                        )
+                        time.sleep(delay)
+                        continue
                     if not self._handle_stream_failure(split, addr, e):
                         return
+                    resume_attempts = 0
         except BaseException as e:  # pragma: no cover - belt and braces
             self._fail(e)
         finally:
@@ -1041,6 +1485,9 @@ class DataServiceClient:
         self, split: int, addr: str, skip: int, gen: int,
         trace_ctx: dict | None,
     ) -> None:
+        with self._reshard_lock:
+            self._stream_rids[split] += 1
+            rid = self._stream_rids[split]
         request = {
             "kind": "get_next",
             "epoch": self._epoch,
@@ -1049,48 +1496,68 @@ class DataServiceClient:
             "skip": skip,
             "gen": gen,
             "wire": self._wire,
+            # Per-stream resume token + monotonic attempt number: the
+            # worker adopts/rebuilds its iterator slot by comparing this
+            # stream's skip to the slot position whenever the sid changes
+            # (reconnect-with-resume), and refuses frames whose rid is
+            # stale (a severed predecessor's buffered pipeline).
+            "sid": f"{self._client_id}-{split}-{uuid.uuid4().hex[:8]}",
+            "rid": rid,
         }
-        host, port = addr.rsplit(":", 1)
-        with socket.create_connection(
-            (host, int(port)), timeout=self._timeout
-        ) as s:
-            s.settimeout(self._timeout)
-            outstanding = 0
-            traced_sent = trace_ctx is None  # inject once per stream
-            while not self._closed:
-                # Credit window: keep W get_nexts on the wire.  Requests
-                # are tiny JSON frames; the responses stream back in order
-                # on the same socket while we decode/enqueue.
-                target = max(1, self._window_depth())
-                while outstanding < target:
-                    if not traced_sent:
-                        traced_sent = True
-                        _send_msg(s, dict(request, trace=trace_ctx))
-                    else:
-                        _send_msg(s, request)
-                    outstanding += 1
-                t0 = time.perf_counter()
-                header, data = _recv_msg(s)
-                self._m_fetch.observe(time.perf_counter() - t0, worker=addr)
-                outstanding -= 1
-                if not header.get("ok"):
-                    raise _WorkerRefusal(
-                        f"data worker {addr}: {header.get('error')}"
-                    )
-                if header.get("eof"):
-                    # In-flight requests beyond EOF answer eof too; the
-                    # socket just closes under them.
-                    return
-                batch = decode_batch(data)
-                # Exactly-once accounting: count only fully-received,
-                # decoded batches — a response torn mid-wire is refetched
-                # by the takeover worker, a counted one never is.
-                with self._reshard_lock:
-                    self._received[split] += 1
-                if self._controller:
-                    self._controller.note_bytes(wirelib.tensor_bytes(batch))
-                if not self._buffer_put((split, batch)):
-                    return
+        # Dialing rides the net substrate: backoff+jitter inside a short
+        # connect deadline (the fetch loop owns the longer retry/evict
+        # policy), breaker feed, and sever-target registration (chaos).
+        s, token = netrpc.connect_stream(
+            addr, endpoint=f"data_worker:{addr}", timeout_s=self._timeout,
+            connect_deadline_s=2.0, policy=self._stream_policy,
+        )
+        try:
+            self._stream_pump(s, request, split, addr, trace_ctx)
+        finally:
+            netrpc.unregister_stream(token)
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _stream_pump(self, s: socket.socket, request: dict, split: int,
+                     addr: str, trace_ctx: dict | None) -> None:
+        outstanding = 0
+        traced_sent = trace_ctx is None  # inject once per stream
+        while not self._closed:
+            # Credit window: keep W get_nexts on the wire.  Requests
+            # are tiny JSON frames; the responses stream back in order
+            # on the same socket while we decode/enqueue.
+            target = max(1, self._window_depth())
+            while outstanding < target:
+                if not traced_sent:
+                    traced_sent = True
+                    _send_msg(s, dict(request, trace=trace_ctx))
+                else:
+                    _send_msg(s, request)
+                outstanding += 1
+            t0 = time.perf_counter()
+            header, data = _recv_msg(s)
+            self._m_fetch.observe(time.perf_counter() - t0, worker=addr)
+            outstanding -= 1
+            if not header.get("ok"):
+                raise _WorkerRefusal(
+                    f"data worker {addr}: {header.get('error')}"
+                )
+            if header.get("eof"):
+                # In-flight requests beyond EOF answer eof too; the
+                # socket just closes under them.
+                return
+            batch = decode_batch(data)
+            # Exactly-once accounting: count only fully-received,
+            # decoded batches — a response torn mid-wire is refetched
+            # by the takeover worker, a counted one never is.
+            with self._reshard_lock:
+                self._received[split] += 1
+            if self._controller:
+                self._controller.note_bytes(wirelib.tensor_bytes(batch))
+            if not self._buffer_put((split, batch)):
+                return
 
     def _handle_stream_failure(
         self, split: int, addr: str, err: BaseException
@@ -1125,6 +1592,7 @@ class DataServiceClient:
                         },
                         timeout=10.0,
                         trace=getattr(_rp_span, "context", None),
+                        endpoint=self._dispatcher_ep,
                     )
                 except OSError as e:
                     resp = {
@@ -1223,6 +1691,11 @@ class DataServiceClient:
                         "wire": self._wire,
                     },
                     timeout=self._timeout,
+                    # get_next is NOT idempotent: a transport retry after
+                    # a lost response would skip a batch — the v1 fault
+                    # policy (drop/raise) handles it instead.
+                    policy=netrpc.RetryPolicy(deadline_s=self._timeout,
+                                              max_attempts=1),
                 )
             except OSError as e:
                 if not self._ignore_errors:
@@ -1267,6 +1740,7 @@ class DataServiceClient:
         if self._protocol == "per_connection":
             return
         self._closed = True
+        self._progress_stop.set()
         while True:
             try:
                 self._q.get_nowait()

@@ -1,0 +1,22 @@
+"""Resilient network substrate shared by every cross-process transport.
+
+``net.rpc`` — deadline-bounded, retrying, breaker-guarded unary calls +
+persistent-stream dialing + a hard-deadline HTTP GET;
+``net.breaker`` — the per-endpoint closed/open/half-open circuit
+breakers.  Importable on bare hosts (no jax): telemetry degrades to
+no-ops where the obs registry is unavailable.
+"""
+
+from . import breaker, rpc  # noqa: F401
+from .breaker import BreakerOpenError, CircuitBreaker, breaker_for  # noqa: F401
+from .rpc import (  # noqa: F401
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    backoff_s,
+    call,
+    connect_stream,
+    connect_with_retry,
+    http_get,
+    remaining_from_request,
+)

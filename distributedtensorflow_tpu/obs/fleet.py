@@ -233,23 +233,48 @@ class FleetAggregator:
 
     # -- scraping ------------------------------------------------------------
 
-    def _fetch(self, addr: str) -> str:
-        """GET one peer's /varz; raises on any transport/HTTP failure
-        (urlopen raises ``HTTPError`` itself for non-2xx; the explicit
-        check covers non-200 2xx/3xx pass-throughs)."""
-        url = f"http://{addr}/varz"
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
-            if resp.status != 200:
-                raise FleetScrapeError(f"/varz answered HTTP {resp.status}")
-            return resp.read().decode("utf-8", errors="replace")
+    def _fetch(self, addr: str, peer_name: str = "") -> str:
+        """GET one peer's /varz under a HARD per-peer deadline
+        (``net.rpc.http_get``): connect, headers and every body chunk
+        are charged to one budget, so a hung or byte-trickling peer can
+        cost at most ``timeout_s`` — it can no longer stall the scrape
+        round past ``interval_s`` by stringing per-op timeouts along."""
+        from ..net import rpc as netrpc  # noqa: PLC0415
+
+        status, body = netrpc.http_get(
+            f"http://{addr}/varz",
+            deadline_s=min(self.timeout_s, self.interval_s),
+            endpoint=f"fleet_peer:{peer_name or addr}",
+        )
+        if status != 200:
+            raise FleetScrapeError(f"/varz answered HTTP {status}")
+        return body
 
     def _classify_failure(self, peer: _Peer, err: Exception,
                           now: float) -> str:
         """down vs stale: a refused connection, an HTTP error status, or
         a malformed page is an unambiguous ``down`` (the server is gone
-        or sick); a timeout or transient socket error is ``stale`` while
-        the last success is recent — the acceptance contract is that a
-        KILLED peer flips to ``down`` within one scrape interval."""
+        or sick); a deadline miss or transient socket error is ``stale``
+        while the last success is recent — the acceptance contract is
+        that a KILLED peer flips to ``down`` within one scrape
+        interval."""
+        from ..net import BreakerOpenError
+        from ..net.rpc import DeadlineExceeded
+
+        if isinstance(err, BreakerOpenError) and peer.state == "down":
+            # The open breaker gathered no fresh evidence — the previous
+            # rounds' verdict stands.  A peer already marked down (its
+            # refused connections are what tripped the breaker) must not
+            # oscillate back to stale whenever the scrape interval
+            # undercuts the breaker cooldown.
+            return "down"
+        if isinstance(err, (DeadlineExceeded, BreakerOpenError)):
+            # Soft: a hung-but-listening peer (or a breaker pacing one)
+            # means "try again next round", not "gone".
+            if peer.last_ok_t is not None \
+                    and (now - peer.last_ok_t) <= self.stale_after_s:
+                return "stale"
+            return "down"
         # HTTPError first: it subclasses URLError but its .reason is a
         # string, so the refused-connection probe below would misread a
         # 500-ing peer as merely stale.
@@ -264,37 +289,62 @@ class FleetAggregator:
             return "stale"
         return "down"
 
+    def _scrape_peer(self, peer: _Peer) -> None:
+        t0 = time.perf_counter()
+        now = time.time()
+        try:
+            samples = parse_prometheus(self._fetch(peer.addr, peer.name))
+        except Exception as e:  # noqa: BLE001 — classified, never fatal
+            state = self._classify_failure(peer, e, now)
+            with self._lock:
+                peer.errors += 1
+                peer.last_err = f"{type(e).__name__}: {e}"
+                peer.state = state
+                if state == "down":
+                    peer.samples = {}
+            self._m_scrapes.inc(outcome="error")
+            logger.debug("fleet: peer %s scrape failed (%s) -> %s",
+                         peer.name, peer.last_err, state)
+        else:
+            with self._lock:
+                peer.ok += 1
+                peer.last_ok_t = now
+                peer.last_err = None
+                peer.state = "up"
+                peer.samples = samples
+            self._m_scrapes.inc(outcome="ok")
+        self._m_scrape.observe(time.perf_counter() - t0, peer=peer.name)
+
     def scrape_once(self) -> dict:
         """One scrape round over every registered peer; returns the fleet
-        view (:meth:`view`).  A failing or malformed peer is classified
-        and skipped — this method never raises on peer behavior."""
+        view (:meth:`view`).  Peers are scraped CONCURRENTLY (one thread
+        each) so the round's wall time is the slowest single peer's
+        deadline, not the sum — N hung peers cost one ``timeout_s``, not
+        N.  A failing or malformed peer is classified and skipped — this
+        method never raises on peer behavior."""
         with self._lock:
             peers = list(self._peers.values())
-        for peer in peers:
-            t0 = time.perf_counter()
-            now = time.time()
-            try:
-                samples = parse_prometheus(self._fetch(peer.addr))
-            except Exception as e:  # noqa: BLE001 — classified, never fatal
-                state = self._classify_failure(peer, e, now)
-                with self._lock:
-                    peer.errors += 1
-                    peer.last_err = f"{type(e).__name__}: {e}"
-                    peer.state = state
-                    if state == "down":
-                        peer.samples = {}
-                self._m_scrapes.inc(outcome="error")
-                logger.debug("fleet: peer %s scrape failed (%s) -> %s",
-                             peer.name, peer.last_err, state)
-            else:
-                with self._lock:
-                    peer.ok += 1
-                    peer.last_ok_t = now
-                    peer.last_err = None
-                    peer.state = "up"
-                    peer.samples = samples
-                self._m_scrapes.inc(outcome="ok")
-            self._m_scrape.observe(time.perf_counter() - t0, peer=peer.name)
+        if len(peers) <= 1:
+            for peer in peers:
+                self._scrape_peer(peer)
+        else:
+            threads = [
+                threading.Thread(
+                    target=self._scrape_peer, args=(peer,),
+                    name=f"dtf-fleet-scrape-{peer.name}", daemon=True,
+                )
+                for peer in peers
+            ]
+            for t in threads:
+                t.start()
+            # http_get's hard deadline bounds every worker; the extra
+            # grace only covers scheduling jitter.
+            join_deadline = (
+                time.monotonic() + min(self.timeout_s, self.interval_s)
+                + 1.0
+            )
+            for t in threads:
+                t.join(timeout=max(join_deadline - time.monotonic(), 0.05))
         self._remerge()
         with self._lock:
             self._rounds += 1

@@ -478,7 +478,19 @@ class AsyncPSClient:
     def _rpc(self, ps: int, request: dict, data: bytes | None = None):
         try:
             if data is None:
-                return _rpc(self._addrs[ps], request, timeout=self._timeout)
+                from ..net.rpc import RetryPolicy  # noqa: PLC0415
+
+                # Single-shot with an honest endpoint identity: PS loss
+                # is FATAL by contract (the reference's semantics) — the
+                # net substrate's default retries would mask it, and the
+                # default data_worker label would render PS traffic as
+                # data-plane traffic in every rpc_* time series.
+                return _rpc(
+                    self._addrs[ps], request, timeout=self._timeout,
+                    endpoint=f"peer:ps{ps}",
+                    policy=RetryPolicy(deadline_s=self._timeout,
+                                       max_attempts=1),
+                )
             import socket as socket_mod
 
             host, port = self._addrs[ps].rsplit(":", 1)

@@ -263,21 +263,40 @@ def _serve_link(rdir: str, link: int, timeout_s: float) -> socket.socket:
 
 
 def _connect_link(rdir: str, link: int, timeout_s: float) -> socket.socket:
-    deadline = time.monotonic() + timeout_s
+    """Dial the downstream peer's published port through the resilient
+    substrate (``net.rpc.connect_with_retry``): exponential backoff +
+    jitter instead of a fixed poll, per-link ``rpc_attempt_seconds`` /
+    retry metrics, and a breaker that fast-fails a peer stuck refusing.
+    Each attempt RE-READS the port file — a respawned server republishes
+    a fresh port and the retry picks it up."""
+    from ..net import rpc as netrpc  # noqa: PLC0415
+
     path = _port_file(rdir, link)
-    while time.monotonic() < deadline:
-        try:
-            with open(path) as f:
-                port = int(f.read().strip())
-            sock = socket.create_connection(("127.0.0.1", port), timeout=2.0)
-            sock.settimeout(None)  # connect-only timeout; reads block
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            return sock
-        except (OSError, ValueError):
-            time.sleep(0.1)
-    raise WorkerUnavailableError(
-        f"link {link}: could not connect within {timeout_s:.0f}s"
-    )
+
+    def _dial() -> socket.socket:
+        with open(path) as f:
+            port = int(f.read().strip())
+        sock = socket.create_connection(("127.0.0.1", port), timeout=2.0)
+        sock.settimeout(None)  # connect-only timeout; reads block
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    try:
+        return netrpc.connect_with_retry(
+            _dial,
+            endpoint=f"mpmd_link:{link}",
+            deadline_s=timeout_s,
+            policy=netrpc.RetryPolicy(
+                deadline_s=timeout_s, backoff_base_s=0.05,
+                backoff_max_s=0.5,
+            ),
+            retryable=(OSError, ValueError),
+        )
+    except (netrpc.DeadlineExceeded, ConnectionError) as e:
+        raise WorkerUnavailableError(
+            f"link {link}: could not connect within {timeout_s:.0f}s "
+            f"({e})"
+        ) from e
 
 
 # --- per-stage model ---------------------------------------------------------

@@ -18,6 +18,7 @@ closed recovery loop:
 
 from .chaos import (  # noqa: F401
     FAULT_KINDS,
+    NET_FAULT_KINDS,
     ChaosInjector,
     DataStallFault,
     FaultPlan,

@@ -42,6 +42,31 @@ on demand, deterministically, from a JSON *fault plan*
     :meth:`attach_preemption`): the trainer's own consistent-save path
     runs and the fit exits preempted; the supervisor resumes it.
 
+Network fault kinds (ISSUE 13 — injected at the :mod:`..net` layer, and
+recovered by the TRANSPORT, not by a supervised restart; their
+``recovered`` row is written when the first successful matching call
+proves the fault was absorbed):
+
+``net_delay``
+    Arms a delay of ``delay_s`` (default 0.05) against the next
+    ``calls`` (default 4) RPC attempts whose endpoint contains
+    ``endpoint`` (default: every endpoint).
+``net_drop``
+    Drops (fails with ``ConnectionError`` before any byte is sent) the
+    next ``calls`` (default 2) matching RPC attempts; retries absorb
+    them.
+``net_sever``
+    Forcibly severs every live registered persistent stream matching
+    ``endpoint`` (data-service fetch streams); the streaming client
+    reconnects to the same worker and resumes exactly-once.
+``dispatcher_kill``
+    Kills the attached data-service dispatcher mid-epoch (simulated
+    crash: no clean shutdown), drives its circuit breaker through a full
+    open cycle with failing probes, restarts it from the durable journal
+    (:meth:`attach_data_service` supplies the restart hook), and probes
+    until the transport recovers — the breaker's open → half_open →
+    closed transitions land in ``breaker_transitions_total``.
+
 Every injection and recovery is appended to ``<logdir>/faults.jsonl``
 (one JSON object per line, ``t`` non-decreasing)::
 
@@ -73,12 +98,23 @@ logger = logging.getLogger("distributedtensorflow_tpu")
 
 __all__ = [
     "FAULT_KINDS",
+    "NET_FAULT_KINDS",
     "ChaosInjector",
     "DataStallFault",
     "FaultPlan",
     "InjectedFault",
     "WorkerKilledFault",
 ]
+
+#: Fault kinds recovered by the resilient transport itself (no restart):
+#: the supervisor's mark_recovered must NOT claim these — their recovery
+#: row is written when the net layer observes a post-fault success.
+NET_FAULT_KINDS = (
+    "net_delay",
+    "net_drop",
+    "net_sever",
+    "dispatcher_kill",
+)
 
 #: The known fault kinds (duplicated stdlib-side in
 #: tools/check_metrics_schema.py FAULT_KINDS — keep in sync).
@@ -88,7 +124,7 @@ FAULT_KINDS = (
     "worker_kill",
     "data_stall",
     "preemption",
-)
+) + NET_FAULT_KINDS
 
 _M_INJECTED = obs.counter(
     "faults_injected_total", "chaos faults injected, by kind"
@@ -208,6 +244,8 @@ class ChaosInjector(Callback):
         )
         self._preemption = None
         self._coordinator = None
+        self._dispatcher = None
+        self._dispatcher_restart = None
         if self._path:
             os.makedirs(logdir, exist_ok=True)
             # Truncate a prior run's log: the plan restarts from scratch.
@@ -223,6 +261,15 @@ class ChaosInjector(Callback):
         """A process-backed Coordinator whose worker 0 ``worker_kill``
         faults SIGKILL (optional — without one the fault only raises)."""
         self._coordinator = coord
+
+    def attach_data_service(self, dispatcher, restart_fn) -> None:
+        """The data-service control plane ``dispatcher_kill`` faults
+        target: ``dispatcher`` is the live ``DispatchServer``,
+        ``restart_fn()`` builds its replacement on the SAME port from
+        the durable journal.  Also gives :meth:`on_fit_end` a live
+        endpoint to probe when pairing net-fault recovery rows."""
+        self._dispatcher = dispatcher
+        self._dispatcher_restart = restart_fn
 
     def wrap_train_step(self, train_step):
         """NaN-loss injection: at the trigger step the returned metrics
@@ -253,9 +300,34 @@ class ChaosInjector(Callback):
 
     # -- Callback hooks (worker_kill / data_stall / preemption) --------------
 
+    #: Kinds fired from on_step_end (nan_loss fires inside the wrapped
+    #: train step, checkpoint_truncate inside the wrapped save).
+    _STEP_KINDS = ("preemption", "data_stall", "worker_kill") \
+        + NET_FAULT_KINDS
+
     def on_step_end(self, trainer, step: int, state, metrics) -> None:
-        fault = self._pending("preemption", step)
-        if fault is not None:
+        # Due faults fire in id (= plan trigger) order, so injected rows
+        # keep their strictly-increasing-id invariant even when a
+        # transport fault and a process fault share a trigger step; a
+        # raising kind naturally ends the batch (the rest re-trigger
+        # after the supervised restart re-reaches this step).
+        while True:
+            with self._lock:
+                due = [
+                    f for f in self.plan.faults
+                    if not f.injected and f.step <= step
+                    and f.kind in self._STEP_KINDS
+                ]
+            if not due:
+                return
+            self._fire_one(min(due, key=lambda f: f.id), step)
+
+    def _fire_one(self, fault: _Fault, step: int) -> None:
+        kind = fault.kind
+        if kind in NET_FAULT_KINDS:
+            self._fire_net_fault(fault, step)
+            return
+        if kind == "preemption":
             self._inject(fault, at_step=step)
             if self._preemption is not None:
                 self._preemption.trigger()
@@ -264,8 +336,8 @@ class ChaosInjector(Callback):
                     "chaos: preemption fault at step %d but no handler "
                     "attached; fault is a no-op", step,
                 )
-        fault = self._pending("data_stall", step)
-        if fault is not None:
+            return
+        if kind == "data_stall":
             stall_s = float(fault.params.get("stall_s", 0.0))
             self._inject(fault, at_step=step, stall_s=stall_s)
             if stall_s > 0:
@@ -276,8 +348,7 @@ class ChaosInjector(Callback):
                 f"chaos: input pipeline stalled at step {step}",
                 fault_id=fault.id, step=step,
             )
-        fault = self._pending("worker_kill", step)
-        if fault is not None:
+        if kind == "worker_kill":
             self._inject(fault, at_step=step)
             if self._coordinator is not None:
                 try:
@@ -290,6 +361,159 @@ class ChaosInjector(Callback):
                 f"chaos: worker killed at step {step}",
                 fault_id=fault.id, step=step,
             )
+
+    # -- network faults (transport-recovered; ISSUE 13) ----------------------
+
+    def _fire_net_fault(self, fault: _Fault, step: int) -> None:
+        """Arm/execute one due ``net_*`` / ``dispatcher_kill`` fault.
+        None of these raise: the resilient transport is what is under
+        test, and the run must proceed THROUGH the fault."""
+        from ..net import rpc as netrpc  # noqa: PLC0415 (jax-free)
+
+        if fault.kind == "net_delay":
+            self._inject(fault, at_step=step)
+            netrpc.arm_fault(
+                "net_delay",
+                calls=int(fault.params.get("calls", 4)),
+                delay_s=float(fault.params.get("delay_s", 0.05)),
+                match=str(fault.params.get("endpoint", "")),
+                on_recovered=lambda f=fault: self._recover_net(f),
+            )
+        elif fault.kind == "net_drop":
+            self._inject(fault, at_step=step)
+            netrpc.arm_fault(
+                "net_drop",
+                calls=int(fault.params.get("calls", 2)),
+                match=str(fault.params.get("endpoint", "")),
+                on_recovered=lambda f=fault: self._recover_net(f),
+            )
+        elif fault.kind == "net_sever":
+            n = netrpc.sever_streams(str(fault.params.get("endpoint", "")))
+            self._inject(fault, at_step=step, severed=n)
+            # Recovery = the next successful matching attempt (the
+            # severed streams' reconnect, or — when nothing was live to
+            # sever — any healthy call proving the plane still works).
+            netrpc.watch_recovery(
+                str(fault.params.get("endpoint", "")),
+                on_recovered=lambda f=fault: self._recover_net(f),
+            )
+        elif fault.kind == "dispatcher_kill":
+            self._inject(fault, at_step=step)
+            self._dispatcher_kill(fault, step)
+
+    def _recover_net(self, fault: _Fault, *, resumed_step: int | None = None,
+                     attempt: int = 0) -> None:
+        """Write the paired ``recovered`` row for a transport-absorbed
+        fault (idempotent; callable from any thread — the net layer fires
+        it from whichever thread observed the post-fault success)."""
+        with self._lock:
+            if not fault.injected or fault.recovered:
+                return
+            fault.recovered = True
+            _M_RECOVERED.inc(kind=fault.kind)
+            step = (fault.injected_step if fault.injected_step is not None
+                    else fault.step)
+            self._write({
+                "t": time.time(), "id": fault.id, "step": step,
+                "kind": fault.kind, "phase": "recovered",
+                "resumed_step": int(resumed_step if resumed_step is not None
+                                    else step),
+                "attempt": int(attempt),
+            })
+        logger.warning("chaos: transport recovered from %s (fault #%d)",
+                       fault.kind, fault.id)
+
+    def _dispatcher_kill(self, fault: _Fault, step: int) -> None:
+        """Kill → breaker-open → journal-replay restart → probe-closed.
+
+        Runs synchronously on the trainer thread (chaos is a test
+        harness): the data streams to the WORKERS keep flowing the whole
+        time — only the control plane dies — and the dispatcher endpoint
+        breaker is driven through a full open → half_open → closed cycle
+        so the recovery is visible in ``breaker_transitions_total``."""
+        from ..net import breaker as netbreaker  # noqa: PLC0415
+        from ..net import rpc as netrpc  # noqa: PLC0415
+
+        if self._dispatcher is None or self._dispatcher_restart is None:
+            logger.error(
+                "chaos: dispatcher_kill at step %d but no data service "
+                "attached; fault cannot recover", step,
+            )
+            return
+        target = self._dispatcher.target()
+        ep = f"dispatcher:{target}"
+        probe = netrpc.RetryPolicy(deadline_s=0.5, max_attempts=1,
+                                   connect_timeout_s=0.3)
+        self._dispatcher.kill()
+        logger.warning("chaos: dispatcher %s killed at step %d", target,
+                       step)
+        # Fail fast probes until the endpoint breaker trips open.
+        br = netbreaker.breaker_for(ep)
+        deadline = time.monotonic() + 15.0
+        while br.state != "open" and time.monotonic() < deadline:
+            try:
+                netrpc.call(target, {"kind": "get_workers"}, endpoint=ep,
+                            policy=probe)
+            except OSError:
+                pass
+        # Restart on the same port from the journal (the port may sit in
+        # TIME_WAIT for a beat — retry the bind briefly).
+        restarted = None
+        deadline = time.monotonic() + 15.0
+        while restarted is None and time.monotonic() < deadline:
+            try:
+                restarted = self._dispatcher_restart()
+            except OSError:
+                time.sleep(0.2)
+        if restarted is None:
+            logger.error("chaos: dispatcher restart failed; fault #%d "
+                         "stays unrecovered", fault.id)
+            return
+        self._dispatcher = restarted
+        # Probe until the breaker's half-open probe closes it again.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                resp, _ = netrpc.call(target, {"kind": "get_workers"},
+                                      endpoint=ep, policy=probe)
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if resp.get("ok"):
+                self._recover_net(fault, resumed_step=step)
+                logger.warning(
+                    "chaos: dispatcher %s restarted from journal "
+                    "(breaker %s)", target, br.state,
+                )
+                return
+        logger.error("chaos: restarted dispatcher %s never answered; "
+                     "fault #%d stays unrecovered", target, fault.id)
+
+    def on_fit_end(self, trainer, state) -> None:
+        """Pair any armed-but-unproven net faults before the run ends: a
+        successful probe against the attached dispatcher counts as the
+        post-fault success for every matching fault still watching."""
+        from ..net import rpc as netrpc  # noqa: PLC0415
+
+        pending = [
+            f for f in self.plan.faults
+            if f.kind in NET_FAULT_KINDS and f.injected and not f.recovered
+        ]
+        if not pending or self._dispatcher is None:
+            return
+        target = self._dispatcher.target()
+        probe = netrpc.RetryPolicy(deadline_s=1.0, max_attempts=1)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                netrpc.call(target, {"kind": "get_workers"},
+                            endpoint=f"dispatcher:{target}", policy=probe)
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if all(f.recovered for f in pending):
+                return
+            time.sleep(0.1)
 
     # -- recovery bookkeeping (called by the Supervisor) ---------------------
 
@@ -305,6 +529,11 @@ class ChaosInjector(Callback):
         with self._lock:
             for f in self.plan.faults:
                 if not f.injected or f.recovered:
+                    continue
+                if f.kind in NET_FAULT_KINDS:
+                    # Transport-recovered, not restart-recovered: their
+                    # row is written when the net layer proves a
+                    # post-fault success (_recover_net).
                     continue
                 if f.kind == "checkpoint_truncate":
                     if f.detail_step not in rejected:

@@ -88,6 +88,11 @@ class GenRequest:
     #: the queue/prefill/decode spans the engine emits into trace.jsonl
     #: carry it, so a slow request's time is attributable end to end.
     trace_id: str = ""
+    #: Absolute wall deadline (0 = none): a request still QUEUED past it
+    #: is abandoned at admission instead of decoded for a client that
+    #: already stopped listening (net-layer deadline honored end to end).
+    t_deadline: float = 0.0
+    deadline_exceeded: bool = False
 
     # -- lifecycle (engine-owned) --
     status: str = "queued"          # queued/active/ok/rejected/error
@@ -259,6 +264,7 @@ class Engine:
         eos_token_id: int | None = None,
         seed: int = 0,
         trace_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> GenRequest:
         """Validate + enqueue; returns the live :class:`GenRequest`.
 
@@ -312,6 +318,13 @@ class Engine:
                     f"trace_id must be 1..64 characters, got "
                     f"{len(trace_id)}"
                 )
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if not math.isfinite(deadline_s) or deadline_s <= 0:
+                raise ValueError(
+                    f"deadline_s must be a finite number > 0, got "
+                    f"{deadline_s}"
+                )
         footprint = self._footprint(len(prompt), max_new_tokens)
         if footprint > self.kv.max_context:
             raise ValueError(
@@ -337,6 +350,8 @@ class Engine:
             trace_id=trace_id or obs_tracing.new_trace_id(),
             t_submit=time.time(),
         )
+        if deadline_s is not None:
+            req.t_deadline = req.t_submit + deadline_s
         req._rng = np.random.default_rng(req.seed)
         rejected = False
         with self._cond:
@@ -408,12 +423,27 @@ class Engine:
         """Strict-FIFO admission: pop the head only while a slot AND its
         whole block reservation fit (head-of-line blocking = fairness)."""
         admitted = []
+        expired: list[GenRequest] = []
         with self._cond:
             while self._queue:
+                head = self._queue[0]
+                if head.t_deadline and time.time() > head.t_deadline:
+                    # The caller's deadline passed while the request sat
+                    # queued: abandon it NOW — decoding for a client that
+                    # already timed out would only steal slots from live
+                    # requests (overload turns into fast deadline errors
+                    # instead of everything finishing late).
+                    self._queue.popleft()
+                    head.deadline_exceeded = True
+                    head.error = (
+                        f"deadline exceeded after "
+                        f"{time.time() - head.t_submit:.3f}s in queue"
+                    )
+                    expired.append(head)
+                    continue
                 free = [i for i, r in enumerate(self._slots) if r is None]
                 if not free:
                     break
-                head = self._queue[0]
                 need = self.kv.blocks_for(
                     self._footprint(len(head.prompt), head.max_new_tokens)
                 )
@@ -438,6 +468,9 @@ class Engine:
                 self._m_admits.inc(reused=str(reused).lower())
                 admitted.append(head)
             self._m_queue.set(len(self._queue))
+        for req in expired:
+            # Finished OUTSIDE the scheduler lock (log I/O, metrics).
+            self._finish(req, None, status="error")
         self._m_active.set(sum(r is not None for r in self._slots))
         self._m_blocks_free.set(self.kv.allocator.free_blocks)
         return admitted

@@ -67,6 +67,7 @@ class ServeServer:
                  default_timeout_s: float = DEFAULT_TIMEOUT_S):
         self.engine = engine
         self._default_timeout_s = default_timeout_s
+        self._draining = False
         self._srv = StatusServer(
             port, host=host, registry=registry,
             status_fn=lambda: {"serving": engine.state()},
@@ -104,7 +105,21 @@ class ServeServer:
     def _get_state(self, query: str):
         return 200, self.engine.state()
 
+    def begin_drain(self) -> None:
+        """Refuse NEW submits with 503 immediately (bounded SIGTERM
+        drain): in-flight requests keep running and their responses still
+        go out over the live server; the caller owns the wait-then-stop
+        sequencing (serve.py ``--drain-timeout``)."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def _post_generate(self, query: str, body: bytes):
+        if self._draining:
+            return 503, {"error": "server draining (shutting down); "
+                                  "resubmit elsewhere"}
         try:
             payload = json.loads(body or b"{}")
         except json.JSONDecodeError as e:
@@ -153,7 +168,14 @@ class ServeServer:
                                   f">= 0, got {timeout}"}
         timeout = min(timeout, threading.TIMEOUT_MAX)
         try:
-            req = self.engine.submit(prompt, **kwargs)
+            # The client's timeout IS the request deadline, propagated
+            # into the engine: a request still queued past it is
+            # abandoned server-side instead of decoded for a client that
+            # already gave up.
+            req = self.engine.submit(
+                prompt, deadline_s=timeout if timeout > 0 else None,
+                **kwargs,
+            )
         except QueueFullError as e:
             return 429, {"error": str(e)}
         except ValueError as e:
@@ -163,6 +185,11 @@ class ServeServer:
         if not req.wait(timeout):
             return 504, {"error": f"generation exceeded timeout_s="
                                   f"{timeout}", "id": req.id}
+        if req.deadline_exceeded:
+            # The engine abandoned it at admission (overload): same
+            # contract as the handler-side timer, observed server-side.
+            return 504, {"error": req.error or "deadline exceeded",
+                         "id": req.id}
         if req.status != "ok":
             return 500, {"error": req.error or f"request {req.status}",
                          "id": req.id}

@@ -121,6 +121,11 @@ def main(argv=None) -> int:
                    help="writes requests.jsonl / metrics.jsonl / "
                         "metrics.prom (and, with tracing, trace.jsonl) "
                         "here")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="bounded SIGTERM drain: refuse new submits with "
+                        "503 immediately, finish in-flight requests, and "
+                        "force-exit (exception flight event, exit 1) if "
+                        "any are still running after this many seconds")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--slo-rules", default=None, metavar="JSON",
                    help="SLO rule file (obs.slo schema): evaluate burn "
@@ -149,14 +154,27 @@ def main(argv=None) -> int:
     # its trace_id (client-suppliable via POST /generatez) — the stream
     # tools/timeline.py --fleet stitches across processes.
     tracer = None
+    flight = None
     if args.logdir:
         import os
 
+        from distributedtensorflow_tpu.obs.flight_recorder import (
+            FlightRecorder,
+            install_recorder,
+        )
         from distributedtensorflow_tpu.obs.tracing import TraceRecorder
 
         tracer = TraceRecorder(
             os.path.join(args.logdir, "trace.jsonl")
         ).install()
+        # Flight ring for lifecycle forensics: the drain-timeout
+        # `exception` event (and anything else record_event raises)
+        # lands in <logdir>/flight.jsonl.
+        flight = FlightRecorder(
+            path=os.path.join(args.logdir, "flight.jsonl")
+        )
+        install_recorder(flight)
+        flight.install_crash_hooks()
     engine = Engine(
         params, cfg,
         max_slots=args.max_slots, max_queue=args.max_queue,
@@ -204,19 +222,54 @@ def main(argv=None) -> int:
         time.sleep(0.2)
     if slo_monitor is not None:
         slo_monitor.stop()
+    # Bounded drain (--drain-timeout): refuse NEW submits with 503 right
+    # away, keep the server up so in-flight responses still go out,
+    # finish what is running, and force-exit at the bound instead of
+    # hanging forever on a wedged request.
+    server.begin_drain()
+    drain_deadline = time.monotonic() + max(args.drain_timeout, 0.0)
+    drained = False
+    while time.monotonic() < drain_deadline:
+        st = engine.state()
+        if st["queue_depth"] == 0 and st["active_slots"] == 0:
+            drained = True
+            break
+        time.sleep(0.1)
+    forced = not drained
+    if forced:
+        st = engine.state()
+        logging.error(
+            "drain timeout (%.1fs): %d queued + %d active request(s) "
+            "still running; forcing exit",
+            args.drain_timeout, st["queue_depth"], st["active_slots"],
+        )
+        from distributedtensorflow_tpu.obs import record_event
+
+        record_event(
+            "exception", reason="drain_timeout",
+            drain_timeout_s=args.drain_timeout,
+            queued=st["queue_depth"], active=st["active_slots"],
+        )
+        if flight is not None:
+            flight.dump(reason="drain_timeout")
     server.stop()
-    engine.stop(drain=True)
+    engine.stop(drain=not forced)
     if tracer is not None:
         tracer.uninstall()
         tracer.close()
+    if flight is not None:
+        flight.record("serve_shutdown", drained=drained,
+                      forced=forced)
+        flight.dump(reason="shutdown")
     st = engine.state()
     logging.info(
         "served %d ok / %d rejected / %d error; %d tokens, peak "
-        "occupancy %d", st["counters"]["ok"], st["counters"]["rejected"],
+        "occupancy %d%s", st["counters"]["ok"], st["counters"]["rejected"],
         st["counters"]["error"], st["counters"]["tokens_generated"],
-        st["occupancy_max"],
+        st["occupancy_max"], " (FORCED exit at drain bound)" if forced
+        else "",
     )
-    return 0
+    return 1 if forced else 0
 
 
 if __name__ == "__main__":

@@ -608,3 +608,38 @@ def test_http_error_peer_is_hard_down(two_peers):
     finally:
         sick.shutdown()
         sick.server_close()
+
+
+def test_hung_peer_cannot_stall_scrape_round(two_peers):
+    """ISSUE 13 satellite: a peer that ACCEPTS and then never answers
+    (hung, not refused) must cost at most the per-peer scrape deadline —
+    the round completes within ~one interval and the healthy peer's
+    samples still merge."""
+    import socket as socketlib
+
+    from distributedtensorflow_tpu.net import breaker as netbreaker
+
+    netbreaker.reset_breakers()
+    hung = socketlib.socket()
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(4)  # accepts connections; never reads or responds
+    try:
+        agg = fleet_mod.FleetAggregator(
+            interval_s=0.5, timeout_s=0.5, stale_after_s=60.0,
+            registry=obs.Registry(),
+        )
+        agg.add_peer("ok", f"127.0.0.1:{two_peers[0].port}")
+        agg.add_peer("hung", f"127.0.0.1:{hung.getsockname()[1]}")
+        agg.add_peer("hung2", f"127.0.0.1:{hung.getsockname()[1]}")
+        t0 = time.monotonic()
+        view = agg.scrape_once()
+        wall = time.monotonic() - t0
+        # concurrent scrape + hard deadline: two hung peers cost ONE
+        # deadline, not two — the round stays inside the interval budget
+        assert wall < 2.0, f"scrape round took {wall:.2f}s"
+        assert view["peers"]["ok"]["state"] == "up"
+        assert view["peers"]["hung"]["state"] in ("stale", "down")
+        assert view["metrics"]["g"]["n"] == 1.0  # healthy merge intact
+    finally:
+        hung.close()
+        netbreaker.reset_breakers()

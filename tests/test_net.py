@@ -1,0 +1,621 @@
+"""Resilient RPC substrate (net/): deadlines, retries, breakers, resume.
+
+Unit-level coverage of ISSUE 13's transport layer — the backoff/jitter
+schedule, deadline propagation through the wire header, the circuit
+breaker state machine — plus sever/delay/drop chaos cases against a real
+loopback ``WorkerServer`` and the dispatcher journal's replay/validation
+contract.  Everything here is thread-based loopback (no OS processes).
+"""
+
+import json
+import os
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedtensorflow_tpu.net import breaker as netbreaker
+from distributedtensorflow_tpu.net import rpc as netrpc
+
+
+@pytest.fixture(autouse=True)
+def _net_isolation():
+    """Breakers and armed chaos faults are process-global: reset around
+    every test so one test's tripped endpoint cannot poison the next."""
+    netbreaker.reset_breakers()
+    netrpc.clear_faults()
+    yield
+    netbreaker.reset_breakers()
+    netrpc.clear_faults()
+
+
+# --- backoff / policy --------------------------------------------------------
+
+
+def test_backoff_schedule_deterministic_and_capped():
+    policy = netrpc.RetryPolicy(backoff_base_s=0.1, backoff_max_s=0.8,
+                                jitter=0.5)
+    a = [netrpc.backoff_s(policy, i, random.Random(7)) for i in range(8)]
+    b = [netrpc.backoff_s(policy, i, random.Random(7)) for i in range(8)]
+    assert a == b  # seeded rng => reproducible schedule
+    for i, d in enumerate(a):
+        base = min(0.1 * 2**i, 0.8)
+        assert 0.5 * base <= d <= 1.5 * base  # jitter stays multiplicative
+    # without jitter the schedule is the pure capped exponential
+    flat = netrpc.RetryPolicy(backoff_base_s=0.1, backoff_max_s=0.8,
+                              jitter=0.0)
+    assert [netrpc.backoff_s(flat, i) for i in range(5)] == [
+        0.1, 0.2, 0.4, 0.8, 0.8
+    ]
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError):
+        netrpc.RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        netrpc.RetryPolicy(jitter=1.0)
+
+
+# --- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_transitions_closed_open_half_open_closed():
+    clock = [0.0]
+    br = netbreaker.CircuitBreaker(
+        "peer:test1", failure_threshold=3, open_for_s=5.0,
+        clock=lambda: clock[0],
+    )
+    assert br.state == "closed"
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()  # open: fail fast, no probe yet
+    clock[0] = 5.1
+    assert br.state == "half_open"
+    assert br.allow()       # exactly one probe...
+    assert not br.allow()   # ...everyone else keeps failing fast
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    clock = [0.0]
+    br = netbreaker.CircuitBreaker(
+        "peer:test2", failure_threshold=1, open_for_s=2.0,
+        clock=lambda: clock[0],
+    )
+    br.record_failure()
+    assert br.state == "open"
+    clock[0] = 2.5
+    assert br.allow()
+    br.record_failure()  # the probe failed
+    assert br.state == "open"
+    assert not br.allow()  # fresh cooldown from the failed probe
+    clock[0] = 4.0
+    assert br.state == "open"
+    clock[0] = 4.6
+    assert br.state == "half_open"
+
+
+def test_breaker_success_resets_failure_streak():
+    br = netbreaker.CircuitBreaker("peer:test3", failure_threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # streak broken
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # CONSECUTIVE failures trip, not total
+
+
+# --- unary call: deadline propagation, retries, deadline exceeded ------------
+
+
+class _EchoServer:
+    """Tiny loopback server speaking the net framing; echoes the request
+    header back.  ``fail_first`` connections are accepted then severed
+    before any response (the transient transport fault)."""
+
+    def __init__(self, fail_first: int = 0, hang: bool = False):
+        self.requests: list[dict] = []
+        self._fail = fail_first
+        self._hang = hang
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.addr = f"127.0.0.1:{self._srv.getsockname()[1]}"
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                if self._fail > 0:
+                    self._fail -= 1
+                    conn.close()
+                    continue
+                req, _ = netrpc.recv_msg(conn)
+                self.requests.append(req)
+                if self._hang:
+                    time.sleep(30)
+                netrpc.send_msg(conn, {"ok": True, "echo": req})
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self._stop = True
+        self._srv.close()
+
+
+def test_deadline_propagates_in_wire_header():
+    srv = _EchoServer()
+    try:
+        resp, _ = netrpc.call(
+            srv.addr, {"kind": "ping"}, endpoint="peer:echo",
+            deadline_s=7.5,
+        )
+        assert resp["ok"]
+        sent = resp["echo"]
+        # the remaining budget rides the frame, and some wall time was
+        # already spent connecting
+        assert 0.0 < sent["deadline_s"] <= 7.5
+        assert netrpc.remaining_from_request(sent) == sent["deadline_s"]
+    finally:
+        srv.close()
+
+
+def test_call_retries_transient_failure_and_counts():
+    srv = _EchoServer(fail_first=2)
+    try:
+        ep = "peer:flaky"
+        before = netrpc._M_RETRIES.value(endpoint=ep, outcome="ok")
+        policy = netrpc.RetryPolicy(deadline_s=10.0, max_attempts=4,
+                                    backoff_base_s=0.01, jitter=0.0)
+        resp, _ = netrpc.call(srv.addr, {"kind": "ping"}, endpoint=ep,
+                              policy=policy)
+        assert resp["ok"]
+        # two severed attempts then a successful retry
+        assert netrpc._M_RETRIES.value(endpoint=ep, outcome="ok") \
+            == before + 1
+        assert netrpc._M_RETRIES.value(endpoint=ep, outcome="error") >= 1
+    finally:
+        srv.close()
+
+
+def test_call_gives_up_after_max_attempts():
+    srv = _EchoServer(fail_first=100)
+    try:
+        policy = netrpc.RetryPolicy(deadline_s=10.0, max_attempts=3,
+                                    backoff_base_s=0.01, jitter=0.0)
+        with pytest.raises((ConnectionError, OSError)):
+            netrpc.call(srv.addr, {"kind": "ping"}, endpoint="peer:dead1",
+                        policy=policy)
+    finally:
+        srv.close()
+
+
+def test_call_deadline_exceeded_on_hung_server():
+    srv = _EchoServer(hang=True)
+    try:
+        ep = "peer:hung"
+        before = netrpc._M_DEADLINE.value(endpoint=ep)
+        t0 = time.monotonic()
+        with pytest.raises(netrpc.DeadlineExceeded):
+            netrpc.call(srv.addr, {"kind": "ping"}, endpoint=ep,
+                        deadline_s=0.4,
+                        policy=netrpc.RetryPolicy(deadline_s=0.4,
+                                                  max_attempts=1))
+        assert time.monotonic() - t0 < 3.0  # the deadline bounded the wait
+        assert netrpc._M_DEADLINE.value(endpoint=ep) == before + 1
+    finally:
+        srv.close()
+
+
+def test_breaker_opens_and_fast_fails_call():
+    ep = "peer:dead2"
+    policy = netrpc.RetryPolicy(deadline_s=5.0, max_attempts=1,
+                                connect_timeout_s=0.2)
+    # a port with no listener: every call fails and feeds the breaker
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{srv.getsockname()[1]}"
+    srv.close()
+    for _ in range(5):
+        with pytest.raises(OSError):
+            netrpc.call(addr, {"kind": "ping"}, endpoint=ep, policy=policy)
+    assert netbreaker.breaker_for(ep).state == "open"
+    t0 = time.monotonic()
+    with pytest.raises(netbreaker.BreakerOpenError):
+        netrpc.call(addr, {"kind": "ping"}, endpoint=ep, policy=policy)
+    assert time.monotonic() - t0 < 0.1  # no socket was touched
+
+
+# --- chaos faults at the net layer ------------------------------------------
+
+
+def test_net_drop_fault_absorbed_and_recovery_fires():
+    srv = _EchoServer()
+    recovered = threading.Event()
+    try:
+        netrpc.arm_fault("net_drop", calls=2, match="peer:chaos1",
+                         on_recovered=recovered.set)
+        policy = netrpc.RetryPolicy(deadline_s=10.0, max_attempts=4,
+                                    backoff_base_s=0.01, jitter=0.0)
+        resp, _ = netrpc.call(srv.addr, {"kind": "ping"},
+                              endpoint="peer:chaos1", policy=policy)
+        assert resp["ok"]  # retries absorbed both drops
+        assert recovered.is_set()  # post-fault success proved recovery
+    finally:
+        srv.close()
+
+
+def test_net_delay_fault_slows_but_succeeds():
+    srv = _EchoServer()
+    try:
+        netrpc.arm_fault("net_delay", calls=1, delay_s=0.2,
+                         match="peer:chaos2")
+        t0 = time.monotonic()
+        resp, _ = netrpc.call(srv.addr, {"kind": "ping"},
+                              endpoint="peer:chaos2")
+        assert resp["ok"]
+        assert time.monotonic() - t0 >= 0.2
+    finally:
+        srv.close()
+
+
+# --- streaming resume against a real loopback WorkerServer -------------------
+
+
+def _tagged_input_fn(n_batches: int):
+    def input_fn(shard_index, num_shards):
+        for k in range(n_batches):
+            yield {"tag": np.full((1,), shard_index * 10000 + k,
+                                  np.int64)}
+    return input_fn
+
+
+@pytest.fixture()
+def data_cluster():
+    from distributedtensorflow_tpu.data import DispatchServer, WorkerServer
+
+    d = DispatchServer(port=0)
+    workers = []
+    try:
+        yield d, workers
+    finally:
+        for w in workers:
+            w.stop()
+        d.stop()
+
+
+def _drain_tags(client):
+    tags = []
+    for batch in client:
+        tags.extend(int(t) for t in batch["tag"])
+    return tags
+
+
+def test_stream_sever_resumes_exactly_once(data_cluster):
+    """The acceptance core: a severed stream reconnects to the SAME
+    worker and the epoch still delivers every batch exactly once — no
+    dispatcher eviction, no loss, no duplicates."""
+    from distributedtensorflow_tpu.data import DataServiceClient, WorkerServer
+
+    d, workers = data_cluster
+    n = 40
+    workers.append(WorkerServer(d.target(), _tagged_input_fn(n), port=0))
+    client = DataServiceClient(
+        d.target(), window=2, adaptive_window=False,
+        progress_interval_s=0.2, get_next_timeout_s=30.0,
+    )
+    dropped_before = client._m_dropped.value()
+    tags = []
+    for _ in range(5):
+        tags.extend(int(t) for t in next(client)["tag"])
+    severed = netrpc.sever_streams("data_worker")
+    assert severed >= 1
+    tags.extend(_drain_tags(client))
+    client.close()
+    assert sorted(tags) == list(range(n))       # nothing lost
+    assert len(tags) == len(set(tags)) == n     # nothing duplicated
+    assert client._m_dropped.value() == dropped_before  # no eviction
+    assert client._m_resumes.value() >= 1
+
+
+def test_repeated_sever_still_exactly_once(data_cluster):
+    from distributedtensorflow_tpu.data import DataServiceClient, WorkerServer
+
+    d, workers = data_cluster
+    n = 60
+    workers.append(WorkerServer(d.target(), _tagged_input_fn(n), port=0))
+    client = DataServiceClient(
+        d.target(), window=3, adaptive_window=False,
+        stream_retries=4, get_next_timeout_s=30.0,
+    )
+    tags = []
+    for burst in range(3):
+        for _ in range(5):
+            tags.extend(int(t) for t in next(client)["tag"])
+        netrpc.sever_streams("data_worker")
+    tags.extend(_drain_tags(client))
+    client.close()
+    assert sorted(tags) == list(range(n))
+    assert len(tags) == n
+
+
+def test_worker_death_still_evicts_after_retry_budget(data_cluster):
+    """Bounded resume must DEGRADE to elastic eviction: a worker that is
+    genuinely dead (not just a severed wire) exhausts the same-worker
+    budget and the dispatcher reshards its split to a survivor."""
+    from distributedtensorflow_tpu.data import DataServiceClient, WorkerServer
+
+    d, workers = data_cluster
+    n = 30
+    w0 = WorkerServer(d.target(), _tagged_input_fn(n), port=0)
+    w1 = WorkerServer(d.target(), _tagged_input_fn(n), port=0)
+    workers.append(w1)
+    client = DataServiceClient(
+        d.target(), window=2, adaptive_window=False, stream_retries=1,
+        get_next_timeout_s=60.0,
+    )
+    tags = []
+    for _ in range(4):
+        tags.extend(int(t) for t in next(client)["tag"])
+    w0.kill()  # crash, not a clean stop: streams sever mid-flight
+    tags.extend(_drain_tags(client))
+    client.close()
+    # both shards' full ranges delivered exactly once despite the death
+    expected = sorted(list(range(n)) + [10000 + k for k in range(n)])
+    assert sorted(tags) == expected
+    assert client._m_dropped.value() >= 1  # the dead worker WAS evicted
+
+
+# --- dispatcher journal ------------------------------------------------------
+
+
+def test_dispatcher_restart_replays_journal(tmp_path):
+    from distributedtensorflow_tpu.data import DispatchServer
+
+    jp = os.path.join(tmp_path, "dispatcher.journal")
+    d = DispatchServer(port=0, journal_path=jp)
+    try:
+        for fake in ("127.0.0.1:1011", "127.0.0.1:1012"):
+            resp, _ = netrpc.call(d.target(),
+                                  {"kind": "register_worker", "addr": fake})
+            assert resp["ok"]
+        resp, _ = netrpc.call(d.target(), {"kind": "start_epoch",
+                                           "epoch": "7"})
+        assert resp["ok"] and resp["gen"] == 0
+        # the client's periodic progress report lands in the journal
+        resp, _ = netrpc.call(d.target(), {
+            "kind": "report_progress", "epoch": "7", "client": "c0",
+            "received": {"0": 9, "1": 3},
+        })
+        assert resp["ok"]
+    finally:
+        d.kill()  # simulated crash: no clean journal close
+
+    d2 = DispatchServer(port=0, journal_path=jp)
+    try:
+        resp, _ = netrpc.call(d2.target(), {"kind": "get_assignments",
+                                            "epoch": "7"})
+        assert resp["ok"], "epoch state must survive the restart"
+        assert resp["num_shards"] == 2
+        # a re-registering worker keeps its shard (no epoch retirement)
+        resp, _ = netrpc.call(d2.target(), {"kind": "register_worker",
+                                            "addr": "127.0.0.1:1012"})
+        assert resp["shard"] == 1
+        # a failure report WITHOUT a count falls back to the journaled
+        # progress, preserving exactly-once across the restart
+        resp, _ = netrpc.call(d2.target(), {
+            "kind": "report_worker_failure", "epoch": "7",
+            "addr": "127.0.0.1:1011",
+        })
+        assert resp["ok"] and resp["gen"] == 1
+        assert resp["splits"]["0"]["skip"] == 9
+        assert resp["splits"]["0"]["addr"] == "127.0.0.1:1012"
+    finally:
+        d2.stop()
+
+    # the journal is one continuous, checker-clean audit trail
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_metrics_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    errors, _warnings = checker.check_journal_file(jp)
+    assert errors == [], errors
+    kinds = [json.loads(ln)["kind"] for ln in open(jp) if ln.strip()]
+    assert kinds[0] == "open"
+    assert "replay" in kinds and "reshard" in kinds
+    assert "client_progress" in kinds
+
+
+def test_journal_checker_rejects_corruption(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_metrics_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+
+    bad = os.path.join(tmp_path, "dispatcher.journal")
+    rows = [
+        {"seq": 0, "t": 1.0, "kind": "open"},
+        # reshard before its epoch_start: replay-unsafe
+        {"seq": 1, "t": 2.0, "kind": "reshard", "epoch": "0", "gen": 1,
+         "splits": {}},
+        {"seq": 1, "t": 3.0, "kind": "epoch_start", "epoch": "0",
+         "gen": 0, "splits": {}},  # seq does not increase
+        {"seq": 3, "t": 4.0, "kind": "bogus_kind"},
+        # gen must strictly increase per epoch
+        {"seq": 4, "t": 5.0, "kind": "reshard", "epoch": "0", "gen": 0,
+         "splits": {}},
+    ]
+    with open(bad, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    errors, _ = checker.check_journal_file(bad)
+    text = "\n".join(errors)
+    assert "precedes its epoch_start" in text
+    assert "does not increase" in text
+    assert "bogus_kind" in text
+    assert "reshard gen 0 does not increase" in text
+
+    # a torn final line is the one tolerated corruption
+    torn = os.path.join(tmp_path, "dispatcher_torn.journal")
+    with open(torn, "w") as f:
+        f.write(json.dumps(rows[0]) + "\n")
+        f.write('{"seq": 1, "t": 2.0, "ki')
+    errors, warnings = checker.check_journal_file(torn)
+    assert errors == []
+    assert any("torn final line" in w for w in warnings)
+
+
+def test_journal_replay_tolerates_torn_tail(tmp_path):
+    from distributedtensorflow_tpu.data.service import DispatcherJournal
+
+    jp = os.path.join(tmp_path, "j.journal")
+    j = DispatcherJournal(jp)
+    j.append("open")
+    j.append("worker_register", addr="a:1", shard=0)
+    j.close()
+    with open(jp, "a") as f:
+        f.write('{"seq": 2, "t": 1.0, "kind": "worker_reg')  # torn append
+    records, torn = DispatcherJournal.replay(jp)
+    assert torn
+    assert [r["kind"] for r in records] == ["open", "worker_register"]
+    # a new journal TRUNCATES the torn fragment before appending, so the
+    # post-crash append cannot concatenate onto it and corrupt the file
+    # mid-line — the continued journal replays clean end to end
+    j2 = DispatcherJournal(jp)
+    j2.append("worker_deregister", addr="a:1")
+    j2.close()
+    records, torn = DispatcherJournal.replay(jp)
+    assert not torn
+    assert [r["kind"] for r in records] == [
+        "open", "worker_register", "worker_deregister"
+    ]
+    assert [r["seq"] for r in records] == [0, 1, 2]
+
+
+def test_worker_refuses_stale_stream_frames(data_cluster):
+    """A severed stream's leftover pipelined frames (old sid, LOWER rid)
+    must be refused, never allowed to steal the slot back from the live
+    resume stream and rewind the iterator into duplicates."""
+    from distributedtensorflow_tpu.data import WorkerServer
+    from distributedtensorflow_tpu.data.service import decode_batch
+
+    d, workers = data_cluster
+    w = WorkerServer(d.target(), _tagged_input_fn(20), port=0)
+    workers.append(w)
+
+    def stream_req(sid, rid, skip):
+        return {"kind": "get_next", "epoch": "0", "split": 0,
+                "num_shards": 1, "skip": skip, "gen": 0, "wire": "raw",
+                "sid": sid, "rid": rid}
+
+    def pull(sock, req):
+        netrpc.send_msg(sock, req)
+        return netrpc.recv_msg(sock)
+
+    host, port = w.addr.rsplit(":", 1)
+    s1 = socket.create_connection((host, int(port)), timeout=10)
+    s2 = socket.create_connection((host, int(port)), timeout=10)
+    s3 = socket.create_connection((host, int(port)), timeout=10)
+    try:
+        # stream 1 (rid 1) serves batches 0 and 1
+        for expect in (0, 1):
+            header, data = pull(s1, stream_req("A", 1, 0))
+            assert header["ok"]
+            assert int(decode_batch(data)["tag"][0]) == expect
+        # the resume stream (rid 2) takes over from the client's count
+        header, data = pull(s2, stream_req("B", 2, 2))
+        assert header["ok"]
+        assert int(decode_batch(data)["tag"][0]) == 2
+        # a leftover frame of the dead stream 1 arrives late: refused
+        header, _ = pull(s3, stream_req("A", 1, 0))
+        assert not header["ok"]
+        assert "stale resume token" in header["error"]
+        # and the live stream is untouched: next batch is 3, not 1
+        header, data = pull(s2, stream_req("B", 2, 2))
+        assert header["ok"]
+        assert int(decode_batch(data)["tag"][0]) == 3
+    finally:
+        for s in (s1, s2, s3):
+            s.close()
+
+
+def test_breaker_cycle_on_dispatcher_kill_restart(tmp_path):
+    """The smoke's breaker contract in miniature: kill the dispatcher,
+    probe it open, restart from the journal on the SAME port, probe it
+    closed — open -> half_open -> closed all visible in the transition
+    counter."""
+    from distributedtensorflow_tpu.data import DispatchServer
+    from distributedtensorflow_tpu.net.breaker import _M_TRANSITIONS
+
+    jp = os.path.join(tmp_path, "dispatcher.journal")
+    d = DispatchServer(port=0, journal_path=jp)
+    port = d.port
+    target = d.target()
+    ep = f"dispatcher:{target}"
+    netrpc.call(target, {"kind": "register_worker", "addr": "x:1"},
+                endpoint=ep)
+    d.kill()
+    probe = netrpc.RetryPolicy(deadline_s=0.3, max_attempts=1,
+                               connect_timeout_s=0.2)
+    br = netbreaker.breaker_for(ep)
+    deadline = time.monotonic() + 10
+    while br.state != "open" and time.monotonic() < deadline:
+        with pytest.raises(OSError):
+            netrpc.call(target, {"kind": "get_workers"}, endpoint=ep,
+                        policy=probe)
+    assert br.state == "open"
+    d2 = None
+    restart_deadline = time.monotonic() + 10
+    while d2 is None and time.monotonic() < restart_deadline:
+        try:
+            d2 = DispatchServer(port=port, journal_path=jp)
+        except OSError:
+            time.sleep(0.2)
+    assert d2 is not None, "same-port restart failed"
+    try:
+        ok = False
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                resp, _ = netrpc.call(target, {"kind": "get_workers"},
+                                      endpoint=ep, policy=probe)
+            except OSError:
+                time.sleep(0.1)
+                continue
+            ok = resp.get("ok", False)
+            break
+        assert ok
+        assert br.state == "closed"
+        for to in ("open", "half_open", "closed"):
+            assert _M_TRANSITIONS.value(endpoint=ep, to=to) >= 1
+        # the replayed dispatcher still knows its worker
+        resp, _ = netrpc.call(target, {"kind": "get_workers"}, endpoint=ep)
+        assert "x:1" in resp["workers"]
+    finally:
+        d2.stop()

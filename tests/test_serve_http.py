@@ -277,3 +277,46 @@ def test_status_server_extra_routes_unit():
         assert json.loads(body)["ok"] is True  # builtin won, not get_route
     finally:
         srv.stop()
+
+
+def test_drain_refuses_new_submits_with_503(frontend):
+    """ISSUE 13 satellite: begin_drain() refuses NEW submits with 503
+    immediately while the rest of the endpoint family stays up (in-flight
+    responses still need the server)."""
+    server, engine, prompt = frontend
+    status, body = _post(server.port, "/generatez",
+                         {"prompt": prompt, "max_new_tokens": 2})
+    assert status == 200
+    server.begin_drain()
+    status, body = _post(server.port, "/generatez",
+                         {"prompt": prompt, "max_new_tokens": 2})
+    assert status == 503
+    assert "draining" in body["error"]
+    status, _ = _get(server.port, "/generatez")
+    assert status == 200  # state introspection survives the drain
+
+
+def test_queued_past_deadline_abandoned_server_side(served_model):
+    """The per-request deadline is honored END TO END: a request whose
+    deadline expires while it is still queued behind a busy slot is
+    abandoned at admission (504, engine-side error), not decoded for a
+    client that already gave up."""
+    cfg, params, prompt = served_model
+    engine = Engine(params, cfg, max_slots=1, max_queue=8, block_size=4,
+                    prefill_chunk=4, max_context=64)
+    try:
+        # no loop running: submit queues; drive the scheduler by hand
+        blocker = engine.submit(prompt, max_new_tokens=8)
+        doomed = engine.submit(prompt, max_new_tokens=2, deadline_s=0.05)
+        time.sleep(0.1)  # the doomed request's deadline passes in queue
+        for _ in range(40):
+            engine.step()
+            if blocker.wait(0) and doomed.wait(0):
+                break
+        assert blocker.status == "ok"
+        assert doomed.status == "error"
+        assert doomed.deadline_exceeded
+        assert "deadline" in doomed.error
+        assert doomed.tokens == []  # never decoded
+    finally:
+        engine.stop(drain=False)

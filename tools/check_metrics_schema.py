@@ -36,7 +36,13 @@ collective set — see :data:`COLLECTIVE_OPS` — ``overlapped`` labels to
 :data:`PREFETCH_COMPONENTS` / :data:`PREFETCH_DIRECTIONS`, the fleet
 ``fleet_peers`` ``state`` label to :data:`FLEET_PEER_STATES`, and
 ``slo_burn_rate`` samples to a known ``window`` label with a
-non-negative value); everything else against the metric-row schema
+non-negative value, and the resilient-transport ``rpc_*`` /
+``breaker_*`` families to known endpoint prefixes / retry outcomes /
+breaker-state encodings); basenames starting with ``dispatcher`` and
+ending ``.journal`` against the dispatcher durability-journal schema
+(``data/service.py``: strictly-increasing ``seq``, known record kinds,
+per-epoch monotonic generations, replay-safe ordering, a torn final
+line tolerated); everything else against the metric-row schema
 (where ``quant_mode`` is the one string-typed field, from
 :data:`QUANT_MODES`; the input-plane/fleet/slo label checks apply to the
 jsonl-flattened field names too).
@@ -72,7 +78,9 @@ The faults schema (docs/API.md "Self-healing & fault injection"): every
 row of a ``faults.jsonl`` chaos log is one JSON object with finite
 non-decreasing ``t``, non-negative integer ``id`` and ``step``, ``kind``
 from the known fault set (``nan_loss`` / ``checkpoint_truncate`` /
-``worker_kill`` / ``data_stall`` / ``preemption``), and ``phase``
+``worker_kill`` / ``data_stall`` / ``preemption`` plus the
+transport-recovered ``net_delay`` / ``net_drop`` / ``net_sever`` /
+``dispatcher_kill``), and ``phase``
 ``injected`` or ``recovered``; injected ``id``s strictly increase with
 non-decreasing ``step``s, every recovered row must reference an earlier
 injected ``id`` of the same kind, and every injected fault must be paired
@@ -121,6 +129,14 @@ _FLAT_WINDOW_RE = re.compile(r"\.window_([A-Za-z0-9_]+?)(?=\.|$)")
 #: jsonl-flattened ``stage`` label of the pipeline handoff/stall
 #: histograms (parallel/pipeline_mpmd.py).
 _FLAT_STAGE_RE = re.compile(r"\.stage_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``endpoint`` label of the ``rpc_*`` / ``breaker_*``
+#: families (net/rpc.py, net/breaker.py).  Endpoint identities embed
+#: addresses, so ``:`` is a legal value character.
+_FLAT_ENDPOINT_RE = re.compile(r"\.endpoint_([A-Za-z0-9_:]+?)(?=\.|$)")
+#: jsonl-flattened ``outcome`` label of ``rpc_retries_total``.
+_FLAT_OUTCOME_RE = re.compile(r"\.outcome_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``to`` label of ``breaker_transitions_total``.
+_FLAT_TO_RE = re.compile(r"\.to_([A-Za-z0-9_]+?)(?=\.|$)")
 
 #: One Prometheus exposition sample: name, optional {labels}, value.
 _PROM_SAMPLE_RE = re.compile(
@@ -160,6 +176,9 @@ DEFAULT_FLEET_GLOB = os.path.join(
 DEFAULT_TIMELINE_GLOB = os.path.join(
     REPO, "ARTIFACTS", "*", "timeline*.json"
 )
+DEFAULT_JOURNAL_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "dispatcher*.journal"
+)
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
 #: duplicated: this tool is stdlib-only and must run anywhere logs land).
@@ -177,12 +196,43 @@ CAPTURE_TRIGGERS = (
 )
 
 #: The known chaos fault kinds (resilience/chaos.py FAULT_KINDS —
-#: duplicated for the same stdlib-only reason).
+#: duplicated for the same stdlib-only reason; the ``net_*`` /
+#: ``dispatcher_kill`` kinds are transport-recovered, ISSUE 13).
 FAULT_KINDS = (
     "nan_loss", "checkpoint_truncate", "worker_kill", "data_stall",
     "preemption",
+    "net_delay", "net_drop", "net_sever", "dispatcher_kill",
 )
 FAULT_PHASES = ("injected", "recovered")
+
+#: Resilient-transport label sets (net/rpc.py, net/breaker.py —
+#: duplicated for the same stdlib-only reason).  Endpoint identities are
+#: "<prefix>" or "<prefix>:<detail>"; the prefix names the transport.
+RPC_ENDPOINT_PREFIXES = (
+    "dispatcher", "data_worker", "mpmd_link", "fleet_peer", "serve",
+    "peer",
+)
+RPC_RETRY_OUTCOMES = ("ok", "error")
+BREAKER_TO_STATES = ("closed", "half_open", "open")
+
+#: Dispatcher journal record kinds (data/service.py JOURNAL_KINDS —
+#: duplicated for the same stdlib-only reason).
+JOURNAL_KINDS = (
+    "open", "replay", "worker_register", "worker_deregister",
+    "epoch_start", "reshard", "client_progress",
+)
+
+
+def _check_endpoint_value(value: str) -> str | None:
+    """None when ``value`` is a well-formed endpoint identity, else the
+    complaint."""
+    if not value:
+        return "is empty"
+    prefix = value.split(":", 1)[0]
+    if prefix not in RPC_ENDPOINT_PREFIXES:
+        return (f"has unknown endpoint prefix {prefix!r} "
+                f"(known: {RPC_ENDPOINT_PREFIXES})")
+    return None
 
 #: Terminal request states + finish reasons (serve/engine.py — duplicated
 #: for the same stdlib-only reason).
@@ -295,6 +345,33 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                     f"line {lineno}: field {k!r} carries unknown fleet "
                     f"peer state {m.group(1)!r} "
                     f"(known: {FLEET_PEER_STATES})"
+                )
+        if k.startswith(("rpc_retries_total", "rpc_deadline_exceeded_total",
+                         "rpc_attempt_seconds", "breaker_state",
+                         "breaker_transitions_total")):
+            m = _FLAT_ENDPOINT_RE.search(k)
+            if m:
+                bad = _check_endpoint_value(m.group(1))
+                if bad:
+                    errors.append(f"line {lineno}: field {k!r} {bad}")
+            m = _FLAT_OUTCOME_RE.search(k)
+            if m and m.group(1) not in RPC_RETRY_OUTCOMES:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown rpc "
+                    f"retry outcome {m.group(1)!r} "
+                    f"(known: {RPC_RETRY_OUTCOMES})"
+                )
+            m = _FLAT_TO_RE.search(k)
+            if m and m.group(1) not in BREAKER_TO_STATES:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown breaker "
+                    f"state {m.group(1)!r} (known: {BREAKER_TO_STATES})"
+                )
+            if k.startswith("breaker_state") and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool) and v not in (0, 1, 2):
+                errors.append(
+                    f"line {lineno}: field {k!r} value {v!r} is not a "
+                    "breaker state encoding (0=closed, 1=half_open, 2=open)"
                 )
         if k.startswith("slo_burn_rate"):
             m = _FLAT_WINDOW_RE.search(k)
@@ -602,6 +679,125 @@ def check_faults_file(path: str) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
+def check_journal_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate one ``dispatcher.journal`` durability log
+    (``data/service.py`` DispatcherJournal): every line one JSON object
+    with a strictly-increasing integer ``seq``, non-decreasing finite
+    ``t``, a ``kind`` from :data:`JOURNAL_KINDS`, and replay-safe
+    ordering — an epoch's ``epoch_start`` (gen 0) precedes any of its
+    ``reshard`` / ``client_progress`` records, reshard generations
+    strictly increase per epoch, and worker registrations carry an
+    address + non-negative shard.  A torn FINAL line is tolerated (the
+    one legal partial append); torn lines elsewhere are errors."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    prev_seq: int | None = None
+    prev_t: float | None = None
+    epoch_gens: dict[str, int] = {}
+    with open(path) as f:
+        lines = f.read().split("\n")
+    n_lines = len([ln for ln in lines if ln.strip()])
+    seen = 0
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        seen += 1
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            if seen == n_lines:
+                warnings.append(f"line {i}: torn final line dropped "
+                                "(interrupted append)")
+            else:
+                errors.append(f"line {i}: invalid JSON ({e})")
+            continue
+        if not isinstance(row, dict):
+            errors.append(f"line {i}: record is {type(row).__name__}, "
+                          "not an object")
+            continue
+        seq = row.get("seq")
+        if not _nonneg_int(seq):
+            errors.append(f"line {i}: 'seq' {seq!r} is not a non-negative "
+                          "integer")
+        else:
+            seq = int(seq)
+            if prev_seq is not None and seq <= prev_seq:
+                errors.append(f"line {i}: 'seq' {seq} does not increase "
+                              f"(previous {prev_seq})")
+            prev_seq = seq if prev_seq is None else max(prev_seq, seq)
+        t = row.get("t")
+        if isinstance(t, bool) or not isinstance(t, (int, float)) \
+                or not math.isfinite(t):
+            errors.append(f"line {i}: 't' {t!r} is not a finite number")
+        else:
+            if prev_t is not None and t < prev_t:
+                errors.append(f"line {i}: 't' {t} decreases")
+            prev_t = float(t)
+        kind = row.get("kind")
+        if kind not in JOURNAL_KINDS:
+            errors.append(f"line {i}: 'kind' {kind!r} not in "
+                          f"{JOURNAL_KINDS}")
+            continue
+        if kind == "worker_register":
+            if not isinstance(row.get("addr"), str) or not row["addr"]:
+                errors.append(f"line {i}: worker_register 'addr' "
+                              f"{row.get('addr')!r} is not a non-empty "
+                              "string")
+            if not _nonneg_int(row.get("shard")):
+                errors.append(f"line {i}: worker_register 'shard' "
+                              f"{row.get('shard')!r} is not a "
+                              "non-negative integer")
+        elif kind == "epoch_start":
+            epoch = str(row.get("epoch"))
+            if row.get("gen") != 0:
+                errors.append(f"line {i}: epoch_start 'gen' "
+                              f"{row.get('gen')!r} must be 0")
+            if not isinstance(row.get("splits"), dict):
+                errors.append(f"line {i}: epoch_start 'splits' is not an "
+                              "object")
+            if epoch in epoch_gens:
+                errors.append(f"line {i}: epoch {epoch!r} started twice")
+            epoch_gens[epoch] = 0
+        elif kind == "reshard":
+            epoch = str(row.get("epoch"))
+            gen = row.get("gen")
+            if epoch not in epoch_gens:
+                errors.append(f"line {i}: reshard for epoch {epoch!r} "
+                              "precedes its epoch_start (replay-unsafe "
+                              "ordering)")
+            elif not _nonneg_int(gen):
+                errors.append(f"line {i}: reshard 'gen' {gen!r} is not a "
+                              "non-negative integer")
+            elif int(gen) <= epoch_gens[epoch]:
+                errors.append(
+                    f"line {i}: reshard gen {int(gen)} does not increase "
+                    f"for epoch {epoch!r} (previous {epoch_gens[epoch]})"
+                )
+            else:
+                epoch_gens[epoch] = int(gen)
+            if not isinstance(row.get("splits"), dict):
+                errors.append(f"line {i}: reshard 'splits' is not an "
+                              "object")
+        elif kind == "client_progress":
+            epoch = str(row.get("epoch"))
+            if epoch not in epoch_gens:
+                errors.append(f"line {i}: client_progress for epoch "
+                              f"{epoch!r} precedes its epoch_start")
+            received = row.get("received")
+            if not isinstance(received, dict):
+                errors.append(f"line {i}: client_progress 'received' is "
+                              "not an object")
+            else:
+                for s, n in received.items():
+                    if not _nonneg_int(n):
+                        errors.append(
+                            f"line {i}: client_progress received[{s!r}] "
+                            f"{n!r} is not a non-negative integer"
+                        )
+    return errors, warnings
+
+
 def check_requests_file(path: str) -> tuple[list[str], list[str]]:
     """Validate one serving ``requests.jsonl`` log (docs/API.md
     "Serving"): every row is one JSON object with finite non-decreasing
@@ -840,6 +1036,45 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                         f"line {i}: {name} carries non-numeric stage "
                         f"label {stage!r}"
                     )
+            if name.startswith(("rpc_retries_total",
+                                "rpc_deadline_exceeded_total",
+                                "rpc_attempt_seconds", "breaker_state",
+                                "breaker_transitions_total")):
+                labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
+                ep = labels.get("endpoint")
+                if ep is None:
+                    errors.append(
+                        f"line {i}: {name} sample is missing the "
+                        "'endpoint' label"
+                    )
+                else:
+                    bad = _check_endpoint_value(ep)
+                    if bad:
+                        errors.append(f"line {i}: {name} endpoint {bad}")
+                outcome = labels.get("outcome")
+                if name.startswith("rpc_retries_total") \
+                        and outcome not in RPC_RETRY_OUTCOMES:
+                    errors.append(
+                        f"line {i}: {name} carries unknown retry outcome "
+                        f"{outcome!r} (known: {RPC_RETRY_OUTCOMES})"
+                    )
+                to = labels.get("to")
+                if name.startswith("breaker_transitions_total") \
+                        and to not in BREAKER_TO_STATES:
+                    errors.append(
+                        f"line {i}: {name} carries unknown breaker state "
+                        f"{to!r} (known: {BREAKER_TO_STATES})"
+                    )
+                if name == "breaker_state":
+                    try:
+                        if float(value) not in (0.0, 1.0, 2.0):
+                            errors.append(
+                                f"line {i}: breaker_state value {value!r} "
+                                "is not a state encoding (0=closed, "
+                                "1=half_open, 2=open)"
+                            )
+                    except ValueError:
+                        pass  # already reported above
             if name == "slo_burn_rate":
                 labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
                 window = labels.get("window")
@@ -1161,6 +1396,9 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         return check_flash_cache_doc(doc)
     if os.path.basename(path).startswith("faults"):
         return check_faults_file(path)
+    if os.path.basename(path).startswith("dispatcher") \
+            and path.endswith(".journal"):
+        return check_journal_file(path)
     if path.endswith(".prom"):
         return check_prom_file(path)
     if os.path.basename(path).startswith("requests"):
@@ -1202,6 +1440,7 @@ def main(argv: list[str] | None = None) -> int:
         + glob.glob(DEFAULT_PROM_GLOB) + glob.glob(DEFAULT_FLASH_GLOB)
         + glob.glob(DEFAULT_SLO_GLOB) + glob.glob(DEFAULT_FLEET_GLOB)
         + glob.glob(DEFAULT_TIMELINE_GLOB)
+        + glob.glob(DEFAULT_JOURNAL_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

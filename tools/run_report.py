@@ -405,6 +405,119 @@ def step_time_opt_summary(train: list[dict], logdir: str) -> dict:
     return out
 
 
+_RPC_RETRY_RE = re.compile(
+    r"^rpc_retries_total\.endpoint_(?P<ep>[A-Za-z0-9_:]+)"
+    r"\.outcome_(?P<outcome>[a-z_]+)$"
+)
+_RPC_DEADLINE_RE = re.compile(
+    r"^rpc_deadline_exceeded_total\.endpoint_(?P<ep>[A-Za-z0-9_:]+)$"
+)
+_RPC_ATTEMPT_COUNT_RE = re.compile(
+    r"^rpc_attempt_seconds_count\.endpoint_(?P<ep>[A-Za-z0-9_:]+)$"
+)
+_BREAKER_STATE_RE = re.compile(
+    r"^breaker_state\.endpoint_(?P<ep>[A-Za-z0-9_:]+)$"
+)
+_BREAKER_TRANS_RE = re.compile(
+    r"^breaker_transitions_total\.endpoint_(?P<ep>[A-Za-z0-9_:]+)"
+    r"\.to_(?P<to>[a-z_]+)$"
+)
+_BREAKER_STATE_NAMES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+
+
+def rpc_summary(train: list[dict], logdir: str) -> tuple[dict, int]:
+    """``(rpc digest, parse errors)``: resilient-transport behavior from
+    the last metric record's flattened ``rpc_*`` / ``breaker_*`` fields
+    (retries + deadline misses + attempts by endpoint, breaker states
+    and trip counts, same-worker stream resumes) plus a replay summary
+    of ``<logdir>/dispatcher.journal`` when one exists — journal parse
+    errors gate the exit code like every other stream."""
+    last: dict = {}
+    for r in train:
+        if any(k.startswith(("rpc_", "breaker_")) for k in r):
+            last = r
+    out: dict = {}
+    bad = 0
+    endpoints: dict[str, dict] = {}
+    for k, v in last.items():
+        if not isinstance(v, (int, float)):
+            continue
+        m = _RPC_RETRY_RE.match(k)
+        if m:
+            d = endpoints.setdefault(m.group("ep"), {})
+            d[f"retries_{m.group('outcome')}"] = int(v)
+        m = _RPC_DEADLINE_RE.match(k)
+        if m:
+            endpoints.setdefault(m.group("ep"), {})["deadline_misses"] = \
+                int(v)
+        m = _RPC_ATTEMPT_COUNT_RE.match(k)
+        if m:
+            endpoints.setdefault(m.group("ep"), {})["attempts"] = int(v)
+        m = _BREAKER_STATE_RE.match(k)
+        if m:
+            endpoints.setdefault(m.group("ep"), {})["breaker"] = \
+                _BREAKER_STATE_NAMES.get(float(v), f"?{v}")
+        m = _BREAKER_TRANS_RE.match(k)
+        if m:
+            d = endpoints.setdefault(m.group("ep"), {})
+            d[f"breaker_to_{m.group('to')}"] = int(v)
+    if endpoints:
+        out["endpoints"] = dict(sorted(endpoints.items()))
+        out["retries_total"] = sum(
+            d.get("retries_ok", 0) + d.get("retries_error", 0)
+            for d in endpoints.values()
+        )
+        out["deadline_misses_total"] = sum(
+            d.get("deadline_misses", 0) for d in endpoints.values()
+        )
+        out["breaker_trips_total"] = sum(
+            d.get("breaker_to_open", 0) for d in endpoints.values()
+        )
+    if isinstance(last.get("data_service_stream_resumes_total"),
+                  (int, float)):
+        out["stream_resumes"] = int(
+            last["data_service_stream_resumes_total"]
+        )
+    journal_path = os.path.join(logdir, "dispatcher.journal")
+    if os.path.exists(journal_path):
+        by_kind: dict[str, int] = {}
+        epochs: dict[str, int] = {}
+        replays = 0
+        lines = open(journal_path).read().split("\n")
+        n_lines = len([ln for ln in lines if ln.strip()])
+        seen = 0
+        for ln in lines:
+            ln = ln.strip()
+            if not ln:
+                continue
+            seen += 1
+            try:
+                row = json.loads(ln)
+            except json.JSONDecodeError:
+                if seen == n_lines:
+                    continue  # torn final line: the one legal tear
+                print(f"{journal_path}: corrupt journal line",
+                      file=sys.stderr)
+                bad += 1
+                continue
+            if not isinstance(row, dict):
+                bad += 1
+                continue
+            kind = str(row.get("kind", "?"))
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+            if kind == "replay":
+                replays += 1
+            elif kind in ("epoch_start", "reshard"):
+                epochs[str(row.get("epoch"))] = int(row.get("gen", 0))
+        out["journal"] = {
+            "records": sum(by_kind.values()),
+            "by_kind": dict(sorted(by_kind.items())),
+            "replays": replays,
+            "epochs": epochs,
+        }
+    return out, bad
+
+
 _WORKER_COUNT_RE = re.compile(
     r"^data_service_fetch_seconds_count\.worker_(.+)$"
 )
@@ -677,6 +790,7 @@ def build_report(logdir: str) -> dict:
     goodput, bad_goodput = load_goodput(logdir)
     train, evals = split_rows(rows)
     fleet, bad_fleet = fleet_summary(logdir, train, trace, flight)
+    rpc, bad_journal = rpc_summary(train, logdir)
 
     times, source = step_times(train, trace)
     times_sorted = sorted(times)
@@ -712,12 +826,14 @@ def build_report(logdir: str) -> dict:
         "resilience": resilience_summary(faults, flight, goodput),
         "serving": serving_summary(requests),
         "fleet": fleet,
+        "rpc": rpc,
         # metric-stream health: any unparseable metrics.jsonl / trace /
         # captures / faults / requests line (or an unreadable
-        # goodput.json / fleet.json) makes main() exit non-zero (CI gate)
+        # goodput.json / fleet.json / dispatcher.journal) makes main()
+        # exit non-zero (CI gate)
         "parse_errors": (bad_metrics + bad_trace + bad_goodput
                          + bad_captures + bad_faults + bad_requests
-                         + bad_fleet),
+                         + bad_fleet + bad_journal),
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -944,6 +1060,46 @@ def render(report: dict) -> str:
                     f"{v.get('burn')}x (limit {v.get('limit')}x, "
                     f"{v.get('metric')})"
                 )
+    rpc = report.get("rpc")
+    if rpc:
+        parts = []
+        if "retries_total" in rpc:
+            parts.append(f"{rpc['retries_total']} retried attempt(s)")
+        if rpc.get("deadline_misses_total"):
+            parts.append(f"{rpc['deadline_misses_total']} deadline "
+                         "miss(es)")
+        if rpc.get("breaker_trips_total"):
+            parts.append(f"{rpc['breaker_trips_total']} breaker trip(s)")
+        if rpc.get("stream_resumes"):
+            parts.append(f"{rpc['stream_resumes']} stream resume(s)")
+        lines += ["", "rpc: " + (", ".join(parts) or "telemetry only")]
+        for ep, d in (rpc.get("endpoints") or {}).items():
+            bits = [f"attempts {d.get('attempts', 0)}"]
+            retries = d.get("retries_ok", 0) + d.get("retries_error", 0)
+            if retries:
+                bits.append(f"retries {retries} "
+                            f"(ok {d.get('retries_ok', 0)} / err "
+                            f"{d.get('retries_error', 0)})")
+            if d.get("deadline_misses"):
+                bits.append(f"deadline misses {d['deadline_misses']}")
+            if "breaker" in d:
+                cyc = "".join(
+                    f" {to}x{d[f'breaker_to_{to}']}"
+                    for to in ("open", "half_open", "closed")
+                    if d.get(f"breaker_to_{to}")
+                )
+                bits.append(f"breaker {d['breaker']}"
+                            + (f" (transitions:{cyc})" if cyc else ""))
+            lines.append(f"  {ep}: " + "  ".join(bits))
+        j = rpc.get("journal")
+        if j:
+            kinds = ", ".join(f"{k} x{v}" for k, v in j["by_kind"].items())
+            lines.append(
+                f"  dispatcher journal: {j['records']} record(s) "
+                f"({kinds}), {j['replays']} replay(s)"
+            )
+            for epoch, gen in sorted(j["epochs"].items()):
+                lines.append(f"    epoch {epoch}: generation {gen}")
     sto = report.get("step_time_opt")
     if sto:
         parts = []
@@ -1089,7 +1245,7 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"run_report: {report['parse_errors']} unparseable telemetry "
             "entries (metrics/trace/captures/faults/requests/goodput/"
-            "fleet)", file=sys.stderr,
+            "fleet/dispatcher-journal)", file=sys.stderr,
         )
         return 1
     if not (report["rows"]["train"] or report["rows"]["eval"]):

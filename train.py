@@ -1158,7 +1158,15 @@ def main() -> None:
             # per-host batches.
             return wl.input_fn(ctx, args.seed + 1009 * (split + 1))
 
-        _dispatch = DispatchServer(port=0)
+        # Durable dispatcher state: with a logdir, every control-plane
+        # mutation (worker registration, epoch start, reshard, client
+        # progress) is journaled and replayed on restart — a dispatcher
+        # crash mid-epoch no longer orphans the fetchers.
+        _ds_journal = (
+            os.path.join(args.logdir, "dispatcher.journal")
+            if args.logdir else None
+        )
+        _dispatch = DispatchServer(port=0, journal_path=_ds_journal)
         _workers = [
             WorkerServer(
                 _dispatch.target(), _worker_input_fn, port=0,
@@ -1258,6 +1266,17 @@ def main() -> None:
             "chaos: %d fault(s) planned from %s; faults.jsonl in %s",
             len(chaos.plan), args.fault_plan, args.logdir,
         )
+        if data_service is not None:
+            # dispatcher_kill faults: kill the live dispatcher, restart
+            # it on the SAME port from the journal, and probe the
+            # endpoint breaker through a full open->half_open->closed
+            # cycle.
+            _ds_port = data_service.port
+            chaos.attach_data_service(
+                data_service,
+                lambda: DispatchServer(port=_ds_port,
+                                       journal_path=_ds_journal),
+            )
 
     checkpointer = None
     preemption = None
