@@ -1,22 +1,47 @@
 #!/usr/bin/env python
-"""Offered-load benchmark for the serving engine (ISSUE 6).
+"""Offered-load + prefix-caching + interference benchmarks for the
+serving engine (ISSUE 6 / ISSUE 14).
 
 bench_generate.py measures the raw decode loop; this measures the SYSTEM —
-the continuous-batching engine under request traffic: a Poisson-ish
-arrival sweep drives `serve.Engine` directly (no HTTP, so the number is
-the scheduler's, not the socket stack's) and reports, per offered rate,
-request-level SLOs (TTFT / TPOT / e2e p50+p99), batch occupancy, rejects,
-and delivered tokens/sec.
+the continuous-batching engine under request traffic, driving
+`serve.Engine` directly (no HTTP, so the numbers are the scheduler's, not
+the socket stack's).  Three sweeps, selectable via ``BENCH_SERVE_MODE``
+(``all`` default, or ``load`` / ``prefix`` / ``interference``):
 
-Evidence discipline (same contract as bench_generate.py): the headline
-operating point is the MEDIAN OF 3 independent trials with its relative
-spread recorded; one JSON line on stdout.
+- **offered load** (ISSUE 6): a Poisson-ish arrival sweep; per rate,
+  request-level SLOs (TTFT / TPOT / e2e p50+p99), batch occupancy,
+  rejects, delivered tokens/sec.
+- **shared prefix** (ISSUE 14): N prompts sharing a long common header
+  (the system-prompt / few-shot pattern), offered at saturation with
+  ``prefix_cache`` OFF vs ON — the ON arm maps the header's KV blocks
+  refcount+1 instead of re-prefilling them, so the headline is the
+  tokens/sec speedup at the same offered load.
+- **long-prompt interference** (ISSUE 14): victims in steady decode, one
+  intruder with an N×-length prompt arriving mid-decode.  Without a
+  prefill budget the intruder's whole chunked prefill runs between two
+  decode steps and every victim's inter-token latency eats it (stall
+  scales with the intruder's prompt); with ``--prefill-budget`` the
+  scheduler interleaves at most one budget's worth of chunks per decode
+  step, so victim TPOT/ITL p99 is bounded by the budget, independent of
+  the intruder length.
+
+Evidence discipline (same contract as bench_generate.py): headline
+operating points are the MEDIAN OF 3 independent trials with relative
+spread recorded; one JSON document on stdout (one line).  The prefix and
+interference rows are CPU-meaningful (scheduler + cache arithmetic, not
+chip FLOPs) and are persisted to BENCH_RESULTS/ on any platform — the
+serving trajectory must not depend on the TPU tunnel.
 
 Knobs (env): ``BENCH_SERVE_RATES`` (comma req/s, default "2,8,32"),
 ``BENCH_SERVE_N`` (requests per point, default 32), ``BENCH_SERVE_NEW``
 (max_new_tokens, default 32), ``BENCH_SERVE_PROMPT`` (max prompt len,
-default 64), ``BENCH_SERVE_SLOTS`` (default 8), ``BENCH_SERVE_TEST=1``
-CPU smoke (tiny model, 2 slots, few requests).
+default 64), ``BENCH_SERVE_SLOTS`` (default 8), ``BENCH_SERVE_MODEL``
+(``small``/``tiny``), ``BENCH_SERVE_HEADER`` (shared header tokens,
+default 256), ``BENCH_SERVE_BUDGET`` (prefill budget tokens, default 2
+chunks), ``BENCH_SERVE_CTX`` (serving max_context, default 1024 — the
+decode gather scales with it, so slow boxes shrink it), and
+``BENCH_SERVE_TEST=1`` CPU smoke (tiny model, 2 slots, few requests,
+nothing persisted).
 """
 
 from __future__ import annotations
@@ -47,6 +72,17 @@ def _percentile(vals, q):
         return 0.0
     s = sorted(vals)
     return s[min(len(s) - 1, max(0, int(round(q * len(s))) - 1))]
+
+
+def _median_of(trials: list[dict], key: str) -> tuple[dict, float]:
+    """The trial whose ``key`` is the median, plus the relative spread."""
+    vals = [t[key] for t in trials]
+    med = statistics.median(vals)
+    pick = dict(sorted(trials, key=lambda t: t[key])[len(trials) // 2])
+    pick["spread"] = round(
+        (max(vals) - min(vals)) / med, 4) if med else 0.0
+    pick["trials"] = len(trials)
+    return pick, med
 
 
 def _run_point(engine, *, rate: float, n: int, new: int, prompt_max: int,
@@ -92,6 +128,155 @@ def _run_point(engine, *, rate: float, n: int, new: int, prompt_max: int,
     }
 
 
+def _offered_load_sweep(make_engine, *, rates, n, new, prompt_max,
+                        vocab) -> dict:
+    engine = make_engine()
+    engine.generate(list(range(4)), max_new_tokens=2, timeout=300)  # warm
+    points = []
+    head_rate = rates[-1]  # the highest offered load is the headline
+    head_pts = []
+    for rate in rates:
+        trials = 3 if rate == head_rate else 1
+        for t in range(trials):
+            pt = _run_point(
+                engine, rate=rate, n=n, new=new, prompt_max=prompt_max,
+                vocab=vocab, seed=17 * t + int(rate),
+            )
+            (head_pts if rate == head_rate else points).append(pt)
+    head, med = _median_of(head_pts, "tokens_per_sec")
+    points.append(head)
+    engine.stop()
+    return {"value": med, "headline": head, "curve": points}
+
+
+def _shared_prefix_sweep(make_engine, *, header: int, tail_max: int,
+                         n: int, new: int, vocab: int) -> dict:
+    """N prompts sharing a ``header``-token prefix, offered at saturation
+    (all submitted at once), prefix cache OFF vs ON.  The ON engine's
+    index is pre-warmed with one pass so every timed trial measures the
+    steady state a long-running server sits in."""
+    rng = np.random.default_rng(7)
+    hdr = list(map(int, rng.integers(0, vocab, size=header)))
+    prompts = [
+        hdr + list(map(int, rng.integers(
+            0, vocab, size=int(rng.integers(1, tail_max + 1)))))
+        for _ in range(n)
+    ]
+    arms = {}
+    for on in (False, True):
+        engine = make_engine(prefix_cache=on)
+        engine.generate(list(range(4)), max_new_tokens=2, timeout=300)
+        warm = [engine.submit(p, max_new_tokens=2) for p in prompts[:2]]
+        for r in warm:
+            r.wait(600)
+        trials = []
+        for t in range(3):
+            t0 = time.perf_counter()
+            reqs = [engine.submit(p, max_new_tokens=new) for p in prompts]
+            for r in reqs:
+                r.wait(600)
+            makespan = time.perf_counter() - t0
+            ok = [r for r in reqs if r.status == "ok"]
+            trials.append({
+                "tokens_per_sec": round(
+                    sum(len(r.tokens) for r in ok) / makespan, 1),
+                "ok": len(ok),
+                "ttft_p50_s": round(
+                    _percentile([r.ttft_s for r in ok], 0.50), 4),
+                "ttft_p99_s": round(
+                    _percentile([r.ttft_s for r in ok], 0.99), 4),
+                "e2e_p99_s": round(
+                    _percentile([r.e2e_s for r in ok], 0.99), 4),
+                "cached_prefix_tokens": sum(
+                    r.cached_prefix_tokens for r in ok),
+                "prompt_tokens": sum(len(r.prompt) for r in ok),
+            })
+        head, med = _median_of(trials, "tokens_per_sec")
+        st = engine.state()
+        head["prefix_hit_rate"] = st["kv"]["prefix_hit_rate"]
+        head["cached_token_share"] = round(
+            head["cached_prefix_tokens"] / head["prompt_tokens"], 4
+        ) if head["prompt_tokens"] else 0.0
+        engine.stop()
+        arms["on" if on else "off"] = {"tokens_per_sec": med, **head}
+    speedup = (arms["on"]["tokens_per_sec"]
+               / arms["off"]["tokens_per_sec"]
+               if arms["off"]["tokens_per_sec"] else 0.0)
+    return {
+        "header_tokens": header,
+        "tail_max_tokens": tail_max,
+        "requests": n,
+        "max_new_tokens": new,
+        "off": arms["off"],
+        "on": arms["on"],
+        "speedup": round(speedup, 3),
+    }
+
+
+def _interference_sweep(make_engine, *, victims: int, victim_prompt: int,
+                        victim_new: int, mults, budget: int,
+                        vocab: int) -> dict:
+    """Victims in steady decode; one ``mult``×-length intruder prompt
+    arrives mid-decode.  Reports victim TPOT p99 and worst inter-token
+    stall, unbudgeted vs budgeted — the budgeted stall must be flat in
+    the intruder length (the acceptance claim)."""
+    rng = np.random.default_rng(11)
+    vprompts = [
+        list(map(int, rng.integers(0, vocab, size=victim_prompt)))
+        for _ in range(victims)
+    ]
+    # ONE engine per budget arm, reused across mults and trials (no
+    # state crosses trials: the prefix cache is off and the pool drains
+    # when every request terminates) — a fresh engine per trial would
+    # re-trace the three serving programs 12x for identical shapes.
+    engines = {}
+    for b in (None, budget):
+        engines[b] = make_engine(prefill_budget=b)
+        engines[b].generate(list(range(4)), max_new_tokens=2, timeout=300)
+    rows = []
+    for mult in mults:
+        iprompt = list(map(int, rng.integers(
+            0, vocab, size=victim_prompt * mult)))
+        for b in (None, budget):
+            engine = engines[b]
+            trials = []
+            for t in range(3):
+                vs = [engine.submit(p, max_new_tokens=victim_new)
+                      for p in vprompts]
+                deadline = time.time() + 300
+                while (any(v.t_first_token == 0.0 for v in vs)
+                       and time.time() < deadline):
+                    time.sleep(0.002)  # victims reach steady decode
+                intruder = engine.submit(iprompt, max_new_tokens=2)
+                for r in vs + [intruder]:
+                    r.wait(600)
+                ok = [v for v in vs if v.status == "ok"]
+                trials.append({
+                    "victim_tpot_p99_s": round(
+                        _percentile([v.tpot_s for v in ok], 0.99), 4),
+                    "victim_itl_max_s": round(
+                        max((v.itl_max_s for v in ok), default=0.0), 4),
+                    "intruder_ttft_s": round(intruder.ttft_s, 4),
+                    "victims_ok": len(ok),
+                })
+            head, _ = _median_of(trials, "victim_itl_max_s")
+            rows.append({
+                "intruder_mult": mult,
+                "intruder_prompt_tokens": len(iprompt),
+                "prefill_budget": b or 0,
+                **head,
+            })
+    for engine in engines.values():
+        engine.stop()
+    return {
+        "victims": victims,
+        "victim_prompt_tokens": victim_prompt,
+        "victim_new_tokens": victim_new,
+        "budget_tokens": budget,
+        "rows": rows,
+    }
+
+
 def main() -> None:
     import dataclasses
 
@@ -103,7 +288,10 @@ def main() -> None:
     from distributedtensorflow_tpu.serve import Engine
 
     test_size = os.environ.get("BENCH_SERVE_TEST") == "1"
-    cfg = gpt_tiny() if test_size else gpt_small()
+    model = os.environ.get("BENCH_SERVE_MODEL",
+                           "tiny" if test_size else "small")
+    cfg = gpt_tiny() if model == "tiny" else gpt_small()
+    mode = os.environ.get("BENCH_SERVE_MODE", "all")
     slots = int(os.environ.get("BENCH_SERVE_SLOTS", "2" if test_size else "8"))
     n = int(os.environ.get("BENCH_SERVE_N", "6" if test_size else "32"))
     new = int(os.environ.get("BENCH_SERVE_NEW", "8" if test_size else "32"))
@@ -114,66 +302,87 @@ def main() -> None:
             "BENCH_SERVE_RATES", "16" if test_size else "2,8,32"
         ).split(",")
     )
-    max_context = 64 if test_size else 1024
+    header = int(os.environ.get(
+        "BENCH_SERVE_HEADER", "32" if test_size else "256"))
+    block = 8 if test_size else 16
+    chunk = 8 if test_size else 32
+    budget = int(os.environ.get("BENCH_SERVE_BUDGET", str(2 * chunk)))
+    max_context = int(os.environ.get(
+        "BENCH_SERVE_CTX", "128" if test_size else "1024"))
     cfg = dataclasses.replace(cfg, max_seq=max_context)
 
     params = GPTLM(cfg).init(
         jax.random.PRNGKey(0), np.zeros((1, 1), np.int32),
         deterministic=True,
     )["params"]
-    engine = Engine(
-        params, cfg, max_slots=slots, max_queue=max(4 * n, 64),
-        block_size=8 if test_size else 16,
-        prefill_chunk=8 if test_size else 32,
-        max_context=max_context,
-    ).start()
 
-    # Warm both compiled programs before any timed trial.
-    engine.generate(list(range(4)), max_new_tokens=2, timeout=300)
+    def make_engine(prefix_cache=False, prefill_budget=None):
+        return Engine(
+            params, cfg, max_slots=slots, max_queue=max(4 * n, 64),
+            block_size=block, prefill_chunk=chunk,
+            prefix_cache=prefix_cache, prefill_budget=prefill_budget,
+            max_context=max_context,
+        ).start()
 
-    points = []
-    head_rate = rates[-1]  # the highest offered load is the headline
-    head_vals, head_pts = [], []
-    for rate in rates:
-        trials = 3 if rate == head_rate else 1
-        for t in range(trials):
-            pt = _run_point(
-                engine, rate=rate, n=n, new=new, prompt_max=prompt_max,
-                vocab=cfg.vocab_size, seed=17 * t + int(rate),
-            )
-            if rate == head_rate:
-                head_vals.append(pt["tokens_per_sec"])
-                head_pts.append(pt)
-            else:
-                points.append(pt)
-    med = statistics.median(head_vals)
-    head = dict(sorted(head_pts, key=lambda p: p["tokens_per_sec"])[
-        len(head_pts) // 2
-    ])
-    head["spread"] = round(
-        (max(head_vals) - min(head_vals)) / med, 4) if med else 0.0
-    head["trials"] = len(head_vals)
-    points.append(head)
-    engine.stop()
-
-    result = {
-        "metric": "serve_offered_load_tokens_per_sec",
-        "value": med,
-        "unit": "tokens/sec",
-        "vs_baseline": None,  # no public anchor for this serving config
-        "headline": head,
-        "curve": points,
+    platform = jax.devices()[0].platform
+    base = {
         "max_slots": slots,
-        "requests_per_point": n,
-        "max_new_tokens": new,
-        "platform": jax.devices()[0].platform,
+        "model": model,
+        "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     from bench_probe import is_tpu_platform, persist_result
 
-    if is_tpu_platform(result["platform"]) and not test_size:
-        persist_result("serve", result)
+    result = dict(base)
+    if mode in ("all", "load"):
+        load = _offered_load_sweep(
+            make_engine, rates=rates, n=n, new=new, prompt_max=prompt_max,
+            vocab=cfg.vocab_size,
+        )
+        result.update({
+            "metric": "serve_offered_load_tokens_per_sec",
+            "value": load["value"],
+            "unit": "tokens/sec",
+            "vs_baseline": None,  # no public anchor for this serving config
+            "headline": load["headline"],
+            "curve": load["curve"],
+            "requests_per_point": n,
+            "max_new_tokens": new,
+        })
+        if is_tpu_platform(platform) and not test_size:
+            persist_result("serve", result)
+    if mode in ("all", "prefix"):
+        prefix = _shared_prefix_sweep(
+            make_engine, header=header, tail_max=max(prompt_max // 4, 4),
+            n=n, new=new, vocab=cfg.vocab_size,
+        )
+        result["shared_prefix"] = prefix
+        if not test_size:
+            # CPU evidence is the point here (ISSUE 14): the win is
+            # scheduler + cache arithmetic, not chip FLOPs.
+            persist_result("serve_prefix", {
+                "metric": "serve_shared_prefix_speedup",
+                "value": prefix["speedup"],
+                "unit": "x tokens/sec (prefix_cache on/off)",
+                **base, **prefix,
+            })
+    if mode in ("all", "interference"):
+        interference = _interference_sweep(
+            make_engine,
+            victims=min(2 if test_size else 3, slots - 1) or 1,
+            victim_prompt=8 if test_size else 32,
+            victim_new=12 if test_size else 48,
+            mults=(2, 4) if test_size else (4, 8),
+            budget=budget, vocab=cfg.vocab_size,
+        )
+        result["interference"] = interference
+        if not test_size:
+            persist_result("serve_interference", {
+                "metric": "serve_interference_victim_itl",
+                "unit": "seconds (victim worst inter-token stall)",
+                **base, **interference,
+            })
     print(json.dumps(result))
 
 

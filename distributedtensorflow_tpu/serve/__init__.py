@@ -1,17 +1,20 @@
-"""Serving engine: continuous-batching generation server (ISSUE 6).
+"""Serving engine: continuous-batching generation server (ISSUE 6 + 14).
 
-The online half of the stack: ``kv_cache`` (paged block-pool KV +
-allocator), ``model`` (the two compiled programs — chunked prefill and
-paged one-token decode), ``engine`` (thread-safe queue + continuous
-batching scheduler + SLO metrics), ``server`` (``/generatez`` HTTP
-frontend on the obs StatusServer pattern).  Entry point: ``serve.py`` at
-the repo root.
+The online half of the stack: ``kv_cache`` (paged block-pool KV with a
+refcounted copy-on-write allocator + prefix index), ``model`` (the
+compiled serving programs — chunked prefill, paged one-token decode, and
+the pool→dense cache gather that makes prefill chunks interleavable),
+``engine`` (thread-safe queue + continuous batching scheduler with
+decode-integrated budgeted prefill + SLO metrics), ``server``
+(``/generatez`` HTTP frontend on the obs StatusServer pattern).  Entry
+point: ``serve.py`` at the repo root.
 """
 
 from .engine import Engine, GenRequest, QueueFullError  # noqa: F401
 from .kv_cache import BlockAllocator, OutOfBlocksError, PagedKVCache  # noqa: F401
 from .model import (  # noqa: F401
     make_decode_fn,
+    make_gather_cache_fn,
     make_prefill_cache,
     make_prefill_fn,
 )

@@ -8,15 +8,29 @@ online replacement:
 
 - **thread-safe FIFO queue** (bounded; a full queue rejects loudly so the
   frontend can return 429 instead of letting latency grow unboundedly);
-- **continuous (in-flight) batching**: every scheduler iteration first
-  admits queued requests into free slots (chunked prefill, one compiled
-  width), then runs ONE paged decode step for all active slots, then
-  evicts finished sequences (EOS / max_new_tokens) — freed slots and KV
-  blocks are available to the very next admission, so the decode batch
-  refills while long requests keep streaming;
-- **paged KV** (``serve.kv_cache``): admission reserves only the
-  request's worst-case footprint (prompt + max_new), not ``max_seq``,
-  and eviction returns the blocks immediately;
+- **continuous (in-flight) batching with decode-integrated chunked
+  prefill**: every scheduler iteration first admits queued requests into
+  free slots, then runs at most ``prefill_budget`` TOKENS of prefill
+  chunks — budget-bounded bursts rotating round-robin across the
+  admitted-but-unfilled requests (consecutive chunks per burst keep the
+  dense-cache fast path; rotation keeps prefill fair across fillers) —
+  and then ONE paged decode step for all decoding slots, then evicts
+  finished sequences (EOS / max_new_tokens).  Decode never starves: a
+  newly arrived long prompt can delay the running requests' next token
+  by at most one budget's worth of chunks per iteration (instead of its
+  whole prefill), and queued requests' time-to-first-token overlaps with
+  in-flight decode.  A request's first token is sampled in the iteration
+  its last chunk completes (TTFT stops there).  ``prefill_budget=None``
+  = unbudgeted (all pending chunks run before each decode step);
+- **paged KV with prefix caching** (``serve.kv_cache``): admission
+  reserves only the request's worst-case footprint (prompt + max_new),
+  not ``max_seq`` — and with ``prefix_cache=True``, whole token-aligned
+  blocks matching an indexed prefix (system prompts, few-shot headers)
+  are mapped in shared at refcount+1, so the reservation shrinks to the
+  footprint MINUS the mapped prefix and prefill skips the cached tokens.
+  Completed prompts register their full blocks; release decrements
+  refcounts (registered blocks stay warm, LRU-evicted only under
+  pressure, never while mapped);
 - **admission control**: a request is admitted only when a slot AND its
   whole block reservation are free (no mid-flight OOM), strictly in
   arrival order (head-of-line blocking keeps FIFO fairness — a small
@@ -26,10 +40,16 @@ Observability (wired into the obs registry): ``serve_ttft_seconds``,
 ``serve_tpot_seconds``, ``serve_e2e_seconds``, ``serve_batch_occupancy``
 histograms, queue/slot/block gauges, ``serve_requests_total{status=}`` /
 ``serve_tokens_generated_total`` / ``serve_admits_total{reused=}``
-counters; a per-request ``requests.jsonl`` log and periodic
-``metrics.jsonl`` rows + ``metrics.prom`` snapshots in ``logdir`` (the
-same streams ``tools/run_report.py`` and ``tools/check_metrics_schema.py``
-consume).
+counters; prefix-caching counters ``serve_prefix_hits_total`` /
+``serve_prefix_cached_tokens_total`` / ``serve_prefill_tokens_total`` /
+``serve_prefix_evictions_total`` / ``serve_kv_cow_copies_total`` and
+gauges ``serve_kv_blocks_cached`` / ``serve_kv_block_refs`` /
+``serve_kv_fragmentation`` / ``serve_prefix_cache_occupancy`` /
+``serve_prefix_hit_rate``; a per-request ``requests.jsonl`` log (ok rows
+carry ``cached_prefix_tokens`` + ``prefill_tokens``, summing to
+``prompt_tokens``) and periodic ``metrics.jsonl`` rows + ``metrics.prom``
+snapshots in ``logdir`` (the same streams ``tools/run_report.py`` and
+``tools/check_metrics_schema.py`` consume).
 
 Threading model: HTTP/handler threads only touch :meth:`submit` (queue +
 lock); all device work and all ``PagedKVCache`` mutation happens on the
@@ -57,6 +77,7 @@ from ..utils.metrics import json_sanitize
 from .kv_cache import PagedKVCache
 from .model import (
     make_decode_fn,
+    make_gather_cache_fn,
     make_prefill_cache,
     make_prefill_fn,
     reset_cache_index,
@@ -73,7 +94,10 @@ class QueueFullError(RuntimeError):
     (HTTP frontends map it to 429)."""
 
 
-@dataclasses.dataclass
+# eq=False: requests are live objects, not value types — membership tests
+# on the _filling deque need identity, and field-wise eq would compare
+# numpy fill buffers (ambiguous truth value).
+@dataclasses.dataclass(eq=False)
 class GenRequest:
     """One generation request plus its lifecycle bookkeeping."""
 
@@ -107,6 +131,22 @@ class GenRequest:
     occ_sum: int = 0
     occ_steps: int = 0
     occ_max: int = 0
+    #: prompt tokens mapped from the prefix cache at admission (whole
+    #: shared blocks) vs. prompt tokens owed to prefill compute — the two
+    #: always sum to ``len(prompt)``.
+    cached_prefix_tokens: int = 0
+    prefill_tokens: int = 0
+    #: worst observed inter-token latency (decode stall ceiling — the
+    #: number the prefill budget bounds).
+    itl_max_s: float = 0.0
+    # -- chunked-prefill state (engine thread only) --
+    _fill_buf: np.ndarray | None = dataclasses.field(
+        default=None, repr=False
+    )
+    _fill_next: int = 0             # next chunk's first absolute position
+    _fill_pad: int = 0              # padded prefill extent
+    _prefill_done: bool = False
+    _t_last_token: float = 0.0
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False
     )
@@ -137,7 +177,7 @@ class GenRequest:
 
 
 class Engine:
-    """Continuous-batching scheduler over the two compiled serving
+    """Continuous-batching scheduler over the compiled serving
     programs (``serve.model``).  See the module docstring for the loop
     contract; construct, :meth:`start`, :meth:`submit` from any thread,
     :meth:`stop` to drain."""
@@ -152,6 +192,8 @@ class Engine:
         block_size: int = 16,
         num_blocks: int | None = None,
         prefill_chunk: int = 16,
+        prefill_budget: int | None = None,
+        prefix_cache: bool = False,
         max_context: int | None = None,
         max_new_cap: int | None = None,
         logdir: str | None = None,
@@ -175,6 +217,11 @@ class Engine:
                 f"prefill_chunk={prefill_chunk} must be in "
                 f"[1, max_context={max_context}]"
             )
+        if prefill_budget is not None and prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget={prefill_budget} must be >= 1 tokens "
+                "(None = unbudgeted)"
+            )
         #: params stay the caller's (possibly mesh-sharded) arrays — GSPMD
         #: partitions both programs exactly as it does models.generate.
         self.params = params
@@ -183,6 +230,8 @@ class Engine:
         self.max_queue = max_queue
         self.max_new_cap = max_new_cap
         self.prefill_chunk = prefill_chunk
+        self.prefill_budget = prefill_budget
+        self.prefix_cache = bool(prefix_cache)
         self.logdir = logdir
         self.log_every = max(int(log_every), 1)
 
@@ -201,7 +250,12 @@ class Engine:
         self._prefill = make_prefill_fn(self.cfg, chunk=prefill_chunk,
                                         block_size=block_size)
         self._decode = make_decode_fn(self.cfg)
+        self._gather = make_gather_cache_fn(self.cfg, block_size=block_size)
         self._prefill_cache = make_prefill_cache(self.cfg)
+        #: (slot, pos): the dense prefill cache currently holds that
+        #: slot's K/V for positions [0, pos) — consecutive chunks of one
+        #: request skip the pool re-gather.  None = unknown/stale.
+        self._prefill_cache_state: tuple[int, int] | None = None
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -209,6 +263,9 @@ class Engine:
         self._ids = itertools.count()
         self._slots: list[GenRequest | None] = [None] * max_slots
         self._slot_reused = [False] * max_slots  # slot saw a previous request
+        #: admitted-but-unfilled requests, round-robin order (the budget
+        #: scheduler's working set; entries are also in _slots).
+        self._filling: collections.deque[GenRequest] = collections.deque()
         self._last_tokens = np.zeros((max_slots,), np.int32)
         self._thread: threading.Thread | None = None
         self._stop_flag = False
@@ -216,9 +273,16 @@ class Engine:
         self._stopped = False             # clean shutdown: refuse new work
         self.decode_steps = 0
         self.occupancy_max = 0
+        self.prefill_iters = 0   # iterations that ran >= 1 prefill chunk
+        self.prefill_chunks = 0  # chunks run across all iterations
+        # prefix_lookups/hits/cached_tokens live on the PagedKVCache (the
+        # admission path that owns the success-only counting rule) — one
+        # source of truth, surfaced via kv.stats(); only the engine-level
+        # logical split (uncached prompt tokens) is counted here.
         self.counters = {
             "submitted": 0, "ok": 0, "rejected": 0, "error": 0,
             "tokens_generated": 0, "admits": 0, "admits_into_freed_slot": 0,
+            "prefill_tokens": 0,
         }
 
         reg = registry or obs_registry.default_registry()
@@ -236,12 +300,43 @@ class Engine:
         self._m_active = reg.gauge("serve_active_slots", "occupied slots")
         self._m_blocks_free = reg.gauge(
             "serve_kv_blocks_free", "free KV pool blocks")
+        self._m_blocks_cached = reg.gauge(
+            "serve_kv_blocks_cached",
+            "refcount-0 prefix-cached KV blocks (evictable)")
+        self._m_block_refs = reg.gauge(
+            "serve_kv_block_refs",
+            "sum of block refcounts (> used blocks = sharing live)")
+        self._m_frag = reg.gauge(
+            "serve_kv_fragmentation",
+            "internal fragmentation of allocated KV blocks [0,1]")
+        self._m_prefix_occ = reg.gauge(
+            "serve_prefix_cache_occupancy",
+            "share of the pool holding indexed prefix content [0,1]")
+        self._m_prefix_rate = reg.gauge(
+            "serve_prefix_hit_rate",
+            "admissions that mapped >=1 cached prefix block [0,1]")
         self._m_requests = reg.counter(
             "serve_requests_total", "terminal requests by status")
         self._m_tokens = reg.counter(
             "serve_tokens_generated_total", "generated tokens")
         self._m_admits = reg.counter(
             "serve_admits_total", "admissions (reused=slot had served before)")
+        self._m_prefix_hits = reg.counter(
+            "serve_prefix_hits_total",
+            "admissions that mapped >=1 cached prefix block")
+        self._m_prefix_tokens = reg.counter(
+            "serve_prefix_cached_tokens_total",
+            "prompt tokens served from the prefix cache (no prefill)")
+        self._m_prefill_tokens = reg.counter(
+            "serve_prefill_tokens_total",
+            "prompt tokens owed to prefill compute (uncached)")
+        self._m_evictions = reg.counter(
+            "serve_prefix_evictions_total",
+            "cached blocks evicted under pool pressure")
+        self._m_cow = reg.counter(
+            "serve_kv_cow_copies_total", "copy-on-write block copies")
+        self._last_evictions = 0  # registry-counter delta trackers
+        self._last_cow = 0
         self._registry = reg
 
         self._req_log = None
@@ -325,6 +420,9 @@ class Engine:
                     f"deadline_s must be a finite number > 0, got "
                     f"{deadline_s}"
                 )
+        # The footprint is prefix-cache-independent (the chunk grid stays
+        # anchored at position 0), so the worst case is checkable at
+        # submit time without peeking at the engine thread's index state.
         footprint = self._footprint(len(prompt), max_new_tokens)
         if footprint > self.kv.max_context:
             raise ValueError(
@@ -400,28 +498,34 @@ class Engine:
     def _footprint(self, prompt_len: int, max_new: int) -> int:
         """Worst-case KV positions a request can touch: the padded prompt
         (the final prefill chunk writes pad K/V) or the full generation,
-        whichever is larger."""
+        whichever is larger.  Independent of any prefix-cache hit: the
+        chunk grid is anchored at position 0, so a partially cached
+        prompt still spans the same padded extent."""
         return max(self._padded_prompt_len(prompt_len),
                    prompt_len + max_new)
 
     def step(self) -> bool:
-        """One scheduler iteration: admit → decode → evict.  Public so
-        tests can drive the engine synchronously; returns True when any
-        work happened."""
+        """One scheduler iteration: admit → budgeted prefill → decode →
+        evict.  Public so tests can drive the engine synchronously;
+        returns True when any work happened."""
         admitted = self._admit_from_queue()
-        for req in admitted:
-            self._run_prefill(req)
-        active = [r for r in self._slots if r is not None]
-        if active:
+        chunks = self._run_prefill_budget()
+        decoding = any(
+            r is not None and r._prefill_done for r in self._slots
+        )
+        if decoding:
             self._run_decode_step()
-        did = bool(admitted or active)
+        did = bool(admitted or chunks or decoding)
         if did and self.decode_steps % self.log_every == 0:
             self._log_metrics_row()
         return did
 
     def _admit_from_queue(self) -> list[GenRequest]:
         """Strict-FIFO admission: pop the head only while a slot AND its
-        whole block reservation fit (head-of-line blocking = fairness)."""
+        whole (prefix-discounted) block reservation fit (head-of-line
+        blocking = fairness).  Admitted requests join the prefill
+        round-robin; their first token arrives when their last chunk
+        completes."""
         admitted = []
         expired: list[GenRequest] = []
         with self._cond:
@@ -444,70 +548,162 @@ class Engine:
                 free = [i for i, r in enumerate(self._slots) if r is None]
                 if not free:
                     break
-                need = self.kv.blocks_for(
-                    self._footprint(len(head.prompt), head.max_new_tokens)
-                )
-                if need > self.kv.allocator.free_blocks:
-                    break
-                self._queue.popleft()
                 slot = free[0]
-                ok = self.kv.admit(
+                pages = self.kv.admit(
                     slot,
                     self._footprint(len(head.prompt), head.max_new_tokens),
+                    prompt=head.prompt if self.prefix_cache else None,
                 )
-                assert ok  # free_blocks was checked above
+                if pages is None:  # pool pressure (all-or-nothing rollback)
+                    break
+                self._queue.popleft()
+                p = pages.prefix_tokens
+                head.cached_prefix_tokens = p
+                head.prefill_tokens = len(head.prompt) - p
                 head.slot = slot
                 head.status = "active"
                 head.t_admit = time.time()
+                # chunked-prefill state: the grid stays anchored at 0, so
+                # prefill starts at the last chunk boundary <= the first
+                # uncached token (a straddling chunk re-writes the shared
+                # tail with bitwise-identical K/V — see serve.kv_cache).
+                head._fill_buf = np.zeros(
+                    (self._padded_prompt_len(len(head.prompt)),), np.int32
+                )
+                head._fill_buf[: len(head.prompt)] = head.prompt
+                head._fill_pad = len(head._fill_buf)
+                head._fill_next = (p // self.prefill_chunk) \
+                    * self.prefill_chunk
                 self._slots[slot] = head
+                if self._prefill_cache_state is not None \
+                        and self._prefill_cache_state[0] == slot:
+                    # the dense cache's claimed contents belonged to this
+                    # slot's PREVIOUS tenant — never alias across requests
+                    self._prefill_cache_state = None
+                self._filling.append(head)
                 reused = self._slot_reused[slot]
                 self._slot_reused[slot] = True
                 self.counters["admits"] += 1
                 if reused:
                     self.counters["admits_into_freed_slot"] += 1
                 self._m_admits.inc(reused=str(reused).lower())
+                if p:
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_tokens.inc(p)
+                self.counters["prefill_tokens"] += head.prefill_tokens
+                self._m_prefill_tokens.inc(head.prefill_tokens)
                 admitted.append(head)
             self._m_queue.set(len(self._queue))
         for req in expired:
             # Finished OUTSIDE the scheduler lock (log I/O, metrics).
             self._finish(req, None, status="error")
         self._m_active.set(sum(r is not None for r in self._slots))
-        self._m_blocks_free.set(self.kv.allocator.free_blocks)
+        self._update_kv_metrics()
         return admitted
 
-    def _run_prefill(self, req: GenRequest) -> None:
-        """Chunked prefill for one admitted request, then sample its first
-        token (TTFT stops here)."""
+    def _run_prefill_budget(self) -> int:
+        """At most ``prefill_budget`` tokens of prefill chunks this
+        iteration, round-robin in budget-bounded BURSTS across the
+        admitted-but-unfilled set: the head request runs consecutive
+        chunks (hitting the dense-cache fast path — chunk-granularity
+        interleaving would pay a full pool→cache gather per chunk) until
+        it finishes or the budget runs out, then rotates to the back so
+        the next iteration's budget goes to the next filler.  A long
+        prompt can therefore neither starve decode (the per-iteration
+        bound) nor monopolize prefill across iterations (the rotation).
+        Always makes progress: at least one chunk runs when any request
+        is filling, even with a budget below the chunk width.  Returns
+        the chunk count."""
+        if not self._filling:
+            return 0
+        budget = self.prefill_budget
+        spent = 0
+        chunks = 0
+        while self._filling and (budget is None or spent < budget):
+            req = self._filling.popleft()
+            done = False
+            while True:
+                last_logits = self._run_prefill_chunk(req)
+                spent += self.prefill_chunk
+                chunks += 1
+                if req._fill_next >= req._fill_pad:
+                    self._finish_prefill(req, last_logits)
+                    done = True
+                    break
+                if budget is not None and spent >= budget:
+                    break
+            if not done:
+                self._filling.append(req)
+        self.prefill_iters += 1
+        self.prefill_chunks += chunks
+        return chunks
+
+    def _run_prefill_chunk(self, req: GenRequest):
+        """One fixed-width prefill chunk for one request.  The dense
+        prefill cache is re-materialized from the slot's pool blocks
+        (``make_gather_cache_fn``) unless it already holds exactly this
+        slot's K/V through the chunk start — which makes chunks
+        stateless and freely interleavable across requests."""
         slot = req.slot
         c = self.prefill_chunk
-        prompt = np.asarray(req.prompt, np.int32)
-        pad = self._padded_prompt_len(len(prompt))
-        buf = np.zeros((pad,), np.int32)
-        buf[: len(prompt)] = prompt
-        self._prefill_cache = reset_cache_index(self._prefill_cache)
+        start = req._fill_next
         table_row = jnp.asarray(self.kv.block_tables[slot])
-        last_logits = None
-        for start in range(0, pad, c):
-            last_ix = min(max(len(prompt) - 1 - start, 0), c - 1)
-            last_logits, self._prefill_cache, self.kv.k_pool, self.kv.v_pool = (
-                self._prefill(
-                    self.params, self.kv.k_pool, self.kv.v_pool,
-                    self._prefill_cache, jnp.asarray(buf[None, start:start + c]),
-                    jnp.int32(start), table_row, jnp.int32(last_ix),
+        if self._prefill_cache_state != (slot, start):
+            if start:
+                self._prefill_cache = self._gather(
+                    self.kv.k_pool, self.kv.v_pool, self._prefill_cache,
+                    table_row, jnp.int32(start),
                 )
+            else:
+                self._prefill_cache = reset_cache_index(self._prefill_cache)
+        last_ix = min(max(len(req.prompt) - 1 - start, 0), c - 1)
+        last_logits, self._prefill_cache, self.kv.k_pool, self.kv.v_pool = (
+            self._prefill(
+                self.params, self.kv.k_pool, self.kv.v_pool,
+                self._prefill_cache,
+                jnp.asarray(req._fill_buf[None, start:start + c]),
+                jnp.int32(start), table_row, jnp.int32(last_ix),
             )
-        self.kv.note_written(slot, len(prompt))
+        )
+        req._fill_next = start + c
+        self._prefill_cache_state = (slot, start + c)
+        self.kv.note_written(
+            slot, max(min(start + c, len(req.prompt)),
+                      int(self.kv.seq_lens[slot]))
+        )
+        return last_logits
+
+    def _finish_prefill(self, req: GenRequest, last_logits) -> None:
+        """The request's last chunk just completed: index its full prompt
+        blocks (prefix cache), sample the first token (TTFT stops here),
+        and hand the slot to the decode batch."""
+        if self.prefix_cache:
+            self.kv.register_prefix(req.slot, req.prompt)
+        req._prefill_done = True
         tok = self._sample(req, np.asarray(last_logits))
         req.t_first_token = time.time()
+        req._t_last_token = req.t_first_token
         req.tokens.append(tok)
-        self._last_tokens[slot] = tok
+        self._last_tokens[req.slot] = tok
         self._m_ttft.observe(req.ttft_s)
         self._maybe_finish(req)
 
     def _run_decode_step(self) -> None:
-        """One paged decode token for every active slot."""
-        active = np.array([r is not None for r in self._slots])
-        n_active = int(active.sum())
+        """One paged decode token for every slot whose prefill is done."""
+        decoding = [
+            (i, r) for i, r in enumerate(self._slots)
+            if r is not None and r._prefill_done
+        ]
+        n_active = len(decoding)
+        for i, _ in decoding:
+            # CoW guard: never write a shared or indexed block in place.
+            # Steady state this is a no-op (appends land past the shared
+            # prompt blocks) — it is what makes a future scheduler bug a
+            # local copy instead of cross-request cache corruption.
+            self.kv.ensure_writable(i, int(self.kv.seq_lens[i]))
+        active = np.array(
+            [r is not None and r._prefill_done for r in self._slots]
+        )
         logits, self.kv.k_pool, self.kv.v_pool = self._decode(
             self.params, self.kv.k_pool, self.kv.v_pool,
             jnp.asarray(self._last_tokens), jnp.asarray(self.kv.block_tables),
@@ -517,15 +713,17 @@ class Engine:
         self.decode_steps += 1
         self._m_occ.observe(float(n_active))
         self.occupancy_max = max(self.occupancy_max, n_active)
-        for slot, req in enumerate(self._slots):
-            if req is None:
-                continue
+        now = time.time()
+        for slot, req in decoding:
             self.kv.note_written(slot, int(self.kv.seq_lens[slot]) + 1)
             req.occ_sum += n_active
             req.occ_steps += 1
             req.occ_max = max(req.occ_max, n_active)
             tok = self._sample(req, logits[slot])
             req.tokens.append(tok)
+            if req._t_last_token:
+                req.itl_max_s = max(req.itl_max_s, now - req._t_last_token)
+            req._t_last_token = now
             self._last_tokens[slot] = tok
             self._maybe_finish(req)
 
@@ -552,10 +750,17 @@ class Engine:
 
     def _finish(self, req: GenRequest, reason: str,
                 status: str = "ok") -> None:
-        """Evict: free the slot + blocks, close out metrics, signal."""
+        """Evict: release the slot's block references (registered prefix
+        blocks park in the cached LRU, the rest free), close out metrics,
+        signal."""
         if req.slot is not None:
             self.kv.release(req.slot)
             self._slots[req.slot] = None
+            if self._prefill_cache_state is not None \
+                    and self._prefill_cache_state[0] == req.slot:
+                self._prefill_cache_state = None
+        if req in self._filling:  # error paths only; finished fills popped
+            self._filling.remove(req)
         req.status = status
         req.finish_reason = reason if status == "ok" else None
         req.t_done = time.time()
@@ -568,9 +773,27 @@ class Engine:
             self._m_tpot.observe(req.tpot_s)
             self._emit_trace_spans(req)
         self._m_active.set(sum(r is not None for r in self._slots))
-        self._m_blocks_free.set(self.kv.allocator.free_blocks)
+        self._update_kv_metrics()
         self._log_request(req)
         req._done.set()
+
+    def _update_kv_metrics(self) -> None:
+        """Mirror the pool's host-side census into the obs registry
+        (gauges set, monotonic kv counters bridged as deltas)."""
+        alloc = self.kv.allocator
+        self._m_blocks_free.set(alloc.free_blocks)
+        self._m_blocks_cached.set(alloc.cached_blocks)
+        self._m_block_refs.set(alloc.total_refs)
+        if alloc.evictions > self._last_evictions:
+            self._m_evictions.inc(alloc.evictions - self._last_evictions)
+            self._last_evictions = alloc.evictions
+        if self.kv.cow_copies > self._last_cow:
+            self._m_cow.inc(self.kv.cow_copies - self._last_cow)
+            self._last_cow = self.kv.cow_copies
+        stats = self.kv.stats()
+        self._m_frag.set(stats["fragmentation"])
+        self._m_prefix_occ.set(stats["prefix_occupancy"])
+        self._m_prefix_rate.set(stats["prefix_hit_rate"])
 
     def _emit_trace_spans(self, req: GenRequest) -> None:
         """Distributed request tracing: one root span per completed
@@ -587,6 +810,7 @@ class Engine:
             "serve.request", t0=req.t_submit, dur_s=req.e2e_s,
             trace_id=req.trace_id, span_id=root, request=req.id,
             prompt_tokens=len(req.prompt), new_tokens=len(req.tokens),
+            cached_prefix_tokens=req.cached_prefix_tokens,
         )
         obs_tracing.record_remote_span(
             "serve.queue", t0=req.t_submit,
@@ -691,6 +915,7 @@ class Engine:
             doomed = list(self._queue)
             self._queue.clear()
             self._m_queue.set(0)
+        self._filling.clear()  # entries are also in _slots, failed below
         doomed += [r for r in self._slots if r is not None]
         for req in doomed:
             req.error = message
@@ -707,6 +932,8 @@ class Engine:
                 "id": r.id, "seq_len": int(self.kv.seq_lens[i]),
                 "new_tokens": len(r.tokens),
                 "max_new_tokens": r.max_new_tokens,
+                "phase": "decode" if r._prefill_done else "prefill",
+                "cached_prefix_tokens": r.cached_prefix_tokens,
             }
             for i, r in enumerate(self._slots)
         ]
@@ -715,12 +942,19 @@ class Engine:
             "max_queue": self.max_queue,
             "max_slots": self.max_slots,
             "active_slots": sum(s is not None for s in slots),
+            "filling_slots": sum(
+                s is not None and s["phase"] == "prefill" for s in slots
+            ),
             "slots": slots,
             "decode_steps": self.decode_steps,
             "occupancy_max": self.occupancy_max,
+            "prefill_iters": self.prefill_iters,
+            "prefill_chunks": self.prefill_chunks,
             "kv": self.kv.stats(),
             "counters": dict(self.counters),
             "prefill_chunk": self.prefill_chunk,
+            "prefill_budget": self.prefill_budget or 0,
+            "prefix_cache": self.prefix_cache,
             "max_context": self.kv.max_context,
         }
 
@@ -743,6 +977,9 @@ class Engine:
                 occ_mean=(round(req.occ_sum / req.occ_steps, 3)
                           if req.occ_steps else 0.0),
                 occ_max=req.occ_max,
+                cached_prefix_tokens=req.cached_prefix_tokens,
+                prefill_tokens=req.prefill_tokens,
+                itl_max_s=round(req.itl_max_s, 6),
             )
         elif req.error:
             row["error"] = req.error
@@ -763,9 +1000,24 @@ class Engine:
             "step": self.decode_steps,
             "queue_depth": len(self._queue),
             "active_slots": sum(r is not None for r in self._slots),
+            "filling_slots": len(self._filling),
             "occupancy_max": self.occupancy_max,
             "blocks_free": kv["blocks_free"],
+            "blocks_cached": kv["blocks_cached"],
+            "block_refs": kv["block_refs"],
             "kv_fragmentation": round(kv["fragmentation"], 4),
+            "prefix_occupancy": round(kv["prefix_occupancy"], 4),
+            "prefix_hit_rate": round(kv["prefix_hit_rate"], 4),
+            "prefix_lookups_total": kv["prefix_lookups"],
+            "prefix_hits_total": kv["prefix_hits"],
+            "prefix_cached_tokens_total": kv["prefix_cached_tokens"],
+            "prefill_tokens_total": self.counters["prefill_tokens"],
+            "prefix_evictions_total": kv["prefix_evictions"],
+            "cow_copies_total": kv["cow_copies"],
+            "prefill_iters": self.prefill_iters,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_budget": self.prefill_budget or 0,
             "requests_ok_total": self.counters["ok"],
             "requests_rejected_total": self.counters["rejected"],
             "requests_error_total": self.counters["error"],

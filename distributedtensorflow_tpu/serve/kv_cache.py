@@ -1,4 +1,4 @@
-"""Paged KV cache: a block pool + per-slot page tables + an allocator.
+"""Paged KV cache: refcounted block pool + prefix index + page tables.
 
 The dense serving cache (``models.generate``) pins ``max_seq`` tokens of
 K/V per batch slot for the whole request lifetime — a 16-token reply in a
@@ -6,16 +6,61 @@ slot sized for 2048 tokens wastes 99% of the slot's HBM.  This module is
 the vLLM-style fix, built on the same sequence-chunking idiom as
 ``ops/blockwise.py``: K/V live in a pool of fixed-size **blocks** shared
 by every slot, each slot's **page table** row names the blocks holding
-its sequence, and a free-list **allocator** hands blocks out per request
+its sequence, and a refcounted **allocator** hands blocks out per request
 — so memory held is proportional to tokens actually resident, and a
 finished sequence's blocks return to the pool the moment it is evicted.
 
-Device-side state is functional (jnp arrays threaded through the two
+**Prefix caching** (ISSUE 14): identical prompt prefixes — system
+prompts, few-shot headers — are the dominant redundancy in request
+traffic, and re-prefilling them re-computes and re-stores the same K/V
+every request.  The pool therefore keeps a **prefix index**: every FULL
+token-aligned block of a completed prompt is registered under a chained
+content hash (``h_i = hash((h_{i-1}, block_i_tokens))``, so a block's
+hash commits to the whole prefix up to it, not just its own tokens).
+Admission looks up the longest indexed chain for the new prompt and maps
+those blocks into the request's page table at ``refcount + 1`` — prefill
+then only runs the uncached tail.  The match is capped at
+``(prompt_len - 1) // block_size`` blocks so at least one prompt token
+always runs through prefill (the last token's logits seed sampling).
+
+Block states (``BlockAllocator``):
+
+- **free** — on the free list, contents meaningless;
+- **active** — refcount >= 1: mapped by that many slot page tables.  A
+  block with refcount > 1 is *shared* and must never be written in place
+  (copy-on-write below);
+- **cached** — refcount 0 but registered in the prefix index: the K/V
+  stay warm for future lookups.  Cached blocks form an LRU; ``alloc``
+  evicts from it only under pressure (dropping the index entry), and a
+  **mapped block is never evicted** — eviction only ever sees
+  refcount-0 blocks.
+
+``release`` therefore *decrements* instead of freeing: a registered
+block outlives its first request as a cached block, an unregistered one
+goes straight back to the free list.
+
+**Copy-on-write**: :meth:`PagedKVCache.ensure_writable` guards every
+in-place write position — a shared target block is copied into a fresh
+block first (pool-level device copy) and the writer's table re-pointed;
+a registered-but-exclusive target is unregistered (the write would
+invalidate the indexed content).  In the engine's steady state neither
+fires: only FULL prompt blocks are ever registered/shared and all
+appends land past the prompt — but the guard is what turns a future
+scheduler bug into a local copy instead of silent cross-request cache
+corruption.  One deliberate exception: a prefill chunk that straddles
+the cached-prefix boundary re-writes the tail of the shared prefix with
+**bitwise-identical** K/V (same tokens, same positions, same compiled
+program — and causal masking makes positions ``< p`` independent of the
+differing suffix), which is benign and keeps the chunk grid anchored at
+zero so the admission footprint math is unchanged.
+
+Device-side state is functional (jnp arrays threaded through the
 compiled serving programs — see ``serve.model``); this module owns the
-HOST-side bookkeeping: the allocator free list, the numpy page tables and
-sequence lengths the engine mutates between steps.  Single-writer by
-design: only the engine loop thread touches a ``PagedKVCache`` (the
-HTTP threads go through the engine's queue), so there are no locks here.
+HOST-side bookkeeping: the allocator states, the prefix index, the
+numpy page tables and sequence lengths the engine mutates between
+steps.  Single-writer by design: only the engine loop thread touches a
+``PagedKVCache`` (the HTTP threads go through the engine's queue), so
+there are no locks here.
 
 Layout: ``(num_layers, num_blocks + 1, block_size, kv_heads, head_dim)``
 per pool — one stacked array for all layers so the decode program indexes
@@ -29,35 +74,64 @@ engine discards anyway.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import functools
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 
 class OutOfBlocksError(RuntimeError):
-    """Raised on ``free``/table misuse; ``alloc`` returns None instead."""
+    """Raised on ``free``/refcount/table misuse; ``alloc`` returns None
+    instead."""
+
+
+@functools.lru_cache(maxsize=1)
+def _copy_block_fn():
+    """Compiled pool-level block copy (the copy-on-write program).
+
+    Compiled lazily on the first CoW — steady-state serving with
+    full-block prefix sharing never triggers it (see module docstring)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def copy_block(k_pool, v_pool, src, dst):
+        return (k_pool.at[:, dst].set(k_pool[:, src]),
+                v_pool.at[:, dst].set(v_pool[:, src]))
+
+    return copy_block
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` uniform physical blocks.
+    """Refcounted allocator over ``num_blocks`` uniform physical blocks.
 
     ``alloc(n)`` is all-or-nothing (a request is admitted only when its
     whole worst-case footprint fits — no mid-flight OOM, see
-    ``serve.engine``); ``free`` returns blocks and rejects double-frees
-    loudly (a double-free means two slots share a block — silent cache
-    corruption).  Blocks are uniform so there is no external
-    fragmentation; the waste mode is *internal* (allocated-but-unused
-    tokens inside a request's last block and its not-yet-generated tail),
-    reported by :meth:`PagedKVCache.stats`.
+    ``serve.engine``) and may evict LRU *cached* (refcount-0, registered)
+    blocks to satisfy the grant — a mapped (refcount >= 1) block is never
+    evicted.  ``free``/:meth:`decref` decrement and reject double-frees
+    loudly (an over-decrement means two slots think they own a block's
+    last reference — silent cache corruption).  Blocks are uniform so
+    there is no external fragmentation; the waste mode is *internal*
+    (allocated-but-unused tokens inside a request's last block and its
+    not-yet-generated tail), reported by :meth:`PagedKVCache.stats`.
     """
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, on_evict=None):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, -1, -1))  # pop() -> block 0 first
-        self._used: set[int] = set()
+        self._ref: dict[int, int] = {}
+        #: refcount-0 registered blocks, insertion order = LRU order.
+        self._cached: collections.OrderedDict[int, None] = \
+            collections.OrderedDict()
+        self._registered: set[int] = set()
+        self._on_evict = on_evict
+        self.evictions = 0
+
+    # -- state census --------------------------------------------------------
 
     @property
     def free_blocks(self) -> int:
@@ -65,28 +139,110 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
-        return len(self._used)
+        """Blocks with refcount >= 1 (mapped by some page table)."""
+        return len(self._ref)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Refcount-0 blocks kept warm for the prefix index (evictable)."""
+        return len(self._cached)
+
+    @property
+    def allocatable_blocks(self) -> int:
+        """Blocks ``alloc`` could grant right now (free + evictable)."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def total_refs(self) -> int:
+        """Sum of refcounts (> used_blocks means prefix sharing is live)."""
+        return sum(self._ref.values())
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def is_registered(self, block: int) -> bool:
+        return block in self._registered
+
+    # -- grant / return ------------------------------------------------------
 
     def alloc(self, n: int) -> list[int] | None:
-        """``n`` physical block ids, or None when fewer than ``n`` are free
-        (all-or-nothing: never a partial grant)."""
+        """``n`` physical block ids at refcount 1, or None when fewer than
+        ``n`` are grantable (all-or-nothing: never a partial grant).
+        Evicts LRU cached blocks only as needed — never a mapped block."""
         if n < 0:
             raise ValueError(f"alloc({n}) is negative")
-        if n > len(self._free):
+        if n > self.allocatable_blocks:
             return None
+        while len(self._free) < n:
+            self._evict_lru()
         blocks = [self._free.pop() for _ in range(n)]
-        self._used.update(blocks)
+        for b in blocks:
+            self._ref[b] = 1
         return blocks
 
+    def incref(self, block: int) -> None:
+        """Map a block into one more page table (prefix reuse).  A cached
+        block is reactivated (leaves the eviction LRU)."""
+        if block in self._ref:
+            self._ref[block] += 1
+        elif block in self._cached:
+            del self._cached[block]
+            self._ref[block] = 1
+        else:
+            raise OutOfBlocksError(
+                f"incref({block}): block is neither active nor cached"
+            )
+
+    def decref(self, block: int) -> None:
+        """Drop one reference.  At refcount 0 a registered block parks in
+        the cached LRU (contents stay lookup-able); an unregistered one
+        returns to the free list."""
+        if block not in self._ref:
+            raise OutOfBlocksError(
+                f"decref({block}): block is not allocated (double free or "
+                "foreign id)"
+            )
+        self._ref[block] -= 1
+        if self._ref[block]:
+            return
+        del self._ref[block]
+        if block in self._registered:
+            self._cached[block] = None  # MRU end of the eviction LRU
+        else:
+            self._free.append(block)
+
     def free(self, blocks: list[int]) -> None:
+        """Drop one reference per block (the release path)."""
         for b in blocks:
-            if b not in self._used:
-                raise OutOfBlocksError(
-                    f"free({b}): block is not allocated (double free or "
-                    "foreign id)"
-                )
-            self._used.remove(b)
-            self._free.append(b)
+            self.decref(b)
+
+    # -- prefix-index hooks --------------------------------------------------
+
+    def register(self, block: int) -> None:
+        """Mark an active block as holding indexed prefix content: when
+        its refcount drops to 0 it becomes cached instead of free."""
+        if block not in self._ref:
+            raise OutOfBlocksError(
+                f"register({block}): block is not active"
+            )
+        self._registered.add(block)
+
+    def unregister(self, block: int) -> None:
+        """Forget a block's indexed status (a write is about to change
+        its contents, or the index dropped it)."""
+        self._registered.discard(block)
+        if block in self._cached:
+            # no references AND no longer indexed: nothing can reach it
+            del self._cached[block]
+            self._free.append(block)
+
+    def _evict_lru(self) -> None:
+        block, _ = self._cached.popitem(last=False)
+        self._registered.discard(block)
+        self.evictions += 1
+        if self._on_evict is not None:
+            self._on_evict(block)
+        self._free.append(block)
 
 
 @dataclasses.dataclass
@@ -96,6 +252,7 @@ class SlotPages:
     blocks: list[int]          # physical block ids, logical order
     capacity_tokens: int       # blocks * block_size
     used_tokens: int = 0       # K/V positions actually written so far
+    prefix_tokens: int = 0     # tokens mapped from the prefix cache at admit
 
 
 class PagedKVCache:
@@ -104,7 +261,7 @@ class PagedKVCache:
     Device arrays (``k_pool``/``v_pool``) are created once and threaded
     functionally through the serving programs; the engine assigns the
     updated arrays back after every call.  Host state (page tables,
-    lengths) advances in lockstep on the engine thread.
+    lengths, the prefix index) advances in lockstep on the engine thread.
     """
 
     def __init__(self, *, num_layers: int, kv_heads: int, head_dim: int,
@@ -122,7 +279,7 @@ class PagedKVCache:
         self.max_context = max_context
         self.blocks_per_slot = max_context // block_size
         self.scratch_block = num_blocks  # reserved physical block
-        self.allocator = BlockAllocator(num_blocks)
+        self.allocator = BlockAllocator(num_blocks, on_evict=self._on_evict)
         shape = (num_layers, num_blocks + 1, block_size, kv_heads, head_dim)
         self.k_pool = jnp.zeros(shape, dtype)
         self.v_pool = jnp.zeros(shape, dtype)
@@ -133,6 +290,80 @@ class PagedKVCache:
         )
         self.seq_lens = np.zeros((max_slots,), np.int32)
         self.pages: list[SlotPages | None] = [None] * max_slots
+        # prefix index: chained content hash -> (physical block, the
+        # block's token tuple), + reverse map for eviction.  The tokens
+        # are stored so every lookup VERIFIES them — hash() is 64-bit
+        # and non-cryptographic, and an unverified chain collision would
+        # silently map another prompt's K/V into a new request (the
+        # vLLM prefix-cache CVE class).  Verifying each matched block's
+        # own tokens suffices: a wrong mapping would need a colliding
+        # parent hash at some earlier step WITH equal tokens at every
+        # step up to it — and token-equal at every step IS the same
+        # prefix.
+        self._hash_to_block: dict[int, tuple[int, tuple[int, ...]]] = {}
+        self._block_hash: dict[int, int] = {}
+        # admission-time accounting (the engine mirrors these into the
+        # obs registry; stats() derives hit rate / occupancy from them)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_cached_tokens = 0
+        self.cow_copies = 0
+
+    def _on_evict(self, block: int) -> None:
+        h = self._block_hash.pop(block, None)
+        if h is not None:
+            self._hash_to_block.pop(h, None)
+
+    # -- prefix index (engine thread only) -----------------------------------
+
+    def _chained_hashes(self, tokens):
+        """(chained hash, block token tuple) per FULL block of
+        ``tokens`` — each hash commits to the entire prefix through its
+        block."""
+        h = 0
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            tok = tuple(tokens[i * bs:(i + 1) * bs])
+            h = hash((h, tok))
+            yield h, tok
+
+    def lookup_prefix(self, tokens) -> list[int]:
+        """Longest indexed chain of full blocks matching ``tokens``,
+        capped so at least one prompt token remains for prefill (the
+        final token's logits must be computed to sample from).  Every
+        matched entry's stored tokens are compared, so a hash collision
+        degrades to a cache miss, never to serving another prompt's
+        K/V.  Pure lookup: no state change, no refcounts taken."""
+        limit = (len(tokens) - 1) // self.block_size
+        blocks: list[int] = []
+        for i, (h, tok) in enumerate(self._chained_hashes(tokens)):
+            if i >= limit:
+                break
+            entry = self._hash_to_block.get(h)
+            if entry is None or entry[1] != tok:
+                break
+            blocks.append(entry[0])
+        return blocks
+
+    def register_prefix(self, slot: int, tokens) -> int:
+        """Index every FULL block of a slot's freshly prefilled prompt.
+        First writer wins: a hash already indexed (necessarily the block
+        this slot mapped at admission, or a concurrent identical prompt
+        that prefilled its own copy) keeps its existing entry.  Returns
+        the number of newly indexed blocks."""
+        pages = self.pages[slot]
+        if pages is None:
+            raise OutOfBlocksError(f"slot {slot} has no pages")
+        added = 0
+        for i, (h, tok) in enumerate(self._chained_hashes(tokens)):
+            b = pages.blocks[i]
+            if h in self._hash_to_block:
+                continue
+            self._hash_to_block[h] = (b, tok)
+            self._block_hash[b] = h
+            self.allocator.register(b)
+            added += 1
+        return added
 
     # -- admission / eviction (engine thread only) ---------------------------
 
@@ -140,29 +371,55 @@ class PagedKVCache:
         """Physical blocks needed to hold ``tokens`` K/V positions."""
         return -(-tokens // self.block_size)
 
-    def admit(self, slot: int, tokens: int) -> bool:
+    def admit(self, slot: int, tokens: int, prompt=None) -> SlotPages | None:
         """Reserve a slot's worst-case footprint (``tokens`` positions).
 
-        All-or-nothing; False = pool pressure, caller keeps the request
-        queued.  The slot must be empty (engine invariant)."""
+        With ``prompt`` (the token list), the longest indexed prefix is
+        mapped into the page table at refcount+1 and only the remaining
+        blocks are freshly allocated — the all-or-nothing contract then
+        covers the worst-case footprint MINUS the mapped prefix.  Returns
+        the slot's :class:`SlotPages` (``prefix_tokens`` tells how much
+        was mapped) or None under pool pressure — a failed grant rolls
+        the prefix mappings back.  The slot must be empty (engine
+        invariant)."""
         if self.pages[slot] is not None:
             raise OutOfBlocksError(f"slot {slot} is already occupied")
         if tokens > self.max_context:
             raise ValueError(
                 f"{tokens} tokens exceed max_context={self.max_context}"
             )
+        prefix_blocks: list[int] = []
+        if prompt is not None:
+            prefix_blocks = self.lookup_prefix(prompt)
         n = self.blocks_for(tokens)
-        blocks = self.allocator.alloc(n)
-        if blocks is None:
-            return False
-        self.pages[slot] = SlotPages(blocks, n * self.block_size)
+        for b in prefix_blocks:
+            self.allocator.incref(b)  # pinned: alloc's eviction can't touch
+        fresh = self.allocator.alloc(n - len(prefix_blocks))
+        if fresh is None:
+            for b in prefix_blocks:
+                self.allocator.decref(b)
+            return None
+        # counted on SUCCESS only — a pool-pressure head retries admission
+        # every scheduler iteration and must not inflate the denominator
+        prefix_tokens = len(prefix_blocks) * self.block_size
+        if prompt is not None:
+            self.prefix_lookups += 1
+        if prefix_blocks:
+            self.prefix_hits += 1
+            self.prefix_cached_tokens += prefix_tokens
+        blocks = prefix_blocks + fresh
+        pages = SlotPages(blocks, n * self.block_size,
+                          used_tokens=prefix_tokens,
+                          prefix_tokens=prefix_tokens)
+        self.pages[slot] = pages
         self.block_tables[slot, :] = self.scratch_block
         self.block_tables[slot, : len(blocks)] = blocks
-        self.seq_lens[slot] = 0
-        return True
+        self.seq_lens[slot] = prefix_tokens
+        return pages
 
     def release(self, slot: int) -> None:
-        """Return the slot's blocks to the pool (eviction path)."""
+        """Drop the slot's block references (eviction path): registered
+        blocks park in the cached LRU, the rest return to the pool."""
         pages = self.pages[slot]
         if pages is None:
             return
@@ -170,6 +427,49 @@ class PagedKVCache:
         self.pages[slot] = None
         self.block_tables[slot, :] = self.scratch_block
         self.seq_lens[slot] = 0
+
+    def ensure_writable(self, slot: int, pos: int) -> str | None:
+        """Copy-on-write guard for an in-place write at ``pos``.
+
+        Returns ``"cow"`` when the target block was shared (refcount > 1)
+        and has been copied into a fresh exclusive block (page table
+        re-pointed), ``"unregistered"`` when it was exclusive but indexed
+        (the entry is dropped — the write would invalidate the cached
+        content), or None when the write was already safe.  Raises under
+        pool pressure if a copy is needed but no block is grantable (the
+        engine's admission contract makes that unreachable: appends land
+        past the prompt, and only full prompt blocks are ever shared)."""
+        pages = self.pages[slot]
+        if pages is None:
+            raise OutOfBlocksError(f"slot {slot} has no pages")
+        li = pos // self.block_size
+        if li >= len(pages.blocks):
+            raise OutOfBlocksError(
+                f"slot {slot}: write at {pos} exceeds reserved capacity "
+                f"{pages.capacity_tokens}"
+            )
+        b = pages.blocks[li]
+        if self.allocator.refcount(b) > 1:
+            fresh = self.allocator.alloc(1)
+            if fresh is None:
+                raise OutOfBlocksError(
+                    f"slot {slot}: copy-on-write at position {pos} needs a "
+                    "block but the pool is exhausted"
+                )
+            dst = fresh[0]
+            self.k_pool, self.v_pool = _copy_block_fn()(
+                self.k_pool, self.v_pool, jnp.int32(b), jnp.int32(dst)
+            )
+            self.allocator.decref(b)
+            pages.blocks[li] = dst
+            self.block_tables[slot, li] = dst
+            self.cow_copies += 1
+            return "cow"
+        if self.allocator.is_registered(b):
+            self._on_evict(b)  # drop the index entry
+            self.allocator.unregister(b)
+            return "unregistered"
+        return None
 
     def note_written(self, slot: int, tokens: int) -> None:
         """Advance a slot's resident-token count (after a program wrote
@@ -189,16 +489,20 @@ class PagedKVCache:
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> dict:
-        """Pool occupancy + internal-fragmentation stats (for
-        ``GET /generatez`` and the engine's metrics.jsonl rows)."""
+        """Pool occupancy, internal fragmentation, and prefix-cache
+        occupancy/hit-rate (for ``GET /generatez``, the registry gauges,
+        and the engine's metrics.jsonl rows)."""
         used = [p for p in self.pages if p is not None]
         allocated_tokens = sum(p.capacity_tokens for p in used)
         used_tokens = sum(p.used_tokens for p in used)
+        alloc = self.allocator
         return {
             "block_size": self.block_size,
-            "blocks_total": self.allocator.num_blocks,
-            "blocks_free": self.allocator.free_blocks,
-            "blocks_used": self.allocator.used_blocks,
+            "blocks_total": alloc.num_blocks,
+            "blocks_free": alloc.free_blocks,
+            "blocks_used": alloc.used_blocks,
+            "blocks_cached": alloc.cached_blocks,
+            "block_refs": alloc.total_refs,
             "slots_occupied": len(used),
             "allocated_tokens": allocated_tokens,
             "resident_tokens": used_tokens,
@@ -207,4 +511,17 @@ class PagedKVCache:
                 1.0 - used_tokens / allocated_tokens if allocated_tokens
                 else 0.0
             ),
+            # prefix cache: share of the pool holding indexed content
+            # (mapped-shared OR parked cached), and the admission hit rate
+            "prefix_blocks_indexed": len(self._hash_to_block),
+            "prefix_occupancy": len(self._hash_to_block) / alloc.num_blocks,
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": (
+                self.prefix_hits / self.prefix_lookups
+                if self.prefix_lookups else 0.0
+            ),
+            "prefix_cached_tokens": self.prefix_cached_tokens,
+            "prefix_evictions": alloc.evictions,
+            "cow_copies": self.cow_copies,
         }

@@ -20,6 +20,19 @@ shapes, so a serving process compiles **exactly two** XLA executables:
   with ``GPTLM`` is pinned by tests/test_serve.py, and every dtype choice
   (bf16 matmuls, fp32 layernorm/softmax/logits) mirrors ``models/gpt.py``
   line for line.
+- :func:`make_gather_cache_fn` — rebuild the dense prefill cache for one
+  slot from its pool blocks (gather through the page-table row).  This is
+  what makes chunked prefill *stateless*: any slot's next chunk can run
+  at any time by re-materializing its cache from the pool, so the
+  scheduler can interleave prefill chunks of several requests with
+  decode steps (ISSUE 14 budgeted prefill), and a request admitted onto
+  a cached prefix starts from the shared blocks without a special load
+  path.  The gathered values are the exact bytes prefill scattered out
+  (or that an earlier request with the same prefix scattered), so the
+  chunk math stays byte-identical to an uninterrupted prefill.
+
+(There is also a tiny pool-level block-copy program in ``serve.kv_cache``
+— the copy-on-write path — compiled only if a CoW ever fires.)
 
 The pool arrays are donated: steady-state serving does not allocate.
 """
@@ -41,6 +54,7 @@ __all__ = [
     "make_prefill_cache",
     "make_prefill_fn",
     "make_decode_fn",
+    "make_gather_cache_fn",
     "reset_cache_index",
 ]
 
@@ -125,6 +139,44 @@ def make_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int):
         return logits[0, last_ix], cache, k_pool, v_pool
 
     return prefill_chunk
+
+
+def make_gather_cache_fn(cfg: GPTConfig, *, block_size: int):
+    """Compiled program: rebuild one slot's dense prefill cache from the
+    paged pool.
+
+    ``fn(k_pool, v_pool, cache, table_row, start) -> cache`` gathers ALL
+    ``max_seq`` positions through ``table_row`` into the (donated) dense
+    cache buffer and sets ``cache_index = start`` — the position the next
+    prefill chunk writes at.  Positions >= ``start`` gather garbage
+    (scratch / stale blocks) but are exactly the positions the decode-mode
+    validity rule masks (``k_idx <= q_pos``) until a chunk overwrites
+    them, so no dynamic-shape masking is needed and the program stays
+    static.  Positions < ``start`` reproduce bit-for-bit the K/V a
+    straight-line prefill would have left in the cache (the pool holds
+    the same bytes the dense cache was sliced into)."""
+    _check_servable(cfg)
+    num_layers = cfg.num_layers
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def gather_cache(k_pool, v_pool, cache, table_row, start):
+        _, nb_total, bs, h_kv, d = k_pool.shape
+        pos = jnp.arange(cfg.max_seq)
+        idx = table_row[pos // block_size] * bs + pos % bs
+        kf = k_pool.reshape(num_layers, nb_total * bs, h_kv, d)[:, idx]
+        vf = v_pool.reshape(num_layers, nb_total * bs, h_kv, d)[:, idx]
+        # (L, max_seq, Hkv, D) -> per-layer (1, Hkv, max_seq, D), the flax
+        # decode-cache layout make_prefill_cache builds.
+        return {
+            f"h{i}": {"attn": {
+                "cached_key": kf[i].transpose(1, 0, 2)[None],
+                "cached_value": vf[i].transpose(1, 0, 2)[None],
+                "cache_index": start.astype(jnp.int32),
+            }}
+            for i in range(num_layers)
+        }
+
+    return gather_cache
 
 
 def make_decode_fn(cfg: GPTConfig):

@@ -19,9 +19,14 @@ Endpoint contract (docs/API.md "Serving"):
   mapping: malformed body/parameters → 400, queue full (backpressure) →
   429, engine failure → 500, wall-clock timeout → 504 (the request keeps
   running server-side; poll ``GET /generatez`` for slot state).
-- ``GET /generatez`` — engine state JSON: queue depth, slot occupancy,
-  paged-KV budget, admission/eviction counters (the scheduler's live
-  control surface).
+- ``GET /generatez`` — engine state JSON: queue depth, slot occupancy
+  (with each slot's ``prefill``/``decode`` phase), paged-KV budget,
+  admission/eviction counters, and the prefix-cache census (``kv``:
+  blocks free/used/cached, fragmentation, prefix occupancy, hit rate,
+  evictions, CoW copies; ``prefill_budget``/``prefix_cache`` config) —
+  the scheduler's live control surface.  The same census rides ``/varz``
+  as ``serve_kv_*`` / ``serve_prefix_*`` registry metrics, so the fleet
+  scraper (``obs.fleet``) sees it without a serve-specific endpoint.
 """
 
 from __future__ import annotations

@@ -113,6 +113,23 @@ def main(argv=None) -> int:
                         "max-context/block-size = no oversubscription)")
     p.add_argument("--prefill-chunk", type=int, default=16,
                    help="prefill program width in tokens")
+    p.add_argument("--prefill-budget", type=int, default=0,
+                   help="max prefill tokens per scheduler iteration "
+                        "(decode-integrated chunked prefill: every "
+                        "iteration runs at most this many tokens of "
+                        "prefill chunks, round-robin across unfilled "
+                        "requests, THEN one decode step for all running "
+                        "slots — a long prompt cannot stall in-flight "
+                        "decode by more than one budget's worth of "
+                        "chunks; 0 = unbudgeted)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="copy-on-write prefix caching: whole token-"
+                        "aligned KV blocks of completed prompts are "
+                        "indexed by content hash and mapped refcount+1 "
+                        "into later requests sharing the prefix, so "
+                        "prefill starts at the first uncached token; "
+                        "refcount-0 blocks stay warm and are LRU-evicted "
+                        "only under pool pressure")
     p.add_argument("--max-context", type=int, default=None,
                    help="serving context cap (default: model max_seq)")
     p.add_argument("--max-new-cap", type=int, default=None,
@@ -179,7 +196,10 @@ def main(argv=None) -> int:
         params, cfg,
         max_slots=args.max_slots, max_queue=args.max_queue,
         block_size=args.block_size, num_blocks=args.kv_blocks,
-        prefill_chunk=args.prefill_chunk, max_context=args.max_context,
+        prefill_chunk=args.prefill_chunk,
+        prefill_budget=args.prefill_budget or None,
+        prefix_cache=args.prefix_cache,
+        max_context=args.max_context,
         max_new_cap=args.max_new_cap, logdir=args.logdir,
         log_every=args.log_every,
     ).start()
@@ -214,9 +234,11 @@ def main(argv=None) -> int:
         "max_slots": args.max_slots, "logdir": args.logdir,
     }), flush=True)
     logging.info(
-        "serving %s on %s:%d (slots=%d queue=%d block=%d)",
+        "serving %s on %s:%d (slots=%d queue=%d block=%d prefix_cache=%s "
+        "prefill_budget=%s)",
         args.config, args.host, server.port, args.max_slots,
-        args.max_queue, args.block_size,
+        args.max_queue, args.block_size, args.prefix_cache,
+        args.prefill_budget or "unbudgeted",
     )
     while not stop.is_set():
         time.sleep(0.2)

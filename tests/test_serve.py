@@ -468,3 +468,372 @@ def test_engine_submit_rejects_bad_trace_id(served_model):
         eng.submit(prompt, max_new_tokens=2, trace_id="x" * 65)
     with pytest.raises(ValueError):
         eng.submit(prompt, max_new_tokens=2, trace_id="")
+
+
+# ----------------------------------------------- refcounts / CoW (ISSUE 14)
+
+
+def test_allocator_refcount_sharing():
+    """A double-mapped block frees only at its LAST decref."""
+    a = BlockAllocator(4)
+    (b,) = a.alloc(1)
+    a.incref(b)
+    assert a.refcount(b) == 2
+    assert a.total_refs == 2 and a.used_blocks == 1
+    a.decref(b)
+    assert a.refcount(b) == 1 and a.free_blocks == 3  # still held
+    a.decref(b)
+    assert a.refcount(b) == 0 and a.free_blocks == 4
+    with pytest.raises(OutOfBlocksError, match="double free|not allocated"):
+        a.decref(b)
+    with pytest.raises(OutOfBlocksError, match="neither active nor cached"):
+        a.incref(b)  # a free block cannot be mapped
+
+
+def test_allocator_release_to_cached_vs_free():
+    """refcount->0: a registered block parks in the cached LRU (contents
+    stay reusable), an unregistered one goes straight to the free list."""
+    a = BlockAllocator(4)
+    reg, plain = a.alloc(2)
+    a.register(reg)
+    a.free([reg, plain])
+    assert a.cached_blocks == 1 and a.free_blocks == 3
+    assert a.used_blocks == 0
+    # a cached block reactivates through incref (prefix-cache hit)
+    a.incref(reg)
+    assert a.refcount(reg) == 1 and a.cached_blocks == 0
+    # unregistering a refcount-0 cached block releases it for real
+    a.decref(reg)
+    assert a.cached_blocks == 1
+    a.unregister(reg)
+    assert a.cached_blocks == 0 and a.free_blocks == 4
+
+
+def test_allocator_eviction_lru_never_touches_mapped():
+    """Under pressure alloc evicts cached blocks LRU-first — and can
+    NEVER evict a mapped block, no matter the pressure."""
+    evicted = []
+    a = BlockAllocator(4, on_evict=evicted.append)
+    blocks = a.alloc(4)
+    for b in blocks[:3]:
+        a.register(b)
+    a.decref(blocks[0])  # LRU order: 0 then 2 (1 stays mapped)
+    a.decref(blocks[2])
+    assert a.cached_blocks == 2 and a.free_blocks == 0
+    got = a.alloc(1)  # grantable via eviction of the LRU cached block
+    assert got is not None
+    assert evicted == [blocks[0]]
+    assert a.evictions == 1
+    # two mapped blocks + one cached remain; a 3-block grant is impossible
+    # even though 1 free + ... no: 0 free, 1 cached -> alloc(2) must fail
+    assert a.alloc(2) is None
+    assert a.refcount(blocks[1]) == 1  # the mapped blocks were untouched
+    assert a.refcount(blocks[3]) == 1
+    got2 = a.alloc(1)  # evicts the remaining cached block
+    assert got2 is not None and evicted == [blocks[0], blocks[2]]
+
+
+def _tokens(rng, n, vocab=512):
+    return [int(t) for t in rng.integers(0, vocab, size=n)]
+
+
+def test_kv_prefix_lookup_register_and_cap():
+    """register_prefix indexes whole prompt blocks; lookup walks the
+    chained hashes and is capped so >= 1 token is always left to
+    prefill."""
+    kv = _kv(num_blocks=8, block_size=4, max_context=32)
+    rng = np.random.default_rng(0)
+    prompt = _tokens(rng, 10)  # 2 full blocks + 2 tail tokens
+    pages = kv.admit(0, tokens=12, prompt=prompt)
+    assert pages is not None and pages.prefix_tokens == 0  # cold index
+    kv.register_prefix(0, prompt)
+    assert kv.stats()["prefix_blocks_indexed"] == 2
+    # identical prompt: both full blocks match
+    assert kv.lookup_prefix(prompt) == pages.blocks[:2]
+    # divergence INSIDE block 2 invalidates block 2's chain, keeps block 1
+    fork = prompt[:5] + [(prompt[5] + 1) % 512] + prompt[6:]
+    assert kv.lookup_prefix(fork) == pages.blocks[:1]
+    # a prompt that IS exactly the indexed blocks: the cap keeps the last
+    # block out so its final token still runs through prefill
+    assert kv.lookup_prefix(prompt[:8]) == pages.blocks[:1]
+    assert kv.lookup_prefix(prompt[:4]) == []  # 4 tokens: cap -> 0 blocks
+
+
+def test_kv_admit_maps_prefix_and_rolls_back_under_pressure():
+    kv = _kv(num_blocks=6, block_size=4, max_context=24, max_slots=3)
+    rng = np.random.default_rng(1)
+    prompt = _tokens(rng, 9)  # blocks: 2 full + tail
+    first = kv.admit(0, tokens=12, prompt=prompt)
+    kv.register_prefix(0, prompt)
+    kv.release(0)  # -> both full blocks parked cached
+    assert kv.allocator.cached_blocks == 2
+    # hit: the new request maps the 2 cached blocks + allocs 1 fresh
+    hit = kv.admit(1, tokens=12, prompt=prompt)
+    assert hit is not None and hit.prefix_tokens == 8
+    assert hit.blocks[:2] == first.blocks[:2]
+    assert kv.allocator.refcount(first.blocks[0]) == 1
+    # double-map: a THIRD identical request shares at refcount 2
+    hit2 = kv.admit(2, tokens=12, prompt=prompt)
+    assert hit2 is not None and hit2.prefix_tokens == 8
+    assert kv.allocator.refcount(first.blocks[0]) == 2
+    # pressure rollback: slot 1+2 hold 2 shared + 2 exclusive; free pool
+    # is 2 blocks -> a 16-token no-prefix admission needs 4, must fail
+    # WITHOUT leaking refcounts on anything
+    kv.release(2)
+    refs_before = kv.allocator.total_refs
+    assert kv.admit(2, tokens=16, prompt=_tokens(rng, 15)) is None
+    assert kv.allocator.total_refs == refs_before
+    assert kv.stats()["prefix_hits"] == 2
+
+
+def test_kv_cow_copies_shared_block_before_write():
+    kv = _kv(num_blocks=8, block_size=4, max_context=16, max_slots=2)
+    rng = np.random.default_rng(2)
+    prompt = _tokens(rng, 8)
+    kv.admit(0, tokens=8, prompt=prompt)
+    # give the pool recognizable contents for the copy check
+    kv.k_pool = kv.k_pool.at[:, kv.pages[0].blocks[0]].set(7.0)
+    kv.register_prefix(0, prompt)
+    kv.release(0)
+    a = kv.admit(0, tokens=8, prompt=prompt)
+    b = kv.admit(1, tokens=8, prompt=prompt)
+    shared = a.blocks[0]
+    assert b.blocks[0] == shared
+    assert kv.allocator.refcount(shared) == 2
+    # a write into the shared block must copy first
+    assert kv.ensure_writable(1, 0) == "cow"
+    assert kv.pages[1].blocks[0] != shared
+    assert kv.allocator.refcount(shared) == 1
+    assert kv.allocator.refcount(kv.pages[1].blocks[0]) == 1
+    assert int(kv.block_tables[1, 0]) == kv.pages[1].blocks[0]
+    np.testing.assert_array_equal(
+        np.asarray(kv.k_pool[:, kv.pages[1].blocks[0]]),
+        np.asarray(kv.k_pool[:, shared]),
+    )
+    assert kv.stats()["cow_copies"] == 1
+    # slot 0's block is now exclusive but still INDEXED: writing it must
+    # drop the index entry instead of corrupting future lookups
+    assert kv.ensure_writable(0, 0) == "unregistered"
+    assert kv.lookup_prefix(prompt + [1]) == []
+    # and a plain exclusive unindexed block needs nothing
+    assert kv.ensure_writable(1, 0) is None
+
+
+def test_kv_lookup_verifies_tokens_not_just_hashes():
+    """A chain-hash collision must degrade to a MISS, never map another
+    prompt's blocks (hash() is 64-bit and non-cryptographic — the
+    unverified-lookup failure mode is silent cross-request K/V reuse).
+    Simulated by planting a colliding entry with foreign tokens."""
+    kv = _kv(num_blocks=8, block_size=4, max_context=16)
+    rng = np.random.default_rng(4)
+    prompt = _tokens(rng, 8)
+    kv.admit(0, tokens=8, prompt=prompt)
+    kv.register_prefix(0, prompt)
+    kv.release(0)
+    assert len(kv.lookup_prefix(prompt + [1])) == 2  # honest entries hit
+    h, _tok = next(iter(kv._chained_hashes(prompt)))
+    block, tok = kv._hash_to_block[h]
+    kv._hash_to_block[h] = (block, tuple((t + 1) % 512 for t in tok))
+    assert kv.lookup_prefix(prompt + [1]) == []  # collision -> miss
+    kv._hash_to_block[h] = (block, tok)
+    assert len(kv.lookup_prefix(prompt + [1])) == 2
+
+
+def test_kv_eviction_drops_index_entry():
+    kv = _kv(num_blocks=3, block_size=4, max_context=12)
+    rng = np.random.default_rng(3)
+    prompt = _tokens(rng, 9)
+    kv.admit(0, tokens=12, prompt=prompt)
+    kv.register_prefix(0, prompt)
+    kv.release(0)
+    assert len(kv.lookup_prefix(prompt)) == 2
+    # a full-pool admission evicts both cached blocks
+    assert kv.admit(1, tokens=12) is not None
+    assert kv.lookup_prefix(prompt) == []
+    assert kv.stats()["prefix_evictions"] == 2
+    assert kv.stats()["prefix_blocks_indexed"] == 0
+
+
+# ----------------------------------- prefix caching + budget in the engine
+
+
+def test_engine_prefix_cache_parity_and_accounting(served_model):
+    """With prefix caching AND a prefill budget on, a repeated prompt is
+    served from shared blocks — and the output stays token-for-token
+    equal to the dense whole-batch scan (greedy path)."""
+    cfg, params, ids = served_model
+    dense = np.asarray(generate(params, ids[:1], cfg=cfg, max_new_tokens=6))
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params, prefix_cache=True, prefill_budget=4)
+    first = eng.submit(prompt, max_new_tokens=6)
+    _drain(eng, [first])
+    second = eng.submit(prompt, max_new_tokens=6)
+    _drain(eng, [second])
+    assert first.tokens == list(dense[0, 8:])
+    assert second.tokens == list(dense[0, 8:])
+    # 8-token prompt, block 4: 1 full block mapped (cap leaves the rest)
+    assert first.cached_prefix_tokens == 0
+    assert second.cached_prefix_tokens == 4
+    assert second.prefill_tokens == 4
+    st = eng.state()
+    assert st["kv"]["prefix_hits"] == 1
+    assert st["kv"]["prefix_lookups"] == 2
+    assert st["kv"]["prefix_cached_tokens"] == 4
+    assert eng.counters["prefill_tokens"] == 8 + 4
+    assert st["prefix_cache"] is True
+    assert st["kv"]["prefix_hit_rate"] == pytest.approx(0.5)
+    assert st["kv"]["prefix_blocks_indexed"] >= 1
+    # everything released cleanly: shared blocks parked cached, not leaked
+    assert st["kv"]["blocks_used"] == 0
+    assert st["kv"]["blocks_cached"] >= 1
+
+
+def test_engine_prefix_cache_longer_prompt_reuses_header(served_model):
+    """The few-shot pattern: a LONGER prompt sharing the indexed header
+    maps the header blocks and prefills only its own tail — and matches
+    the dense scan run on the long prompt."""
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    long_prompt = prompt + [int(t) for t in np.asarray(ids)[1]][:4]
+    eng = _engine(cfg, params, prefix_cache=True)
+    warm = eng.submit(prompt, max_new_tokens=2)
+    _drain(eng, [warm])
+    req = eng.submit(long_prompt, max_new_tokens=5)
+    _drain(eng, [req])
+    assert req.cached_prefix_tokens == 8  # both header blocks mapped
+    dense = np.asarray(generate(
+        params, jnp.asarray([long_prompt]), cfg=cfg, max_new_tokens=5
+    ))
+    assert req.tokens == list(dense[0, len(long_prompt):])
+
+
+def test_engine_seeded_sampling_invariant_under_prefix_reuse(served_model):
+    """Seeded temperature/top-k sampling draws identical tokens whether
+    the prompt was prefilled from scratch or mapped from the prefix cache
+    (logit bitwise-equality under reuse)."""
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    kw = dict(max_new_tokens=8, temperature=0.8, top_k=24, seed=5)
+    eng = _engine(cfg, params, prefix_cache=True, prefill_budget=4)
+    warm = eng.submit(prompt, **kw)  # cold: full prefill, no mapping
+    _drain(eng, [warm])
+    hit = eng.submit(prompt, **kw)   # identical seed, cached prefix
+    _drain(eng, [hit])
+    assert warm.cached_prefix_tokens == 0
+    assert hit.cached_prefix_tokens > 0
+    assert hit.tokens == warm.tokens
+
+
+def test_budget_long_prompt_cannot_stall_decode(served_model):
+    """Fairness bound: with a prefill budget of one chunk, an admitted
+    long prompt delays the running request's next token by at most one
+    chunk per iteration — the victim gains exactly one token every
+    scheduler iteration while the intruder fills."""
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    intruder_prompt = [int(t) for t in
+                       np.asarray(ids).reshape(-1)] * 3  # 48 tokens
+    eng = _engine(cfg, params, prefill_budget=4, max_context=64)
+    victim = eng.submit(prompt, max_new_tokens=40)
+    while not victim.tokens:
+        eng.step()
+    intruder = eng.submit(intruder_prompt, max_new_tokens=2)
+    # 48-token prompt / 4-token chunks = 12 fill iterations
+    for i in range(12):
+        before = len(victim.tokens)
+        eng.step()
+        assert len(victim.tokens) == before + 1, (
+            f"victim stalled at fill iteration {i}"
+        )
+    assert intruder.tokens, "intruder prefill should have completed"
+    _drain(eng, [victim, intruder])
+    assert victim.status == "ok" and intruder.status == "ok"
+    # and the budget actually spread the fill: >= 12 prefill iterations
+    assert eng.prefill_iters >= 12
+
+
+def test_unbudgeted_engine_prefills_to_completion(served_model):
+    """prefill_budget=None keeps the PR-6 behavior: the whole prompt
+    fills in one iteration (all chunks), then decode resumes."""
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params)
+    victim = eng.submit(prompt, max_new_tokens=8)
+    while not victim.tokens:
+        eng.step()
+    intruder = eng.submit(prompt * 4, max_new_tokens=2)  # 32 tokens
+    eng.step()  # ONE iteration runs all 8 chunks
+    assert intruder.tokens  # first token already sampled
+    _drain(eng, [victim, intruder])
+
+
+def test_prefix_requests_jsonl_fields_and_schema(served_model, tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import check_metrics_schema as checker
+
+    from distributedtensorflow_tpu.obs.registry import Registry
+
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    # isolated registry: the engine's metrics.prom must carry only the
+    # serve_* families, not whatever earlier tests left in the default
+    eng = _engine(cfg, params, prefix_cache=True, prefill_budget=8,
+                  logdir=str(tmp_path), log_every=1, registry=Registry())
+    warm = eng.submit(prompt, max_new_tokens=3)
+    _drain(eng, [warm])  # indexes the prompt's full blocks
+    reqs = [eng.submit(prompt, max_new_tokens=3) for _ in range(2)]
+    _drain(eng, reqs)
+    eng.stop()
+    rows = [json.loads(line) for line in
+            open(os.path.join(tmp_path, "requests.jsonl"))]
+    ok = [r for r in rows if r["status"] == "ok"]
+    assert all(
+        r["cached_prefix_tokens"] + r["prefill_tokens"]
+        == r["prompt_tokens"] for r in ok
+    )
+    assert sum(r["cached_prefix_tokens"] > 0 for r in ok) == 2
+    for path in ("requests.jsonl", "metrics.jsonl", "metrics.prom"):
+        errors, _ = checker.check_file(os.path.join(tmp_path, path))
+        assert errors == [], (path, errors)
+    # a mangled split must be CAUGHT by the checker
+    bad = dict(ok[0], cached_prefix_tokens=ok[0]["cached_prefix_tokens"] + 1)
+    p = tmp_path / "requests_bad.jsonl"
+    p.write_text(json.dumps(bad) + "\n")
+    errors, _ = checker.check_file(str(p))
+    assert any("prompt_tokens" in e for e in errors)
+
+
+def test_run_report_prefix_section(served_model, tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import run_report
+
+    cfg, params, ids = served_model
+    prompt = [int(t) for t in np.asarray(ids)[0]]
+    eng = _engine(cfg, params, prefix_cache=True, prefill_budget=4,
+                  logdir=str(tmp_path), log_every=1)
+    warm = eng.submit(prompt, max_new_tokens=3)
+    _drain(eng, [warm])  # indexes the prompt's full blocks
+    reqs = [eng.submit(prompt, max_new_tokens=3) for _ in range(2)]
+    _drain(eng, reqs)
+    eng.stop()
+    report = run_report.build_report(str(tmp_path))
+    srv = report["serving"]
+    pc = srv["prefix_cache"]
+    assert pc["requests_with_hits"] == 2
+    assert pc["cached_tokens"] == 8
+    assert 0 < pc["cached_token_share"] < 1
+    ts = srv["token_split"]
+    assert ts["prompt_cached"] == 8
+    assert ts["prompt_prefilled"] == 3 * 8 - 8
+    assert ts["decode"] == 9
+    bu = srv["prefill_budget"]
+    assert bu["budget_tokens"] == 4
+    assert 0 < bu["utilization"] <= 1.0
+    text = run_report.render(report)
+    assert "prefix cache: hit rate" in text
+    assert "tokens/iteration" in text
+    assert report["parse_errors"] == 0

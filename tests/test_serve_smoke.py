@@ -160,6 +160,107 @@ def test_serve_smoke_concurrent_requests(tmp_path):
     assert chk.returncode == 0, chk.stdout + chk.stderr
 
 
+def test_serve_smoke_prefix_cache_and_budget(tmp_path):
+    """ISSUE 14 slow-lane smoke: serve.py with --prefix-cache and
+    --prefill-budget, clients sharing a long prompt header.  Asserts the
+    cache actually fired (serve_prefix_hits_total > 0 on /varz), the
+    requests.jsonl rows carry the cached/prefilled split, the schema
+    gates stay green, and run_report renders the prefix-cache section."""
+    logdir = str(tmp_path / "serve_prefix")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--config", "gpt_tiny", "--port", "0",
+            "--max-slots", "2", "--max-queue", "32",
+            "--block-size", "8", "--prefill-chunk", "8",
+            "--prefill-budget", "16", "--prefix-cache",
+            "--max-context", "128", "--logdir", logdir,
+            "--log-every", "5",
+        ],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        boot = json.loads(proc.stdout.readline())
+        port = boot["port"]
+        header = list(range(1, 41))  # 5 whole 8-token blocks shared
+        # warm request indexes the header blocks...
+        _post(port, {"prompt": header + [100], "max_new_tokens": 4})
+        # ...then every follow-up with the same header maps them shared
+        results = [
+            _post(port, {"prompt": header + [100 + i, 200 + i],
+                         "max_new_tokens": 4})
+            for i in range(6)
+        ]
+        for status, body in results:
+            assert status == 200, body
+            assert body["new_tokens"] >= 1
+
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/varz", timeout=10
+        )
+        varz = r.read().decode()
+        hits = [line for line in varz.splitlines()
+                if line.startswith("serve_prefix_hits_total")]
+        assert hits and float(hits[0].split()[-1]) > 0, hits
+        cached = [line for line in varz.splitlines()
+                  if line.startswith("serve_prefix_cached_tokens_total")]
+        assert cached and float(cached[0].split()[-1]) >= 40 * 6
+
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/generatez", timeout=10
+        )
+        state = json.loads(r.read().decode())
+        assert state["prefix_cache"] is True
+        assert state["prefill_budget"] == 16
+        assert state["kv"]["prefix_hits"] >= 6
+        assert state["kv"]["prefix_blocks_indexed"] >= 5
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, err[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+
+    rows = [json.loads(line)
+            for line in open(os.path.join(logdir, "requests.jsonl"))]
+    ok = [r for r in rows if r["status"] == "ok"]
+    assert sum(r["cached_prefix_tokens"] > 0 for r in ok) >= 6
+    assert all(r["cached_prefix_tokens"] + r["prefill_tokens"]
+               == r["prompt_tokens"] for r in ok)
+
+    chk = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_metrics_schema.py"),
+         os.path.join(logdir, "requests.jsonl"),
+         os.path.join(logdir, "metrics.jsonl"),
+         os.path.join(logdir, "metrics.prom")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+         logdir, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    srv = json.loads(rep.stdout)["serving"]
+    assert srv["prefix_cache"]["requests_with_hits"] >= 6
+    assert srv["prefix_cache"]["cached_token_share"] > 0.5
+    assert srv["prefill_budget"]["budget_tokens"] == 16
+
+    text = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+         logdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "prefix cache: hit rate" in text.stdout
+
+
 def test_bench_serve_smoke():
     """BENCH_SERVE_TEST=1 CPU smoke: one JSON line, same bench contract."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SERVE_TEST="1")
@@ -177,3 +278,11 @@ def test_bench_serve_smoke():
     assert head["ok"] > 0
     assert head["ttft_p99_s"] >= head["ttft_p50_s"] >= 0
     assert result["curve"]
+    # ISSUE 14 sweeps ride the same smoke
+    prefix = result["shared_prefix"]
+    assert prefix["on"]["cached_prefix_tokens"] > 0
+    assert prefix["off"]["cached_prefix_tokens"] == 0
+    assert prefix["speedup"] > 0
+    rows = result["interference"]["rows"]
+    assert rows and all(r["victims_ok"] >= 1 for r in rows)
+    assert {r["prefill_budget"] for r in rows} == {0, 16}

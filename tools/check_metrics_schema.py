@@ -13,7 +13,11 @@ flight-recorder event schema; basenames starting with ``goodput`` against
 the goodput-ledger document schema; basenames starting with ``captures``
 against the reactive-profiler manifest schema; basenames starting with
 ``faults`` against the chaos fault-log schema; basenames starting with
-``requests`` against the serving per-request log schema; basenames
+``requests`` against the serving per-request log schema (ok rows also
+carry the ISSUE-14 prefix-cache split when present:
+``cached_prefix_tokens >= 0``, ``prefill_tokens >= 0``, the two summing
+exactly to ``prompt_tokens``, plus a non-negative ``itl_max_s``);
+basenames
 starting with ``flash_blocks`` against the flash-attention autotune cache
 schema (ops/flash_tuning.py: version 1, entries with platform/dtype/
 shape, blocks dividing seq, known sources); basenames starting with
@@ -36,7 +40,9 @@ collective set — see :data:`COLLECTIVE_OPS` — ``overlapped`` labels to
 :data:`PREFETCH_COMPONENTS` / :data:`PREFETCH_DIRECTIONS`, the fleet
 ``fleet_peers`` ``state`` label to :data:`FLEET_PEER_STATES`, and
 ``slo_burn_rate`` samples to a known ``window`` label with a
-non-negative value, and the resilient-transport ``rpc_*`` /
+non-negative value, the serving prefix-cache families
+(``serve_prefix_*`` / ``serve_kv_*``) to non-negative values with the
+ratio gauges in [0, 1], and the resilient-transport ``rpc_*`` /
 ``breaker_*`` families to known endpoint prefixes / retry outcomes /
 breaker-state encodings); basenames starting with ``dispatcher`` and
 ending ``.journal`` against the dispatcher durability-journal schema
@@ -239,6 +245,31 @@ def _check_endpoint_value(value: str) -> str | None:
 REQUEST_STATES = ("ok", "rejected", "error")
 FINISH_REASONS = ("eos", "length")
 
+#: Serving prefix-cache metric families (serve/engine.py, ISSUE 14).
+#: The monotonic counters must be non-negative; the ratio gauges live in
+#: [0, 1].  Checked both as .prom samples and as jsonl-flattened /
+#: engine-metrics-row field names.
+SERVE_PREFIX_COUNTERS = (
+    "serve_prefix_hits_total", "serve_prefix_cached_tokens_total",
+    "serve_prefill_tokens_total", "serve_prefix_evictions_total",
+    "serve_kv_cow_copies_total", "serve_kv_block_refs",
+    "serve_kv_blocks_cached",
+)
+SERVE_PREFIX_RATIOS = (
+    "serve_prefix_hit_rate", "serve_prefix_cache_occupancy",
+    "serve_kv_fragmentation",
+)
+#: Their spellings inside the serving engine's own metrics.jsonl rows.
+SERVE_ROW_COUNTERS = (
+    "prefix_hits_total", "prefix_lookups_total",
+    "prefix_cached_tokens_total", "prefill_tokens_total",
+    "prefix_evictions_total", "cow_copies_total", "blocks_cached",
+    "block_refs", "prefill_iters", "prefill_chunks", "prefill_budget",
+)
+SERVE_ROW_RATIOS = (
+    "prefix_hit_rate", "prefix_occupancy", "kv_fragmentation",
+)
+
 #: The known ``op`` labels of the ``collective_dispatch_seconds``
 #: histogram (parallel/collectives.py wrappers — duplicated for the same
 #: stdlib-only reason).  ``reduce_scatter`` / ``all_gather`` cover both
@@ -428,6 +459,20 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                     f"line {lineno}: field {k!r} carries non-numeric "
                     f"pipeline stage label {m.group(1)!r}"
                 )
+        if (k.startswith(SERVE_PREFIX_COUNTERS) or k in SERVE_ROW_COUNTERS) \
+                and isinstance(v, (int, float)) \
+                and not isinstance(v, bool) and math.isfinite(v) and v < 0:
+            errors.append(
+                f"line {lineno}: field {k!r} is negative ({v}) — the "
+                "serving prefix-cache counters are monotonic"
+            )
+        if (k in SERVE_ROW_RATIOS or k.startswith(SERVE_PREFIX_RATIOS)) \
+                and isinstance(v, (int, float)) \
+                and not isinstance(v, bool) and math.isfinite(v) \
+                and not 0.0 <= v <= 1.0:
+            errors.append(
+                f"line {lineno}: field {k!r} {v!r} is not in [0, 1]"
+            )
         if v in ("NaN", "Infinity", "-Infinity"):
             warnings.append(f"line {lineno}: field {k!r} is non-finite ({v})")
         elif isinstance(v, bool) or not isinstance(v, (int, float)):
@@ -890,6 +935,35 @@ def check_requests_file(path: str) -> tuple[list[str], list[str]]:
                     or slot < -1:
                 errors.append(f"line {i}: 'slot' {slot!r} is not an "
                               "integer >= -1")
+            # prefix-cache accounting (ISSUE 14; present on engines built
+            # since then — validated when present so pre-ISSUE-14 logs in
+            # ARTIFACTS stay green): the cached/prefilled split must tile
+            # the prompt exactly.
+            split = {}
+            for name in ("cached_prefix_tokens", "prefill_tokens"):
+                v = row.get(name)
+                if v is None:
+                    continue
+                if not _nonneg_int(v):
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative integer")
+                else:
+                    split[name] = int(v)
+            if len(split) == 2 and _nonneg_int(row.get("prompt_tokens")) \
+                    and sum(split.values()) != int(row["prompt_tokens"]):
+                errors.append(
+                    f"line {i}: cached_prefix_tokens "
+                    f"{split['cached_prefix_tokens']} + prefill_tokens "
+                    f"{split['prefill_tokens']} != prompt_tokens "
+                    f"{int(row['prompt_tokens'])}"
+                )
+            itl = row.get("itl_max_s")
+            if itl is not None and (
+                isinstance(itl, bool) or not isinstance(itl, (int, float))
+                or not math.isfinite(itl) or itl < 0
+            ):
+                errors.append(f"line {i}: 'itl_max_s' {itl!r} is not a "
+                              "non-negative finite number")
     return errors, warnings
 
 
@@ -1021,6 +1095,21 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                         f"line {i}: {name} carries unknown fleet peer "
                         f"state {state!r} (known: {FLEET_PEER_STATES})"
                     )
+            if name in SERVE_PREFIX_COUNTERS or name in SERVE_PREFIX_RATIOS:
+                try:
+                    v = float(value)
+                except ValueError:
+                    v = None  # already reported above
+                if v is not None and math.isfinite(v):
+                    if v < 0:
+                        errors.append(
+                            f"line {i}: {name} is negative ({value}) — "
+                            "serving prefix-cache samples are non-negative"
+                        )
+                    elif name in SERVE_PREFIX_RATIOS and v > 1.0:
+                        errors.append(
+                            f"line {i}: {name} {value} is not in [0, 1]"
+                        )
             if name.startswith(
                 ("pipeline_handoff_seconds", "pipeline_mpmd_stall_seconds")
             ):

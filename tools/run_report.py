@@ -35,7 +35,10 @@ writes — and prints:
   restarts cost;
 - serving: the request-level story from ``requests.jsonl`` (serve.py
   logdirs) — terminal-state counts, TTFT/TPOT/e2e p50+p99, batch
-  occupancy, rejects, delivered tokens/sec;
+  occupancy, rejects, delivered tokens/sec, plus the ISSUE-14
+  prefix-cache story (hit rate, cached-token share, prefill-vs-decode
+  token split) and the per-iteration prefill-budget utilization from
+  the engine's metrics rows;
 - input plane: data-wait share of step time, live adaptive prefetch
   depth / data-service credit window, per-worker fetch throughput,
   dropped workers, and elastic ``data_reshard`` events;
@@ -292,11 +295,15 @@ def resilience_summary(faults: list[dict], flight: list[dict],
     return out
 
 
-def serving_summary(rows: list[dict]) -> dict:
+def serving_summary(rows: list[dict], metrics_rows: list[dict] | None
+                    = None) -> dict:
     """The serving digest from ``requests.jsonl`` (serve.py logdirs):
     terminal-state counts, SLO percentiles (TTFT / TPOT / e2e p50+p99),
     batch occupancy (per-request mean/max fields written by the engine),
-    and delivered token throughput over the log's time span."""
+    and delivered token throughput over the log's time span.  With the
+    engine's ``metrics.jsonl`` rows (ISSUE 14), also the prefix-cache
+    story — hit rate, cached-token share, prefill-vs-decode token split —
+    and the per-iteration prefill-budget utilization."""
     if not rows:
         return {}
     by_status: dict[str, int] = {}
@@ -335,7 +342,7 @@ def serving_summary(rows: list[dict]) -> dict:
     for r in ok:
         fr = str(r.get("finish_reason", "?"))
         reasons[fr] = reasons.get(fr, 0) + 1
-    return {
+    out = {
         "requests": len(rows),
         "by_status": dict(sorted(by_status.items(), key=lambda kv: -kv[1])),
         "rejected": by_status.get("rejected", 0),
@@ -349,6 +356,52 @@ def serving_summary(rows: list[dict]) -> dict:
         "occupancy_mean": (sum(occ_mean) / len(occ_mean)
                            if occ_mean else 0.0),
     }
+    # prefix-cache accounting (per-request split fields, ISSUE 14):
+    # cached_prefix_tokens + prefill_tokens tile each ok row's prompt.
+    split_rows_ = [
+        r for r in ok
+        if isinstance(r.get("cached_prefix_tokens"), (int, float))
+        and isinstance(r.get("prefill_tokens"), (int, float))
+    ]
+    if split_rows_:
+        cached = sum(r["cached_prefix_tokens"] for r in split_rows_)
+        prefilled = sum(r["prefill_tokens"] for r in split_rows_)
+        prompt_total = cached + prefilled
+        out["prefix_cache"] = {
+            "requests_with_hits": sum(
+                1 for r in split_rows_ if r["cached_prefix_tokens"] > 0
+            ),
+            "hit_rate": (sum(
+                1 for r in split_rows_ if r["cached_prefix_tokens"] > 0
+            ) / len(split_rows_)),
+            "cached_tokens": cached,
+            "cached_token_share": (cached / prompt_total
+                                   if prompt_total else 0.0),
+        }
+        out["token_split"] = {
+            "prompt_cached": cached,
+            "prompt_prefilled": prefilled,
+            "decode": tokens,
+        }
+    # per-iteration prefill-budget utilization, from the engine's last
+    # metrics.jsonl row (cumulative chunk/iteration counters + config)
+    last = {}
+    for r in metrics_rows or []:
+        if "prefill_iters" in r:
+            last = r
+    iters = last.get("prefill_iters")
+    chunk = last.get("prefill_chunk")
+    budget = last.get("prefill_budget")
+    if isinstance(iters, (int, float)) and iters \
+            and isinstance(chunk, (int, float)):
+        per_iter = last.get("prefill_chunks", 0) * chunk / iters
+        bu = {"prefill_iters": int(iters),
+              "tokens_per_iter": per_iter,
+              "budget_tokens": int(budget or 0)}
+        if budget:
+            bu["utilization"] = min(per_iter / budget, 1.0)
+        out["prefill_budget"] = bu
+    return out
 
 
 def step_time_opt_summary(train: list[dict], logdir: str) -> dict:
@@ -824,7 +877,7 @@ def build_report(logdir: str) -> dict:
         "captures": capture_summary(captures),
         "goodput": goodput,
         "resilience": resilience_summary(faults, flight, goodput),
-        "serving": serving_summary(requests),
+        "serving": serving_summary(requests, train),
         "fleet": fleet,
         "rpc": rpc,
         # metric-stream health: any unparseable metrics.jsonl / trace /
@@ -1012,6 +1065,30 @@ def render(report: dict) -> str:
             fr = ", ".join(f"{k} x{v}"
                            for k, v in sorted(srv["finish_reasons"].items()))
             lines.append(f"  finish: {fr}")
+        pc = srv.get("prefix_cache")
+        if pc:
+            lines.append(
+                f"  prefix cache: hit rate {pc['hit_rate']:.0%} "
+                f"({pc['requests_with_hits']} request(s)), "
+                f"{pc['cached_tokens']} cached tokens "
+                f"({pc['cached_token_share']:.0%} of prompt tokens)"
+            )
+        ts = srv.get("token_split")
+        if ts:
+            lines.append(
+                f"  tokens: {ts['prompt_prefilled']} prefilled + "
+                f"{ts['prompt_cached']} cache-mapped prompt, "
+                f"{ts['decode']} decoded"
+            )
+        bu = srv.get("prefill_budget")
+        if bu:
+            util = (f", {bu['utilization']:.0%} of the "
+                    f"{bu['budget_tokens']}-token budget"
+                    if "utilization" in bu else " (unbudgeted)")
+            lines.append(
+                f"  prefill: {bu['tokens_per_iter']:.1f} tokens/iteration "
+                f"over {bu['prefill_iters']} iteration(s){util}"
+            )
         if srv.get("rejected"):
             lines.append(f"  REJECTED {srv['rejected']} request(s) "
                          "(queue backpressure)")
