@@ -24,6 +24,18 @@ the socket stack's).  Three sweeps, selectable via ``BENCH_SERVE_MODE``
   scheduler interleaves at most one budget's worth of chunks per decode
   step, so victim TPOT/ITL p99 is bounded by the budget, independent of
   the intruder length.
+- **decode fast path** (ISSUE 15, ``spec`` / ``--spec-sweep``): A/B/C
+  arms — host sampling vs ``fused_sampling`` vs fused + ``speculate K``
+  — over a *repetitive-suffix* workload (periodic prompts, greedy: the
+  n-gram drafter hits, bursts amortize dispatches) and a *random-text*
+  workload (uniform prompts, seeded temperature sampling: the drafter
+  whiffs and speculation must cost ~nothing because draft-less
+  iterations run the one-token fused program).  Per arm: tokens/sec,
+  TPOT p50/p99, draft acceptance rate, mean tokens per decode step PER
+  SLOT (1.0 without speculation, up to K+1 on accepted bursts), and
+  dispatches per decode step (decode program executions + host
+  sampling rounds: the per-token round-trip count each running request
+  experiences — host sampling = 2, fused = 1).
 
 Evidence discipline (same contract as bench_generate.py): headline
 operating points are the MEDIAN OF 3 independent trials with relative
@@ -39,9 +51,11 @@ default 64), ``BENCH_SERVE_SLOTS`` (default 8), ``BENCH_SERVE_MODEL``
 (``small``/``tiny``), ``BENCH_SERVE_HEADER`` (shared header tokens,
 default 256), ``BENCH_SERVE_BUDGET`` (prefill budget tokens, default 2
 chunks), ``BENCH_SERVE_CTX`` (serving max_context, default 1024 — the
-decode gather scales with it, so slow boxes shrink it), and
-``BENCH_SERVE_TEST=1`` CPU smoke (tiny model, 2 slots, few requests,
-nothing persisted).
+decode gather scales with it, so slow boxes shrink it),
+``BENCH_SERVE_SPEC_K`` (draft length, default 4) /
+``BENCH_SERVE_SPEC_PROMPT`` (spec-sweep prompt tokens; ``--spec-sweep``
+on argv == ``BENCH_SERVE_MODE=spec``), and ``BENCH_SERVE_TEST=1`` CPU
+smoke (tiny model, 2 slots, few requests, nothing persisted).
 """
 
 from __future__ import annotations
@@ -49,6 +63,7 @@ from __future__ import annotations
 import json
 import os
 import statistics
+import sys
 import time
 
 from bench_probe import enable_compile_cache, probe_devices_with_retries
@@ -277,6 +292,114 @@ def _interference_sweep(make_engine, *, victims: int, victim_prompt: int,
     }
 
 
+def _spec_sweep(make_engine, *, n: int, new: int, prompt_len: int,
+                vocab: int, speculate: int) -> dict:
+    """Host vs fused vs fused+speculate over a repetitive-suffix and a
+    random-text workload (saturation offered load, counters per-trial
+    deltas so one engine per arm serves every trial)."""
+    rng = np.random.default_rng(23)
+    period = 8
+    base = list(map(int, rng.integers(0, vocab, size=period)))
+    rep_prompts = []
+    for _ in range(n):
+        head = list(map(int, rng.integers(0, vocab, size=4)))
+        body = (base * (prompt_len // period + 2))[: prompt_len - len(head)]
+        rep_prompts.append(head + body)
+    rand_prompts = [
+        list(map(int, rng.integers(0, vocab, size=prompt_len)))
+        for _ in range(n)
+    ]
+    workloads = {
+        # greedy: deterministic, the drafter's best case
+        "repetitive": (rep_prompts, {}),
+        # seeded sampling over uniform prompts: the drafter's worst case
+        "random": (rand_prompts, {"temperature": 1.0, "top_k": 64}),
+    }
+    arm_cfg = {
+        "host": {},
+        "fused": {"fused_sampling": True},
+        "spec": {"fused_sampling": True, "speculate": speculate},
+    }
+    out = {"speculate": speculate, "requests": n, "max_new_tokens": new,
+           "prompt_tokens": prompt_len,
+           "workloads": {wname: {} for wname in workloads}}
+    # Arm-outer: ONE engine (one paged KV pool + compiled program set)
+    # resident at a time — three simultaneous gpt_small pools would
+    # triple peak host memory for nothing, since the counters are
+    # per-trial deltas anyway.
+    for aname, akw in arm_cfg.items():
+        engine = make_engine(**akw)
+        # Warm with one prompt from EACH workload: a periodic prompt
+        # drafts, so the spec arm's T=K+1 verify program compiles here
+        # instead of inside trial 1.
+        engine.generate(rep_prompts[0], max_new_tokens=new, timeout=300)
+        engine.generate(rand_prompts[0], max_new_tokens=new,
+                        temperature=1.0, top_k=64, timeout=300)
+        for wname, (prompts, skw) in workloads.items():
+            trials = []
+            for _ in range(3):
+                c0 = dict(engine.counters)
+                steps0 = engine.decode_steps
+                t0 = time.perf_counter()
+                reqs = [engine.submit(p, max_new_tokens=new, seed=j, **skw)
+                        for j, p in enumerate(prompts)]
+                for r in reqs:
+                    r.wait(600)
+                makespan = time.perf_counter() - t0
+                ok = [r for r in reqs if r.status == "ok"]
+                dc = {k: engine.counters[k] - c0[k] for k in c0}
+                steps = engine.decode_steps - steps0
+                tokens = sum(len(r.tokens) for r in ok)
+                tpot = [r.tpot_s for r in ok if len(r.tokens) > 1]
+                dispatches = dc["decode_dispatches"] + dc["host_sample_rounds"]
+                trials.append({
+                    "tokens_per_sec": round(tokens / makespan, 1)
+                    if makespan else 0.0,
+                    "ok": len(ok),
+                    "tpot_p50_s": round(_percentile(tpot, 0.50), 4),
+                    "tpot_p99_s": round(_percentile(tpot, 0.99), 4),
+                    "drafted": dc["spec_drafted"],
+                    "accepted": dc["spec_accepted"],
+                    "acceptance_rate": round(
+                        dc["spec_accepted"] / dc["spec_drafted"], 4)
+                    if dc["spec_drafted"] else 0.0,
+                    # per SLOT (decode_tokens over slot-steps): 1.0
+                    # without speculation, matching the engine's
+                    # tokens_per_step scalar and histogram
+                    "tokens_per_decode_step": round(
+                        dc["decode_tokens"] / dc["slot_steps"], 3)
+                    if dc["slot_steps"] else 0.0,
+                    # per decode step every running slot commits >= 1
+                    # token, so this is the per-token round-trip count a
+                    # request experiences: host sampling = 2 (program +
+                    # logits pull/sample/feed-back), fused = 1
+                    "dispatches_per_step": round(dispatches / steps, 3)
+                    if steps else 0.0,
+                })
+            head, med = _median_of(trials, "tokens_per_sec")
+            out["workloads"][wname][aname] = {"tokens_per_sec": med, **head}
+        engine.stop()
+    rep, rnd = out["workloads"]["repetitive"], out["workloads"]["random"]
+
+    def _ratio(a, b):
+        return round(a / b, 3) if b else 0.0
+
+    # the acceptance claims: speculation wins where the drafter hits,
+    # and costs <10% vs plain fused where it whiffs (the acceptance-rate
+    # telemetry explains which regime a workload is in)
+    out["repetitive_speedup_vs_host"] = _ratio(
+        rep["spec"]["tokens_per_sec"], rep["host"]["tokens_per_sec"])
+    out["repetitive_speedup_vs_fused"] = _ratio(
+        rep["spec"]["tokens_per_sec"], rep["fused"]["tokens_per_sec"])
+    out["fused_speedup_vs_host"] = _ratio(
+        rep["fused"]["tokens_per_sec"], rep["host"]["tokens_per_sec"])
+    out["random_spec_vs_fused"] = _ratio(
+        rnd["spec"]["tokens_per_sec"], rnd["fused"]["tokens_per_sec"])
+    out["random_regression_vs_fused"] = round(
+        1.0 - out["random_spec_vs_fused"], 4)
+    return out
+
+
 def main() -> None:
     import dataclasses
 
@@ -292,6 +415,8 @@ def main() -> None:
                            "tiny" if test_size else "small")
     cfg = gpt_tiny() if model == "tiny" else gpt_small()
     mode = os.environ.get("BENCH_SERVE_MODE", "all")
+    if "--spec-sweep" in sys.argv[1:]:
+        mode = "spec"
     slots = int(os.environ.get("BENCH_SERVE_SLOTS", "2" if test_size else "8"))
     n = int(os.environ.get("BENCH_SERVE_N", "6" if test_size else "32"))
     new = int(os.environ.get("BENCH_SERVE_NEW", "8" if test_size else "32"))
@@ -316,11 +441,13 @@ def main() -> None:
         deterministic=True,
     )["params"]
 
-    def make_engine(prefix_cache=False, prefill_budget=None):
+    def make_engine(prefix_cache=False, prefill_budget=None,
+                    fused_sampling=False, speculate=0):
         return Engine(
             params, cfg, max_slots=slots, max_queue=max(4 * n, 64),
             block_size=block, prefill_chunk=chunk,
             prefix_cache=prefix_cache, prefill_budget=prefill_budget,
+            fused_sampling=fused_sampling, speculate=speculate,
             max_context=max_context,
         ).start()
 
@@ -366,6 +493,26 @@ def main() -> None:
                 "value": prefix["speedup"],
                 "unit": "x tokens/sec (prefix_cache on/off)",
                 **base, **prefix,
+            })
+    if mode in ("all", "spec"):
+        spec = _spec_sweep(
+            make_engine, n=n, new=new,
+            prompt_len=int(os.environ.get(
+                "BENCH_SERVE_SPEC_PROMPT", "24" if test_size else "64")),
+            vocab=cfg.vocab_size,
+            speculate=int(os.environ.get("BENCH_SERVE_SPEC_K", "4")),
+        )
+        result["spec"] = spec
+        if not test_size:
+            # CPU evidence again: the headline is speculation ON vs OFF
+            # at an otherwise identical engine (the clean A/B); the
+            # vs-host ratio rides alongside.
+            persist_result("serve_spec", {
+                "metric": "serve_spec_decode_speedup",
+                "value": spec["repetitive_speedup_vs_fused"],
+                "unit": "x tokens/sec (speculation on vs off, "
+                        "repetitive-suffix workload)",
+                **base, **spec,
             })
     if mode in ("all", "interference"):
         interference = _interference_sweep(

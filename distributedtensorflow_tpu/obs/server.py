@@ -112,12 +112,54 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply_routed(self, result) -> None:
         """Render an extra-route handler's ``(status, payload)`` result:
-        dict/list payloads as JSON, strings as plain text."""
+        dict/list payloads as JSON, strings as plain text, and any other
+        iterable (a generator of str/bytes chunks) as a chunked-transfer
+        stream — the serving frontend's token streaming rides this."""
         status, payload = result
         if isinstance(payload, str):
             self._reply(payload, status=status)
+        elif hasattr(payload, "__next__"):
+            # an ITERATOR (generator) streams; concrete containers
+            # (dict/list/tuple/set) keep rendering as JSON bodies
+            self._reply_chunked(payload, status=status)
         else:
             self._reply_json(payload, status=status)
+
+    def _reply_chunked(self, chunks, *, status: int = 200,
+                       content_type: str = "application/x-ndjson") -> None:
+        """Stream an iterable of str/bytes as HTTP/1.1 chunked transfer.
+
+        Headers go out before the first chunk, so the producer must
+        already have validated the request (the status is committed).  A
+        client that disconnects mid-stream closes the producer (its
+        ``GeneratorExit`` runs) and drops the connection; a producer
+        exception after headers cannot be turned into an error status
+        any more, so the stream is terminated and the connection closed
+        — the outer handler's 500 path never runs after bytes went out."""
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for chunk in chunks:
+                data = (chunk.encode("utf-8") if isinstance(chunk, str)
+                        else bytes(chunk))
+                if not data:
+                    continue
+                self.wfile.write(
+                    f"{len(data):X}\r\n".encode("ascii") + data + b"\r\n"
+                )
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            self.close_connection = True  # client went away mid-stream
+        except Exception:
+            logger.exception("streaming route producer failed mid-stream")
+            self.close_connection = True
+        finally:
+            close = getattr(chunks, "close", None)
+            if close is not None:
+                close()
 
     def do_GET(self) -> None:  # noqa: N802 — http.server contract
         srv = self.server_ref

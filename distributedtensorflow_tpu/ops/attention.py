@@ -219,6 +219,63 @@ def paged_decode_attention(
     return out.astype(q.dtype)
 
 
+def paged_verify_attention(
+    q: jax.Array,             # (B, T, H, D) draft-window queries per slot
+    k_pool: jax.Array,        # (num_blocks, block_size, Hkv, D) shared pool
+    v_pool: jax.Array,        # (num_blocks, block_size, Hkv, D)
+    block_tables: jax.Array,  # (B, max_blocks) int32 physical block ids
+    attend_lens: jax.Array,   # (B,) int32 valid tokens for query 0
+) -> jax.Array:
+    """Multi-token decode attention against the paged pool (speculative
+    verification).
+
+    The ``T > 1`` generalization of :func:`paged_decode_attention`:
+    each slot carries a window of ``T`` query positions — its last
+    committed token followed by ``T - 1`` draft tokens — whose K/V this
+    step wrote at consecutive positions, and query ``t`` attends
+    ``attend_lens + t`` positions (causal masking *inside the draft
+    window*: draft ``t`` sees everything committed plus the drafts
+    before it, exactly what a sequential decode would have seen — which
+    is why accepted drafts are token-for-token what the one-token path
+    would have produced).  Same gather-through-page-table walk, same
+    fp32-softmax scaled dot product, same GQA grouping; at ``T = 1``
+    with ``attend_lens = seq_lens`` it reduces to the decode path.
+    Returns ``(B, T, H, D)``.
+    """
+    b, t, h, d = q.shape
+    nb, block_size, h_kv, _ = k_pool.shape
+    cap = block_tables.shape[1] * block_size
+    k = k_pool[block_tables].reshape(b, cap, h_kv, d).transpose(0, 2, 1, 3)
+    v = v_pool[block_tables].reshape(b, cap, h_kv, d).transpose(0, 2, 1, 3)
+    # (B, T, cap): query t of slot b sees positions < attend_lens[b] + t
+    valid = (jnp.arange(cap)[None, None, :]
+             < (attend_lens[:, None] + jnp.arange(t)[None, :])[:, :, None])
+    if h != h_kv:  # GQA: grouped einsums, pool never broadcast to H
+        g = h // h_kv
+        qg = q.reshape(b, t, h_kv, g, d)
+        scores = jnp.einsum(
+            "bthgd,bhkd->bhgtk", qg, k,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, h, t, cap) / (d ** 0.5)
+    else:
+        scores = jnp.einsum(
+            "bthd,bhkd->bhtk", q, k, preferred_element_type=jnp.float32,
+        ) / (d ** 0.5)
+    scores = jnp.where(valid[:, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    if h != h_kv:
+        wg = weights.astype(q.dtype).reshape(b, h_kv, g, t, cap)
+        out = jnp.einsum(
+            "bhgtk,bhkd->bthgd", wg, v, preferred_element_type=jnp.float32,
+        ).reshape(b, t, h, d)
+    else:
+        out = jnp.einsum(
+            "bhtk,bhkd->bthd", weights.astype(q.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+    return out.astype(q.dtype)
+
+
 def _decode_attn_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, scale):
     """A block of heads of one batch row's single-token decode attention.
 

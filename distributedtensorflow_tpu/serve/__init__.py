@@ -1,12 +1,15 @@
-"""Serving engine: continuous-batching generation server (ISSUE 6 + 14).
+"""Serving engine: continuous-batching generation server (ISSUE 6 + 14 + 15).
 
 The online half of the stack: ``kv_cache`` (paged block-pool KV with a
 refcounted copy-on-write allocator + prefix index), ``model`` (the
-compiled serving programs — chunked prefill, paged one-token decode, and
-the pool→dense cache gather that makes prefill chunks interleavable),
-``engine`` (thread-safe queue + continuous batching scheduler with
-decode-integrated budgeted prefill + SLO metrics), ``server``
-(``/generatez`` HTTP frontend on the obs StatusServer pattern).  Entry
+compiled serving programs — chunked prefill, paged one-token decode, the
+pool→dense cache gather that makes prefill chunks interleavable, and the
+fused decode/verify fast path), ``sampling`` (the one logits→probs
+reference + the fused/rejection sampler), ``draft`` (model-free n-gram
+draft proposals for self-speculative decoding), ``engine`` (thread-safe
+queue + continuous batching scheduler with decode-integrated budgeted
+prefill + SLO metrics), ``server`` (``/generatez`` HTTP frontend —
+blocking or chunked-streaming — on the obs StatusServer pattern).  Entry
 point: ``serve.py`` at the repo root.
 """
 
@@ -14,6 +17,7 @@ from .engine import Engine, GenRequest, QueueFullError  # noqa: F401
 from .kv_cache import BlockAllocator, OutOfBlocksError, PagedKVCache  # noqa: F401
 from .model import (  # noqa: F401
     make_decode_fn,
+    make_fused_decode_fn,
     make_gather_cache_fn,
     make_prefill_cache,
     make_prefill_fn,

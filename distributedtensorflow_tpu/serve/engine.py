@@ -34,7 +34,28 @@ online replacement:
 - **admission control**: a request is admitted only when a slot AND its
   whole block reservation are free (no mid-flight OOM), strictly in
   arrival order (head-of-line blocking keeps FIFO fairness — a small
-  request never jumps a large one under backpressure).
+  request never jumps a large one under backpressure);
+- **decode fast path** (ISSUE 15): with ``fused_sampling=True`` the
+  per-token host round-trip disappears — greedy / temperature+top-k
+  sampling is folded INTO the compiled decode program
+  (``serve.model.make_fused_decode_fn`` + ``serve.sampling``): per-slot
+  PRNG keys and the last sampled tokens stay resident on device across
+  steps, and the host fetches only the small ``(tokens, counts)`` pair
+  per iteration for EOS/logging — one device dispatch per token instead
+  of dispatch → logits fetch → numpy softmax → token feed-back.  With
+  ``speculate=K`` on top, a model-free n-gram drafter (``serve.draft``)
+  proposes up to K continuation tokens from each request's own history,
+  verified in ONE multi-token paged attention pass and accepted by
+  rejection sampling — greedy output stays token-for-token identical to
+  the sequential path, seeded sampling stays exactly the target model's
+  distribution, and an accepted burst emits up to K+1 tokens per
+  dispatch.  Iterations where no slot has a draft fall back to the
+  one-token fused program, so a low-hit-rate workload pays only the
+  (microsecond) lookup;
+- **streaming**: a request submitted with ``stream=True`` exposes each
+  iteration's newly committed tokens through a per-request event queue
+  (the HTTP frontend's chunked ``/generatez`` transfer) — requests.jsonl
+  rows are unchanged.
 
 Observability (wired into the obs registry): ``serve_ttft_seconds``,
 ``serve_tpot_seconds``, ``serve_e2e_seconds``, ``serve_batch_occupancy``
@@ -45,11 +66,14 @@ counters; prefix-caching counters ``serve_prefix_hits_total`` /
 ``serve_prefix_evictions_total`` / ``serve_kv_cow_copies_total`` and
 gauges ``serve_kv_blocks_cached`` / ``serve_kv_block_refs`` /
 ``serve_kv_fragmentation`` / ``serve_prefix_cache_occupancy`` /
-``serve_prefix_hit_rate``; a per-request ``requests.jsonl`` log (ok rows
-carry ``cached_prefix_tokens`` + ``prefill_tokens``, summing to
-``prompt_tokens``) and periodic ``metrics.jsonl`` rows + ``metrics.prom``
-snapshots in ``logdir`` (the same streams ``tools/run_report.py`` and
-``tools/check_metrics_schema.py`` consume).
+``serve_prefix_hit_rate``; speculation counters
+``serve_spec_drafted_total`` / ``serve_spec_accepted_total`` and the
+``serve_decode_tokens_per_step`` histogram; a per-request
+``requests.jsonl`` log (ok rows carry ``cached_prefix_tokens`` +
+``prefill_tokens``, summing to ``prompt_tokens``, and the per-request
+``drafted`` / ``accepted`` draft split) and periodic ``metrics.jsonl``
+rows + ``metrics.prom`` snapshots in ``logdir`` (the same streams
+``tools/run_report.py`` and ``tools/check_metrics_schema.py`` consume).
 
 Threading model: HTTP/handler threads only touch :meth:`submit` (queue +
 lock); all device work and all ``PagedKVCache`` mutation happens on the
@@ -65,18 +89,23 @@ import itertools
 import json
 import math
 import os
+import queue
 import threading
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..obs import registry as obs_registry
 from ..obs import tracing as obs_tracing
 from ..utils.metrics import json_sanitize
+from . import draft as spec_draft
+from . import sampling
 from .kv_cache import PagedKVCache
 from .model import (
     make_decode_fn,
+    make_fused_decode_fn,
     make_gather_cache_fn,
     make_prefill_cache,
     make_prefill_fn,
@@ -139,6 +168,16 @@ class GenRequest:
     #: worst observed inter-token latency (decode stall ceiling — the
     #: number the prefill budget bounds).
     itl_max_s: float = 0.0
+    #: speculative-decoding accounting: draft tokens proposed for this
+    #: request and how many the verifier accepted (``accepted <=
+    #: drafted`` always; both 0 without ``--speculate``).
+    drafted: int = 0
+    accepted: int = 0
+    #: streaming: newly committed tokens per iteration as ("tokens",
+    #: [ids]) events plus one terminal ("done", None); None = blocking.
+    _events: queue.Queue | None = dataclasses.field(
+        default=None, repr=False
+    )
     # -- chunked-prefill state (engine thread only) --
     _fill_buf: np.ndarray | None = dataclasses.field(
         default=None, repr=False
@@ -194,6 +233,9 @@ class Engine:
         prefill_chunk: int = 16,
         prefill_budget: int | None = None,
         prefix_cache: bool = False,
+        fused_sampling: bool = False,
+        speculate: int = 0,
+        spec_ngram: int = 3,
         max_context: int | None = None,
         max_new_cap: int | None = None,
         logdir: str | None = None,
@@ -222,6 +264,16 @@ class Engine:
                 f"prefill_budget={prefill_budget} must be >= 1 tokens "
                 "(None = unbudgeted)"
             )
+        speculate = int(speculate)
+        if speculate < 0:
+            raise ValueError(f"speculate={speculate} must be >= 0")
+        if speculate and not fused_sampling:
+            # Speculation verifies + rejection-samples on device; a host
+            # sampler would re-introduce the per-token round-trip the
+            # draft window exists to amortize.
+            raise ValueError("speculate requires fused_sampling=True")
+        if speculate and spec_ngram < 1:
+            raise ValueError(f"spec_ngram={spec_ngram} must be >= 1")
         #: params stay the caller's (possibly mesh-sharded) arrays — GSPMD
         #: partitions both programs exactly as it does models.generate.
         self.params = params
@@ -250,6 +302,40 @@ class Engine:
         self._prefill = make_prefill_fn(self.cfg, chunk=prefill_chunk,
                                         block_size=block_size)
         self._decode = make_decode_fn(self.cfg)
+        self.fused_sampling = bool(fused_sampling)
+        self.speculate = speculate
+        self.spec_ngram = int(spec_ngram)
+        self._fused1 = None
+        self._fused_spec = None
+        if self.fused_sampling:
+            # T=1 fused program (always) + the T=K+1 verify program: an
+            # iteration where no slot drafted runs the cheap one-token
+            # program, so a zero-hit-rate workload pays only the lookup.
+            self._fused1 = make_fused_decode_fn(
+                self.cfg, block_size=block_size, draft=0)
+            if self.speculate:
+                self._fused_spec = make_fused_decode_fn(
+                    self.cfg, block_size=block_size, draft=self.speculate)
+            # Device-resident sampling state: last sampled token and the
+            # per-request base PRNG key per slot (set at admission /
+            # prefill completion; read every step with no host feed).
+            # Tokens carry the (B, 1) feed shape the program consumes.
+            self._dev_tokens = jnp.zeros((max_slots, 1), jnp.int32)
+            self._dev_keys = jnp.zeros((max_slots, 2), jnp.uint32)
+        # Per-step host->device traffic diet: the per-slot sampling
+        # params and the active mask only change when the slot set does
+        # (admission / prefill completion / eviction), and the page
+        # tables only on admit/release/CoW — cache the device/host
+        # copies behind dirty flags instead of re-shipping every step.
+        self._slot_meta_dirty = True
+        self._active_arr = np.zeros((max_slots,), bool)
+        self._dev_active = jnp.asarray(self._active_arr)
+        self._dev_temp = jnp.zeros((max_slots,), jnp.float32)
+        self._dev_topk = jnp.zeros((max_slots,), jnp.int32)
+        self._dev_prompt_lens = jnp.zeros((max_slots,), jnp.int32)
+        self._dev_zero_drafts = jnp.zeros((max_slots,), jnp.int32)
+        self._dev_tables = None
+        self._dev_tables_version = -1
         self._gather = make_gather_cache_fn(self.cfg, block_size=block_size)
         self._prefill_cache = make_prefill_cache(self.cfg)
         #: (slot, pos): the dense prefill cache currently holds that
@@ -283,6 +369,18 @@ class Engine:
             "submitted": 0, "ok": 0, "rejected": 0, "error": 0,
             "tokens_generated": 0, "admits": 0, "admits_into_freed_slot": 0,
             "prefill_tokens": 0,
+            # decode fast path (ISSUE 15): tokens committed by decode /
+            # verify steps, draft proposals and acceptances, and the
+            # dispatch accounting the bench A/Bs — decode program
+            # executions plus host sampling rounds (the logits fetch +
+            # numpy softmax + token feed-back the fused path removes).
+            "decode_tokens": 0, "spec_drafted": 0, "spec_accepted": 0,
+            "decode_dispatches": 0, "host_sample_rounds": 0,
+            # slot-steps = sum of active slots over decode steps: the
+            # denominator that makes tokens-per-step PER-SLOT (1.0
+            # without speculation, matching the histogram), not an
+            # occupancy echo.
+            "slot_steps": 0,
         }
 
         reg = registry or obs_registry.default_registry()
@@ -335,6 +433,20 @@ class Engine:
             "cached blocks evicted under pool pressure")
         self._m_cow = reg.counter(
             "serve_kv_cow_copies_total", "copy-on-write block copies")
+        self._m_spec_drafted = reg.counter(
+            "serve_spec_drafted_total",
+            "draft tokens proposed to the speculative verifier")
+        self._m_spec_accepted = reg.counter(
+            "serve_spec_accepted_total",
+            "draft tokens accepted by the verifier (always <= drafted)")
+        self._m_tok_step = reg.histogram(
+            "serve_decode_tokens_per_step",
+            "tokens committed per slot per decode step (1 without "
+            "speculation; up to speculate+1 with an accepted burst)",
+            buckets=tuple(
+                float(i) for i in range(1, max(self.speculate, 1) + 2)
+            ),
+        )
         self._last_evictions = 0  # registry-counter delta trackers
         self._last_cow = 0
         self._registry = reg
@@ -360,6 +472,7 @@ class Engine:
         seed: int = 0,
         trace_id: str | None = None,
         deadline_s: float | None = None,
+        stream: bool = False,
     ) -> GenRequest:
         """Validate + enqueue; returns the live :class:`GenRequest`.
 
@@ -450,6 +563,8 @@ class Engine:
         )
         if deadline_s is not None:
             req.t_deadline = req.t_submit + deadline_s
+        if stream:
+            req._events = queue.Queue()
         req._rng = np.random.default_rng(req.seed)
         rejected = False
         with self._cond:
@@ -487,6 +602,39 @@ class Engine:
         return req
 
     # -- scheduler (engine thread) -------------------------------------------
+
+    def _refresh_slot_meta(self) -> None:
+        """Rebuild the cached per-slot sampling-param / active-mask
+        DEVICE arrays after a slot-set change (admission, prefill
+        completion, eviction; engine thread only).  These are the
+        decode inputs that do not change between slot-set changes —
+        caching them takes the per-step host->device transfers down to
+        the two that genuinely change every step (seq_lens and, on the
+        speculative path, the draft window)."""
+        if not self._slot_meta_dirty:
+            return
+        for i, r in enumerate(self._slots):
+            self._active_arr[i] = r is not None and r._prefill_done
+        self._dev_active = jnp.asarray(self._active_arr)
+        if self.fused_sampling:
+            self._dev_temp = jnp.asarray(np.array(
+                [0.0 if r is None else r.temperature for r in self._slots],
+                np.float32))
+            self._dev_topk = jnp.asarray(np.array(
+                [0 if r is None else r.top_k for r in self._slots],
+                np.int32))
+            self._dev_prompt_lens = jnp.asarray(np.array(
+                [0 if r is None else len(r.prompt) for r in self._slots],
+                np.int32))
+        self._slot_meta_dirty = False
+
+    def _tables_dev(self):
+        """Device copy of the page tables, re-shipped only when a table
+        actually changed (``PagedKVCache.tables_version``)."""
+        if self._dev_tables_version != self.kv.tables_version:
+            self._dev_tables = jnp.asarray(self.kv.block_tables)
+            self._dev_tables_version = self.kv.tables_version
+        return self._dev_tables
 
     def _padded_prompt_len(self, prompt_len: int) -> int:
         """Prompt length rounded up to whole prefill chunks — the extent
@@ -575,6 +723,13 @@ class Engine:
                 head._fill_next = (p // self.prefill_chunk) \
                     * self.prefill_chunk
                 self._slots[slot] = head
+                self._slot_meta_dirty = True
+                if self.fused_sampling:
+                    # the request's sampling stream lives on device: one
+                    # tiny scatter per admission, zero feeds per step
+                    self._dev_keys = self._dev_keys.at[slot].set(
+                        jax.random.PRNGKey(head.seed)
+                    )
                 if self._prefill_cache_state is not None \
                         and self._prefill_cache_state[0] == slot:
                     # the dense cache's claimed contents belonged to this
@@ -680,66 +835,212 @@ class Engine:
         if self.prefix_cache:
             self.kv.register_prefix(req.slot, req.prompt)
         req._prefill_done = True
-        tok = self._sample(req, np.asarray(last_logits))
+        self._slot_meta_dirty = True
+        if self.fused_sampling:
+            # The prefill program hands logits to the host anyway (its
+            # last chunk); sampling them with the device sampler's exact
+            # math + key schedule (emitted index 0) keeps the request on
+            # ONE sampling stream across the host/device boundary.
+            tok = sampling.sample_one(
+                np.asarray(last_logits), jax.random.PRNGKey(req.seed), 0,
+                req.temperature, req.top_k,
+            )
+            self._dev_tokens = self._dev_tokens.at[req.slot, 0].set(tok)
+        else:
+            tok = self._sample(req, np.asarray(last_logits))
         req.t_first_token = time.time()
         req._t_last_token = req.t_first_token
         req.tokens.append(tok)
         self._last_tokens[req.slot] = tok
         self._m_ttft.observe(req.ttft_s)
+        self._stream_emit(req, [tok])
         self._maybe_finish(req)
 
     def _run_decode_step(self) -> None:
-        """One paged decode token for every slot whose prefill is done."""
+        """One decode iteration for every slot whose prefill is done:
+        the host-sampling path (one token per slot, numpy fallback
+        sampler) or the fused fast path (sampling — and optionally
+        speculative verification — inside the compiled program)."""
         decoding = [
             (i, r) for i, r in enumerate(self._slots)
             if r is not None and r._prefill_done
         ]
         n_active = len(decoding)
+        if self.fused_sampling:
+            self._decode_step_fused(decoding, n_active)
+            return
         for i, _ in decoding:
             # CoW guard: never write a shared or indexed block in place.
             # Steady state this is a no-op (appends land past the shared
             # prompt blocks) — it is what makes a future scheduler bug a
             # local copy instead of cross-request cache corruption.
             self.kv.ensure_writable(i, int(self.kv.seq_lens[i]))
-        active = np.array(
-            [r is not None and r._prefill_done for r in self._slots]
-        )
+        self._refresh_slot_meta()
         logits, self.kv.k_pool, self.kv.v_pool = self._decode(
             self.params, self.kv.k_pool, self.kv.v_pool,
-            jnp.asarray(self._last_tokens), jnp.asarray(self.kv.block_tables),
-            jnp.asarray(self.kv.seq_lens), jnp.asarray(active),
+            jnp.asarray(self._last_tokens), self._tables_dev(),
+            jnp.asarray(self.kv.seq_lens), self._dev_active,
         )
         logits = np.asarray(logits)
         self.decode_steps += 1
+        self.counters["decode_dispatches"] += 1
+        self.counters["host_sample_rounds"] += 1
+        self.counters["slot_steps"] += n_active
         self._m_occ.observe(float(n_active))
         self.occupancy_max = max(self.occupancy_max, n_active)
         now = time.time()
         for slot, req in decoding:
             self.kv.note_written(slot, int(self.kv.seq_lens[slot]) + 1)
-            req.occ_sum += n_active
-            req.occ_steps += 1
-            req.occ_max = max(req.occ_max, n_active)
             tok = self._sample(req, logits[slot])
-            req.tokens.append(tok)
-            if req._t_last_token:
-                req.itl_max_s = max(req.itl_max_s, now - req._t_last_token)
-            req._t_last_token = now
-            self._last_tokens[slot] = tok
-            self._maybe_finish(req)
+            self._commit_tokens(slot, req, [tok], n_active, now)
+
+    def _commit_tokens(self, slot: int, req: GenRequest, kept: list[int],
+                       n_active: int, now: float) -> None:
+        """Per-request bookkeeping for this iteration's committed tokens
+        — ONE implementation for the host and fused paths, so telemetry
+        (occupancy, tokens/step, ITL) cannot drift between them."""
+        req.occ_sum += n_active
+        req.occ_steps += 1
+        req.occ_max = max(req.occ_max, n_active)
+        req.tokens.extend(kept)
+        self.counters["decode_tokens"] += len(kept)
+        self._m_tok_step.observe(float(len(kept)))
+        if req._t_last_token:
+            req.itl_max_s = max(req.itl_max_s, now - req._t_last_token)
+        req._t_last_token = now
+        self._last_tokens[slot] = kept[-1]
+        self._stream_emit(req, kept)
+        self._maybe_finish(req)
+
+    def _decode_step_fused(self, decoding, n_active: int) -> None:
+        """One fused decode iteration: build the (optional) draft
+        window, dispatch ONE program, commit the emitted bursts.
+
+        The program returns ``(out_tokens, n_emitted, next_feed)`` —
+        the only host transfer per iteration; ``next_feed`` stays on
+        device as the next step's input.  Draft K/V is written for the
+        whole window; the host commits only ``committed + accepted``
+        positions (``kv.note_written``) so rejected-draft K/V is dead
+        beyond the sequence length — and an EOS landing mid-burst
+        truncates the request's tokens AND retreats the K/V extent
+        (``kv.rollback``), which by construction never crosses a
+        shared (refcount > 1) prefix block."""
+        drafts: dict[int, list[int]] = {}
+        if self.speculate:
+            for i, r in decoding:
+                cap = min(self.speculate,
+                          r.max_new_tokens - len(r.tokens) - 1)
+                if cap > 0:
+                    # min_ngram=2: a single repeated token is mostly
+                    # coincidence on novel text, and every spurious
+                    # proposal pays the T=K+1 verify program for an
+                    # almost-surely-rejected draft — requiring a 2-gram
+                    # match keeps the low-hit-rate regression bounded
+                    # while leaving real repetition (>= 2-gram) intact.
+                    d = spec_draft.propose(
+                        r.prompt + r.tokens, cap,
+                        max_ngram=self.spec_ngram,
+                        min_ngram=min(2, self.spec_ngram),
+                    )
+                    if d:
+                        drafts[i] = d
+        # Program choice is per BATCH: one drafting slot routes every
+        # active slot through the T=K+1 program that iteration (static
+        # shapes — the non-drafting slots' extra positions are pad
+        # writes to scratch, but their forward compute still scales with
+        # T).  The draft-less fallback therefore helps exactly when NO
+        # slot drafts; a mixed batch pays the window for everyone, which
+        # is the right trade only while acceptance is healthy — the
+        # acceptance-rate telemetry is the dial to watch.
+        t_width = self.speculate + 1 if drafts else 1
+        for i, r in decoding:
+            s = int(self.kv.seq_lens[i])
+            self.kv.ensure_writable_range(
+                i, s, s + 1 + len(drafts.get(i, ())))
+        self._refresh_slot_meta()
+        draft_lens = np.zeros((self.max_slots,), np.int32)
+        if t_width > 1:
+            toks = np.zeros((self.max_slots, t_width), np.int32)
+            toks[:, 0] = self._last_tokens
+            for i, d in drafts.items():
+                toks[i, 1:1 + len(d)] = d
+                draft_lens[i] = len(d)
+            tokens_in = jnp.asarray(toks)
+            dev_draft_lens = jnp.asarray(draft_lens)
+            fn = self._fused_spec
+        else:
+            tokens_in = self._dev_tokens  # device-resident (B, 1) feed
+            dev_draft_lens = self._dev_zero_drafts
+            fn = self._fused1
+        packed, next_feed, self.kv.k_pool, self.kv.v_pool = fn(
+            self.params, self.kv.k_pool, self.kv.v_pool, tokens_in,
+            dev_draft_lens, self._tables_dev(),
+            jnp.asarray(self.kv.seq_lens), self._dev_active,
+            self._dev_keys, self._dev_prompt_lens, self._dev_temp,
+            self._dev_topk,
+        )
+        self._dev_tokens = next_feed
+        packed = np.asarray(packed)  # the ONE small host fetch per
+        out = packed[:, :-1]         # iteration (EOS / logging):
+        n_emit = packed[:, -1]       # emitted tokens + counts, packed
+        self.decode_steps += 1
+        self.counters["decode_dispatches"] += 1
+        self.counters["slot_steps"] += n_active
+        self._m_occ.observe(float(n_active))
+        self.occupancy_max = max(self.occupancy_max, n_active)
+        now = time.time()
+        for slot, req in decoding:
+            n = int(n_emit[slot])
+            emitted = [int(t) for t in out[slot, :n]]
+            k_drafted = int(draft_lens[slot])
+            accepted = n - 1
+            s = int(self.kv.seq_lens[slot])
+            # Commit the last input token + every ACCEPTED draft's K/V;
+            # rejected drafts' K/V sits past this extent (dead, masked,
+            # overwritten by the next append).
+            self.kv.note_written(slot, s + 1 + accepted)
+            kept = emitted
+            if req.eos_token_id is not None and req.eos_token_id in emitted:
+                kept = emitted[: emitted.index(req.eos_token_id) + 1]
+                if len(kept) < n:
+                    # tokens after the EOS never happened: retreat the
+                    # K/V extent past the discarded accepted drafts too
+                    self.kv.rollback(slot, s + len(kept))
+            if k_drafted:
+                # acceptance telemetry counts COMMITTED drafts: an
+                # accepted draft discarded by the EOS truncation above
+                # was rolled back as "never happened" and must not
+                # inflate the acceptance rate.  kept == emitted keeps
+                # `accepted`; a truncated burst is all-drafts.
+                committed = accepted if len(kept) == n else len(kept)
+                req.drafted += k_drafted
+                req.accepted += committed
+                self.counters["spec_drafted"] += k_drafted
+                self.counters["spec_accepted"] += committed
+                self._m_spec_drafted.inc(k_drafted)
+                if committed:
+                    self._m_spec_accepted.inc(committed)
+            self._commit_tokens(slot, req, kept, n_active, now)
 
     def _sample(self, req: GenRequest, logits: np.ndarray) -> int:
-        """Host-side greedy / temperature+top-k sampling (deterministic
-        per request seed).  Device-side fused sampling is future work."""
+        """Host-side sampling fallback (``fused_sampling=False``):
+        greedy / temperature+top-k, deterministic per request seed.  The
+        logits→probs math is the SHARED reference
+        (:func:`serve.sampling.logits_to_probs`, fp32) — the historical
+        float64 up-cast made this path drift from any fp32 device
+        sampler in the last ulps, which poisoned parity testing."""
         if req.temperature <= 0.0:
             return int(np.argmax(logits))
-        scaled = logits.astype(np.float64) / max(req.temperature, 1e-6)
-        if req.top_k > 0:
-            kth = np.partition(scaled, -req.top_k)[-req.top_k]
-            scaled = np.where(scaled < kth, -np.inf, scaled)
-        scaled -= scaled.max()
-        probs = np.exp(scaled)
-        probs /= probs.sum()
-        return int(req._rng.choice(len(probs), p=probs))
+        probs = sampling.logits_to_probs(
+            np.asarray(logits), req.temperature, req.top_k, xp=np
+        ).astype(np.float64)  # np.random requires probs summing to 1 in f64
+        return int(req._rng.choice(len(probs), p=probs / probs.sum()))
+
+    def _stream_emit(self, req: GenRequest, toks: list[int]) -> None:
+        """Push newly committed tokens to a streaming request's event
+        queue (no-op for blocking requests)."""
+        if req._events is not None and toks:
+            req._events.put(("tokens", list(toks)))
 
     def _maybe_finish(self, req: GenRequest) -> None:
         last = req.tokens[-1]
@@ -756,6 +1057,7 @@ class Engine:
         if req.slot is not None:
             self.kv.release(req.slot)
             self._slots[req.slot] = None
+            self._slot_meta_dirty = True
             if self._prefill_cache_state is not None \
                     and self._prefill_cache_state[0] == req.slot:
                 self._prefill_cache_state = None
@@ -775,6 +1077,8 @@ class Engine:
         self._m_active.set(sum(r is not None for r in self._slots))
         self._update_kv_metrics()
         self._log_request(req)
+        if req._events is not None:
+            req._events.put(("done", None))
         req._done.set()
 
     def _update_kv_metrics(self) -> None:
@@ -955,6 +1259,16 @@ class Engine:
             "prefill_chunk": self.prefill_chunk,
             "prefill_budget": self.prefill_budget or 0,
             "prefix_cache": self.prefix_cache,
+            "fused_sampling": self.fused_sampling,
+            "speculate": self.speculate,
+            "spec_acceptance_rate": (
+                self.counters["spec_accepted"] / self.counters["spec_drafted"]
+                if self.counters["spec_drafted"] else 0.0
+            ),
+            "tokens_per_step": (
+                self.counters["decode_tokens"] / self.counters["slot_steps"]
+                if self.counters["slot_steps"] else 0.0
+            ),
             "max_context": self.kv.max_context,
         }
 
@@ -980,6 +1294,8 @@ class Engine:
                 cached_prefix_tokens=req.cached_prefix_tokens,
                 prefill_tokens=req.prefill_tokens,
                 itl_max_s=round(req.itl_max_s, 6),
+                drafted=req.drafted,
+                accepted=req.accepted,
             )
         elif req.error:
             row["error"] = req.error
@@ -1022,6 +1338,25 @@ class Engine:
             "requests_rejected_total": self.counters["rejected"],
             "requests_error_total": self.counters["error"],
             "tokens_generated_total": self.counters["tokens_generated"],
+            # decode fast path (ISSUE 15)
+            "fused_sampling": int(self.fused_sampling),
+            "speculate": self.speculate,
+            "spec_drafted_total": self.counters["spec_drafted"],
+            "spec_accepted_total": self.counters["spec_accepted"],
+            "spec_acceptance_rate": round(
+                self.counters["spec_accepted"]
+                / self.counters["spec_drafted"], 4
+            ) if self.counters["spec_drafted"] else 0.0,
+            "decode_tokens_total": self.counters["decode_tokens"],
+            # PER-SLOT (decode_tokens over slot-steps): 1.0 without
+            # speculation, up to speculate+1 — the scalar twin of the
+            # serve_decode_tokens_per_step histogram.
+            "tokens_per_step": round(
+                self.counters["decode_tokens"] / self.counters["slot_steps"],
+                4,
+            ) if self.counters["slot_steps"] else 0.0,
+            "decode_dispatches_total": self.counters["decode_dispatches"],
+            "host_sample_rounds_total": self.counters["host_sample_rounds"],
         }
         with self._log_lock:
             if self._met_log is None:

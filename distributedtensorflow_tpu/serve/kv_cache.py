@@ -288,6 +288,11 @@ class PagedKVCache:
         self.block_tables = np.full(
             (max_slots, self.blocks_per_slot), self.scratch_block, np.int32
         )
+        #: bumped on every page-table mutation (admit/release/CoW
+        #: repoint) so the engine can cache the device copy of
+        #: ``block_tables`` across the many decode steps between
+        #: admissions instead of re-shipping it per step.
+        self.tables_version = 0
         self.seq_lens = np.zeros((max_slots,), np.int32)
         self.pages: list[SlotPages | None] = [None] * max_slots
         # prefix index: chained content hash -> (physical block, the
@@ -414,6 +419,7 @@ class PagedKVCache:
         self.pages[slot] = pages
         self.block_tables[slot, :] = self.scratch_block
         self.block_tables[slot, : len(blocks)] = blocks
+        self.tables_version += 1
         self.seq_lens[slot] = prefix_tokens
         return pages
 
@@ -426,6 +432,7 @@ class PagedKVCache:
         self.allocator.free(pages.blocks)
         self.pages[slot] = None
         self.block_tables[slot, :] = self.scratch_block
+        self.tables_version += 1
         self.seq_lens[slot] = 0
 
     def ensure_writable(self, slot: int, pos: int) -> str | None:
@@ -463,6 +470,7 @@ class PagedKVCache:
             self.allocator.decref(b)
             pages.blocks[li] = dst
             self.block_tables[slot, li] = dst
+            self.tables_version += 1
             self.cow_copies += 1
             return "cow"
         if self.allocator.is_registered(b):
@@ -470,6 +478,68 @@ class PagedKVCache:
             self.allocator.unregister(b)
             return "unregistered"
         return None
+
+    def ensure_writable_range(self, slot: int, start: int, end: int) -> int:
+        """Copy-on-write guard over every block a multi-token write
+        ``[start, end)`` touches (the speculative verify program appends
+        the committed token plus all drafts in one dispatch).  Returns
+        the number of blocks that needed a CoW copy or an unregister —
+        steady state 0, same as the single-position guard."""
+        if end <= start:
+            return 0
+        fixed = 0
+        bs = self.block_size
+        for li in range(start // bs, (end - 1) // bs + 1):
+            if self.ensure_writable(slot, li * bs) is not None:
+                fixed += 1
+        return fixed
+
+    def rollback(self, slot: int, tokens: int) -> None:
+        """Retreat a slot's resident-token count to ``tokens`` (rejected
+        or discarded speculative drafts: the K/V past the new extent is
+        dead and will be overwritten by the next append).
+
+        Two hard rules.  (1) **Never into the mapped prefix**: positions
+        below ``prefix_tokens`` are another request's cached content
+        mapped refcount+1 — retreating "past" them would claim the slot
+        re-owns positions it never wrote.  (2) **No block is freed**:
+        the admission contract reserved the slot's whole worst-case
+        footprint all-or-nothing, and handing blocks back on a retreat
+        would let another admission claim them and force a mid-flight
+        re-alloc (the OOM class admission control exists to prevent)
+        when this slot's generation advances again.  As belt and braces
+        the retreat also refuses to cross any *shared* (refcount > 1)
+        block — the engine only ever speculates past the prompt, so a
+        shared block inside the retreat window means scheduler state
+        went inconsistent and silently continuing would corrupt the
+        shared content's accounting."""
+        pages = self.pages[slot]
+        if pages is None:
+            raise OutOfBlocksError(f"slot {slot} has no pages")
+        if tokens > pages.used_tokens:
+            raise OutOfBlocksError(
+                f"slot {slot}: rollback target {tokens} exceeds resident "
+                f"{pages.used_tokens} (rollback only retreats)"
+            )
+        if tokens < pages.prefix_tokens:
+            raise OutOfBlocksError(
+                f"slot {slot}: rollback to {tokens} would retreat into the "
+                f"mapped shared prefix ({pages.prefix_tokens} tokens)"
+            )
+        if tokens == pages.used_tokens:
+            return  # empty retreat window
+        bs = self.block_size
+        for li in range(tokens // bs,
+                        min((pages.used_tokens - 1) // bs + 1,
+                            len(pages.blocks))):
+            if self.allocator.refcount(pages.blocks[li]) > 1:
+                raise OutOfBlocksError(
+                    f"slot {slot}: rollback window covers shared block "
+                    f"{pages.blocks[li]} (refcount "
+                    f"{self.allocator.refcount(pages.blocks[li])})"
+                )
+        pages.used_tokens = tokens
+        self.seq_lens[slot] = tokens
 
     def note_written(self, slot: int, tokens: int) -> None:
         """Advance a slot's resident-token count (after a program wrote

@@ -46,14 +46,16 @@ import jax.numpy as jnp
 
 from ..models.generate import prefill
 from ..models.gpt import GPTConfig, rope, rope_tables
-from ..ops.attention import paged_decode_attention
+from ..ops.attention import paged_decode_attention, paged_verify_attention
 from ..ops.layernorm import layer_norm
 from ..ops.xent import tied_head_logits
+from .sampling import sample_burst
 
 __all__ = [
     "make_prefill_cache",
     "make_prefill_fn",
     "make_decode_fn",
+    "make_fused_decode_fn",
     "make_gather_cache_fn",
     "reset_cache_index",
 ]
@@ -254,3 +256,131 @@ def make_decode_fn(cfg: GPTConfig):
         return logits, kf.reshape(k_pool.shape), vf.reshape(v_pool.shape)
 
     return decode
+
+
+def make_fused_decode_fn(cfg: GPTConfig, *, block_size: int, draft: int = 0):
+    """Compiled program (b'): the decode **fast path** — forward, K/V
+    append, AND sampling in one dispatch; optionally speculative.
+
+    ``fn(params, k_pool, v_pool, tokens, draft_lens, block_tables,
+    seq_lens, active, keys, prompt_lens, temperature, top_k) ->
+    (packed, next_feed, k_pool, v_pool)`` with ``T = draft + 1`` query
+    positions per slot: column 0 is each slot's last
+    committed token, columns ``1..draft_lens`` its n-gram draft
+    proposals (``serve.draft``), the rest padding.  The program writes
+    K/V for the committed token and every draft at consecutive
+    positions (pad/inactive writes land in the scratch block), runs ONE
+    multi-token paged attention pass
+    (:func:`ops.attention.paged_verify_attention`) with causal masking
+    inside the draft window, and applies the fused sampler
+    (:func:`serve.sampling.sample_burst`): greedy / temperature+top-k
+    with per-slot PRNG keys resident in ``keys``, generalized to
+    rejection-sampled draft verification — the emitted distribution is
+    exactly the target model's, and greedy output is token-for-token
+    the sequential path's.
+
+    Versus :func:`make_decode_fn` + host sampling, the host round-trip
+    per token collapses to one small ``(out_tokens, n_emitted)`` fetch
+    per *iteration* (EOS/logging), ``next_feed`` stays device-resident
+    as the next step's input, and with ``draft > 0`` one dispatch can
+    emit up to ``draft + 1`` tokens per slot.  ``draft=0`` (``T = 1``)
+    is the non-speculative fused program — same signature, so the
+    engine swaps between the two without a third code path.
+
+    Every forward-pass dtype choice mirrors :func:`make_decode_fn` line
+    for line; the accepted-token logits are therefore the same numbers
+    the one-token program would have produced (parity pinned by
+    tests/test_serve_spec.py, incl. bf16).
+    """
+    _check_servable(cfg)
+    num_layers = cfg.num_layers
+    n_heads = cfg.num_heads
+    h_kv = cfg.kv_heads
+    head_dim = cfg.hidden_size // n_heads
+    hidden = cfg.hidden_size
+    kv_width = h_kv * head_dim
+    t_width = draft + 1
+
+    def _ln(x, p, out_dtype=None):
+        return layer_norm(x, p["scale"], p["bias"], eps=1e-6,
+                          out_dtype=out_dtype or x.dtype)
+
+    def _dense(x, kernel):
+        return x @ kernel.astype(cfg.dtype)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def fused_decode(params, k_pool, v_pool, tokens, draft_lens,
+                     block_tables, seq_lens, active, keys, prompt_lens,
+                     temperature, top_k):
+        b = tokens.shape[0]
+        _, nb_total, bs, _, _ = k_pool.shape
+        nb_table = block_tables.shape[1]
+        x = params["wte"]["embedding"].astype(cfg.dtype)[tokens]  # (B,T,H)
+        positions = (seq_lens[:, None]
+                     + jnp.arange(t_width, dtype=jnp.int32)[None, :])
+        tabs = rope_tables(positions, head_dim, cfg.rope_theta, cfg.dtype)
+        # Write coordinates: the committed token (column 0) and the real
+        # drafts append at consecutive positions inside the slot's pages;
+        # pad columns and inactive slots hit scratch.  Rejected drafts
+        # leave garbage PAST the committed seq_len — masked by the
+        # validity rule until a later write overwrites it (the K/V-level
+        # rollback; the host-side retreat is kv_cache.rollback).
+        valid_w = active[:, None] & (
+            jnp.arange(t_width)[None, :] <= draft_lens[:, None]
+        )
+        blk = jnp.take_along_axis(
+            block_tables, jnp.clip(positions // bs, 0, nb_table - 1), axis=1
+        )
+        idx = jnp.where(valid_w, blk * bs + positions % bs,
+                        (nb_total - 1) * bs)                    # (B, T)
+        attend_lens = jnp.where(active, seq_lens + 1, 1)
+        kf = k_pool.reshape(num_layers, nb_total * bs, h_kv, head_dim)
+        vf = v_pool.reshape(num_layers, nb_total * bs, h_kv, head_dim)
+        for layer in range(num_layers):
+            p = params[f"h{layer}"]
+            h = _ln(x, p["ln1"])
+            qkv = _dense(h, p["attn"]["qkv"]["kernel"])
+            q = qkv[..., :hidden].reshape(b, t_width, n_heads, head_dim)
+            k = qkv[..., hidden:hidden + kv_width].reshape(
+                b, t_width, h_kv, head_dim)
+            v = qkv[..., hidden + kv_width:].reshape(
+                b, t_width, h_kv, head_dim)
+            q = rope(q, positions, cfg.rope_theta, tabs)
+            k = rope(k, positions, cfg.rope_theta, tabs)
+            kf = kf.at[layer, idx.reshape(-1)].set(
+                k.reshape(b * t_width, h_kv, head_dim))
+            vf = vf.at[layer, idx.reshape(-1)].set(
+                v.reshape(b * t_width, h_kv, head_dim))
+            out = paged_verify_attention(
+                q,
+                kf[layer].reshape(nb_total, bs, h_kv, head_dim),
+                vf[layer].reshape(nb_total, bs, h_kv, head_dim),
+                block_tables, attend_lens,
+            ).reshape(b, t_width, hidden).astype(cfg.dtype)
+            x = x + _dense(out, p["attn"]["proj"]["kernel"])
+            h = _ln(x, p["ln2"])
+            m = _dense(jax.nn.gelu(_dense(h, p["fc_in"]["kernel"])),
+                       p["fc_out"]["kernel"])
+            x = x + m
+        xf = _ln(x, params["ln_f"], out_dtype=jnp.float32)
+        logits = tied_head_logits(
+            xf, params["wte"]["embedding"], cfg.dtype
+        )                                                       # (B, T, V)
+        # Emitted-token index of each slot's next sample, derived
+        # on-device (decode invariant: seq_len = prompt + emitted - 1)
+        # so the host ships nothing per step that it can avoid —
+        # prompt_lens changes only at admission.
+        sample_pos = jnp.maximum(seq_lens - prompt_lens + 1, 0)
+        out_tokens, n_emitted, next_feed = sample_burst(
+            logits, tokens, draft_lens, keys, sample_pos, temperature,
+            top_k, active,
+        )
+        # out_tokens and n_emitted packed into ONE array so the host
+        # pays a single small device->host fetch per iteration;
+        # next_feed keeps the feed shape (B, 1) so the next T=1 call
+        # consumes it with zero host-side reshaping.
+        packed = jnp.concatenate([out_tokens, n_emitted[:, None]], axis=1)
+        return (packed, next_feed[:, None],
+                kf.reshape(k_pool.shape), vf.reshape(v_pool.shape))
+
+    return fused_decode

@@ -1,0 +1,224 @@
+"""Decode-time sampling: ONE reference implementation, every consumer.
+
+The serving engine historically had two samplers that could drift: the
+compiled-program side (device) and ``Engine._sample`` (a numpy fallback
+that quietly up-cast to float64, so its probabilities disagreed with any
+fp32 device sampler in the last ulps).  This module is the fix and the
+ISSUE 15 fast path:
+
+- :func:`logits_to_probs` — the logits→probabilities REFERENCE
+  (temperature scaling, dynamic per-row top-k via a sort threshold,
+  fp32 softmax, explicit greedy one-hot).  Written against the array
+  namespace (``xp=np`` or ``xp=jnp``) so the numpy fallback, the fused
+  device program, and the parity tests literally share one function.
+- :func:`sample_burst` — the fused device sampler (traced inside
+  ``serve.model.make_fused_decode_fn``): greedy / temperature+top-k
+  sampling of ONE token per slot, generalized to **draft verification
+  by rejection sampling** for self-speculative decoding.  Draft
+  proposals come from the model-free n-gram drafter (``serve.draft``),
+  i.e. a *deterministic* proposal ``q = onehot(d)``: a draft token
+  ``d`` is accepted with probability ``min(1, p(d)/q(d)) = p(d)``, and
+  on rejection the replacement is drawn from the residual
+  ``max(p - q, 0)`` renormalized — so the emitted distribution is
+  EXACTLY the target model's ``p``, token by token (the standard
+  speculative-sampling correctness argument; pinned by the
+  distribution test in tests/test_serve_spec.py).  At temperature 0
+  this degenerates to ``accept iff d == argmax(p)`` and the output is
+  token-for-token identical to sequential greedy decoding.
+- :func:`sample_one` — the same math applied eagerly to one logits row
+  (the engine's first-token sample at prefill completion, so the host
+  and device samplers cannot diverge).
+
+Randomness contract: each request owns a base key (``PRNGKey(seed)``,
+resident on device in the engine); the draw for the token at emitted
+index ``t`` uses ``fold_in(base, t)`` split into an accept-uniform and
+a sample key.  Keying by *emitted index* (not decode step) keeps a
+request's sampling stream independent of how many tokens each
+speculative step happened to accept.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["logits_to_probs", "sample_burst", "sample_one"]
+
+
+def logits_to_probs(logits, temperature, top_k, *, xp=np):
+    """``(..., V)`` logits → fp32 probabilities; the one reference.
+
+    ``temperature`` and ``top_k`` broadcast against the leading dims
+    (scalars or per-row arrays).  ``top_k=0`` disables truncation;
+    ``temperature <= 0`` is greedy and returns the exact one-hot of the
+    (first) argmax — NOT a softmax at a tiny temperature, so ties
+    resolve identically to ``argmax``.  fp32 throughout: the numpy
+    fallback must match the device sampler bit-for-bit in structure (no
+    float64 up-cast), which is what makes it usable as the parity
+    reference.  Pass ``xp=jnp`` to trace the same math on device.
+    """
+    v = logits.shape[-1]
+    logits = logits.astype(xp.float32)
+    rows = logits.shape[:-1]
+    t = xp.broadcast_to(
+        xp.asarray(temperature, dtype=xp.float32), rows)[..., None]
+    k = xp.broadcast_to(xp.asarray(top_k, dtype=xp.int32), rows)[..., None]
+    scaled = logits / xp.maximum(t, xp.asarray(1e-6, dtype=xp.float32))
+    # dynamic per-row top-k: threshold at the k-th largest via one sort
+    # (jax.lax.top_k needs a static k; the per-request k is data here)
+    srt = xp.sort(scaled, axis=-1)  # ascending
+    kth = xp.take_along_axis(
+        srt, xp.clip(v - k, 0, v - 1).astype(xp.int32), axis=-1)
+    neg_inf = xp.asarray(-np.inf, dtype=xp.float32)
+    scaled = xp.where((k > 0) & (scaled < kth), neg_inf, scaled)
+    m = xp.max(scaled, axis=-1, keepdims=True)
+    p = xp.exp(scaled - m)
+    soft = p / xp.sum(p, axis=-1, keepdims=True)
+    # greedy rows: exact one-hot of the first argmax (tie semantics ==
+    # argmax, unlike a temperature->0 softmax which splits tie mass)
+    am = xp.argmax(logits, axis=-1)
+    onehot = (xp.arange(v, dtype=xp.int32)[None, :]
+              == xp.reshape(am, (-1, 1))).reshape(logits.shape)
+    return xp.where(t <= 0, onehot.astype(xp.float32), soft)
+
+
+def _fold_keys(keys, positions):
+    """Per-(row, position) (accept-uniform, sample) key pairs from the
+    per-row base keys: ``fold_in(base, position)`` then one split."""
+
+    def one(key, pos):
+        k = jax.random.fold_in(key, pos)
+        ku, ks = jax.random.split(k)
+        return ku, ks
+
+    return jax.vmap(jax.vmap(one, in_axes=(None, 0)))(keys, positions)
+
+
+def _categorical(key, probs):
+    """One draw from a probability vector (zeros stay unreachable)."""
+    return jax.random.categorical(
+        key, jnp.where(probs > 0, jnp.log(probs), -jnp.inf)
+    ).astype(jnp.int32)
+
+
+def sample_burst(logits, tokens, draft_lens, keys, sample_pos, temperature,
+                 top_k, active):
+    """Fused sampling + speculative verification (traced, device side).
+
+    Args (``B`` slots, ``T = 1 + max draft`` query positions):
+
+    - ``logits`` ``(B, T, V)`` fp32 — position ``i``'s logits condition
+      on the last committed token plus drafts ``d_1..d_i``;
+    - ``tokens`` ``(B, T)`` — ``[:, 0]`` is each slot's last committed
+      token (whose K/V this step wrote), ``[:, 1:]`` the draft tokens;
+    - ``draft_lens`` ``(B,)`` — how many drafts are real (0 = plain
+      decode; ``T=1`` is the non-speculative fused program);
+    - ``keys`` ``(B, 2)`` per-request base PRNG keys, ``sample_pos``
+      ``(B,)`` the emitted index of each slot's next token;
+    - ``temperature``/``top_k`` ``(B,)`` per-request sampling params;
+    - ``active`` ``(B,)`` bool slot mask.
+
+    Returns ``(out_tokens (B, T), n_emitted (B,), next_feed (B,))``:
+    ``out_tokens[b, :n]`` are the emitted tokens (accepted draft prefix
+    + one correction/bonus token, so ``1 <= n <= draft_lens[b] + 1``),
+    and ``next_feed`` is each slot's last emitted token (the next
+    step's input, kept device-resident by the engine; inactive slots
+    pass their input through).
+    """
+    b, t_width, v = logits.shape
+    argmx = jnp.argmax(logits, axis=-1).astype(jnp.int32)        # (B, T)
+    greedy = (temperature <= 0.0)[:, None]                       # (B, 1)
+    drafts_pad = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1
+    )                                                            # (B, T)
+    draft_mask = jnp.arange(t_width - 1)[None, :] < draft_lens[:, None]
+
+    def _assemble(accepted, corr):
+        i_idx = jnp.arange(t_width)[None, :]
+        out = jnp.where(
+            i_idx < accepted[:, None], drafts_pad,
+            jnp.where(i_idx == accepted[:, None], corr, 0),
+        ).astype(jnp.int32)
+        n_emitted = jnp.where(active, accepted + 1, 0).astype(jnp.int32)
+        last = jnp.take_along_axis(out, accepted[:, None], axis=1)[:, 0]
+        next_feed = jnp.where(active, last, tokens[:, 0]).astype(jnp.int32)
+        return out, n_emitted, next_feed
+
+    def _prefix_len(acc):
+        return jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
+
+    def _greedy_branch():
+        # All-greedy batches (the common serving default) skip the probs
+        # machinery entirely: accept iff the draft IS the argmax, emit
+        # argmaxes — token-for-token the sequential greedy path.
+        if t_width > 1:
+            accepted = _prefix_len(
+                (tokens[:, 1:] == argmx[:, :-1]) & draft_mask)
+        else:
+            accepted = jnp.zeros((b,), jnp.int32)
+        return _assemble(accepted, argmx)
+
+    def _general_branch():
+        probs = logits_to_probs(logits, temperature[:, None],
+                                top_k[:, None], xp=jnp)
+        pos = sample_pos[:, None] + jnp.arange(t_width)[None, :]
+        ku, ks = _fold_keys(keys, pos)
+        u = jax.vmap(jax.vmap(jax.random.uniform))(ku)           # (B, T)
+        if t_width > 1:
+            d = tokens[:, 1:]                                    # (B, T-1)
+            p_d = jnp.take_along_axis(
+                probs[:, :-1], d[:, :, None], axis=-1)[..., 0]
+            acc = jnp.where(greedy, d == argmx[:, :-1], u[:, :-1] < p_d)
+            accepted = _prefix_len(acc & draft_mask)
+        else:
+            accepted = jnp.zeros((b,), jnp.int32)
+        # Correction (rejected draft: residual max(p - onehot(d), 0)
+        # renormalized) / bonus (all drafts accepted: full distribution)
+        # token for EVERY position; position `accepted` is the one used.
+        has_draft = jnp.arange(t_width)[None, :] < draft_lens[:, None]
+        onehot_d = jax.nn.one_hot(drafts_pad, v, dtype=probs.dtype)
+        resid = jnp.where(has_draft[..., None],
+                          jnp.maximum(probs - onehot_d, 0.0), probs)
+        denom = resid.sum(-1, keepdims=True)
+        # p == onehot(d) exactly means accept probability 1 — the
+        # residual is unreachable; guard the 0/0 anyway.
+        resid = jnp.where(denom > 0, resid / jnp.maximum(denom, 1e-30),
+                          probs)
+        samp = jax.vmap(jax.vmap(_categorical))(ks, resid)       # (B, T)
+        corr = jnp.where(greedy, argmx, samp).astype(jnp.int32)
+        return _assemble(accepted, corr)
+
+    # Runtime (not trace-time) gate: greedy rows inside a mixed batch
+    # take the argmax/argmax-accept where's of the general branch, so
+    # the fast branch is exactly the all-greedy specialization of it.
+    return jax.lax.cond(jnp.all(greedy), _greedy_branch, _general_branch)
+
+
+@jax.jit
+def _sample_one_impl(logits_row, key, index, temperature, top_k):
+    out, _, _ = sample_burst(
+        logits_row.astype(jnp.float32)[None, None, :],
+        jnp.zeros((1, 1), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        key[None],
+        index[None],
+        temperature[None],
+        top_k[None],
+        jnp.ones((1,), bool),
+    )
+    return out[0, 0]
+
+
+def sample_one(logits_row, key, index, temperature, top_k) -> int:
+    """One token from one logits row with the device sampler's exact
+    math and key schedule (the engine's first-token sample when fused
+    sampling is on — host and device draws stay one stream).  Jitted:
+    an eager ``sample_burst`` would re-trace its ``lax.cond`` branches
+    on every call."""
+    return int(_sample_one_impl(
+        jnp.asarray(logits_row), jnp.asarray(key),
+        jnp.asarray(index, jnp.int32),
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+    ))

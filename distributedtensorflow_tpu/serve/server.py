@@ -11,14 +11,25 @@ Endpoint contract (docs/API.md "Serving"):
 
 - ``POST /generatez`` — body ``{"prompt": [int, ...], "max_new_tokens":
   int, "temperature"?: float, "top_k"?: int, "eos_token_id"?: int,
-  "seed"?: int, "timeout_s"?: float, "trace_id"?: str}``.  Blocks until
-  the request reaches a terminal state; replies 200 ``{"id", "tokens",
-  "trace_id", "finish_reason", "prompt_tokens", "new_tokens", "ttft_s",
-  "tpot_s", "e2e_s"}``.  ``trace_id`` is the distributed-tracing id the
-  engine's queue/prefill/decode spans carry (generated when absent).  Error
+  "seed"?: int, "timeout_s"?: float, "trace_id"?: str, "stream"?:
+  bool}``.  Blocks until the request reaches a terminal state; replies
+  200 ``{"id", "tokens", "trace_id", "finish_reason", "prompt_tokens",
+  "new_tokens", "ttft_s", "tpot_s", "e2e_s", "drafted", "accepted"}``.
+  ``trace_id`` is the distributed-tracing id the engine's
+  queue/prefill/decode spans carry (generated when absent).  Error
   mapping: malformed body/parameters → 400, queue full (backpressure) →
   429, engine failure → 500, wall-clock timeout → 504 (the request keeps
   running server-side; poll ``GET /generatez`` for slot state).
+
+  With ``"stream": true`` the reply is a chunked-transfer
+  ``application/x-ndjson`` stream: one ``{"tokens": [int, ...]}`` line
+  per engine iteration AS each iteration commits tokens (a speculative
+  burst arrives as one line), then a final trailer line ``{"done":
+  true, "status": ..., ...}`` carrying the same stats the blocking
+  reply would (or the error).  Because headers go out before the first
+  token, submit-time failures still map to real 4xx/5xx statuses —
+  only post-admission failures land in the trailer.  requests.jsonl
+  rows are identical to blocking requests.
 - ``GET /generatez`` — engine state JSON: queue depth, slot occupancy
   (with each slot's ``prefill``/``decode`` phase), paged-KV budget,
   admission/eviction counters, and the prefix-cache census (``kv``:
@@ -34,10 +45,12 @@ from __future__ import annotations
 import json
 import logging
 import math
+import queue as queue_mod
 import threading
+import time
 
 from ..obs.server import StatusServer
-from .engine import Engine, QueueFullError
+from .engine import Engine, GenRequest, QueueFullError
 
 logger = logging.getLogger("distributedtensorflow_tpu")
 
@@ -172,6 +185,9 @@ class ServeServer:
             return 400, {"error": f"'timeout_s' must be a finite number "
                                   f">= 0, got {timeout}"}
         timeout = min(timeout, threading.TIMEOUT_MAX)
+        stream = payload.get("stream", False)
+        if not isinstance(stream, bool):
+            return 400, {"error": f"bad 'stream': {stream!r} (a boolean)"}
         try:
             # The client's timeout IS the request deadline, propagated
             # into the engine: a request still queued past it is
@@ -179,7 +195,7 @@ class ServeServer:
             # already gave up.
             req = self.engine.submit(
                 prompt, deadline_s=timeout if timeout > 0 else None,
-                **kwargs,
+                stream=stream, **kwargs,
             )
         except QueueFullError as e:
             return 429, {"error": str(e)}
@@ -187,6 +203,12 @@ class ServeServer:
             return 400, {"error": str(e)}
         except RuntimeError as e:  # dead scheduler loop
             return 503, {"error": str(e)}
+        if stream:
+            # Chunked transfer: the StatusServer streams this generator
+            # (obs.server._reply_chunked); submit-time errors above kept
+            # their real statuses — from here on failures ride the
+            # trailer line, since headers are already committed.
+            return 200, self._stream_response(req, timeout)
         if not req.wait(timeout):
             return 504, {"error": f"generation exceeded timeout_s="
                                   f"{timeout}", "id": req.id}
@@ -198,7 +220,13 @@ class ServeServer:
         if req.status != "ok":
             return 500, {"error": req.error or f"request {req.status}",
                          "id": req.id}
-        return 200, {
+        return 200, self._ok_stats(req)
+
+    @staticmethod
+    def _ok_stats(req: GenRequest) -> dict:
+        """The completed-request stat block: the blocking 200 body, and
+        (minus ``tokens``, already streamed) the streaming trailer."""
+        return {
             "id": req.id,
             "tokens": req.tokens,
             "trace_id": req.trace_id,
@@ -208,7 +236,55 @@ class ServeServer:
             "ttft_s": round(req.ttft_s, 6),
             "tpot_s": round(req.tpot_s, 6),
             "e2e_s": round(req.e2e_s, 6),
+            "drafted": req.drafted,
+            "accepted": req.accepted,
         }
+
+    def _stream_response(self, req: GenRequest, timeout: float):
+        """Generator of ndjson lines for one streaming request: token
+        lines as iterations commit, then one trailer with the stats.
+        The engine always terminates requests (crash/stop included), so
+        the ``done`` event is guaranteed; the timeout guards the stream
+        the same way ``req.wait(timeout)`` guards the blocking path —
+        on expiry the trailer reports it and the request keeps running
+        server-side (the engine-side deadline already abandons requests
+        still QUEUED past it)."""
+        deadline = time.monotonic() + timeout
+
+        def gen():
+            while True:
+                remaining = deadline - time.monotonic()
+                try:
+                    kind, payload = req._events.get(
+                        timeout=max(remaining, 0.0))
+                except queue_mod.Empty:
+                    yield json.dumps({
+                        "done": True, "status": "timeout", "id": req.id,
+                        "error": f"generation exceeded timeout_s={timeout}",
+                    }) + "\n"
+                    return
+                if kind != "tokens":
+                    break
+                yield json.dumps({"tokens": payload}) + "\n"
+            if req.status == "ok":
+                trailer = {"done": True, "status": "ok", **self._ok_stats(req)}
+                del trailer["tokens"]  # already streamed line by line
+            elif req.deadline_exceeded:
+                # engine-side deadline abandonment is the SAME condition
+                # the generator's own expiry reports (and the blocking
+                # path maps to 504): one status class, not a race
+                trailer = {
+                    "done": True, "status": "timeout", "id": req.id,
+                    "error": req.error or "deadline exceeded",
+                }
+            else:
+                trailer = {
+                    "done": True, "status": req.status, "id": req.id,
+                    "error": req.error or f"request {req.status}",
+                }
+            yield json.dumps(trailer) + "\n"
+
+        return gen()
 
     # -- lifecycle -----------------------------------------------------------
 

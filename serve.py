@@ -130,6 +130,28 @@ def main(argv=None) -> int:
                         "prefill starts at the first uncached token; "
                         "refcount-0 blocks stay warm and are LRU-evicted "
                         "only under pool pressure")
+    p.add_argument("--fused-sampling", action="store_true",
+                   help="decode fast path: fold greedy and temperature/"
+                        "top-k sampling into the compiled decode program "
+                        "— per-slot PRNG keys and last tokens stay "
+                        "device-resident, the host gets one small "
+                        "(tokens, counts) fetch per iteration for EOS/"
+                        "logging instead of a logits pull + numpy "
+                        "softmax + token feed-back per token")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="self-speculative decoding (requires "
+                        "--fused-sampling): a model-free n-gram drafter "
+                        "proposes up to K tokens from the request's own "
+                        "history, verified in ONE multi-token paged "
+                        "attention pass; greedy output is token-for-"
+                        "token the sequential path's, sampling is exact "
+                        "via rejection sampling.  Pays off when "
+                        "continuations repeat context (code, few-shot, "
+                        "extraction); novel text degrades to the plain "
+                        "fused path (0 = off)")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="longest suffix n-gram the drafter matches "
+                        "against the request history")
     p.add_argument("--max-context", type=int, default=None,
                    help="serving context cap (default: model max_seq)")
     p.add_argument("--max-new-cap", type=int, default=None,
@@ -199,6 +221,9 @@ def main(argv=None) -> int:
         prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget or None,
         prefix_cache=args.prefix_cache,
+        fused_sampling=args.fused_sampling or args.speculate > 0,
+        speculate=args.speculate,
+        spec_ngram=args.spec_ngram,
         max_context=args.max_context,
         max_new_cap=args.max_new_cap, logdir=args.logdir,
         log_every=args.log_every,
@@ -235,10 +260,11 @@ def main(argv=None) -> int:
     }), flush=True)
     logging.info(
         "serving %s on %s:%d (slots=%d queue=%d block=%d prefix_cache=%s "
-        "prefill_budget=%s)",
+        "prefill_budget=%s fused_sampling=%s speculate=%d)",
         args.config, args.host, server.port, args.max_slots,
         args.max_queue, args.block_size, args.prefix_cache,
         args.prefill_budget or "unbudgeted",
+        args.fused_sampling or args.speculate > 0, args.speculate,
     )
     while not stop.is_set():
         time.sleep(0.2)

@@ -320,3 +320,85 @@ def test_queued_past_deadline_abandoned_server_side(served_model):
         assert doomed.tokens == []  # never decoded
     finally:
         engine.stop(drain=False)
+
+
+# ------------------------------------------------- streaming (ISSUE 15)
+
+
+def _post_stream(port, payload, timeout=60):
+    """POST /generatez with a streaming body; returns (status, lines)
+    where lines are the parsed ndjson documents (urllib's http.client
+    decodes the chunked transfer)."""
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generatez", data=data,
+        headers={"Content-Type": "application/json"},
+    )
+    r = urllib.request.urlopen(req, timeout=timeout)
+    lines = [json.loads(l) for l in r.read().decode().splitlines() if l]
+    return r.status, r.headers, lines
+
+
+def test_streaming_tokens_then_trailer(frontend):
+    """stream=true emits per-iteration token lines whose concatenation
+    equals the blocking reply, then one trailer with the usual stats;
+    requests.jsonl semantics (tested on the engine) are untouched."""
+    server, engine, prompt = frontend
+    status, blocking = _post(server.port, "/generatez",
+                             {"prompt": prompt, "max_new_tokens": 6})
+    assert status == 200
+    status, headers, lines = _post_stream(
+        server.port, {"prompt": prompt, "max_new_tokens": 6,
+                      "stream": True})
+    assert status == 200
+    assert headers.get("Content-Type", "").startswith(
+        "application/x-ndjson")
+    token_lines = [l for l in lines if "tokens" in l and "done" not in l]
+    assert len(token_lines) >= 2  # incremental, not one blob
+    streamed = [t for l in token_lines for t in l["tokens"]]
+    assert streamed == blocking["tokens"]  # greedy: identical output
+    trailer = lines[-1]
+    assert trailer["done"] is True and trailer["status"] == "ok"
+    assert trailer["new_tokens"] == 6
+    assert trailer["finish_reason"] == "length"
+    assert 0 <= trailer["ttft_s"] <= trailer["e2e_s"]
+    assert "tokens" not in trailer  # already streamed line by line
+    assert trailer["accepted"] <= trailer["drafted"] or (
+        trailer["drafted"] == 0 and trailer["accepted"] == 0)
+
+
+def test_streaming_submit_errors_keep_real_statuses(frontend):
+    """Submit-time failures must NOT be smuggled into a 200 stream:
+    validation still 400s before any chunk goes out."""
+    server, engine, prompt = frontend
+    status, body = _post(server.port, "/generatez",
+                         {"prompt": prompt, "max_new_tokens": 0,
+                          "stream": True})
+    assert status == 400
+    status, body = _post(server.port, "/generatez",
+                         {"prompt": prompt, "max_new_tokens": 2,
+                          "stream": "yes"})
+    assert status == 400
+    assert "stream" in body["error"]
+
+
+def test_streaming_timeout_lands_in_trailer(served_model):
+    """A stream whose request outlives timeout_s ends with a timeout
+    trailer (headers are committed, so no 504 is possible) while the
+    request keeps running server-side."""
+    cfg, params, prompt = served_model
+    engine = Engine(params, cfg, max_slots=1, max_queue=8, block_size=4,
+                    prefill_chunk=4, max_context=64)
+    server = ServeServer(engine, 0).start()
+    try:
+        # engine loop NOT started: nothing drains, the stream times out
+        status, headers, lines = _post_stream(
+            server.port, {"prompt": prompt, "max_new_tokens": 4,
+                          "stream": True, "timeout_s": 0.3})
+        assert status == 200
+        assert lines[-1]["done"] is True
+        assert lines[-1]["status"] == "timeout"
+        assert "timeout" in lines[-1]["error"]
+    finally:
+        server.stop()
+        engine.stop(drain=False)

@@ -286,3 +286,119 @@ def test_bench_serve_smoke():
     rows = result["interference"]["rows"]
     assert rows and all(r["victims_ok"] >= 1 for r in rows)
     assert {r["prefill_budget"] for r in rows} == {0, 16}
+    # ISSUE 15 spec sweep rides along: three arms, both workloads, the
+    # dispatch claim (host 2.0 -> fused 1.0) and accepted <= drafted
+    spec = result["spec"]
+    for wname in ("repetitive", "random"):
+        arms = spec["workloads"][wname]
+        assert set(arms) == {"host", "fused", "spec"}
+        assert arms["host"]["dispatches_per_step"] == 2.0
+        assert arms["fused"]["dispatches_per_step"] == 1.0
+        assert arms["spec"]["dispatches_per_step"] <= 1.0
+        for arm in arms.values():
+            assert arm["accepted"] <= arm["drafted"]
+    assert spec["workloads"]["repetitive"]["spec"]["drafted"] > 0
+    assert spec["workloads"]["repetitive"]["spec"][
+        "tokens_per_decode_step"] > 1.0
+
+
+def test_serve_smoke_fused_speculative_streaming(tmp_path):
+    """ISSUE 15 slow-lane smoke: serve.py with the full fast-path flag
+    set (--fused-sampling --speculate --prefix-cache --prefill-budget),
+    a streaming client, spec counters on /varz, schema gates green, and
+    the run_report decode-fast-path digest."""
+    logdir = str(tmp_path / "serve_spec")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--config", "gpt_tiny", "--port", "0",
+            "--max-slots", "2", "--max-queue", "32",
+            "--block-size", "8", "--prefill-chunk", "8",
+            "--prefill-budget", "16", "--prefix-cache",
+            "--fused-sampling", "--speculate", "4",
+            "--max-context", "128", "--logdir", logdir,
+            "--log-every", "5",
+        ],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        boot = json.loads(proc.stdout.readline())
+        port = boot["port"]
+        periodic = (list(range(1, 9)) * 6)[:40]  # the drafter's habitat
+        blocking = []
+        for i in range(4):
+            blocking.append(_post(
+                port, {"prompt": periodic[i:] + periodic[:i],
+                       "max_new_tokens": 16}))
+        for status, body in blocking:
+            assert status == 200, body
+            assert body["new_tokens"] >= 1
+            assert body["accepted"] <= body["drafted"]
+
+        # streaming client: chunked token lines + the stats trailer,
+        # token-for-token what the blocking reply for the same prompt
+        # returned (greedy = deterministic)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generatez",
+            data=json.dumps({"prompt": periodic, "max_new_tokens": 16,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        r = urllib.request.urlopen(req, timeout=120)
+        assert r.status == 200
+        lines = [json.loads(l) for l in r.read().decode().splitlines()]
+        streamed = [t for l in lines if "tokens" in l and "done" not in l
+                    for t in l["tokens"]]
+        assert streamed == blocking[0][1]["tokens"]
+        assert lines[-1]["done"] is True and lines[-1]["status"] == "ok"
+
+        varz = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/varz", timeout=10).read().decode()
+        drafted = [line for line in varz.splitlines()
+                   if line.startswith("serve_spec_drafted_total")]
+        assert drafted and float(drafted[0].split()[-1]) > 0, drafted
+        assert "serve_decode_tokens_per_step_bucket" in varz
+
+        state = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/generatez", timeout=10
+        ).read().decode())
+        assert state["fused_sampling"] is True
+        assert state["speculate"] == 4
+        assert state["tokens_per_step"] >= 1.0
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, err[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+
+    rows = [json.loads(line)
+            for line in open(os.path.join(logdir, "requests.jsonl"))]
+    ok = [r for r in rows if r["status"] == "ok"]
+    assert sum(r["drafted"] for r in ok) > 0
+    assert all(r["accepted"] <= r["drafted"] for r in ok)
+
+    chk = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_metrics_schema.py"),
+         os.path.join(logdir, "requests.jsonl"),
+         os.path.join(logdir, "metrics.jsonl"),
+         os.path.join(logdir, "metrics.prom")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+         logdir, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    fp = json.loads(rep.stdout)["serving"]["decode_fast_path"]
+    assert fp["fused_sampling"] is True and fp["speculate"] == 4
+    assert fp["drafted"] > 0
+    assert fp["dispatches_per_step"] == 1.0

@@ -259,15 +259,26 @@ SERVE_PREFIX_RATIOS = (
     "serve_prefix_hit_rate", "serve_prefix_cache_occupancy",
     "serve_kv_fragmentation",
 )
+#: Decode-fast-path metric families (serve/engine.py, ISSUE 15): the
+#: speculative counters are monotonic non-negative and the acceptance
+#: invariant ``accepted <= drafted`` must hold wherever both appear
+#: (one .prom page, one metrics row, one requests.jsonl row).
+SERVE_SPEC_COUNTERS = (
+    "serve_spec_drafted_total", "serve_spec_accepted_total",
+)
 #: Their spellings inside the serving engine's own metrics.jsonl rows.
 SERVE_ROW_COUNTERS = (
     "prefix_hits_total", "prefix_lookups_total",
     "prefix_cached_tokens_total", "prefill_tokens_total",
     "prefix_evictions_total", "cow_copies_total", "blocks_cached",
     "block_refs", "prefill_iters", "prefill_chunks", "prefill_budget",
+    "spec_drafted_total", "spec_accepted_total", "decode_tokens_total",
+    "decode_dispatches_total", "host_sample_rounds_total", "speculate",
+    "fused_sampling", "tokens_per_step",
 )
 SERVE_ROW_RATIOS = (
     "prefix_hit_rate", "prefix_occupancy", "kv_fragmentation",
+    "spec_acceptance_rate",
 )
 
 #: The known ``op`` labels of the ``collective_dispatch_seconds``
@@ -484,6 +495,15 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
             # pre-sentinel writers emitted bare NaN tokens; python json
             # still parses them, so keep flagging rather than erroring
             warnings.append(f"line {lineno}: field {k!r} is non-finite ({v})")
+    drafted = row.get("spec_drafted_total")
+    accepted = row.get("spec_accepted_total")
+    if _nonneg_int(drafted) and _nonneg_int(accepted) \
+            and accepted > drafted:
+        errors.append(
+            f"line {lineno}: spec_accepted_total {accepted} exceeds "
+            f"spec_drafted_total {drafted} — the verifier cannot accept "
+            "more drafts than were proposed"
+        )
     return errors, warnings
 
 
@@ -964,6 +984,25 @@ def check_requests_file(path: str) -> tuple[list[str], list[str]]:
             ):
                 errors.append(f"line {i}: 'itl_max_s' {itl!r} is not a "
                               "non-negative finite number")
+            # speculative-decoding accounting (ISSUE 15; present on
+            # engines built since then — validated when present): both
+            # non-negative ints, and a request can never have more
+            # drafts accepted than proposed.
+            spec = {}
+            for name in ("drafted", "accepted"):
+                v = row.get(name)
+                if v is None:
+                    continue
+                if not _nonneg_int(v):
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative integer")
+                else:
+                    spec[name] = int(v)
+            if len(spec) == 2 and spec["accepted"] > spec["drafted"]:
+                errors.append(
+                    f"line {i}: 'accepted' {spec['accepted']} exceeds "
+                    f"'drafted' {spec['drafted']}"
+                )
     return errors, warnings
 
 
@@ -1039,6 +1078,7 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
     silently fork the histogram's time series."""
     errors: list[str] = []
     warnings: list[str] = []
+    spec_totals: dict[str, float] = {}
     with open(path) as f:
         for i, line in enumerate(f, start=1):
             line = line.strip()
@@ -1095,7 +1135,8 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                         f"line {i}: {name} carries unknown fleet peer "
                         f"state {state!r} (known: {FLEET_PEER_STATES})"
                     )
-            if name in SERVE_PREFIX_COUNTERS or name in SERVE_PREFIX_RATIOS:
+            if name in SERVE_PREFIX_COUNTERS or name in SERVE_PREFIX_RATIOS \
+                    or name in SERVE_SPEC_COUNTERS:
                 try:
                     v = float(value)
                 except ValueError:
@@ -1104,12 +1145,21 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                     if v < 0:
                         errors.append(
                             f"line {i}: {name} is negative ({value}) — "
-                            "serving prefix-cache samples are non-negative"
+                            "serving prefix-cache/speculation samples are "
+                            "non-negative"
                         )
                     elif name in SERVE_PREFIX_RATIOS and v > 1.0:
                         errors.append(
                             f"line {i}: {name} {value} is not in [0, 1]"
                         )
+                    if name in SERVE_SPEC_COUNTERS:
+                        if labelstr:
+                            errors.append(
+                                f"line {i}: {name} carries unexpected "
+                                f"labels {labelstr!r} (the speculation "
+                                "counters are unlabeled)"
+                            )
+                        spec_totals[name] = v
             if name.startswith(
                 ("pipeline_handoff_seconds", "pipeline_mpmd_stall_seconds")
             ):
@@ -1186,6 +1236,17 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                         )
                 except ValueError:
                     pass  # already reported above
+    if len(spec_totals) == 2 and (
+        spec_totals["serve_spec_accepted_total"]
+        > spec_totals["serve_spec_drafted_total"]
+    ):
+        errors.append(
+            f"serve_spec_accepted_total "
+            f"{spec_totals['serve_spec_accepted_total']:g} exceeds "
+            f"serve_spec_drafted_total "
+            f"{spec_totals['serve_spec_drafted_total']:g} — the verifier "
+            "cannot accept more drafts than were proposed"
+        )
     return errors, warnings
 
 

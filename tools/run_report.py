@@ -303,7 +303,9 @@ def serving_summary(rows: list[dict], metrics_rows: list[dict] | None
     and delivered token throughput over the log's time span.  With the
     engine's ``metrics.jsonl`` rows (ISSUE 14), also the prefix-cache
     story — hit rate, cached-token share, prefill-vs-decode token split —
-    and the per-iteration prefill-budget utilization."""
+    and the per-iteration prefill-budget utilization; with the ISSUE 15
+    fast path, the speculation digest (draft acceptance rate, tokens per
+    decode step, per-step dispatch count)."""
     if not rows:
         return {}
     by_status: dict[str, int] = {}
@@ -383,12 +385,39 @@ def serving_summary(rows: list[dict], metrics_rows: list[dict] | None
             "prompt_prefilled": prefilled,
             "decode": tokens,
         }
-    # per-iteration prefill-budget utilization, from the engine's last
-    # metrics.jsonl row (cumulative chunk/iteration counters + config)
+    # decode fast path (ISSUE 15): per-request draft accounting from the
+    # requests rows, tokens-per-step / dispatch telemetry from the
+    # engine's last metrics.jsonl row.
     last = {}
     for r in metrics_rows or []:
         if "prefill_iters" in r:
             last = r
+    spec_rows = [
+        r for r in ok
+        if isinstance(r.get("drafted"), (int, float))
+        and isinstance(r.get("accepted"), (int, float))
+    ]
+    drafted = sum(int(r["drafted"]) for r in spec_rows)
+    accepted = sum(int(r["accepted"]) for r in spec_rows)
+    if drafted or last.get("fused_sampling") or last.get("speculate"):
+        fast: dict = {
+            "fused_sampling": bool(last.get("fused_sampling", drafted > 0)),
+            "speculate": int(last.get("speculate", 0)),
+            "drafted": drafted,
+            "accepted": accepted,
+        }
+        if drafted:
+            fast["acceptance_rate"] = accepted / drafted
+        if isinstance(last.get("tokens_per_step"), (int, float)):
+            fast["tokens_per_step"] = last["tokens_per_step"]
+        steps = last.get("step")
+        disp = last.get("decode_dispatches_total")
+        rounds = last.get("host_sample_rounds_total")
+        if isinstance(steps, (int, float)) and steps \
+                and isinstance(disp, (int, float)) \
+                and isinstance(rounds, (int, float)):
+            fast["dispatches_per_step"] = (disp + rounds) / steps
+        out["decode_fast_path"] = fast
     iters = last.get("prefill_iters")
     chunk = last.get("prefill_chunk")
     budget = last.get("prefill_budget")
@@ -1080,6 +1109,23 @@ def render(report: dict) -> str:
                 f"{ts['prompt_cached']} cache-mapped prompt, "
                 f"{ts['decode']} decoded"
             )
+        fp = srv.get("decode_fast_path")
+        if fp:
+            bits = [f"fused_sampling={'on' if fp['fused_sampling'] else 'off'}"]
+            if fp.get("speculate"):
+                bits.append(f"speculate={fp['speculate']}")
+            if "acceptance_rate" in fp:
+                bits.append(
+                    f"{fp['acceptance_rate']:.0%} acceptance "
+                    f"({fp['accepted']}/{fp['drafted']} drafts)"
+                )
+            if "tokens_per_step" in fp:
+                bits.append(f"{fp['tokens_per_step']:.2f} tokens/step")
+            if "dispatches_per_step" in fp:
+                bits.append(
+                    f"{fp['dispatches_per_step']:.1f} dispatches/step"
+                )
+            lines.append("  decode fast path: " + ", ".join(bits))
         bu = srv.get("prefill_budget")
         if bu:
             util = (f", {bu['utilization']:.0%} of the "
