@@ -10,7 +10,11 @@ the socket stack's).  Three sweeps, selectable via ``BENCH_SERVE_MODE``
 
 - **offered load** (ISSUE 6): a Poisson-ish arrival sweep; per rate,
   request-level SLOs (TTFT / TPOT / e2e p50+p99), batch occupancy,
-  rejects, delivered tokens/sec.
+  rejects, delivered tokens/sec, plus the per-phase latency shares from
+  the engine's exclusive attribution fields (ISSUE 16:
+  ``queue_share_mean`` / ``prefill_share_mean`` / ``decode_share_mean``
+  — mean fraction of each request's e2e spent queued, in prefill
+  compute + interference stall, and in decode compute + speculation).
 - **shared prefix** (ISSUE 14): N prompts sharing a long common header
   (the system-prompt / few-shot pattern), offered at saturation with
   ``prefix_cache`` OFF vs ON — the ON arm maps the header's KV blocks
@@ -124,7 +128,7 @@ def _run_point(engine, *, rate: float, n: int, new: int, prompt_max: int,
     tpot = [r.tpot_s for r in ok if len(r.tokens) > 1]
     e2e = [r.e2e_s for r in ok]
     occ = [r.occ_max for r in ok if r.occ_steps]
-    return {
+    out = {
         "rate_rps": rate,
         "requests": n,
         "ok": len(ok),
@@ -141,6 +145,22 @@ def _run_point(engine, *, rate: float, n: int, new: int, prompt_max: int,
             if any(r.occ_steps for r in ok) else 0.0),
         "occupancy_max": max(occ, default=0),
     }
+    # per-phase latency shares from the engine's exclusive attribution
+    # fields (ISSUE 16): where each request's e2e went, averaged over ok
+    # requests — queue wait vs prefill (compute + interference stall) vs
+    # decode (compute + speculation window).
+    attr_ok = [r for r in ok if r.e2e_s > 0]
+    if attr_ok:
+        out["queue_share_mean"] = round(statistics.fmean(
+            max(r.t_admit - r.t_submit, 0.0) / r.e2e_s for r in attr_ok
+        ), 4)
+        out["prefill_share_mean"] = round(statistics.fmean(
+            (r.attr_prefill_s + r.attr_stall_s) / r.e2e_s for r in attr_ok
+        ), 4)
+        out["decode_share_mean"] = round(statistics.fmean(
+            (r.attr_decode_s + r.attr_spec_s) / r.e2e_s for r in attr_ok
+        ), 4)
+    return out
 
 
 def _offered_load_sweep(make_engine, *, rates, n, new, prompt_max,

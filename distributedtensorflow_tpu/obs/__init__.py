@@ -46,6 +46,12 @@ Surfaces:
   (``slo_burn_rate{slo=,window=}``), raising ``slo_violation`` flight
   events, serving ``/sloz``, and optionally arming the CaptureEngine on
   a fast-burn trip;
+- ``MetricsHistory`` — the embedded metrics history store (``obs.tsdb``):
+  fixed-memory downsampling rings over registry samples (plus fleet
+  merges and per-SLO good/total snapshots when attached), answering
+  windowed queries at ``GET /histz`` and persisting ``history.jsonl``
+  ticks that ``obs.slo.recompute_from_history`` replays into offline
+  burn rates;
 - ``remote_span`` / ``record_remote_span`` — cross-process request
   tracing: a trace context (trace_id, parent span_id) propagated over
   RPC frames so spans in every process's ``trace.jsonl`` stitch into one
@@ -55,7 +61,7 @@ Surfaces:
   single Chrome-trace/Perfetto timeline (restarts included).
 """
 
-from . import capture, fleet, flight_recorder, goodput, memory, slo  # noqa: F401
+from . import capture, fleet, flight_recorder, goodput, memory, slo, tsdb  # noqa: F401
 from .aggregate import (  # noqa: F401
     host_aggregate,
     spread_ratio,
@@ -85,6 +91,7 @@ from .registry import (  # noqa: F401
 )
 from .server import StatusServer  # noqa: F401
 from .slo import SLOMonitor, SLORule  # noqa: F401
+from .tsdb import MetricsHistory  # noqa: F401
 from .tracing import (  # noqa: F401
     Span,
     TraceRecorder,

@@ -72,6 +72,8 @@ __all__ = [
     "SLOMonitor",
     "load_rules",
     "validate_rules_doc",
+    "rule_history_samples",
+    "recompute_from_history",
 ]
 
 RULE_KINDS = ("histogram_under", "gauge_good_fraction", "gauge_bad_fraction")
@@ -199,6 +201,145 @@ def load_rules(path: str) -> list[SLORule]:
     return [SLORule.from_dict(r) for r in rules]
 
 
+def _rule_sample(rule: SLORule, reg) -> tuple | None:
+    """One instantaneous sample for ``rule`` from the registry, or None
+    for no data: ``(good, total)`` cumulative counts for histogram rules,
+    ``(good_fraction,)`` for gauge rules.  READ-ONLY lookup: get-or-create
+    would register the name with the observer's kind and crash the real
+    producer's later registration with a kind mismatch."""
+    m = reg.get(rule.metric)
+    if rule.kind == "histogram_under":
+        if not isinstance(m, reglib.Histogram):
+            return None
+        return (m.count_under(rule.threshold), m.total_count())
+    if not isinstance(m, reglib.Gauge):
+        return None
+    items = dict(m._items())
+    if () not in items:
+        # No UNLABELED sample: either never written, or a labeled-only
+        # gauge — reading value() would return the 0.0 default and fire
+        # a false maximum-burn violation.  Gauge rules target the
+        # unlabeled series; no data.
+        return None
+    value = items[()]
+    if not math.isfinite(value):
+        return None
+    good = value if rule.kind == "gauge_good_fraction" else 1.0 - value
+    return (min(max(good, 0.0), 1.0),)
+
+
+def _window_good(rule: SLORule, samples, window_s: float,
+                 now: float) -> float | None:
+    """Good fraction over the trailing window from a sample deque
+    (``(t, good, total)`` snapshots for histogram rules, ``(t, good)``
+    for gauge rules), or None for no data.  Shared between the live
+    monitor and :func:`recompute_from_history` so offline burns use the
+    exact same math."""
+    if not samples:
+        return None
+    cutoff = now - window_s
+    if rule.kind == "histogram_under":
+        cur = samples[-1]
+        # reference = the newest snapshot at or before the window edge
+        # (covers the full window); fall back to the oldest we have.
+        ref = samples[0]
+        for s in samples:
+            if s[0] <= cutoff:
+                ref = s
+            else:
+                break
+        d_total = cur[2] - ref[2]
+        if d_total <= 0:
+            return None  # no traffic in the window
+        d_good = max(min(cur[1] - ref[1], d_total), 0.0)
+        return d_good / d_total
+    vals = [s[1] for s in samples if s[0] >= cutoff]
+    if not vals:
+        vals = [samples[-1][1]]
+    return sum(vals) / len(vals)
+
+
+def _burn(good: float, objective: float) -> float:
+    budget = 1.0 - objective
+    return max((1.0 - good) / budget, 0.0) if budget > 0 else 0.0
+
+
+def rule_history_samples(rules, registry=None) -> dict[str, float]:
+    """Per-rule good/total snapshot scalars for the history store
+    (``obs.tsdb``): ``slo_good.<name>`` (+ ``slo_total.<name>`` for
+    histogram rules) per rule with data.  Persisted into history.jsonl
+    ticks, these are exactly the samples :func:`recompute_from_history`
+    needs to rebuild burn rates offline."""
+    reg = registry or reglib.default_registry()
+    out: dict[str, float] = {}
+    for rule in rules:
+        rule = rule if isinstance(rule, SLORule) else SLORule.from_dict(rule)
+        s = _rule_sample(rule, reg)
+        if s is None:
+            continue
+        out[f"slo_good.{rule.name}"] = float(s[0])
+        if len(s) > 1:
+            out[f"slo_total.{rule.name}"] = float(s[1])
+    return out
+
+
+def recompute_from_history(rules, rows, now: float | None = None) -> list[dict]:
+    """Offline SLO burn recomputation from ``history.jsonl`` rows
+    (each ``{"t": ..., "values": {...}}``, as written by
+    ``obs.tsdb.MetricsHistory``).  Replays each rule's
+    ``slo_good.<name>`` / ``slo_total.<name>`` series through the same
+    windowed-good math the live monitor uses and returns per-rule result
+    dicts shaped like :meth:`SLOMonitor.evaluate`'s (burn/good/no_data
+    per window), evaluated at ``now`` (default: the newest row time)."""
+    rules = [r if isinstance(r, SLORule) else SLORule.from_dict(r)
+             for r in rules]
+    samples: dict[str, collections.deque] = {
+        r.name: collections.deque() for r in rules
+    }
+    last_t = None
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        t = row.get("t")
+        vals = row.get("values")
+        if not _num(t) or not isinstance(vals, dict):
+            continue
+        last_t = t if last_t is None else max(last_t, t)
+        for rule in rules:
+            g = vals.get(f"slo_good.{rule.name}")
+            if not _num(g):
+                continue
+            if rule.kind == "histogram_under":
+                tot = vals.get(f"slo_total.{rule.name}")
+                if not _num(tot):
+                    continue
+                samples[rule.name].append((float(t), float(g), float(tot)))
+            else:
+                samples[rule.name].append((float(t), float(g)))
+    if now is None:
+        now = last_t
+    results: list[dict] = []
+    for rule in rules:
+        result: dict = {
+            "name": rule.name,
+            "kind": rule.kind,
+            "metric": rule.metric,
+            "objective": rule.objective,
+        }
+        for window, window_s in (("fast", rule.fast_window_s),
+                                 ("slow", rule.slow_window_s)):
+            good = None if now is None else _window_good(
+                rule, samples[rule.name], window_s, now)
+            if good is None:
+                result[f"burn_{window}"] = 0.0
+                result[f"no_data_{window}"] = True
+            else:
+                result[f"good_{window}"] = good
+                result[f"burn_{window}"] = _burn(good, rule.objective)
+        results.append(result)
+    return results
+
+
 class _RuleState:
     __slots__ = ("rule", "samples", "active", "violations", "last")
 
@@ -251,34 +392,15 @@ class SLOMonitor:
 
     def _sample(self, st: _RuleState, now: float) -> None:
         rule = st.rule
-        # READ-ONLY lookup: get-or-create would register the name with
-        # the monitor's kind and crash the real producer's later
-        # histogram()/gauge() call with a kind mismatch (or clobber its
-        # custom buckets).  An absent or differently-kinded metric is
-        # simply no data.
-        m = self._reg.get(rule.metric)
+        s = _rule_sample(rule, self._reg)
+        if s is None:
+            # absent or differently-kinded metric (or a non-finite /
+            # labeled-only gauge): simply no data
+            return
         if rule.kind == "histogram_under":
-            if not isinstance(m, reglib.Histogram):
-                return
-            total = m.total_count()
-            good = m.count_under(rule.threshold)
-            st.samples.append((now, good, total))
+            st.samples.append((now, s[0], s[1]))
         else:
-            if not isinstance(m, reglib.Gauge):
-                return
-            items = dict(m._items())
-            if () not in items:
-                # No UNLABELED sample: either never written, or a
-                # labeled-only gauge — reading value() would return the
-                # 0.0 default and fire a false maximum-burn violation.
-                # Gauge rules target the unlabeled series; no data.
-                return
-            value = items[()]
-            if not math.isfinite(value):
-                return
-            good = value if rule.kind == "gauge_good_fraction" \
-                else 1.0 - value
-            st.samples.append((now, min(max(good, 0.0), 1.0)))
+            st.samples.append((now, s[0]))
         horizon = now - st.rule.slow_window_s - self.interval_s
         while len(st.samples) > 1 and st.samples[0][0] < horizon:
             st.samples.popleft()
@@ -286,29 +408,7 @@ class SLOMonitor:
     def _window_good(self, st: _RuleState, window_s: float,
                      now: float) -> float | None:
         """Good fraction over the trailing window, or None for no data."""
-        rule = st.rule
-        if not st.samples:
-            return None
-        cutoff = now - window_s
-        if rule.kind == "histogram_under":
-            cur = st.samples[-1]
-            # reference = the newest snapshot at or before the window edge
-            # (covers the full window); fall back to the oldest we have.
-            ref = st.samples[0]
-            for s in st.samples:
-                if s[0] <= cutoff:
-                    ref = s
-                else:
-                    break
-            d_total = cur[2] - ref[2]
-            if d_total <= 0:
-                return None  # no traffic in the window
-            d_good = max(min(cur[1] - ref[1], d_total), 0.0)
-            return d_good / d_total
-        vals = [s[1] for s in st.samples if s[0] >= cutoff]
-        if not vals:
-            vals = [st.samples[-1][1]]
-        return sum(vals) / len(vals)
+        return _window_good(st.rule, st.samples, window_s, now)
 
     # -- evaluation ----------------------------------------------------------
 
@@ -323,7 +423,6 @@ class SLOMonitor:
         for st in states:
             rule = st.rule
             self._sample(st, now)
-            budget = 1.0 - rule.objective
             result: dict = {
                 "name": rule.name,
                 "kind": rule.kind,
@@ -340,8 +439,7 @@ class SLOMonitor:
                     burn = 0.0
                     result[f"no_data_{window}"] = True
                 else:
-                    burn = max((1.0 - good) / budget, 0.0) if budget > 0 \
-                        else 0.0
+                    burn = _burn(good, rule.objective)
                     result[f"good_{window}"] = good
                 result[f"burn_{window}"] = burn
                 self._m_burn.set(burn, slo=rule.name, window=window)

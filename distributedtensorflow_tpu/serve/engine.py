@@ -70,10 +70,18 @@ gauges ``serve_kv_blocks_cached`` / ``serve_kv_block_refs`` /
 ``serve_spec_drafted_total`` / ``serve_spec_accepted_total`` and the
 ``serve_decode_tokens_per_step`` histogram; a per-request
 ``requests.jsonl`` log (ok rows carry ``cached_prefix_tokens`` +
-``prefill_tokens``, summing to ``prompt_tokens``, and the per-request
-``drafted`` / ``accepted`` draft split) and periodic ``metrics.jsonl``
-rows + ``metrics.prom`` snapshots in ``logdir`` (the same streams
+``prefill_tokens``, summing to ``prompt_tokens``, the per-request
+``spec_drafted`` / ``spec_accepted`` draft split, and the EXCLUSIVE
+tail-latency attribution ``attr_queue_s`` / ``attr_prefill_s`` /
+``attr_stall_s`` / ``attr_decode_s`` / ``attr_spec_s`` / ``attr_gap_s``
+summing to ``e2e_s``) and periodic ``metrics.jsonl`` rows +
+``metrics.prom`` snapshots in ``logdir`` (the same streams
 ``tools/run_report.py`` and ``tools/check_metrics_schema.py`` consume).
+Every scheduler iteration that did work additionally leaves one step-log
+record — phase mix, occupancy, token/draft deltas, admissions/evictions,
+prefill chunks + budget stalls, and the admit/prefill/decode +
+host-vs-device wall split — in a bounded ring (``GET /stepz`` via the
+frontend; :meth:`Engine.step_records`) and ``steps.jsonl``.
 
 Threading model: HTTP/handler threads only touch :meth:`submit` (queue +
 lock); all device work and all ``PagedKVCache`` mutation happens on the
@@ -173,6 +181,20 @@ class GenRequest:
     #: drafted`` always; both 0 without ``--speculate``).
     drafted: int = 0
     accepted: int = 0
+    #: tail-latency attribution: the request's e2e decomposed into
+    #: EXCLUSIVE wall components charged on the engine thread — own
+    #: prefill compute, interference stall (the engine was running other
+    #: requests' prefill while this one was runnable), decode-program
+    #: wall (non-speculative / speculative dispatches split), and
+    #: scheduler gap (admit scans, bookkeeping, idle waits).  Together
+    #: with queue wait (``t_admit - t_submit``) they sum to ``e2e_s`` up
+    #: to clock rounding; ``_t_attr`` is the charging frontier.
+    attr_prefill_s: float = 0.0
+    attr_stall_s: float = 0.0
+    attr_decode_s: float = 0.0
+    attr_spec_s: float = 0.0
+    attr_gap_s: float = 0.0
+    _t_attr: float = 0.0
     #: streaming: newly committed tokens per iteration as ("tokens",
     #: [ids]) events plus one terminal ("done", None); None = blocking.
     _events: queue.Queue | None = dataclasses.field(
@@ -240,6 +262,7 @@ class Engine:
         max_new_cap: int | None = None,
         logdir: str | None = None,
         log_every: int = 50,
+        step_ring: int = 512,
         registry=None,
     ):
         if max_slots < 1:
@@ -361,6 +384,22 @@ class Engine:
         self.occupancy_max = 0
         self.prefill_iters = 0   # iterations that ran >= 1 prefill chunk
         self.prefill_chunks = 0  # chunks run across all iterations
+        #: iterations where the prefill budget ran out with fillers still
+        #: pending (the per-step ``budget_stall`` flag, accumulated).
+        self.prefill_budget_stalls = 0
+        # engine step log (request-path observability): every step()
+        # iteration that did work appends one structured record to this
+        # bounded ring (the GET /stepz tail) and, with a logdir, to
+        # steps.jsonl.  Ring appends/reads happen under _log_lock so
+        # /stepz snapshots never race the engine thread.
+        self.step_ring_size = max(int(step_ring), 1)
+        self._step_ring: collections.deque = collections.deque(
+            maxlen=self.step_ring_size)
+        self._step_id = 0
+        self._step_evicted = 0     # requests finished in the current step
+        self._iter_prefill_s = 0.0  # this iteration's prefill-phase wall
+        self._iter_device_s = 0.0   # this iteration's program-dispatch wall
+        self._prefill_stalled = False
         # prefix_lookups/hits/cached_tokens live on the PagedKVCache (the
         # admission path that owns the success-only counting rule) — one
         # source of truth, surfaced via kv.stats(); only the engine-level
@@ -453,11 +492,13 @@ class Engine:
 
         self._req_log = None
         self._met_log = None
+        self._step_log = None
         self._log_lock = threading.Lock()
         if logdir:
             os.makedirs(logdir, exist_ok=True)
             self._req_log = open(os.path.join(logdir, "requests.jsonl"), "a")
             self._met_log = open(os.path.join(logdir, "metrics.jsonl"), "a")
+            self._step_log = open(os.path.join(logdir, "steps.jsonl"), "a")
 
     # -- submission (any thread) ---------------------------------------------
 
@@ -655,18 +696,99 @@ class Engine:
     def step(self) -> bool:
         """One scheduler iteration: admit → budgeted prefill → decode →
         evict.  Public so tests can drive the engine synchronously;
-        returns True when any work happened."""
+        returns True when any work happened.  Every iteration that did
+        work leaves one step-log record (ring + steps.jsonl)."""
+        t0 = time.time()
+        tokens0 = self.counters["decode_tokens"]
+        drafted0 = self.counters["spec_drafted"]
+        accepted0 = self.counters["spec_accepted"]
+        self._step_evicted = 0
+        self._iter_device_s = 0.0
         admitted = self._admit_from_queue()
+        t1 = time.time()
         chunks = self._run_prefill_budget()
-        decoding = any(
+        t2 = time.time()
+        self._iter_prefill_s = t2 - t1
+        occupancy = sum(
             r is not None and r._prefill_done for r in self._slots
         )
-        if decoding:
+        if occupancy:
             self._run_decode_step()
-        did = bool(admitted or chunks or decoding)
+        t3 = time.time()
+        did = bool(admitted or chunks or occupancy)
+        if did:
+            self._log_step(
+                t0, t1, t2, t3, len(admitted), chunks, occupancy,
+                self.counters["decode_tokens"] - tokens0,
+                self.counters["spec_drafted"] - drafted0,
+                self.counters["spec_accepted"] - accepted0,
+            )
         if did and self.decode_steps % self.log_every == 0:
             self._log_metrics_row()
         return did
+
+    def _log_step(self, t0: float, t1: float, t2: float, t3: float,
+                  admitted: int, chunks: int, occupancy: int,
+                  tokens: int, drafted: int, accepted: int) -> None:
+        """One structured record for the iteration that just ran: phase
+        mix, occupancy, per-phase token deltas, and the wall split —
+        admit/prefill/decode phases plus the device share (time blocked
+        dispatching compiled programs and fetching their results; the
+        remainder is host scheduling/bookkeeping)."""
+        phases = []
+        if admitted:
+            phases.append("admit")
+        if chunks:
+            phases.append("prefill")
+        if occupancy:
+            phases.append("decode")
+        self._step_id += 1
+        device_s = min(self._iter_device_s, t3 - t0)
+        rec = {
+            "t": t3,
+            "step": self._step_id,
+            "phase": "+".join(phases) or "idle",
+            "occupancy": occupancy,
+            "active_slots": sum(r is not None for r in self._slots),
+            "filling_slots": len(self._filling),
+            "queue_depth": len(self._queue),
+            "admitted": admitted,
+            "evicted": self._step_evicted,
+            "prefill_chunks": chunks,
+            "budget_stall": int(self._prefill_stalled),
+            "tokens_committed": tokens,
+            "spec_drafted": drafted,
+            "spec_accepted": accepted,
+            "admit_s": round(t1 - t0, 6),
+            "prefill_s": round(t2 - t1, 6),
+            "decode_s": round(t3 - t2, 6),
+            "step_s": round(t3 - t0, 6),
+            "device_s": round(device_s, 6),
+            "host_s": round(max((t3 - t0) - device_s, 0.0), 6),
+        }
+        with self._log_lock:
+            # ring appended under the log lock so a /stepz snapshot
+            # (HTTP thread) never races the engine thread's append;
+            # t is stamped above on the single writer, so the stream
+            # stays t-ordered (schema checker invariant)
+            self._step_ring.append(rec)
+            if self._step_log is None:
+                return
+            self._step_log.write(json.dumps(json_sanitize(rec)) + "\n")
+            self._step_log.flush()
+
+    def step_records(self, n: int | None = None) -> list[dict]:
+        """Snapshot of the newest ``n`` step-log records (all retained
+        records when ``n`` is None) — the ``GET /stepz`` live tail."""
+        with self._log_lock:
+            recs = list(self._step_ring)
+        return recs[-n:] if n else recs
+
+    @property
+    def steps_total(self) -> int:
+        """Step-log records emitted over the engine's lifetime (the ring
+        keeps only the newest ``step_ring_size``)."""
+        return self._step_id
 
     def _admit_from_queue(self) -> list[GenRequest]:
         """Strict-FIFO admission: pop the head only while a slot AND its
@@ -711,6 +833,7 @@ class Engine:
                 head.slot = slot
                 head.status = "active"
                 head.t_admit = time.time()
+                head._t_attr = head.t_admit  # attribution frontier opens
                 # chunked-prefill state: the grid stays anchored at 0, so
                 # prefill starts at the last chunk boundary <= the first
                 # uncached token (a straddling chunk re-writes the shared
@@ -770,6 +893,7 @@ class Engine:
         is filling, even with a budget below the chunk width.  Returns
         the chunk count."""
         if not self._filling:
+            self._prefill_stalled = False
             return 0
         budget = self.prefill_budget
         spent = 0
@@ -791,6 +915,12 @@ class Engine:
                 self._filling.append(req)
         self.prefill_iters += 1
         self.prefill_chunks += chunks
+        # budget stall: the token budget ran out with fillers still
+        # pending — those requests eat >= 1 more iteration of TTFT (the
+        # step-log field that explains a prefill-bound tail)
+        self._prefill_stalled = bool(self._filling)
+        if self._prefill_stalled:
+            self.prefill_budget_stalls += 1
         return chunks
 
     def _run_prefill_chunk(self, req: GenRequest):
@@ -802,6 +932,11 @@ class Engine:
         slot = req.slot
         c = self.prefill_chunk
         start = req._fill_next
+        t_chunk0 = time.time()
+        # everything since this request's attribution frontier was spent
+        # on OTHER requests' work (their chunks, decode steps, admit
+        # scans) — interference stall, not its own prefill compute
+        req.attr_stall_s += max(t_chunk0 - req._t_attr, 0.0)
         table_row = jnp.asarray(self.kv.block_tables[slot])
         if self._prefill_cache_state != (slot, start):
             if start:
@@ -826,6 +961,10 @@ class Engine:
             slot, max(min(start + c, len(req.prompt)),
                       int(self.kv.seq_lens[slot]))
         )
+        t_chunk1 = time.time()
+        req.attr_prefill_s += max(t_chunk1 - t_chunk0, 0.0)
+        req._t_attr = t_chunk1
+        self._iter_device_s += t_chunk1 - t_chunk0
         return last_logits
 
     def _finish_prefill(self, req: GenRequest, last_logits) -> None:
@@ -836,6 +975,7 @@ class Engine:
             self.kv.register_prefix(req.slot, req.prompt)
         req._prefill_done = True
         self._slot_meta_dirty = True
+        t_sample0 = time.time()
         if self.fused_sampling:
             # The prefill program hands logits to the host anyway (its
             # last chunk); sampling them with the device sampler's exact
@@ -850,6 +990,12 @@ class Engine:
             tok = self._sample(req, np.asarray(last_logits))
         req.t_first_token = time.time()
         req._t_last_token = req.t_first_token
+        # the first-token sample blocks on the last chunk's logits — it
+        # is the tail of this request's prefill compute, for both the
+        # attribution ledger and the step record's device share
+        req.attr_prefill_s += max(req.t_first_token - req._t_attr, 0.0)
+        req._t_attr = req.t_first_token
+        self._iter_device_s += req.t_first_token - t_sample0
         req.tokens.append(tok)
         self._last_tokens[req.slot] = tok
         self._m_ttft.observe(req.ttft_s)
@@ -869,6 +1015,7 @@ class Engine:
         if self.fused_sampling:
             self._decode_step_fused(decoding, n_active)
             return
+        t_dec0 = time.time()
         for i, _ in decoding:
             # CoW guard: never write a shared or indexed block in place.
             # Steady state this is a no-op (appends land past the shared
@@ -889,10 +1036,33 @@ class Engine:
         self._m_occ.observe(float(n_active))
         self.occupancy_max = max(self.occupancy_max, n_active)
         now = time.time()
+        self._iter_device_s += now - t_dec0
+        decode_dt = now - t_dec0
         for slot, req in decoding:
             self.kv.note_written(slot, int(self.kv.seq_lens[slot]) + 1)
             tok = self._sample(req, logits[slot])
+            self._charge_decode(req, now, decode_dt, spec=False)
             self._commit_tokens(slot, req, [tok], n_active, now)
+
+    def _charge_decode(self, req: GenRequest, now: float,
+                       decode_dt: float, spec: bool) -> None:
+        """Advance the request's attribution frontier to ``now``,
+        splitting the interval exclusively: this iteration's decode
+        dispatch wall to decode (or the speculative-verify component),
+        up to this iteration's prefill-phase wall to interference stall
+        (the engine ran other requests' chunks while this one had a
+        token pending), the remainder to scheduler gap (admit scans,
+        bookkeeping, idle waits between iterations)."""
+        interval = max(now - req._t_attr, 0.0)
+        d = min(interval, max(decode_dt, 0.0))
+        if spec:
+            req.attr_spec_s += d
+        else:
+            req.attr_decode_s += d
+        s = min(interval - d, max(self._iter_prefill_s, 0.0))
+        req.attr_stall_s += s
+        req.attr_gap_s += interval - d - s
+        req._t_attr = now
 
     def _commit_tokens(self, slot: int, req: GenRequest, kept: list[int],
                        n_active: int, now: float) -> None:
@@ -925,6 +1095,7 @@ class Engine:
         truncates the request's tokens AND retreats the K/V extent
         (``kv.rollback``), which by construction never crosses a
         shared (refcount > 1) prefix block."""
+        t_dec0 = time.time()
         drafts: dict[int, list[int]] = {}
         if self.speculate:
             for i, r in decoding:
@@ -989,6 +1160,8 @@ class Engine:
         self._m_occ.observe(float(n_active))
         self.occupancy_max = max(self.occupancy_max, n_active)
         now = time.time()
+        self._iter_device_s += now - t_dec0
+        decode_dt = now - t_dec0
         for slot, req in decoding:
             n = int(n_emit[slot])
             emitted = [int(t) for t in out[slot, :n]]
@@ -1020,6 +1193,10 @@ class Engine:
                 self._m_spec_drafted.inc(k_drafted)
                 if committed:
                     self._m_spec_accepted.inc(committed)
+            # a T=K+1 (verify) dispatch charges the speculation
+            # component for EVERY active slot — a mixed batch pays the
+            # window for everyone, and the attribution should say so
+            self._charge_decode(req, now, decode_dt, spec=t_width > 1)
             self._commit_tokens(slot, req, kept, n_active, now)
 
     def _sample(self, req: GenRequest, logits: np.ndarray) -> int:
@@ -1066,6 +1243,13 @@ class Engine:
         req.status = status
         req.finish_reason = reason if status == "ok" else None
         req.t_done = time.time()
+        if req._t_attr:
+            # close the attribution ledger: the post-commit residue
+            # (eviction bookkeeping) is scheduler gap, and the component
+            # sum now equals e2e up to clock rounding
+            req.attr_gap_s += max(req.t_done - req._t_attr, 0.0)
+            req._t_attr = req.t_done
+        self._step_evicted += 1
         self.counters[status] += 1
         self._m_requests.inc(status=status)
         if status == "ok":
@@ -1203,6 +1387,9 @@ class Engine:
             if self._met_log is not None:
                 self._met_log.close()
                 self._met_log = None
+            if self._step_log is not None:
+                self._step_log.close()
+                self._step_log = None
         if self.logdir:
             self._registry.write_prometheus(
                 os.path.join(self.logdir, "metrics.prom")
@@ -1254,6 +1441,9 @@ class Engine:
             "occupancy_max": self.occupancy_max,
             "prefill_iters": self.prefill_iters,
             "prefill_chunks": self.prefill_chunks,
+            "prefill_budget_stalls": self.prefill_budget_stalls,
+            "steps_total": self._step_id,
+            "step_ring_size": self.step_ring_size,
             "kv": self.kv.stats(),
             "counters": dict(self.counters),
             "prefill_chunk": self.prefill_chunk,
@@ -1296,6 +1486,20 @@ class Engine:
                 itl_max_s=round(req.itl_max_s, 6),
                 drafted=req.drafted,
                 accepted=req.accepted,
+                # per-request speculative split under the fleet-wide
+                # spelling (the global counters' names), next to the
+                # legacy drafted/accepted pair
+                spec_drafted=req.drafted,
+                spec_accepted=req.accepted,
+                # exclusive tail-latency attribution: queue + prefill +
+                # stall + decode + spec + gap == e2e up to rounding
+                # (tools/tail_report.py joins these against steps.jsonl)
+                attr_queue_s=round(max(req.t_admit - req.t_submit, 0.0), 6),
+                attr_prefill_s=round(req.attr_prefill_s, 6),
+                attr_stall_s=round(req.attr_stall_s, 6),
+                attr_decode_s=round(req.attr_decode_s, 6),
+                attr_spec_s=round(req.attr_spec_s, 6),
+                attr_gap_s=round(req.attr_gap_s, 6),
             )
         elif req.error:
             row["error"] = req.error

@@ -38,6 +38,10 @@ Endpoint contract (docs/API.md "Serving"):
   the scheduler's live control surface.  The same census rides ``/varz``
   as ``serve_kv_*`` / ``serve_prefix_*`` registry metrics, so the fleet
   scraper (``obs.fleet``) sees it without a serve-specific endpoint.
+- ``GET /stepz?n=`` — the engine step log's live tail: the newest ``n``
+  (default 32) scheduler-iteration records from the bounded ring (the
+  ``steps.jsonl`` schema), wrapped with ``ring_size`` / ``steps_total``
+  — "what is the engine doing RIGHT NOW, iteration by iteration".
 """
 
 from __future__ import annotations
@@ -93,6 +97,7 @@ class ServeServer:
             routes={
                 ("GET", "/generatez"): self._get_state,
                 ("POST", "/generatez"): self._post_generate,
+                ("GET", "/stepz"): self._stepz,
             },
         )
 
@@ -122,6 +127,31 @@ class ServeServer:
 
     def _get_state(self, query: str):
         return 200, self.engine.state()
+
+    def _stepz(self, query: str):
+        """``GET /stepz`` — live tail of the engine step log: the newest
+        ``n`` (default 32) per-iteration records from the bounded ring
+        (phase mix, occupancy, token/draft deltas, admissions/evictions,
+        prefill chunks + budget stalls, host-vs-device wall split) —
+        the same records ``steps.jsonl`` persists."""
+        from urllib.parse import parse_qs
+
+        params = parse_qs(query or "", keep_blank_values=True)
+        n = params.get("n", ["32"])[0]
+        try:
+            n = int(n)
+            if n < 1:
+                raise ValueError(n)
+        except ValueError:
+            return 400, {"error": f"bad 'n': {params.get('n')!r} "
+                                  "(a positive integer)"}
+        recs = self.engine.step_records(n)
+        return 200, {
+            "ring_size": self.engine.step_ring_size,
+            "steps_total": self.engine.steps_total,
+            "n": len(recs),
+            "steps": recs,
+        }
 
     def begin_drain(self) -> None:
         """Refuse NEW submits with 503 immediately (bounded SIGTERM

@@ -158,14 +158,31 @@ def main(argv=None) -> int:
                    help="reject requests asking for more new tokens")
     p.add_argument("--logdir", default=None,
                    help="writes requests.jsonl / metrics.jsonl / "
-                        "metrics.prom (and, with tracing, trace.jsonl) "
-                        "here")
+                        "steps.jsonl / history.jsonl / metrics.prom "
+                        "(and, with tracing, trace.jsonl) here")
     p.add_argument("--drain-timeout", type=float, default=30.0,
                    help="bounded SIGTERM drain: refuse new submits with "
                         "503 immediately, finish in-flight requests, and "
                         "force-exit (exception flight event, exit 1) if "
                         "any are still running after this many seconds")
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--step-ring", type=int, default=512,
+                   help="engine step-log ring size: every scheduler "
+                        "iteration leaves one structured record (phase "
+                        "mix, occupancy, token deltas, host-vs-device "
+                        "wall split) in a bounded ring served at GET "
+                        "/stepz and appended to <logdir>/steps.jsonl")
+    p.add_argument("--history-interval", type=float, default=2.0,
+                   help="embedded metrics history store (obs.tsdb): "
+                        "sample the registry (and SLO good/total "
+                        "snapshots) every this many seconds into fixed-"
+                        "memory downsampling rings, served at GET /histz "
+                        "and appended to <logdir>/history.jsonl (offline "
+                        "SLO burn recomputation); 0 = off")
+    p.add_argument("--history-points", type=int, default=360,
+                   help="history ring size per series: on overflow the "
+                        "ring decimates 2:1 and doubles its resolution, "
+                        "so memory stays fixed for any run length")
     p.add_argument("--slo-rules", default=None, metavar="JSON",
                    help="SLO rule file (obs.slo schema): evaluate burn "
                         "rates over the serve_* histograms on a "
@@ -226,7 +243,7 @@ def main(argv=None) -> int:
         spec_ngram=args.spec_ngram,
         max_context=args.max_context,
         max_new_cap=args.max_new_cap, logdir=args.logdir,
-        log_every=args.log_every,
+        log_every=args.log_every, step_ring=args.step_ring,
     ).start()
     server = ServeServer(engine, args.port, host=args.host).start()
 
@@ -243,6 +260,23 @@ def main(argv=None) -> int:
         ).install(server.status_server).start()
         logging.info("slo monitor: %d rule(s) from %s (GET /sloz)",
                      len(rules), args.slo_rules)
+
+    history = None
+    if args.history_interval > 0:
+        from distributedtensorflow_tpu.obs.tsdb import MetricsHistory
+
+        # the embedded history store samples the registry (and, with
+        # --slo-rules, each rule's good/total snapshot, so burn rates are
+        # recomputable offline from history.jsonl) next to the SLO
+        # monitor; GET /histz answers windowed queries from the rings
+        history = MetricsHistory(
+            interval_s=args.history_interval,
+            points_per_series=args.history_points,
+            logdir=args.logdir,
+            rules=slo_monitor.rules if slo_monitor is not None else None,
+        ).install(server.status_server).start()
+        logging.info("metrics history: sampling every %.1fs (GET /histz)",
+                     args.history_interval)
 
     stop = threading.Event()
 
@@ -302,6 +336,10 @@ def main(argv=None) -> int:
             flight.dump(reason="drain_timeout")
     server.stop()
     engine.stop(drain=not forced)
+    if history is not None:
+        # stopped after the engine drain: the final tick snapshots the
+        # completed run's counters into history.jsonl
+        history.stop()
     if tracer is not None:
         tracer.uninstall()
         tracer.close()

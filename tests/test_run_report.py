@@ -532,3 +532,169 @@ def test_report_unreadable_fleet_json_exits_nonzero(logdir, capsys):
     (logdir / "fleet.json").write_text("{truncated")
     assert run_report.main([str(logdir)]) == 1
     assert "fleet.json: unreadable" in capsys.readouterr().err
+
+
+# --- serving tail attribution + step log (ISSUE 16) --------------------------
+
+
+def _ok_request_row(t, e2e, *, queue=0.0, prefill=0.0, stall=0.0,
+                    decode=0.0, spec=0.0, gap=0.0, rid="r"):
+    """One schema-valid ok row whose attribution components tile e2e by
+    construction (callers pass components summing to e2e)."""
+    return {
+        "t": t, "id": rid, "status": "ok", "prompt_tokens": 8,
+        "new_tokens": 4, "finish_reason": "length",
+        "ttft_s": queue + prefill + stall, "tpot_s": decode / 3,
+        "e2e_s": e2e, "queue_s": queue, "occ_mean": 1.0, "occ_max": 2,
+        "slot": 0, "drafted": 0, "accepted": 0,
+        "spec_drafted": 0, "spec_accepted": 0,
+        "attr_queue_s": queue, "attr_prefill_s": prefill,
+        "attr_stall_s": stall, "attr_decode_s": decode,
+        "attr_spec_s": spec, "attr_gap_s": gap,
+    }
+
+
+def _step_row(t, step, **kw):
+    row = {
+        "t": t, "step": step, "phase": "decode", "occupancy": 1,
+        "active_slots": 1, "filling_slots": 0, "queue_depth": 0,
+        "admitted": 0, "evicted": 0, "prefill_chunks": 0,
+        "budget_stall": 0, "tokens_committed": 2, "spec_drafted": 0,
+        "spec_accepted": 0, "admit_s": 0.0, "prefill_s": 0.0,
+        "decode_s": 0.004, "step_s": 0.005, "device_s": 0.003,
+        "host_s": 0.002,
+    }
+    row.update(kw)
+    return row
+
+
+def _serving_logdir(logdir):
+    """requests.jsonl where the p99 tail is dominated by prefill-
+    interference stall, plus a matching steps.jsonl."""
+    reqs = [
+        _ok_request_row(100.0 + i, 0.05, queue=0.01, prefill=0.01,
+                        decode=0.03, rid=f"fast{i}")
+        for i in range(9)
+    ]
+    reqs.append(_ok_request_row(110.0, 0.55, queue=0.01, prefill=0.01,
+                                stall=0.50, decode=0.03, rid="slow"))
+    _write_jsonl(logdir / "requests.jsonl", reqs)
+    _write_jsonl(logdir / "steps.jsonl", [
+        _step_row(100.0, 1, phase="admit+prefill", admitted=1,
+                  prefill_chunks=2, tokens_committed=0),
+        _step_row(100.1, 2, budget_stall=1),
+        _step_row(100.2, 3, tokens_committed=5),
+    ])
+
+
+def test_report_serving_tail_attribution(logdir, capsys):
+    _serving_logdir(logdir)
+    report = run_report.build_report(str(logdir))
+    srv = report["serving"]
+    ta = srv["tail_attribution"]
+    assert ta["requests"] == 10
+    assert ta["dominant"] == "stall"
+    assert ta["dominant_growth_s"] == pytest.approx(0.5)
+    assert ta["covered_share"] == 1.0  # components tile e2e exactly
+    assert srv["step_log"] == {
+        "records": 3, "budget_stalls": 1, "tokens_committed": 7,
+    }
+    assert run_report.main([str(logdir)]) == 0
+    text = capsys.readouterr().out
+    assert "tail attribution (10 request(s)" in text
+    assert "<< dominant" in text
+    assert "step log: 3 iteration record(s)" in text
+
+
+def test_report_corrupt_steps_exits_nonzero(logdir, capsys):
+    _serving_logdir(logdir)
+    with open(logdir / "steps.jsonl", "a") as f:
+        f.write("{not json\n")
+    assert run_report.main([str(logdir)]) == 1
+    assert "unparseable telemetry entries" in capsys.readouterr().err
+
+
+def test_steps_schema_accepts_valid_rows(tmp_path):
+    p = tmp_path / "steps.jsonl"
+    _write_jsonl(p, [
+        _step_row(100.0, 1, phase="admit+prefill+decode", admitted=1,
+                  prefill_chunks=1),
+        _step_row(100.1, 2),
+        _step_row(100.2, 5, phase="idle", occupancy=0, active_slots=0,
+                  tokens_committed=0),  # gaps in step ids are fine
+    ])
+    errors, warnings = check_metrics_schema.check_file(str(p))
+    assert errors == [] and warnings == []
+    assert check_metrics_schema.main([str(p)]) == 0
+
+
+def test_steps_schema_rejects_bad_rows(tmp_path):
+    p = tmp_path / "steps.jsonl"
+    _write_jsonl(p, [
+        _step_row(100.0, 2),
+        _step_row(99.0, 2, phase="warmup"),  # t rewinds, id repeats, phase
+        _step_row(100.2, 3, budget_stall=2),  # not a 0/1 flag
+        _step_row(100.3, 4, spec_drafted=1, spec_accepted=2),
+        _step_row(100.4, 5, admit_s=0.004, prefill_s=0.004,
+                  decode_s=0.004, step_s=0.005),  # phases exceed the step
+        _step_row(100.5, 6, device_s=0.009, step_s=0.005),
+    ])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    joined = "\n".join(errors)
+    assert "'t' 99.0 decreases" in joined
+    assert "does not increase" in joined
+    assert "phase" in joined
+    assert "budget_stall" in joined
+    assert "spec_accepted" in joined
+    assert "step_s" in joined and "device_s" in joined
+    assert check_metrics_schema.main([str(p)]) == 1
+
+
+def test_requests_schema_validates_attribution_fields(tmp_path):
+    p = tmp_path / "requests.jsonl"
+    good = _ok_request_row(100.0, 0.05, queue=0.01, decode=0.04)
+    neg = dict(_ok_request_row(100.1, 0.05, decode=0.05),
+               attr_queue_s=-0.01)
+    # components summing way past e2e: not exclusive
+    overlap = dict(_ok_request_row(100.2, 0.05, decode=0.05),
+                   attr_decode_s=0.05, attr_prefill_s=0.05)
+    bad_mirror = dict(_ok_request_row(100.3, 0.05, decode=0.05),
+                      spec_drafted=1, spec_accepted=3)
+    _write_jsonl(p, [good, neg, overlap, bad_mirror])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    joined = "\n".join(errors)
+    assert not any("line 1" in e for e in errors)
+    assert "'attr_queue_s' -0.01" in joined
+    assert "not exclusive" in joined
+    assert "'spec_accepted' 3 exceeds 'spec_drafted' 1" in joined
+
+
+def test_history_schema_accepts_valid_rows(tmp_path):
+    p = tmp_path / "history.jsonl"
+    _write_jsonl(p, [
+        {"t": 100.0, "values": {"queue_depth": 3.0, "slo_good.e2e": 0.9}},
+        {"t": 102.0, "values": {}},
+        {"t": 104.0, "values": {"fleet.loss.median": 1.5}},
+    ])
+    errors, warnings = check_metrics_schema.check_file(str(p))
+    assert errors == [] and warnings == []
+    assert check_metrics_schema.main([str(p)]) == 0
+
+
+def test_history_schema_rejects_bad_rows(tmp_path):
+    p = tmp_path / "history.jsonl"
+    over = {f"m{i}": 1.0 for i in range(
+        check_metrics_schema.HISTORY_MAX_SERIES + 1)}
+    _write_jsonl(p, [
+        {"t": 100.0, "values": {"ok": 1.0}},
+        {"t": 99.0},  # t rewinds, no values
+        {"t": 101.0, "values": {"bad name!": 1.0}},
+        {"t": 102.0, "values": {"x": "NaN"}},  # writer filters non-finite
+        {"t": 103.0, "values": over},
+    ])
+    errors, _ = check_metrics_schema.check_file(str(p))
+    joined = "\n".join(errors)
+    assert "'t' 99.0 decreases" in joined
+    assert "values" in joined
+    assert "bad name!" in joined
+    assert check_metrics_schema.main([str(p)]) == 1

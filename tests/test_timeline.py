@@ -230,3 +230,66 @@ def test_single_logdir_renders_cross_process_spans_absolutely(tmp_path):
     # the span t0s anchor the absolute origin
     assert doc["otherData"]["origin_unix_s"] == T0 + 3.0
     assert min(e["ts"] for e in xs) == 0.0
+
+
+# --- engine step lane (ISSUE 16) ---------------------------------------------
+
+
+def _step_rows():
+    return [
+        {"t": T0 + 0.01, "step": 1, "phase": "admit+prefill",
+         "occupancy": 0, "queue_depth": 2, "admitted": 1,
+         "prefill_chunks": 2, "budget_stall": 0, "tokens_committed": 0,
+         "step_s": 0.01},
+        {"t": T0 + 0.02, "step": 2, "phase": "decode", "occupancy": 2,
+         "queue_depth": 1, "admitted": 0, "prefill_chunks": 0,
+         "budget_stall": 1, "tokens_committed": 2, "step_s": 0.005},
+    ]
+
+
+def test_timeline_engine_steps_lane(tmp_path):
+    # a steps-only logdir is a valid stream set on its own
+    _write_jsonl(tmp_path / "steps.jsonl", _step_rows())
+    doc = timeline.build_timeline(str(tmp_path))
+    xs = [e for e in doc["traceEvents"]
+          if e.get("ph") == "X" and e.get("pid") == timeline.PID_STEPS]
+    assert [e["name"] for e in xs] == ["admit+prefill", "decode"]
+    # the slice starts at t - step_s and spans the iteration
+    assert xs[0]["ts"] == pytest.approx(0.0, abs=1.0)
+    assert xs[0]["dur"] == pytest.approx(0.01 * 1e6)
+    assert xs[1]["args"]["budget_stall"] == 1
+    counters = [e for e in doc["traceEvents"]
+                if e.get("ph") == "C" and e.get("pid") == timeline.PID_STEPS]
+    assert {e["name"] for e in counters} == {"occupancy", "queue_depth"}
+    assert doc["otherData"]["streams"]["engine_steps"] == 2
+
+
+def test_timeline_steps_compose_with_other_streams(tmp_path, capsys):
+    _write_jsonl(tmp_path / "flight.jsonl", [
+        {"t": T0, "kind": "fit_begin", "step": 0},
+    ])
+    _write_jsonl(tmp_path / "steps.jsonl", _step_rows())
+    assert timeline.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "2 engine steps" in out
+    doc = json.loads((tmp_path / "timeline.json").read_text())
+    assert doc["otherData"]["streams"]["engine_steps"] == 2
+    # steps place absolutely against the flight origin
+    xs = [e for e in doc["traceEvents"]
+          if e.get("ph") == "X" and e.get("pid") == timeline.PID_STEPS]
+    assert xs[0]["ts"] >= 0.0
+
+
+def test_fleet_mode_carries_step_lane(tmp_path):
+    a, b = tmp_path / "serve0", tmp_path / "trainer"
+    a.mkdir(), b.mkdir()
+    _write_jsonl(a / "steps.jsonl", _step_rows())
+    _write_jsonl(b / "flight.jsonl", [
+        {"t": T0, "kind": "fit_begin", "step": 0},
+    ])
+    doc = timeline.build_fleet_timeline([str(a), str(b)])
+    xs = [e for e in doc["traceEvents"]
+          if e.get("ph") == "X"
+          and e.get("pid", 0) % timeline._FLEET_PID_STRIDE
+          == timeline.PID_STEPS]
+    assert {e["name"] for e in xs} == {"admit+prefill", "decode"}

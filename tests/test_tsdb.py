@@ -1,0 +1,232 @@
+"""Embedded metrics history store tests (``obs.tsdb``, ISSUE 16).
+
+The load-bearing checks: (1) the per-series ring is FIXED memory — on
+overflow it decimates 2:1 and doubles its resolution instead of growing,
+and the series count is hard-capped; (2) ``history.jsonl`` rows are
+schema-green and carry exactly what :func:`obs.slo.recompute_from_history`
+needs — the offline burn recomputation MATCHES the live monitor's, since
+both replay the same samples through the same windowed-good math; (3)
+``GET /histz`` answers windowed queries with the right status codes.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from distributedtensorflow_tpu.obs import Registry
+from distributedtensorflow_tpu.obs import slo as slo_mod
+from distributedtensorflow_tpu.obs.tsdb import MetricsHistory, _Series
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import check_metrics_schema as checker  # noqa: E402
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------- the ring
+
+
+def test_series_ring_fixed_memory_downsampling():
+    s = _Series(maxpoints=8, res_s=1.0)
+    for i in range(64):
+        s.add(float(i * 2), float(i))  # 2s spacing: every point lands
+    # never grew past the cap; resolution doubled along the way
+    assert len(s.points) <= 8
+    assert s.res_s > 1.0
+    # full-span history retained at coarse resolution: the very first
+    # point survives every decimation, and the newest value always lands
+    assert s.points[0] == (0.0, 0.0)
+    assert s.points[-1][1] == 63.0
+
+
+def test_series_merges_points_within_resolution():
+    s = _Series(maxpoints=8, res_s=10.0)
+    s.add(0.0, 1.0)
+    s.add(3.0, 2.0)  # closer than res_s: merges, latest value wins
+    s.add(9.0, 3.0)
+    assert len(s.points) == 1
+    assert s.points[0] == (0.0, 3.0)
+    s.add(15.0, 4.0)  # past the resolution: a new bucket
+    assert len(s.points) == 2
+
+
+# ---------------------------------------------------------------- sampling
+
+
+def test_tick_collects_registry_scalars():
+    reg = Registry()
+    reg.gauge("queue_depth").set(7.0)
+    reg.counter("requests_total").inc(3)
+    clock = _Clock()
+    hist = MetricsHistory(registry=reg, time_fn=clock)
+    kept = hist.tick()
+    assert kept["queue_depth"] == 7.0
+    assert kept["requests_total"] == 3.0
+    assert hist.ticks == 1
+    assert "queue_depth" in hist.series_names()
+    # non-finite values never enter a ring
+    reg.gauge("bad").set(float("nan"))
+    kept = hist.tick()
+    assert "bad" not in kept
+    assert "bad" not in hist.series_names()
+
+
+def test_fleet_series_names_flatten_labels():
+    """Fleet-merged keys arrive with Prometheus label braces; the history
+    store must flatten them to the registry's dotted form, or the
+    history.jsonl name schema rejects every labeled fleet series."""
+
+    class _Fleet:
+        def view(self):
+            return {"metrics": {
+                'breaker_state{endpoint="fleet_peer:chief"}':
+                    {"median": 0.0, "max": 1.0},
+                'data_wait_seconds_bucket{le="+Inf"}':
+                    {"median": 2.0, "max": 2.0},
+                "step": {"median": 5.0, "max": 7.0},
+            }}
+
+    hist = MetricsHistory(registry=Registry(), fleet=_Fleet(),
+                          time_fn=_Clock())
+    kept = hist.tick()
+    assert kept["fleet.breaker_state.endpoint_fleet_peer:chief.median"] == 0.0
+    assert kept["fleet.data_wait_seconds_bucket.le__Inf.max"] == 2.0
+    assert kept["fleet.step.median"] == 5.0
+    for name in kept:
+        assert checker._HISTORY_NAME_RE.match(name), name
+
+
+def test_series_cap_drops_new_names_not_memory():
+    reg = Registry()
+    for i in range(4):
+        reg.gauge(f"g{i}").set(float(i))
+    hist = MetricsHistory(registry=reg, max_series=2, time_fn=_Clock())
+    kept = hist.tick()
+    assert len(kept) == 2
+    st = hist.state()
+    assert st["series"] == 2
+    assert st["series_dropped"] == 2
+
+
+def test_query_windows_and_latest():
+    reg = Registry()
+    g = reg.gauge("load")
+    clock = _Clock(1000.0)
+    hist = MetricsHistory(registry=reg, interval_s=1.0, time_fn=clock)
+    for i in range(10):
+        g.set(float(i))
+        hist.tick(now=1000.0 + i * 10)
+    out = hist.query("load", window_s=35.0, now=1090.0)
+    assert out["n"] == 4  # t in [1055, 1090]: 1060/1070/1080/1090
+    assert out["latest"] == 9.0
+    assert all(t >= 1055.0 for t, _ in out["points"])
+    assert hist.query("nope", window_s=60.0) is None
+
+
+# ------------------------------------------------------- history.jsonl
+
+
+def test_history_jsonl_rows_and_schema(tmp_path):
+    reg = Registry()
+    g = reg.gauge("occupancy")
+    clock = _Clock()
+    hist = MetricsHistory(registry=reg, logdir=str(tmp_path),
+                          time_fn=clock)
+    for i in range(5):
+        g.set(float(i))
+        hist.tick(now=100.0 + i)
+    clock.t = 110.0  # stop()'s final snapshot must not rewind t
+    hist.stop()
+    path = os.path.join(tmp_path, "history.jsonl")
+    rows = [json.loads(line) for line in open(path) if line.strip()]
+    assert len(rows) >= 5
+    for row in rows:
+        assert set(row) == {"t", "values"}
+        assert isinstance(row["values"], dict)
+    assert rows[-2]["values"]["occupancy"] == 4.0
+    errors, _warnings = checker.check_file(path)
+    assert errors == [], errors
+    assert checker.main([path]) == 0
+
+
+# ------------------------------------------------------------- /histz
+
+
+def test_histz_handler_status_codes():
+    reg = Registry()
+    reg.gauge("depth").set(2.0)
+    clock = _Clock(500.0)
+    hist = MetricsHistory(registry=reg, time_fn=clock)
+    hist.tick()
+    status, body = hist.histz("")
+    assert status == 200 and body["names"] == ["depth"]
+    assert body["series"] == 1
+    status, body = hist.histz("window=abc&metric=depth")
+    assert status == 400 and "window" in body["error"]
+    status, body = hist.histz("metric=depth&window=-5")
+    assert status == 400
+    status, body = hist.histz("metric=missing")
+    assert status == 404 and body["names"] == ["depth"]
+    status, body = hist.histz("metric=depth&window=60")
+    assert status == 200
+    assert body["latest"] == 2.0 and body["n"] == 1
+
+
+def test_histz_route_installs_on_status_server():
+    from distributedtensorflow_tpu.obs import StatusServer
+
+    reg = Registry()
+    reg.gauge("depth").set(1.0)
+    srv = StatusServer(0, registry=reg)
+    hist = MetricsHistory(registry=reg, time_fn=_Clock()).install(srv)
+    hist.tick()
+    assert ("GET", "/histz") in srv.routes
+    status, body = srv.routes[("GET", "/histz")]("metric=depth&window=60")
+    assert status == 200 and body["latest"] == 1.0
+
+
+# ----------------------------------------- offline SLO burn recomputation
+
+
+def test_offline_burn_recompute_matches_live_monitor(tmp_path):
+    """The acceptance bar: replaying history.jsonl through
+    recompute_from_history reproduces the live monitor's burn rates —
+    same samples, same windowed-good math, so the match is exact."""
+    reg = Registry()
+    g = reg.gauge("goodput_fraction")
+    rules = [{
+        "name": "goodput", "kind": "gauge_good_fraction",
+        "metric": "goodput_fraction", "objective": 0.7,
+        "fast_window_s": 30, "slow_window_s": 120,
+        "fast_burn": 2.0, "slow_burn": 1.5,
+    }]
+    mon = slo_mod.SLOMonitor(rules, registry=reg, interval_s=1.0)
+    hist = MetricsHistory(registry=reg, rules=mon.rules,
+                          logdir=str(tmp_path), time_fn=_Clock())
+    live = None
+    for i, frac in enumerate((0.95, 0.9, 0.4, 0.2, 0.3)):
+        g.set(frac)
+        now = 1000.0 + i * 10
+        live = {r["name"]: r for r in mon.evaluate(now=now)}
+        hist.tick(now=now)
+
+    rows = [json.loads(line)
+            for line in open(os.path.join(tmp_path, "history.jsonl"))]
+    assert all("slo_good.goodput" in r["values"] for r in rows)
+    off = {r["name"]: r for r in slo_mod.recompute_from_history(
+        mon.rules, rows, now=1040.0)}
+    for window in ("fast", "slow"):
+        assert off["goodput"][f"burn_{window}"] == pytest.approx(
+            live["goodput"][f"burn_{window}"])
+        assert off["goodput"][f"good_{window}"] == pytest.approx(
+            live["goodput"][f"good_{window}"])
+    # burning by the end: the tail samples are deep under the objective
+    assert off["goodput"]["burn_fast"] > 1.0

@@ -16,7 +16,16 @@ against the reactive-profiler manifest schema; basenames starting with
 ``requests`` against the serving per-request log schema (ok rows also
 carry the ISSUE-14 prefix-cache split when present:
 ``cached_prefix_tokens >= 0``, ``prefill_tokens >= 0``, the two summing
-exactly to ``prompt_tokens``, plus a non-negative ``itl_max_s``);
+exactly to ``prompt_tokens``, plus a non-negative ``itl_max_s``, the
+ISSUE-16 ``spec_drafted``/``spec_accepted`` mirror pair, and the
+exclusive ``attr_*`` tail-latency components whose sum must stay within
+5% of ``e2e_s``); basenames starting with ``steps`` against the engine
+step-log schema (serve/engine.py: strictly-increasing ``step`` ids,
+non-decreasing ``t``, known phase tokens, non-negative counts, phase
+wall split tiling ``step_s``); basenames starting with ``history``
+against the metrics-history tick schema (obs/tsdb.py: non-decreasing
+``t``, well-formed metric names mapping to finite numbers, cardinality
+bounded by :data:`HISTORY_MAX_SERIES`);
 basenames
 starting with ``flash_blocks`` against the flash-attention autotune cache
 schema (ops/flash_tuning.py: version 1, entries with platform/dtype/
@@ -167,6 +176,12 @@ DEFAULT_FAULTS_GLOB = os.path.join(
 DEFAULT_REQUESTS_GLOB = os.path.join(
     REPO, "ARTIFACTS", "serve_*", "requests*.jsonl"
 )
+DEFAULT_STEPS_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "serve_*", "steps*.jsonl"
+)
+DEFAULT_HISTORY_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "history*.jsonl"
+)
 DEFAULT_PROM_GLOB = os.path.join(
     REPO, "ARTIFACTS", "convergence_*", "metrics.prom"
 )
@@ -244,6 +259,39 @@ def _check_endpoint_value(value: str) -> str | None:
 #: for the same stdlib-only reason).
 REQUEST_STATES = ("ok", "rejected", "error")
 FINISH_REASONS = ("eos", "length")
+
+#: Exclusive tail-latency attribution fields stamped on ok requests.jsonl
+#: rows (serve/engine.py, ISSUE 16).  Together with ``attr_queue_s`` they
+#: tile ``e2e_s``: each non-negative finite, the sum within 5% of e2e.
+REQUEST_ATTR_FIELDS = (
+    "attr_queue_s", "attr_prefill_s", "attr_stall_s", "attr_decode_s",
+    "attr_spec_s", "attr_gap_s",
+)
+
+#: Engine step-log schema (serve/engine.py ``_log_step``, ISSUE 16):
+#: phase tokens of the per-iteration ``phase`` field, the non-negative
+#: integer count fields, and the non-negative finite wall-split fields
+#: (``admit_s + prefill_s + decode_s == step_s`` up to rounding;
+#: ``device_s <= step_s``).
+STEP_PHASE_TOKENS = ("admit", "prefill", "decode")
+STEP_COUNT_FIELDS = (
+    "occupancy", "active_slots", "filling_slots", "queue_depth",
+    "admitted", "evicted", "prefill_chunks", "budget_stall",
+    "tokens_committed", "spec_drafted", "spec_accepted",
+)
+STEP_WALL_FIELDS = (
+    "admit_s", "prefill_s", "decode_s", "step_s", "device_s", "host_s",
+)
+
+#: Series cap of the embedded metrics history store (obs/tsdb.py
+#: ``MetricsHistory`` default ``max_series`` — duplicated, stdlib-only).
+#: A ``history.jsonl`` row carrying more names than this means the
+#: writer's cardinality bound is broken.
+HISTORY_MAX_SERIES = 512
+#: A history metric name: the registry's flattened spelling (dots join
+#: label suffixes; ``fleet.<key>.<stat>`` / ``slo_good.<rule>`` ride the
+#: same namespace).  No whitespace, no control characters.
+_HISTORY_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.:/\-]*$")
 
 #: Serving prefix-cache metric families (serve/engine.py, ISSUE 14).
 #: The monotonic counters must be non-negative; the ratio gauges live in
@@ -1003,6 +1051,216 @@ def check_requests_file(path: str) -> tuple[list[str], list[str]]:
                     f"line {i}: 'accepted' {spec['accepted']} exceeds "
                     f"'drafted' {spec['drafted']}"
                 )
+            # spec_* mirror fields (ISSUE 16): the fleet-wide spelling of
+            # the same per-request draft accounting.
+            mirror = {}
+            for name in ("spec_drafted", "spec_accepted"):
+                v = row.get(name)
+                if v is None:
+                    continue
+                if not _nonneg_int(v):
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative integer")
+                else:
+                    mirror[name] = int(v)
+            if len(mirror) == 2 \
+                    and mirror["spec_accepted"] > mirror["spec_drafted"]:
+                errors.append(
+                    f"line {i}: 'spec_accepted' {mirror['spec_accepted']} "
+                    f"exceeds 'spec_drafted' {mirror['spec_drafted']}"
+                )
+            # exclusive tail-latency attribution (ISSUE 16; validated
+            # when present so pre-ISSUE-16 logs stay green): each
+            # component non-negative finite, and the sum must not exceed
+            # e2e by more than the documented 5% (+ rounding epsilon) —
+            # the components are exclusive, never overlapping.
+            attr = {}
+            for name in REQUEST_ATTR_FIELDS:
+                v = row.get(name)
+                if v is None:
+                    continue
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(v) or v < 0:
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative finite number")
+                else:
+                    attr[name] = float(v)
+            if len(attr) == len(REQUEST_ATTR_FIELDS) and "e2e_s" in lat:
+                total = sum(attr.values())
+                if total > lat["e2e_s"] * 1.05 + 1e-4:
+                    errors.append(
+                        f"line {i}: attribution sum {total:.6f} exceeds "
+                        f"e2e_s {lat['e2e_s']:.6f} by more than 5% — the "
+                        "components are not exclusive"
+                    )
+    return errors, warnings
+
+
+def check_steps_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate one engine step log ``steps.jsonl`` (serve/engine.py
+    ``_log_step``; docs/API.md "Serving observability"): every row one
+    JSON object with finite non-decreasing ``t``, a positive integer
+    ``step`` strictly increasing across the file, a ``phase`` of
+    ``"idle"`` or "+"-joined tokens from :data:`STEP_PHASE_TOKENS`,
+    non-negative integer count fields (:data:`STEP_COUNT_FIELDS`, with
+    ``budget_stall`` in {0, 1} and ``spec_accepted <= spec_drafted``),
+    and non-negative finite wall fields whose phase split tiles the
+    iteration: ``admit_s + prefill_s + decode_s <= step_s`` and
+    ``device_s <= step_s`` (up to rounding)."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    prev_t: float | None = None
+    prev_step: int | None = None
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: invalid JSON ({e})")
+                continue
+            if not isinstance(row, dict):
+                errors.append(f"line {i}: row is {type(row).__name__}, "
+                              "not an object")
+                continue
+            t = row.get("t")
+            if isinstance(t, bool) or not isinstance(t, (int, float)) \
+                    or not math.isfinite(t):
+                errors.append(f"line {i}: 't' {t!r} is not a finite number")
+            else:
+                if prev_t is not None and t < prev_t:
+                    errors.append(f"line {i}: 't' {t} decreases")
+                prev_t = float(t)
+            step = row.get("step")
+            if not _nonneg_int(step) or int(step) < 1:
+                errors.append(f"line {i}: 'step' {step!r} is not a "
+                              "positive integer")
+            else:
+                step = int(step)
+                if prev_step is not None and step <= prev_step:
+                    errors.append(f"line {i}: 'step' {step} does not "
+                                  f"increase (previous {prev_step})")
+                prev_step = step if prev_step is None \
+                    else max(prev_step, step)
+            phase = row.get("phase")
+            if not isinstance(phase, str) or not phase:
+                errors.append(f"line {i}: 'phase' {phase!r} is not a "
+                              "non-empty string")
+            elif phase != "idle":
+                for tok in phase.split("+"):
+                    if tok not in STEP_PHASE_TOKENS:
+                        errors.append(
+                            f"line {i}: phase token {tok!r} not in "
+                            f"{STEP_PHASE_TOKENS}"
+                        )
+            counts = {}
+            for name in STEP_COUNT_FIELDS:
+                v = row.get(name)
+                if not _nonneg_int(v):
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative integer")
+                else:
+                    counts[name] = int(v)
+            if counts.get("budget_stall", 0) > 1:
+                errors.append(f"line {i}: 'budget_stall' "
+                              f"{counts['budget_stall']} is not 0/1")
+            if "spec_drafted" in counts and "spec_accepted" in counts \
+                    and counts["spec_accepted"] > counts["spec_drafted"]:
+                errors.append(
+                    f"line {i}: 'spec_accepted' {counts['spec_accepted']} "
+                    f"exceeds 'spec_drafted' {counts['spec_drafted']}"
+                )
+            walls = {}
+            for name in STEP_WALL_FIELDS:
+                v = row.get(name)
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(v) or v < 0:
+                    errors.append(f"line {i}: {name!r} {v!r} is not a "
+                                  "non-negative finite number")
+                else:
+                    walls[name] = float(v)
+            if all(k in walls for k in ("admit_s", "prefill_s", "decode_s",
+                                        "step_s")):
+                parts = (walls["admit_s"] + walls["prefill_s"]
+                         + walls["decode_s"])
+                if parts > walls["step_s"] + 1e-5:
+                    errors.append(
+                        f"line {i}: admit_s+prefill_s+decode_s "
+                        f"{parts:.6f} exceeds step_s "
+                        f"{walls['step_s']:.6f}"
+                    )
+            if "device_s" in walls and "step_s" in walls \
+                    and walls["device_s"] > walls["step_s"] + 1e-5:
+                errors.append(
+                    f"line {i}: device_s {walls['device_s']:.6f} exceeds "
+                    f"step_s {walls['step_s']:.6f}"
+                )
+    return errors, warnings
+
+
+def check_history_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate one metrics-history tick log ``history.jsonl``
+    (obs/tsdb.py ``MetricsHistory``; docs/API.md "Serving
+    observability"): every row one JSON object with finite
+    non-decreasing ``t`` and a ``values`` object mapping well-formed
+    metric names (:data:`_HISTORY_NAME_RE`) to finite numbers — the
+    writer filters non-finite samples, so a sentinel string here is a
+    corruption — with per-row and whole-file name cardinality bounded by
+    :data:`HISTORY_MAX_SERIES` (the store's fixed-memory contract)."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    prev_t: float | None = None
+    all_names: set[str] = set()
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: invalid JSON ({e})")
+                continue
+            if not isinstance(row, dict):
+                errors.append(f"line {i}: row is {type(row).__name__}, "
+                              "not an object")
+                continue
+            t = row.get("t")
+            if isinstance(t, bool) or not isinstance(t, (int, float)) \
+                    or not math.isfinite(t):
+                errors.append(f"line {i}: 't' {t!r} is not a finite number")
+            else:
+                if prev_t is not None and t < prev_t:
+                    errors.append(f"line {i}: 't' {t} decreases")
+                prev_t = float(t)
+            values = row.get("values")
+            if not isinstance(values, dict):
+                errors.append(f"line {i}: 'values' is "
+                              f"{type(values).__name__}, not an object")
+                continue
+            if len(values) > HISTORY_MAX_SERIES:
+                errors.append(
+                    f"line {i}: {len(values)} series in one tick exceeds "
+                    f"the {HISTORY_MAX_SERIES}-series cardinality bound"
+                )
+            for name, v in values.items():
+                if not isinstance(name, str) \
+                        or not _HISTORY_NAME_RE.match(name):
+                    errors.append(f"line {i}: metric name {name!r} is "
+                                  "malformed")
+                    continue
+                all_names.add(name)
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(v):
+                    errors.append(f"line {i}: values[{name!r}] {v!r} is "
+                                  "not a finite number")
+    if len(all_names) > HISTORY_MAX_SERIES:
+        errors.append(
+            f"{len(all_names)} distinct series across the file exceeds "
+            f"the {HISTORY_MAX_SERIES}-series cardinality bound"
+        )
     return errors, warnings
 
 
@@ -1553,6 +1811,10 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         return check_prom_file(path)
     if os.path.basename(path).startswith("requests"):
         return check_requests_file(path)
+    if os.path.basename(path).startswith("steps"):
+        return check_steps_file(path)
+    if os.path.basename(path).startswith("history"):
+        return check_history_file(path)
     flight = os.path.basename(path).startswith("flight")
     captures = os.path.basename(path).startswith("captures")
     manifest_dir = os.path.dirname(os.path.abspath(path))
@@ -1587,6 +1849,7 @@ def main(argv: list[str] | None = None) -> int:
         glob.glob(DEFAULT_GLOB) + glob.glob(DEFAULT_FLIGHT_GLOB)
         + glob.glob(DEFAULT_GOODPUT_GLOB) + glob.glob(DEFAULT_CAPTURES_GLOB)
         + glob.glob(DEFAULT_FAULTS_GLOB) + glob.glob(DEFAULT_REQUESTS_GLOB)
+        + glob.glob(DEFAULT_STEPS_GLOB) + glob.glob(DEFAULT_HISTORY_GLOB)
         + glob.glob(DEFAULT_PROM_GLOB) + glob.glob(DEFAULT_FLASH_GLOB)
         + glob.glob(DEFAULT_SLO_GLOB) + glob.glob(DEFAULT_FLEET_GLOB)
         + glob.glob(DEFAULT_TIMELINE_GLOB)

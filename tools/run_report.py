@@ -37,8 +37,13 @@ writes — and prints:
   logdirs) — terminal-state counts, TTFT/TPOT/e2e p50+p99, batch
   occupancy, rejects, delivered tokens/sec, plus the ISSUE-14
   prefix-cache story (hit rate, cached-token share, prefill-vs-decode
-  token split) and the per-iteration prefill-budget utilization from
-  the engine's metrics rows;
+  token split), the per-iteration prefill-budget utilization from
+  the engine's metrics rows, and the ISSUE-16 tail attribution — the
+  p50-vs-p99 breakdown of the exclusive ``attr_*`` latency components
+  (queue/prefill/stall/decode/spec/gap) with the dominant tail
+  component called out, plus the ``steps.jsonl`` step-log digest
+  (``tools/tail_report.py`` renders the same split with step-log
+  evidence);
 - input plane: data-wait share of step time, live adaptive prefetch
   depth / data-service credit window, per-worker fetch throughput,
   dropped workers, and elastic ``data_reshard`` events;
@@ -55,9 +60,9 @@ Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
 Exit status: 0 = report rendered from a healthy stream; 1 = the metric
 stream had unparseable lines or no valid rows (CI gates on this —
 ``trace.jsonl``, ``captures.jsonl``, ``faults.jsonl``,
-``requests.jsonl``, ``goodput.json``, and ``fleet.json`` parse errors
-gate it too, matching the stream-gating convention); missing
-``metrics.jsonl`` is a hard SystemExit.
+``requests.jsonl``, ``steps.jsonl``, ``goodput.json``, and
+``fleet.json`` parse errors gate it too, matching the stream-gating
+convention); missing ``metrics.jsonl`` is a hard SystemExit.
 """
 
 from __future__ import annotations
@@ -295,8 +300,62 @@ def resilience_summary(faults: list[dict], flight: list[dict],
     return out
 
 
+_ATTR_COMPONENTS = (
+    ("queue", "attr_queue_s"),
+    ("prefill", "attr_prefill_s"),
+    ("stall", "attr_stall_s"),
+    ("decode", "attr_decode_s"),
+    ("spec", "attr_spec_s"),
+    ("gap", "attr_gap_s"),
+)
+
+
+def tail_attribution(ok: list[dict]) -> dict:
+    """The p50-vs-p99 component breakdown from the engine's exclusive
+    attribution fields on ok requests (``attr_*_s``; they tile e2e).
+    The dominant component is the one whose tail-cohort mean grew the
+    most over the p50 cohort — ``tools/tail_report.py`` renders the
+    same split with step-log evidence attached."""
+    rows = [
+        r for r in ok
+        if isinstance(r.get("e2e_s"), (int, float))
+        and math.isfinite(r["e2e_s"])
+        and all(isinstance(r.get(f), (int, float))
+                and math.isfinite(r[f]) for _, f in _ATTR_COMPONENTS)
+    ]
+    if not rows:
+        return {}
+    e2es = sorted(r["e2e_s"] for r in rows)
+    p50 = _percentile(e2es, 0.50)
+    p99 = _percentile(e2es, 0.99)
+    p50_rows = [r for r in rows if r["e2e_s"] <= p50]
+    tail_rows = ([r for r in rows if r["e2e_s"] >= p99]
+                 or [max(rows, key=lambda r: r["e2e_s"])])
+    comps = {}
+    for label, field in _ATTR_COMPONENTS:
+        m50 = sum(r[field] for r in p50_rows) / len(p50_rows)
+        mtail = sum(r[field] for r in tail_rows) / len(tail_rows)
+        comps[label] = {"p50_mean_s": m50, "tail_mean_s": mtail,
+                        "growth_s": mtail - m50}
+    dominant = max(comps, key=lambda k: comps[k]["growth_s"])
+    covered = sum(
+        1 for r in rows
+        if abs(sum(r[f] for _, f in _ATTR_COMPONENTS) - r["e2e_s"])
+        <= 0.05 * r["e2e_s"] + 1e-4
+    )
+    return {
+        "requests": len(rows),
+        "e2e_p50_s": p50,
+        "e2e_p99_s": p99,
+        "components": comps,
+        "dominant": dominant,
+        "dominant_growth_s": comps[dominant]["growth_s"],
+        "covered_share": covered / len(rows),
+    }
+
+
 def serving_summary(rows: list[dict], metrics_rows: list[dict] | None
-                    = None) -> dict:
+                    = None, steps_rows: list[dict] | None = None) -> dict:
     """The serving digest from ``requests.jsonl`` (serve.py logdirs):
     terminal-state counts, SLO percentiles (TTFT / TPOT / e2e p50+p99),
     batch occupancy (per-request mean/max fields written by the engine),
@@ -430,6 +489,23 @@ def serving_summary(rows: list[dict], metrics_rows: list[dict] | None
         if budget:
             bu["utilization"] = min(per_iter / budget, 1.0)
         out["prefill_budget"] = bu
+    # tail attribution (ISSUE 16): which exclusive component (queue /
+    # prefill / stall / decode / spec / gap) explains p99 vs p50.
+    ta = tail_attribution(ok)
+    if ta:
+        out["tail_attribution"] = ta
+    if steps_rows:
+        out["step_log"] = {
+            "records": len(steps_rows),
+            "budget_stalls": sum(
+                int(r.get("budget_stall", 0)) for r in steps_rows
+                if isinstance(r.get("budget_stall"), (int, float))
+            ),
+            "tokens_committed": sum(
+                int(r.get("tokens_committed", 0)) for r in steps_rows
+                if isinstance(r.get("tokens_committed"), (int, float))
+            ),
+        }
     return out
 
 
@@ -869,6 +945,11 @@ def build_report(logdir: str) -> dict:
         _load_jsonl(requests_path) if os.path.exists(requests_path)
         else ([], 0)
     )
+    steps_path = os.path.join(logdir, "steps.jsonl")
+    steps_rows, bad_steps = (
+        _load_jsonl(steps_path) if os.path.exists(steps_path)
+        else ([], 0)
+    )
     goodput, bad_goodput = load_goodput(logdir)
     train, evals = split_rows(rows)
     fleet, bad_fleet = fleet_summary(logdir, train, trace, flight)
@@ -906,7 +987,7 @@ def build_report(logdir: str) -> dict:
         "captures": capture_summary(captures),
         "goodput": goodput,
         "resilience": resilience_summary(faults, flight, goodput),
-        "serving": serving_summary(requests, train),
+        "serving": serving_summary(requests, train, steps_rows),
         "fleet": fleet,
         "rpc": rpc,
         # metric-stream health: any unparseable metrics.jsonl / trace /
@@ -915,7 +996,7 @@ def build_report(logdir: str) -> dict:
         # exit non-zero (CI gate)
         "parse_errors": (bad_metrics + bad_trace + bad_goodput
                          + bad_captures + bad_faults + bad_requests
-                         + bad_fleet + bad_journal),
+                         + bad_steps + bad_fleet + bad_journal),
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -1134,6 +1215,27 @@ def render(report: dict) -> str:
             lines.append(
                 f"  prefill: {bu['tokens_per_iter']:.1f} tokens/iteration "
                 f"over {bu['prefill_iters']} iteration(s){util}"
+            )
+        ta = srv.get("tail_attribution")
+        if ta:
+            lines.append(
+                f"  tail attribution ({ta['requests']} request(s), "
+                f"{ta['covered_share']:.0%} within 5% of e2e):"
+            )
+            for label, _ in _ATTR_COMPONENTS:
+                c = ta["components"][label]
+                mark = "  << dominant" if label == ta["dominant"] else ""
+                lines.append(
+                    f"    {label:<8} p50 {c['p50_mean_s'] * 1e3:9.3f} ms"
+                    f"   p99 {c['tail_mean_s'] * 1e3:9.3f} ms"
+                    f"   growth {c['growth_s'] * 1e3:+9.3f} ms{mark}"
+                )
+        sl = srv.get("step_log")
+        if sl:
+            lines.append(
+                f"  step log: {sl['records']} iteration record(s), "
+                f"{sl['tokens_committed']} decode tokens committed, "
+                f"{sl['budget_stalls']} prefill budget stall(s)"
             )
         if srv.get("rejected"):
             lines.append(f"  REJECTED {srv['rejected']} request(s) "
@@ -1367,8 +1469,8 @@ def main(argv: list[str] | None = None) -> int:
     if report.get("parse_errors"):
         print(
             f"run_report: {report['parse_errors']} unparseable telemetry "
-            "entries (metrics/trace/captures/faults/requests/goodput/"
-            "fleet/dispatcher-journal)", file=sys.stderr,
+            "entries (metrics/trace/captures/faults/requests/steps/"
+            "goodput/fleet/dispatcher-journal)", file=sys.stderr,
         )
         return 1
     if not (report["rows"]["train"] or report["rows"]["eval"]):

@@ -1,0 +1,366 @@
+#!/usr/bin/env python
+"""Explain a serving run's p99 tail: which latency component grew.
+
+Usage::
+
+    python tools/tail_report.py <logdir> [--json] [--tail-q 0.99]
+
+Joins the two request-path streams a ``serve.py`` logdir holds:
+
+- ``requests.jsonl`` — per-request rows whose ok entries carry the
+  engine's EXCLUSIVE tail-latency attribution fields
+  (``attr_queue_s`` / ``attr_prefill_s`` / ``attr_stall_s`` /
+  ``attr_decode_s`` / ``attr_spec_s`` / ``attr_gap_s``; they sum to
+  ``e2e_s`` up to rounding);
+- ``steps.jsonl`` — the engine step log (one record per ``step()``
+  iteration: phase mix, occupancy, queue depth, prefill chunks,
+  budget stalls, wall split).
+
+and answers *why is p99 slower than p50*:
+
+- cohorts: the p50 cohort (ok requests with ``e2e_s`` at or below the
+  median) vs the tail cohort (``e2e_s`` at or above the p99 threshold;
+  the single slowest request when the run is too small for a stable
+  p99);
+- per-component cohort means and the tail-vs-p50 growth of each — the
+  **dominant** component is the one that grew the most;
+- step-log evidence: the engine iterations that ran while each tail
+  request was in flight (``[t - e2e_s, t]``), summarized as mean
+  occupancy / queue depth and total prefill chunks / budget stalls,
+  against the same stats over the whole step log — congestion during
+  the tail windows shows up as elevated numbers here;
+- attribution coverage: the share of ok rows whose component sum lands
+  within 5% of ``e2e_s`` (the exactness contract the engine maintains).
+
+``--json`` emits the same content as one machine-readable object.
+Pure stdlib on purpose: must run anywhere the logs land.
+
+Exit status: 0 = report rendered; 1 = either stream had unparseable
+lines, or no ok request carried attribution fields (pre-observability
+logdirs).  Missing ``requests.jsonl`` is a hard SystemExit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+
+_NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
+              "-Infinity": float("-inf")}
+
+#: (label, requests.jsonl field) — the engine's exclusive decomposition
+#: of each ok request's e2e wall time, in pipeline order.
+COMPONENTS = (
+    ("queue", "attr_queue_s"),
+    ("prefill", "attr_prefill_s"),
+    ("stall", "attr_stall_s"),
+    ("decode", "attr_decode_s"),
+    ("spec", "attr_spec_s"),
+    ("gap", "attr_gap_s"),
+)
+
+#: |sum(components) - e2e| <= COVERAGE_RTOL * e2e + COVERAGE_ATOL counts
+#: as covered (the atol absorbs per-field rounding on sub-ms requests).
+COVERAGE_RTOL = 0.05
+COVERAGE_ATOL = 1e-4
+
+
+def _load_jsonl(path: str) -> tuple[list[dict], int]:
+    """Parsed rows plus the count of unparseable lines (the CI gate:
+    ``main`` exits non-zero when either stream had any)."""
+    rows = []
+    bad = 0
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"{path}:{i + 1}: skipping bad row ({e})",
+                      file=sys.stderr)
+                bad += 1
+                continue
+            if isinstance(row, dict):
+                rows.append({
+                    k: _NONFINITE.get(v, v) if isinstance(v, str) else v
+                    for k, v in row.items()
+                })
+            else:
+                print(f"{path}:{i + 1}: skipping non-object row",
+                      file=sys.stderr)
+                bad += 1
+    return rows, bad
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile (no interpolation; stdlib-only)."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def _attr_rows(requests: list[dict]) -> list[dict]:
+    """ok rows carrying a finite e2e and every attribution field."""
+    out = []
+    for r in requests:
+        if r.get("status") != "ok":
+            continue
+        e2e = r.get("e2e_s")
+        if not isinstance(e2e, (int, float)) or not math.isfinite(e2e):
+            continue
+        if all(isinstance(r.get(f), (int, float))
+               and math.isfinite(r[f]) for _, f in COMPONENTS):
+            out.append(r)
+    return out
+
+
+def attribution_coverage(rows: list[dict]) -> dict:
+    """How exactly the components tile e2e: covered-row share plus the
+    worst relative error seen (the acceptance bar is >= 95% within 5%)."""
+    if not rows:
+        return {}
+    covered = 0
+    worst = 0.0
+    for r in rows:
+        total = sum(r[f] for _, f in COMPONENTS)
+        err = abs(total - r["e2e_s"])
+        tol = COVERAGE_RTOL * r["e2e_s"] + COVERAGE_ATOL
+        if err <= tol:
+            covered += 1
+        if r["e2e_s"] > 0:
+            worst = max(worst, err / r["e2e_s"])
+    return {
+        "rows": len(rows),
+        "covered": covered,
+        "covered_share": covered / len(rows),
+        "worst_rel_err": worst,
+    }
+
+
+def attribution_cohorts(rows: list[dict], tail_q: float = 0.99) -> dict:
+    """The p50-vs-tail component breakdown.  ``rows`` must come from
+    ``_attr_rows``.  Returns the two cohorts' per-component means, the
+    tail-vs-p50 growth of each, and the dominant (max-growth)
+    component."""
+    if not rows:
+        return {}
+    e2es = sorted(r["e2e_s"] for r in rows)
+    p50 = _percentile(e2es, 0.50)
+    p_tail = _percentile(e2es, tail_q)
+    p50_rows = [r for r in rows if r["e2e_s"] <= p50]
+    tail_rows = [r for r in rows if r["e2e_s"] >= p_tail]
+    if not tail_rows:  # degenerate (all-equal e2e): slowest request
+        tail_rows = [max(rows, key=lambda r: r["e2e_s"])]
+    comps = {}
+    for label, field in COMPONENTS:
+        m50 = sum(r[field] for r in p50_rows) / len(p50_rows)
+        mtail = sum(r[field] for r in tail_rows) / len(tail_rows)
+        comps[label] = {
+            "p50_mean_s": m50,
+            "tail_mean_s": mtail,
+            "growth_s": mtail - m50,
+        }
+    dominant = max(comps, key=lambda k: comps[k]["growth_s"])
+    return {
+        "tail_q": tail_q,
+        "requests": len(rows),
+        "e2e_p50_s": p50,
+        "e2e_tail_s": p_tail,
+        "p50_cohort": len(p50_rows),
+        "tail_cohort": len(tail_rows),
+        "components": comps,
+        "dominant": dominant,
+        "dominant_growth_s": comps[dominant]["growth_s"],
+    }
+
+
+def _window_stats(steps: list[dict]) -> dict:
+    """Congestion stats over a set of step records."""
+    if not steps:
+        return {}
+    n = len(steps)
+    return {
+        "steps": n,
+        "occupancy_mean": sum(s.get("occupancy", 0) for s in steps) / n,
+        "queue_depth_mean": sum(s.get("queue_depth", 0)
+                                for s in steps) / n,
+        "prefill_chunks": sum(s.get("prefill_chunks", 0) for s in steps),
+        "budget_stalls": sum(s.get("budget_stall", 0) for s in steps),
+        "step_s_mean": sum(s.get("step_s", 0.0) for s in steps) / n,
+    }
+
+
+def step_evidence(steps: list[dict], cohorts: dict,
+                  rows: list[dict]) -> dict:
+    """Join the step log against the tail cohort: the engine iterations
+    that completed while a tail request was in flight vs the whole log.
+    Congested tails show elevated occupancy / queue depth / budget
+    stalls inside the tail windows."""
+    usable = [s for s in steps
+              if isinstance(s.get("t"), (int, float))]
+    if not usable or not cohorts:
+        return {}
+    p_tail = cohorts["e2e_tail_s"]
+    tail_rows = [r for r in rows if r["e2e_s"] >= p_tail] or \
+        [max(rows, key=lambda r: r["e2e_s"])]
+    windows = [
+        (r["t"] - r["e2e_s"], r["t"]) for r in tail_rows
+        if isinstance(r.get("t"), (int, float))
+    ]
+    in_tail = [
+        s for s in usable
+        if any(lo <= s["t"] <= hi for lo, hi in windows)
+    ]
+    return {
+        "tail_windows": len(windows),
+        "tail": _window_stats(in_tail),
+        "overall": _window_stats(usable),
+    }
+
+
+def build(logdir: str, tail_q: float = 0.99) -> dict:
+    requests_path = os.path.join(logdir, "requests.jsonl")
+    if not os.path.exists(requests_path):
+        raise SystemExit(
+            f"{requests_path}: not found (is this a serve logdir?)"
+        )
+    requests, bad_requests = _load_jsonl(requests_path)
+    steps_path = os.path.join(logdir, "steps.jsonl")
+    steps, bad_steps = (_load_jsonl(steps_path)
+                        if os.path.exists(steps_path) else ([], 0))
+    rows = _attr_rows(requests)
+    cohorts = attribution_cohorts(rows, tail_q)
+    return {
+        "logdir": logdir,
+        "requests": len(requests),
+        "ok_with_attribution": len(rows),
+        "step_records": len(steps),
+        "coverage": attribution_coverage(rows),
+        "cohorts": cohorts,
+        "evidence": step_evidence(steps, cohorts, rows),
+        "parse_errors": bad_requests + bad_steps,
+    }
+
+
+def render(rep: dict) -> str:
+    lines = [
+        f"TAIL REPORT — {rep['logdir']}",
+        "=" * 72,
+        (
+            f"requests: {rep['requests']} total, "
+            f"{rep['ok_with_attribution']} ok with attribution fields; "
+            f"{rep['step_records']} step-log record(s)"
+        ),
+    ]
+    cov = rep.get("coverage")
+    if cov:
+        lines.append(
+            f"attribution coverage: {cov['covered']}/{cov['rows']} "
+            f"({cov['covered_share']:.0%}) within "
+            f"{COVERAGE_RTOL:.0%} of e2e  "
+            f"(worst rel err {cov['worst_rel_err']:.2%})"
+        )
+    co = rep.get("cohorts")
+    if not co:
+        lines.append("no ok rows carry attribution fields — nothing to "
+                     "explain (pre-observability logdir?)")
+        return "\n".join(lines) + "\n"
+    lines += [
+        "",
+        (
+            f"e2e p50 {co['e2e_p50_s']:.4g}s "
+            f"({co['p50_cohort']} request(s))  vs  "
+            f"p{co['tail_q'] * 100:g} {co['e2e_tail_s']:.4g}s "
+            f"({co['tail_cohort']} request(s))"
+        ),
+        "",
+        f"{'component':<10} {'p50 mean':>12} {'tail mean':>12} "
+        f"{'growth':>12}",
+    ]
+    for label, _ in COMPONENTS:
+        c = co["components"][label]
+        mark = "  << dominant" if label == co["dominant"] else ""
+        lines.append(
+            f"{label:<10} {c['p50_mean_s'] * 1e3:10.3f} ms "
+            f"{c['tail_mean_s'] * 1e3:10.3f} ms "
+            f"{c['growth_s'] * 1e3:10.3f} ms{mark}"
+        )
+    lines += [
+        "",
+        (
+            f"dominant tail component: {co['dominant']} "
+            f"(+{co['dominant_growth_s'] * 1e3:.3f} ms tail vs p50)"
+        ),
+    ]
+    ev = rep.get("evidence")
+    if ev and ev.get("tail", {}).get("steps"):
+        t, o = ev["tail"], ev["overall"]
+        lines += [
+            "",
+            (
+                f"step-log evidence ({t['steps']} iteration(s) inside "
+                f"{ev['tail_windows']} tail window(s) vs "
+                f"{o['steps']} overall):"
+            ),
+            (
+                f"  occupancy   {t['occupancy_mean']:.2f} vs "
+                f"{o['occupancy_mean']:.2f}"
+            ),
+            (
+                f"  queue depth {t['queue_depth_mean']:.2f} vs "
+                f"{o['queue_depth_mean']:.2f}"
+            ),
+            (
+                f"  prefill chunks {t['prefill_chunks']} "
+                f"(of {o['prefill_chunks']} total)   budget stalls "
+                f"{t['budget_stalls']} (of {o['budget_stalls']} total)"
+            ),
+            (
+                f"  mean iteration {t['step_s_mean'] * 1e3:.3f} ms vs "
+                f"{o['step_s_mean'] * 1e3:.3f} ms"
+            ),
+        ]
+    elif not rep.get("step_records"):
+        lines += ["", "no steps.jsonl — step-log evidence unavailable"]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logdir", help="serve.py logdir holding "
+                                  "requests.jsonl (+ steps.jsonl)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one JSON object")
+    p.add_argument("--tail-q", type=float, default=0.99,
+                   help="tail quantile to explain (default 0.99)")
+    args = p.parse_args(argv)
+    if not 0.5 < args.tail_q < 1.0:
+        p.error("--tail-q must be in (0.5, 1.0)")
+    rep = build(args.logdir, tail_q=args.tail_q)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(render(rep), end="")
+    if rep["parse_errors"]:
+        print(
+            f"tail_report: {rep['parse_errors']} unparseable telemetry "
+            "entries (requests/steps)", file=sys.stderr,
+        )
+        return 1
+    if not rep["ok_with_attribution"]:
+        print("tail_report: no ok rows with attribution fields",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,7 +36,14 @@ Tracks (one Chrome-trace "process" per stream):
   labelled by its trigger;
 - **goodput** — one bar per process generation (restart gaps show as the
   space between bars, labelled ``badput_restart`` when the ledger booked
-  them).
+  them);
+- **engine steps** — a serve logdir's ``steps.jsonl`` records as one
+  duration bar per ``Engine.step()`` iteration, named by its phase mix
+  (``admit+prefill+decode``), with occupancy / queue-depth counter
+  tracks riding alongside — batch congestion reads directly off the
+  lane.  In ``--fleet`` mode the lane keeps the serve process's track
+  group, so request spans and the iterations that served them line up
+  on the shared clock.
 
 Timestamp reconstruction: ``trace.jsonl`` spans carry durations only, so
 step rows are anchored to the flight recorder's absolute ``step`` events
@@ -62,6 +69,7 @@ PID_SPANS = 1
 PID_FLIGHT = 2
 PID_CAPTURES = 3
 PID_GOODPUT = 4
+PID_STEPS = 5
 #: --fleet: the shared cross-process trace group; per-logdir pids are
 #: offset by _FLEET_PID_STRIDE * index.
 PID_FLEET_TRACES = 90
@@ -162,11 +170,13 @@ def build_timeline(logdir: str) -> dict:
     trace = load_jsonl(os.path.join(logdir, "trace.jsonl"))
     flight = load_jsonl(os.path.join(logdir, "flight.jsonl"))
     captures = load_jsonl(os.path.join(logdir, "captures.jsonl"))
+    steps = load_jsonl(os.path.join(logdir, "steps.jsonl"))
     gens = load_goodput(logdir)
-    if not (trace or flight or captures or gens):
+    if not (trace or flight or captures or steps or gens):
         raise SystemExit(
             f"{logdir}: no telemetry streams (trace.jsonl / flight.jsonl / "
-            "captures.jsonl / goodput.json) — is this a logdir?"
+            "captures.jsonl / steps.jsonl / goodput.json) — is this a "
+            "logdir?"
         )
 
     # Absolute origin: the earliest timestamp any stream carries.
@@ -188,6 +198,11 @@ def build_timeline(logdir: str) -> dict:
         t = _num(g.get("start_t"))
         if t is not None:
             absolutes.append(t)
+    for s in steps:
+        t = _num(s.get("t"))
+        if t is not None:
+            # `t` stamps the iteration's END; its start is t - step_s
+            absolutes.append(t - max(_num(s.get("step_s")) or 0.0, 0.0))
     t0 = min(absolutes) if absolutes else 0.0
     t0_us = t0 * 1e6
 
@@ -196,6 +211,8 @@ def build_timeline(logdir: str) -> dict:
     _meta(events, PID_FLIGHT, "flight events (flight.jsonl)", 1)
     _meta(events, PID_CAPTURES, "captures (captures.jsonl)", 2)
     _meta(events, PID_GOODPUT, "goodput generations (goodput.json)", 3)
+    if steps:
+        _meta(events, PID_STEPS, "engine steps (steps.jsonl)", 4)
 
     # -- flight events: one lane per kind, instants ---------------------------
     kind_tid: dict[str, int] = {}
@@ -327,6 +344,33 @@ def build_timeline(logdir: str) -> dict:
                     "dur": round((nxt_start - last) * 1e6, 3),
                 })
 
+    # -- engine step lane (serve logdirs: steps.jsonl) ------------------------
+    if steps:
+        events.append({"ph": "M", "pid": PID_STEPS, "tid": 1,
+                       "name": "thread_name",
+                       "args": {"name": "iterations (by phase)"}})
+        for s in steps:
+            t_end = _num(s.get("t"))
+            if t_end is None:
+                continue
+            dur = max(_num(s.get("step_s")) or 0.0, 0.0)
+            ts = round((t_end - dur) * 1e6 - t0_us, 3)
+            events.append({
+                "ph": "X", "pid": PID_STEPS, "tid": 1,
+                "name": str(s.get("phase", "?")),
+                "ts": ts, "dur": round(dur * 1e6, 3),
+                "args": {k: v for k, v in s.items()
+                         if not isinstance(v, (list, dict))},
+            })
+            # counter tracks: occupancy + queue depth read as area plots
+            for key in ("occupancy", "queue_depth"):
+                v = _num(s.get(key))
+                if v is not None:
+                    events.append({
+                        "ph": "C", "pid": PID_STEPS, "tid": 0,
+                        "name": key, "ts": ts, "args": {key: v},
+                    })
+
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -338,6 +382,7 @@ def build_timeline(logdir: str) -> dict:
                 "flight_events": len(flight),
                 "captures": len(captures),
                 "goodput_generations": len(gens),
+                "engine_steps": len(steps),
             },
         },
     }
@@ -438,7 +483,7 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("logdir", nargs="?", default=None,
                    help="directory holding trace.jsonl / "
-                        "flight.jsonl / captures.jsonl / "
+                        "flight.jsonl / captures.jsonl / steps.jsonl / "
                         "goodput.json (any subset)")
     p.add_argument("--fleet", nargs="+", default=None, metavar="LOGDIR",
                    help="fleet mode: stitch SEVERAL processes' logdirs "
@@ -482,8 +527,8 @@ def main(argv: list[str] | None = None) -> int:
     print(
         f"timeline: {len(doc['traceEvents'])} events "
         f"({n['trace_rows']} span rows, {n['flight_events']} flight, "
-        f"{n['captures']} captures, {n['goodput_generations']} "
-        f"generations) -> {out}"
+        f"{n['captures']} captures, {n['engine_steps']} engine steps, "
+        f"{n['goodput_generations']} generations) -> {out}"
     )
     return 0
 

@@ -1435,6 +1435,23 @@ def main() -> None:
         logging.info("slo monitor: %d rule(s) from %s evaluated every "
                      "%.1fs", len(slo_rules), args.slo_rules,
                      args.slo_interval)
+    metrics_history = None
+    if fleet_agg is not None:
+        from distributedtensorflow_tpu.obs.tsdb import MetricsHistory
+
+        # Embedded history store over the fleet plane: the chief keeps a
+        # windowed, fixed-memory history of its own registry AND the
+        # fleet-merged per-key median/max (plus SLO good/total snapshots
+        # when rules are loaded), served at GET /histz and persisted to
+        # <logdir>/history.jsonl for offline burn recomputation.
+        metrics_history = MetricsHistory(
+            interval_s=args.fleet_interval,
+            logdir=args.logdir,
+            rules=slo_monitor.rules if slo_monitor is not None else None,
+            fleet=fleet_agg,
+        ).install(trainer.status_server).start()
+        logging.info("metrics history: fleet-merged sampling every %.1fs "
+                     "(GET /histz)", args.fleet_interval)
 
     eval_iter_fn = None
     if args.eval_every and eval_step is not None:
@@ -1548,6 +1565,8 @@ def main() -> None:
                 slo_monitor.evaluate()
             except Exception:
                 logging.exception("final slo evaluation failed")
+        if metrics_history is not None:
+            metrics_history.stop()
         if fleet_agg is not None:
             fleet_agg.stop()
         if (slo_monitor is not None or fleet_agg is not None) \
