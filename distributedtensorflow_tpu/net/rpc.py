@@ -84,6 +84,7 @@ __all__ = [
     "connect_stream",
     "connect_with_retry",
     "http_get",
+    "http_post",
     "note_success",
     "recv_frame",
     "recv_msg",
@@ -101,7 +102,7 @@ __all__ = [
 #: silently fork every ``rpc_*`` time series.
 ENDPOINT_PREFIXES = (
     "dispatcher", "data_worker", "mpmd_link", "fleet_peer", "serve",
-    "peer",
+    "peer", "webhook",
 )
 
 #: ``rpc_retries_total`` outcome label values (mirrored by the checker).
@@ -646,3 +647,95 @@ def http_get(url: str, *, deadline_s: float, endpoint: str,
     br.record_success()
     note_success(endpoint)
     return status, body
+
+
+def http_post(
+    url: str,
+    payload: dict,
+    *,
+    endpoint: str,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    deadline_s: float | None = None,
+    breaker=None,
+    rng: random.Random | None = None,
+) -> tuple[int, str]:
+    """POST ``payload`` as JSON under the full unary machinery — the
+    deadline bounds connect + send + response + backoff sleeps, transport
+    failures retry under ``policy``, the endpoint's breaker is consulted
+    before and fed after every attempt, and armed chaos faults apply
+    (webhook delivery is chaos-testable like any RPC).  A 5xx status is a
+    transport-shaped failure (the receiver exists but is broken) and
+    retries; any other status is RETURNED as ``(status, body)``.  Raises
+    :class:`DeadlineExceeded` / :class:`~net.breaker.BreakerOpenError` /
+    the last transport error like :func:`call`."""
+    br = breaker if breaker is not None else breaker_for(endpoint)
+    dl = Deadline(policy.deadline_s if deadline_s is None else deadline_s)
+    if not url.startswith("http://"):
+        raise ValueError(f"http_post supports http:// urls only: {url!r}")
+    hostport, _, path = url[len("http://"):].partition("/")
+    host, port = _split_addr(hostport)
+    body = json.dumps(payload).encode("utf-8")
+    last_err: BaseException | None = None
+    for attempt in range(policy.max_attempts):
+        br.check()
+        t0 = time.perf_counter()
+        conn = None
+        try:
+            _apply_faults(endpoint)
+            remaining = dl.remaining()
+            if remaining <= 0:
+                raise DeadlineExceeded(
+                    f"post to {endpoint} out of budget before attempt "
+                    f"{attempt}", endpoint=endpoint,
+                )
+            conn = http.client.HTTPConnection(
+                host, port,
+                timeout=min(policy.connect_timeout_s, remaining),
+            )
+            conn.request(
+                "POST", "/" + path, body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            if conn.sock is not None:
+                conn.sock.settimeout(max(dl.remaining(), 1e-3))
+            resp = conn.getresponse()
+            text = resp.read(1 << 20).decode("utf-8", errors="replace")
+            if resp.status >= 500:
+                raise OSError(
+                    f"webhook {endpoint} answered {resp.status}")
+            status = resp.status
+        except (OSError, http.client.HTTPException) as e:
+            _H_ATTEMPT.observe(time.perf_counter() - t0, endpoint=endpoint)
+            br.record_failure()
+            if attempt > 0:
+                _M_RETRIES.inc(endpoint=endpoint, outcome="error")
+            if isinstance(e, DeadlineExceeded) or dl.expired:
+                _M_DEADLINE.inc(endpoint=endpoint)
+                if isinstance(e, DeadlineExceeded):
+                    raise
+                raise DeadlineExceeded(
+                    f"post to {endpoint} exceeded its deadline "
+                    f"({type(e).__name__}: {e})", endpoint=endpoint,
+                ) from e
+            last_err = e
+            if attempt + 1 >= policy.max_attempts:
+                raise
+            delay = backoff_s(policy, attempt, rng)
+            if dl.remaining() <= delay:
+                _M_DEADLINE.inc(endpoint=endpoint)
+                raise DeadlineExceeded(
+                    f"post to {endpoint}: deadline leaves no room for "
+                    f"retry backoff ({delay:.3f}s)", endpoint=endpoint,
+                ) from e
+            time.sleep(delay)
+            continue
+        finally:
+            if conn is not None:
+                conn.close()
+        _H_ATTEMPT.observe(time.perf_counter() - t0, endpoint=endpoint)
+        br.record_success()
+        note_success(endpoint)
+        if attempt > 0:
+            _M_RETRIES.inc(endpoint=endpoint, outcome="ok")
+        return status, text
+    raise last_err if last_err is not None else RuntimeError("unreachable")

@@ -46,6 +46,13 @@ Surfaces:
   (``slo_burn_rate{slo=,window=}``), raising ``slo_violation`` flight
   events, serving ``/sloz``, and optionally arming the CaptureEngine on
   a fast-burn trip;
+- ``AlertManager`` — declarative alert rules (JSON) over registry
+  scalars, history series, and fleet-merged samples — ``threshold`` /
+  ``burn`` / ``absence`` / ``anomaly`` kinds, edge-triggered with
+  cooldowns, dedup, and silences — fanning out to log/webhook/capture
+  sinks, appending ``alerts.jsonl``, snapshotting per-firing incident
+  evidence bundles (``incidents/<id>/``), and serving ``GET /alertz``;
+  ``obs.alerts.recompute_from_history`` replays the rules offline;
 - ``MetricsHistory`` — the embedded metrics history store (``obs.tsdb``):
   fixed-memory downsampling rings over registry samples (plus fleet
   merges and per-SLO good/total snapshots when attached), answering
@@ -61,7 +68,8 @@ Surfaces:
   single Chrome-trace/Perfetto timeline (restarts included).
 """
 
-from . import capture, fleet, flight_recorder, goodput, memory, slo, tsdb  # noqa: F401
+from . import alerts, capture, fleet, flight_recorder, goodput, memory, slo, tsdb  # noqa: F401
+from .alerts import AlertManager, AlertRule  # noqa: F401
 from .aggregate import (  # noqa: F401
     host_aggregate,
     spread_ratio,

@@ -31,7 +31,17 @@ from collections.abc import Callable
 
 logger = logging.getLogger("distributedtensorflow_tpu")
 
-__all__ = ["Anomaly", "AnomalyDetector"]
+__all__ = ["Anomaly", "AnomalyDetector", "zscore"]
+
+
+def zscore(values, value: float) -> float:
+    """How many sigma ``value`` sits from ``values``' mean, with a
+    relative std floor: a bitwise-constant plateau (pstdev 0) must not
+    turn float jitter into a spike.  The loss-spike detector's math,
+    exposed for any series (``obs.alerts`` anomaly rules)."""
+    mean = statistics.fmean(values)
+    std = statistics.pstdev(values)
+    return abs(value - mean) / max(std, 1e-6 * max(abs(mean), 1.0))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,10 +104,7 @@ class AnomalyDetector:
             else:
                 if len(self._losses) >= self.min_history:
                     mean = statistics.fmean(self._losses)
-                    std = statistics.pstdev(self._losses)
-                    # Relative std floor: a bitwise-constant loss plateau
-                    # (pstdev 0) must not turn float jitter into a spike.
-                    z = abs(loss - mean) / max(std, 1e-6 * max(abs(mean), 1.0))
+                    z = zscore(self._losses, loss)
                     if z > self.z_threshold:
                         found.append(Anomaly(
                             "loss_spike", step,

@@ -86,7 +86,7 @@ __all__ = [
 #: The known capture trigger kinds (the ``captures.jsonl`` schema —
 #: ``tools/check_metrics_schema.py`` validates against this set).
 TRIGGERS = ("static", "manual", "step_time_regression", "straggler_spread",
-            "slo_burn")
+            "slo_burn", "alert")
 
 _M_CAPTURES = counter(
     "profiler_captures_total", "profiler captures started, by trigger"

@@ -41,7 +41,8 @@ rejected a truncated/corrupt checkpoint and fell back —
 ``supervisor_giving_up`` (supervised in-process restarts —
 ``resilience.supervisor``), ``data_reshard`` (elastic data-service
 re-assignment — ``data.service``), ``slo_violation`` (an SLO burn-rate
-threshold trip — ``obs.slo``), ``fit_begin``, ``fit_end``.
+threshold trip — ``obs.slo``), ``alert`` (an alert rule fired or
+resolved — ``obs.alerts``), ``fit_begin``, ``fit_end``.
 
 The hot path is one ``time.time()`` + one deque append under a lock; dumps
 rewrite the whole file atomically (tmp + rename) so a reader — or the

@@ -16,11 +16,20 @@ Thread-safe: metric objects hold one lock each; the hot path (unlabeled
 ``inc``/``set``/``observe``) is a dict update under that lock.  Metric
 handles are cached — call :func:`counter` once and keep the object when
 incrementing from a hot loop.
+
+Label cardinality is guarded: each metric family admits at most
+``max_label_sets`` unique label-sets (default
+:data:`DEFAULT_MAX_LABEL_SETS`); past the cap, NEW label-sets are
+dropped — counted in ``registry_dropped_series_total{metric=...}`` with
+a one-time warning — so a buggy label (a per-request id, say) can no
+longer grow ``/varz``, fleet scrapes, and the history store without
+bound.  Existing series keep updating.
 """
 
 from __future__ import annotations
 
 import bisect
+import logging
 import math
 import os
 import re
@@ -28,7 +37,10 @@ import threading
 import time
 from typing import Iterable, Mapping
 
+logger = logging.getLogger("distributedtensorflow_tpu")
+
 __all__ = [
+    "DEFAULT_MAX_LABEL_SETS",
     "Counter",
     "Gauge",
     "Histogram",
@@ -45,6 +57,14 @@ DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+#: Unique label-sets a metric family admits before new ones are dropped.
+DEFAULT_MAX_LABEL_SETS = 1024
+
+#: Where the guard's drops are counted (exempt from its own guard —
+#: its cardinality is bounded by the number of metric NAMES, which is
+#: code-controlled, and an attached drop hook would recurse).
+_DROP_COUNTER = "registry_dropped_series_total"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -78,10 +98,36 @@ class _Metric:
         self.help = help
         self._lock = threading.Lock()
         self._values: dict[tuple, float] = {}
+        self.max_label_sets = DEFAULT_MAX_LABEL_SETS
+        self.dropped_series = 0
+        self._warned_cardinality = False
+        self._on_drop = None  # Registry hook: counts the family's drops
 
     def _items(self) -> list[tuple[tuple, float]]:
         with self._lock:
             return list(self._values.items())
+
+    def _admit(self, store: dict, key: tuple) -> bool:
+        """Cardinality guard, called under ``self._lock``: an existing
+        label-set always updates; a new one is admitted only under the
+        cap.  Refusals are tallied here and reported by :meth:`_note_drop`
+        OUTSIDE the lock (the drop counter takes its own lock)."""
+        if key in store or len(store) < self.max_label_sets:
+            return True
+        self.dropped_series += 1
+        return False
+
+    def _note_drop(self) -> None:
+        if not self._warned_cardinality:
+            self._warned_cardinality = True
+            logger.warning(
+                "metric %s: label cardinality cap (%d unique label-sets) "
+                "reached — new series are being DROPPED; a label is "
+                "probably carrying unbounded values (request ids?)",
+                self.name, self.max_label_sets,
+            )
+        if self._on_drop is not None:
+            self._on_drop(self.name)
 
 
 class Counter(_Metric):
@@ -94,7 +140,11 @@ class Counter(_Metric):
             raise ValueError(f"counter {self.name}: inc({n}) is negative")
         key = _label_key(labels)
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + n
+            ok = self._admit(self._values, key)
+            if ok:
+                self._values[key] = self._values.get(key, 0.0) + n
+        if not ok:
+            self._note_drop()
 
     def value(self, **labels) -> float:
         with self._lock:
@@ -109,12 +159,20 @@ class Gauge(_Metric):
     def set(self, value: float, **labels) -> None:
         key = _label_key(labels)
         with self._lock:
-            self._values[key] = float(value)
+            ok = self._admit(self._values, key)
+            if ok:
+                self._values[key] = float(value)
+        if not ok:
+            self._note_drop()
 
     def add(self, n: float, **labels) -> None:
         key = _label_key(labels)
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + n
+            ok = self._admit(self._values, key)
+            if ok:
+                self._values[key] = self._values.get(key, 0.0) + n
+        if not ok:
+            self._note_drop()
 
     def value(self, **labels) -> float:
         with self._lock:
@@ -137,11 +195,15 @@ class Histogram(_Metric):
         value = float(value)
         key = _label_key(labels)
         with self._lock:
-            counts, total, n = self._hist.get(
-                key, ([0] * (len(self.buckets) + 1), 0.0, 0)
-            )
-            counts[bisect.bisect_left(self.buckets, value)] += 1
-            self._hist[key] = (counts, total + value, n + 1)
+            ok = self._admit(self._hist, key)
+            if ok:
+                counts, total, n = self._hist.get(
+                    key, ([0] * (len(self.buckets) + 1), 0.0, 0)
+                )
+                counts[bisect.bisect_left(self.buckets, value)] += 1
+                self._hist[key] = (counts, total + value, n + 1)
+        if not ok:
+            self._note_drop()
 
     def stats(self, **labels) -> dict[str, float]:
         with self._lock:
@@ -233,15 +295,25 @@ class Histogram(_Metric):
 class Registry:
     """Name → metric map; the exporters read it, any module writes it."""
 
-    def __init__(self):
+    def __init__(self, max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
+        self.max_label_sets = max(int(max_label_sets), 1)
+
+    def _count_drop(self, metric_name: str) -> None:
+        self.counter(
+            _DROP_COUNTER,
+            "series dropped by the per-metric label-cardinality cap",
+        ).inc(metric=metric_name)
 
     def _get_or_create(self, cls, name: str, help: str, **kwargs):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = cls(name, help, **kwargs)
+                m.max_label_sets = self.max_label_sets
+                if name != _DROP_COUNTER:
+                    m._on_drop = self._count_drop
                 self._metrics[name] = m
             elif not isinstance(m, cls):
                 raise TypeError(

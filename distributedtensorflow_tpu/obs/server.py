@@ -178,7 +178,11 @@ class _Handler(BaseHTTPRequestHandler):
                     + "\n"
                 )
             elif path == "/healthz":
+                from urllib.parse import parse_qs  # noqa: PLC0415
+
                 health = srv.health()
+                if "deep" in parse_qs(query, keep_blank_values=True):
+                    health = srv.deep_health(shallow=health)
                 self._reply_json(
                     health, status=200 if health.get("ok", True) else 503
                 )
@@ -322,6 +326,7 @@ class StatusServer:
         capture=None,
         status_fn: Callable[[], dict] | None = None,
         health_fn: Callable[[], dict] | None = None,
+        deep_health_fn: Callable[[], dict] | None = None,
         routes: dict | None = None,
     ):
         from . import registry as reglib  # noqa: PLC0415
@@ -331,6 +336,12 @@ class StatusServer:
         self._capture = capture
         self._status_fn = status_fn
         self._health_fn = health_fn
+        #: ``GET /healthz?deep=1`` verdict source: ``fn() -> dict`` with an
+        #: ``ok`` bool plus whatever component detail it wants to expose
+        #: (see :func:`obs.alerts.compose_deep_health`).  Assignable after
+        #: construction — entry points compose it once every subsystem
+        #: (alerts, SLO monitor, engine) exists.
+        self.deep_health_fn = deep_health_fn
         #: Extra application endpoints: ``{("GET"|"POST", path): handler}``
         #: where a GET handler is ``fn(query) -> (status, payload)`` and a
         #: POST handler ``fn(query, body_bytes) -> (status, payload)``
@@ -397,6 +408,29 @@ class StatusServer:
                       "uptime_s": round(time.time() - self._t0, 1)}
         if self._health_fn is not None:
             base.update(self._health_fn())
+        return base
+
+    def deep_health(self, shallow: dict | None = None) -> dict:
+        """The composed ``?deep=1`` verdict: the shallow health payload
+        plus ``deep_health_fn``'s component breakdown, ``ok`` ANDed
+        across both — so a router polling one endpoint sees liveness and
+        the named failing component together.  Without a
+        ``deep_health_fn`` the shallow verdict stands (``deep: false``
+        marks the downgrade)."""
+        base = dict(shallow if shallow is not None else self.health())
+        if self.deep_health_fn is None:
+            base["deep"] = False
+            return base
+        try:
+            verdict = dict(self.deep_health_fn())
+        except Exception as e:  # a probe bug reads as unhealthy, loudly
+            logger.exception("deep health verdict failed")
+            verdict = {"ok": False, "failing": ["deep_health_fn"],
+                       "error": repr(e)}
+        ok = bool(base.get("ok", True)) and bool(verdict.pop("ok", True))
+        base.update(verdict)
+        base["ok"] = ok
+        base["deep"] = True
         return base
 
     # -- lifecycle -----------------------------------------------------------

@@ -124,6 +124,7 @@ class MetricsHistory:
         self._lock = threading.Lock()
         self._series: dict[str, _Series] = {}
         self._dropped: set[str] = set()  # names refused by the series cap
+        self._pinned: set[str] = set()   # names with reserved capacity
         self.ticks = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -167,12 +168,22 @@ class MetricsHistory:
         values = self._collect()
         kept: dict[str, float] = {}
         with self._lock:
+            reserved = len(self._pinned - set(self._series))
             for name, v in values.items():
                 s = self._series.get(name)
                 if s is None:
+                    # hard memory bound: a cardinality bug upstream must
+                    # not grow this process without limit.  Pinned names
+                    # (alert-rule metrics) have reserved slots so a
+                    # late-appearing watched series is never the one the
+                    # cap evicts; the total still never exceeds
+                    # max_series.
+                    if name in self._pinned:
+                        reserved -= 1
+                    elif len(self._series) + reserved >= self.max_series:
+                        self._dropped.add(name)
+                        continue
                     if len(self._series) >= self.max_series:
-                        # hard memory bound: a cardinality bug upstream
-                        # must not grow this process without limit
                         self._dropped.add(name)
                         continue
                     s = self._series[name] = _Series(
@@ -189,6 +200,17 @@ class MetricsHistory:
                     {"t": now, "values": kept}) + "\n")
                 self._hist_log.flush()
         return kept
+
+    def pin(self, names) -> "MetricsHistory":
+        """Reserve capacity for these series names: pinned series are
+        admitted even after unpinned cardinality has filled the cap
+        (unpinned series can only claim ``max_series`` minus the not-yet-
+        materialized pinned count).  The alert manager pins every rule's
+        watched metric so offline replay over ``history.jsonl`` sees the
+        exact series the live rules evaluated."""
+        with self._lock:
+            self._pinned.update(str(n) for n in names if n)
+        return self
 
     # -- queries -------------------------------------------------------------
 
@@ -227,6 +249,7 @@ class MetricsHistory:
                 "max_series": self.max_series,
                 "series": len(self._series),
                 "series_dropped": len(self._dropped),
+                "series_pinned": len(self._pinned),
                 "ticks": self.ticks,
             }
 

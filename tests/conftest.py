@@ -52,6 +52,7 @@ _PROCESS_TEST_FILES = {
     "test_train_quant_smoke.py",
     "test_train_data_service_smoke.py",
     "test_train_fleet_smoke.py",
+    "test_train_alert_chaos_smoke.py",
     "test_serve_smoke.py",
 }
 

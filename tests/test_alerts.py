@@ -1,0 +1,754 @@
+"""Fleet alerting tests (``obs.alerts``, ISSUE 17).
+
+The load-bearing checks: (1) the state machine is edge-triggered and
+deduplicated by construction — a condition that stays true fires ONCE,
+resolves once on the falling edge, and cooldown/silences gate only the
+firing edge; (2) degenerate inputs (unknown metric, empty history,
+all-NaN series) are no-data, never a crash or a flap; (3) a dead webhook
+receiver gives up through the net/ breaker without wedging evaluation;
+(4) `alerts.jsonl` and incident bundles are schema-green under the
+repo's own checker; (5) offline replay over history rows reproduces the
+live firings in lockstep.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from distributedtensorflow_tpu.obs import Registry, StatusServer
+from distributedtensorflow_tpu.obs import alerts as alerts_mod
+from distributedtensorflow_tpu.obs.alerts import (
+    AlertManager,
+    AlertRule,
+    compose_deep_health,
+    load_rules,
+    make_webhook_sink,
+    recompute_from_history,
+    validate_rules_doc,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import check_metrics_schema as checker  # noqa: E402
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _mgr(rules, reg=None, clock=None, **kw):
+    kw.setdefault("sinks", [])
+    kw.setdefault("record_flight", False)
+    return AlertManager(
+        rules, registry=reg or Registry(),
+        time_fn=clock or _Clock(), interval_s=1.0, **kw,
+    )
+
+
+def _threshold(name="hot", metric="temp", bound=10.0, **kw):
+    kw.setdefault("cooldown_s", 0.0)
+    return AlertRule.from_dict({
+        "name": name, "kind": "threshold", "metric": metric,
+        "op": "gt", "bound": bound, "window_s": 30.0, **kw,
+    })
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_validation_lists_every_violation():
+    doc = {"alerts": [
+        {"name": "a", "kind": "nope"},
+        {"name": "b", "kind": "threshold", "metric": "m"},  # no bound
+        {"name": "b", "kind": "absence", "metric": "m", "for_s": 5},
+    ]}
+    errors = validate_rules_doc(doc)
+    assert any("'kind'" in e for e in errors)
+    assert any("'bound'" in e for e in errors)
+    assert any("duplicate rule name" in e for e in errors)
+
+
+def test_validation_rejects_prefix_on_history_source():
+    errors = validate_rules_doc([{
+        "name": "a", "kind": "threshold", "metric": "m", "bound": 1,
+        "source": "history", "match": "prefix",
+    }])
+    assert any("prefix" in e for e in errors)
+
+
+def test_load_rules_raises_with_path(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps({"alerts": [{"name": "x", "kind": "bogus"}]}))
+    with pytest.raises(ValueError, match="rules.json"):
+        load_rules(str(p))
+
+
+def test_example_rules_ship_valid():
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "alert_rules.json")
+    rules = load_rules(path)
+    kinds = {r.kind for r in rules}
+    assert kinds == {"threshold", "burn", "absence", "anomaly"}
+
+
+# ---------------------------------------------------- threshold + dedup
+
+
+def test_threshold_fires_once_and_resolves_once():
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = _mgr([_threshold()], reg, clock)
+    g.set(5.0)
+    mgr.evaluate()
+    assert not mgr.open_alerts()
+    g.set(25.0)
+    for _ in range(5):  # condition stays true: exactly one firing
+        clock.t += 1.0
+        mgr.evaluate()
+    fired = [r for r in mgr.recent if r["phase"] == "fired"]
+    assert len(fired) == 1
+    assert fired[0]["rule"] == "hot"
+    assert mgr.open_alerts() == [
+        {"rule": "hot", "id": 0, "severity": "warn", "labels": {}}
+    ]
+    # falling edge (last agg must leave the window-aggregated value low)
+    g.set(1.0)
+    clock.t += 1.0
+    mgr.evaluate()
+    resolved = [r for r in mgr.recent if r["phase"] == "resolved"]
+    assert len(resolved) == 1 and resolved[0]["id"] == 0
+    assert not mgr.open_alerts()
+    assert reg.scalars()["alerts_total.rule_hot.severity_warn"] == 1.0
+
+
+def test_threshold_prefix_sums_labeled_family():
+    reg, clock = Registry(), _Clock()
+    c = reg.counter("rpc_retries_total", "r")
+    c.inc(endpoint="a", outcome="ok")
+    c.inc(endpoint="b", outcome="ok")
+    mgr = _mgr([_threshold(metric="rpc_retries_total", bound=1.5,
+                           match="prefix")], reg, clock)
+    res = mgr.evaluate()
+    assert res[0]["condition"] is True
+    assert res[0]["value"] == 2.0
+
+
+def test_cooldown_gates_refire_but_not_resolve():
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    rule = _threshold(cooldown_s=60.0, agg="last")
+    mgr = _mgr([rule], reg, clock)
+    g.set(25.0)
+    mgr.evaluate()
+    g.set(1.0)
+    clock.t += 1
+    mgr.evaluate()  # resolves fine inside the cooldown
+    assert not mgr.open_alerts()
+    g.set(25.0)
+    clock.t += 1
+    res = mgr.evaluate()
+    assert res[0]["suppressed"] == "cooldown"
+    clock.t += 120  # past the cooldown (and the window: re-samples)
+    mgr.evaluate()
+    assert [r["phase"] for r in mgr.recent].count("fired") == 2
+
+
+def test_silence_expiry_mid_firing():
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = _mgr([_threshold()], reg, clock)
+    mgr.silence("hot", 30.0, reason="maintenance")
+    g.set(25.0)
+    res = mgr.evaluate()
+    assert res[0]["suppressed"] == "silenced"
+    assert not mgr.open_alerts()
+    clock.t += 10
+    assert mgr.evaluate()[0]["suppressed"] == "silenced"
+    clock.t += 25  # the silence expired while the condition held
+    mgr.evaluate()
+    assert mgr.open_alerts() and mgr.state()["silences"] == []
+
+
+def test_star_silence_covers_every_rule():
+    reg, clock = Registry(), _Clock()
+    reg.gauge("temp", "t").set(25.0)
+    mgr = _mgr([_threshold()], reg, clock)
+    mgr.silence("*", 30.0)
+    assert mgr.evaluate()[0]["suppressed"] == "silenced"
+
+
+# ------------------------------------------------------------- absence
+
+
+def test_absence_fires_on_wedged_counter_and_resolves_on_change():
+    reg, clock = Registry(), _Clock()
+    c = reg.counter("steps", "s")
+    rule = AlertRule.from_dict({
+        "name": "stalled", "kind": "absence", "metric": "steps",
+        "for_s": 10.0, "severity": "page", "cooldown_s": 0.0,
+    })
+    mgr = _mgr([rule], reg, clock)
+    for _ in range(5):  # advancing counter: healthy
+        c.inc()
+        clock.t += 3.0
+        mgr.evaluate()
+    assert not mgr.open_alerts()
+    clock.t += 11.0  # the counter wedges
+    mgr.evaluate()
+    assert mgr.open_alerts(severity="page")
+    c.inc()  # progress resumes
+    clock.t += 1.0
+    mgr.evaluate()
+    assert not mgr.open_alerts()
+    phases = [r["phase"] for r in mgr.recent]
+    assert phases == ["fired", "resolved"]
+
+
+def test_absence_fires_for_never_appeared_metric():
+    reg, clock = Registry(), _Clock()
+    rule = AlertRule.from_dict({
+        "name": "missing", "kind": "absence", "metric": "never_registered",
+        "for_s": 5.0,
+    })
+    mgr = _mgr([rule], reg, clock)
+    mgr.evaluate()
+    assert not mgr.open_alerts()
+    clock.t += 6.0
+    mgr.evaluate()
+    assert mgr.open_alerts()
+
+
+# ------------------------------------------------------------- anomaly
+
+
+def test_anomaly_fires_on_spike_not_during_warmup():
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("lat", "l")
+    rule = AlertRule.from_dict({
+        "name": "spike", "kind": "anomaly", "metric": "lat",
+        "z_threshold": 6.0, "min_history": 8, "window_s": 120.0,
+        "cooldown_s": 0.0,
+    })
+    mgr = _mgr([rule], reg, clock)
+    for i in range(12):  # noisy-but-stable baseline, no firing
+        g.set(1.0 + (i % 3) * 0.01)
+        clock.t += 1.0
+        res = mgr.evaluate()
+        assert res[0]["condition"] in (False, None)
+    g.set(50.0)
+    clock.t += 1.0
+    res = mgr.evaluate()
+    assert res[0]["condition"] is True
+    assert mgr.open_alerts()
+
+
+def test_anomaly_all_identical_values_no_fire():
+    # zero variance must not divide by zero or fire on equality
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("flat", "f")
+    rule = AlertRule.from_dict({
+        "name": "flat", "kind": "anomaly", "metric": "flat",
+        "min_history": 4, "window_s": 60.0,
+    })
+    mgr = _mgr([rule], reg, clock)
+    for _ in range(10):
+        g.set(3.0)
+        clock.t += 1.0
+        res = mgr.evaluate()
+    assert res[0]["condition"] is False
+    assert not mgr.open_alerts()
+
+
+# ---------------------------------------------------------------- burn
+
+
+def test_burn_delegates_to_live_slo_monitor():
+    from distributedtensorflow_tpu.obs.slo import SLOMonitor, SLORule
+
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("goodput_fraction", "g")
+    slo_rule = SLORule.from_dict({
+        "name": "goodput", "kind": "gauge_good_fraction",
+        "metric": "goodput_fraction", "objective": 0.9,
+        "fast_window_s": 30, "slow_window_s": 300,
+        "fast_burn": 2.0, "slow_burn": 1.5,
+    })
+    monitor = SLOMonitor([slo_rule], registry=reg, time_fn=clock)
+    rule = AlertRule.from_dict({
+        "name": "goodput_burn", "kind": "burn", "slo": "goodput",
+        "window": "fast", "severity": "page", "cooldown_s": 0.0,
+    })
+    mgr = _mgr([rule], reg, clock, slo_monitor=monitor)
+    g.set(0.95)  # above objective: burn < 1
+    for _ in range(3):
+        clock.t += 5.0
+        monitor.evaluate(now=clock.t)
+        mgr.evaluate()
+    assert not mgr.open_alerts()
+    g.set(0.0)  # burn = (1-0)/(1-0.9) = 10x > fast_burn
+    for _ in range(8):
+        clock.t += 5.0
+        monitor.evaluate(now=clock.t)
+        mgr.evaluate()
+    assert mgr.open_alerts(severity="page")
+    g.set(1.0)  # recovery drains the window
+    for _ in range(10):
+        clock.t += 5.0
+        monitor.evaluate(now=clock.t)
+        mgr.evaluate()
+    assert not mgr.open_alerts()
+    phases = [r["phase"] for r in mgr.recent]
+    assert phases == ["fired", "resolved"]
+
+
+def test_burn_without_monitor_is_no_data():
+    rule = AlertRule.from_dict(
+        {"name": "b", "kind": "burn", "slo": "nope"})
+    mgr = _mgr([rule])
+    res = mgr.evaluate()
+    assert res[0]["condition"] is None
+    assert not mgr.open_alerts()
+
+
+# --------------------------------------------------------- degenerates
+
+
+def test_unknown_metric_is_no_data_and_holds_state():
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = _mgr([_threshold()], reg, clock)
+    g.set(25.0)
+    mgr.evaluate()
+    assert mgr.open_alerts()
+    # the series disappears (fresh registry semantics): no data must HOLD
+    # the open alert, not flap it closed
+    del reg  # noqa: F841 — the manager keeps its own reference
+    mgr._reg = Registry()
+    clock.t += 5.0
+    res = mgr.evaluate()
+    assert res[0]["condition"] is None
+    assert mgr.open_alerts()
+
+
+def test_empty_history_store_is_no_data():
+    from distributedtensorflow_tpu.obs.tsdb import MetricsHistory
+
+    reg, clock = Registry(), _Clock()
+    hist = MetricsHistory(registry=reg, time_fn=clock)
+    rule = _threshold(metric="nothing_sampled", source="history")
+    mgr = _mgr([rule], reg, clock, history=hist)
+    res = mgr.evaluate()
+    assert res[0]["condition"] is None
+    assert res[0]["reason"] in ("no data", "no data in window")
+
+
+def test_nan_series_is_no_data_never_crashes():
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = _mgr([_threshold()], reg, clock)
+    for _ in range(4):
+        g.set(float("nan"))
+        clock.t += 1.0
+        res = mgr.evaluate()
+        assert res[0]["condition"] is None
+    assert not mgr.open_alerts()
+
+
+def test_background_thread_survives_degenerate_rules(tmp_path):
+    # the real acceptance: a pathological rule set on the REAL thread
+    reg = Registry()
+    rules = [
+        _threshold(metric="never_there"),
+        AlertRule.from_dict({"name": "a", "kind": "anomaly",
+                             "metric": "also_missing"}),
+        AlertRule.from_dict({"name": "b", "kind": "burn", "slo": "x"}),
+    ]
+    mgr = AlertManager(rules, registry=reg, interval_s=0.05,
+                       logdir=str(tmp_path), sinks=[], record_flight=False)
+    with mgr:
+        import time as _t
+
+        _t.sleep(0.3)
+        assert mgr._thread.is_alive()
+    assert mgr._thread is None  # clean join
+
+
+# ------------------------------------------------------------ webhooks
+
+
+class _Hook(BaseHTTPRequestHandler):
+    rows: list = []
+    fail_first = 0
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if _Hook.fail_first > 0:
+            _Hook.fail_first -= 1
+            self.send_response(500)
+            self.end_headers()
+            return
+        _Hook.rows.append(json.loads(body))
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"ok")
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def webhook():
+    _Hook.rows, _Hook.fail_first = [], 0
+    srv = HTTPServer(("127.0.0.1", 0), _Hook)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}/alerts"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_webhook_sink_delivers_and_retries_5xx(webhook):
+    _Hook.fail_first = 1  # first attempt 500s; the retry must land it
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = _mgr([_threshold()], reg, clock,
+               sinks=[make_webhook_sink(webhook)])
+    g.set(25.0)
+    mgr.evaluate()
+    assert len(_Hook.rows) == 1
+    row = _Hook.rows[0]
+    assert row["rule"] == "hot" and row["phase"] == "fired"
+    assert sum(v for k, v in reg.scalars().items()
+               if k.startswith("alert_sink_errors_total")) == 0
+
+
+def test_webhook_dead_port_gives_up_without_wedging():
+    # a port nothing listens on: the sink must fail fast (connection
+    # refused beats the deadline), count the error, and leave the alert
+    # row written
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    url = f"http://127.0.0.1:{dead_port}/alerts"
+    mgr = _mgr([_threshold()], reg, clock,
+               sinks=[make_webhook_sink(url, deadline_s=0.5)])
+    g.set(25.0)
+    import time as _t
+
+    t0 = _t.monotonic()
+    mgr.evaluate()
+    assert _t.monotonic() - t0 < 5.0  # bounded, not wedged
+    assert mgr.open_alerts()  # the alert itself still fired
+    errs = [v for k, v in reg.scalars().items()
+            if k.startswith("alert_sink_errors_total")]
+    assert errs and errs[0] >= 1.0
+
+
+# ----------------------------------------------- artifacts + checker
+
+
+def test_alerts_jsonl_schema_clean(tmp_path):
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = AlertManager([_threshold()], registry=reg, time_fn=clock,
+                       sinks=[], record_flight=False,
+                       logdir=str(tmp_path))
+    g.set(25.0)
+    mgr.evaluate()
+    g.set(1.0)
+    clock.t += 1.0
+    mgr.evaluate()
+    mgr.stop()
+    path = str(tmp_path / "alerts.jsonl")
+    problems, _warnings = checker.check_file(path)
+    assert problems == [], problems
+    rows = [json.loads(line) for line in open(path)]
+    assert [r["phase"] for r in rows] == ["fired", "resolved"]
+
+
+def test_checker_flags_bad_alert_rows(tmp_path):
+    path = tmp_path / "alerts.jsonl"
+    path.write_text(json.dumps({
+        "t": 1.0, "id": 0, "rule": "r", "kind": "nope",
+        "severity": "warn", "phase": "fired", "labels": {},
+    }) + "\n" + json.dumps({
+        "t": 0.5, "id": 1, "rule": "r", "kind": "threshold",
+        "severity": "warn", "phase": "fired", "labels": {},
+    }) + "\n")
+    problems, _ = checker.check_file(str(path))
+    assert any("kind" in p for p in problems)
+    assert any("non-decreasing" in p or "t" in p for p in problems)
+
+
+def test_checker_flags_dedup_violation(tmp_path):
+    path = tmp_path / "alerts.jsonl"
+    row = {"t": 1.0, "id": 0, "rule": "r", "kind": "threshold",
+           "severity": "warn", "phase": "fired", "labels": {}}
+    row2 = dict(row, id=1, t=2.0)  # second fire with no resolve between
+    path.write_text(json.dumps(row) + "\n" + json.dumps(row2) + "\n")
+    problems, _ = checker.check_file(str(path))
+    assert any("already open" in p or "dedup" in p for p in problems)
+
+
+def test_incident_bundle_written_and_schema_clean(tmp_path):
+    from distributedtensorflow_tpu.obs.tsdb import MetricsHistory
+
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    hist = MetricsHistory(registry=reg, time_fn=clock)
+    g.set(25.0)
+    hist.tick(now=clock.t)
+    mgr = AlertManager(
+        [_threshold(severity="page")], registry=reg, time_fn=clock,
+        sinks=[], logdir=str(tmp_path), history=hist,
+        step_records_fn=lambda n=None: [{"t": clock.t, "step": 1}],
+    )
+    mgr.evaluate()
+    mgr.stop()
+    incidents = sorted((tmp_path / "incidents").iterdir())
+    assert len(incidents) == 1
+    assert incidents[0].name == "0000-hot"
+    manifest = json.loads((incidents[0] / "manifest.json").read_text())
+    assert manifest["rule"] == "hot" and manifest["severity"] == "page"
+    for name in manifest["files"]:
+        assert (incidents[0] / name).exists()
+    assert "varz.prom" in manifest["files"]
+    assert "threads.txt" in manifest["files"]
+    assert "steps.json" in manifest["files"]
+    problems, _ = checker.check_file(str(incidents[0] / "manifest.json"))
+    assert problems == [], problems
+
+
+def test_incident_budget_caps_bundles(tmp_path):
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = AlertManager([_threshold()], registry=reg, time_fn=clock,
+                       sinks=[], logdir=str(tmp_path), max_incidents=2)
+    for i in range(5):  # flap: fire, resolve, fire, ...
+        g.set(25.0)
+        clock.t += 40.0
+        mgr.evaluate()
+        g.set(1.0)
+        clock.t += 40.0
+        mgr.evaluate()
+    assert len(list((tmp_path / "incidents").iterdir())) == 2
+    mgr.stop()
+
+
+# ----------------------------------------------------- /alertz + deep
+
+
+def _get(port, path, timeout=10):
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_alertz_endpoint_and_deep_health():
+    reg, clock = Registry(), _Clock()
+    g = reg.gauge("temp", "t")
+    mgr = _mgr([_threshold(severity="page")], reg, clock)
+    srv = StatusServer(0, host="127.0.0.1", registry=reg,
+                       health_fn=lambda: {"ok": True}).start()
+    try:
+        mgr.install(srv)
+        srv.deep_health_fn = compose_deep_health(
+            {"alerts": mgr.health_component})
+        status, body = _get(srv.port, "/alertz")
+        assert status == 200 and "hot" in body
+        status, body = _get(srv.port, "/alertz?json")
+        assert status == 200
+        assert json.loads(body)["open"] == []
+        # shallow health ignores alerts; deep fails on the open page
+        g.set(25.0)
+        mgr.evaluate()
+        status, _ = _get(srv.port, "/healthz")
+        assert status == 200
+        status, body = _get(srv.port, "/healthz?deep=1")
+        assert status == 503
+        payload = json.loads(body)
+        assert payload["deep"] is True and payload["failing"] == ["alerts"]
+        assert payload["components"]["alerts"]["ok"] is False
+        g.set(1.0)
+        clock.t += 1.0
+        mgr.evaluate()
+        status, body = _get(srv.port, "/healthz?deep=1")
+        assert status == 200 and json.loads(body)["ok"] is True
+    finally:
+        srv.stop()
+
+
+def test_deep_health_probe_exception_names_itself():
+    def bad():
+        raise RuntimeError("boom")
+
+    verdict = compose_deep_health({"good": lambda: (True, {}),
+                                   "bad": bad})()
+    assert verdict["ok"] is False
+    assert verdict["failing"] == ["bad"]
+    assert "boom" in verdict["components"]["bad"]["error"]
+
+
+def test_health_component_helpers():
+    from distributedtensorflow_tpu.obs.alerts import (
+        engine_health_component,
+        fleet_health_component,
+        slo_health_component,
+    )
+
+    class _Slo:
+        def state(self):
+            return {"rules": [{"name": "a", "violating_fast": True}]}
+
+    ok, detail = slo_health_component(_Slo())()
+    assert ok is False and detail["fast_burning"] == ["a"]
+
+    class _Fleet:
+        def view(self):
+            return {"peers": {"w0": {"state": "up"},
+                              "w1": {"state": "down"}}}
+
+    ok, detail = fleet_health_component(_Fleet())()
+    assert ok is False and detail["down_peers"] == ["w1"]
+
+    clock = _Clock()
+
+    class _Engine:
+        def state(self):
+            return {"queue_depth": 3, "active_slots": 1}
+
+        def step_records(self, n=None):
+            return [{"t": clock.t - 100.0}]
+
+    class _Srv:
+        draining = False
+
+    ok, detail = engine_health_component(
+        _Engine(), _Srv(), stall_after_s=30.0, time_fn=clock)()
+    assert ok is False and detail["stalled"] is True
+
+
+# ------------------------------------------------------ offline replay
+
+
+def test_offline_recompute_matches_live_lockstep():
+    rules = [
+        _threshold(agg="last"),
+        AlertRule.from_dict({"name": "stall", "kind": "absence",
+                             "metric": "steps", "for_s": 6.0,
+                             "source": "history", "cooldown_s": 0.0}),
+    ]
+    # synthesize history rows: temp spikes mid-run, steps wedge at the end
+    rows = []
+    steps = 0
+    for i in range(30):
+        t = 1000.0 + i * 2.0
+        temp = 25.0 if 10 <= i < 16 else 1.0
+        if i < 20:
+            steps += 1
+        rows.append({"t": t, "values": {"temp": temp,
+                                        "steps": float(steps)}})
+
+    # live: a manager fed the same values at the same times
+    live = _mgr(rules, clock=_Clock())
+    for row in rows:
+        live.evaluate(now=row["t"], values=row["values"])
+    live_rows = [(r["rule"], r["phase"], r["t"]) for r in live.recent]
+
+    replay = recompute_from_history(rules, rows)
+    replay_rows = [(r["rule"], r["phase"], r["t"]) for r in replay]
+    assert live_rows == replay_rows
+    assert any(r[0] == "hot" and r[1] == "fired" for r in live_rows)
+    assert any(r[0] == "stall" and r[1] == "fired" for r in live_rows)
+
+
+def test_offline_recompute_burn_rules():
+    slo_rules = [{
+        "name": "lat", "kind": "gauge_good_fraction",
+        "metric": "good_frac", "objective": 0.9,
+        "fast_window_s": 10, "slow_window_s": 100,
+        "fast_burn": 2.0, "slow_burn": 1.5,
+    }]
+    rules = [AlertRule.from_dict({"name": "lat_burn", "kind": "burn",
+                                  "slo": "lat", "window": "fast",
+                                  "cooldown_s": 0.0})]
+    rows = []
+    for i in range(20):
+        good = 0.0 if 8 <= i < 12 else 1.0
+        rows.append({"t": 1000.0 + i * 2.0,
+                     "values": {"slo_good.lat": good}})
+    fired = [r for r in recompute_from_history(rules, rows,
+                                               slo_rules=slo_rules)
+             if r["phase"] == "fired"]
+    assert len(fired) == 1 and fired[0]["rule"] == "lat_burn"
+
+
+# ------------------------------------------------- registry guard
+
+
+def test_registry_label_cardinality_guard():
+    reg = Registry(max_label_sets=4)
+    c = reg.counter("chatty_total", "c")
+    for i in range(10):
+        c.inc(peer=f"p{i}")
+    scalars = reg.scalars()
+    kept = [k for k in scalars if k.startswith("chatty_total.")]
+    assert len(kept) == 4  # new series past the cap were dropped
+    # existing series keep updating through the cap
+    c.inc(peer="p0")
+    assert reg.scalars()["chatty_total.peer_p0"] == 2.0
+    assert scalars["registry_dropped_series_total.metric_chatty_total"] == 6.0
+
+
+def test_registry_guard_histogram_and_gauge():
+    reg = Registry(max_label_sets=2)
+    g = reg.gauge("g", "g")
+    h = reg.histogram("h", "h", buckets=(1.0,))
+    for i in range(5):
+        g.set(1.0, shard=str(i))
+        h.observe(0.5, shard=str(i))
+    assert g.dropped_series == 3
+    assert h.dropped_series == 3
+    drops = reg.scalars()
+    assert drops["registry_dropped_series_total.metric_g"] == 3.0
+    assert drops["registry_dropped_series_total.metric_h"] == 3.0
+
+
+def test_registry_guard_default_cap_is_documented_constant():
+    from distributedtensorflow_tpu.obs.registry import (
+        DEFAULT_MAX_LABEL_SETS,
+    )
+
+    assert DEFAULT_MAX_LABEL_SETS == 1024
+    reg = Registry()
+    assert reg.counter("x_total", "x").max_label_sets == 1024
+
+
+# ------------------------------------------------------- fleet source
+
+
+def test_fleet_source_rule_reads_composed_stat():
+    rule = AlertRule.from_dict({
+        "name": "fleet_low", "kind": "threshold", "source": "fleet",
+        "metric": "goodput_fraction", "stat": "min", "op": "lt",
+        "bound": 0.5, "window_s": 30.0, "cooldown_s": 0.0,
+    })
+    mgr = _mgr([rule], clock=_Clock())
+    res = mgr.evaluate(values={"fleet.goodput_fraction.min": 0.2})
+    assert res[0]["condition"] is True
+    assert mgr.open_alerts()

@@ -1,0 +1,193 @@
+"""tools/doctor.py: offline cross-stream root-cause correlation.
+
+Synthesizes a logdir the way a chaos run would leave it — faults.jsonl,
+alerts.jsonl, flight.jsonl, steps.jsonl, history.jsonl all sharing one
+unix clock — and checks that the injected fault ranks as the top
+hypothesis with citations from every stream that saw damage.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import doctor  # noqa: E402
+
+T0 = 1700000000.0
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def _chaos_logdir(tmp_path, name="run"):
+    """A data_stall injected at T0+10, recovered at T0+14; the stall
+    trips an absence alert, a step gap, and rpc retry growth."""
+    d = tmp_path / name
+    d.mkdir()
+    _write_jsonl(d / "faults.jsonl", [
+        {"t": T0 + 10.0, "kind": "data_stall", "phase": "injected",
+         "id": 0, "step": 40},
+        {"t": T0 + 14.0, "kind": "data_stall", "phase": "recovered",
+         "id": 0, "step": 40},
+    ])
+    _write_jsonl(d / "alerts.jsonl", [
+        {"t": T0 + 13.0, "id": 1, "rule": "training_stalled",
+         "kind": "absence", "severity": "page", "phase": "fired",
+         "labels": {}, "value": None, "reason": "no increase in 3.0s"},
+        {"t": T0 + 20.0, "id": 1, "rule": "training_stalled",
+         "kind": "absence", "severity": "page", "phase": "resolved",
+         "labels": {}, "value": 41.0, "reason": "recovered"},
+    ])
+    _write_jsonl(d / "flight.jsonl", [
+        {"t": T0 + 12.0, "kind": "anomaly", "detail": {"metric": "loss"}},
+    ])
+    # steady 1s step cadence up to the injection, then a 6.5s gap
+    step_ts = [T0 + i for i in range(11)] + [T0 + 16.5, T0 + 17.5]
+    _write_jsonl(d / "steps.jsonl",
+                 [{"t": t, "step": i} for i, t in enumerate(step_ts)])
+    _write_jsonl(d / "history.jsonl", [
+        {"t": T0 + 8.0, "values": {"rpc_retries_total": 0.0}},
+        {"t": T0 + 11.0, "values": {"rpc_retries_total": 0.0}},
+        {"t": T0 + 13.0, "values": {"rpc_retries_total": 4.0}},
+    ])
+    return d
+
+
+def test_injected_fault_ranks_top(tmp_path):
+    d = _chaos_logdir(tmp_path)
+    problems = []
+    report = doctor.diagnose([str(d)], problems=problems)
+    assert problems == []
+    assert report["parse_problems"] == []
+    hyps = report["hypotheses"]
+    assert hyps, "chaos logdir must produce hypotheses"
+    top = hyps[0]
+    assert top["rank"] == 1
+    assert top["kind"] == "fault_injection"
+    assert top["fault_kind"] == "data_stall"
+    # the kind-matched absence firing, the anomaly event, the step
+    # stall and the rpc retry growth must all be cited
+    streams_cited = {e["stream"] for e in top["evidence"]}
+    assert {"faults.jsonl", "alerts.jsonl", "flight.jsonl",
+            "steps.jsonl", "history.jsonl"} <= streams_cited
+    assert any("kind-matched" in e["detail"] for e in top["evidence"])
+    # firings inside the fault window never spawn an "unexplained" twin
+    assert not [h for h in hyps if h["kind"] == "unexplained_alert"]
+
+
+def test_kind_matched_alert_outscores_incidental():
+    assert "absence" in doctor.FAULT_EXPECTED_ALERTS["data_stall"]
+    assert "threshold" in doctor.FAULT_EXPECTED_ALERTS["net_sever"]
+
+
+def test_uncovered_alert_becomes_unexplained_hypothesis(tmp_path):
+    d = tmp_path / "bare"
+    d.mkdir()
+    _write_jsonl(d / "alerts.jsonl", [
+        {"t": T0 + 5.0, "id": 1, "rule": "training_stalled",
+         "kind": "absence", "severity": "page", "phase": "fired",
+         "labels": {}, "value": None, "reason": "no increase"},
+    ])
+    report = doctor.diagnose([str(d)])
+    kinds = [h["kind"] for h in report["hypotheses"]]
+    assert kinds == ["unexplained_alert"]
+    assert "wedged engine" in report["hypotheses"][0]["cause"]
+
+
+def test_breaker_open_without_fault_is_a_cause(tmp_path):
+    d = tmp_path / "net"
+    d.mkdir()
+    _write_jsonl(d / "history.jsonl", [
+        {"t": T0, "values": {"breaker_state.peer_p1": 0.0}},
+        {"t": T0 + 5.0, "values": {"breaker_state.peer_p1": 2.0,
+                                   "rpc_retries_total.peer_p1": 3.0}},
+        {"t": T0 + 9.0, "values": {"breaker_state.peer_p1": 2.0,
+                                   "rpc_retries_total.peer_p1": 9.0}},
+    ])
+    report = doctor.diagnose([str(d)])
+    hyps = report["hypotheses"]
+    assert len(hyps) == 1
+    assert hyps[0]["kind"] == "breaker_open"
+    assert "breaker_state.peer_p1" in hyps[0]["cause"]
+    assert any("rpc_retries_total" in e["detail"]
+               for e in hyps[0]["evidence"])
+
+
+def test_healthy_run_yields_no_hypotheses(tmp_path):
+    d = tmp_path / "healthy"
+    d.mkdir()
+    _write_jsonl(d / "steps.jsonl",
+                 [{"t": T0 + i, "step": i} for i in range(10)])
+    report = doctor.diagnose([str(d)])
+    assert report["hypotheses"] == []
+    assert report["streams"] == 1
+    out = doctor.render(report)
+    assert "looks healthy" in out
+
+
+def test_empty_logdir_spans_zero(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    report = doctor.diagnose([str(d)])
+    assert report["hypotheses"] == []
+    assert report["span_s"] == 0.0
+    assert report["streams"] == 0
+
+
+def test_corrupt_stream_fails_loudly(tmp_path, capsys):
+    d = _chaos_logdir(tmp_path)
+    with open(d / "alerts.jsonl", "a") as f:
+        f.write("{truncated\n")
+    problems = []
+    report = doctor.diagnose([str(d)], problems=problems)
+    assert problems and "invalid JSON" in problems[0]
+    # the valid rows before the corruption still contribute evidence
+    assert report["hypotheses"]
+    assert doctor.main([str(d)]) == 1
+    assert "PARSE ERROR" in capsys.readouterr().out
+
+
+def test_main_json_mode(tmp_path, capsys):
+    d = _chaos_logdir(tmp_path)
+    assert doctor.main([str(d), "--json", "--window", "30"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["window_s"] == 30.0
+    assert report["hypotheses"][0]["fault_kind"] == "data_stall"
+
+
+def test_main_rejects_missing_dir(tmp_path, capsys):
+    assert doctor.main([str(tmp_path / "nope")]) == 1
+    assert "not a directory" in capsys.readouterr().err
+
+
+def test_multi_logdir_labels_causes(tmp_path):
+    a = _chaos_logdir(tmp_path, "run-a")
+    b = tmp_path / "run-b"
+    b.mkdir()
+    _write_jsonl(b / "steps.jsonl",
+                 [{"t": T0 + i, "step": i} for i in range(5)])
+    report = doctor.diagnose([str(a), str(b)])
+    assert report["hypotheses"][0]["cause"].endswith("[run-a]")
+
+
+def test_step_stall_detection_needs_real_gap():
+    problems = []
+
+    class _S(doctor.Streams):
+        def __init__(self, steps):
+            self.steps = steps
+
+    even = _S([{"t": T0 + i} for i in range(10)])
+    assert even.step_stalls() == []
+    gappy = _S([{"t": T0 + i} for i in range(5)]
+               + [{"t": T0 + 30.0}, {"t": T0 + 31.0}])
+    stalls = gappy.step_stalls()
+    assert len(stalls) == 1 and stalls[0]["gap_s"] == pytest.approx(26.0)
+    assert problems == []

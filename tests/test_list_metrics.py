@@ -1,0 +1,107 @@
+"""tools/list_metrics.py: metric inventory + docs cross-check.
+
+The fast-lane drift gate: every import-time metric family must be named
+in docs/API.md or docs/OBSERVABILITY.md, so a rename in code fails here
+before it blanks a dashboard.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import list_metrics  # noqa: E402
+
+PROM_SNAPSHOT = """\
+# HELP train_steps_total steps
+# TYPE train_steps_total counter
+train_steps_total 42
+# TYPE rpc_retries_total counter
+rpc_retries_total{peer="p0"} 3
+rpc_retries_total{peer="p1"} 1
+# TYPE serve_ttft_seconds histogram
+serve_ttft_seconds_bucket{le="0.1"} 5
+serve_ttft_seconds_bucket{le="+Inf"} 9
+serve_ttft_seconds_sum 1.25
+serve_ttft_seconds_count 9
+"""
+
+
+def test_live_inventory_is_documented(capsys):
+    """The shipped docs must name every import-time family — the actual
+    drift gate this tool exists for."""
+    assert list_metrics.main([]) == 0
+    out = capsys.readouterr()
+    assert "metric families" in out.out
+    assert "UNDOCUMENTED" not in out.err
+
+
+def test_live_inventory_names_and_types():
+    inv = list_metrics.registry_inventory()
+    names = {m["name"] for m in inv}
+    # families created at import time by the net/coordinator planes
+    assert "rpc_retries_total" in names
+    assert "breaker_state" in names
+    assert "goodput_fraction" in names
+    for m in inv:
+        assert m["type"] in ("counter", "gauge", "histogram")
+        assert m["label_keys"] == sorted(m["label_keys"])
+
+
+def test_prom_inventory_parses_snapshot(tmp_path):
+    p = tmp_path / "metrics.prom"
+    p.write_text(PROM_SNAPSHOT)
+    inv = list_metrics.prom_inventory(str(p))
+    by_name = {m["name"]: m for m in inv}
+    assert by_name["train_steps_total"]["type"] == "counter"
+    assert by_name["rpc_retries_total"]["label_keys"] == ["peer"]
+    # histogram samples fold back into one family; "le" is not a label
+    assert by_name["serve_ttft_seconds"]["type"] == "histogram"
+    assert by_name["serve_ttft_seconds"]["label_keys"] == []
+    assert "serve_ttft_seconds_bucket" not in by_name
+
+
+def test_undocumented_name_fails(tmp_path, capsys):
+    prom = tmp_path / "metrics.prom"
+    prom.write_text("# TYPE brand_new_metric_total counter\n"
+                    "brand_new_metric_total 1\n")
+    docs = tmp_path / "DOCS.md"
+    docs.write_text("nothing relevant here\n")
+    assert list_metrics.main(
+        ["--prom", str(prom), "--docs", str(docs)]) == 1
+    err = capsys.readouterr().err
+    assert "UNDOCUMENTED: brand_new_metric_total" in err
+
+
+def test_missing_doc_file_fails(tmp_path, capsys):
+    prom = tmp_path / "metrics.prom"
+    prom.write_text("# TYPE x_total counter\nx_total 1\n")
+    assert list_metrics.main(
+        ["--prom", str(prom),
+         "--docs", str(tmp_path / "absent.md")]) == 1
+    assert "MISSING DOC FILE" in capsys.readouterr().err
+
+
+def test_no_check_skips_docs_gate(tmp_path, capsys):
+    prom = tmp_path / "metrics.prom"
+    prom.write_text("# TYPE undocumented_total counter\n"
+                    "undocumented_total 1\n")
+    assert list_metrics.main(["--prom", str(prom), "--no-check",
+                              "--docs", str(tmp_path / "absent.md")]) == 0
+
+
+def test_json_mode(tmp_path, capsys):
+    prom = tmp_path / "metrics.prom"
+    prom.write_text(PROM_SNAPSHOT)
+    docs = tmp_path / "DOCS.md"
+    docs.write_text("train_steps_total rpc_retries_total "
+                    "serve_ttft_seconds\n")
+    assert list_metrics.main(
+        ["--prom", str(prom), "--docs", str(docs), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["undocumented"] == []
+    assert report["missing_docs"] == []
+    assert {m["name"] for m in report["metrics"]} == {
+        "train_steps_total", "rpc_retries_total", "serve_ttft_seconds"}

@@ -116,6 +116,26 @@ def test_series_cap_drops_new_names_not_memory():
     assert st["series_dropped"] == 2
 
 
+def test_pinned_series_survive_the_cap():
+    """A pinned name is admitted even when unpinned cardinality would
+    have filled the cap first — but the total never exceeds max_series."""
+    reg = Registry()
+    for i in range(4):
+        reg.gauge(f"g{i}").set(float(i))
+    hist = MetricsHistory(registry=reg, max_series=3, time_fn=_Clock())
+    hist.pin(["late_watched"])
+    kept = hist.tick()
+    # one slot stayed reserved: only 2 of the 4 g* series got in
+    assert len(kept) == 2
+    # the watched series appears later (e.g. first increment mid-run)
+    reg.gauge("late_watched").set(7.0)
+    kept = hist.tick()
+    assert kept["late_watched"] == 7.0
+    st = hist.state()
+    assert st["series"] == 3  # the cap still holds
+    assert st["series_pinned"] == 1
+
+
 def test_query_windows_and_latest():
     reg = Registry()
     g = reg.gauge("load")

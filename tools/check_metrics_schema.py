@@ -25,7 +25,14 @@ non-decreasing ``t``, known phase tokens, non-negative counts, phase
 wall split tiling ``step_s``); basenames starting with ``history``
 against the metrics-history tick schema (obs/tsdb.py: non-decreasing
 ``t``, well-formed metric names mapping to finite numbers, cardinality
-bounded by :data:`HISTORY_MAX_SERIES`);
+bounded by :data:`HISTORY_MAX_SERIES`); basenames starting with
+``alerts`` against the alert-stream schema (``obs/alerts.py``:
+non-decreasing ``t``, known kinds/severities/phases, every ``resolved``
+row pairing an earlier ``fired`` id of the same rule, and the dedup
+invariant — never two open alerts per (rule, labels)); files named
+``manifest.json`` under an ``incidents/`` directory against the
+incident evidence-bundle manifest schema (required keys, known
+severity/kind, every listed evidence file present in the bundle);
 basenames
 starting with ``flash_blocks`` against the flash-attention autotune cache
 schema (ops/flash_tuning.py: version 1, entries with platform/dtype/
@@ -200,6 +207,12 @@ DEFAULT_TIMELINE_GLOB = os.path.join(
 DEFAULT_JOURNAL_GLOB = os.path.join(
     REPO, "ARTIFACTS", "*", "dispatcher*.journal"
 )
+DEFAULT_ALERTS_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "alerts*.jsonl"
+)
+DEFAULT_INCIDENT_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "incidents", "*", "manifest.json"
+)
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
 #: duplicated: this tool is stdlib-only and must run anywhere logs land).
@@ -213,7 +226,7 @@ GOODPUT_BUCKETS = (
 #: for the same stdlib-only reason).
 CAPTURE_TRIGGERS = (
     "static", "manual", "step_time_regression", "straggler_spread",
-    "slo_burn",
+    "slo_burn", "alert",
 )
 
 #: The known chaos fault kinds (resilience/chaos.py FAULT_KINDS —
@@ -231,10 +244,16 @@ FAULT_PHASES = ("injected", "recovered")
 #: "<prefix>" or "<prefix>:<detail>"; the prefix names the transport.
 RPC_ENDPOINT_PREFIXES = (
     "dispatcher", "data_worker", "mpmd_link", "fleet_peer", "serve",
-    "peer",
+    "peer", "webhook",
 )
 RPC_RETRY_OUTCOMES = ("ok", "error")
 BREAKER_TO_STATES = ("closed", "half_open", "open")
+
+#: Alert-stream vocabularies (obs/alerts.py — duplicated for the same
+#: stdlib-only reason).
+ALERT_KINDS = ("threshold", "burn", "absence", "anomaly")
+ALERT_SEVERITIES = ("info", "warn", "page")
+ALERT_PHASES = ("fired", "resolved")
 
 #: Dispatcher journal record kinds (data/service.py JOURNAL_KINDS —
 #: duplicated for the same stdlib-only reason).
@@ -1770,6 +1789,151 @@ def check_goodput_doc(doc) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
+def check_alerts_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate an ``alerts.jsonl`` stream (obs/alerts.py AlertManager):
+    rows t-ordered, known kinds/severities/phases, every ``resolved`` row
+    pairing an earlier ``fired`` id of the same rule, and the dedup
+    invariant — never two OPEN alerts for one (rule, labels) key."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    prev_t: float | None = None
+    prev_fired_id: int | None = None
+    # alert id -> (rule, labels_key) for open (fired, unresolved) alerts
+    open_by_id: dict = {}
+    open_keys: set = set()
+    required = ("t", "id", "rule", "kind", "severity", "phase", "labels")
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: invalid JSON ({e})")
+                continue
+            if not isinstance(row, dict):
+                errors.append(f"line {i}: row is not an object")
+                continue
+            missing = [k for k in required if k not in row]
+            if missing:
+                errors.append(f"line {i}: missing keys {missing}")
+                continue
+            t = row["t"]
+            if not isinstance(t, (int, float)) or isinstance(t, bool) \
+                    or not math.isfinite(t):
+                errors.append(f"line {i}: 't' {t!r} is not a finite number")
+            elif prev_t is not None and t < prev_t:
+                errors.append(
+                    f"line {i}: 't' went backwards ({t} < {prev_t})")
+            else:
+                prev_t = float(t)
+            aid = row["id"]
+            if isinstance(aid, bool) or not isinstance(aid, int) or aid < 0:
+                errors.append(f"line {i}: 'id' {aid!r} is not a "
+                              "non-negative int")
+                continue
+            if row["kind"] not in ALERT_KINDS:
+                errors.append(f"line {i}: unknown kind {row['kind']!r} "
+                              f"(known: {ALERT_KINDS})")
+            if row["severity"] not in ALERT_SEVERITIES:
+                errors.append(
+                    f"line {i}: unknown severity {row['severity']!r} "
+                    f"(known: {ALERT_SEVERITIES})")
+            labels = row["labels"]
+            if not isinstance(labels, dict):
+                errors.append(f"line {i}: 'labels' is not an object")
+                labels = {}
+            key = (str(row["rule"]),
+                   tuple(sorted((str(k), str(v))
+                                for k, v in labels.items())))
+            phase = row["phase"]
+            if phase == "fired":
+                if prev_fired_id is not None and aid <= prev_fired_id:
+                    errors.append(
+                        f"line {i}: fired id {aid} not increasing "
+                        f"(previous fired id {prev_fired_id})")
+                prev_fired_id = aid
+                if key in open_keys:
+                    errors.append(
+                        f"line {i}: duplicate OPEN alert for rule "
+                        f"{row['rule']!r} labels {dict(labels)!r} "
+                        "(dedup invariant)")
+                else:
+                    open_keys.add(key)
+                    open_by_id[aid] = key
+            elif phase == "resolved":
+                if aid not in open_by_id:
+                    errors.append(
+                        f"line {i}: resolved id {aid} has no earlier "
+                        "unresolved 'fired' row")
+                else:
+                    fired_key = open_by_id.pop(aid)
+                    open_keys.discard(fired_key)
+                    if fired_key[0] != str(row["rule"]):
+                        errors.append(
+                            f"line {i}: resolved id {aid} names rule "
+                            f"{row['rule']!r} but fired under "
+                            f"{fired_key[0]!r}")
+            else:
+                errors.append(f"line {i}: unknown phase {phase!r} "
+                              f"(known: {ALERT_PHASES})")
+    if open_by_id:
+        warnings.append(
+            f"{len(open_by_id)} alert(s) still open at end of stream "
+            f"(ids {sorted(open_by_id)}) — fine for a live file, "
+            "suspicious for a finished run")
+    return errors, warnings
+
+
+def check_incident_manifest(path: str) -> tuple[list[str], list[str]]:
+    """Validate an incident evidence-bundle ``manifest.json``
+    (obs/alerts.py ``_write_incident``): required keys, known
+    severity/kind, and every listed evidence file present next to it."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    try:
+        doc = _load_json_doc(path)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"invalid JSON ({e})"], []
+    if not isinstance(doc, dict):
+        return ["manifest is not an object"], []
+    required = ("id", "t", "rule", "kind", "severity", "labels", "files")
+    missing = [k for k in required if k not in doc]
+    if missing:
+        return [f"missing keys {missing}"], []
+    aid = doc["id"]
+    if isinstance(aid, bool) or not isinstance(aid, int) or aid < 0:
+        errors.append(f"'id' {aid!r} is not a non-negative int")
+    t = doc["t"]
+    if not isinstance(t, (int, float)) or isinstance(t, bool) \
+            or not math.isfinite(t):
+        errors.append(f"'t' {t!r} is not a finite number")
+    if doc["kind"] not in ALERT_KINDS:
+        errors.append(f"unknown kind {doc['kind']!r} (known: {ALERT_KINDS})")
+    if doc["severity"] not in ALERT_SEVERITIES:
+        errors.append(f"unknown severity {doc['severity']!r} "
+                      f"(known: {ALERT_SEVERITIES})")
+    if not isinstance(doc["labels"], dict):
+        errors.append("'labels' is not an object")
+    files = doc["files"]
+    if not isinstance(files, list) or not all(
+            isinstance(f, str) for f in files):
+        errors.append("'files' is not a list of file names")
+    else:
+        bundle_dir = os.path.dirname(os.path.abspath(path))
+        for name in files:
+            if os.path.basename(name) != name:
+                errors.append(f"evidence file {name!r} is not a bare "
+                              "file name")
+            elif not os.path.exists(os.path.join(bundle_dir, name)):
+                errors.append(f"evidence file {name!r} listed in the "
+                              "manifest is missing from the bundle")
+        if not files:
+            warnings.append("bundle lists no evidence files")
+    return errors, warnings
+
+
 def _load_json_doc(path: str):
     with open(path) as f:
         return json.load(f)
@@ -1815,6 +1979,11 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         return check_steps_file(path)
     if os.path.basename(path).startswith("history"):
         return check_history_file(path)
+    if os.path.basename(path).startswith("alerts"):
+        return check_alerts_file(path)
+    if os.path.basename(path) == "manifest.json" \
+            and "incidents" in os.path.abspath(path).split(os.sep):
+        return check_incident_manifest(path)
     flight = os.path.basename(path).startswith("flight")
     captures = os.path.basename(path).startswith("captures")
     manifest_dir = os.path.dirname(os.path.abspath(path))
@@ -1854,6 +2023,8 @@ def main(argv: list[str] | None = None) -> int:
         + glob.glob(DEFAULT_SLO_GLOB) + glob.glob(DEFAULT_FLEET_GLOB)
         + glob.glob(DEFAULT_TIMELINE_GLOB)
         + glob.glob(DEFAULT_JOURNAL_GLOB)
+        + glob.glob(DEFAULT_ALERTS_GLOB)
+        + glob.glob(DEFAULT_INCIDENT_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

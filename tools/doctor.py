@@ -1,0 +1,426 @@
+#!/usr/bin/env python
+"""Cross-stream root-cause diagnosis for one or more logdirs.
+
+Every observability stream a run leaves behind — ``flight.jsonl``,
+``faults.jsonl``, ``alerts.jsonl``, ``steps.jsonl``, ``requests.jsonl``,
+``history.jsonl``, ``goodput.json``, incident bundles — carries absolute
+unix timestamps.  This tool joins ALL of them on that one clock and asks
+the question ``run_report`` leaves to the reader: *what went wrong
+first, and what is downstream of it?*
+
+Usage::
+
+    python tools/doctor.py LOGDIR [LOGDIR ...] [--json]
+        [--window SECONDS]   # evidence window after each candidate cause
+
+Method: candidate root causes are anchored on the streams that record
+*causes* (chaos fault injections, breaker trips, watchdog timeouts,
+crashes); each candidate collects evidence — alert firings, anomaly /
+SLO-violation flight events, engine step-log stalls, failed requests,
+``rpc_*`` retry growth and ``breaker_state`` opens in the history series
+— from the window after its onset, and is scored by how much of the
+observed damage it explains.  Damage no candidate covers becomes an
+"unexplained" hypothesis of its own (a wedged engine with no injected
+fault is exactly the case that matters in production).  The output is a
+ranked hypothesis list with per-evidence citations (stream, timestamp,
+detail), text or ``--json``.
+
+Exit status: 0 on success (even with zero hypotheses — a healthy run is
+a valid diagnosis), 1 when any stream is unparseable (a truncated or
+corrupt log must fail loudly, not silently shrink the evidence).
+
+Stdlib-only, like every tool in this directory — it must run wherever
+the logs land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+#: Which alert kinds an injected fault kind is expected to trip — used
+#: to weight kind-MATCHED alert evidence above incidental firings
+#: (resilience/chaos.py FAULT_KINDS x obs/alerts.py ALERT_KINDS).
+FAULT_EXPECTED_ALERTS = {
+    "data_stall": ("absence",),
+    "worker_kill": ("absence", "threshold"),
+    "dispatcher_kill": ("absence", "threshold"),
+    "net_sever": ("threshold", "absence"),
+    "net_drop": ("threshold",),
+    "net_delay": ("threshold", "anomaly"),
+    "nan_loss": ("anomaly",),
+    "preemption": ("absence",),
+    "checkpoint_truncate": (),
+}
+
+#: Flight-event kinds that are damage (evidence), not causes.
+DAMAGE_FLIGHT_KINDS = (
+    "anomaly", "slo_violation", "checkpoint_corrupt", "coordinator_failure",
+)
+
+#: Flight-event kinds that are causes in their own right.
+CAUSE_FLIGHT_KINDS = ("watchdog_timeout", "exception", "preemption")
+
+_BREAKER_OPEN = 2.0  # net/breaker.py gauge encoding: closed/half_open/open
+
+
+def _finite(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and math.isfinite(v)
+
+
+def _read_jsonl(path: str, problems: list[str]) -> list[dict]:
+    rows: list[dict] = []
+    try:
+        with open(path) as f:
+            for i, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError as e:
+                    problems.append(f"{path}:{i}: invalid JSON ({e})")
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError as e:
+        problems.append(f"{path}: unreadable ({e})")
+    return rows
+
+
+class Streams:
+    """Every stream of one logdir, parsed and clock-joined."""
+
+    def __init__(self, logdir: str, problems: list[str]):
+        self.logdir = logdir
+        j = lambda name: os.path.join(logdir, name)  # noqa: E731
+        rd = lambda name: (_read_jsonl(j(name), problems)  # noqa: E731
+                           if os.path.exists(j(name)) else [])
+        self.flight = rd("flight.jsonl")
+        self.faults = rd("faults.jsonl")
+        self.alerts = rd("alerts.jsonl")
+        self.steps = rd("steps.jsonl")
+        self.requests = rd("requests.jsonl")
+        self.history = rd("history.jsonl")
+        self.goodput = None
+        if os.path.exists(j("goodput.json")):
+            try:
+                with open(j("goodput.json")) as f:
+                    self.goodput = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                problems.append(f"{j('goodput.json')}: invalid JSON ({e})")
+
+    def stream_count(self) -> int:
+        return sum(1 for s in (self.flight, self.faults, self.alerts,
+                               self.steps, self.requests, self.history)
+                   if s) + (1 if self.goodput is not None else 0)
+
+    def span(self) -> tuple[float, float] | None:
+        ts = [row["t"]
+              for rows in (self.flight, self.faults, self.alerts,
+                           self.steps, self.requests, self.history)
+              for row in rows if _finite(row.get("t"))]
+        return (min(ts), max(ts)) if ts else None
+
+    # -- derived damage signals ----------------------------------------------
+
+    def step_stalls(self, factor: float = 5.0,
+                    min_gap_s: float = 1.0) -> list[dict]:
+        """Engine step-log gaps ``factor``x the median inter-step gap
+        (and at least ``min_gap_s``) — the offline wedged-engine signal."""
+        ts = sorted(row["t"] for row in self.steps
+                    if _finite(row.get("t")))
+        if len(ts) < 3:
+            return []
+        gaps = sorted(b - a for a, b in zip(ts, ts[1:]))
+        median = gaps[len(gaps) // 2]
+        bound = max(median * factor, min_gap_s)
+        return [
+            {"t": a, "gap_s": b - a}
+            for a, b in zip(ts, ts[1:]) if b - a >= bound
+        ]
+
+    def failed_requests(self) -> list[dict]:
+        return [r for r in self.requests
+                if r.get("status") not in (None, "ok")]
+
+    def series_deltas(self, prefix: str, t0: float,
+                      t1: float) -> dict[str, float]:
+        """Per-series increase of every history series named
+        ``prefix``* inside [t0, t1] (cumulative counters: last - first)."""
+        first: dict[str, float] = {}
+        last: dict[str, float] = {}
+        for row in self.history:
+            t = row.get("t")
+            vals = row.get("values")
+            if not _finite(t) or not isinstance(vals, dict) \
+                    or not t0 <= t <= t1:
+                continue
+            for name, v in vals.items():
+                if not name.startswith(prefix) or not _finite(v):
+                    continue
+                first.setdefault(name, float(v))
+                last[name] = float(v)
+        return {name: last[name] - first[name]
+                for name in last if last[name] > first[name]}
+
+    def breaker_opens(self, t0: float, t1: float) -> list[dict]:
+        """History moments where a ``breaker_state`` series reaches the
+        OPEN encoding inside [t0, t1]."""
+        opens: list[dict] = []
+        was_open: set[str] = set()
+        for row in self.history:
+            t = row.get("t")
+            vals = row.get("values")
+            if not _finite(t) or not isinstance(vals, dict):
+                continue
+            for name, v in vals.items():
+                if not name.startswith("breaker_state") or not _finite(v):
+                    continue
+                if v >= _BREAKER_OPEN and name not in was_open:
+                    was_open.add(name)
+                    if t0 <= t <= t1:
+                        opens.append({"t": t, "series": name})
+                elif v < _BREAKER_OPEN:
+                    was_open.discard(name)
+        return opens
+
+
+def _cite(stream: str, t, detail: str) -> dict:
+    return {"stream": stream, "t": round(float(t), 3), "detail": detail}
+
+
+def _collect_window_evidence(s: Streams, kind: str | None, t0: float,
+                             t1: float) -> tuple[float, list[dict]]:
+    """Damage inside [t0, t1] attributed to a candidate cause at ``t0``
+    of fault kind ``kind`` (None for non-fault causes).  Returns
+    (score contribution, citations)."""
+    score = 0.0
+    ev: list[dict] = []
+    expected = FAULT_EXPECTED_ALERTS.get(kind or "", ())
+    for a in s.alerts:
+        t = a.get("t")
+        if not _finite(t) or not t0 <= t <= t1 \
+                or a.get("phase") != "fired":
+            continue
+        matched = a.get("kind") in expected
+        score += 5.0 if matched else 3.0
+        ev.append(_cite(
+            "alerts.jsonl", t,
+            f"alert '{a.get('rule')}' ({a.get('kind')}/"
+            f"{a.get('severity')}) fired +{t - t0:.1f}s after onset"
+            + (" — kind-matched" if matched else "")))
+    for e in s.flight:
+        t = e.get("t")
+        if not _finite(t) or not t0 < t <= t1:
+            continue
+        if e.get("kind") in DAMAGE_FLIGHT_KINDS:
+            score += 2.0
+            ev.append(_cite("flight.jsonl", t,
+                            f"{e.get('kind')} event +{t - t0:.1f}s "
+                            "after onset"))
+    for stall in s.step_stalls():
+        if t0 <= stall["t"] <= t1:
+            score += 2.0
+            ev.append(_cite(
+                "steps.jsonl", stall["t"],
+                f"engine step gap {stall['gap_s']:.2f}s (stall) "
+                f"+{stall['t'] - t0:.1f}s after onset"))
+    for r in s.failed_requests():
+        t = r.get("t")
+        if _finite(t) and t0 <= t <= t1:
+            score += 0.5
+            ev.append(_cite("requests.jsonl", t,
+                            f"request {r.get('id')} ended "
+                            f"{r.get('status')}"))
+    retries = s.series_deltas("rpc_retries_total", t0, t1)
+    for name, d in sorted(retries.items()):
+        score += 1.0
+        ev.append(_cite("history.jsonl", t0,
+                        f"{name} grew by {d:g} inside the window"))
+    deadlines = s.series_deltas("rpc_deadline_exceeded_total", t0, t1)
+    for name, d in sorted(deadlines.items()):
+        score += 1.0
+        ev.append(_cite("history.jsonl", t0,
+                        f"{name} grew by {d:g} inside the window"))
+    for op in s.breaker_opens(t0, t1):
+        score += 2.0
+        ev.append(_cite("history.jsonl", op["t"],
+                        f"{op['series']} reached OPEN "
+                        f"+{op['t'] - t0:.1f}s after onset"))
+    return score, ev
+
+
+def diagnose(logdirs: list[str], *, window_s: float = 60.0,
+             problems: list[str] | None = None) -> dict:
+    """Build the ranked hypothesis list across ``logdirs``.  Appends
+    stream-parse complaints to ``problems`` (callers decide the exit
+    status)."""
+    problems = problems if problems is not None else []
+    streams = [Streams(d, problems) for d in logdirs]
+    hypotheses: list[dict] = []
+    many = len(streams) > 1
+
+    for s in streams:
+        where = f" [{os.path.basename(os.path.normpath(s.logdir))}]" \
+            if many else ""
+        # fault recovery times, to extend each fault's evidence window
+        recovered: dict[int, float] = {
+            int(r["id"]): r["t"] for r in s.faults
+            if r.get("phase") == "recovered" and _finite(r.get("t"))
+            and isinstance(r.get("id"), int)
+        }
+        fault_windows: list[tuple[float, float]] = []
+
+        # 1) injected chaos faults: the strongest candidate causes
+        for r in s.faults:
+            if r.get("phase") != "injected" or not _finite(r.get("t")):
+                continue
+            t0 = float(r["t"])
+            t1 = max(recovered.get(r.get("id"), t0), t0) + window_s
+            fault_windows.append((t0, t1))
+            score, ev = _collect_window_evidence(s, r.get("kind"), t0, t1)
+            ev.insert(0, _cite(
+                "faults.jsonl", t0,
+                f"fault '{r.get('kind')}' injected (id {r.get('id')}"
+                + (f", step {r.get('step')}" if r.get("step") is not None
+                   else "") + ")"))
+            hypotheses.append({
+                "cause": f"injected chaos fault '{r.get('kind')}'{where}",
+                "kind": "fault_injection",
+                "fault_kind": r.get("kind"),
+                "t": round(t0, 3),
+                "logdir": s.logdir,
+                "score": round(3.0 + score, 2),
+                "evidence": ev,
+            })
+
+        def covered(t: float) -> bool:
+            return any(a <= t <= b for a, b in fault_windows)
+
+        # 2) cause-grade flight events not explained by a fault
+        for e in s.flight:
+            t = e.get("t")
+            if not _finite(t) or e.get("kind") not in CAUSE_FLIGHT_KINDS \
+                    or covered(t):
+                continue
+            score, ev = _collect_window_evidence(s, None, t, t + window_s)
+            ev.insert(0, _cite("flight.jsonl", t,
+                               f"{e.get('kind')} event (no fault plan "
+                               "covers this moment)"))
+            hypotheses.append({
+                "cause": f"{e.get('kind')} with no injected fault{where}",
+                "kind": "process_event",
+                "t": round(float(t), 3),
+                "logdir": s.logdir,
+                "score": round(2.0 + score, 2),
+                "evidence": ev,
+            })
+
+        # 3) breaker opens nothing above explains: network/peer failure
+        span = s.span()
+        if span is not None:
+            for op in s.breaker_opens(span[0], span[1]):
+                if covered(op["t"]):
+                    continue
+                score, ev = _collect_window_evidence(
+                    s, None, op["t"], op["t"] + window_s)
+                ev.insert(0, _cite("history.jsonl", op["t"],
+                                   f"{op['series']} reached OPEN with no "
+                                   "fault plan covering this moment"))
+                hypotheses.append({
+                    "cause": f"peer/network failure ({op['series']})"
+                             f"{where}",
+                    "kind": "breaker_open",
+                    "t": round(op["t"], 3),
+                    "logdir": s.logdir,
+                    "score": round(1.0 + score, 2),
+                    "evidence": ev,
+                })
+
+        # 4) uncovered firings: the unexplained-damage bucket
+        for a in s.alerts:
+            t = a.get("t")
+            if not _finite(t) or a.get("phase") != "fired" or covered(t):
+                continue
+            label = ("wedged engine / dead peer (stall with no "
+                     "injected fault)" if a.get("kind") == "absence"
+                     else "unexplained regression")
+            hypotheses.append({
+                "cause": f"{label}{where}",
+                "kind": "unexplained_alert",
+                "t": round(float(t), 3),
+                "logdir": s.logdir,
+                "score": 1.5,
+                "evidence": [_cite(
+                    "alerts.jsonl", t,
+                    f"alert '{a.get('rule')}' ({a.get('kind')}/"
+                    f"{a.get('severity')}) fired outside every fault "
+                    "window")],
+            })
+
+    hypotheses.sort(key=lambda h: (-h["score"], h["t"]))
+    for rank, h in enumerate(hypotheses, start=1):
+        h["rank"] = rank
+    spans = [sp for s in streams if (sp := s.span()) is not None]
+    return {
+        "logdirs": logdirs,
+        "streams": sum(s.stream_count() for s in streams),
+        "span_s": round(max(b for _, b in spans)
+                        - min(a for a, _ in spans), 3) if spans else 0.0,
+        "window_s": window_s,
+        "parse_problems": list(problems),
+        "hypotheses": hypotheses,
+    }
+
+
+def render(report: dict) -> str:
+    lines = [
+        f"doctor: {len(report['logdirs'])} logdir(s), "
+        f"{report['streams']} stream(s), spanning "
+        f"{report['span_s']:.1f}s on one clock",
+    ]
+    if not report["hypotheses"]:
+        lines.append("  no root-cause hypotheses: no faults, no alerts, "
+                     "no cause-grade events — the run looks healthy")
+    for h in report["hypotheses"]:
+        lines.append(
+            f"\n#{h['rank']} (score {h['score']:g}) {h['cause']} "
+            f"at t={h['t']:.2f}")
+        for e in h["evidence"]:
+            lines.append(f"    - {e['stream']} t={e['t']:.2f}: "
+                         f"{e['detail']}")
+    for p in report["parse_problems"]:
+        lines.append(f"\nPARSE ERROR: {p}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("logdirs", nargs="+", help="run log directories")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the report as JSON")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="evidence window after each candidate cause "
+                        "(seconds, default 60)")
+    args = p.parse_args(argv)
+    for d in args.logdirs:
+        if not os.path.isdir(d):
+            print(f"doctor: {d} is not a directory", file=sys.stderr)
+            return 1
+    problems: list[str] = []
+    report = diagnose(args.logdirs, window_s=args.window,
+                      problems=problems)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report), end="")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Dump the live metric inventory and cross-check it against the docs.
+
+Metric names rot silently: a rename in code leaves docs/API.md and
+docs/OBSERVABILITY.md describing series that no longer exist, and the
+first anyone notices is a dashboard going blank.  This tool makes the
+drift a test failure:
+
+- default mode imports :mod:`distributedtensorflow_tpu` (registering
+  every import-time metric into the process registry) and dumps the
+  inventory — name, type, observed label keys;
+- ``--prom FILE`` parses a ``metrics.prom`` exposition snapshot instead
+  (stdlib-only: works on an artifact from any run, no jax import);
+- every inventoried family name must appear in at least one of the doc
+  files (``--docs``, default docs/API.md + docs/OBSERVABILITY.md);
+  undocumented names are listed and the exit status is non-zero.
+
+Usage::
+
+    python tools/list_metrics.py [--json] [--no-check]
+    python tools/list_metrics.py --prom ARTIFACTS/run/metrics.prom
+
+Construction-time metrics (engine step counters, prefetcher gauges) only
+exist in a process that built those objects — the default mode therefore
+sees the import-time floor, which is exactly the set worth pinning: it
+is what every process exports regardless of role.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_DOCS = (
+    os.path.join(REPO, "docs", "API.md"),
+    os.path.join(REPO, "docs", "OBSERVABILITY.md"),
+)
+
+_TYPE_RE = re.compile(r"^# TYPE ([A-Za-z_:][A-Za-z0-9_:]*) (\w+)$")
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{([^}]*)\})?\s+\S+$")
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="')
+#: Histogram/summary sample suffixes that fold back into the family name.
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count", "_quantile", "_avg")
+
+
+def registry_inventory() -> list[dict]:
+    """The live default-registry inventory (imports the package — every
+    import-time metric registers as a side effect)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import distributedtensorflow_tpu  # noqa: F401 — the side effect
+    from distributedtensorflow_tpu.obs import registry as reglib
+
+    out = []
+    for m in reglib.default_registry().metrics():
+        label_keys: set[str] = set()
+        items = m._hist_items() if hasattr(m, "_hist_items") else m._items()
+        for entry in items:
+            label_keys.update(k for k, _v in entry[0])
+        out.append({"name": m.name, "type": m.kind,
+                    "label_keys": sorted(label_keys)})
+    return sorted(out, key=lambda d: d["name"])
+
+
+def prom_inventory(path: str) -> list[dict]:
+    """Inventory from a Prometheus exposition snapshot (stdlib-only)."""
+    families: dict[str, dict] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("# TYPE "):
+                m = _TYPE_RE.match(line)
+                if m:
+                    families.setdefault(
+                        m.group(1), {"name": m.group(1),
+                                     "type": m.group(2),
+                                     "label_keys": set()})
+                continue
+            if not line or line.startswith("#"):
+                continue
+            m = _SAMPLE_RE.match(line)
+            if not m:
+                continue
+            name = m.group(1)
+            for suffix in _FAMILY_SUFFIXES:
+                base = name[:-len(suffix)] if name.endswith(suffix) else None
+                if base and base in families:
+                    name = base
+                    break
+            fam = families.setdefault(
+                name, {"name": name, "type": "untyped",
+                       "label_keys": set()})
+            if m.group(3):
+                fam["label_keys"].update(
+                    k for k in _LABEL_RE.findall(m.group(3)) if k != "le")
+    return sorted(
+        ({**f, "label_keys": sorted(f["label_keys"])}
+         for f in families.values()
+         if not f["name"].endswith("_quantile")),
+        key=lambda d: d["name"])
+
+
+def check_documented(inventory: list[dict],
+                     doc_paths: list[str]) -> tuple[list[str], list[str]]:
+    """(undocumented names, missing doc files): every family name must
+    appear verbatim somewhere in at least one doc file."""
+    text = ""
+    missing: list[str] = []
+    for p in doc_paths:
+        try:
+            with open(p) as f:
+                text += f.read()
+        except OSError:
+            missing.append(p)
+    undocumented = [m["name"] for m in inventory if m["name"] not in text]
+    return undocumented, missing
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--prom", help="parse a metrics.prom snapshot instead "
+                                  "of the live registry")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--docs", nargs="*", default=list(DEFAULT_DOCS),
+                   help="doc files the names are checked against")
+    p.add_argument("--no-check", action="store_true",
+                   help="dump the inventory without the docs cross-check")
+    args = p.parse_args(argv)
+    inventory = prom_inventory(args.prom) if args.prom \
+        else registry_inventory()
+    undocumented: list[str] = []
+    missing_docs: list[str] = []
+    if not args.no_check:
+        undocumented, missing_docs = check_documented(inventory, args.docs)
+    if args.as_json:
+        print(json.dumps({"metrics": inventory,
+                          "undocumented": undocumented,
+                          "missing_docs": missing_docs}, indent=1))
+    else:
+        for m in inventory:
+            labels = ("{" + ",".join(m["label_keys"]) + "}"
+                      if m["label_keys"] else "")
+            print(f"{m['name']}{labels}  [{m['type']}]")
+        print(f"\n{len(inventory)} metric families")
+        for p_ in missing_docs:
+            print(f"MISSING DOC FILE: {p_}", file=sys.stderr)
+        for name in undocumented:
+            print(f"UNDOCUMENTED: {name} (not found in "
+                  f"{', '.join(os.path.relpath(d, REPO) for d in args.docs)})",
+                  file=sys.stderr)
+    return 1 if (undocumented or missing_docs) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

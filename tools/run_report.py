@@ -894,6 +894,67 @@ def fleet_summary(logdir: str, train: list[dict], trace: list[dict],
     return out, bad
 
 
+def alerts_summary(logdir: str) -> tuple[dict, int]:
+    """``(alerts digest, parse errors)`` from ``<logdir>/alerts.jsonl``
+    plus the ``incidents/`` evidence bundles: firing counts by rule and
+    severity, the still-open set, the last firings, and per-bundle
+    manifest summaries.  Empty when the run carried no alerting."""
+    out: dict = {}
+    bad = 0
+    path = os.path.join(logdir, "alerts.jsonl")
+    if os.path.exists(path):
+        rows, bad = _load_jsonl(path)
+        fired = [r for r in rows if r.get("phase") == "fired"]
+        resolved_ids = {r.get("id") for r in rows
+                        if r.get("phase") == "resolved"}
+        by_rule: dict[str, int] = {}
+        by_severity: dict[str, int] = {}
+        for r in fired:
+            by_rule[str(r.get("rule"))] = by_rule.get(
+                str(r.get("rule")), 0) + 1
+            by_severity[str(r.get("severity"))] = by_severity.get(
+                str(r.get("severity")), 0) + 1
+        out = {
+            "fired": len(fired),
+            "by_rule": {k: by_rule[k] for k in sorted(by_rule)},
+            "by_severity": {k: by_severity[k] for k in sorted(by_severity)},
+            "open": [
+                {k: r.get(k) for k in ("id", "rule", "severity", "t")}
+                for r in fired if r.get("id") not in resolved_ids
+            ],
+            "last": [
+                {k: r.get(k) for k in ("t", "id", "rule", "kind",
+                                       "severity", "phase", "value",
+                                       "reason")}
+                for r in rows[-10:]
+            ],
+        }
+    incidents_dir = os.path.join(logdir, "incidents")
+    if os.path.isdir(incidents_dir):
+        bundles = []
+        for name in sorted(os.listdir(incidents_dir)):
+            manifest = os.path.join(incidents_dir, name, "manifest.json")
+            if not os.path.exists(manifest):
+                continue
+            try:
+                with open(manifest) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError, ValueError) as e:
+                print(f"{manifest}: unreadable ({e})", file=sys.stderr)
+                bad += 1
+                continue
+            if isinstance(doc, dict):
+                bundles.append({
+                    "dir": name,
+                    **{k: doc.get(k) for k in ("id", "rule", "severity",
+                                               "t")},
+                    "files": len(doc.get("files") or []),
+                })
+        if bundles:
+            out["incidents"] = bundles
+    return out, bad
+
+
 def load_goodput(logdir: str) -> tuple[dict, int]:
     """``(goodput summary, parse errors)`` from ``<logdir>/goodput.json``
     (the GoodputLedger document; empty summary when absent)."""
@@ -954,6 +1015,7 @@ def build_report(logdir: str) -> dict:
     train, evals = split_rows(rows)
     fleet, bad_fleet = fleet_summary(logdir, train, trace, flight)
     rpc, bad_journal = rpc_summary(train, logdir)
+    alerts, bad_alerts = alerts_summary(logdir)
 
     times, source = step_times(train, trace)
     times_sorted = sorted(times)
@@ -990,13 +1052,15 @@ def build_report(logdir: str) -> dict:
         "serving": serving_summary(requests, train, steps_rows),
         "fleet": fleet,
         "rpc": rpc,
+        "alerts": alerts,
         # metric-stream health: any unparseable metrics.jsonl / trace /
         # captures / faults / requests line (or an unreadable
         # goodput.json / fleet.json / dispatcher.journal) makes main()
         # exit non-zero (CI gate)
         "parse_errors": (bad_metrics + bad_trace + bad_goodput
                          + bad_captures + bad_faults + bad_requests
-                         + bad_steps + bad_fleet + bad_journal),
+                         + bad_steps + bad_fleet + bad_journal
+                         + bad_alerts),
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -1325,6 +1389,24 @@ def render(report: dict) -> str:
             )
             for epoch, gen in sorted(j["epochs"].items()):
                 lines.append(f"    epoch {epoch}: generation {gen}")
+    al = report.get("alerts")
+    if al:
+        sev = ", ".join(f"{k} x{v}" for k, v in
+                        (al.get("by_severity") or {}).items())
+        lines += ["", f"alerts: {al.get('fired', 0)} firing(s)"
+                  + (f" ({sev})" if sev else "")
+                  + (f", {len(al['open'])} still open"
+                     if al.get("open") else "")]
+        for rule, n in (al.get("by_rule") or {}).items():
+            lines.append(f"  {rule}: fired x{n}")
+        for o in al.get("open", []):
+            lines.append(f"  OPEN: {o.get('rule')} "
+                         f"[{o.get('severity')}] id {o.get('id')}")
+        for b in al.get("incidents", []):
+            lines.append(
+                f"  incident {b.get('dir')}: rule {b.get('rule')} "
+                f"[{b.get('severity')}], {b.get('files', 0)} evidence "
+                "file(s)")
     sto = report.get("step_time_opt")
     if sto:
         parts = []

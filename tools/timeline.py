@@ -44,6 +44,10 @@ Tracks (one Chrome-trace "process" per stream):
   lane.  In ``--fleet`` mode the lane keeps the serve process's track
   group, so request spans and the iterations that served them line up
   on the shared clock.
+- **alerts** — every ``alerts.jsonl`` row (``obs.alerts``) as an
+  instant, one lane per rule, named ``<rule> fired`` / ``<rule>
+  resolved`` — whether the alert landed before or after the damage it
+  describes reads directly off the shared clock.
 
 Timestamp reconstruction: ``trace.jsonl`` spans carry durations only, so
 step rows are anchored to the flight recorder's absolute ``step`` events
@@ -70,6 +74,7 @@ PID_FLIGHT = 2
 PID_CAPTURES = 3
 PID_GOODPUT = 4
 PID_STEPS = 5
+PID_ALERTS = 6
 #: --fleet: the shared cross-process trace group; per-logdir pids are
 #: offset by _FLEET_PID_STRIDE * index.
 PID_FLEET_TRACES = 90
@@ -171,8 +176,9 @@ def build_timeline(logdir: str) -> dict:
     flight = load_jsonl(os.path.join(logdir, "flight.jsonl"))
     captures = load_jsonl(os.path.join(logdir, "captures.jsonl"))
     steps = load_jsonl(os.path.join(logdir, "steps.jsonl"))
+    alerts = load_jsonl(os.path.join(logdir, "alerts.jsonl"))
     gens = load_goodput(logdir)
-    if not (trace or flight or captures or steps or gens):
+    if not (trace or flight or captures or steps or gens or alerts):
         raise SystemExit(
             f"{logdir}: no telemetry streams (trace.jsonl / flight.jsonl / "
             "captures.jsonl / steps.jsonl / goodput.json) — is this a "
@@ -203,6 +209,10 @@ def build_timeline(logdir: str) -> dict:
         if t is not None:
             # `t` stamps the iteration's END; its start is t - step_s
             absolutes.append(t - max(_num(s.get("step_s")) or 0.0, 0.0))
+    for a in alerts:
+        t = _num(a.get("t"))
+        if t is not None:
+            absolutes.append(t)
     t0 = min(absolutes) if absolutes else 0.0
     t0_us = t0 * 1e6
 
@@ -213,6 +223,8 @@ def build_timeline(logdir: str) -> dict:
     _meta(events, PID_GOODPUT, "goodput generations (goodput.json)", 3)
     if steps:
         _meta(events, PID_STEPS, "engine steps (steps.jsonl)", 4)
+    if alerts:
+        _meta(events, PID_ALERTS, "alerts (alerts.jsonl)", 5)
 
     # -- flight events: one lane per kind, instants ---------------------------
     kind_tid: dict[str, int] = {}
@@ -371,6 +383,25 @@ def build_timeline(logdir: str) -> dict:
                         "name": key, "ts": ts, "args": {key: v},
                     })
 
+    # -- alerts: one lane per rule, fired/resolved instants -------------------
+    rule_tid: dict[str, int] = {}
+    for a in alerts:
+        t = _num(a.get("t"))
+        if t is None:
+            continue
+        rule = str(a.get("rule", "?"))
+        tid = rule_tid.setdefault(rule, len(rule_tid) + 1)
+        args = {k: v for k, v in a.items()
+                if k not in ("t", "rule") and not isinstance(v, (list, dict))}
+        events.append({
+            "ph": "i", "s": "t", "pid": PID_ALERTS, "tid": tid,
+            "name": f"{rule} {a.get('phase', '?')}",
+            "ts": round(t * 1e6 - t0_us, 3), "args": args,
+        })
+    for rule, tid in rule_tid.items():
+        events.append({"ph": "M", "pid": PID_ALERTS, "tid": tid,
+                       "name": "thread_name", "args": {"name": rule}})
+
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -383,6 +414,7 @@ def build_timeline(logdir: str) -> dict:
                 "captures": len(captures),
                 "goodput_generations": len(gens),
                 "engine_steps": len(steps),
+                "alerts": len(alerts),
             },
         },
     }
@@ -528,6 +560,7 @@ def main(argv: list[str] | None = None) -> int:
         f"timeline: {len(doc['traceEvents'])} events "
         f"({n['trace_rows']} span rows, {n['flight_events']} flight, "
         f"{n['captures']} captures, {n['engine_steps']} engine steps, "
+        f"{n['alerts']} alerts, "
         f"{n['goodput_generations']} generations) -> {out}"
     )
     return 0

@@ -665,6 +665,21 @@ def main() -> None:
                         "reactive capture on a fast-burn trip")
     p.add_argument("--slo-interval", type=float, default=5.0,
                    help="seconds between SLO burn-rate evaluations")
+    p.add_argument("--alert-rules", default=None, metavar="JSON",
+                   help="alert rule file (obs.alerts schema): evaluate "
+                        "threshold/burn/absence/anomaly rules over the "
+                        "registry (and the SLO monitor / history store / "
+                        "fleet view when present) on a background thread; "
+                        "firings append <logdir>/alerts.jsonl, write "
+                        "incident evidence bundles under "
+                        "<logdir>/incidents/, raise alert flight events, "
+                        "and serve GET /alertz + /healthz?deep=1")
+    p.add_argument("--alert-interval", type=float, default=5.0,
+                   help="seconds between alert rule evaluations")
+    p.add_argument("--alert-webhook", default=None, metavar="URL",
+                   help="POST every alert transition to this http:// URL "
+                        "as JSON (through net.rpc: deadline, retries, "
+                        "circuit breaker)")
     p.add_argument("--profiler-port", type=int, default=None, metavar="PORT",
                    help="start the jax.profiler server for on-demand remote "
                         "trace capture (TensorBoard 'capture profile' / "
@@ -1452,6 +1467,49 @@ def main() -> None:
         ).install(trainer.status_server).start()
         logging.info("metrics history: fleet-merged sampling every %.1fs "
                      "(GET /histz)", args.fleet_interval)
+    alert_manager = None
+    if args.alert_rules:
+        import json as jsonlib3
+
+        from distributedtensorflow_tpu.obs import alerts as alertslib
+
+        try:
+            alert_rules = alertslib.load_rules(args.alert_rules)
+        except (OSError, ValueError, jsonlib3.JSONDecodeError) as e:
+            raise SystemExit(f"--alert-rules {args.alert_rules}: {e}")
+        sinks = [alertslib.log_sink]
+        if args.alert_webhook:
+            sinks.append(alertslib.make_webhook_sink(args.alert_webhook))
+        alert_manager = alertslib.AlertManager(
+            alert_rules,
+            interval_s=args.alert_interval,
+            logdir=args.logdir,
+            history=metrics_history,
+            fleet=fleet_agg,
+            slo_monitor=slo_monitor,
+            capture_engine=trainer.capture if args.auto_profile else None,
+            sinks=sinks,
+        )
+        if trainer.status_server is not None:
+            alert_manager.install(trainer.status_server)
+            # /healthz?deep=1 — the shallow watchdog verdict is already in
+            # the base health; deep adds the alerting/SLO/fleet planes.
+            components = {"alerts": alert_manager.health_component}
+            if slo_monitor is not None:
+                components["slo"] = alertslib.slo_health_component(
+                    slo_monitor)
+            if fleet_agg is not None:
+                components["fleet"] = alertslib.fleet_health_component(
+                    fleet_agg)
+            trainer.status_server.deep_health_fn = \
+                alertslib.compose_deep_health(components)
+        alert_manager.start()
+        logging.info(
+            "alerts: %d rule(s) from %s evaluated every %.1fs%s",
+            len(alert_rules), args.alert_rules, args.alert_interval,
+            f" (webhook {args.alert_webhook})" if args.alert_webhook
+            else "",
+        )
 
     eval_iter_fn = None
     if args.eval_every and eval_step is not None:
@@ -1559,6 +1617,10 @@ def main() -> None:
         # log boundary, BEFORE these final gauge updates — without the
         # rewrite a run shorter than --slo-interval would end with no
         # slo_burn_rate samples on disk at all.
+        if alert_manager is not None:
+            # Before the SLO monitor: stop() runs one final evaluation so
+            # resolve rows land, and burn rules read the monitor's state.
+            alert_manager.stop()
         if slo_monitor is not None:
             slo_monitor.stop()
             try:
@@ -1569,8 +1631,8 @@ def main() -> None:
             metrics_history.stop()
         if fleet_agg is not None:
             fleet_agg.stop()
-        if (slo_monitor is not None or fleet_agg is not None) \
-                and args.logdir:
+        if (slo_monitor is not None or fleet_agg is not None
+                or alert_manager is not None) and args.logdir:
             from distributedtensorflow_tpu.obs import registry as _reglib
 
             try:
