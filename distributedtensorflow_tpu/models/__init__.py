@@ -11,6 +11,7 @@ from .gpt import (  # noqa: F401
     gpt_tiny,
     lm_eval,
     lm_loss,
+    nan_taps,
 )
 from .lenet import LeNet5  # noqa: F401
 from .resnet import (  # noqa: F401
@@ -55,3 +56,16 @@ from .widedeep import (  # noqa: F401
     widedeep_loss,
     widedeep_test_config,
 )
+
+
+def make_nan_taps(model):
+    """Best-effort NaN-provenance tap forward for ``obs.dynamics``:
+    ``tap_fn(params, batch) -> {"NNN_module": nonfinite_count}`` with
+    the forward position encoded in the key (``000_wte``, ``001_h0``,
+    ... — jit canonicalizes dict outputs to sorted key order, so bare
+    module names would lose forward order), or None for models without
+    activation taps (provenance then falls back to the model-agnostic
+    parameter/gradient censuses)."""
+    if isinstance(model, GPTLM):
+        return nan_taps(model)
+    return None

@@ -29,7 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.sharding import LayoutMap
-from .layers import FusedLayerNorm, dense
+from .layers import FusedLayerNorm, dense, sow_nonfinite
 
 AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
@@ -394,6 +394,12 @@ class GPTLM(nn.Module):
             cfg.vocab_size, cfg.hidden_size,
             dtype=cfg.dtype, name="wte",
         )(input_ids)
+        # NaN-provenance taps (obs/dynamics.py): per-module activation
+        # isfinite counts sown into the "dynamics" collection.  Sown in
+        # THIS scope — outside any remat'd block — so the taps are
+        # remat-safe, and only when the collection is mutable (the
+        # provenance re-forward), so training pays nothing.
+        sow_nonfinite(self, "wte", x)
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1]), input_ids.shape
@@ -417,7 +423,9 @@ class GPTLM(nn.Module):
             x = block(cfg, self.attn_fn, self.decode, name=f"h{i}")(
                 x, positions, deterministic, rope_tabs
             )
+            sow_nonfinite(self, f"h{i}", x)
         x = FusedLayerNorm(out_dtype=jnp.float32, name="ln_f")(x)
+        sow_nonfinite(self, "ln_f", x)
         if return_hidden:
             # Loss-side chunked head (ops/xent.py): the caller applies the
             # tied embedding per token chunk so full-vocab logits never
@@ -431,6 +439,35 @@ class GPTLM(nn.Module):
 
         wte = self.variables["params"]["wte"]["embedding"]
         return tied_head_logits(x, wte, cfg.dtype)
+
+
+def nan_taps(model: GPTLM):
+    """The NaN-provenance tap forward for ``obs.dynamics``: a
+    ``tap_fn(params, batch) -> {"NNN_module": nonfinite_count}`` whose
+    keys embed the FORWARD position (``000_wte``, ``001_h0``, ...,
+    ``00N_ln_f``) — jit canonicalizes dict outputs to sorted key order,
+    so a bare module-name key would silently turn "first in the forward
+    pass" into "first alphabetically"; with the index prefix, sorted
+    order IS forward order and the provenance binary search names the
+    first module that produced a non-finite activation.  jit-able; runs
+    the deterministic no-dropout forward with only the ``dynamics``
+    collection mutable."""
+    order = (["wte"] + [f"h{i}" for i in range(model.cfg.num_layers)]
+             + ["ln_f"])
+
+    def tap_fn(params, batch):
+        _, variables = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            deterministic=True,
+            return_hidden=True,
+            mutable=["dynamics"],
+        )
+        taps = variables.get("dynamics", {})
+        return {f"{i:03d}_{name}": taps[f"{name}__nf"]
+                for i, name in enumerate(order) if f"{name}__nf" in taps}
+
+    return tap_fn
 
 
 def lm_loss(model: GPTLM):

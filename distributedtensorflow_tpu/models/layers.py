@@ -12,6 +12,34 @@ from ..ops.layernorm import layer_norm
 from ..ops.quant import quantized_matmul, validate_mode
 
 
+def nonfinite_count(x) -> jax.Array:
+    """Count of non-finite elements of ``x`` as an int32 scalar (fp32
+    view, so bf16 Infs count too)."""
+    return jnp.sum(~jnp.isfinite(x.astype(jnp.float32)), dtype=jnp.int32)
+
+
+def sow_nonfinite(module: nn.Module, name: str, x):
+    """NaN-provenance tap: sow ``x``'s non-finite count into the
+    ``dynamics`` variable collection (obs/dynamics.py's activation
+    census) and return ``x`` unchanged.
+
+    Free in training: the collection is only mutable during the
+    provenance re-forward (``mutable=["dynamics"]``), so the guarded
+    branch traces nothing in the compiled train step.  Guarded off
+    during ``init`` too — a sown count in the init variables would leak
+    into ``model_state`` and change the checkpoint tree.
+
+    The variable is stored as ``<name>__nf``: flax submodule and
+    variable names share one scope namespace, so sowing under the
+    module's own name ("wte", "h0", ...) is a duplicate-scope error.
+    """
+    if not module.is_initializing() \
+            and module.is_mutable_collection("dynamics"):
+        module.sow("dynamics", f"{name}__nf", nonfinite_count(x),
+                   reduce_fn=lambda _prev, new: new)
+    return x
+
+
 class FusedLayerNorm(nn.Module):
     """Drop-in for ``nn.LayerNorm(dtype=float32)`` + output cast.
 

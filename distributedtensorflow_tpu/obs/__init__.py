@@ -53,6 +53,14 @@ Surfaces:
   sinks, appending ``alerts.jsonl``, snapshotting per-firing incident
   evidence bundles (``incidents/<id>/``), and serving ``GET /alertz``;
   ``obs.alerts.recompute_from_history`` replays the rules offline;
+- ``DynamicsMonitor`` — training-dynamics observability (``obs.dynamics``):
+  in-graph per-module grad/param/update statistics on a ``lax.cond``
+  cadence riding the train step's metrics, flushed at log boundaries
+  into ``dynamics.jsonl`` + the ``dynamics_*`` registry families +
+  ``GET /dynamicz``, with a NaN-provenance pass (activation taps,
+  parameter census, gradient binary search) that names the first
+  module to go non-finite as a ``nan_provenance`` flight event and
+  incident bundle;
 - ``MetricsHistory`` — the embedded metrics history store (``obs.tsdb``):
   fixed-memory downsampling rings over registry samples (plus fleet
   merges and per-SLO good/total snapshots when attached), answering
@@ -68,7 +76,7 @@ Surfaces:
   single Chrome-trace/Perfetto timeline (restarts included).
 """
 
-from . import alerts, capture, fleet, flight_recorder, goodput, memory, slo, tsdb  # noqa: F401
+from . import alerts, capture, dynamics, fleet, flight_recorder, goodput, memory, slo, tsdb  # noqa: F401
 from .alerts import AlertManager, AlertRule  # noqa: F401
 from .aggregate import (  # noqa: F401
     host_aggregate,

@@ -42,7 +42,9 @@ rejected a truncated/corrupt checkpoint and fell back —
 ``resilience.supervisor``), ``data_reshard`` (elastic data-service
 re-assignment — ``data.service``), ``slo_violation`` (an SLO burn-rate
 threshold trip — ``obs.slo``), ``alert`` (an alert rule fired or
-resolved — ``obs.alerts``), ``fit_begin``, ``fit_end``.
+resolved — ``obs.alerts``), ``nan_provenance`` (the first module to
+produce a non-finite value, named by the NaN-provenance pass —
+``obs.dynamics``), ``fit_begin``, ``fit_end``.
 
 The hot path is one ``time.time()`` + one deque append under a lock; dumps
 rewrite the whole file atomically (tmp + rename) so a reader — or the

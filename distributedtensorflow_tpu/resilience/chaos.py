@@ -21,7 +21,12 @@ on demand, deterministically, from a JSON *fault plan*
     The wrapped train step reports a NaN loss at the trigger step; the
     streaming AnomalyDetector flags it at the next log boundary and the
     Supervisor's watch callback turns it into a restart from a checkpoint
-    *before* the poisoned step.
+    *before* the poisoned step.  An optional ``"module": "h1"`` param
+    ALSO poisons that top-level module's parameters to NaN — the
+    end-to-end NaN-provenance drill (obs/dynamics.py must name exactly
+    that module).  For a sharp verdict keep the trigger step a multiple
+    of ``log_every``: detection then runs while the poison is still
+    localized to the one module.
 ``checkpoint_truncate``
     The first checkpoint save at/after the trigger step is truncated on
     disk post-commit (the torn-write storage fault), so the next
@@ -273,16 +278,45 @@ class ChaosInjector(Callback):
 
     def wrap_train_step(self, train_step):
         """NaN-loss injection: at the trigger step the returned metrics
-        report a NaN loss (the state itself is untouched — the detection
-        and recovery machinery downstream is what is under test)."""
+        report a NaN loss.  Without a ``module`` param the state itself
+        is untouched (the detection and recovery machinery downstream is
+        what is under test); with ``{"module": "h1"}`` the named
+        top-level module's parameter subtree is ALSO poisoned to NaN —
+        the provenance-accuracy drill: exactly one module is bad at the
+        detection boundary, and obs.dynamics must name it."""
+        import jax  # noqa: PLC0415
         import jax.numpy as jnp  # noqa: PLC0415
+
+        def _poison_module(state, module: str):
+            params = state.params
+            if not hasattr(params, "get") or params.get(module) is None:
+                logger.error(
+                    "chaos: nan_loss module %r not a top-level param "
+                    "module (have: %s) — loss-only injection",
+                    module, sorted(params) if hasattr(params, "keys")
+                    else type(params).__name__)
+                return state, False
+            poisoned = jax.tree.map(
+                lambda x: jnp.full_like(x, jnp.nan), params[module]
+            )
+            if isinstance(params, dict):
+                new_params = {**params, module: poisoned}
+            else:  # flax FrozenDict
+                new_params = params.copy({module: poisoned})
+            return state.replace(params=new_params), True
 
         def chaotic_step(state, batch, rng):
             step_before = int(state.step)
             new_state, metrics = train_step(state, batch, rng)
             fault = self._pending("nan_loss", step_before + 1)
             if fault is not None and "loss" in metrics:
-                self._inject(fault, at_step=step_before + 1)
+                module = fault.params.get("module")
+                extra = {}
+                if module:
+                    new_state, ok = _poison_module(new_state, str(module))
+                    if ok:
+                        extra["module"] = str(module)
+                self._inject(fault, at_step=step_before + 1, **extra)
                 metrics = dict(
                     metrics,
                     loss=jnp.full_like(

@@ -364,10 +364,32 @@ class Supervisor:
         if clear is not None:
             clear()
         _M_RESTARTS.inc(kind=kind)
+        # NaN-provenance hint (obs/dynamics.py): a nan_loss restart that
+        # knows WHICH module went bad says so — "restored from step K"
+        # becomes "module h3 produced the first non-finite at step K".
+        prov_fields = {}
+        if kind == "nan_loss":
+            try:
+                from ..obs import dynamics as dynlib  # noqa: PLC0415
+
+                prov = dynlib.last_provenance()
+            except Exception:  # pragma: no cover — hint only, never fatal
+                prov = None
+            if prov and prov.get("module"):
+                prov_fields = {
+                    "nan_module": prov["module"],
+                    "provenance_step": prov.get("step"),
+                }
+                logger.warning(
+                    "supervisor: nan provenance — module %r produced the "
+                    "first non-finite value at step %s (via %s)",
+                    prov["module"], prov.get("step"), prov.get("method"),
+                )
         obs.record_event(
             "restart", step=resumed_step, failure=kind, attempt=attempt,
             backoff_s=round(backoff, 3),
             rejected_checkpoints=len(rejected_steps),
+            **prov_fields,
         )
         self.restarts.append({
             "kind": kind, "attempt": attempt, "resumed_step": resumed_step,

@@ -213,6 +213,7 @@ def make_train_step(
     accum_steps: int = 1,
     donate: bool = True,
     overlap=None,
+    dynamics_every: int = 0,
 ) -> Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, dict[str, jax.Array]]]:
     """Compile the full train step over ``mesh``.
 
@@ -225,11 +226,16 @@ def make_train_step(
     parameters through per-layer-group backward tags so each bucket's
     gradient collective is issued inside the backward pass (collective–
     matmul overlap) instead of after it; numerically identity.
+
+    ``dynamics_every > 0`` adds the in-graph training-dynamics stats
+    (:func:`~..obs.dynamics.cadence_stats`): ``lax.cond``-gated
+    per-module grad/param/update statistics riding the metrics dict
+    under ``dynamics/`` keys every that many optimizer steps.
     """
     batch_sharding = NamedSharding(mesh, shardlib.batch_spec(mesh))
     state_shardings = shardlib.named_shardings(mesh, state_specs)
     repl = NamedSharding(mesh, P())
-    step = _step_body(loss_fn, accum_steps, overlap)
+    step = _step_body(loss_fn, accum_steps, overlap, dynamics_every)
 
     return _InstrumentedStep(
         jax.jit(
@@ -242,7 +248,8 @@ def make_train_step(
     )
 
 
-def _step_body(loss_fn: LossFn, accum_steps: int, overlap=None):
+def _step_body(loss_fn: LossFn, accum_steps: int, overlap=None,
+               dynamics_every: int = 0):
     """The one train-step function both engines compile.
 
     Folds the step counter into the rng (dropout etc. differs per step
@@ -250,7 +257,11 @@ def _step_body(loss_fn: LossFn, accum_steps: int, overlap=None):
     microbatches, applies the update.  Shared so the single-step and
     multi-step (scanned) engines can never drift apart semantically.
     ``overlap`` wraps the loss so parameter cotangents flow through the
-    plan's bucket tags (see :func:`make_train_step`).
+    plan's bucket tags (see :func:`make_train_step`).  ``dynamics_every``
+    merges the cadence-gated dynamics stats into the metrics dict — the
+    stats read the pre-update params, the grads, and the post-update
+    params, so they must be computed here, before donation recycles the
+    old buffers.
     """
     if overlap is not None:
         loss_fn = overlap.wrap_loss_fn(loss_fn)
@@ -260,10 +271,16 @@ def _step_body(loss_fn: LossFn, accum_steps: int, overlap=None):
         grads, metrics, new_mstate = accumulate_gradients(
             loss_fn, state.params, state.model_state, batch, r, accum_steps
         )
-        return (
-            state.apply_gradients(grads).replace(model_state=new_mstate),
-            metrics,
-        )
+        new_state = state.apply_gradients(grads).replace(
+            model_state=new_mstate)
+        if dynamics_every > 0:
+            from ..obs import dynamics as dynlib
+
+            metrics = dict(metrics, **dynlib.cadence_stats(
+                state.params, new_state.params, grads,
+                step=state.step, every=dynamics_every,
+            ))
+        return new_state, metrics
 
     return step
 
@@ -277,6 +294,7 @@ def make_multi_train_step(
     accum_steps: int = 1,
     donate: bool = True,
     overlap=None,
+    dynamics_every: int = 0,
 ) -> Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, dict[str, jax.Array]]]:
     """Compile ``steps_per_call`` optimizer steps into ONE dispatch.
 
@@ -299,7 +317,7 @@ def make_multi_train_step(
     if steps_per_call <= 1:
         return make_train_step(
             loss_fn, mesh, state_specs, accum_steps=accum_steps,
-            donate=donate, overlap=overlap,
+            donate=donate, overlap=overlap, dynamics_every=dynamics_every,
         )
     batch_sharding = NamedSharding(
         mesh, shardlib.batch_spec(mesh, leading_unsharded=1)
@@ -307,7 +325,7 @@ def make_multi_train_step(
     state_shardings = shardlib.named_shardings(mesh, state_specs)
     repl = NamedSharding(mesh, P())
 
-    one_step = _step_body(loss_fn, accum_steps, overlap)
+    one_step = _step_body(loss_fn, accum_steps, overlap, dynamics_every)
 
     def multi_step(state: TrainState, batches: PyTree, rng: jax.Array):
         def body(s, b):

@@ -148,8 +148,17 @@ class TrainerConfig:
     # default so deep layers' markers flow in.
     flight_recorder: bool = False
     flight_capacity: int = 2048
+    # Training-dynamics telemetry (obs.dynamics): informational — the
+    # cadence is compiled into the train step (engine dynamics_every) and
+    # the DynamicsMonitor callback books the stats.  > 0 stamps the
+    # cadence into /statusz so a live run advertises which steps carry
+    # the per-module grad/param/update statistics.
+    dynamics_every: int = 0
 
     def __post_init__(self):
+        if self.dynamics_every < 0:
+            raise ValueError(
+                f"dynamics_every must be >= 0, got {self.dynamics_every}")
         # Fail a dead-on-arrival gate at setup, not after the first eval.
         if self.target_metric:
             if self.target_value is None:
@@ -896,6 +905,8 @@ class Trainer:
             out["run"]["quant"] = self.config.quant
         if self.config.overlap_buckets:
             out["run"]["overlap_buckets"] = self.config.overlap_buckets
+        if self.config.dynamics_every:
+            out["run"]["dynamics_every"] = self.config.dynamics_every
         if self.config.pipeline_stages:
             out["run"]["pipeline"] = {
                 "schedule": self.config.pipeline_schedule,

@@ -1,0 +1,582 @@
+"""Training-dynamics observability (obs/dynamics.py; ISSUE 18).
+
+Fast lane: the in-graph cadence stats (gating, grouping, hand-checked
+arithmetic), the monitor's host-side booking/flushing, the
+NaN-provenance binary search on a synthetically poisoned module, the
+dynamics.jsonl schema gates, /dynamicz, and the run_report section.
+The end-to-end chaos drill (inject -> provenance names the module ->
+doctor ranks it first) lives in tests/test_train_dynamics_smoke.py.
+"""
+
+import json
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributedtensorflow_tpu.obs import dynamics as dyn
+from distributedtensorflow_tpu.obs import flight_recorder as frlib
+from distributedtensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributedtensorflow_tpu.train import (
+    create_sharded_state,
+    make_train_step,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_metrics_schema as cms  # noqa: E402
+import run_report  # noqa: E402
+
+
+# --- in-graph cadence stats --------------------------------------------------
+
+
+def _tree(a, b):
+    return {"enc": {"w": jnp.asarray(a, jnp.float32)},
+            "dec": {"w": jnp.asarray(b, jnp.float32)}}
+
+
+def test_cadence_stats_on_step_hand_math():
+    old = _tree([3.0, 4.0], [0.0, 0.0])
+    grads = _tree([1.0, 0.0], [2.0, 0.0])
+    new = _tree([3.0, 4.0 - 0.2], [0.5, 0.0])
+    # step=4 (pre-increment) completes optimizer step 5 -> on cadence
+    out = jax.jit(
+        lambda o, n, g: dyn.cadence_stats(o, n, g, step=4, every=5)
+    )(old, new, grads)
+    assert float(out["dynamics/grad_norm/enc"]) == pytest.approx(1.0)
+    assert float(out["dynamics/grad_norm/dec"]) == pytest.approx(2.0)
+    assert float(out["dynamics/param_norm/enc"]) == pytest.approx(5.0)
+    # ||dW||/||W||: enc moved by 0.2 against norm 5
+    assert float(out["dynamics/update_ratio/enc"]) == pytest.approx(
+        0.2 / 5.0, rel=1e-5)
+    assert float(out["dynamics/global_grad_norm"]) == pytest.approx(
+        math.sqrt(1.0 + 4.0), rel=1e-6)
+    assert float(out["dynamics/nonfinite/enc"]) == 0.0
+
+
+def test_cadence_stats_off_step_is_zeros():
+    old = _tree([3.0, 4.0], [1.0, 1.0])
+    grads = _tree([1.0, 1.0], [2.0, 2.0])
+    out = jax.jit(
+        lambda o, n, g: dyn.cadence_stats(o, n, g, step=4, every=7)
+    )(old, old, grads)
+    assert all(float(v) == 0.0 for v in out.values()), out
+
+
+def test_cadence_stats_counts_nonfinite_grads():
+    old = _tree([1.0, 1.0], [1.0, 1.0])
+    grads = _tree([float("nan"), 1.0],
+                  [float("inf"), float("-inf")])
+    out = jax.jit(
+        lambda o, n, g: dyn.cadence_stats(o, n, g, step=0, every=1)
+    )(old, old, grads)
+    assert float(out["dynamics/nonfinite/enc"]) == 1.0
+    assert float(out["dynamics/nonfinite/dec"]) == 2.0
+
+
+def test_cadence_stats_rejects_nothing_weird_names():
+    params = {"a b/c": jnp.ones(2), "0head": jnp.ones(2)}
+    names = dyn.group_names(params)
+    # sorted raw-key order (jit's canonical dict order), sanitized
+    assert names == ["_0head", "a_b_c"]
+
+
+def test_grouping_cardinality_cap():
+    params = {f"layer{i:02d}": jnp.ones(1) for i in range(40)}
+    names = dyn.group_names(params)
+    assert len(names) == dyn.MAX_MODULES
+    assert names[-1] == dyn.OVERFLOW_MODULE
+    # the overflow group still carries every excess subtree
+    out = jax.jit(
+        lambda o, n, g: dyn.cadence_stats(o, n, g, step=0, every=1)
+    )(params, params, params)
+    grad_keys = [k for k in out if k.startswith("dynamics/grad_norm/")]
+    assert len(grad_keys) == dyn.MAX_MODULES
+    # 40 modules of one unit element: 31 singles + 9 pooled in _other
+    assert float(
+        out[f"dynamics/grad_norm/{dyn.OVERFLOW_MODULE}"]
+    ) == pytest.approx(3.0)  # sqrt(9)
+
+
+def test_first_bad_index():
+    mk = lambda *v: jnp.cumsum(jnp.asarray(v)) > 0
+    assert dyn.first_bad_index(mk(0, 0, 0)) is None
+    assert dyn.first_bad_index(mk(0, 0, 3)) == 2
+    assert dyn.first_bad_index(mk(5, 1, 0)) == 0
+    assert dyn.first_bad_index(mk(0, 2, 0, 1)) == 1
+    assert dyn.first_bad_index(jnp.zeros((0,), bool)) is None
+
+
+# --- engine integration ------------------------------------------------------
+
+
+def _toy_setup(mesh, lr=0.1):
+    def init_fn(_r):
+        return {"params": {
+            "lin": {"w": jnp.ones((4, 4), jnp.float32)},
+            "head": {"w": jnp.full((4, 1), 0.5, jnp.float32)},
+        }}
+
+    def loss_fn(params, model_state, batch, rng):
+        pred = batch["x"] @ params["lin"]["w"] @ params["head"]["w"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, ({"loss": loss}, model_state)
+
+    state, specs = create_sharded_state(
+        init_fn, optax.sgd(lr), mesh, jax.random.PRNGKey(0))
+    return state, specs, loss_fn
+
+
+def _toy_batch(i):
+    k = jax.random.PRNGKey(i)
+    x = jax.random.normal(k, (8, 4))
+    return {"x": x, "y": jnp.sum(x, axis=1, keepdims=True)}
+
+
+def test_engine_emits_dynamics_keys_on_cadence(devices):
+    mesh = build_mesh(MeshSpec(data=1), devices[:1])
+    state, specs, loss_fn = _toy_setup(mesh)
+    step = make_train_step(loss_fn, mesh, specs, dynamics_every=3)
+    rng = jax.random.PRNGKey(1)
+    seen = {}
+    for i in range(6):
+        state, metrics = step(state, _toy_batch(i), rng)
+        seen[int(state.step)] = {
+            k: float(v) for k, v in metrics.items()
+            if k.startswith(dyn.METRIC_PREFIX)
+        }
+    # every step carries the keys; only completed-step multiples of 3
+    # carry values (the lax.cond zero branch elsewhere)
+    assert all(seen[s] for s in seen)
+    assert seen[3]["dynamics/global_grad_norm"] > 0.0
+    assert seen[6]["dynamics/global_grad_norm"] > 0.0
+    for s in (1, 2, 4, 5):
+        assert seen[s]["dynamics/global_grad_norm"] == 0.0, (s, seen[s])
+        assert all(v == 0.0 for v in seen[s].values())
+    assert "dynamics/grad_norm/lin" in seen[3]
+    assert "dynamics/param_norm/head" in seen[3]
+
+
+def test_engine_dynamics_off_emits_no_keys(devices):
+    mesh = build_mesh(MeshSpec(data=1), devices[:1])
+    state, specs, loss_fn = _toy_setup(mesh)
+    step = make_train_step(loss_fn, mesh, specs)
+    state, metrics = step(state, _toy_batch(0), jax.random.PRNGKey(1))
+    assert not any(k.startswith(dyn.METRIC_PREFIX) for k in metrics)
+
+
+# --- the monitor -------------------------------------------------------------
+
+
+class _State:
+    def __init__(self, params, step=0, model_state=None):
+        self.params = params
+        self.step = step
+        self.model_state = model_state if model_state is not None else {}
+
+
+def _fake_dyn(scale=1.0, modules=("enc", "dec"), nonfinite=0):
+    out = {}
+    for m in modules:
+        out[f"dynamics/grad_norm/{m}"] = jnp.float32(scale)
+        out[f"dynamics/param_norm/{m}"] = jnp.float32(2.0 * scale)
+        out[f"dynamics/update_ratio/{m}"] = jnp.float32(0.1)
+        out[f"dynamics/nonfinite/{m}"] = jnp.float32(nonfinite)
+    out["dynamics/global_grad_norm"] = jnp.float32(scale)
+    return out
+
+
+def test_monitor_rejects_nonpositive_every(tmp_path):
+    with pytest.raises(ValueError):
+        dyn.DynamicsMonitor(0, logdir=str(tmp_path))
+
+
+def test_monitor_pops_keys_and_books_rows(tmp_path):
+    mon = dyn.DynamicsMonitor(2, logdir=str(tmp_path), log_every=4)
+
+    def train_step(state, batch, rng):
+        return state, {"loss": jnp.float32(1.0), **_fake_dyn()}
+
+    wrapped = mon.wrap_train_step(train_step)
+    state = _State({"enc": jnp.ones(2)})
+    mon.on_fit_begin(None, _State(None, step=0))
+    for s in range(1, 9):
+        state, metrics = wrapped(state, {}, None)
+        # the MetricWriter-facing dict is clean of dynamics keys
+        assert list(metrics) == ["loss"]
+        mon.on_step_end(None, s, state, metrics)
+    mon.on_fit_end(None, state)
+
+    rows = [json.loads(line) for line in
+            (tmp_path / "dynamics.jsonl").read_text().splitlines()]
+    assert [r["step"] for r in rows] == [2, 4, 6, 8]
+    assert all(r["every"] == 2 for r in rows)
+    r = rows[0]
+    assert r["global_grad_norm"] == pytest.approx(1.0)
+    assert set(r["modules"]) == {"enc", "dec"}
+    assert r["modules"]["enc"]["param_norm"] == pytest.approx(2.0)
+    assert r["modules"]["enc"]["nonfinite_grads"] == 0
+    assert r["nonfinite_total"] == 0
+    # flushes happen at log boundaries (4, 8) plus the fit-end flush
+    assert mon.rows_written == 4
+    errors, warnings = cms.check_file(str(tmp_path / "dynamics.jsonl"))
+    assert errors == [], errors
+
+
+def test_monitor_books_stacked_substeps(tmp_path):
+    mon = dyn.DynamicsMonitor(
+        2, logdir=str(tmp_path), log_every=4, steps_per_call=4)
+    stacked = {k: jnp.stack([v * (i + 1) for i in range(4)])
+               for k, v in _fake_dyn().items()}
+
+    def train_step(state, batch, rng):
+        return state, {"loss": jnp.float32(1.0), **stacked}
+
+    wrapped = mon.wrap_train_step(train_step)
+    state = _State({"enc": jnp.ones(2)})
+    mon.on_fit_begin(None, _State(None, step=0))
+    state, metrics = wrapped(state, {}, None)
+    mon.on_step_end(None, 4, state, metrics)
+    mon.on_fit_end(None, state)
+
+    rows = [json.loads(line) for line in
+            (tmp_path / "dynamics.jsonl").read_text().splitlines()]
+    # sub-steps 2 and 4 of the 4-step dispatch, indexed out of the stack
+    assert [r["step"] for r in rows] == [2, 4]
+    assert rows[0]["global_grad_norm"] == pytest.approx(2.0)
+    assert rows[1]["global_grad_norm"] == pytest.approx(4.0)
+
+
+def test_monitor_pins_history_series(tmp_path):
+    class _Hist:
+        def __init__(self):
+            self.pinned = []
+
+        def pin(self, names):
+            self.pinned.extend(names)
+
+    hist = _Hist()
+    mon = dyn.DynamicsMonitor(1, logdir=str(tmp_path), log_every=1)
+    mon.attach_history(hist)
+
+    def train_step(state, batch, rng):
+        return state, {"loss": jnp.float32(1.0), **_fake_dyn()}
+
+    wrapped = mon.wrap_train_step(train_step)
+    state = _State({"enc": jnp.ones(2)})
+    state, metrics = wrapped(state, {}, None)
+    mon.on_step_end(None, 1, state, metrics)
+    assert "dynamics_global_grad_norm" in hist.pinned
+    assert "dynamics_grad_norm.module_enc" in hist.pinned
+    assert "dynamics_update_ratio.module_dec" in hist.pinned
+
+
+# --- NaN provenance ----------------------------------------------------------
+
+
+def _poisoned_state():
+    params = {
+        "wte": {"w": jnp.ones((3, 3))},
+        "h0": {"w": jnp.ones((3, 3))},
+        "h1": {"w": jnp.full((3, 3), jnp.nan)},
+        "ln_f": {"w": jnp.ones(3)},
+    }
+    return _State(params, step=10)
+
+
+def test_provenance_param_census_names_poisoned_module(tmp_path):
+    mon = dyn.DynamicsMonitor(5, logdir=str(tmp_path))
+    mon._last = (_poisoned_state(), {"x": jnp.ones(2)}, jax.random.PRNGKey(0))
+    doc = mon.maybe_provenance(10, "non_finite_loss")
+    assert doc is not None
+    assert doc["module"] == "h1"
+    assert doc["method"] == "param_census"
+    assert doc["first_bad_param_module"] == "h1"
+    assert doc["nonfinite_param_counts"] == {"h1": 9}
+    assert dyn.last_provenance()["module"] == "h1"
+
+    # the incident bundle next to it passes the schema gate
+    bundle = tmp_path / "incidents" / "0010-nan_provenance"
+    errors, warnings = cms.check_file(str(bundle / "manifest.json"))
+    assert errors == [], errors
+    manifest = json.loads((bundle / "manifest.json").read_text())
+    assert manifest["labels"]["module"] == "h1"
+    assert "provenance.json" in manifest["files"]
+
+
+def test_provenance_activation_taps_win_over_census(tmp_path):
+    def tap_fn(params, batch):
+        # forward-order taps (position-prefixed keys, the tap_fn
+        # contract): h0 is the FIRST module to go non-finite even
+        # though h1's params are the poisoned ones
+        return {
+            "000_wte": jnp.int32(0),
+            "001_h0": jnp.int32(4),
+            "002_h1": jnp.int32(9),
+            "003_ln_f": jnp.int32(2),
+        }
+
+    mon = dyn.DynamicsMonitor(5, logdir=str(tmp_path), tap_fn=tap_fn)
+    mon._last = (_poisoned_state(), {"x": jnp.ones(2)}, jax.random.PRNGKey(0))
+    doc = mon.maybe_provenance(10, "non_finite_loss")
+    assert doc["method"] == "activation_taps"
+    assert doc["module"] == "h0"
+    assert doc["first_bad_activation"] == "h0"
+    assert doc["first_bad_param_module"] == "h1"
+    assert doc["nonfinite_activation_counts"] == {
+        "h0": 4, "h1": 9, "ln_f": 2}
+
+
+def test_gpt_nan_taps_name_poisoned_module():
+    """The real-model activation channel: GPTLM's sow taps must come
+    back in forward order and localize a poisoned module (regression:
+    sowing under the submodule's own scope name was a flax
+    duplicate-scope error that silently killed the channel)."""
+    from distributedtensorflow_tpu.models import GPTLM, gpt_tiny, make_nan_taps
+
+    model = GPTLM(gpt_tiny())
+    batch = {"input_ids": jnp.ones((2, 8), jnp.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"])["params"]
+    # init-time guard: no dynamics collection leaks into the param tree
+    assert set(params) == {"wte", "h0", "h1", "ln_f"}
+    tap_fn = make_nan_taps(model)
+    taps = jax.jit(tap_fn)(params, batch)
+    # keys carry the forward position ("000_wte") so jit's sorted-dict
+    # canonicalization preserves forward order
+    names = [k.split("_", 1)[1] for k in sorted(taps)]
+    assert names == ["wte", "h0", "h1", "ln_f"]  # forward order
+    assert all(int(jnp.asarray(v).sum()) == 0 for v in taps.values())
+
+    poisoned = dict(params)
+    poisoned["h1"] = jax.tree.map(
+        lambda x: jnp.full_like(x, jnp.nan), params["h1"])
+    taps = jax.jit(tap_fn)(poisoned, batch)
+    bad = [k.split("_", 1)[1] for k in sorted(taps)
+           if int(jnp.asarray(taps[k]).sum()) > 0]
+    assert bad and bad[0] == "h1", taps
+    assert "wte" not in bad and "h0" not in bad
+
+    # and the monitor's activation channel names it end to end
+    mon = dyn.DynamicsMonitor(5, tap_fn=tap_fn)
+    mon._last = (_State(poisoned, step=7), batch, jax.random.PRNGKey(0))
+    doc = mon.maybe_provenance(7, "non_finite_loss")
+    assert doc["method"] == "activation_taps"
+    assert doc["module"] == "h1"
+
+
+def test_provenance_grad_census_last_resort(tmp_path):
+    def loss_fn(params, model_state, batch, rng):
+        # only h0's gradient is non-finite; params/activations are clean
+        bad = jnp.sum(params["h0"]["w"]) * jnp.float32(jnp.inf) * 0.0
+        loss = jnp.sum(params["wte"]["w"]) + bad
+        return loss, ({}, model_state)
+
+    params = {"wte": {"w": jnp.ones((2, 2))}, "h0": {"w": jnp.ones((2, 2))}}
+    mon = dyn.DynamicsMonitor(5, logdir=str(tmp_path), loss_fn=loss_fn)
+    mon._last = (_State(params, step=3), {"x": jnp.ones(2)},
+                 jax.random.PRNGKey(0))
+    doc = mon.maybe_provenance(3, "non_finite_grads")
+    assert doc["method"] == "grad_census"
+    assert doc["module"] == "h0"
+
+
+def test_provenance_idempotent_per_step_and_flight_event(tmp_path):
+    rec = frlib.FlightRecorder(capacity=64)
+    prev = frlib.install_recorder(rec)
+    try:
+        mon = dyn.DynamicsMonitor(5, logdir=str(tmp_path))
+        mon._last = (_poisoned_state(), {"x": jnp.ones(2)},
+                     jax.random.PRNGKey(0))
+        assert mon.maybe_provenance(10, "non_finite_loss") is not None
+        assert mon.maybe_provenance(10, "non_finite_loss") is None
+        assert mon.maybe_provenance(9, "non_finite_loss") is None
+    finally:
+        frlib.install_recorder(prev)
+    events = [e for e in rec.events() if e["kind"] == "nan_provenance"]
+    assert len(events) == 1
+    e = events[0]
+    assert e["module"] == "h1" and e["step"] == 10
+    # flight rows must stay scalar-only (the stream schema contract)
+    assert all(not isinstance(v, (dict, list)) for v in e.values()), e
+
+
+def test_flush_triggers_provenance_on_nonfinite_grads(tmp_path):
+    mon = dyn.DynamicsMonitor(2, logdir=str(tmp_path), log_every=2)
+
+    def train_step(state, batch, rng):
+        return state, {"loss": jnp.float32(1.0),
+                       **_fake_dyn(nonfinite=3)}
+
+    wrapped = mon.wrap_train_step(train_step)
+    state = _poisoned_state()
+    mon.on_fit_begin(None, _State(None, step=0))
+    new_state, metrics = wrapped(state, {"x": jnp.ones(2)}, None)
+    mon.on_step_end(None, 2, new_state, metrics)
+    assert mon.last_prov is not None
+    assert mon.last_prov["reason"] == "non_finite_grads"
+    assert mon.last_prov["module"] == "h1"
+    rows = [json.loads(line) for line in
+            (tmp_path / "dynamics.jsonl").read_text().splitlines()]
+    assert rows[0]["nonfinite_total"] == 6  # 3 per module, 2 modules
+
+
+# --- /dynamicz ---------------------------------------------------------------
+
+
+def test_dynamicz_payload_and_install(tmp_path):
+    mon = dyn.DynamicsMonitor(2, logdir=str(tmp_path), log_every=2)
+
+    def train_step(state, batch, rng):
+        return state, {"loss": jnp.float32(1.0), **_fake_dyn()}
+
+    wrapped = mon.wrap_train_step(train_step)
+    state = _State({"enc": jnp.ones(2)})
+    mon.on_fit_begin(None, _State(None, step=0))
+    for s in (1, 2):
+        state, metrics = wrapped(state, {}, None)
+        mon.on_step_end(None, s, state, metrics)
+    code, payload = mon.dynamicz()
+    assert code == 200
+    assert payload["every"] == 2
+    assert payload["rows"] and payload["rows"][-1]["step"] == 2
+    assert set(payload["modules"]) == {"enc", "dec"}
+    assert payload["provenance"] is None
+    json.dumps(payload)  # JSON-serializable end to end
+
+    # ?n= bounds the ring to the newest rows
+    _, bounded = mon.dynamicz("n=1")
+    assert [r["step"] for r in bounded["rows"]] == [2]
+    assert mon.dynamicz("n=0")[1]["rows"] == []
+    assert mon.dynamicz("n=999")[1]["rows"] == payload["rows"]
+    assert mon.dynamicz("n=bogus")[0] == 400
+
+    class _Server:
+        routes = {}
+
+    server = _Server()
+    mon.install(server)
+    code2, payload2 = server.routes[("GET", "/dynamicz")]()
+    assert code2 == 200 and payload2["rows"] == payload["rows"]
+
+
+# --- schema gates ------------------------------------------------------------
+
+
+def _dyn_row(step, every=5, t=None, modules=None, nft=0, **over):
+    row = {
+        "t": 100.0 + step if t is None else t,
+        "step": step, "every": every,
+        "global_grad_norm": 1.5,
+        "nonfinite_total": nft,
+        "modules": modules if modules is not None else {
+            "enc": {"grad_norm": 1.0, "param_norm": 2.0,
+                    "update_ratio": 0.1, "nonfinite_grads": nft},
+        },
+    }
+    row.update(over)
+    return row
+
+
+def _write_dyn(tmp_path, rows, name="dynamics.jsonl"):
+    p = tmp_path / name
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(p)
+
+
+def test_schema_valid_file_passes(tmp_path):
+    path = _write_dyn(tmp_path, [_dyn_row(5), _dyn_row(10), _dyn_row(15)])
+    errors, warnings = cms.check_file(path)
+    assert errors == [] and warnings == []
+
+
+def test_schema_off_cadence_step_is_error(tmp_path):
+    path = _write_dyn(tmp_path, [_dyn_row(5), _dyn_row(7)])
+    errors, _ = cms.check_file(path)
+    assert any("not a multiple of the cadence" in e for e in errors)
+
+
+def test_schema_repeated_step_is_error_rewind_is_warning(tmp_path):
+    path = _write_dyn(tmp_path, [_dyn_row(10), _dyn_row(10)])
+    errors, _ = cms.check_file(path)
+    assert any("repeats the previous row" in e for e in errors)
+    # a rewind (supervised restart replay) only warns
+    path = _write_dyn(tmp_path,
+                      [_dyn_row(15, t=100.0), _dyn_row(5, t=101.0),
+                       _dyn_row(10, t=102.0)],
+                      name="dynamics_restart.jsonl")
+    errors, warnings = cms.check_file(path)
+    assert errors == []
+    assert any("went backwards" in w for w in warnings)
+
+
+def test_schema_cadence_change_midstream_is_error(tmp_path):
+    path = _write_dyn(tmp_path, [_dyn_row(5), _dyn_row(12, every=6)])
+    errors, _ = cms.check_file(path)
+    assert any("changed mid-stream" in e for e in errors)
+
+
+def test_schema_bad_module_name_is_error(tmp_path):
+    path = _write_dyn(tmp_path, [_dyn_row(
+        5, modules={"bad name!": {"grad_norm": 1.0}}, nft=0)])
+    errors, _ = cms.check_file(path)
+    assert any("malformed module name" in e for e in errors)
+
+
+def test_schema_nonfinite_total_mismatch_is_error(tmp_path):
+    path = _write_dyn(tmp_path, [_dyn_row(
+        5, modules={"enc": {"nonfinite_grads": 2}}, nft=5)])
+    errors, _ = cms.check_file(path)
+    assert any("sum of module" in e for e in errors)
+
+
+def test_schema_sentinels_allowed(tmp_path):
+    path = _write_dyn(tmp_path, [_dyn_row(
+        5, global_grad_norm="NaN",
+        modules={"enc": {"grad_norm": "Infinity", "nonfinite_grads": 1}},
+        nft=1)])
+    errors, warnings = cms.check_file(path)
+    assert errors == [], errors
+
+
+# --- run_report --------------------------------------------------------------
+
+
+def test_run_report_dynamics_section(tmp_path):
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    rows = [
+        _dyn_row(5, modules={"h1": {"grad_norm": 1.0, "param_norm": 4.0,
+                                    "update_ratio": 0.2,
+                                    "nonfinite_grads": 0}}),
+        _dyn_row(10, modules={"h1": {"grad_norm": "NaN", "param_norm": 4.0,
+                                     "update_ratio": 0.9,
+                                     "nonfinite_grads": 7}}, nft=7),
+    ]
+    (logdir / "dynamics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows))
+    flight = [{"t": 110.0, "kind": "nan_provenance", "step": 10,
+               "module": "h1", "reason": "non_finite_grads",
+               "method": "param_census"}]
+    out, bad = run_report.dynamics_summary(str(logdir), flight)
+    assert bad == 0
+    assert out["rows"] == 2 and out["every"] == 5
+    assert out["steps"] == {"first": 5, "last": 10}
+    assert out["nonfinite_steps"] == [10]
+    h1 = out["modules"]["h1"]
+    assert h1["nonfinite_grads"] == 7
+    assert h1["grad_norm"] == pytest.approx(1.0)  # last FINITE value
+    assert h1["update_ratio_max"] == pytest.approx(0.9)
+    assert out["provenance"] == {
+        "step": 10, "module": "h1", "reason": "non_finite_grads",
+        "method": "param_census"}
+
+
+def test_run_report_no_dynamics_is_empty(tmp_path):
+    out, bad = run_report.dynamics_summary(str(tmp_path), [])
+    assert out == {} and bad == 0

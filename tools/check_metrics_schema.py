@@ -64,7 +64,14 @@ breaker-state encodings); basenames starting with ``dispatcher`` and
 ending ``.journal`` against the dispatcher durability-journal schema
 (``data/service.py``: strictly-increasing ``seq``, known record kinds,
 per-epoch monotonic generations, replay-safe ordering, a torn final
-line tolerated); everything else against the metric-row schema
+line tolerated); basenames starting with ``dynamics`` against the
+training-dynamics cadence-row schema (``obs/dynamics.py``:
+non-decreasing ``t``, a constant positive ``every`` dividing every
+``step`` (step rewinds allowed — supervised restarts — but never two
+rows for the same step in a row), per-module stats under identifier
+module names with finite-or-sentinel values and non-negative integer
+``nonfinite_grads`` counts consistent with ``nonfinite_total``);
+everything else against the metric-row schema
 (where ``quant_mode`` is the one string-typed field, from
 :data:`QUANT_MODES`; the input-plane/fleet/slo label checks apply to the
 jsonl-flattened field names too).
@@ -159,6 +166,12 @@ _FLAT_ENDPOINT_RE = re.compile(r"\.endpoint_([A-Za-z0-9_:]+?)(?=\.|$)")
 _FLAT_OUTCOME_RE = re.compile(r"\.outcome_([A-Za-z0-9_]+?)(?=\.|$)")
 #: jsonl-flattened ``to`` label of ``breaker_transitions_total``.
 _FLAT_TO_RE = re.compile(r"\.to_([A-Za-z0-9_]+?)(?=\.|$)")
+#: jsonl-flattened ``module`` label of the ``dynamics_*`` families
+#: (obs/dynamics.py).
+_FLAT_MODULE_RE = re.compile(r"\.module_([A-Za-z0-9_]+?)(?=\.|$)")
+#: Dynamics module names: sanitized first parameter-path components
+#: (obs/dynamics.py _sanitize) — identifier grammar.
+_MODULE_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
 
 #: One Prometheus exposition sample: name, optional {labels}, value.
 _PROM_SAMPLE_RE = re.compile(
@@ -212,6 +225,9 @@ DEFAULT_ALERTS_GLOB = os.path.join(
 )
 DEFAULT_INCIDENT_GLOB = os.path.join(
     REPO, "ARTIFACTS", "*", "incidents", "*", "manifest.json"
+)
+DEFAULT_DYNAMICS_GLOB = os.path.join(
+    REPO, "ARTIFACTS", "*", "dynamics*.jsonl"
 )
 
 #: The documented exclusive wall-time buckets (obs/goodput.py BUCKETS —
@@ -481,6 +497,26 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                 errors.append(
                     f"line {lineno}: field {k!r} value {v!r} is not a "
                     "breaker state encoding (0=closed, 1=half_open, 2=open)"
+                )
+        if k.startswith("dynamics_"):
+            # flattened ``module`` label of the training-dynamics
+            # families: a malformed module name forks the per-layer
+            # divergence series (obs/dynamics.py sanitizes to
+            # identifier grammar)
+            m = _FLAT_MODULE_RE.search(k)
+            if m and not _MODULE_NAME_RE.match(m.group(1)):
+                errors.append(
+                    f"line {lineno}: field {k!r} carries malformed "
+                    f"dynamics module name {m.group(1)!r}"
+                )
+            if k.startswith(("dynamics_nonfinite_grads_total",
+                             "dynamics_provenance_total")) \
+                    and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool) \
+                    and math.isfinite(v) and v < 0:
+                errors.append(
+                    f"line {lineno}: field {k!r} is negative ({v}) — the "
+                    "dynamics counters are monotonic"
                 )
         if k.startswith("slo_burn_rate"):
             m = _FLAT_WINDOW_RE.search(k)
@@ -1491,6 +1527,24 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                             )
                     except ValueError:
                         pass  # already reported above
+            if name.startswith("dynamics_"):
+                labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
+                module = labels.get("module")
+                if module is not None and not _MODULE_NAME_RE.match(module):
+                    errors.append(
+                        f"line {i}: {name} carries malformed dynamics "
+                        f"module name {module!r}"
+                    )
+                if name in ("dynamics_nonfinite_grads_total",
+                            "dynamics_provenance_total"):
+                    try:
+                        if float(value) < 0:
+                            errors.append(
+                                f"line {i}: {name} is negative ({value}) — "
+                                "the dynamics counters are monotonic"
+                            )
+                    except ValueError:
+                        pass  # already reported above
             if name == "slo_burn_rate":
                 labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
                 window = labels.get("window")
@@ -1934,6 +1988,155 @@ def check_incident_manifest(path: str) -> tuple[list[str], list[str]]:
     return errors, warnings
 
 
+def _num_or_sentinel(v) -> bool:
+    """A dynamics stat value: a number, or a writer sentinel string."""
+    if v in ("NaN", "Infinity", "-Infinity"):
+        return True
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def check_dynamics_file(path: str) -> tuple[list[str], list[str]]:
+    """Validate one ``dynamics.jsonl`` training-dynamics stream
+    (obs/dynamics.py): non-decreasing ``t``; a constant positive
+    ``every`` dividing every ``step`` (the in-graph ``lax.cond`` cadence
+    contract — an off-cadence row means the gate is broken); step
+    rewinds allowed (supervised restart replays the window) but never
+    two consecutive rows for the same step; identifier-grammar module
+    names; per-module stats finite or sentinel-flagged with
+    non-negative integer ``nonfinite_grads`` counts summing to the
+    row's ``nonfinite_total``."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    required = ("t", "step", "every", "global_grad_norm",
+                "nonfinite_total", "modules")
+    stats_known = ("grad_norm", "param_norm", "update_ratio",
+                   "nonfinite_grads")
+    prev_t: float | None = None
+    prev_step: int | None = None
+    file_every: int | None = None
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: invalid JSON ({e})")
+                continue
+            if not isinstance(row, dict):
+                errors.append(f"line {i}: row is {type(row).__name__}, "
+                              "not an object")
+                continue
+            missing = [k for k in required if k not in row]
+            if missing:
+                errors.append(f"line {i}: missing keys {missing}")
+                continue
+            t = row["t"]
+            if not isinstance(t, (int, float)) or isinstance(t, bool) \
+                    or not math.isfinite(t):
+                errors.append(f"line {i}: 't' {t!r} is not a finite number")
+            else:
+                if prev_t is not None and t < prev_t:
+                    errors.append(
+                        f"line {i}: 't' went backwards "
+                        f"({prev_t} -> {t})"
+                    )
+                prev_t = float(t)
+            every = row["every"]
+            if isinstance(every, bool) or not isinstance(every, int) \
+                    or every <= 0:
+                errors.append(f"line {i}: 'every' {every!r} is not a "
+                              "positive integer")
+                every = None
+            elif file_every is None:
+                file_every = every
+            elif every != file_every:
+                errors.append(
+                    f"line {i}: 'every' changed mid-stream "
+                    f"({file_every} -> {every}) — the cadence is fixed "
+                    "at monitor construction"
+                )
+            step = row["step"]
+            if isinstance(step, bool) or not isinstance(step, int) \
+                    or step < 0:
+                errors.append(f"line {i}: 'step' {step!r} is not a "
+                              "non-negative integer")
+            else:
+                if every and step % every != 0:
+                    errors.append(
+                        f"line {i}: step {step} is not a multiple of the "
+                        f"cadence ({every}) — the lax.cond gate booked an "
+                        "off-cadence row"
+                    )
+                if prev_step is not None and step == prev_step:
+                    errors.append(
+                        f"line {i}: step {step} repeats the previous row "
+                        "(rewinds after a restart are fine; an exact "
+                        "repeat means double-booking)"
+                    )
+                elif prev_step is not None and step < prev_step:
+                    warnings.append(
+                        f"line {i}: step went backwards "
+                        f"({prev_step} -> {step}) — supervised restart "
+                        "replay"
+                    )
+                prev_step = step
+            if not _num_or_sentinel(row["global_grad_norm"]):
+                errors.append(
+                    f"line {i}: 'global_grad_norm' "
+                    f"{row['global_grad_norm']!r} is neither a number nor "
+                    "a non-finite sentinel"
+                )
+            nft = row["nonfinite_total"]
+            if isinstance(nft, bool) or not isinstance(nft, int) or nft < 0:
+                errors.append(f"line {i}: 'nonfinite_total' {nft!r} is not "
+                              "a non-negative integer")
+                nft = None
+            modules = row["modules"]
+            if not isinstance(modules, dict):
+                errors.append(f"line {i}: 'modules' is not an object")
+                continue
+            counted = 0
+            for mname, stats in modules.items():
+                if not isinstance(mname, str) \
+                        or not _MODULE_NAME_RE.match(mname):
+                    errors.append(f"line {i}: malformed module name "
+                                  f"{mname!r}")
+                if not isinstance(stats, dict):
+                    errors.append(f"line {i}: module {mname!r} stats is "
+                                  f"{type(stats).__name__}, not an object")
+                    continue
+                for sk, sv in stats.items():
+                    if sk not in stats_known:
+                        warnings.append(
+                            f"line {i}: module {mname!r} carries unknown "
+                            f"stat {sk!r} (known: {stats_known})"
+                        )
+                    elif sk == "nonfinite_grads":
+                        if isinstance(sv, bool) or not isinstance(sv, int) \
+                                or sv < 0:
+                            errors.append(
+                                f"line {i}: module {mname!r} "
+                                f"'nonfinite_grads' {sv!r} is not a "
+                                "non-negative integer"
+                            )
+                        else:
+                            counted += sv
+                    elif not _num_or_sentinel(sv):
+                        errors.append(
+                            f"line {i}: module {mname!r} stat {sk!r} "
+                            f"{sv!r} is neither a number nor a non-finite "
+                            "sentinel"
+                        )
+            if nft is not None and counted != nft:
+                errors.append(
+                    f"line {i}: 'nonfinite_total' {nft} != sum of module "
+                    f"'nonfinite_grads' ({counted})"
+                )
+    return errors, warnings
+
+
 def _load_json_doc(path: str):
     with open(path) as f:
         return json.load(f)
@@ -1981,6 +2184,8 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
         return check_history_file(path)
     if os.path.basename(path).startswith("alerts"):
         return check_alerts_file(path)
+    if os.path.basename(path).startswith("dynamics"):
+        return check_dynamics_file(path)
     if os.path.basename(path) == "manifest.json" \
             and "incidents" in os.path.abspath(path).split(os.sep):
         return check_incident_manifest(path)
@@ -2025,6 +2230,7 @@ def main(argv: list[str] | None = None) -> int:
         + glob.glob(DEFAULT_JOURNAL_GLOB)
         + glob.glob(DEFAULT_ALERTS_GLOB)
         + glob.glob(DEFAULT_INCIDENT_GLOB)
+        + glob.glob(DEFAULT_DYNAMICS_GLOB)
     )
     if not paths:
         print(f"no metrics.jsonl found under {DEFAULT_GLOB}", file=sys.stderr)

@@ -218,6 +218,17 @@ def _collect_window_evidence(s: Streams, kind: str | None, t0: float,
         t = e.get("t")
         if not _finite(t) or not t0 < t <= t1:
             continue
+        if e.get("kind") == "nan_provenance":
+            # The dynamics monitor NAMED the first non-finite module —
+            # near-conclusive for a nan_loss cause, still strong damage
+            # evidence for anything else that poisoned the numerics.
+            score += 4.0 if kind == "nan_loss" else 2.0
+            ev.append(_cite(
+                "flight.jsonl", t,
+                f"nan_provenance named module "
+                f"'{e.get('module') or '?'}' "
+                f"(via {e.get('method', '?')}) +{t - t0:.1f}s after onset"))
+            continue
         if e.get("kind") in DAMAGE_FLIGHT_KINDS:
             score += 2.0
             ev.append(_cite("flight.jsonl", t,

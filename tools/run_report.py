@@ -60,9 +60,10 @@ Pure stdlib + numpy-free on purpose: must run anywhere the logs land.
 Exit status: 0 = report rendered from a healthy stream; 1 = the metric
 stream had unparseable lines or no valid rows (CI gates on this —
 ``trace.jsonl``, ``captures.jsonl``, ``faults.jsonl``,
-``requests.jsonl``, ``steps.jsonl``, ``goodput.json``, and
-``fleet.json`` parse errors gate it too, matching the stream-gating
-convention); missing ``metrics.jsonl`` is a hard SystemExit.
+``requests.jsonl``, ``steps.jsonl``, ``dynamics.jsonl``,
+``goodput.json``, and ``fleet.json`` parse errors gate it too, matching
+the stream-gating convention); missing ``metrics.jsonl`` is a hard
+SystemExit.
 """
 
 from __future__ import annotations
@@ -955,6 +956,61 @@ def alerts_summary(logdir: str) -> tuple[dict, int]:
     return out, bad
 
 
+def dynamics_summary(logdir: str, flight: list[dict]) -> tuple[dict, int]:
+    """``(training-dynamics digest, parse errors)`` from
+    ``<logdir>/dynamics.jsonl`` (obs/dynamics.py cadence rows): cadence
+    coverage, global-grad-norm envelope, per-module last/peak stats,
+    non-finite rows, and the flight stream's last ``nan_provenance``
+    verdict.  Empty when the run carried no ``--dynamics-every``
+    telemetry."""
+    path = os.path.join(logdir, "dynamics.jsonl")
+    if not os.path.exists(path):
+        return {}, 0
+    rows, bad = _load_jsonl(path)
+    rows = [r for r in rows if isinstance(r.get("step"), int)]
+    if not rows:
+        return ({"rows": 0} if not bad else {}), bad
+    gnorms = [r["global_grad_norm"] for r in rows
+              if isinstance(r.get("global_grad_norm"), (int, float))
+              and math.isfinite(r["global_grad_norm"])]
+    modules: dict[str, dict] = {}
+    for r in rows:
+        for m, stats in (r.get("modules") or {}).items():
+            if not isinstance(stats, dict):
+                continue
+            d = modules.setdefault(m, {"nonfinite_grads": 0})
+            for k in ("grad_norm", "param_norm", "update_ratio"):
+                v = stats.get(k)
+                v = _NONFINITE.get(v, v) if isinstance(v, str) else v
+                if isinstance(v, (int, float)) and math.isfinite(v):
+                    d[k] = v  # last finite value wins
+                    if k == "update_ratio":
+                        d["update_ratio_max"] = max(
+                            d.get("update_ratio_max", 0.0), v)
+            nf = stats.get("nonfinite_grads")
+            if isinstance(nf, int) and not isinstance(nf, bool):
+                d["nonfinite_grads"] += nf
+    out = {
+        "rows": len(rows),
+        "every": rows[-1].get("every"),
+        "steps": {"first": rows[0]["step"], "last": rows[-1]["step"]},
+        "global_grad_norm": {
+            "last": gnorms[-1] if gnorms else None,
+            "max": max(gnorms) if gnorms else None,
+        },
+        "nonfinite_steps": [r["step"] for r in rows
+                            if r.get("nonfinite_total")],
+        "modules": {m: modules[m] for m in sorted(modules)},
+    }
+    prov = [e for e in flight if e.get("kind") == "nan_provenance"]
+    if prov:
+        out["provenance"] = {
+            k: prov[-1].get(k)
+            for k in ("step", "module", "reason", "method")
+        }
+    return out, bad
+
+
 def load_goodput(logdir: str) -> tuple[dict, int]:
     """``(goodput summary, parse errors)`` from ``<logdir>/goodput.json``
     (the GoodputLedger document; empty summary when absent)."""
@@ -1016,6 +1072,7 @@ def build_report(logdir: str) -> dict:
     fleet, bad_fleet = fleet_summary(logdir, train, trace, flight)
     rpc, bad_journal = rpc_summary(train, logdir)
     alerts, bad_alerts = alerts_summary(logdir)
+    dynamics, bad_dynamics = dynamics_summary(logdir, flight)
 
     times, source = step_times(train, trace)
     times_sorted = sorted(times)
@@ -1053,6 +1110,7 @@ def build_report(logdir: str) -> dict:
         "fleet": fleet,
         "rpc": rpc,
         "alerts": alerts,
+        "dynamics": dynamics,
         # metric-stream health: any unparseable metrics.jsonl / trace /
         # captures / faults / requests line (or an unreadable
         # goodput.json / fleet.json / dispatcher.journal) makes main()
@@ -1060,7 +1118,7 @@ def build_report(logdir: str) -> dict:
         "parse_errors": (bad_metrics + bad_trace + bad_goodput
                          + bad_captures + bad_faults + bad_requests
                          + bad_steps + bad_fleet + bad_journal
-                         + bad_alerts),
+                         + bad_alerts + bad_dynamics),
         "final_metrics": {
             k: v for k, v in final_train.items()
             if k in ("step", "loss", "accuracy", "steps_per_sec",
@@ -1407,6 +1465,45 @@ def render(report: dict) -> str:
                 f"  incident {b.get('dir')}: rule {b.get('rule')} "
                 f"[{b.get('severity')}], {b.get('files', 0)} evidence "
                 "file(s)")
+    dyn = report.get("dynamics")
+    if dyn and dyn.get("rows"):
+        st = dyn.get("steps") or {}
+        lines += [
+            "",
+            (
+                f"training dynamics: {dyn['rows']} cadence row(s) "
+                f"(every {dyn.get('every')}, steps "
+                f"{st.get('first')}..{st.get('last')})"
+            ),
+        ]
+        gg = dyn.get("global_grad_norm") or {}
+        if isinstance(gg.get("last"), (int, float)):
+            lines.append(
+                f"  global grad norm: last {gg['last']:.4g}, "
+                f"max {gg.get('max', float('nan')):.4g}"
+            )
+        for m, d in (dyn.get("modules") or {}).items():
+            bits = []
+            for key, label in (("grad_norm", "grad"),
+                               ("param_norm", "param"),
+                               ("update_ratio", "upd")):
+                if isinstance(d.get(key), (int, float)):
+                    bits.append(f"{label} {d[key]:.4g}")
+            if d.get("nonfinite_grads"):
+                bits.append(f"NONFINITE x{d['nonfinite_grads']}")
+            lines.append(f"  module {m:<12} " + "  ".join(bits))
+        if dyn.get("nonfinite_steps"):
+            lines.append(
+                "  NON-FINITE gradient row(s) at step(s): "
+                f"{dyn['nonfinite_steps']}"
+            )
+        prov = dyn.get("provenance")
+        if prov:
+            lines.append(
+                f"  nan provenance: module '{prov.get('module') or '?'}' "
+                f"first non-finite at step {prov.get('step')} "
+                f"({prov.get('reason')}, via {prov.get('method')})"
+            )
     sto = report.get("step_time_opt")
     if sto:
         parts = []

@@ -48,6 +48,11 @@ Tracks (one Chrome-trace "process" per stream):
   instant, one lane per rule, named ``<rule> fired`` / ``<rule>
   resolved`` — whether the alert landed before or after the damage it
   describes reads directly off the shared clock.
+- **training dynamics** — every ``dynamics.jsonl`` cadence row
+  (``obs.dynamics``) as ``global_grad_norm`` / ``nonfinite_grads``
+  counter tracks, with an instant marking each non-finite row — the
+  divergence early-warning signal lines up against checkpoints,
+  faults, and alerts on the shared clock.
 
 Timestamp reconstruction: ``trace.jsonl`` spans carry durations only, so
 step rows are anchored to the flight recorder's absolute ``step`` events
@@ -75,6 +80,7 @@ PID_CAPTURES = 3
 PID_GOODPUT = 4
 PID_STEPS = 5
 PID_ALERTS = 6
+PID_DYNAMICS = 7
 #: --fleet: the shared cross-process trace group; per-logdir pids are
 #: offset by _FLEET_PID_STRIDE * index.
 PID_FLEET_TRACES = 90
@@ -177,8 +183,10 @@ def build_timeline(logdir: str) -> dict:
     captures = load_jsonl(os.path.join(logdir, "captures.jsonl"))
     steps = load_jsonl(os.path.join(logdir, "steps.jsonl"))
     alerts = load_jsonl(os.path.join(logdir, "alerts.jsonl"))
+    dynamics = load_jsonl(os.path.join(logdir, "dynamics.jsonl"))
     gens = load_goodput(logdir)
-    if not (trace or flight or captures or steps or gens or alerts):
+    if not (trace or flight or captures or steps or gens or alerts
+            or dynamics):
         raise SystemExit(
             f"{logdir}: no telemetry streams (trace.jsonl / flight.jsonl / "
             "captures.jsonl / steps.jsonl / goodput.json) — is this a "
@@ -213,6 +221,10 @@ def build_timeline(logdir: str) -> dict:
         t = _num(a.get("t"))
         if t is not None:
             absolutes.append(t)
+    for r in dynamics:
+        t = _num(r.get("t"))
+        if t is not None:
+            absolutes.append(t)
     t0 = min(absolutes) if absolutes else 0.0
     t0_us = t0 * 1e6
 
@@ -225,6 +237,8 @@ def build_timeline(logdir: str) -> dict:
         _meta(events, PID_STEPS, "engine steps (steps.jsonl)", 4)
     if alerts:
         _meta(events, PID_ALERTS, "alerts (alerts.jsonl)", 5)
+    if dynamics:
+        _meta(events, PID_DYNAMICS, "training dynamics (dynamics.jsonl)", 6)
 
     # -- flight events: one lane per kind, instants ---------------------------
     kind_tid: dict[str, int] = {}
@@ -383,6 +397,39 @@ def build_timeline(logdir: str) -> dict:
                         "name": key, "ts": ts, "args": {key: v},
                     })
 
+    # -- training-dynamics lane (dynamics.jsonl counter tracks) ---------------
+    if dynamics:
+        events.append({"ph": "M", "pid": PID_DYNAMICS, "tid": 1,
+                       "name": "thread_name",
+                       "args": {"name": "non-finite rows"}})
+        for r in dynamics:
+            t = _num(r.get("t"))
+            if t is None:
+                continue
+            ts = round(t * 1e6 - t0_us, 3)
+            g = _num(r.get("global_grad_norm"))
+            if g is not None and g == g and abs(g) != float("inf"):
+                events.append({
+                    "ph": "C", "pid": PID_DYNAMICS, "tid": 0,
+                    "name": "global_grad_norm", "ts": ts,
+                    "args": {"global_grad_norm": g},
+                })
+            nft = _num(r.get("nonfinite_total"))
+            if nft is not None:
+                events.append({
+                    "ph": "C", "pid": PID_DYNAMICS, "tid": 0,
+                    "name": "nonfinite_grads", "ts": ts,
+                    "args": {"nonfinite_grads": nft},
+                })
+            if nft:
+                events.append({
+                    "ph": "i", "s": "t", "pid": PID_DYNAMICS, "tid": 1,
+                    "name": f"non-finite grads (step {r.get('step')})",
+                    "ts": ts,
+                    "args": {"step": r.get("step"),
+                             "nonfinite_total": nft},
+                })
+
     # -- alerts: one lane per rule, fired/resolved instants -------------------
     rule_tid: dict[str, int] = {}
     for a in alerts:
@@ -415,6 +462,7 @@ def build_timeline(logdir: str) -> dict:
                 "goodput_generations": len(gens),
                 "engine_steps": len(steps),
                 "alerts": len(alerts),
+                "dynamics_rows": len(dynamics),
             },
         },
     }

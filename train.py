@@ -596,6 +596,15 @@ def main() -> None:
                    help="greedy merge threshold (MiB of parameter bytes) "
                         "for --overlap's per-layer-group gradient buckets")
     p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--dynamics-every", type=int, default=0,
+                   help="training-dynamics telemetry cadence (obs.dynamics): "
+                        "every N optimizer steps the train step computes "
+                        "per-module grad/param/update statistics in-graph "
+                        "(lax.cond-gated — off-cadence steps pay ~nothing), "
+                        "flushed at log boundaries into dynamics.jsonl, the "
+                        "dynamics_* metric families, and GET /dynamicz; a "
+                        "non-finite loss or grad triggers the NaN-provenance "
+                        "pass.  0 disables")
     p.add_argument("--eval-every", type=int, default=0)
     p.add_argument("--target-metric", default=None,
                    help="stop when this eval metric reaches --target-value "
@@ -1087,12 +1096,12 @@ def main() -> None:
         train_step = make_multi_train_step(
             wl.loss_fn, mesh, specs,
             steps_per_call=args.steps_per_call, accum_steps=accum,
-            overlap=overlap_plan,
+            overlap=overlap_plan, dynamics_every=args.dynamics_every,
         )
     else:
         train_step = make_train_step(
             wl.loss_fn, mesh, specs, accum_steps=accum,
-            overlap=overlap_plan,
+            overlap=overlap_plan, dynamics_every=args.dynamics_every,
         )
     eval_step = (
         make_eval_step(wl.eval_fn, mesh, specs) if wl.eval_fn else None
@@ -1327,6 +1336,27 @@ def main() -> None:
     train_iter = None  # supervised runs build theirs via make_train_iter
     if chaos is not None:
         train_step = chaos.wrap_train_step(train_step)
+    dynamics_monitor = None
+    if args.dynamics_every > 0:
+        from distributedtensorflow_tpu.models import make_nan_taps
+        from distributedtensorflow_tpu.obs.dynamics import DynamicsMonitor
+
+        dynamics_monitor = DynamicsMonitor(
+            args.dynamics_every,
+            logdir=args.logdir,
+            loss_fn=wl.loss_fn,
+            tap_fn=make_nan_taps(wl.model),
+            log_every=args.log_every,
+            steps_per_call=args.steps_per_call,
+        )
+        # OUTSIDE the chaos wrapper: the provenance pass must probe the
+        # post-injection state the optimizer actually consumed, not the
+        # clean state chaos was about to poison.
+        train_step = dynamics_monitor.wrap_train_step(train_step)
+        logging.info(
+            "dynamics: in-graph module telemetry every %d step(s) -> "
+            "%s/dynamics.jsonl", args.dynamics_every, args.logdir,
+        )
 
     trainer = Trainer(
         train_step,
@@ -1340,6 +1370,7 @@ def main() -> None:
             eval_steps=0 if args.eval_data_dir else 10,
             checkpoint_every=args.checkpoint_every,
             steps_per_call=args.steps_per_call,
+            dynamics_every=args.dynamics_every,
             input_prebundled=args.steps_per_call > 1,
             zero_stage=1 if zero_sharder is not None else 0,
             quant=args.quant,
@@ -1382,9 +1413,14 @@ def main() -> None:
         checkpointer=checkpointer,
         preemption=preemption,
         # The injector is a Callback: its on_step_end fires the
-        # worker-kill / data-stall / preemption triggers.
-        callbacks=[chaos] if chaos is not None else None,
+        # worker-kill / data-stall / preemption triggers.  The dynamics
+        # monitor rides the same protocol (books cadence rows, flushes
+        # at log boundaries, runs NaN provenance on anomalies).
+        callbacks=[cb for cb in (chaos, dynamics_monitor)
+                   if cb is not None] or None,
     )
+    if dynamics_monitor is not None and trainer.status_server is not None:
+        dynamics_monitor.install(trainer.status_server)
 
     # Fleet observability plane (ISSUE 11): the chief scrapes every peer
     # StatusServer — itself, the data-service workers' embedded servers,
@@ -1467,6 +1503,10 @@ def main() -> None:
         ).install(trainer.status_server).start()
         logging.info("metrics history: fleet-merged sampling every %.1fs "
                      "(GET /histz)", args.fleet_interval)
+        if dynamics_monitor is not None:
+            # Late attach: the monitor pins every dynamics_* series at its
+            # first flush so the cap never evicts the divergence signal.
+            dynamics_monitor.attach_history(metrics_history)
     alert_manager = None
     if args.alert_rules:
         import json as jsonlib3
@@ -1631,6 +1671,8 @@ def main() -> None:
             metrics_history.stop()
         if fleet_agg is not None:
             fleet_agg.stop()
+        if dynamics_monitor is not None:
+            dynamics_monitor.close()
         if (slo_monitor is not None or fleet_agg is not None
                 or alert_manager is not None) and args.logdir:
             from distributedtensorflow_tpu.obs import registry as _reglib
