@@ -107,6 +107,7 @@ import numpy as np
 
 from ..obs import registry as obs_registry
 from ..obs import tracing as obs_tracing
+from ..obs import usage as obs_usage
 from ..utils.metrics import json_sanitize
 from . import draft as spec_draft
 from . import sampling
@@ -149,6 +150,10 @@ class GenRequest:
     #: the queue/prefill/decode spans the engine emits into trace.jsonl
     #: carry it, so a slow request's time is attributable end to end.
     trace_id: str = ""
+    #: Validated tenant identity (``obs.usage.validate_tenant``): the
+    #: unit of resource attribution — every requests.jsonl row, step-log
+    #: admission, and usage-ledger integral is keyed by it.
+    tenant: str = obs_usage.DEFAULT_TENANT
     #: Absolute wall deadline (0 = none): a request still QUEUED past it
     #: is abandoned at admission instead of decoded for a client that
     #: already stopped listening (net-layer deadline honored end to end).
@@ -500,6 +505,17 @@ class Engine:
             self._met_log = open(os.path.join(logdir, "metrics.jsonl"), "a")
             self._step_log = open(os.path.join(logdir, "steps.jsonl"), "a")
 
+        # Per-tenant usage ledger (ISSUE 19): fed from the loop thread
+        # with the SAME step wall + post-eviction census the step log
+        # records, so its integrals tile steps.jsonl by construction.
+        self.usage = obs_usage.UsageMeter(
+            registry=reg, logdir=logdir,
+            token_flops=obs_usage.estimate_token_flops(self.cfg),
+            max_slots=max_slots,
+            kv_blocks_total=self.kv.allocator.num_blocks,
+            flush_every=log_every,
+        )
+
     # -- submission (any thread) ---------------------------------------------
 
     def submit(
@@ -512,6 +528,7 @@ class Engine:
         eos_token_id: int | None = None,
         seed: int = 0,
         trace_id: str | None = None,
+        tenant: str | None = None,
         deadline_s: float | None = None,
         stream: bool = False,
     ) -> GenRequest:
@@ -567,6 +584,9 @@ class Engine:
                     f"trace_id must be 1..64 characters, got "
                     f"{len(trace_id)}"
                 )
+        # Validated BEFORE GenRequest construction so even the rejected
+        # path's requests.jsonl row carries a well-formed identity.
+        tenant = obs_usage.validate_tenant(tenant)
         if deadline_s is not None:
             deadline_s = float(deadline_s)
             if not math.isfinite(deadline_s) or deadline_s <= 0:
@@ -600,6 +620,7 @@ class Engine:
             temperature=float(temperature), top_k=int(top_k),
             eos_token_id=eos_token_id, seed=int(seed),
             trace_id=trace_id or obs_tracing.new_trace_id(),
+            tenant=tenant,
             t_submit=time.time(),
         )
         if deadline_s is not None:
@@ -629,6 +650,7 @@ class Engine:
             # The disk write happens OUTSIDE the scheduler lock: a 429
             # storm must not stall the decode loop on log I/O.
             self._log_request(req)
+            self.usage.on_finish(req)
             raise QueueFullError(
                 f"queue full ({self.max_queue} requests waiting)"
             )
@@ -717,24 +739,38 @@ class Engine:
         t3 = time.time()
         did = bool(admitted or chunks or occupancy)
         if did:
+            # Post-eviction census at t3 — the same instant and slot set
+            # the step record's active_slots reflects, so the usage
+            # ledger's per-tenant integrals tile the step-log occupancy
+            # integrals exactly (conservation by construction).
+            held = [
+                (r, self.kv.billed_blocks(i))
+                for i, r in enumerate(self._slots) if r is not None
+            ]
             self._log_step(
-                t0, t1, t2, t3, len(admitted), chunks, occupancy,
+                t0, t1, t2, t3, admitted, chunks, occupancy,
                 self.counters["decode_tokens"] - tokens0,
                 self.counters["spec_drafted"] - drafted0,
                 self.counters["spec_accepted"] - accepted0,
+                sum(b for _, b in held),
             )
+            self.usage.on_step(t3, t3 - t0, held, self._step_id)
         if did and self.decode_steps % self.log_every == 0:
             self._log_metrics_row()
         return did
 
     def _log_step(self, t0: float, t1: float, t2: float, t3: float,
-                  admitted: int, chunks: int, occupancy: int,
-                  tokens: int, drafted: int, accepted: int) -> None:
+                  admitted: list[GenRequest], chunks: int, occupancy: int,
+                  tokens: int, drafted: int, accepted: int,
+                  blocks_billed: float) -> None:
         """One structured record for the iteration that just ran: phase
         mix, occupancy, per-phase token deltas, and the wall split —
         admit/prefill/decode phases plus the device share (time blocked
         dispatching compiled programs and fetching their results; the
-        remainder is host scheduling/bookkeeping)."""
+        remainder is host scheduling/bookkeeping).  ``blocks_billed`` is
+        the pool's refcount-weighted block census at t3 (the usage
+        ledger's conservation reference); admissions are additionally
+        broken down by tenant."""
         phases = []
         if admitted:
             phases.append("admit")
@@ -752,7 +788,7 @@ class Engine:
             "active_slots": sum(r is not None for r in self._slots),
             "filling_slots": len(self._filling),
             "queue_depth": len(self._queue),
-            "admitted": admitted,
+            "admitted": len(admitted),
             "evicted": self._step_evicted,
             "prefill_chunks": chunks,
             "budget_stall": int(self._prefill_stalled),
@@ -765,7 +801,13 @@ class Engine:
             "step_s": round(t3 - t0, 6),
             "device_s": round(device_s, 6),
             "host_s": round(max((t3 - t0) - device_s, 0.0), 6),
+            "kv_blocks_billed": round(blocks_billed, 4),
         }
+        if admitted:
+            by_tenant: dict[str, int] = {}
+            for r in admitted:
+                by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
+            rec["admitted_tenants"] = by_tenant
         with self._log_lock:
             # ring appended under the log lock so a /stepz snapshot
             # (HTTP thread) never races the engine thread's append;
@@ -877,6 +919,8 @@ class Engine:
             self._finish(req, None, status="error")
         self._m_active.set(sum(r is not None for r in self._slots))
         self._update_kv_metrics()
+        for req in admitted:
+            self.usage.on_admit(req)
         return admitted
 
     def _run_prefill_budget(self) -> int:
@@ -997,6 +1041,7 @@ class Engine:
         req._t_attr = req.t_first_token
         self._iter_device_s += req.t_first_token - t_sample0
         req.tokens.append(tok)
+        self.usage.on_tokens(req, 1)
         self._last_tokens[req.slot] = tok
         self._m_ttft.observe(req.ttft_s)
         self._stream_emit(req, [tok])
@@ -1073,6 +1118,7 @@ class Engine:
         req.occ_steps += 1
         req.occ_max = max(req.occ_max, n_active)
         req.tokens.extend(kept)
+        self.usage.on_tokens(req, len(kept))
         self.counters["decode_tokens"] += len(kept)
         self._m_tok_step.observe(float(len(kept)))
         if req._t_last_token:
@@ -1261,6 +1307,7 @@ class Engine:
         self._m_active.set(sum(r is not None for r in self._slots))
         self._update_kv_metrics()
         self._log_request(req)
+        self.usage.on_finish(req)
         if req._events is not None:
             req._events.put(("done", None))
         req._done.set()
@@ -1390,6 +1437,9 @@ class Engine:
             if self._step_log is not None:
                 self._step_log.close()
                 self._step_log = None
+        # Final per-tenant rollup (``final: true``) before the registry
+        # snapshot so usage.jsonl always ends with the ledger's totals.
+        self.usage.close()
         if self.logdir:
             self._registry.write_prometheus(
                 os.path.join(self.logdir, "metrics.prom")
@@ -1420,7 +1470,8 @@ class Engine:
             queue_depth = len(self._queue)
         slots = [
             None if r is None else {
-                "id": r.id, "seq_len": int(self.kv.seq_lens[i]),
+                "id": r.id, "tenant": r.tenant,
+                "seq_len": int(self.kv.seq_lens[i]),
                 "new_tokens": len(r.tokens),
                 "max_new_tokens": r.max_new_tokens,
                 "phase": "decode" if r._prefill_done else "prefill",
@@ -1469,6 +1520,7 @@ class Engine:
             "prompt_tokens": len(req.prompt),
             "new_tokens": len(req.tokens),
             "trace_id": req.trace_id,
+            "tenant": req.tenant,
         }
         if req.status == "ok":
             row.update(

@@ -558,6 +558,19 @@ class PagedKVCache:
 
     # -- introspection -------------------------------------------------------
 
+    def billed_blocks(self, slot: int) -> float:
+        """Refcount-weighted block footprint of one slot: each mapped
+        block charged at ``1/refcount``, so a prefix block shared by N
+        slots costs each of them 1/N and summing over all occupied slots
+        can never exceed the pool's mapped-block count (the per-tenant
+        usage ledger's no-double-billing invariant).  Engine thread only,
+        like all host-side page-table state."""
+        pages = self.pages[slot]
+        if pages is None:
+            return 0.0
+        alloc = self.allocator
+        return sum(1.0 / alloc.refcount(b) for b in pages.blocks)
+
     def stats(self) -> dict:
         """Pool occupancy, internal fragmentation, and prefix-cache
         occupancy/hit-rate (for ``GET /generatez``, the registry gauges,

@@ -11,15 +11,19 @@ Endpoint contract (docs/API.md "Serving"):
 
 - ``POST /generatez`` — body ``{"prompt": [int, ...], "max_new_tokens":
   int, "temperature"?: float, "top_k"?: int, "eos_token_id"?: int,
-  "seed"?: int, "timeout_s"?: float, "trace_id"?: str, "stream"?:
-  bool}``.  Blocks until the request reaches a terminal state; replies
-  200 ``{"id", "tokens", "trace_id", "finish_reason", "prompt_tokens",
-  "new_tokens", "ttft_s", "tpot_s", "e2e_s", "drafted", "accepted"}``.
-  ``trace_id`` is the distributed-tracing id the engine's
-  queue/prefill/decode spans carry (generated when absent).  Error
-  mapping: malformed body/parameters → 400, queue full (backpressure) →
-  429, engine failure → 500, wall-clock timeout → 504 (the request keeps
-  running server-side; poll ``GET /generatez`` for slot state).
+  "seed"?: int, "timeout_s"?: float, "trace_id"?: str, "tenant"?: str,
+  "stream"?: bool}``.  Blocks until the request reaches a terminal
+  state; replies 200 ``{"id", "tokens", "trace_id", "tenant",
+  "finish_reason", "prompt_tokens", "new_tokens", "ttft_s", "tpot_s",
+  "e2e_s", "drafted", "accepted"}``.  ``trace_id`` is the
+  distributed-tracing id the engine's queue/prefill/decode spans carry
+  (generated when absent); ``tenant`` is the validated usage-metering
+  identity (identifier-style, <= 64 chars; defaults to ``"default"``)
+  every requests.jsonl row and ``GET /usagez`` integral is keyed by.
+  Error mapping: malformed body/parameters → 400, queue full
+  (backpressure) → 429, engine failure → 500, wall-clock timeout → 504
+  (the request keeps running server-side; poll ``GET /generatez`` for
+  slot state).
 
   With ``"stream": true`` the reply is a chunked-transfer
   ``application/x-ndjson`` stream: one ``{"tokens": [int, ...]}`` line
@@ -202,6 +206,15 @@ class ServeServer:
                 return 400, {"error": f"bad 'trace_id': {trace_id!r} "
                                       "(a 1..64-char string)"}
             kwargs["trace_id"] = trace_id
+        tenant = payload.get("tenant")
+        if tenant is not None:
+            # Usage-metering identity: the engine validates the grammar
+            # (identifier-style) and maps violations to ValueError → 400
+            # below; only the type is checked here.
+            if not isinstance(tenant, str):
+                return 400, {"error": f"bad 'tenant': {tenant!r} "
+                                      "(a string)"}
+            kwargs["tenant"] = tenant
         timeout = payload.get("timeout_s")
         if timeout is None:
             timeout = self._default_timeout_s
@@ -260,6 +273,7 @@ class ServeServer:
             "id": req.id,
             "tokens": req.tokens,
             "trace_id": req.trace_id,
+            "tenant": req.tenant,
             "finish_reason": req.finish_reason,
             "prompt_tokens": len(req.prompt),
             "new_tokens": len(req.tokens),

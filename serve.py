@@ -3,7 +3,8 @@
 
 Loads a GPT config (checkpoint or random init), builds the paged-KV
 serving engine, and fronts it with the ``/generatez`` HTTP endpoint plus
-the whole ``/statusz`` introspection family.  One process per host; the
+the whole ``/statusz`` introspection family (including the per-tenant
+usage ledger at ``GET /usagez``).  One process per host; the
 model may be mesh-sharded (GSPMD partitions both serving programs the
 same way it partitions ``models.generate``).
 
@@ -158,8 +159,9 @@ def main(argv=None) -> int:
                    help="reject requests asking for more new tokens")
     p.add_argument("--logdir", default=None,
                    help="writes requests.jsonl / metrics.jsonl / "
-                        "steps.jsonl / history.jsonl / metrics.prom "
-                        "(and, with tracing, trace.jsonl) here")
+                        "steps.jsonl / usage.jsonl / history.jsonl / "
+                        "metrics.prom (and, with tracing, trace.jsonl) "
+                        "here")
     p.add_argument("--drain-timeout", type=float, default=30.0,
                    help="bounded SIGTERM drain: refuse new submits with "
                         "503 immediately, finish in-flight requests, and "
@@ -260,6 +262,10 @@ def main(argv=None) -> int:
         log_every=args.log_every, step_ring=args.step_ring,
     ).start()
     server = ServeServer(engine, args.port, host=args.host).start()
+    # Per-tenant usage ledger: GET /usagez next to the generation
+    # endpoint (text / ?json / ?tenant= filter; usage.jsonl under
+    # --logdir via the engine).
+    engine.usage.install(server.status_server)
 
     slo_monitor = None
     if args.slo_rules:
@@ -289,6 +295,9 @@ def main(argv=None) -> int:
             logdir=args.logdir,
             rules=slo_monitor.rules if slo_monitor is not None else None,
         ).install(server.status_server).start()
+        # pin each tenant's usage series so tenant cardinality can't be
+        # crowded out of the sampling rings
+        engine.usage.attach_history(history)
         logging.info("metrics history: sampling every %.1fs (GET /histz)",
                      args.history_interval)
 

@@ -20,6 +20,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -481,3 +482,180 @@ def test_serve_smoke_fused_speculative_streaming(tmp_path):
     assert fp["fused_sampling"] is True and fp["speculate"] == 4
     assert fp["drafted"] > 0
     assert fp["dispatches_per_step"] == 1.0
+
+
+def test_serve_smoke_tenant_usage_overload(tmp_path):
+    """ISSUE 19 slow-lane smoke: serve.py with two tenants pushed through
+    overload.  Asserts the tenant identity lands in every stream, the
+    usage ledger conserves against the step log (schema checker enforces
+    the 2% gate), the token-rate quota alert fires over the tenant
+    family, /usagez serves the live ledger, and capacity_report reads
+    back saturation + shares + a what-if that agrees with the observed
+    queue-growth direction."""
+    logdir = str(tmp_path / "serve_tenants")
+    rules_path = str(tmp_path / "rules.json")
+    with open(rules_path, "w") as f:
+        json.dump({"alerts": [{
+            "name": "tenant_token_quota", "kind": "threshold",
+            "severity": "warn", "source": "registry",
+            "metric": "serve_tenant_tokens_per_s", "match": "prefix",
+            "op": "gt", "bound": 0.01, "agg": "max",
+            "window_s": 60, "cooldown_s": 1,
+        }]}, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--config", "gpt_tiny", "--port", "0",
+            "--max-slots", "2", "--max-queue", "8",
+            "--block-size", "8", "--prefill-chunk", "8",
+            "--prefix-cache",
+            "--max-context", "128", "--logdir", logdir,
+            "--log-every", "5", "--alert-rules", rules_path,
+            "--alert-interval", "0.5",
+        ],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        boot = json.loads(proc.stdout.readline())
+        port = boot["port"]
+        header = list(range(1, 25))  # shared 8-token blocks across tenants
+
+        ok: dict[int, dict] = {}
+        rejected = []
+
+        def client(i):
+            tenant = "alpha" if i % 2 == 0 else "beta"
+            payload = {"prompt": header + [100 + i],
+                       "max_new_tokens": 8, "tenant": tenant}
+            try:
+                _, body = _post(port, payload)
+                ok[i] = body
+            except urllib.error.HTTPError as e:
+                assert e.code == 429, e.code
+                rejected.append(i)
+
+        # simultaneous burst >> slots: real queueing, maybe real 429s
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert len(ok) + len(rejected) == 12
+        assert len(ok) >= 8  # queue depth 8 + 2 slots absorb most
+        for body in ok.values():
+            assert body["tenant"] in ("alpha", "beta")
+
+        # live ledger: both tenants metered, filter + 404 behave
+        usagez = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/usagez?json", timeout=10
+        ).read().decode())
+        for tenant in ("alpha", "beta"):
+            acc = usagez["tenants"][tenant]
+            assert acc["new_tokens"] > 0
+            assert acc["slot_s"] > 0 and acc["block_s"] > 0
+
+        # the quota alert fired over the tenant token-rate family
+        fired = None
+        deadline = time.time() + 30
+        while fired is None and time.time() < deadline:
+            alertz = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alertz?json", timeout=10
+            ).read().decode())
+            for rec in alertz.get("recent", []):
+                if rec["rule"] == "tenant_token_quota" and \
+                        rec["phase"] == "fired":
+                    fired = rec
+            time.sleep(0.5)
+        assert fired is not None, alertz
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, err[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+
+    # tenant identity in every stream
+    rows = [json.loads(line)
+            for line in open(os.path.join(logdir, "requests.jsonl"))]
+    assert {r["tenant"] for r in rows} >= {"alpha", "beta"}
+    steps = [json.loads(line)
+             for line in open(os.path.join(logdir, "steps.jsonl"))]
+    admitted = {}
+    for s in steps:
+        for k, v in s.get("admitted_tenants", {}).items():
+            admitted[k] = admitted.get(k, 0) + v
+    assert admitted.get("alpha", 0) > 0 and admitted.get("beta", 0) > 0
+
+    # conservation: the schema checker joins usage.jsonl against the
+    # sibling steps.jsonl occupancy integrals (2% gate) — and the
+    # alert stream validates alongside
+    chk = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_metrics_schema.py"),
+         os.path.join(logdir, "usage.jsonl"),
+         os.path.join(logdir, "requests.jsonl"),
+         os.path.join(logdir, "steps.jsonl"),
+         os.path.join(logdir, "alerts.jsonl")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+    alert_rows = [json.loads(line)
+                  for line in open(os.path.join(logdir, "alerts.jsonl"))]
+    assert any(a["rule"] == "tenant_token_quota" and a["phase"] == "fired"
+               for a in alert_rows)
+
+    # capacity_report: saturation under the burst, shares summing to 1,
+    # and a what-if projection that agrees with the observed trend
+    cap = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "capacity_report.py"),
+         logdir, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert cap.returncode == 0, cap.stderr[-2000:]
+    doc = json.loads(cap.stdout)
+    sat = doc["saturation"]
+    assert sat["saturated"] is True, sat  # 12 requests into 2 slots
+    for field in ("slot_share", "block_share", "new_tokens_share"):
+        total = sum(t[field] for t in doc["tenants"].values())
+        assert abs(total - 1.0) <= 0.01, (field, total)
+    # pick the offered rate to match the observed direction: a rate far
+    # past capacity must predict overload iff the queue was growing
+    rate = "1000" if sat["queue_depth_trend"] == "growing" else "0.001"
+    cap2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "capacity_report.py"),
+         logdir, "--json", "--rate", rate],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert cap2.returncode == 0, cap2.stderr[-2000:]
+    wi = json.loads(cap2.stdout)["what_if"]
+    if sat["queue_depth_trend"] != "unknown":
+        assert wi["agrees_with_observed_trend"] is True, wi
+
+    # run_report renders the usage & capacity section from the same run
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+         logdir, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    usg = json.loads(rep.stdout)["usage"]
+    assert {"alpha", "beta"} <= set(usg["tenants"])
+    assert usg["top_tenant_by_block_s"] in usg["tenants"]
+
+    # tail_report --tenant narrows to one tenant's requests
+    tail = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tail_report.py"),
+         logdir, "--json", "--tenant", "alpha"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert tail.returncode == 0, tail.stderr[-2000:]
+    tdoc = json.loads(tail.stdout)
+    assert tdoc["tenant_filter"] == "alpha"
+    assert {"alpha", "beta"} <= set(tdoc["per_tenant"])

@@ -1,0 +1,426 @@
+#!/usr/bin/env python
+"""Offline capacity model for a serving run: measured throughput per
+slot, per-tenant shares, saturation/headroom, and a what-if projection.
+
+Usage::
+
+    python tools/capacity_report.py <logdir> [--json] [--rate R]
+
+Joins the three request-path streams a ``serve.py`` logdir holds:
+
+- ``usage.jsonl`` — the per-tenant usage ledger (obs/usage.py): periodic
+  cumulative rollup rows carrying each tenant's queue/slot/block-second
+  integrals and token counts, plus one closeout row per request (the
+  observed per-request resource *profile*);
+- ``steps.jsonl`` — the engine step log: per-iteration occupancy,
+  queue depth, token deltas, and the refcount-weighted KV block census
+  (``kv_blocks_billed``);
+- ``requests.jsonl`` — per-request terminal rows (admission outcomes).
+
+and answers *how loaded is this deployment and what happens at rate R*:
+
+- **measured throughput**: tokens/sec per occupied decode slot
+  (Σ ``tokens_committed`` over the decode-occupancy integral) — the
+  service rate the projection is built on;
+- **request profile**: mean slot-seconds, KV-block-seconds, and queue
+  wait per admitted request, from the ledger's closeout rows;
+- **saturation**: slot and KV-pool utilization over the busy span
+  (occupancy integrals over capacity × wall), the queue-depth trend
+  (first vs second half of the step log), and the headroom left;
+- **per-tenant shares**: each tenant's fraction of slot-seconds,
+  block-seconds, and generated tokens (each share column sums to 1);
+- **what-if projection**: at offered rate R requests/s (``--rate``;
+  default = the observed arrival rate), Little's law over the observed
+  profile predicts steady-state slot and block occupancy; demand above
+  capacity means the queue grows without bound (and the verdict says
+  so), and the TTFT regime classifies whether latency is
+  queueing-dominated or service-dominated.
+
+``--json`` emits the same content as one machine-readable object.
+Pure stdlib on purpose: must run anywhere the logs land.
+
+Exit status: 0 = report rendered; 1 = any stream had unparseable lines,
+or the usage ledger holds no rollup row.  A missing ``usage.jsonl`` is
+a hard SystemExit (pre-ISSUE-19 logdirs have no ledger to model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+_NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
+              "-Infinity": float("-inf")}
+
+#: Utilization at or above this fraction of capacity counts as saturated
+#: (the classic knee: queueing delay explodes as utilization -> 1).
+SATURATION_THRESHOLD = 0.85
+
+#: Queue-depth trend classification: second-half mean minus first-half
+#: mean, in requests (absolute, not relative — a queue oscillating by
+#: less than one request is stable).
+QUEUE_TREND_EPS = 0.5
+
+
+def _load_jsonl(path: str) -> tuple[list[dict], int]:
+    """Parsed rows plus the count of unparseable lines (the CI gate:
+    ``main`` exits non-zero when any stream had any)."""
+    rows = []
+    bad = 0
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"{path}:{i + 1}: skipping bad row ({e})",
+                      file=sys.stderr)
+                bad += 1
+                continue
+            if isinstance(row, dict):
+                rows.append({
+                    k: _NONFINITE.get(v, v) if isinstance(v, str) else v
+                    for k, v in row.items()
+                })
+            else:
+                print(f"{path}:{i + 1}: skipping non-object row",
+                      file=sys.stderr)
+                bad += 1
+    return rows, bad
+
+
+def _finite(v) -> bool:
+    return (not isinstance(v, bool) and isinstance(v, (int, float))
+            and math.isfinite(v))
+
+
+def throughput(steps: list[dict]) -> dict:
+    """Measured service rate: tokens/sec per OCCUPIED slot — committed
+    tokens over the decode-occupancy integral, not over wall time, so
+    the number holds at any load level."""
+    occ_integral = 0.0
+    tokens = 0
+    for s in steps:
+        if _finite(s.get("step_s")) and _finite(s.get("occupancy")):
+            occ_integral += s["occupancy"] * s["step_s"]
+            tokens += int(s.get("tokens_committed", 0) or 0)
+    return {
+        "tokens_committed": tokens,
+        "occupancy_integral_slot_s": occ_integral,
+        "tokens_per_slot_s": tokens / occ_integral if occ_integral else 0.0,
+    }
+
+
+def request_profile(usage_rows: list[dict]) -> dict:
+    """Mean per-request resource footprint from the ledger's closeout
+    rows: the observed profile the what-if projection scales by."""
+    ok = [r for r in usage_rows if r.get("kind") == "request"
+          and r.get("status") == "ok"]
+    rejected = sum(1 for r in usage_rows if r.get("kind") == "request"
+                   and r.get("status") == "rejected")
+    errored = sum(1 for r in usage_rows if r.get("kind") == "request"
+                  and r.get("status") == "error")
+    out = {
+        "requests_ok": len(ok),
+        "requests_rejected": rejected,
+        "requests_error": errored,
+    }
+    if not ok:
+        return out
+    n = len(ok)
+    for src, dst in (("slot_s", "mean_slot_s"),
+                     ("block_s", "mean_block_s"),
+                     ("queue_s", "mean_queue_s"),
+                     ("new_tokens", "mean_new_tokens"),
+                     ("prompt_tokens", "mean_prompt_tokens")):
+        vals = [r[src] for r in ok if _finite(r.get(src))]
+        out[dst] = sum(vals) / n if vals else 0.0
+    ts = [r["t"] for r in ok if _finite(r.get("t"))]
+    out["completion_span_s"] = max(ts) - min(ts) if len(ts) > 1 else 0.0
+    return out
+
+
+def saturation(steps: list[dict], max_slots: int,
+               kv_blocks_total: int) -> dict:
+    """Utilization of both capacity pools over the busy span, plus the
+    queue-depth trend (is demand outrunning service?)."""
+    usable = [s for s in steps
+              if _finite(s.get("t")) and _finite(s.get("step_s"))]
+    if not usable:
+        return {}
+    wall = usable[-1]["t"] - usable[0]["t"] + usable[0]["step_s"]
+    wall = max(wall, sum(s["step_s"] for s in usable), 1e-9)
+    slot_integral = sum(
+        s.get("active_slots", 0) * s["step_s"] for s in usable
+        if _finite(s.get("active_slots"))
+    )
+    billed = [s for s in usable if _finite(s.get("kv_blocks_billed"))]
+    block_integral = sum(
+        s["kv_blocks_billed"] * s["step_s"] for s in billed
+    )
+    slot_util = (slot_integral / (max_slots * wall)) if max_slots else 0.0
+    block_util = (block_integral / (kv_blocks_total * wall)) \
+        if kv_blocks_total and len(billed) == len(usable) else None
+    half = len(usable) // 2
+    q1 = [s.get("queue_depth", 0) for s in usable[:half]
+          if _finite(s.get("queue_depth"))]
+    q2 = [s.get("queue_depth", 0) for s in usable[half:]
+          if _finite(s.get("queue_depth"))]
+    trend = "unknown"
+    delta = 0.0
+    if q1 and q2:
+        delta = sum(q2) / len(q2) - sum(q1) / len(q1)
+        trend = ("growing" if delta > QUEUE_TREND_EPS
+                 else "draining" if delta < -QUEUE_TREND_EPS
+                 else "stable")
+    util_max = max(slot_util, block_util or 0.0)
+    return {
+        "busy_span_s": wall,
+        "slot_utilization": slot_util,
+        "block_utilization": block_util,
+        "queue_depth_trend": trend,
+        "queue_depth_delta": delta,
+        "saturated": util_max >= SATURATION_THRESHOLD
+        or trend == "growing",
+        "headroom": max(0.0, 1.0 - util_max),
+    }
+
+
+def tenant_shares(rollup: dict) -> dict:
+    """Each tenant's fraction of the three contended resources, from
+    the last cumulative rollup row.  Every share column sums to 1 over
+    the tenants (modulo rounding) — the conservation invariant again,
+    this time as a fairness table."""
+    tenants = rollup.get("tenants") or {}
+    totals = {"slot_s": 0.0, "block_s": 0.0, "new_tokens": 0.0}
+    for acc in tenants.values():
+        for k in totals:
+            v = acc.get(k)
+            if _finite(v):
+                totals[k] += v
+    out = {}
+    for name in sorted(tenants):
+        acc = tenants[name]
+        out[name] = {
+            k.replace("_s", "") + "_share":
+                (acc.get(k, 0.0) / totals[k] if totals[k] else 0.0)
+            for k in totals
+        }
+        out[name]["new_tokens"] = acc.get("new_tokens", 0)
+        out[name]["block_s"] = acc.get("block_s", 0.0)
+        out[name]["slot_s"] = acc.get("slot_s", 0.0)
+    return out
+
+
+def what_if(rate_rps: float, profile: dict, max_slots: int,
+            kv_blocks_total: int, tput: dict, sat: dict) -> dict:
+    """Little's-law projection at offered rate R: steady-state demand =
+    R × the observed per-request footprint.  Demand above capacity in
+    either pool means no steady state exists — the queue grows without
+    bound and TTFT is dominated by queueing, not service."""
+    mean_slot_s = profile.get("mean_slot_s", 0.0)
+    mean_block_s = profile.get("mean_block_s", 0.0)
+    mean_queue_s = profile.get("mean_queue_s", 0.0)
+    pred_slots = rate_rps * mean_slot_s
+    pred_blocks = rate_rps * mean_block_s
+    over_slots = max_slots and pred_slots > max_slots
+    over_blocks = kv_blocks_total and pred_blocks > kv_blocks_total
+    overloaded = bool(over_slots or over_blocks)
+    verdict = "queue grows without bound" if overloaded else "stable"
+    # Does the projection agree with what the step log actually saw?
+    observed = sat.get("queue_depth_trend", "unknown")
+    agrees = None
+    if observed != "unknown":
+        agrees = overloaded == (observed == "growing")
+    ttft_regime = ("queueing-dominated"
+                   if overloaded or mean_queue_s > mean_slot_s
+                   else "service-dominated")
+    return {
+        "offered_rate_rps": rate_rps,
+        "predicted_slot_occupancy": pred_slots,
+        "predicted_block_occupancy": pred_blocks,
+        "slot_capacity": max_slots,
+        "block_capacity": kv_blocks_total,
+        "predicted_overload": overloaded,
+        "queue_growth_verdict": verdict,
+        "observed_queue_trend": observed,
+        "agrees_with_observed_trend": agrees,
+        "ttft_regime": ttft_regime,
+        "predicted_tokens_per_s": (
+            min(pred_slots, max_slots or pred_slots)
+            * tput.get("tokens_per_slot_s", 0.0)
+        ),
+    }
+
+
+def build(logdir: str, rate_rps: float | None = None) -> dict:
+    usage_path = os.path.join(logdir, "usage.jsonl")
+    if not os.path.exists(usage_path):
+        raise SystemExit(
+            f"{usage_path}: not found (per-tenant ledger requires an "
+            "ISSUE-19 engine; is this a serve logdir?)"
+        )
+    usage_rows, bad_usage = _load_jsonl(usage_path)
+    steps_path = os.path.join(logdir, "steps.jsonl")
+    steps, bad_steps = (_load_jsonl(steps_path)
+                        if os.path.exists(steps_path) else ([], 0))
+    requests_path = os.path.join(logdir, "requests.jsonl")
+    requests, bad_requests = (_load_jsonl(requests_path)
+                              if os.path.exists(requests_path)
+                              else ([], 0))
+    rollups = [r for r in usage_rows if r.get("kind") == "tenants"
+               and isinstance(r.get("tenants"), dict)]
+    rollup = rollups[-1] if rollups else {}
+    max_slots = int(rollup.get("max_slots") or 0)
+    kv_blocks_total = int(rollup.get("kv_blocks_total") or 0)
+    tput = throughput(steps)
+    profile = request_profile(usage_rows)
+    sat = saturation(steps, max_slots, kv_blocks_total)
+    # Observed arrival rate over the engine's busy span (the step log's
+    # wall, not the completion cluster — synchronous drains complete in
+    # a burst and would inflate a completion-span rate).
+    total = (profile.get("requests_ok", 0)
+             + profile.get("requests_rejected", 0)
+             + profile.get("requests_error", 0))
+    span = sat.get("busy_span_s") or profile.get("completion_span_s", 0.0)
+    profile["observed_rate_rps"] = total / span if span > 0 else 0.0
+    rate = rate_rps if rate_rps is not None \
+        else profile.get("observed_rate_rps", 0.0)
+    return {
+        "logdir": logdir,
+        "rollup_rows": len(rollups),
+        "max_slots": max_slots,
+        "kv_blocks_total": kv_blocks_total,
+        "requests_logged": len(requests),
+        "throughput": tput,
+        "profile": profile,
+        "saturation": sat,
+        "tenants": tenant_shares(rollup),
+        "what_if": what_if(rate, profile, max_slots, kv_blocks_total,
+                           tput, sat),
+        "parse_errors": bad_usage + bad_steps + bad_requests,
+    }
+
+
+def render(rep: dict) -> str:
+    lines = [
+        f"CAPACITY REPORT — {rep['logdir']}",
+        "=" * 72,
+        (
+            f"capacity: {rep['max_slots']} decode slot(s), "
+            f"{rep['kv_blocks_total']} KV block(s); "
+            f"{rep['requests_logged']} request(s) logged"
+        ),
+    ]
+    if not rep["rollup_rows"]:
+        lines.append("usage.jsonl holds no rollup row — nothing to model")
+        return "\n".join(lines) + "\n"
+    tput = rep["throughput"]
+    lines.append(
+        f"measured: {tput['tokens_per_slot_s']:.2f} tokens/s per "
+        f"occupied slot ({tput['tokens_committed']} tokens over "
+        f"{tput['occupancy_integral_slot_s']:.2f} slot-seconds)"
+    )
+    prof = rep["profile"]
+    if prof.get("requests_ok"):
+        lines.append(
+            f"profile (per ok request): {prof['mean_slot_s']:.3f} slot-s, "
+            f"{prof['mean_block_s']:.3f} block-s, "
+            f"{prof['mean_queue_s']:.3f}s queued, "
+            f"{prof['mean_new_tokens']:.1f} tokens out  "
+            f"(observed arrival {prof['observed_rate_rps']:.3f} req/s; "
+            f"{prof['requests_rejected']} rejected)"
+        )
+    sat = rep["saturation"]
+    if sat:
+        block_util = sat["block_utilization"]
+        lines += [
+            "",
+            (
+                f"saturation over {sat['busy_span_s']:.2f}s busy span: "
+                f"slots {sat['slot_utilization']:.1%}"
+                + (f", KV pool {block_util:.1%}"
+                   if block_util is not None else "")
+                + f", queue {sat['queue_depth_trend']}"
+            ),
+            (
+                f"verdict: "
+                f"{'SATURATED' if sat['saturated'] else 'not saturated'} "
+                f"(headroom {sat['headroom']:.1%}, threshold "
+                f"{SATURATION_THRESHOLD:.0%})"
+            ),
+        ]
+    tenants = rep["tenants"]
+    if tenants:
+        lines += [
+            "",
+            f"{'tenant':<20} {'slot share':>11} {'block share':>12} "
+            f"{'token share':>12} {'tokens':>9}",
+        ]
+        top = max(tenants, key=lambda n: tenants[n]["block_s"])
+        for name, s in tenants.items():
+            mark = "  << top by block-s" if name == top else ""
+            lines.append(
+                f"{name:<20} {s['slot_share']:>11.1%} "
+                f"{s['block_share']:>12.1%} "
+                f"{s['new_tokens_share']:>12.1%} "
+                f"{s['new_tokens']:>9}{mark}"
+            )
+    wi = rep["what_if"]
+    lines += [
+        "",
+        (
+            f"what-if at {wi['offered_rate_rps']:.3f} req/s: "
+            f"predicted occupancy {wi['predicted_slot_occupancy']:.2f} "
+            f"of {wi['slot_capacity']} slot(s), "
+            f"{wi['predicted_block_occupancy']:.1f} of "
+            f"{wi['block_capacity']} block(s)"
+        ),
+        (
+            f"  -> {wi['queue_growth_verdict']} "
+            f"(observed queue trend: {wi['observed_queue_trend']}); "
+            f"TTFT {wi['ttft_regime']}; "
+            f"~{wi['predicted_tokens_per_s']:.1f} tokens/s sustained"
+        ),
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logdir", help="serve.py logdir holding usage.jsonl "
+                                  "(+ steps.jsonl, requests.jsonl)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one JSON object")
+    p.add_argument("--rate", type=float, default=None, metavar="R",
+                   help="offered request rate (req/s) for the what-if "
+                        "projection (default: the observed arrival rate)")
+    args = p.parse_args(argv)
+    if args.rate is not None and (args.rate < 0
+                                  or not math.isfinite(args.rate)):
+        p.error("--rate must be a finite number >= 0")
+    rep = build(args.logdir, rate_rps=args.rate)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(render(rep), end="")
+    if rep["parse_errors"]:
+        print(
+            f"capacity_report: {rep['parse_errors']} unparseable "
+            "telemetry entries (usage/steps/requests)", file=sys.stderr,
+        )
+        return 1
+    if not rep["rollup_rows"]:
+        print("capacity_report: usage.jsonl holds no rollup row",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

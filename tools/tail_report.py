@@ -4,6 +4,7 @@
 Usage::
 
     python tools/tail_report.py <logdir> [--json] [--tail-q 0.99]
+                                [--tenant NAME]
 
 Joins the two request-path streams a ``serve.py`` logdir holds:
 
@@ -30,7 +31,12 @@ and answers *why is p99 slower than p50*:
   against the same stats over the whole step log — congestion during
   the tail windows shows up as elevated numbers here;
 - attribution coverage: the share of ok rows whose component sum lands
-  within 5% of ``e2e_s`` (the exactness contract the engine maintains).
+  within 5% of ``e2e_s`` (the exactness contract the engine maintains);
+- per-tenant split: each tenant's own p50/p99 e2e over its ok rows
+  (rows without a ``tenant`` field — pre-ISSUE-19 logs — group under
+  ``default``), so one tenant's tail never hides inside another's
+  distribution; ``--tenant NAME`` additionally restricts the cohort
+  analysis and step-log evidence to that tenant's requests.
 
 ``--json`` emits the same content as one machine-readable object.
 Pure stdlib on purpose: must run anywhere the logs land.
@@ -226,7 +232,31 @@ def step_evidence(steps: list[dict], cohorts: dict,
     }
 
 
-def build(logdir: str, tail_q: float = 0.99) -> dict:
+def per_tenant_split(rows: list[dict], tail_q: float = 0.99) -> dict:
+    """Each tenant's own latency distribution over its ok attribution
+    rows: request count, p50 and p-tail e2e.  Rows without a ``tenant``
+    field (pre-ISSUE-19 logs) group under ``default`` — aggregating
+    tenants into one distribution misattributes one tenant's tail to
+    everyone, which is the bug this split fixes."""
+    groups: dict[str, list[float]] = {}
+    for r in rows:
+        tenant = r.get("tenant")
+        if not isinstance(tenant, str) or not tenant:
+            tenant = "default"
+        groups.setdefault(tenant, []).append(r["e2e_s"])
+    out = {}
+    for tenant in sorted(groups):
+        e2es = sorted(groups[tenant])
+        out[tenant] = {
+            "requests": len(e2es),
+            "e2e_p50_s": _percentile(e2es, 0.50),
+            "e2e_tail_s": _percentile(e2es, tail_q),
+        }
+    return out
+
+
+def build(logdir: str, tail_q: float = 0.99,
+          tenant: str | None = None) -> dict:
     requests_path = os.path.join(logdir, "requests.jsonl")
     if not os.path.exists(requests_path):
         raise SystemExit(
@@ -236,15 +266,23 @@ def build(logdir: str, tail_q: float = 0.99) -> dict:
     steps_path = os.path.join(logdir, "steps.jsonl")
     steps, bad_steps = (_load_jsonl(steps_path)
                         if os.path.exists(steps_path) else ([], 0))
-    rows = _attr_rows(requests)
+    all_rows = _attr_rows(requests)
+    # The per-tenant split always covers every tenant; the --tenant
+    # filter narrows only the cohort analysis + step evidence.
+    rows = all_rows if tenant is None else [
+        r for r in all_rows
+        if (r.get("tenant") or "default") == tenant
+    ]
     cohorts = attribution_cohorts(rows, tail_q)
     return {
         "logdir": logdir,
+        "tenant_filter": tenant,
         "requests": len(requests),
         "ok_with_attribution": len(rows),
         "step_records": len(steps),
         "coverage": attribution_coverage(rows),
         "cohorts": cohorts,
+        "per_tenant": per_tenant_split(all_rows, tail_q),
         "evidence": step_evidence(steps, cohorts, rows),
         "parse_errors": bad_requests + bad_steps,
     }
@@ -252,7 +290,9 @@ def build(logdir: str, tail_q: float = 0.99) -> dict:
 
 def render(rep: dict) -> str:
     lines = [
-        f"TAIL REPORT — {rep['logdir']}",
+        f"TAIL REPORT — {rep['logdir']}"
+        + (f" (tenant {rep['tenant_filter']})"
+           if rep.get("tenant_filter") else ""),
         "=" * 72,
         (
             f"requests: {rep['requests']} total, "
@@ -260,6 +300,15 @@ def render(rep: dict) -> str:
             f"{rep['step_records']} step-log record(s)"
         ),
     ]
+    per_tenant = rep.get("per_tenant")
+    if per_tenant and (len(per_tenant) > 1 or rep.get("tenant_filter")):
+        lines.append("per-tenant e2e split:")
+        for tenant, s in per_tenant.items():
+            lines.append(
+                f"  {tenant:<20} {s['requests']:>5} ok   "
+                f"p50 {s['e2e_p50_s']:.4g}s   "
+                f"tail {s['e2e_tail_s']:.4g}s"
+            )
     cov = rep.get("coverage")
     if cov:
         lines.append(
@@ -341,10 +390,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="emit the report as one JSON object")
     p.add_argument("--tail-q", type=float, default=0.99,
                    help="tail quantile to explain (default 0.99)")
+    p.add_argument("--tenant", default=None,
+                   help="restrict the cohort analysis and step evidence "
+                        "to one tenant's requests (the per-tenant split "
+                        "always covers every tenant)")
     args = p.parse_args(argv)
     if not 0.5 < args.tail_q < 1.0:
         p.error("--tail-q must be in (0.5, 1.0)")
-    rep = build(args.logdir, tail_q=args.tail_q)
+    rep = build(args.logdir, tail_q=args.tail_q, tenant=args.tenant)
     if args.json:
         print(json.dumps(rep, indent=2, default=str))
     else:
