@@ -188,6 +188,11 @@ class Prefetcher:
             "data_device_put_seconds", "host->device placement time per batch"
         )
         self._src = it  # kept so close() can release the source too
+        # Consumption acknowledgement (exactly-once across an elastic
+        # resize): sources exposing note_consumed(n) — DataServiceClient —
+        # get told when batches actually reach the consumer, so batches
+        # still in OUR buffer at close are never journaled as trained.
+        self._note_consumed = getattr(it, "note_consumed", None)
         self._thread = threading.Thread(
             target=self._run, args=(iter(it),), daemon=True
         )
@@ -233,7 +238,11 @@ class Prefetcher:
                     else device_put_batch(batch, self._mesh)
                 )
                 self._m_put.observe(time.perf_counter() - t0)
-                if not self._admit(out):
+                # Items ride with their source-batch count (a trailing
+                # partial bundle is shorter) so __next__ can acknowledge
+                # the exact consumption to the source.
+                count = len(batch) if self._bundle > 1 else 1
+                if not self._admit((out, count)):
                     return
         except BaseException as e:  # surfaced on the consumer thread
             self._err = e
@@ -295,7 +304,10 @@ class Prefetcher:
         if self._controller is not None:
             self._controller.observe_wait(wait)
         self._m_batches.inc()
-        return item
+        out, count = item
+        if self._note_consumed is not None:
+            self._note_consumed(count)
+        return out
 
     @property
     def depth(self) -> int:

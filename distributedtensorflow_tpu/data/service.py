@@ -78,6 +78,7 @@ plus the ``rpc_*`` / ``breaker_*`` families from :mod:`..net`.
 
 from __future__ import annotations
 
+import collections
 import io
 import json
 import logging
@@ -493,6 +494,16 @@ class DispatchServer:
             "gen": ep["gen"],
             "splits": {
                 str(s): dict(v) for s, v in sorted(ep["splits"].items())
+            },
+            # Merged per-split progress (max over every client report,
+            # journal-replayed across dispatcher restarts): an elastic
+            # resume — same process after a resize, or another trainer
+            # host joining the SAME epoch — seeds its delivered ledger
+            # from these counts, which is what makes one epoch shareable
+            # across clients exactly-once.
+            "received": {
+                str(s): int(n)
+                for s, n in sorted((ep.get("received") or {}).items())
             },
         }
 
@@ -1038,6 +1049,12 @@ class WorkerServer:
                     # would rewind the slot under the live resume stream
                     # and re-serve counted batches.  Refuse — the answer
                     # goes to a dead socket anyway.
+                    # ``stale_rid`` lets a LIVE successor stream (a new
+                    # CLIENT whose per-client rid counter restarted — an
+                    # elastic-resize resume, or another host taking the
+                    # slot) escalate past the slot's counter and retry;
+                    # a dead predecessor's buffered frame gets the same
+                    # refusal on a socket nobody reads.
                     return {
                         "ok": False,
                         "error": (
@@ -1045,6 +1062,7 @@ class WorkerServer:
                             f"current {entry.rid}) for epoch {epoch} "
                             f"split {split}"
                         ),
+                        "stale_rid": entry.rid,
                     }, None
                 # Reconnect-with-resume: a NEW stream took over a live
                 # slot.  The slot lock is taken INSIDE the worker lock
@@ -1157,7 +1175,17 @@ class WorkerServer:
 
 
 class _WorkerRefusal(RuntimeError):
-    """Worker answered but refused the request (pool-snapshot mismatch)."""
+    """Worker answered but refused the request (pool-snapshot mismatch).
+
+    ``stale_rid`` (when the worker sent one) is the slot's current stream-
+    attempt number: a LIVE successor stream — a post-resize client or
+    another host resuming the slot — escalates past it and retries, which
+    a dead predecessor's leftover pipelined frame can never do (its
+    refusal lands on a closed socket)."""
+
+    def __init__(self, message: str, *, stale_rid: int | None = None):
+        super().__init__(message)
+        self.stale_rid = stale_rid
 
 
 class DataServiceClient:
@@ -1296,7 +1324,30 @@ class DataServiceClient:
         self._assignments: dict[int, dict] = {
             int(s): dict(v) for s, v in resp["splits"].items()
         }
-        self._received: dict[int, int] = {s: 0 for s in self._assignments}
+        # Elastic resume: seed the delivered ledger from the dispatcher's
+        # journaled per-split progress (max-merged over every client that
+        # reported against this epoch), so a rebuilt client — the same
+        # process after a resize, or another trainer host sharing the
+        # epoch — fast-forwards past what the run already trained on
+        # instead of re-pulling it.
+        _progress = {
+            int(s): int(n) for s, n in (resp.get("received") or {}).items()
+        }
+        self._received: dict[int, int] = {
+            s: max(0, _progress.get(s, 0)) for s in self._assignments
+        }
+        # Batches actually handed to the consumer, per split.  `_received`
+        # counts decode completion and drives stream-level resume WITHIN
+        # this client (a buffered batch must not be refetched — it is
+        # still going to be consumed); a batch sitting in the buffer at
+        # close was never trained on, so CROSS-client continuation must
+        # resume at the consumed position (re-fetching the buffered
+        # remainder) or those batches are silently lost.  This is the
+        # ledger progress reports and the drain handoff publish.
+        self._consumed: dict[int, int] = dict(self._received)
+        # Handout order of batches given to the puller but not yet
+        # acknowledged as consumed (note_consumed pops from the left).
+        self._handout: collections.deque[int] = collections.deque()
         # Monotonic per-split stream-attempt counter: rides each stream's
         # requests as ``rid`` so the worker can refuse a severed stream's
         # leftover pipelined frames (stale < current) instead of letting
@@ -1363,24 +1414,41 @@ class DataServiceClient:
     def _progress_loop(self) -> None:
         policy = netrpc.RetryPolicy(deadline_s=2.0, max_attempts=1)
         while not self._progress_stop.wait(self._progress_interval_s):
-            with self._reshard_lock:
-                received = {str(s): n for s, n in self._received.items()}
             try:
-                _rpc(
-                    self._dispatcher,
-                    {
-                        "kind": "report_progress",
-                        "epoch": self._epoch,
-                        "client": self._client_id,
-                        "received": received,
-                    },
-                    timeout=2.0, endpoint=self._dispatcher_ep,
-                    policy=policy,
-                )
+                self.flush_progress(timeout=2.0, policy=policy)
             except (OSError, ConnectionError):
                 # Best-effort durability: a briefly-unreachable (or
                 # breaker-open) dispatcher costs one report, nothing more.
                 pass
+
+    def flush_progress(self, timeout: float = 5.0,
+                       policy: netrpc.RetryPolicy | None = None) -> bool:
+        """Report the CONSUMED-batch ledger to the dispatcher now.
+
+        The journaled counts are what a successor client (elastic resize,
+        another trainer host on the same epoch) seeds from, so a drain
+        calls this synchronously before :meth:`close` — the periodic loop
+        alone could be up to ``progress_interval_s`` stale.  Reports
+        consumed (trained-on) counts, not received: buffered batches die
+        with this client and must be re-fetched by the successor.
+        Returns True when the dispatcher acknowledged."""
+        if self._protocol == "per_connection":
+            return False
+        with self._reshard_lock:
+            consumed = {str(s): n for s, n in self._consumed.items()}
+        resp, _ = _rpc(
+            self._dispatcher,
+            {
+                "kind": "report_progress",
+                "epoch": self._epoch,
+                "client": self._client_id,
+                "received": consumed,
+            },
+            timeout=timeout, endpoint=self._dispatcher_ep,
+            policy=policy or netrpc.RetryPolicy(deadline_s=timeout,
+                                                max_attempts=1),
+        )
+        return bool(resp.get("ok"))
 
     # -- streaming fetchers ---------------------------------------------------
 
@@ -1404,6 +1472,7 @@ class DataServiceClient:
 
     def _fetch_loop(self, split: int) -> None:
         resume_attempts = 0
+        rid_retries = 0
         try:
             while not self._closed:
                 with self._reshard_lock:
@@ -1420,6 +1489,26 @@ class DataServiceClient:
                     self._stream_split(split, addr, skip, gen)
                     return  # EOF: split fully delivered
                 except _WorkerRefusal as e:
+                    if (e.stale_rid is not None
+                            and rid_retries < self._stream_retries):
+                        # The slot's stream-attempt counter outran this
+                        # client's (a fresh client resuming a slot a
+                        # predecessor streamed — elastic resize, shared
+                        # epoch): escalate past it and retry.  Bounded so
+                        # two clients fighting over one slot fail instead
+                        # of livelocking.
+                        rid_retries += 1
+                        with self._reshard_lock:
+                            self._stream_rids[split] = max(
+                                self._stream_rids[split], int(e.stale_rid)
+                            )
+                        logger.info(
+                            "data stream split %d to %s: resume token "
+                            "behind slot (rid -> %d); retry %d/%d",
+                            split, addr, self._stream_rids[split] + 1,
+                            rid_retries, self._stream_retries,
+                        )
+                        continue
                     # Config-level refusal (pool-snapshot mismatch), not a
                     # death — re-sharding can't fix it.
                     if self._ignore_errors:
@@ -1542,7 +1631,8 @@ class DataServiceClient:
             outstanding -= 1
             if not header.get("ok"):
                 raise _WorkerRefusal(
-                    f"data worker {addr}: {header.get('error')}"
+                    f"data worker {addr}: {header.get('error')}",
+                    stale_rid=header.get("stale_rid"),
                 )
             if header.get("eof"):
                 # In-flight requests beyond EOF answer eof too; the
@@ -1673,8 +1763,30 @@ class DataServiceClient:
                 raise self._err
             raise StopIteration
         _split, batch = item
+        with self._reshard_lock:
+            # Not consumed YET: the puller (the Prefetcher) buffers
+            # ahead of the trainer, and a batch still in ITS buffer at
+            # close was never trained on.  Remember the handout order;
+            # note_consumed() advances the per-split consumed ledger
+            # when the downstream consumer actually takes the batch.
+            self._handout.append(_split)
         self._m_batches.inc()
         return batch
+
+    def note_consumed(self, n: int = 1) -> None:
+        """Advance the consumed ledger by ``n`` batches, in handout order.
+
+        Called by the downstream consumer (``Prefetcher.__next__``) when
+        batches actually reach the training loop — counting at our own
+        ``__next__`` would overshoot by whatever the consumer still has
+        buffered at close, and a same-epoch successor would skip batches
+        that were never trained on (lost work)."""
+        with self._reshard_lock:
+            for _ in range(n):
+                if not self._handout:
+                    break
+                s = self._handout.popleft()
+                self._consumed[s] = self._consumed.get(s, 0) + 1
 
     def _next_per_connection(self) -> Batch:
         while self._live:
@@ -1735,10 +1847,25 @@ class DataServiceClient:
         with self._reshard_lock:
             return dict(self._received)
 
+    def consumed_counts(self) -> dict[int, int]:
+        """Cumulative batches handed to the consumer per split (the
+        cross-client continuation ledger — what a drain journals)."""
+        if self._protocol == "per_connection":
+            return {}
+        with self._reshard_lock:
+            return dict(self._consumed)
+
     def close(self) -> None:
-        """Stop fetcher threads and release buffered batches."""
+        """Stop fetcher threads and release buffered batches.  Flushes a
+        final progress report first (best-effort), so a successor client
+        on the same epoch seeds from this client's true consumed
+        position rather than a stale periodic report."""
         if self._protocol == "per_connection":
             return
+        try:
+            self.flush_progress(timeout=2.0)
+        except (OSError, ConnectionError):
+            pass
         self._closed = True
         self._progress_stop.set()
         while True:

@@ -40,7 +40,10 @@ rejected a truncated/corrupt checkpoint and fell back —
 ``faults.jsonl`` — ``resilience.chaos``), ``restart`` /
 ``supervisor_giving_up`` (supervised in-process restarts —
 ``resilience.supervisor``), ``data_reshard`` (elastic data-service
-re-assignment — ``data.service``), ``slo_violation`` (an SLO burn-rate
+re-assignment — ``data.service``), ``resize_begin`` / ``resize_end``
+(an elastic trainer resize window: drain → save → mesh re-form → ZeRO
+rechunk → resume — ``resilience.elastic``; ``resize_end`` carries the
+``outcome``), ``slo_violation`` (an SLO burn-rate
 threshold trip — ``obs.slo``), ``alert`` (an alert rule fired or
 resolved — ``obs.alerts``), ``nan_provenance`` (the first module to
 produce a non-finite value, named by the NaN-provenance pass —

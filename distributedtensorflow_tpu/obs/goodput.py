@@ -31,6 +31,8 @@ bucket               meaning
                      under ``train_step`` — they ran)
 ``lost_work``        wall time a dead generation spent past the checkpoint
                      the next generation resumed from — recomputed at merge
+``resize``           an elastic resize window: drain → save → mesh re-form →
+                     ZeRO rechunk → input rebuild (``resilience.elastic``)
 ``badput_restart``   the gap between a generation's last heartbeat and the
                      next generation's start (scheduler + restart latency)
 ``other``            in-fit wall time no span claims (host Python, logging)
@@ -93,9 +95,12 @@ __all__ = [
     "GoodputLedger",
     "default_ledger",
     "install_ledger",
+    "mark_resize_begin",
+    "mark_resize_end",
     "merge_generations",
     "note_checkpoint",
     "note_event",
+    "note_resize",
     "note_restart",
     "note_restore",
 ]
@@ -111,6 +116,7 @@ BUCKETS = (
     "eval",
     "preemption_drain",
     "profile_capture",
+    "resize",
     "lost_work",
     "badput_restart",
     "other",
@@ -294,6 +300,8 @@ class GoodputLedger:
         self._init = 0.0
         self._preempt_t: float | None = None
         self._preempt_attr = 0.0
+        self._resize_t: float | None = None
+        self._resize_attr = 0.0
         self._ckpts: list[list[float]] = []
         self._events: dict[str, int] = {}
         # last value exported per bucket, for counter delta-incs
@@ -354,6 +362,53 @@ class GoodputLedger:
                 self._buckets.get("badput_restart", 0.0) + s
             )
             self._attr_total += s
+
+    def note_resize(self, seconds: float) -> None:
+        """An elastic resize window (resilience.ElasticController): book
+        the drain→rechunk→resume seconds into ``resize``.
+
+        Attributed like span seconds — the derived ``other`` residual
+        shrinks by the same amount, so the generation's buckets still sum
+        to its wall time.  The restore/save spans inside the window book
+        into their own buckets; the controller passes only the residual
+        window time here, keeping the buckets exclusive.
+        """
+        s = max(float(seconds), 0.0)
+        if not s:
+            return
+        with self._lock:
+            self._buckets["resize"] = self._buckets.get("resize", 0.0) + s
+            self._attr_total += s
+
+    def mark_resize_begin(self) -> None:
+        """Open an elastic resize window: stamp wall time and the
+        span-attributed total so :meth:`mark_resize_end` can book only the
+        RESIDUAL window seconds into ``resize`` — the save/restore/compile
+        spans inside the window keep their own buckets and the sum stays
+        exclusive.  A second begin before the end re-anchors (the prior
+        window was abandoned without bookkeeping)."""
+        with self._lock:
+            self._resize_t = time.time()
+            self._resize_attr = self._attr_total
+
+    def mark_resize_end(self) -> float:
+        """Close the open resize window: book ``wall - span_attributed``
+        seconds of the window into ``resize`` and return the window's wall
+        duration (0.0 when no window was open)."""
+        with self._lock:
+            if self._resize_t is None:
+                return 0.0
+            now = time.time()
+            wall = max(now - self._resize_t, 0.0)
+            residual = max(wall - (self._attr_total - self._resize_attr),
+                           0.0)
+            self._resize_t = None
+            if residual:
+                self._buckets["resize"] = (
+                    self._buckets.get("resize", 0.0) + residual
+                )
+                self._attr_total += residual
+            return wall
 
     def note_event(self, kind: str) -> None:
         """Flight-event tap: stamps the preemption-drain window and counts
@@ -546,6 +601,30 @@ def note_restart(seconds: float) -> None:
     led = _default
     if led is not None:
         led.note_restart(seconds)
+
+
+def note_resize(seconds: float) -> None:
+    """Deep-layer hook (resilience.ElasticController): no-op when no
+    ledger."""
+    led = _default
+    if led is not None:
+        led.note_resize(seconds)
+
+
+def mark_resize_begin() -> None:
+    """Open a resize window on the default ledger (no-op when none)."""
+    led = _default
+    if led is not None:
+        led.mark_resize_begin()
+
+
+def mark_resize_end() -> float:
+    """Close the default ledger's resize window; returns the window's
+    wall seconds (0.0 when no ledger or no open window)."""
+    led = _default
+    if led is not None:
+        return led.mark_resize_end()
+    return 0.0
 
 
 def _observe_root(span) -> None:

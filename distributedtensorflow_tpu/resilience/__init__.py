@@ -13,7 +13,11 @@ closed recovery loop:
   the budget runs out;
 - **fault injection** (:mod:`.chaos`): deterministic fault plans
   (``train.py --fault-plan``) that exercise the whole stack on CPU in CI,
-  logging every injection/recovery pair to ``<logdir>/faults.jsonl``.
+  logging every injection/recovery pair to ``<logdir>/faults.jsonl``;
+- **elasticity** (:mod:`.elastic`): live replica resize without a cold
+  restart (``train.py --elastic``) — drain to a checkpoint boundary,
+  re-form the mesh, rechunk ZeRO state, resume the SAME data-service
+  epoch exactly-once.
 """
 
 from .chaos import (  # noqa: F401
@@ -24,6 +28,10 @@ from .chaos import (  # noqa: F401
     FaultPlan,
     InjectedFault,
     WorkerKilledFault,
+)
+from .elastic import (  # noqa: F401
+    RESIZE_OUTCOMES,
+    ElasticController,
 )
 from .supervisor import (  # noqa: F401
     RestartBudgetExhausted,

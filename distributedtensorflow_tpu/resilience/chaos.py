@@ -46,6 +46,17 @@ on demand, deterministically, from a JSON *fault plan*
     Calls ``PreemptionHandler.trigger()`` (attach via
     :meth:`attach_preemption`): the trainer's own consistent-save path
     runs and the fit exits preempted; the supervisor resumes it.
+``resize``
+    Requests an elastic resize to ``devices`` at the trigger step via the
+    attached :class:`~.elastic.ElasticController`
+    (:meth:`attach_elastic`) — the drain → mesh re-form → ZeRO rechunk →
+    same-epoch resume path, shrink and grow alike.  The ``recovered`` row
+    is written when the controller reports the window's outcome.  An
+    optional ``"compose": "worker_kill"`` arms a crash MID-resize (raised
+    from the entrypoint's resize_fn between the drain save and the mesh
+    commit via :meth:`mid_resize_fault`): the supervisor must classify it,
+    fall back to the pre-resize checkpoint, and resume at the old size —
+    resize-interrupted-by-crash, end to end.
 
 Network fault kinds (ISSUE 13 — injected at the :mod:`..net` layer, and
 recovered by the TRANSPORT, not by a supervised restart; their
@@ -129,6 +140,7 @@ FAULT_KINDS = (
     "worker_kill",
     "data_stall",
     "preemption",
+    "resize",
 ) + NET_FAULT_KINDS
 
 _M_INJECTED = obs.counter(
@@ -251,6 +263,8 @@ class ChaosInjector(Callback):
         self._coordinator = None
         self._dispatcher = None
         self._dispatcher_restart = None
+        self._elastic = None
+        self._mid_resize_kill: _Fault | None = None
         if self._path:
             os.makedirs(logdir, exist_ok=True)
             # Truncate a prior run's log: the plan restarts from scratch.
@@ -266,6 +280,12 @@ class ChaosInjector(Callback):
         """A process-backed Coordinator whose worker 0 ``worker_kill``
         faults SIGKILL (optional — without one the fault only raises)."""
         self._coordinator = coord
+
+    def attach_elastic(self, controller) -> None:
+        """The :class:`~.elastic.ElasticController` that ``resize``
+        faults drive; its completion callback writes the paired
+        ``recovered`` row whatever the window's outcome."""
+        self._elastic = controller
 
     def attach_data_service(self, dispatcher, restart_fn) -> None:
         """The data-service control plane ``dispatcher_kill`` faults
@@ -336,7 +356,7 @@ class ChaosInjector(Callback):
 
     #: Kinds fired from on_step_end (nan_loss fires inside the wrapped
     #: train step, checkpoint_truncate inside the wrapped save).
-    _STEP_KINDS = ("preemption", "data_stall", "worker_kill") \
+    _STEP_KINDS = ("preemption", "data_stall", "worker_kill", "resize") \
         + NET_FAULT_KINDS
 
     def on_step_end(self, trainer, step: int, state, metrics) -> None:
@@ -382,6 +402,35 @@ class ChaosInjector(Callback):
                 f"chaos: input pipeline stalled at step {step}",
                 fault_id=fault.id, step=step,
             )
+        if kind == "resize":
+            devices = int(fault.params.get("devices", 0))
+            compose = fault.params.get("compose")
+            extra = {"devices": devices}
+            if compose:
+                extra["compose"] = str(compose)
+            self._inject(fault, at_step=step, **extra)
+            if self._elastic is None:
+                logger.error(
+                    "chaos: resize fault at step %d but no elastic "
+                    "controller attached; fault cannot recover", step,
+                )
+                return
+            if compose == "worker_kill":
+                with self._lock:
+                    self._mid_resize_kill = fault
+            ok, msg = self._elastic.request_resize(
+                devices, source="chaos",
+                on_done=lambda outcome, info, f=fault:
+                    self._resize_done(f, outcome, info),
+            )
+            if not ok:
+                logger.error("chaos: resize fault #%d rejected: %s",
+                             fault.id, msg)
+                with self._lock:
+                    if self._mid_resize_kill is fault:
+                        self._mid_resize_kill = None
+                self._resize_done(fault, "rejected", {})
+            return
         if kind == "worker_kill":
             self._inject(fault, at_step=step)
             if self._coordinator is not None:
@@ -395,6 +444,49 @@ class ChaosInjector(Callback):
                 f"chaos: worker killed at step {step}",
                 fault_id=fault.id, step=step,
             )
+
+    # -- elastic resize faults (controller-recovered) ------------------------
+
+    def mid_resize_fault(self) -> None:
+        """Hook for the entrypoint's resize_fn, called between the drain
+        save and the mesh commit: raises the armed composed
+        ``worker_kill`` (a ``resize`` fault with ``"compose":
+        "worker_kill"``), simulating a crash landing mid-resize.  A no-op
+        when nothing is armed."""
+        with self._lock:
+            fault, self._mid_resize_kill = self._mid_resize_kill, None
+        if fault is None:
+            return
+        step = (fault.injected_step if fault.injected_step is not None
+                else fault.step)
+        raise WorkerKilledFault(
+            f"chaos: worker killed mid-resize (fault #{fault.id})",
+            fault_id=fault.id, step=step,
+        )
+
+    def _resize_done(self, fault: _Fault, outcome: str, info: dict) -> None:
+        """Completion callback from the ElasticController: write the
+        paired ``recovered`` row (idempotent).  Every outcome pairs the
+        row — a ``failed`` resize recovered by falling back to the
+        pre-resize checkpoint, a ``rejected`` one by never starting."""
+        with self._lock:
+            if not fault.injected or fault.recovered:
+                return
+            fault.recovered = True
+            _M_RECOVERED.inc(kind=fault.kind)
+            step = (fault.injected_step if fault.injected_step is not None
+                    else fault.step)
+            resumed = info.get("resumed_step")
+            self._write({
+                "t": time.time(), "id": fault.id, "step": step,
+                "kind": fault.kind, "phase": "recovered",
+                "resumed_step": int(resumed if resumed is not None
+                                    else step),
+                "attempt": int(info.get("attempt", 0)),
+                "outcome": str(outcome),
+            })
+        logger.warning("chaos: resize fault #%d finished (%s)",
+                       fault.id, outcome)
 
     # -- network faults (transport-recovered; ISSUE 13) ----------------------
 
@@ -568,6 +660,11 @@ class ChaosInjector(Callback):
                     # Transport-recovered, not restart-recovered: their
                     # row is written when the net layer proves a
                     # post-fault success (_recover_net).
+                    continue
+                if f.kind == "resize":
+                    # Controller-recovered: the ElasticController's
+                    # completion callback writes the row (_resize_done)
+                    # whatever the window's outcome.
                     continue
                 if f.kind == "checkpoint_truncate":
                     if f.detail_step not in rejected:

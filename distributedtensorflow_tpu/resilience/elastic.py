@@ -1,0 +1,449 @@
+"""Elastic training: live replica resize without a cold restart.
+
+The harness this repo grew from assumes a fixed cluster shape: losing or
+gaining capacity means killing the process, re-forming the mesh at the
+new size, and replaying the epoch — a cold restart that costs minutes of
+goodput and (without exactly-once input accounting) silently re-trains or
+skips batches.  The :class:`ElasticController` composes primitives the
+repo already owns into *live* resize inside one process:
+
+1. **Signal** — ``SIGUSR2`` (target device count read from
+   ``<logdir>/resize_devices``; absent/invalid means "all visible
+   devices") or ``POST /resizez?devices=N`` on the StatusServer, or a
+   chaos-plan ``resize`` fault, or a direct :meth:`request_resize` call.
+2. **Drain** — the controller is a Trainer :class:`~..train.trainer.
+   Callback`: at the next dispatch boundary it opens the resize window
+   (``resize_begin`` flight event, goodput window stamp) and sets
+   ``trainer.stop_training``; the fit exits through its normal
+   final-checkpoint path, so the drain save rides the existing
+   integrity-manifest machinery — nothing resize-specific to corrupt.
+3. **Re-form** — the entrypoint-supplied ``resize_fn(devices, state)``
+   rebuilds the mesh at the new device count, re-chunks ZeRO optimizer
+   state through :func:`~..parallel.zero.restore_latest_zero`'s
+   cross-degree migration, and rebuilds the train step.  The function is
+   TRANSACTIONAL: it commits (rebinds the live mesh/step/state) only at
+   the very end, so a crash mid-resize leaves the old-size world intact
+   and the supervisor's restart resumes from the drain checkpoint at the
+   old size.
+4. **Resume** — the outer loop (:class:`~.supervisor.Supervisor` or
+   ``train.py --elastic``) rebuilds the input iterator against the SAME
+   data-service epoch: the dispatcher journal's ``client_progress`` rows
+   carry per-split *consumed* counts, so the new client resumes each
+   split exactly after the last batch the trainer actually saw — no
+   duplicate, no lost batch, even across several trainer hosts sharing
+   one elastic epoch.
+
+Bookkeeping per window: ``resize_begin``/``resize_end`` flight events
+(device counts + outcome), the whole drain→rechunk→resume residual booked
+into the goodput ``resize`` bucket (inner save/restore/compile spans keep
+their own buckets — the sum stays exclusive), an
+``elastic_resizes_total{outcome=}`` counter
+(outcomes: ``completed`` / ``failed`` / ``rejected``), and live state on
+``/resizez`` + ``/statusz``.
+
+Failure contract: anything raised between drain and commit falls into the
+supervisor's normal restart path; :meth:`abandon` closes the window as
+``failed`` and DROPS the pending request, so the restart resumes from the
+pre-resize checkpoint at the old device count instead of re-running the
+resize.  A drain that wedges (``TimeoutError`` while :attr:`draining`) is
+classified ``resize_drain`` — retryable, same fallback.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal as signal_mod
+import threading
+import time
+from typing import Any, Callable
+
+from .. import obs
+from ..train.trainer import Callback
+
+logger = logging.getLogger("distributedtensorflow_tpu")
+
+__all__ = [
+    "RESIZE_OUTCOMES",
+    "ElasticController",
+]
+
+#: The ``elastic_resizes_total`` outcome label vocabulary (duplicated
+#: stdlib-side in tools/check_metrics_schema.py — keep in sync).
+RESIZE_OUTCOMES = ("completed", "failed", "rejected")
+
+_M_RESIZES = obs.counter(
+    "elastic_resizes_total", "elastic resize requests, by outcome"
+)
+
+
+class ElasticController(Callback):
+    """Drives live replica resizes through the drain→re-form→resume
+    sequence (module docstring).
+
+    ``resize_fn(devices, state) -> state`` performs the actual re-form
+    (train.py wires a transactional closure over its mesh/workload/step
+    state).  ``current_devices_fn() -> int`` reports the live mesh's
+    device count (validates requests, labels the flight events).
+    Construction is cheap and jax-free; all device work happens inside
+    ``resize_fn``.
+    """
+
+    def __init__(
+        self,
+        *,
+        resize_fn: Callable[[int, Any], Any] | None = None,
+        current_devices_fn: Callable[[], int] | None = None,
+        logdir: str | None = None,
+        devices_file: str | None = None,
+    ):
+        self.resize_fn = resize_fn
+        self.current_devices_fn = current_devices_fn
+        self._devices_file = devices_file or (
+            os.path.join(logdir, "resize_devices") if logdir else None
+        )
+        self._lock = threading.Lock()
+        #: Accepted-but-not-yet-performed request:
+        #: {"devices", "source", "on_done", "t_req"}.
+        self._pending: dict | None = None
+        #: Open resize window (drain begun): {"t0", "from_devices",
+        #: "to_devices", "source", "on_done", "drain_step",
+        #: "anchor_step", "performed"}.
+        self._window: dict | None = None
+        self._draining = False
+        #: Closed-window history (JSON-safe rows), newest last.
+        self.history: list[dict] = []
+
+    # -- request intake ------------------------------------------------------
+
+    def request_resize(
+        self, devices, *, source: str = "api",
+        on_done: Callable[[str, dict], None] | None = None,
+    ) -> tuple[bool, str]:
+        """Ask for a resize to ``devices``; returns ``(accepted, message)``.
+
+        Thread-safe and signal-safe (one lock, no I/O).  A request is
+        rejected — counted under ``outcome="rejected"``, ``on_done`` NOT
+        registered — when the count is invalid, equals the current size,
+        or another resize is already in flight.  ``on_done(outcome,
+        info)`` fires exactly once when an accepted request finishes
+        (the chaos harness pairs its ``faults.jsonl`` rows through it).
+        """
+        try:
+            n = int(devices)
+        except (TypeError, ValueError):
+            n = -1
+        if n < 1:
+            _M_RESIZES.inc(outcome="rejected")
+            return False, f"bad device count {devices!r}"
+        cur = self._current_devices()
+        with self._lock:
+            if self._pending is not None or self._window is not None:
+                _M_RESIZES.inc(outcome="rejected")
+                return False, "a resize is already in flight"
+            if cur is not None and n == int(cur):
+                _M_RESIZES.inc(outcome="rejected")
+                return False, f"already at {n} device(s)"
+            self._pending = {
+                "devices": n, "source": str(source), "on_done": on_done,
+                "t_req": time.time(),
+            }
+        logger.warning(
+            "elastic: resize %s -> %d devices requested (source=%s)",
+            cur if cur is not None else "?", n, source,
+        )
+        return True, f"resize to {n} device(s) pending"
+
+    def install_signal_handler(self, signum: int = signal_mod.SIGUSR2) -> bool:
+        """SIGUSR2 contract: the target device count is read from
+        ``<logdir>/resize_devices`` at delivery time; a missing or invalid
+        file means "grow back to all visible devices".  Returns False when
+        not on the main thread (signal.signal would raise)."""
+
+        def _handler(_sig, _frame):
+            self.request_resize(self._devices_from_file(), source="signal")
+
+        try:
+            signal_mod.signal(signum, _handler)
+        except ValueError:
+            logger.error(
+                "elastic: cannot install the resize signal handler off the "
+                "main thread"
+            )
+            return False
+        return True
+
+    def _devices_from_file(self) -> int:
+        if self._devices_file:
+            try:
+                with open(self._devices_file) as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                pass
+        try:
+            import jax  # noqa: PLC0415
+
+            return len(jax.devices())
+        except Exception:
+            return self._current_devices() or 1
+
+    def routes(self) -> dict:
+        """StatusServer extra routes: ``GET /resizez`` (live state) and
+        ``POST /resizez?devices=N`` (request; 400 bad count, 409 already
+        in flight)."""
+
+        def _get(_query):
+            return 200, self.status()
+
+        def _post(query, body: bytes):
+            from urllib.parse import parse_qs  # noqa: PLC0415
+
+            dev = (parse_qs(query).get("devices") or [None])[0]
+            if dev is None and body:
+                try:
+                    import json  # noqa: PLC0415
+
+                    dev = json.loads(body.decode("utf-8", "replace")) \
+                        .get("devices")
+                except (ValueError, AttributeError):
+                    dev = None
+            ok, msg = self.request_resize(dev, source="api")
+            if ok:
+                status = 200
+            else:
+                status = 400 if "bad device count" in msg else 409
+            return status, {"ok": ok, "message": msg, **self.status()}
+
+        return {("GET", "/resizez"): _get, ("POST", "/resizez"): _post}
+
+    # -- Callback hooks (drain + window close) -------------------------------
+
+    def on_fit_begin(self, trainer, state) -> None:
+        trainer.elastic = self
+        with self._lock:
+            performed = bool(self._window and self._window.get("performed"))
+        if performed:
+            # The resized fit is running again: the window — drain, save,
+            # mesh re-form, ZeRO rechunk, input rebuild — is over.
+            self._close_window("completed", resumed_step=int(state.step))
+
+    def on_step_end(self, trainer, step: int, state, metrics) -> None:
+        with self._lock:
+            if self._pending is None or self._window is not None:
+                return
+            p = self._pending
+            self._window = {
+                "t0": time.time(),
+                "from_devices": self._current_devices() or 0,
+                "to_devices": int(p["devices"]),
+                "source": p["source"],
+                "on_done": p.get("on_done"),
+                "drain_step": int(step),
+                "anchor_step": getattr(trainer, "_last_ckpt_step", None),
+                "performed": False,
+            }
+            self._draining = True
+            w = self._window
+        obs.goodput.mark_resize_begin()
+        obs.record_event(
+            "resize_begin", step=int(step),
+            from_devices=w["from_devices"], to_devices=w["to_devices"],
+            source=w["source"],
+        )
+        logger.warning(
+            "elastic: draining at step %d for resize %d -> %d (pre-resize "
+            "checkpoint: step %s)", step, w["from_devices"],
+            w["to_devices"], w["anchor_step"],
+        )
+        trainer.stop_training = True
+
+    # -- the resize itself (called by the outer loop) ------------------------
+
+    @property
+    def draining(self) -> bool:
+        """True between the drain request and the fit's exit — the
+        supervisor classifies a TimeoutError in this window as
+        ``resize_drain``, not ``data_stall``."""
+        return self._draining
+
+    @property
+    def pending_target(self) -> int | None:
+        with self._lock:
+            return self._pending["devices"] if self._pending else None
+
+    def should_perform(self, step: int, total_steps: int | None = None) -> bool:
+        """After a clean fit exit: is there a drained resize to execute?
+        A request that outlived the run (``step >= total_steps``) is
+        rejected here so its bookkeeping still closes."""
+        with self._lock:
+            has_pending = self._pending is not None
+        if not has_pending:
+            return False
+        if total_steps is not None and int(step) >= int(total_steps):
+            self._reject_pending("run complete")
+            return False
+        return True
+
+    def perform(self, state):
+        """Execute the drained resize; returns the state restored at the
+        new device count.  Raises whatever ``resize_fn`` raises — the
+        caller routes the failure through the normal restart path and
+        :meth:`abandon` closes the window as ``failed``."""
+        with self._lock:
+            p, self._pending = self._pending, None
+            self._draining = False
+            if p is not None and self._window is None:
+                # The request landed after the last dispatch boundary (no
+                # on_step_end fired): open the window here so the
+                # begin/end pair still books.
+                self._window = {
+                    "t0": time.time(),
+                    "from_devices": self._current_devices() or 0,
+                    "to_devices": int(p["devices"]),
+                    "source": p["source"],
+                    "on_done": p.get("on_done"),
+                    "drain_step": int(getattr(state, "step", 0)),
+                    "anchor_step": None,
+                    "performed": False,
+                }
+                late_open = self._window
+            else:
+                late_open = None
+            w = self._window
+        if p is None:
+            return state
+        if late_open is not None:
+            obs.goodput.mark_resize_begin()
+            obs.record_event(
+                "resize_begin", step=late_open["drain_step"],
+                from_devices=late_open["from_devices"],
+                to_devices=late_open["to_devices"],
+                source=late_open["source"],
+            )
+        if self.resize_fn is None:
+            raise RuntimeError("elastic: no resize_fn wired")
+        target = int(p["devices"])
+        logger.warning(
+            "elastic: re-forming mesh %d -> %d devices (drained at step %d)",
+            w["from_devices"], target, w["drain_step"],
+        )
+        new_state = self.resize_fn(target, state)
+        with self._lock:
+            if self._window is not None:
+                self._window["performed"] = True
+        return new_state
+
+    def abandon(self, reason: str = "restart") -> None:
+        """Supervisor restart path: close an in-flight window as
+        ``failed`` and DROP any pending request — the restart resumes
+        from the pre-resize checkpoint at the old device count, and the
+        resize is not re-run."""
+        with self._lock:
+            p, self._pending = self._pending, None
+            self._draining = False
+            has_window = self._window is not None
+        if has_window:
+            self._close_window("failed", error=str(reason))
+        elif p is not None:
+            _M_RESIZES.inc(outcome="rejected")
+            self._finish(p.get("on_done"), "rejected",
+                         {"reason": str(reason)})
+
+    # -- window close --------------------------------------------------------
+
+    def _reject_pending(self, reason: str) -> None:
+        with self._lock:
+            p, self._pending = self._pending, None
+            self._draining = False
+            has_window = self._window is not None
+        if has_window:
+            self._close_window("rejected", error=reason)
+        elif p is not None:
+            _M_RESIZES.inc(outcome="rejected")
+            self._finish(p.get("on_done"), "rejected", {"reason": reason})
+
+    def _close_window(self, outcome: str, *, resumed_step: int | None = None,
+                      error: str | None = None) -> None:
+        with self._lock:
+            w, self._window = self._window, None
+            self._draining = False
+        if w is None:
+            return
+        dur = obs.goodput.mark_resize_end()
+        if not dur:
+            dur = max(time.time() - float(w["t0"]), 0.0)
+        row = {
+            "outcome": outcome,
+            "from_devices": w["from_devices"],
+            "to_devices": w["to_devices"],
+            "source": w["source"],
+            "drain_step": w["drain_step"],
+            "anchor_step": w["anchor_step"],
+            "resumed_step": resumed_step,
+            "duration_s": round(dur, 3),
+            "t": time.time(),
+        }
+        if error:
+            row["error"] = error[:300]
+        fields = {k: v for k, v in row.items() if k != "t" and v is not None}
+        obs.record_event(
+            "resize_end",
+            step=int(resumed_step if resumed_step is not None
+                     else w["drain_step"]),
+            **fields,
+        )
+        _M_RESIZES.inc(outcome=outcome)
+        self.history.append(row)
+        logger.warning(
+            "elastic: resize %d -> %d %s in %.2fs",
+            w["from_devices"], w["to_devices"], outcome, dur,
+        )
+        info = {
+            "resumed_step": (resumed_step if resumed_step is not None
+                             else w["drain_step"]),
+            "duration_s": row["duration_s"],
+        }
+        self._finish(w.get("on_done"), outcome, info)
+
+    def _finish(self, on_done, outcome: str, info: dict) -> None:
+        if on_done is None:
+            return
+        try:
+            on_done(outcome, info)
+        except Exception:
+            logger.exception("elastic: resize on_done callback failed")
+
+    # -- state ---------------------------------------------------------------
+
+    def _current_devices(self) -> int | None:
+        if self.current_devices_fn is None:
+            return None
+        try:
+            return int(self.current_devices_fn())
+        except Exception:
+            return None
+
+    def status(self) -> dict:
+        """The ``/resizez`` (and ``/statusz`` ``elastic``) payload."""
+        with self._lock:
+            pending = (
+                {k: v for k, v in self._pending.items() if k != "on_done"}
+                if self._pending else None
+            )
+            window = (
+                {k: v for k, v in self._window.items() if k != "on_done"}
+                if self._window else None
+            )
+            recent = [dict(r) for r in self.history[-5:]]
+            draining = self._draining
+        counts: dict[str, int] = {}
+        for r in self.history:
+            counts[r["outcome"]] = counts.get(r["outcome"], 0) + 1
+        return {
+            "devices": self._current_devices(),
+            "pending": pending,
+            "in_flight": window,
+            "draining": draining,
+            "resizes": dict(counts),
+            "recent": recent,
+        }

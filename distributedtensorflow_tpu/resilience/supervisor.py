@@ -67,12 +67,20 @@ def classify_failure(
     preempted: bool = False,
     nan_anomaly: bool = False,
     watchdog_fired: bool = False,
+    resize_draining: bool = False,
 ) -> str:
     """The failure-classification table (module docstring, rule order):
     chaos faults carry their kind; known exception types map to kinds; a
     fired watchdog turns an otherwise-unknown failure into ``data_stall``;
     everything else is ``unknown`` (still retried — an unknown crash is
-    exactly what a restart policy is for)."""
+    exactly what a restart policy is for).
+
+    ``resize_draining``: a timeout while the ElasticController is draining
+    the fit is the DRAIN wedging, not a dead input pipeline — classifying
+    it ``data_stall`` restarted from the wrong state (and re-ran the
+    resize that just wedged).  ``resize_drain`` is retryable and the
+    restart path abandons the resize, falling back to the pre-resize
+    checkpoint."""
     if preempted:
         return "preemption"
     if exc is None:
@@ -84,11 +92,11 @@ def classify_failure(
     if isinstance(exc, StopIteration):
         return "data_exhausted"
     if isinstance(exc, TimeoutError):
-        return "data_stall"
+        return "resize_drain" if resize_draining else "data_stall"
     if isinstance(exc, FloatingPointError):
         return "nan_loss"
     if watchdog_fired:
-        return "data_stall"
+        return "resize_drain" if resize_draining else "data_stall"
     return "unknown"
 
 
@@ -182,6 +190,7 @@ class Supervisor:
         eval_iter_fn: Callable[[], Iterable] | None = None,
         config: SupervisorConfig | None = None,
         chaos: chaos_lib.ChaosInjector | None = None,
+        elastic=None,
     ):
         self.trainer = trainer
         self.config = config or SupervisorConfig()
@@ -189,6 +198,10 @@ class Supervisor:
         self._state_template_fn = state_template_fn
         self._eval_iter_fn = eval_iter_fn
         self._chaos = chaos
+        #: resilience.ElasticController (or None): drained resizes are
+        #: performed inside the supervised loop, so a mid-resize crash
+        #: falls into the same classify→restore→re-enter path.
+        self._elastic = elastic
         self._nan_watch = _NanWatch()
         trainer.callbacks.append(self._nan_watch)
         #: Per-restart history: {"kind", "step", "attempt", "resumed_step",
@@ -244,6 +257,24 @@ class Supervisor:
                     kind = "preemption"
                 elif self._nan_watch.tripped() and int(state.step) < total:
                     kind = "nan_loss"
+                elif self._elastic is not None and self._elastic \
+                        .should_perform(int(state.step), total):
+                    # The fit drained for a resize: re-form the mesh and
+                    # rechunk INSIDE the supervised loop, so a mid-resize
+                    # crash is classified/restored like any other failure
+                    # (abandon() in _restart falls back to the pre-resize
+                    # checkpoint at the old size).
+                    try:
+                        state = self._elastic.perform(state)
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except BaseException as e:  # noqa: BLE001
+                        exc = e
+                        t_fail = time.time()
+                        kind = classify_failure(e)
+                        step_now = None
+                    else:
+                        continue
                 else:
                     # Done: target reached, total_steps hit, or a
                     # user-requested stop — none of which is a failure.
@@ -254,6 +285,9 @@ class Supervisor:
                     exc,
                     watchdog_fired=bool(
                         getattr(trainer, "watchdog_fired", False)
+                    ),
+                    resize_draining=bool(
+                        self._elastic is not None and self._elastic.draining
                     ),
                 )
             failures.append({
@@ -294,6 +328,13 @@ class Supervisor:
         trainer = self.trainer
         cfg = self.config
         attempt = len(self.restarts) + 1
+        # A resize in flight does NOT survive a restart: close its window
+        # as failed and drop the pending request BEFORE booking the
+        # restart badput (the resize window's residual stops here), so
+        # the restore below lands on the pre-resize checkpoint and the
+        # resize is not re-run.
+        if self._elastic is not None:
+            self._elastic.abandon(reason=kind)
         backoff = cfg.backoff_s(attempt)
         logger.warning(
             "supervisor: restart %d/%d after %s — backing off %.2fs",

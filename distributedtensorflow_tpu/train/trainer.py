@@ -255,6 +255,9 @@ class Trainer:
         #: {"restarts", "max_restarts", "last_failure", ...} — surfaced on
         #: /statusz so a curl of a restarting run shows the retry budget.
         self.supervisor_status: dict | None = None
+        #: Set by resilience.ElasticController.on_fit_begin while one is
+        #: attached: /statusz reports live resize state under "elastic".
+        self.elastic = None
         # Last log-boundary record + step — what /statusz and /healthz
         # report (plain dict reads under the GIL; handlers never sync).
         self._last_record: dict = {}
@@ -952,6 +955,8 @@ class Trainer:
             }
         if self.supervisor_status:
             out["supervisor"] = dict(self.supervisor_status)
+        if self.elastic is not None:
+            out["elastic"] = self.elastic.status()
         if self.capture is not None:
             cap_state = self.capture.state()
             out["captures"] = {

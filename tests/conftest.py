@@ -47,6 +47,7 @@ _PROCESS_TEST_FILES = {
     "test_train_introspection_smoke.py",
     "test_train_auto_profile_smoke.py",
     "test_train_chaos_smoke.py",
+    "test_train_elastic_smoke.py",
     "test_train_dynamics_smoke.py",
     "test_train_netchaos_smoke.py",
     "test_train_zero_smoke.py",

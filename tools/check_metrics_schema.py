@@ -248,7 +248,7 @@ DEFAULT_DYNAMICS_GLOB = os.path.join(
 GOODPUT_BUCKETS = (
     "init", "compile", "train_step", "data_wait", "checkpoint_save",
     "checkpoint_restore", "eval", "preemption_drain", "profile_capture",
-    "lost_work", "badput_restart", "other",
+    "resize", "lost_work", "badput_restart", "other",
 )
 
 #: The known capture trigger kinds (obs/capture.py TRIGGERS — duplicated
@@ -263,10 +263,15 @@ CAPTURE_TRIGGERS = (
 #: ``dispatcher_kill`` kinds are transport-recovered, ISSUE 13).
 FAULT_KINDS = (
     "nan_loss", "checkpoint_truncate", "worker_kill", "data_stall",
-    "preemption",
+    "preemption", "resize",
     "net_delay", "net_drop", "net_sever", "dispatcher_kill",
 )
 FAULT_PHASES = ("injected", "recovered")
+
+#: ``elastic_resizes_total`` outcome label values
+#: (resilience/elastic.py RESIZE_OUTCOMES — duplicated for the same
+#: stdlib-only reason).
+ELASTIC_RESIZE_OUTCOMES = ("completed", "failed", "rejected")
 
 #: Resilient-transport label sets (net/rpc.py, net/breaker.py —
 #: duplicated for the same stdlib-only reason).  Endpoint identities are
@@ -492,6 +497,16 @@ def check_row(row, lineno: int) -> tuple[list[str], list[str]]:
                     f"line {lineno}: field {k!r} carries unknown resize "
                     f"direction {m.group(1)!r} "
                     f"(known: {PREFETCH_DIRECTIONS})"
+                )
+        if k.startswith("elastic_resizes_total"):
+            # flattened ``outcome`` label of the elastic-resize counter:
+            # an unknown outcome forks the resize success-rate series
+            m = _FLAT_OUTCOME_RE.search(k)
+            if m and m.group(1) not in ELASTIC_RESIZE_OUTCOMES:
+                errors.append(
+                    f"line {lineno}: field {k!r} carries unknown resize "
+                    f"outcome {m.group(1)!r} "
+                    f"(known: {ELASTIC_RESIZE_OUTCOMES})"
                 )
         if k.startswith("fleet_peers"):
             m = _FLAT_STATE_RE.search(k)
@@ -1871,6 +1886,14 @@ def check_prom_file(path: str) -> tuple[list[str], list[str]]:
                             )
                     except ValueError:
                         pass  # already reported above
+            if name.startswith("elastic_resizes_total"):
+                labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
+                outcome = labels.get("outcome")
+                if outcome not in ELASTIC_RESIZE_OUTCOMES:
+                    errors.append(
+                        f"line {i}: {name} carries unknown resize outcome "
+                        f"{outcome!r} (known: {ELASTIC_RESIZE_OUTCOMES})"
+                    )
             if name.startswith("dynamics_"):
                 labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
                 module = labels.get("module")
@@ -2542,6 +2565,7 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
     warnings: list[str] = []
     prev_t: float | None = None
     prev_id: int | None = None
+    resize_events: list[tuple[int, dict]] = []
     with open(path) as f:
         for i, line in enumerate(f, start=1):
             line = line.strip()
@@ -2554,6 +2578,10 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
                 continue
             if flight:
                 e, w, prev_t = check_flight_row(row, i, prev_t)
+                if isinstance(row, dict) and row.get("kind") in (
+                    "resize_begin", "resize_end"
+                ):
+                    resize_events.append((i, row))
             elif captures:
                 e, w, prev_id = check_capture_row(row, i, prev_id,
                                                   manifest_dir)
@@ -2561,6 +2589,77 @@ def check_file(path: str) -> tuple[list[str], list[str]]:
                 e, w = check_row(row, i)
             errors.extend(e)
             warnings.extend(w)
+    if resize_events:
+        e, w = _check_resize_pairing(resize_events)
+        errors.extend(e)
+        warnings.extend(w)
+    return errors, warnings
+
+
+def _check_resize_pairing(
+    events: list[tuple[int, dict]],
+) -> tuple[list[str], list[str]]:
+    """Elastic-resize window invariants over one flight dump:
+    ``resize_begin``/``resize_end`` strictly alternate (every window
+    closes, none nests), device counts are positive and actually change,
+    and ``resize_end`` carries a known ``outcome``.  The flight ring is
+    bounded, so a dump whose FIRST resize event is an ``end`` merely lost
+    its ``begin`` to rotation — warned, not an error."""
+    errors: list[str] = []
+    warnings: list[str] = []
+    open_line: int | None = None
+
+    def _devices(lineno: int, row: dict) -> None:
+        frm, to = row.get("from_devices"), row.get("to_devices")
+        for name, v in (("from_devices", frm), ("to_devices", to)):
+            if not _nonneg_int(v) or int(v) <= 0:
+                errors.append(
+                    f"line {lineno}: {row.get('kind')} {name!r} {v!r} is "
+                    "not a positive integer"
+                )
+                return
+        if int(frm) == int(to):
+            errors.append(
+                f"line {lineno}: {row.get('kind')} from_devices == "
+                f"to_devices ({int(frm)}) — a resize must change the "
+                "device count"
+            )
+
+    for idx, (lineno, row) in enumerate(events):
+        kind = row.get("kind")
+        _devices(lineno, row)
+        if kind == "resize_begin":
+            if open_line is not None:
+                errors.append(
+                    f"line {lineno}: resize_begin while the window from "
+                    f"line {open_line} is still open (windows must not "
+                    "nest)"
+                )
+            open_line = lineno
+        else:  # resize_end
+            if open_line is None:
+                if idx == 0:
+                    warnings.append(
+                        f"line {lineno}: resize_end without a begin — "
+                        "its resize_begin rotated out of the bounded ring"
+                    )
+                else:
+                    errors.append(
+                        f"line {lineno}: resize_end without an open "
+                        "resize_begin"
+                    )
+            open_line = None
+            outcome = row.get("outcome")
+            if outcome not in ELASTIC_RESIZE_OUTCOMES:
+                errors.append(
+                    f"line {lineno}: resize_end 'outcome' {outcome!r} not "
+                    f"in {ELASTIC_RESIZE_OUTCOMES}"
+                )
+    if open_line is not None:
+        errors.append(
+            f"line {open_line}: resize_begin never closed by a "
+            "resize_end (the resize window leaked)"
+        )
     return errors, warnings
 
 

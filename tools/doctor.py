@@ -54,6 +54,9 @@ FAULT_EXPECTED_ALERTS = {
     "nan_loss": ("anomaly",),
     "preemption": ("absence",),
     "checkpoint_truncate": (),
+    # Elastic resizes are controller-recovered (resilience/elastic.py):
+    # the drain→rechunk→resume window is deliberate downtime, not damage.
+    "resize": (),
 }
 
 #: Flight-event kinds that are damage (evidence), not causes.
@@ -143,6 +146,33 @@ class Streams:
             {"t": a, "gap_s": b - a}
             for a, b in zip(ts, ts[1:]) if b - a >= bound
         ]
+
+    def resize_windows(self) -> list[dict]:
+        """Paired elastic-resize windows from the flight stream.  The
+        drain→rechunk→resume gap is DELIBERATE downtime: step-stall
+        evidence inside one of these must not score as a wedge."""
+        wins: list[dict] = []
+        t0: float | None = None
+        for e in self.flight:
+            k = e.get("kind")
+            if k == "resize_begin" and _finite(e.get("t")):
+                t0 = float(e["t"])
+            elif k == "resize_end" and _finite(e.get("t")):
+                t1 = float(e["t"])
+                dur = e.get("duration_s")
+                wins.append({
+                    "t0": t0 if t0 is not None else t1,
+                    "t1": t1,
+                    "outcome": e.get("outcome"),
+                    "from_devices": e.get("from_devices"),
+                    "to_devices": e.get("to_devices"),
+                    "duration_s": (
+                        float(dur) if _finite(dur)
+                        else round(t1 - t0, 3) if t0 is not None else None
+                    ),
+                })
+                t0 = None
+        return wins
 
     def failed_requests(self) -> list[dict]:
         return [r for r in self.requests
@@ -234,7 +264,10 @@ def _collect_window_evidence(s: Streams, kind: str | None, t0: float,
             ev.append(_cite("flight.jsonl", t,
                             f"{e.get('kind')} event +{t - t0:.1f}s "
                             "after onset"))
+    resize_wins = s.resize_windows()
     for stall in s.step_stalls():
+        if any(w["t0"] <= stall["t"] <= w["t1"] for w in resize_wins):
+            continue  # deliberate elastic-resize downtime, not a wedge
         if t0 <= stall["t"] <= t1:
             score += 2.0
             ev.append(_cite(
@@ -378,6 +411,35 @@ def diagnose(logdirs: list[str], *, window_s: float = 60.0,
     for rank, h in enumerate(hypotheses, start=1):
         h["rank"] = rank
     spans = [sp for s in streams if (sp := s.span()) is not None]
+    # Elasticity: resize count, per-resize wall cost, goodput share —
+    # surfaced so deliberate resize downtime reads as capacity change,
+    # not as the stalls it would otherwise look like.
+    resizes: list[dict] = []
+    bucket = wall = 0.0
+    for s in streams:
+        for w in s.resize_windows():
+            resizes.append(dict(w, logdir=s.logdir) if many
+                           else dict(w))
+        merged = ((s.goodput or {}).get("merged")
+                  if isinstance(s.goodput, dict) else None) or {}
+        b = merged.get("buckets") or {}
+        if _finite(b.get("resize")) and _finite(merged.get("wall_s")):
+            bucket += float(b["resize"])
+            wall += float(merged["wall_s"])
+    elasticity = None
+    if resizes:
+        costs = [w["duration_s"] for w in resizes
+                 if _finite(w.get("duration_s"))]
+        elasticity = {
+            "resizes": len(resizes),
+            "completed": sum(1 for w in resizes
+                             if w.get("outcome") == "completed"),
+            "failed": sum(1 for w in resizes
+                          if w.get("outcome") == "failed"),
+            "resize_wall_s": round(sum(costs), 3),
+            "goodput_share": (round(bucket / wall, 4) if wall else None),
+            "windows": resizes,
+        }
     return {
         "logdirs": logdirs,
         "streams": sum(s.stream_count() for s in streams),
@@ -385,6 +447,7 @@ def diagnose(logdirs: list[str], *, window_s: float = 60.0,
                         - min(a for a, _ in spans), 3) if spans else 0.0,
         "window_s": window_s,
         "parse_problems": list(problems),
+        "elasticity": elasticity,
         "hypotheses": hypotheses,
     }
 
@@ -395,6 +458,21 @@ def render(report: dict) -> str:
         f"{report['streams']} stream(s), spanning "
         f"{report['span_s']:.1f}s on one clock",
     ]
+    el = report.get("elasticity")
+    if el:
+        share = el.get("goodput_share")
+        lines.append(
+            f"  elasticity: {el['resizes']} resize(s) "
+            f"({el['completed']} completed, {el['failed']} failed), "
+            f"{el['resize_wall_s']:.1f}s total resize wall"
+            + (f", {100 * share:.1f}% of run wall" if share is not None
+               else ""))
+        for w in el["windows"]:
+            dur = w.get("duration_s")
+            lines.append(
+                f"    - {w.get('from_devices')} -> {w.get('to_devices')} "
+                f"devices, {w.get('outcome')}"
+                + (f", {dur:.2f}s" if _finite(dur) else ""))
     if not report["hypotheses"]:
         lines.append("  no root-cause hypotheses: no faults, no alerts, "
                      "no cause-grade events — the run looks healthy")

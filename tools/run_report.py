@@ -301,6 +301,54 @@ def resilience_summary(faults: list[dict], flight: list[dict],
     return out
 
 
+def elasticity_summary(flight: list[dict], goodput: dict) -> dict:
+    """The elastic-training digest: paired ``resize_begin``/``resize_end``
+    windows (count, outcomes, per-resize wall cost) plus the ``resize``
+    goodput bucket's share of run wall.  Empty when the run never
+    resized."""
+    windows: list[dict] = []
+    t0 = None
+    for e in flight:
+        kind = e.get("kind")
+        if kind == "resize_begin":
+            t0 = e.get("t")
+        elif kind == "resize_end":
+            dur = e.get("duration_s")
+            if not isinstance(dur, (int, float)) and \
+                    isinstance(t0, (int, float)) and \
+                    isinstance(e.get("t"), (int, float)):
+                dur = round(float(e["t"]) - float(t0), 3)
+            windows.append({
+                "from_devices": e.get("from_devices"),
+                "to_devices": e.get("to_devices"),
+                "outcome": e.get("outcome"),
+                "step": e.get("step"),
+                "resumed_step": e.get("resumed_step"),
+                "duration_s": dur,
+                "source": e.get("source"),
+            })
+            t0 = None
+    if not windows:
+        return {}
+    costs = [w["duration_s"] for w in windows
+             if isinstance(w["duration_s"], (int, float))]
+    out = {
+        "resizes": len(windows),
+        "completed": sum(1 for w in windows
+                         if w.get("outcome") == "completed"),
+        "failed": sum(1 for w in windows if w.get("outcome") == "failed"),
+        "resize_wall_s": round(sum(costs), 3),
+        "windows": windows,
+    }
+    bucket = (goodput.get("buckets") or {}).get("resize")
+    wall = goodput.get("wall_s")
+    if isinstance(bucket, (int, float)):
+        out["resize_bucket_s"] = bucket
+        if isinstance(wall, (int, float)) and wall > 0:
+            out["goodput_share"] = round(float(bucket) / float(wall), 4)
+    return out
+
+
 _ATTR_COMPONENTS = (
     ("queue", "attr_queue_s"),
     ("prefill", "attr_prefill_s"),
@@ -1202,6 +1250,7 @@ def build_report(logdir: str) -> dict:
         "captures": capture_summary(captures),
         "goodput": goodput,
         "resilience": resilience_summary(faults, flight, goodput),
+        "elasticity": elasticity_summary(flight, goodput),
         "serving": serving_summary(requests, train, steps_rows),
         "usage": usage_capacity_summary(usage_rows, steps_rows),
         "fleet": fleet,
@@ -1370,6 +1419,28 @@ def render(report: dict) -> str:
             lines.append(
                 f"  UNRECOVERED fault #{u['id']} {u['kind']} "
                 f"(step {u['step']})"
+            )
+    el = report.get("elasticity")
+    if el:
+        share = ""
+        if "goodput_share" in el:
+            share = f", {el['goodput_share'] * 100:.1f}% of run wall"
+        lines += [
+            "",
+            (
+                f"elasticity: {el['resizes']} resize(s) "
+                f"({el['completed']} completed, {el['failed']} failed), "
+                f"{el['resize_wall_s']:.2f} s total resize wall{share}"
+            ),
+        ]
+        for w in el["windows"]:
+            dur = w.get("duration_s")
+            cost = (f"{dur:.2f} s"
+                    if isinstance(dur, (int, float)) else "? s")
+            lines.append(
+                f"  {w.get('from_devices')} -> {w.get('to_devices')} "
+                f"devices at step {w.get('step')}: {w.get('outcome')} "
+                f"in {cost} (source {w.get('source')})"
             )
     srv = report.get("serving")
     if srv:

@@ -81,6 +81,7 @@ PID_GOODPUT = 4
 PID_STEPS = 5
 PID_ALERTS = 6
 PID_DYNAMICS = 7
+PID_RESIZE = 8
 #: --fleet: the shared cross-process trace group; per-logdir pids are
 #: offset by _FLEET_PID_STRIDE * index.
 PID_FLEET_TRACES = 90
@@ -239,6 +240,8 @@ def build_timeline(logdir: str) -> dict:
         _meta(events, PID_ALERTS, "alerts (alerts.jsonl)", 5)
     if dynamics:
         _meta(events, PID_DYNAMICS, "training dynamics (dynamics.jsonl)", 6)
+    if any(e.get("kind") in ("resize_begin", "resize_end") for e in flight):
+        _meta(events, PID_RESIZE, "elastic resizes (flight.jsonl)", 7)
 
     # -- flight events: one lane per kind, instants ---------------------------
     kind_tid: dict[str, int] = {}
@@ -340,6 +343,53 @@ def build_timeline(logdir: str) -> dict:
             "args": {k: v for k, v in c.items()
                      if not isinstance(v, (list, dict))},
         })
+
+    # -- elastic resize windows: paired begin/end flight events as bars -------
+    resize_open: dict | None = None
+    resize_emitted = False
+    for e in flight:
+        kind = e.get("kind")
+        if kind == "resize_begin":
+            resize_open = e
+        elif kind == "resize_end":
+            tb = _num(resize_open.get("t")) if resize_open else None
+            te = _num(e.get("t"))
+            if tb is None and te is not None:
+                # ring rotated the begin away: back the bar off by duration
+                d = _num(e.get("duration_s")) or 0.0
+                tb = te - d
+            if tb is None:
+                resize_open = None
+                continue
+            dur = _num(e.get("duration_s"))
+            if dur is None:
+                dur = max((te - tb) if te is not None else 0.0, 0.0)
+            label = (f"resize {e.get('from_devices', '?')} -> "
+                     f"{e.get('to_devices', '?')} ({e.get('outcome', '?')})")
+            events.append({
+                "ph": "X", "pid": PID_RESIZE, "tid": 1, "name": label,
+                "ts": round(tb * 1e6 - t0_us, 3),
+                "dur": round(dur * 1e6, 3),
+                "args": {k: v for k, v in e.items()
+                         if not isinstance(v, (list, dict))},
+            })
+            resize_emitted = True
+            resize_open = None
+    if resize_open is not None:
+        # open window with no end (run died mid-resize): an instant marker
+        t = _num(resize_open.get("t"))
+        if t is not None:
+            events.append({
+                "ph": "i", "s": "t", "pid": PID_RESIZE, "tid": 1,
+                "name": "resize (no end)",
+                "ts": round(t * 1e6 - t0_us, 3),
+                "args": {k: v for k, v in resize_open.items()
+                         if not isinstance(v, (list, dict))},
+            })
+            resize_emitted = True
+    if resize_emitted:
+        events.append({"ph": "M", "pid": PID_RESIZE, "tid": 1,
+                       "name": "thread_name", "args": {"name": "resizes"}})
 
     # -- goodput generations (+ restart gaps) ---------------------------------
     events.append({"ph": "M", "pid": PID_GOODPUT, "tid": 1,

@@ -186,6 +186,35 @@ while true; do
       fi
       tail_streams ARTIFACTS/pipe_1f1b_tpu
     fi
+    # Elastic resize on real chips (PR 20): 8 -> 4 -> 8 mid-run without a
+    # cold restart; --fault-plan drives both resizes so the item is
+    # hands-off.  Evidence = run_report's elasticity section (goodput
+    # `resize` bucket + paired resize_begin/resize_end flight events) and
+    # the schema gate over the logdir.  Pallas-free (xla attention), so it
+    # rides p2; on CPU dev boxes the same flow is covered by
+    # tests/test_train_elastic_smoke.py — this row is the real-chip proof.
+    if [ ! -f "$STAMPS/elastic" ]; then
+      if timeout 1200 env BENCH_SKIP_PROBE=1 bash -c '
+            mkdir -p ARTIFACTS/elastic_tpu &&
+            printf "%s" "{\"faults\": [{\"step\": 20, \"kind\": \"resize\", \"devices\": 4}, {\"step\": 40, \"kind\": \"resize\", \"devices\": 8}]}" \
+              > ARTIFACTS/elastic_tpu/plan.json &&
+            python train.py --workload gpt_lm --mesh data=-1 \
+              --steps 60 --log-every 10 --attn-impl xla \
+              --zero --data-service 2 --elastic \
+              --checkpoint-dir ARTIFACTS/elastic_tpu/ckpt \
+              --checkpoint-every 10 \
+              --fault-plan ARTIFACTS/elastic_tpu/plan.json \
+              --goodput --flight-recorder \
+              --logdir ARTIFACTS/elastic_tpu/logs &&
+            python tools/run_report.py ARTIFACTS/elastic_tpu/logs &&
+            python tools/check_metrics_schema.py ARTIFACTS/elastic_tpu/logs
+          ' >> "$LOG" 2>&1; then
+        touch "$STAMPS/elastic"; log "item elastic: LANDED"
+      else
+        log "item elastic: failed"; probe || break
+      fi
+      tail_streams ARTIFACTS/elastic_tpu/logs
+    fi
     # -- p3: Pallas rows (the default stack), canary-gated ---------------
     pallas_missing=0
     for s in "${PALLAS_STAMPS[@]}"; do
@@ -304,7 +333,7 @@ while true; do
 
   missing=0
   for s in lm_xla_cb16 conv_tpu resnet resnet_s2d resnet_records bert \
-           "${PALLAS_STAMPS[@]}"; do
+           pipe_sched elastic "${PALLAS_STAMPS[@]}"; do
     [ -f "$STAMPS/$s" ] || missing=$((missing+1))
   done
   if (( missing == 0 )); then log "ALL evidence landed"; exit 0; fi

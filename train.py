@@ -711,6 +711,15 @@ def main() -> None:
                         "backoff (doubles per restart)")
     p.add_argument("--restart-backoff-max", type=float, default=60.0,
                    help="clamp on the supervised-restart backoff")
+    p.add_argument("--elastic", action="store_true",
+                   help="live replica resize without a cold restart: on "
+                        "SIGUSR2 (target device count read from "
+                        "<logdir>/resize_devices) or POST /resizez?devices=N "
+                        "on --status-port, drain to the next checkpoint "
+                        "boundary, re-form the mesh at N devices, rechunk "
+                        "ZeRO optimizer state, and resume the SAME "
+                        "data-service epoch with exactly-once batch "
+                        "continuity. Requires --checkpoint-dir")
     p.add_argument("--flight-recorder", action="store_true",
                    help="record a bounded ring of structured events (step/"
                         "checkpoint/anomaly/preemption/compile markers), "
@@ -1001,6 +1010,15 @@ def main() -> None:
     wl = apply_optimizer_flags(wl, args)
     spec = parse_mesh(args.mesh) or wl.mesh_spec
     mesh = parallel.build_mesh(spec)
+    if args.elastic and not args.checkpoint_dir:
+        raise SystemExit(
+            "--elastic requires --checkpoint-dir (the resize drains to a "
+            "checkpoint boundary and restores through the verified-"
+            "manifest path at the new device count)"
+        )
+    # Keep the mesh-unbound workload: an elastic resize re-binds it
+    # against the re-formed mesh (for_mesh may specialise per-mesh).
+    base_wl = wl
     wl = wl.for_mesh(mesh)  # e.g. gpt_lm binds seq-parallel attention
     from distributedtensorflow_tpu.parallel.mesh import replica_count
 
@@ -1223,14 +1241,26 @@ def main() -> None:
 
     # Each (re)start consumes a FRESH service epoch so worker iterators
     # restart from batch 0 and the resume fast-forward lands correctly.
+    # An elastic resize is the exception: it resumes the SAME epoch, and
+    # the dispatcher's journaled per-split consumed counts (not a batch
+    # skip) position the successor client — exactly-once across the
+    # resize.  _live_iter tracks the current Prefetcher so the resize
+    # can close it deterministically (close flushes the consumed ledger
+    # to the dispatcher BEFORE the successor seeds from it).
     _ds_epoch = [0]
+    _elastic_resume = [False]
+    _live_iter: list = [None]
 
     def make_raw_iter():
         if data_service is not None:
             from distributedtensorflow_tpu.data import DataServiceClient
 
-            epoch = _ds_epoch[0]
-            _ds_epoch[0] += 1
+            if _elastic_resume[0]:
+                _elastic_resume[0] = False
+                epoch = _ds_epoch[0] - 1  # SAME epoch: journal-seeded
+            else:
+                epoch = _ds_epoch[0]
+                _ds_epoch[0] += 1
             return DataServiceClient(
                 data_service.target(),
                 epoch=epoch,
@@ -1261,18 +1291,23 @@ def main() -> None:
         host batches into one (k, B, ...) bundle per dispatch (host-side,
         BEFORE placement — the only ordering that works multi-host) and
         buffers 2 bundles so the transfer overlaps compute."""
+        # Elastic same-epoch resume: the dispatcher journal supplies the
+        # per-split position, so a step-count skip would double-skip.
+        same_epoch = _elastic_resume[0] and data_service is not None
         raw_iter = make_raw_iter()
-        if start_step > 0:
+        if start_step > 0 and not same_epoch:
             from distributedtensorflow_tpu.data import skip_batches
 
             logging.info("fast-forwarding input %d batches", start_step)
             raw_iter = skip_batches(iter(raw_iter), start_step)
-        return Prefetcher(
+        it = Prefetcher(
             raw_iter, mesh, buffer_size=args.prefetch_depth,
             bundle=args.steps_per_call,
             adaptive=args.adaptive_prefetch,
             bytes_budget=int(args.prefetch_budget_mb * 2**20),
         )
+        _live_iter[0] = it
+        return it
 
     # Chaos fault injection (resilience tentpole): a --fault-plan run
     # exercises the whole recovery stack — NaN restarts, checkpoint
@@ -1358,6 +1393,19 @@ def main() -> None:
             "%s/dynamics.jsonl", args.dynamics_every, args.logdir,
         )
 
+    # Elastic resize controller: a Callback that, on a resize request,
+    # drains the fit to the checkpoint boundary (stop_training) and hands
+    # the mesh re-formation to _perform_resize below (bound after the
+    # closures it needs exist).
+    elastic = None
+    if args.elastic:
+        from distributedtensorflow_tpu.resilience import ElasticController
+
+        elastic = ElasticController(
+            current_devices_fn=lambda: mesh.size,
+            logdir=args.logdir,
+        )
+
     trainer = Trainer(
         train_step,
         TrainerConfig(
@@ -1415,12 +1463,20 @@ def main() -> None:
         # The injector is a Callback: its on_step_end fires the
         # worker-kill / data-stall / preemption triggers.  The dynamics
         # monitor rides the same protocol (books cadence rows, flushes
-        # at log boundaries, runs NaN provenance on anomalies).
-        callbacks=[cb for cb in (chaos, dynamics_monitor)
+        # at log boundaries, runs NaN provenance on anomalies).  Chaos
+        # rides BEFORE elastic so a chaos-planned resize request drains
+        # at the very dispatch that fired it.
+        callbacks=[cb for cb in (chaos, dynamics_monitor, elastic)
                    if cb is not None] or None,
     )
     if dynamics_monitor is not None and trainer.status_server is not None:
         dynamics_monitor.install(trainer.status_server)
+    if elastic is not None:
+        elastic.install_signal_handler()
+        if trainer.status_server is not None:
+            trainer.status_server.routes.update(elastic.routes())
+        if chaos is not None:
+            chaos.attach_elastic(elastic)
 
     # Fleet observability plane (ISSUE 11): the chief scrapes every peer
     # StatusServer — itself, the data-service workers' embedded servers,
@@ -1577,6 +1633,132 @@ def main() -> None:
             eval_iter_fn = lambda: Prefetcher(
                 wl.input_fn(ctx, args.seed + 999), mesh
             )
+
+    def _perform_resize(n: int, cur_state):
+        """Re-form the run at ``n`` devices, in-process (elastic tentpole).
+
+        Runs BETWEEN fits: the drained state is already checkpointed
+        (Trainer's post-loop force-save).  Everything is staged against
+        fresh locals and committed only at the very end, so a failure
+        anywhere leaves the pre-resize bindings intact for the
+        supervisor's fallback restart."""
+        nonlocal mesh, wl, specs, zero_sharder, shard_div
+        nonlocal overlap_plan, train_step, eval_step
+        # 1) Close the live input iterator FIRST: Prefetcher.close()
+        #    closes the DataServiceClient underneath, which synchronously
+        #    flushes its CONSUMED-batch ledger to the dispatcher journal —
+        #    the successor client seeds its position from exactly that,
+        #    so buffered-but-untrained batches get re-served (no loss)
+        #    and trained ones never repeat (no duplicates).
+        it, _live_iter[0] = _live_iter[0], None
+        if it is not None:
+            try:
+                it.close()
+            except Exception:
+                logging.exception("resize: closing the old input iterator")
+        avail = len(jax.devices())
+        if not 0 < n <= avail:
+            raise ValueError(
+                f"resize to {n} devices: {avail} visible on this host"
+            )
+        # 2) Re-form the mesh from the SAME spec over a device prefix;
+        #    re-bind the mesh-unbound workload against it.
+        new_mesh = parallel.build_mesh(spec, jax.devices()[:n])
+        new_wl = base_wl.for_mesh(new_mesh)
+        new_div = replica_count(new_mesh)
+        if new_wl.global_batch_size % new_div:
+            raise ValueError(
+                f"resize to {n} devices: global batch "
+                f"{new_wl.global_batch_size} is not divisible by the new "
+                f"batch-sharding factor {new_div}"
+            )
+        new_zero = None
+        if args.zero and new_div > 1:
+            from distributedtensorflow_tpu.parallel.zero import ZeroSharder
+
+            new_zero = ZeroSharder(new_mesh)
+        # 3) Fresh sharded template at the new layout (same optimizer
+        #    INSTANCE — treedef identity), then the cross-degree restore:
+        #    restore_latest_zero rechunks the verified optimizer state
+        #    from the pre-resize ZeRO degree to the new one.
+        new_state, new_specs = create_sharded_state(
+            new_wl.init_fn, optimizer, new_mesh,
+            jax.random.PRNGKey(args.seed),
+            rules=new_wl.layout, fsdp=new_wl.fsdp, zero=new_zero,
+        )
+        from distributedtensorflow_tpu.parallel.zero import (
+            restore_latest_zero as _restore_z,
+        )
+
+        restored = _restore_z(checkpointer, new_state, new_mesh, new_zero)
+        if restored is None:
+            raise RuntimeError(
+                "resize: no usable checkpoint to restore at the new "
+                "device count (drain save missing or corrupt)"
+            )
+        if chaos is not None:
+            # A composed mid-resize worker_kill fires HERE — after the
+            # rechunk, before the commit — so the supervisor's fallback
+            # must recover to the PRE-resize bindings.
+            chaos.mid_resize_fault()
+        new_overlap = None
+        if args.overlap and new_div > 1:
+            from distributedtensorflow_tpu.parallel.overlap import (
+                OverlapPlan,
+            )
+            from distributedtensorflow_tpu.train.state import (
+                split_variables,
+            )
+
+            param_shapes, _ = split_variables(
+                jax.eval_shape(new_wl.init_fn, jax.random.PRNGKey(args.seed))
+            )
+            new_overlap = OverlapPlan.build(
+                new_mesh, param_shapes, new_specs.params, zero=new_zero,
+                bucket_bytes=int(args.overlap_bucket_mb * 2 ** 20),
+            )
+        if args.steps_per_call > 1:
+            from distributedtensorflow_tpu.train import make_multi_train_step
+
+            new_step = make_multi_train_step(
+                new_wl.loss_fn, new_mesh, new_specs,
+                steps_per_call=args.steps_per_call, accum_steps=accum,
+                overlap=new_overlap, dynamics_every=args.dynamics_every,
+            )
+        else:
+            new_step = make_train_step(
+                new_wl.loss_fn, new_mesh, new_specs, accum_steps=accum,
+                overlap=new_overlap, dynamics_every=args.dynamics_every,
+            )
+        if chaos is not None:
+            new_step = chaos.wrap_train_step(new_step)
+        if dynamics_monitor is not None:
+            new_step = dynamics_monitor.wrap_train_step(new_step)
+        new_eval = (
+            make_eval_step(new_wl.eval_fn, new_mesh, new_specs)
+            if new_wl.eval_fn else None
+        )
+        # 4) COMMIT — from here on the run IS at the new device count.
+        mesh, wl, specs = new_mesh, new_wl, new_specs
+        zero_sharder, shard_div, overlap_plan = new_zero, new_div, new_overlap
+        train_step, eval_step = new_step, new_eval
+        trainer.train_step = new_step
+        trainer.eval_step = new_eval
+        if preemption is not None:
+            preemption._mesh = new_mesh
+        if data_service is not None:
+            _elastic_resume[0] = True  # next iterator: SAME epoch, no skip
+        logging.warning(
+            "elastic: resized to %d device(s) (batch-sharding %d-way, "
+            "zero=%s) at step %d", n, new_div,
+            new_zero.degree if new_zero is not None else 0,
+            int(cur_state.step),
+        )
+        return restored
+
+    if elastic is not None:
+        elastic.resize_fn = _perform_resize
+
     supervise = chaos is not None or args.max_restarts > 0
     try:
         with trainer:  # closes the metric writer on every exit path
@@ -1613,6 +1795,7 @@ def main() -> None:
                         backoff_max_s=args.restart_backoff_max,
                     ),
                     chaos=chaos,
+                    elastic=elastic,
                 )
                 try:
                     state = supervisor.run(state, rng)
@@ -1640,9 +1823,20 @@ def main() -> None:
                     raise SystemExit(4)
             else:
                 train_iter = make_train_iter(restored_step)
-                state = trainer.fit(
-                    state, train_iter, rng, eval_iter_fn=eval_iter_fn
-                )
+                while True:
+                    state = trainer.fit(
+                        state, train_iter, rng, eval_iter_fn=eval_iter_fn
+                    )
+                    # An elastic drain ends the fit early (stop_training
+                    # after the boundary save); perform the resize and
+                    # re-enter at the restored step, same process.
+                    if elastic is not None and elastic.should_perform(
+                        int(state.step), args.steps
+                    ):
+                        state = elastic.perform(state)
+                        train_iter = make_train_iter(int(state.step))
+                        continue
+                    break
     except SystemExit:
         raise
     except BaseException:
